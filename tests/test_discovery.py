@@ -1,0 +1,136 @@
+"""Discovery layer: backends, fan-out, ID codec, generation table."""
+
+import os
+import queue
+
+import pytest
+
+from tpushare.plugin import const, discovery
+
+
+def test_fake_device_id_codec_roundtrip():
+    fid = discovery.fake_device_id("tpu-v4-accel0", 17)
+    assert fid == "tpu-v4-accel0-_-17"
+    assert discovery.real_chip_id(fid) == "tpu-v4-accel0"
+    # chip IDs containing the separator-ish content still round-trip
+    fid2 = discovery.fake_device_id("weird-_-id", 3)
+    assert discovery.real_chip_id(fid2) == "weird-_-id"
+
+
+def test_fan_out_one_v4_chip_gib():
+    be = discovery.FakeBackend(n_chips=1, generation="v4")
+    devs = discovery.fan_out(be.chips(), "GiB")
+    assert len(devs) == 32  # v4 = 32 GiB HBM -> 32 fake devices
+    assert all(idx == 0 for _, idx in devs)
+    assert devs[0][0].endswith("-_-0") and devs[-1][0].endswith("-_-31")
+
+
+def test_fan_out_multi_chip_and_mib():
+    be = discovery.FakeBackend(n_chips=4, generation="v5e")
+    devs = discovery.fan_out(be.chips(), "GiB")
+    assert len(devs) == 4 * 16
+    chip_indices = {idx for _, idx in devs}
+    assert chip_indices == {0, 1, 2, 3}
+    # MiB fan-out scales by 1024
+    one = discovery.FakeBackend(n_chips=1, hbm_gib=2)
+    assert len(discovery.fan_out(one.chips(), "MiB")) == 2048
+
+
+def test_generation_table_and_accelerator_type_parse():
+    gen, n = discovery.parse_accelerator_type("v4-16")
+    assert gen.name == "v4" and n == 16
+    assert gen.hbm_bytes == 32 * const.GIB
+    gen5, _ = discovery.parse_accelerator_type("v5litepod-8")
+    assert gen5.name == "v5e" and gen5.hbm_bytes == 16 * const.GIB
+    with pytest.raises(ValueError):
+        discovery.parse_accelerator_type("h100-8")
+    with pytest.raises(ValueError):
+        discovery.parse_accelerator_type("v99-8")
+
+
+def test_fake_backend_health_injection():
+    be = discovery.FakeBackend(n_chips=2)
+    be.init()
+    be.inject_health(1, healthy=False, reason="test")
+    ev = be.health_events().get_nowait()
+    assert ev.chip_index == 1 and not ev.healthy
+    with pytest.raises(queue.Empty):
+        be.health_events().get_nowait()
+
+
+def test_metadata_backend_dev_glob(tmp_path):
+    # simulate /dev/accel1, /dev/accel0, /dev/accel10 — numeric ordering
+    for i in (1, 0, 10):
+        (tmp_path / f"accel{i}").touch()
+    be = discovery.MetadataBackend(
+        dev_glob=str(tmp_path / "accel*"),
+        accelerator_type="v5e-4",
+        metadata_timeout=0.01,
+    )
+    chips = be.chips()
+    # index is the device node's own number, robust to sparse /dev
+    assert [c.index for c in chips] == [0, 1, 10]
+    assert [os.path.basename(c.dev_paths[0]) for c in chips] == [
+        "accel0", "accel1", "accel10"]
+    assert all(c.hbm_bytes == 16 * const.GIB for c in chips)
+    assert all(c.generation == "v5e" for c in chips)
+
+
+def test_metadata_backend_garbage_accelerator_type_falls_back(tmp_path):
+    (tmp_path / "accel0").touch()
+    be = discovery.MetadataBackend(
+        dev_glob=str(tmp_path / "accel*"),
+        accelerator_type="tpu-vX-banana",
+        metadata_timeout=0.01,
+    )
+    chips = be.chips()  # must not raise: daemon would crash-loop on bad metadata
+    # fail-safe: unknown generation rounds DOWN (never overadvertise HBM)
+    assert len(chips) == 1 and chips[0].generation == "unknown"
+    assert chips[0].hbm_bytes == discovery.FALLBACK_GENERATION.hbm_bytes
+
+
+def test_metadata_backend_no_devices(tmp_path):
+    be = discovery.MetadataBackend(
+        dev_glob=str(tmp_path / "accel*"),
+        vfio_glob=str(tmp_path / "vfio/[0-9]*"),
+        accelerator_type="v4-8",
+        metadata_timeout=0.01,
+    )
+    assert be.chips() == []
+
+
+def test_health_watcher_detects_node_loss(tmp_path):
+    dev = tmp_path / "accel0"
+    dev.touch()
+    chip = discovery.Chip(index=0, id="c0", dev_paths=(str(dev),),
+                          hbm_bytes=const.GIB, cores=1)
+    q = queue.Queue()
+    w = discovery.HealthWatcher([chip], q, interval=0.02)
+    w.start()
+    try:
+        dev.unlink()
+        ev = q.get(timeout=2.0)
+        assert ev.chip_index == 0 and not ev.healthy
+        dev.touch()
+        ev2 = q.get(timeout=2.0)
+        assert ev2.healthy  # recovery path (reference lacks this; server.go:180 FIXME)
+    finally:
+        w.stop()
+        w.join(timeout=2.0)
+
+
+def test_make_backend_factory():
+    assert isinstance(discovery.make_backend("fake"), discovery.FakeBackend)
+    assert isinstance(discovery.make_backend("metadata"),
+                      discovery.MetadataBackend)
+    with pytest.raises(ValueError):
+        discovery.make_backend("cuda")
+
+
+def test_libtpu_backend_falls_back_without_shim(tmp_path):
+    be = discovery.LibtpuBackend(shim_path=str(tmp_path / "nope.so"))
+    be._fallback = discovery.MetadataBackend(
+        dev_glob=str(tmp_path / "accel*"), accelerator_type="v4-8",
+        metadata_timeout=0.01)
+    be.init()
+    assert be.chips() == []  # no devices in tmp; no crash without shim

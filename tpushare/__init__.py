@@ -1,0 +1,24 @@
+"""tpushare — TPU-native fractional-accelerator sharing for Kubernetes.
+
+A ground-up rebuild of the capabilities of
+``AliyunContainerService/gpushare-device-plugin`` for TPU hardware:
+
+* ``tpushare.plugin``  — the node daemon: a Kubernetes *device plugin* that
+  advertises each TPU chip's HBM as a schedulable fractional resource
+  (``aliyun.com/tpu-mem``), co-locating multiple JAX pods per chip
+  (reference: ``pkg/gpu/nvidia/``).
+* ``tpushare.inspect`` — ``kubectl-inspect-tpushare``, the cluster-wide
+  binpacking report CLI (reference: ``cmd/inspect/``).
+* ``tpushare.kubelet`` / ``tpushare.k8s`` — control-plane clients
+  (reference: ``pkg/kubelet/client/`` and client-go usage).
+* ``tpushare.runtime`` / ``tpushare.parallel`` / ``tpushare.models`` /
+  ``tpushare.ops`` / ``tpushare.serving`` — the workload plane: JAX-native
+  libraries that *consume* the env contract the plugin injects
+  (visible chips, process bounds, HBM fraction) and run sharded
+  inference/training on the allocated slice of a chip.
+
+The control plane is deliberately stateless: all allocation state lives in
+the cluster (node capacity, pod annotations), exactly as in the reference.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,13 @@
+"""Node-daemon side of tpushare: discovery, device-plugin server, allocation.
+
+Layer map (mirrors SURVEY.md §1 for the reference's ``pkg/gpu/nvidia``):
+
+* ``const``      — resource names, socket path, annotation/env protocol keys.
+* ``discovery``  — chip discovery backends (fake / metadata / libtpu shim)
+  and the fake-device fan-out (1 fake device per GiB/MiB of HBM).
+* ``server``     — the kubelet device-plugin gRPC server
+  (Register / ListAndWatch / Allocate / PreStartContainer).
+* ``allocate``   — the pod↔request matching algorithm and TPU env injection.
+* ``podmanager`` / ``podutils`` — pod-state layer over the apiserver/kubelet.
+* ``manager``    — process lifecycle: restart loop, signal handling.
+"""

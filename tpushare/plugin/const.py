@@ -1,0 +1,77 @@
+"""Protocol constants for tpushare.
+
+This is the TPU analog of the reference's ``pkg/gpu/nvidia/const.go:1-36``:
+resource names, the device-plugin socket, and the scheduler-extender
+annotation/env protocol.  The annotation handshake (assume-time +
+assigned-flag) is kept wire-compatible in *shape* with the gpushare
+scheduler extender so its mem-binpack policy can be reused unchanged over
+the new resource name (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+# --- schedulable resources -------------------------------------------------
+# Fractional resource: 1 unit == 1 GiB (or MiB, see MemoryUnit) of TPU HBM.
+RESOURCE_NAME = "aliyun.com/tpu-mem"
+# Whole-chip count, patched onto node capacity for the extender's use.
+COUNT_NAME = "aliyun.com/tpu-count"
+
+# --- kubelet device-plugin contract ---------------------------------------
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+SERVER_SOCKET = DEVICE_PLUGIN_PATH + "tpushare.sock"
+API_VERSION = "v1beta1"
+
+DEVICE_HEALTHY = "Healthy"
+DEVICE_UNHEALTHY = "Unhealthy"
+
+# --- scheduler-extender annotation protocol --------------------------------
+# Written by the extender at bind time, read+patched by the plugin at
+# Allocate time (reference: const.go:25-31).
+ANN_TPU_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"          # chosen chip index
+ANN_TPU_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"          # pod's total tpu-mem
+ANN_TPU_MEM_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"
+ANN_TPU_MEM_ASSIGNED = "ALIYUN_COM_TPU_MEM_ASSIGNED"  # "false" -> "true"
+# New-style extender annotation: JSON {devIndex: {podUID: mem}} allocation map.
+ANN_TPU_ALLOCATION = "scheduler.framework.tpushare.allocation"
+
+# --- env vars injected into allocated containers ---------------------------
+# TPU runtime contract (consumed by libtpu/JAX in the workload container):
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+ENV_XLA_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+ENV_TPU_RUNTIME_METRICS_PORTS = "TPU_RUNTIME_METRICS_PORTS"
+# Bookkeeping envs (reference: allocate.go:113-128):
+ENV_TPU_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"
+ENV_TPU_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"
+ENV_TPU_MEM_CONTAINER = "ALIYUN_COM_TPU_MEM_CONTAINER"
+ENV_TPU_MEM_DEV = "ALIYUN_COM_TPU_MEM_DEV"
+# Advisory-isolation opt-out, driven by a node label (reference:
+# podmanager.go:59-72, allocate.go:124-126, const.go:32):
+ENV_ISOLATION_DISABLE = "TPUSHARE_DISABLE_ISOLATION"
+LABEL_ISOLATION_DISABLE = "tpushare.disable.isolation"
+
+# Allocate failure is encoded in env rather than an RPC error so kubelet
+# still starts the container with a self-describing failure marker
+# (reference: allocate.go:24-39).
+ENV_ALLOC_FAILURE_FMT = "no-tpu-has-{n}{unit}-to-run"
+
+# --- required daemon environment -------------------------------------------
+ENV_NODE_NAME = "NODE_NAME"   # required (reference: podmanager.go:52-55)
+ENV_KUBECONFIG = "KUBECONFIG"
+
+# --- misc -------------------------------------------------------------------
+OPTIMISTIC_LOCK_ERROR_MSG = "the object has been modified; please apply your changes to the latest version and try again"
+
+GIB = 1024 * 1024 * 1024
+MIB = 1024 * 1024
+
+
+def mem_unit_bytes(unit: str) -> int:
+    """Bytes per advertised fake device for a memory unit flag value."""
+    if unit == "GiB":
+        return GIB
+    if unit == "MiB":
+        return MIB
+    raise ValueError(f"unknown memory unit {unit!r} (want GiB or MiB)")

@@ -1,0 +1,411 @@
+"""TPU chip discovery and fake-device fan-out.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/nvidia.go`` (device walk,
+fake-device fan-out at ``:73-85``, ID codec at ``:26-32``, XID health watch
+at ``:100-152``) plus the NVML binding layer it sits on
+(``vendor/.../nvml/nvml.go``).
+
+Three interchangeable backends implement :class:`ChipBackend`:
+
+* :class:`FakeBackend`     — N synthetic chips, injectable health events;
+  drives every unit test (SURVEY.md §4 plan).
+* :class:`MetadataBackend` — a real TPU VM: ``/dev/accel*`` (or
+  ``/dev/vfio/*``) device nodes + the GCE metadata server's
+  ``accelerator-type`` + a static per-generation HBM table.
+* :class:`LibtpuBackend`   — ctypes over the native ``libtpushim.so``
+  (C, dlopen of ``libtpu.so``), the analog of the reference's
+  ``nvml_dl.c`` shim.  Falls back cleanly when the shim or libtpu is absent.
+
+Unlike NVML, TPU chips on a VM are homogeneous by construction (one
+generation per slice), so the reference's "sample the first device's memory
+and assume uniform" shortcut (``nvidia.go:70-72``) is actually *sound* here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import logging
+import os
+import queue
+import re
+import threading
+import time
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import const
+
+log = logging.getLogger("tpushare.discovery")
+
+# ---------------------------------------------------------------------------
+# Static TPU generation table (HBM per chip, addressable cores per chip).
+#
+# Backs the metadata path when libtpu is absent, like the reference's
+# driver-free build mode (nvml_dl.c dlopen).  Cores here are *addressable*
+# devices per chip: v4/v5p expose one megacore, v5e/v6e one core, v2/v3 two.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    name: str
+    hbm_bytes: int
+    cores_per_chip: int
+    chips_per_host: int  # default host topology (worker of a pod slice)
+
+
+_G = const.GIB
+GENERATIONS: Dict[str, Generation] = {
+    "v2": Generation("v2", 8 * _G, 2, 4),
+    "v3": Generation("v3", 16 * _G, 2, 4),
+    "v4": Generation("v4", 32 * _G, 1, 4),
+    "v5e": Generation("v5e", 16 * _G, 1, 4),
+    "v5litepod": Generation("v5e", 16 * _G, 1, 4),
+    "v5p": Generation("v5p", 95 * _G, 1, 4),
+    "v6e": Generation("v6e", 32 * _G, 1, 4),
+}
+
+# Fail-safe assumption when the generation cannot be determined: advertise
+# the *smallest* per-chip HBM of any supported generation.  Under-advertising
+# wastes capacity; over-advertising makes the scheduler binpack pods that
+# will OOM — so the unknown case must round down.
+FALLBACK_GENERATION = Generation("unknown", 8 * _G, 1, 4)
+
+
+def parse_accelerator_type(acc_type: str) -> Tuple[Generation, int]:
+    """``"v4-16"`` -> (Generation v4, 16 total cores in slice).
+
+    Accepts the GCE metadata ``accelerator-type`` strings
+    (``v2-8``, ``v3-32``, ``v4-16``, ``v5litepod-8``, ``v5p-128``,
+    ``v6e-4``...).
+    """
+    m = re.fullmatch(r"(v\d+(?:litepod|e|p)?)-(\d+)", acc_type.strip())
+    if not m:
+        raise ValueError(f"unparseable accelerator-type {acc_type!r}")
+    gen_key, n = m.group(1), int(m.group(2))
+    gen = GENERATIONS.get(gen_key)
+    if gen is None:
+        raise ValueError(f"unknown TPU generation {gen_key!r} in {acc_type!r}")
+    return gen, n
+
+
+# ---------------------------------------------------------------------------
+# Chip model + fake-device codec
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Chip:
+    """One physical TPU chip on this host."""
+
+    index: int                 # local chip index on this host (0..n-1)
+    id: str                    # stable ID (device-path derived or libtpu)
+    dev_paths: Tuple[str, ...] # /dev/accel<N> (+ /dev/vfio/* when present)
+    hbm_bytes: int
+    cores: int                 # addressable cores on this chip
+    generation: str = "v4"
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthEvent:
+    """A chip transitioned health state (analog of an NVML XID event)."""
+
+    chip_index: int            # -1 => unattributable, mark everything bad
+    healthy: bool
+    reason: str = ""
+
+
+# Fake-device ID codec.  One advertised device per GiB (or MiB) of HBM;
+# the chip ID and the sub-index are recoverable from the fake ID
+# (reference: generateFakeDeviceID/extractRealDeviceID, nvidia.go:26-32).
+_FAKE_SEP = "-_-"
+
+
+def fake_device_id(chip_id: str, j: int) -> str:
+    return f"{chip_id}{_FAKE_SEP}{j}"
+
+
+def real_chip_id(fake_id: str) -> str:
+    return fake_id.rsplit(_FAKE_SEP, 1)[0]
+
+
+def fan_out(chips: Sequence[Chip], memory_unit: str = "GiB") -> List[Tuple[str, int]]:
+    """Manufacture the advertised device list: one fake device per unit of HBM.
+
+    Returns ``[(fake_device_id, chip_index), ...]``.  A v4 chip (32 GiB)
+    yields 32 fake devices under GiB units (reference: nvidia.go:73-85).
+    """
+    unit = const.mem_unit_bytes(memory_unit)
+    out: List[Tuple[str, int]] = []
+    for chip in chips:
+        for j in range(chip.hbm_bytes // unit):
+            out.append((fake_device_id(chip.id, j), chip.index))
+    return out
+
+
+def mem_units_per_chip(chip: Chip, memory_unit: str = "GiB") -> int:
+    return chip.hbm_bytes // const.mem_unit_bytes(memory_unit)
+
+
+# ---------------------------------------------------------------------------
+# Backends
+# ---------------------------------------------------------------------------
+class ChipBackend:
+    """Discovery + health interface every backend implements.
+
+    Mirrors the NVML surface the reference consumes:
+    Init/Shutdown (nvml.go:250-256), device walk (nvidia.go:53-98),
+    event watch (nvidia.go:100-152) — reshaped as a queue of
+    :class:`HealthEvent` instead of a polling XID loop.
+    """
+
+    name = "abstract"
+
+    def init(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def shutdown(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def chips(self) -> List[Chip]:
+        raise NotImplementedError
+
+    def health_events(self) -> "queue.Queue[HealthEvent]":
+        raise NotImplementedError
+
+
+class FakeBackend(ChipBackend):
+    """N synthetic chips with injectable health events — the test backend."""
+
+    name = "fake"
+
+    def __init__(self, n_chips: int = 1, generation: str = "v4",
+                 hbm_gib: Optional[int] = None):
+        gen = GENERATIONS[generation]
+        hbm = (hbm_gib * const.GIB) if hbm_gib is not None else gen.hbm_bytes
+        self._chips = [
+            Chip(index=i, id=f"tpu-{gen.name}-fake-{i}",
+                 dev_paths=(f"/dev/accel{i}",), hbm_bytes=hbm,
+                 cores=gen.cores_per_chip, generation=gen.name)
+            for i in range(n_chips)
+        ]
+        self._events: "queue.Queue[HealthEvent]" = queue.Queue()
+        self.initialized = False
+
+    def init(self) -> None:
+        self.initialized = True
+
+    def shutdown(self) -> None:
+        self.initialized = False
+
+    def chips(self) -> List[Chip]:
+        return list(self._chips)
+
+    def health_events(self) -> "queue.Queue[HealthEvent]":
+        return self._events
+
+    def inject_health(self, chip_index: int, healthy: bool, reason: str = "injected") -> None:
+        self._events.put(HealthEvent(chip_index, healthy, reason))
+
+
+class MetadataBackend(ChipBackend):
+    """Real TPU-VM discovery from device nodes + GCE metadata.
+
+    Sources of truth, in order:
+    1. ``/dev/accel*`` (TPU VM runtime) or ``/dev/vfio/<n>`` device nodes;
+    2. accelerator type from (a) ``TPU_ACCELERATOR_TYPE`` env, (b) the GCE
+       metadata server, (c) ``tpu-env`` metadata blob;
+    3. the static :data:`GENERATIONS` HBM table.
+
+    Health = device-node presence, re-checked by :class:`HealthWatcher`.
+    """
+
+    name = "metadata"
+    METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/attributes/{attr}")
+
+    def __init__(self, dev_glob: str = "/dev/accel*",
+                 vfio_glob: str = "/dev/vfio/[0-9]*",
+                 accelerator_type: Optional[str] = None,
+                 metadata_timeout: float = 2.0):
+        self._dev_glob = dev_glob
+        self._vfio_glob = vfio_glob
+        self._acc_type = accelerator_type
+        self._timeout = metadata_timeout
+        self._events: "queue.Queue[HealthEvent]" = queue.Queue()
+        self._acc_type_cache: Optional[str] = None
+
+    # -- metadata helpers --------------------------------------------------
+    def _metadata(self, attr: str) -> Optional[str]:
+        url = self.METADATA_URL.format(attr=attr)
+        req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as r:
+                return r.read().decode()
+        except Exception:
+            return None
+
+    def accelerator_type(self) -> Optional[str]:
+        if self._acc_type:
+            return self._acc_type
+        if self._acc_type_cache:
+            return self._acc_type_cache
+        env = os.environ.get("TPU_ACCELERATOR_TYPE")
+        if env:
+            self._acc_type_cache = env
+            return env
+        md = self._metadata("accelerator-type")
+        if md:
+            self._acc_type_cache = md.strip()
+            return self._acc_type_cache
+        tpu_env = self._metadata("tpu-env")
+        if tpu_env:
+            # tpu-env is a newline-separated K: 'V' blob.
+            m = re.search(r"ACCELERATOR_TYPE:\s*'([^']+)'", tpu_env)
+            if m:
+                self._acc_type_cache = m.group(1)
+                return self._acc_type_cache
+        return None
+
+    def device_paths(self) -> List[str]:
+        paths = sorted(glob.glob(self._dev_glob),
+                       key=lambda p: _trailing_int(p))
+        if not paths:
+            paths = sorted(glob.glob(self._vfio_glob),
+                           key=lambda p: _trailing_int(p))
+        return paths
+
+    def chips(self) -> List[Chip]:
+        paths = self.device_paths()
+        if not paths:
+            return []
+        acc = self.accelerator_type()
+        gen: Optional[Generation] = None
+        if acc:
+            try:
+                gen, _total_cores = parse_accelerator_type(acc)
+            except ValueError:
+                log.warning("unparseable accelerator-type %r; assuming "
+                            "fail-safe %d GiB/chip", acc,
+                            FALLBACK_GENERATION.hbm_bytes // const.GIB)
+        if gen is None:
+            # Fail safe: round DOWN to the smallest known generation so the
+            # scheduler never binpacks more HBM than the chip has.
+            gen = FALLBACK_GENERATION
+            if not acc:
+                log.warning("no accelerator-type discoverable; assuming "
+                            "fail-safe %d GiB/chip",
+                            gen.hbm_bytes // const.GIB)
+        # Chip index = the device node's own number (accel2 -> 2), NOT the
+        # enumerate position: with a sparse /dev (dead chip), positional
+        # numbering would point TPU_VISIBLE_CHIPS at the wrong silicon.
+        return [
+            Chip(index=_trailing_int(p),
+                 id=f"tpu-{gen.name}-{os.path.basename(p)}",
+                 dev_paths=(p,), hbm_bytes=gen.hbm_bytes,
+                 cores=gen.cores_per_chip, generation=gen.name)
+            for p in paths
+        ]
+
+    def health_events(self) -> "queue.Queue[HealthEvent]":
+        return self._events
+
+
+def _trailing_int(path: str) -> int:
+    m = re.search(r"(\d+)$", path)
+    return int(m.group(1)) if m else 0
+
+
+class LibtpuBackend(ChipBackend):
+    """Discovery via the native C shim (``native/tpushim.c`` -> ctypes).
+
+    The shim dlopens ``libtpu.so`` at runtime — the analog of the
+    reference's ``nvml_dl.c:21-28`` — so the daemon binary/wheel runs on
+    non-TPU nodes and in CI.  When the shim reports no libtpu, we fall
+    back to :class:`MetadataBackend` discovery transparently.
+    """
+
+    name = "libtpu"
+
+    def __init__(self, shim_path: Optional[str] = None):
+        from ..utils import nativeshim  # lazy: optional native artifact
+        self._shim = nativeshim.load(shim_path)
+        self._fallback = MetadataBackend()
+        self._events: "queue.Queue[HealthEvent]" = queue.Queue()
+
+    def init(self) -> None:
+        if self._shim is not None and not self._shim.init():
+            log.info("libtpu shim present but libtpu.so unavailable; "
+                     "using metadata discovery")
+            self._shim = None
+
+    def shutdown(self) -> None:
+        if self._shim is not None:
+            self._shim.shutdown()
+
+    def chips(self) -> List[Chip]:
+        if self._shim is None:
+            return self._fallback.chips()
+        n = self._shim.chip_count()
+        md_chips = {c.index: c for c in self._fallback.chips()}
+        out: List[Chip] = []
+        for i in range(n):
+            info = self._shim.chip_info(i)
+            md = md_chips.get(i)
+            out.append(Chip(
+                index=i,
+                id=info.get("id") or (md.id if md else f"tpu-chip-{i}"),
+                dev_paths=(md.dev_paths if md else (f"/dev/accel{i}",)),
+                hbm_bytes=info.get("hbm_bytes")
+                or (md.hbm_bytes if md else GENERATIONS["v4"].hbm_bytes),
+                cores=info.get("cores")
+                or (md.cores if md else 1),
+                generation=info.get("generation")
+                or (md.generation if md else "v4"),
+            ))
+        return out
+
+    def health_events(self) -> "queue.Queue[HealthEvent]":
+        return self._events
+
+
+class HealthWatcher(threading.Thread):
+    """Re-check device-node presence and emit :class:`HealthEvent`s.
+
+    Replaces the reference's NVML XID polling loop (nvidia.go:126: the one
+    hot loop in the daemon).  A chip whose device node disappears goes
+    Unhealthy; unlike the reference (FIXME at server.go:180) we *do* emit a
+    recovery event when the node reappears.
+    """
+
+    def __init__(self, chips: Sequence[Chip],
+                 events: "queue.Queue[HealthEvent]",
+                 interval: float = 5.0):
+        super().__init__(daemon=True, name="tpushare-health")
+        self._chips = list(chips)
+        self._events = events
+        self._interval = interval
+        self._halt = threading.Event()
+        self._state = {c.index: True for c in chips}
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.wait(self._interval):
+            for chip in self._chips:
+                ok = all(os.path.exists(p) for p in chip.dev_paths)
+                if ok != self._state[chip.index]:
+                    self._state[chip.index] = ok
+                    self._events.put(HealthEvent(
+                        chip.index, ok,
+                        "device node missing" if not ok else "device node back"))
+
+
+def make_backend(kind: str, **kw) -> ChipBackend:
+    """Backend factory for the ``--backend {fake,metadata,libtpu}`` flag."""
+    if kind == "fake":
+        return FakeBackend(**kw)
+    if kind == "metadata":
+        return MetadataBackend(**kw)
+    if kind == "libtpu":
+        return LibtpuBackend(**kw)
+    raise ValueError(f"unknown backend {kind!r}")

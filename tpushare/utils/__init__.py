@@ -1,0 +1,1 @@
+"""Shared utilities: native shim loader, logging, stack dumps."""

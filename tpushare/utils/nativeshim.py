@@ -1,0 +1,81 @@
+"""ctypes loader for the native libtpu discovery shim.
+
+The shim (``native/tpushim.c`` -> ``tpushare/_native/libtpushim.so``) is the
+TPU analog of the reference's vendored NVML cgo binding + ``nvml_dl.c``
+dlopen shim: a thin C layer that dlopens ``libtpu.so`` at *runtime* so the
+Python daemon imports and runs on nodes without a TPU driver (CI, laptops).
+
+Absence of the compiled shim is not an error — callers fall back to
+metadata discovery, mirroring how the reference binary links with
+``--unresolved-symbols=ignore-in-object-files`` (Dockerfile:6).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+log = logging.getLogger("tpushare.nativeshim")
+
+_DEFAULT_PATHS = (
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), "_native",
+                 "libtpushim.so"),
+    "libtpushim.so",
+)
+
+
+class Shim:
+    """Typed wrapper over libtpushim.so."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tpushim_init.restype = ctypes.c_int
+        lib.tpushim_shutdown.restype = None
+        lib.tpushim_chip_count.restype = ctypes.c_int
+        lib.tpushim_chip_info_json.restype = ctypes.c_char_p
+        lib.tpushim_chip_info_json.argtypes = [ctypes.c_int]
+        lib.tpushim_version.restype = ctypes.c_char_p
+
+    def init(self) -> bool:
+        """True iff libtpu.so was dlopen-able and initialized."""
+        return bool(self._lib.tpushim_init())
+
+    def shutdown(self) -> None:
+        self._lib.tpushim_shutdown()
+
+    def version(self) -> str:
+        return self._lib.tpushim_version().decode()
+
+    def chip_count(self) -> int:
+        return max(0, int(self._lib.tpushim_chip_count()))
+
+    def chip_info(self, index: int) -> Dict:
+        raw = self._lib.tpushim_chip_info_json(index)
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw.decode())
+        except json.JSONDecodeError:
+            return {}
+
+
+def load(path: Optional[str] = None) -> Optional[Shim]:
+    """Load the shim; None when it is not built/present (soft dependency)."""
+    candidates = (path,) if path else _DEFAULT_PATHS
+    for cand in candidates:
+        if cand is None:
+            continue
+        try:
+            return Shim(ctypes.CDLL(cand))
+        except OSError:
+            continue
+        except AttributeError:
+            # A library by that name exists but lacks the tpushim_* surface
+            # (stale or foreign .so) — treat as absent, don't crash the daemon.
+            log.warning("%s is not a tpushim library; ignoring", cand)
+            continue
+    log.debug("libtpushim.so not found (tried %s)", candidates)
+    return None
