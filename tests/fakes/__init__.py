@@ -1,0 +1,3 @@
+"""In-process fakes: kubelet registration server, apiserver, kubelet /pods."""
+
+from .kubelet import FakeKubelet  # noqa: F401

@@ -1,0 +1,153 @@
+"""In-memory fake kube-apiserver + fake kubelet /pods/ endpoint (httptest).
+
+Serves just the REST surface the daemon uses: pod list with field
+selectors, pod annotation patch, node get, node status patch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+class FakeApiServer:
+    def __init__(self):
+        self.pods: List[dict] = []
+        self.nodes: Dict[str, dict] = {}
+        self.patch_conflicts_remaining = 0  # inject 409s for retry tests
+        self.requests: List[str] = []
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                with fake._lock:
+                    fake.requests.append(f"GET {self.path}")
+                parsed = urllib.parse.urlparse(self.path)
+                qs = urllib.parse.parse_qs(parsed.query)
+                if parsed.path == "/api/v1/pods":
+                    items = fake._select_pods(qs.get("fieldSelector", [""])[0])
+                    self._send(200, {"kind": "PodList", "items": items})
+                elif parsed.path == "/pods/":  # kubelet read-only endpoint
+                    self._send(200, {"kind": "PodList", "items": list(fake.pods)})
+                elif parsed.path.startswith("/api/v1/nodes/"):
+                    name = parsed.path.rsplit("/", 1)[-1]
+                    node = fake.nodes.get(name)
+                    if node is None:
+                        self._send(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._send(200, node)
+                elif parsed.path == "/api/v1/nodes":
+                    self._send(200, {"kind": "NodeList",
+                                     "items": list(fake.nodes.values())})
+                else:
+                    self._send(404, {"kind": "Status", "code": 404})
+
+            def do_PATCH(self):
+                with fake._lock:
+                    fake.requests.append(f"PATCH {self.path}")
+                length = int(self.headers.get("Content-Length", 0))
+                patch = json.loads(self.rfile.read(length) or b"{}")
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.strip("/").split("/")
+                # /api/v1/namespaces/<ns>/pods/<name>
+                if len(parts) == 6 and parts[2] == "namespaces" and parts[4] == "pods":
+                    with fake._lock:
+                        if fake.patch_conflicts_remaining > 0:
+                            fake.patch_conflicts_remaining -= 1
+                            self._send(409, {"kind": "Status", "code": 409,
+                                             "message": "Operation cannot be "
+                                             "fulfilled on pods"})
+                            return
+                    pod = fake._find_pod(parts[3], parts[5])
+                    if pod is None:
+                        self._send(404, {"kind": "Status", "code": 404})
+                        return
+                    anns = pod.setdefault("metadata", {}).setdefault(
+                        "annotations", {})
+                    anns.update(patch.get("metadata", {}).get("annotations", {}))
+                    self._send(200, pod)
+                # /api/v1/nodes/<name>/status
+                elif len(parts) == 5 and parts[2] == "nodes" and parts[4] == "status":
+                    node = fake.nodes.setdefault(parts[3], {
+                        "metadata": {"name": parts[3]}, "status": {}})
+                    for field in ("capacity", "allocatable"):
+                        if field in patch.get("status", {}):
+                            node.setdefault("status", {}).setdefault(
+                                field, {}).update(patch["status"][field])
+                    self._send(200, node)
+                else:
+                    self._send(404, {"kind": "Status", "code": 404})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def _select_pods(self, selector: str) -> List[dict]:
+        want = dict(kv.split("=", 1) for kv in selector.split(",") if "=" in kv)
+        out = []
+        for p in self.pods:
+            if "spec.nodeName" in want and \
+                    p.get("spec", {}).get("nodeName") != want["spec.nodeName"]:
+                continue
+            if "status.phase" in want and \
+                    p.get("status", {}).get("phase") != want["status.phase"]:
+                continue
+            out.append(p)
+        return out
+
+    def _find_pod(self, ns: str, name: str) -> Optional[dict]:
+        for p in self.pods:
+            md = p.get("metadata", {})
+            if md.get("namespace") == ns and md.get("name") == name:
+                return p
+        return None
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def make_pod(name: str, node: str = "node-a", ns: str = "default",
+             tpu_mem: int = 0, phase: str = "Pending",
+             chip_idx: Optional[int] = None,
+             assume_time: Optional[int] = None,
+             assigned: Optional[str] = None,
+             resource: str = "aliyun.com/tpu-mem") -> dict:
+    anns = {}
+    if chip_idx is not None:
+        anns["ALIYUN_COM_TPU_MEM_IDX"] = str(chip_idx)
+    if assume_time is not None:
+        anns["ALIYUN_COM_TPU_MEM_ASSUME_TIME"] = str(assume_time)
+    if assigned is not None:
+        anns["ALIYUN_COM_TPU_MEM_ASSIGNED"] = assigned
+    containers = [{
+        "name": "main",
+        "resources": {"limits": ({resource: str(tpu_mem)} if tpu_mem else {})},
+    }]
+    return {
+        "metadata": {"name": name, "namespace": ns, "annotations": anns,
+                     "uid": f"uid-{ns}-{name}"},
+        "spec": {"nodeName": node, "containers": containers},
+        "status": {"phase": phase},
+    }

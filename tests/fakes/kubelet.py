@@ -1,0 +1,38 @@
+"""A fake kubelet: the gRPC Registration endpoint device plugins dial.
+
+Test-double for the contract at SURVEY.md §3.1 (Register) and §3.2
+(ListAndWatch driven from the kubelet side via DevicePluginStub).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import List
+
+import grpc
+
+from tpushare.plugin.api import (RegistrationServicer, pb,
+                                 add_registration_servicer)
+
+
+class FakeKubelet(RegistrationServicer):
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.register_requests: List[pb.RegisterRequest] = []
+        self.registered = threading.Event()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        add_registration_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix://{socket_path}")
+
+    def Register(self, request, context):
+        self.register_requests.append(request)
+        self.registered.set()
+        return pb.Empty()
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self):
+        self._server.stop(grace=0.5).wait()

@@ -1,0 +1,126 @@
+"""The core spine end-to-end (BASELINE config 1, SURVEY.md §7):
+
+fake backend → gRPC server → Allocate matches the assumed pod →
+extender-chosen chip honored → ASSIGNED patched → a real JAX process
+runs with the injected env on CPU.
+"""
+
+import os
+import subprocess
+import sys
+
+import grpc
+import pytest
+
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def plugin2(api, tmp_path):
+    """2-chip v4 plugin wired to the fake apiserver's pod state."""
+    backend = discovery.FakeBackend(n_chips=2, generation="v4")
+    pm = PodManager(KubeClient(api.url), "node-a")
+    p = TpuDevicePlugin(backend, allocator=allocate.make_allocator(pm),
+                        socket_path=str(tmp_path / "tpushare.sock"),
+                        kubelet_socket=str(tmp_path / "kubelet.sock"))
+    p.start()
+    yield p
+    p.stop()
+
+
+def _allocate(p, n_units):
+    ch = grpc.insecure_channel(f"unix://{p.socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    stub = DevicePluginStub(ch)
+    fake_ids = [fid for fid, _ in p.devices[:n_units]]
+    resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devicesIDs=fake_ids)]))
+    ch.close()
+    return resp
+
+
+def test_allocate_honors_extender_choice_and_patches_assigned(api, plugin2):
+    api.pods = [
+        make_pod("decoy", tpu_mem=4, assume_time=50, assigned="false",
+                 chip_idx=0),
+        make_pod("target", tpu_mem=2, assume_time=100, assigned="false",
+                 chip_idx=1),
+    ]
+    resp = _allocate(plugin2, 2)  # matches "target" (request == 2), chip 1
+    cr = resp.container_responses[0]
+    assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    assert cr.envs[const.ENV_TPU_MEM_POD] == "2"
+    assert cr.envs[const.ENV_TPU_MEM_DEV] == "32"
+    assert [d.host_path for d in cr.devices] == ["/dev/accel1"]
+
+    target = api.pods[1]["metadata"]["annotations"]
+    decoy = api.pods[0]["metadata"]["annotations"]
+    assert target[const.ANN_TPU_MEM_ASSIGNED] == "true"
+    assert decoy[const.ANN_TPU_MEM_ASSIGNED] == "false"
+
+
+def test_allocate_fifo_prefers_oldest_assumed_pod(api, plugin2):
+    api.pods = [
+        make_pod("younger", tpu_mem=2, assume_time=200, assigned="false",
+                 chip_idx=0),
+        make_pod("older", tpu_mem=2, assume_time=100, assigned="false",
+                 chip_idx=1),
+    ]
+    resp = _allocate(plugin2, 2)
+    # FIFO: the older assumption wins the match (podmanager.go:241-262)
+    assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    assert api.pods[1]["metadata"]["annotations"][
+        const.ANN_TPU_MEM_ASSIGNED] == "true"
+
+
+def test_allocate_no_matching_pod_yields_env_failure(api, plugin2):
+    api.pods = [make_pod("wrong-size", tpu_mem=8, assume_time=1,
+                         assigned="false", chip_idx=0)]
+    resp = _allocate(plugin2, 2)
+    cr = resp.container_responses[0]
+    assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "no-tpu-has-2GiB-to-run"
+    assert cr.envs[const.ENV_TPU_MEM_IDX] == "-1"
+
+
+def test_allocate_unknown_chip_annotation_fails_safely(api, plugin2):
+    api.pods = [make_pod("p", tpu_mem=2, assume_time=1, assigned="false",
+                         chip_idx=99)]
+    resp = _allocate(plugin2, 2)
+    cr = resp.container_responses[0]
+    assert cr.envs[const.ENV_TPU_MEM_IDX] == "-1"
+
+
+def test_e2e_jax_smoke_with_injected_env(api, plugin2):
+    """BASELINE config 1: the allocated env actually runs a JAX workload."""
+    api.pods = [make_pod("smoke", tpu_mem=2, assume_time=1, assigned="false",
+                         chip_idx=0)]
+    resp = _allocate(plugin2, 2)
+    envs = dict(resp.container_responses[0].envs)
+    assert envs[const.ENV_XLA_MEM_FRACTION] == "0.06"  # 2/32 rounded down
+
+    child_env = dict(os.environ)
+    child_env.update(envs)
+    child_env["JAX_PLATFORMS"] = "cpu"  # no TPU in CI; contract env rides along
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import os, jax, jax.numpy as jnp;"
+         "z = jnp.zeros((128, 128)) + 1;"
+         "print('SMOKE_OK', float(z.sum()),"
+         " os.environ['XLA_PYTHON_CLIENT_MEM_FRACTION'],"
+         " os.environ['TPU_VISIBLE_CHIPS'])"],
+        env=child_env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "SMOKE_OK 16384.0 0.06 0" in out.stdout

@@ -1,0 +1,148 @@
+"""Lifecycle manager: restart-on-kubelet-restart, chipless park, shutdown."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from tpushare.plugin import const, discovery
+from tpushare.plugin.manager import SharedTPUManager, SocketWatcher
+from tpushare.plugin.api import DevicePluginStub, pb
+
+from fakes import FakeKubelet
+
+
+def test_socket_watcher_fires_on_recreate(tmp_path):
+    sock = tmp_path / "kubelet.sock"
+    sock.write_text("a")
+    fired = threading.Event()
+    w = SocketWatcher(str(sock), fired.set, interval=0.02)
+    w.start()
+    try:
+        time.sleep(0.1)
+        assert not fired.is_set()
+        sock.unlink()
+        sock.write_text("b")  # new inode
+        assert fired.wait(timeout=2)
+    finally:
+        w.stop()
+        w.join(timeout=2)
+
+
+def test_manager_restarts_and_reregisters_on_kubelet_restart(tmp_path):
+    """kubelet restart => plugin must re-Register (SURVEY.md §3.5)."""
+    plugin_sock = str(tmp_path / "tpushare.sock")
+    kubelet_sock = str(tmp_path / "kubelet.sock")
+    kubelet = FakeKubelet(kubelet_sock).start()
+
+    backend = discovery.FakeBackend(n_chips=1, generation="v5e")
+    mgr = SharedTPUManager(backend, socket_path=plugin_sock,
+                           kubelet_socket=kubelet_sock, health_check=False,
+                           watcher_interval=0.02)
+    t = threading.Thread(target=mgr.run, daemon=True)
+    t.start()
+    try:
+        assert kubelet.registered.wait(timeout=10)
+        n_before = len(kubelet.register_requests)
+
+        # simulate kubelet restart: new socket file (new inode), same path
+        kubelet.stop()
+        import os
+        if os.path.exists(kubelet_sock):
+            os.unlink(kubelet_sock)
+        kubelet2 = FakeKubelet(kubelet_sock).start()
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if kubelet2.register_requests:
+                    break
+                time.sleep(0.05)
+            assert kubelet2.register_requests, "plugin did not re-register"
+        finally:
+            mgr.request_shutdown()
+            t.join(timeout=10)
+            kubelet2.stop()
+        assert n_before >= 1
+    finally:
+        if t.is_alive():
+            mgr.request_shutdown()
+            t.join(timeout=10)
+
+
+def test_manager_with_fake_backend_advertises_healthy_devices(tmp_path):
+    """Regression: the device-node HealthWatcher must not run over a fake
+    backend's nonexistent /dev paths (it marked everything Unhealthy)."""
+    plugin_sock = str(tmp_path / "tpushare.sock")
+    kubelet_sock = str(tmp_path / "kubelet.sock")
+    kubelet = FakeKubelet(kubelet_sock).start()
+    backend = discovery.FakeBackend(n_chips=1, generation="v5e")
+    mgr = SharedTPUManager(backend, socket_path=plugin_sock,
+                           kubelet_socket=kubelet_sock)
+    t = threading.Thread(target=mgr.run, daemon=True)
+    t.start()
+    try:
+        assert kubelet.registered.wait(timeout=10)
+        ch = grpc.insecure_channel(f"unix://{plugin_sock}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        first = next(DevicePluginStub(ch).ListAndWatch(pb.Empty()))
+        assert all(d.health == const.DEVICE_HEALTHY for d in first.devices)
+        ch.close()
+    finally:
+        mgr.request_shutdown()
+        t.join(timeout=10)
+        kubelet.stop()
+
+
+def test_manager_parks_without_chips():
+    backend = discovery.FakeBackend(n_chips=0)
+    mgr = SharedTPUManager(backend, wait_forever_without_chips=False)
+    mgr.run()  # returns instead of crashing/parking when disabled
+
+
+def test_standalone_main_entry_serves(tmp_path):
+    """Drive the real daemon entry end-to-end with --standalone --backend fake."""
+    from tpushare.plugin.main import main
+
+    plugin_sock = str(tmp_path / "tpushare.sock")
+    kubelet_sock = str(tmp_path / "kubelet.sock")
+    kubelet = FakeKubelet(kubelet_sock).start()
+
+    rc = {}
+    t = threading.Thread(
+        target=lambda: rc.update(code=main([
+            "--standalone", "--backend", "fake", "--fake-chips", "1",
+            "--fake-generation", "v4",
+            "--socket", plugin_sock, "--kubelet-socket", kubelet_sock])),
+        daemon=True)
+    # main() installs signal handlers only from the main thread; patch around
+    import tpushare.plugin.manager as mgr_mod
+    orig = mgr_mod.SharedTPUManager.install_signal_handlers
+    mgr_mod.SharedTPUManager.install_signal_handlers = lambda self: None
+    instances = []
+    orig_run = mgr_mod.SharedTPUManager.run
+
+    def capturing_run(self):
+        instances.append(self)
+        orig_run(self)
+
+    mgr_mod.SharedTPUManager.run = capturing_run
+    try:
+        t.start()
+        assert kubelet.registered.wait(timeout=10)
+        ch = grpc.insecure_channel(f"unix://{plugin_sock}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        stub = DevicePluginStub(ch)
+        resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devicesIDs=[d for d, _ in [("x-_-0", 0), ("x-_-1", 0)]])]))
+        assert resp.container_responses[0].envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+        ch.close()
+    finally:
+        for inst in instances:
+            inst.request_shutdown()
+        t.join(timeout=10)
+        mgr_mod.SharedTPUManager.install_signal_handlers = orig
+        mgr_mod.SharedTPUManager.run = orig_run
+        kubelet.stop()
+    assert rc.get("code") == 0

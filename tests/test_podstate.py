@@ -1,0 +1,115 @@
+"""Pod-state layer: podutils codec, podmanager listing/sorting/patching."""
+
+import pytest
+
+from tpushare.k8s.client import KubeClient
+from tpushare.kubelet.client import KubeletClient
+from tpushare.plugin import const, podutils
+from tpushare.plugin.podmanager import PodManager
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+def kube_for(api):
+    return KubeClient(api.url)
+
+
+# -- podutils ----------------------------------------------------------------
+def test_pod_requested_units_sums_containers():
+    pod = make_pod("p", tpu_mem=4)
+    pod["spec"]["containers"].append(
+        {"name": "side", "resources": {"limits": {const.RESOURCE_NAME: "2"}}})
+    assert podutils.pod_requested_units(pod) == 6
+
+
+def test_is_assumed_pod_predicate():
+    # all three conditions required: request>0, assume-time, assigned=false
+    assert podutils.is_assumed_pod(
+        make_pod("p", tpu_mem=2, assume_time=123, assigned="false"))
+    assert not podutils.is_assumed_pod(
+        make_pod("p", tpu_mem=2, assume_time=123, assigned="true"))
+    assert not podutils.is_assumed_pod(
+        make_pod("p", tpu_mem=2, assigned="false"))  # no assume-time
+    assert not podutils.is_assumed_pod(
+        make_pod("p", tpu_mem=0, assume_time=123, assigned="false"))
+
+
+def test_chip_index_annotation_parse():
+    assert podutils.chip_index_from_annotation(
+        make_pod("p", chip_idx=3)) == 3
+    assert podutils.chip_index_from_annotation(make_pod("p")) is None
+    bad = make_pod("p")
+    bad["metadata"]["annotations"][const.ANN_TPU_MEM_IDX] = "banana"
+    assert podutils.chip_index_from_annotation(bad) is None
+
+
+def test_active_pod_predicates():
+    assert podutils.is_active_pod(make_pod("p", phase="Running"))
+    assert not podutils.is_active_pod(make_pod("p", phase="Succeeded"))
+    deleted = make_pod("p", phase="Running")
+    deleted["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    assert not podutils.is_active_pod(deleted)
+
+
+# -- podmanager --------------------------------------------------------------
+def test_candidate_pods_filter_and_fifo_order(api):
+    api.pods = [
+        make_pod("young", tpu_mem=2, assume_time=2000, assigned="false"),
+        make_pod("old", tpu_mem=2, assume_time=1000, assigned="false"),
+        make_pod("done", tpu_mem=2, assume_time=500, assigned="true"),
+        make_pod("other-node", node="node-b", tpu_mem=2, assume_time=1,
+                 assigned="false"),
+        make_pod("running", tpu_mem=2, phase="Running", assume_time=1,
+                 assigned="false"),
+    ]
+    pm = PodManager(kube_for(api), "node-a")
+    names = [p["metadata"]["name"] for p in pm.candidate_pods()]
+    assert names == ["old", "young"]
+
+
+def test_candidate_pods_via_kubelet_path(api):
+    api.pods = [make_pod("p1", tpu_mem=2, assume_time=1, assigned="false")]
+    kubelet = KubeletClient(address="127.0.0.1", port=api.port, scheme="http")
+    pm = PodManager(kube_for(api), "node-a", kubelet_client=kubelet)
+    assert [p["metadata"]["name"] for p in pm.candidate_pods()] == ["p1"]
+    assert any("GET /pods/" in r for r in api.requests)
+
+
+def test_kubelet_failure_falls_back_to_apiserver(api, monkeypatch):
+    from tpushare.plugin import podmanager as pm_mod
+    monkeypatch.setattr(pm_mod, "KUBELET_RETRY_SLEEP", 0.001)
+    api.pods = [make_pod("p1", tpu_mem=2, assume_time=1, assigned="false")]
+    dead_kubelet = KubeletClient(address="127.0.0.1", port=1, scheme="http",
+                                 timeout=0.05)
+    pm = PodManager(kube_for(api), "node-a", kubelet_client=dead_kubelet)
+    assert [p["metadata"]["name"] for p in pm.candidate_pods()] == ["p1"]
+    assert any("fieldSelector" in r for r in api.requests)
+
+
+def test_mark_assigned_patches_and_retries_on_conflict(api):
+    pod = make_pod("p1", tpu_mem=2, assume_time=1, assigned="false")
+    api.pods = [pod]
+    api.patch_conflicts_remaining = 1  # first PATCH 409s, retry succeeds
+    pm = PodManager(kube_for(api), "node-a")
+    pm.mark_assigned(pod)
+    anns = api.pods[0]["metadata"]["annotations"]
+    assert anns[const.ANN_TPU_MEM_ASSIGNED] == "true"
+    assert int(anns[const.ANN_TPU_MEM_ASSUME_TIME]) > 1
+    assert len([r for r in api.requests if r.startswith("PATCH")]) == 2
+
+
+def test_patch_chip_count_and_isolation_label(api):
+    api.nodes["node-a"] = {"metadata": {"name": "node-a", "labels": {
+        const.LABEL_ISOLATION_DISABLE: "true"}}, "status": {}}
+    pm = PodManager(kube_for(api), "node-a")
+    pm.patch_chip_count(4)
+    assert api.nodes["node-a"]["status"]["capacity"][const.COUNT_NAME] == "4"
+    assert api.nodes["node-a"]["status"]["allocatable"][const.COUNT_NAME] == "4"
+    assert pm.isolation_disabled()

@@ -1,0 +1,150 @@
+"""Device-plugin gRPC server: register, ListAndWatch, Allocate, lifecycle."""
+
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from tpushare.plugin import const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes import FakeKubelet
+
+
+@pytest.fixture
+def sockets(tmp_path):
+    return str(tmp_path / "tpushare.sock"), str(tmp_path / "kubelet.sock")
+
+
+@pytest.fixture
+def plugin_v4(sockets):
+    plugin_sock, kubelet_sock = sockets
+    backend = discovery.FakeBackend(n_chips=1, generation="v4")
+    backend.init()
+    p = TpuDevicePlugin(backend, socket_path=plugin_sock,
+                        kubelet_socket=kubelet_sock)
+    yield p
+    p.stop()
+
+
+def _stub(socket_path):
+    ch = grpc.insecure_channel(f"unix://{socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    return DevicePluginStub(ch), ch
+
+
+def test_serve_registers_with_kubelet(plugin_v4, sockets):
+    _, kubelet_sock = sockets
+    kubelet = FakeKubelet(kubelet_sock).start()
+    try:
+        plugin_v4.serve()
+        assert kubelet.registered.wait(timeout=5)
+        req = kubelet.register_requests[0]
+        assert req.resource_name == const.RESOURCE_NAME
+        assert req.version == "v1beta1"
+        assert req.endpoint == os.path.basename(plugin_v4.socket_path)
+    finally:
+        kubelet.stop()
+
+
+def test_list_and_watch_initial_and_health_transition(plugin_v4):
+    plugin_v4.start()
+    stub, ch = _stub(plugin_v4.socket_path)
+    stream = stub.ListAndWatch(pb.Empty())
+
+    first = next(stream)
+    assert len(first.devices) == 32  # one v4 chip = 32 GiB = 32 fake devices
+    assert all(d.health == const.DEVICE_HEALTHY for d in first.devices)
+
+    plugin_v4.backend.inject_health(0, healthy=False, reason="test")
+    second = next(stream)
+    assert all(d.health == const.DEVICE_UNHEALTHY for d in second.devices)
+
+    # recovery transition (reference has a FIXME here; we support it)
+    plugin_v4.backend.inject_health(0, healthy=True, reason="recovered")
+    third = next(stream)
+    assert all(d.health == const.DEVICE_HEALTHY for d in third.devices)
+    ch.close()
+
+
+def test_get_device_plugin_options(plugin_v4):
+    plugin_v4.start()
+    stub, ch = _stub(plugin_v4.socket_path)
+    opts = stub.GetDevicePluginOptions(pb.Empty())
+    assert opts.pre_start_required is False
+    ch.close()
+
+
+def test_allocate_single_chip_fast_path(plugin_v4):
+    """With exactly one chip and no cluster state, Allocate still succeeds
+    (reference single-GPU fast path, allocate.go:151-177)."""
+    plugin_v4.start()
+    stub, ch = _stub(plugin_v4.socket_path)
+    fake_ids = [fid for fid, _ in plugin_v4.devices[:8]]
+    resp = stub.Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(devicesIDs=fake_ids)]))
+    assert len(resp.container_responses) == 1
+    cr = resp.container_responses[0]
+    assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    assert cr.envs[const.ENV_TPU_MEM_CONTAINER] == "8"
+    assert cr.envs[const.ENV_TPU_MEM_DEV] == "32"
+    assert cr.envs[const.ENV_XLA_MEM_FRACTION] == "0.25"
+    assert [d.host_path for d in cr.devices] == ["/dev/accel0"]
+    assert all(d.permissions == "rwm" for d in cr.devices)
+    ch.close()
+
+
+def test_allocate_multi_chip_without_pod_state_fails_in_env(sockets):
+    """>1 chip and no pod state: failure is encoded in env, not RPC error."""
+    plugin_sock, kubelet_sock = sockets
+    backend = discovery.FakeBackend(n_chips=2, generation="v4")
+    p = TpuDevicePlugin(backend, socket_path=plugin_sock,
+                        kubelet_socket=kubelet_sock)
+    p.start()
+    try:
+        stub, ch = _stub(p.socket_path)
+        fake_ids = [fid for fid, _ in p.devices[:4]]
+        resp = stub.Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(devicesIDs=fake_ids)]))
+        cr = resp.container_responses[0]
+        assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "no-tpu-has-4GiB-to-run"
+        assert cr.envs[const.ENV_TPU_MEM_IDX] == "-1"
+        ch.close()
+    finally:
+        p.stop()
+
+
+def test_stop_removes_socket_and_ends_streams(plugin_v4):
+    plugin_v4.start()
+    stub, ch = _stub(plugin_v4.socket_path)
+    stream = stub.ListAndWatch(pb.Empty())
+    next(stream)
+    sock = plugin_v4.socket_path
+    assert os.path.exists(sock)
+    plugin_v4.stop()
+    assert not os.path.exists(sock)
+    with pytest.raises(Exception):
+        # stream terminates (clean or UNAVAILABLE) rather than hanging
+        next(stream)
+    ch.close()
+
+
+def test_unattributable_health_event_marks_all_unhealthy(sockets):
+    plugin_sock, kubelet_sock = sockets
+    backend = discovery.FakeBackend(n_chips=2, generation="v5e")
+    p = TpuDevicePlugin(backend, socket_path=plugin_sock,
+                        kubelet_socket=kubelet_sock)
+    p.start()
+    try:
+        stub, ch = _stub(p.socket_path)
+        stream = stub.ListAndWatch(pb.Empty())
+        next(stream)
+        backend.inject_health(-1, healthy=False, reason="unattributable")
+        resp = next(stream)
+        assert all(d.health == const.DEVICE_UNHEALTHY for d in resp.devices)
+        ch.close()
+    finally:
+        p.stop()

@@ -1,0 +1,1 @@
+"""Minimal Kubernetes apiserver REST client (the daemon's client-go)."""

@@ -1,0 +1,189 @@
+"""Dependency-free Kubernetes apiserver REST client.
+
+Covers exactly the API surface the reference uses through client-go
+(SURVEY.md §2.3 control-plane table):
+
+* list pods on a node by phase (field selectors,
+  ``podmanager.go:142-160``);
+* strategic-merge-patch pod annotations (assume/assign handshake,
+  ``podutils.go:27-35``);
+* get node + patch node status capacity/allocatable
+  (``podmanager.go:74-99``);
+
+Auth: in-cluster service account (token + CA bundle) or a KUBECONFIG
+file (token / client-cert / insecure), resolved the same way the
+reference's ``kubeInit`` does (``podmanager.go:29-57``).
+
+Pods/nodes are plain parsed-JSON dicts — there is no typed object layer
+on purpose; the annotation protocol codec lives in ``plugin/podutils.py``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+log = logging.getLogger("tpushare.k8s")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"apiserver HTTP {status}: {body[:300]}")
+        self.status = status
+        self.body = body
+
+    @property
+    def is_conflict(self) -> bool:
+        return self.status == 409
+
+
+class KubeClient:
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 token_path: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 client_cert: Optional[tuple] = None,
+                 insecure: bool = False):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        # Bound SA tokens rotate on disk (default 1h TTL); re-read per
+        # request like client-go does, instead of caching at construction.
+        self.token_path = token_path
+        ctx = ssl.create_default_context(cafile=ca_file) if ca_file \
+            else ssl.create_default_context()
+        if insecure:
+            ctx = ssl._create_unverified_context()
+        if client_cert:
+            ctx.load_cert_chain(*client_cert)
+        self._ctx = ctx if self.base_url.startswith("https") else None
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_env(cls) -> "KubeClient":
+        """KUBECONFIG if set (out-of-cluster dev), else in-cluster SA."""
+        kubeconfig = os.environ.get("KUBECONFIG")
+        if kubeconfig and os.path.exists(kubeconfig):
+            return cls.from_kubeconfig(kubeconfig)
+        return cls.in_cluster()
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token_path = os.path.join(SA_DIR, "token")
+        ca = os.path.join(SA_DIR, "ca.crt")
+        return cls(f"https://{host}:{port}",
+                   token_path=token_path if os.path.exists(token_path) else None,
+                   ca_file=ca if os.path.exists(ca) else None,
+                   insecure=not os.path.exists(ca))
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "KubeClient":
+        import yaml
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context")
+        ctx = next(c["context"] for c in cfg["contexts"]
+                   if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in cfg["clusters"]
+                       if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in cfg["users"]
+                    if u["name"] == ctx["user"])
+
+        ca_file = cluster.get("certificate-authority")
+        if not ca_file and cluster.get("certificate-authority-data"):
+            ca_file = _data_to_tempfile(cluster["certificate-authority-data"])
+        client_cert = None
+        cert = user.get("client-certificate") or (
+            _data_to_tempfile(user["client-certificate-data"])
+            if user.get("client-certificate-data") else None)
+        key = user.get("client-key") or (
+            _data_to_tempfile(user["client-key-data"])
+            if user.get("client-key-data") else None)
+        if cert and key:
+            client_cert = (cert, key)
+        return cls(cluster["server"], token=user.get("token"),
+                   ca_file=ca_file, client_cert=client_cert,
+                   insecure=bool(cluster.get("insecure-skip-tls-verify")))
+
+    # -- transport ----------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None,
+                 content_type: str = "application/json",
+                 query: Optional[Dict[str, str]] = None) -> dict:
+        url = self.base_url + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        tok = self._bearer()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=10) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        return json.loads(payload) if payload else {}
+
+    def _bearer(self) -> Optional[str]:
+        if self.token_path:
+            try:
+                with open(self.token_path) as f:
+                    return f.read().strip()
+            except OSError:
+                pass
+        return self.token
+
+    # -- pods ---------------------------------------------------------------
+    def list_pods(self, node_name: Optional[str] = None,
+                  phase: Optional[str] = None,
+                  namespace: Optional[str] = None) -> List[dict]:
+        selectors = []
+        if node_name:
+            selectors.append(f"spec.nodeName={node_name}")
+        if phase:
+            selectors.append(f"status.phase={phase}")
+        path = (f"/api/v1/namespaces/{namespace}/pods" if namespace
+                else "/api/v1/pods")
+        q = {"fieldSelector": ",".join(selectors)} if selectors else None
+        return self._request("GET", path, query=q).get("items", [])
+
+    def patch_pod_annotations(self, namespace: str, name: str,
+                              annotations: Dict[str, str]) -> dict:
+        return self._request(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body={"metadata": {"annotations": annotations}},
+            content_type="application/strategic-merge-patch+json")
+
+    # -- nodes --------------------------------------------------------------
+    def get_node(self, name: str) -> dict:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_status(self, name: str, capacity: Dict[str, str]) -> dict:
+        body = {"status": {"capacity": capacity, "allocatable": capacity}}
+        return self._request(
+            "PATCH", f"/api/v1/nodes/{name}/status", body=body,
+            content_type="application/strategic-merge-patch+json")
+
+    def list_nodes(self) -> List[dict]:
+        return self._request("GET", "/api/v1/nodes").get("items", [])
+
+
+def _data_to_tempfile(b64: str) -> str:
+    f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+    f.write(base64.b64decode(b64))
+    f.close()
+    return f.name

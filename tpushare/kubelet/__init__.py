@@ -1,0 +1,1 @@
+"""Kubelet read-only API client (the ``pkg/kubelet/client`` analog)."""

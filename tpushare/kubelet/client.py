@@ -1,0 +1,60 @@
+"""Authenticated client for kubelet's node-local ``/pods/`` endpoint.
+
+TPU analog of the reference's ``pkg/kubelet/client/client.go``: an HTTPS
+GET against ``https://<node>:10250/pods/`` with service-account bearer
+auth (``client.go:119-134``), used by the pod-state layer when the daemon
+runs with ``--query-kubelet`` (fresher than the apiserver cache during
+allocation races).  Kubelet serves a self-signed cert, so verification is
+off by default — matching the reference transport config
+(``client.go:56-99``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import urllib.request
+from typing import List, Optional
+
+log = logging.getLogger("tpushare.kubelet")
+
+
+class KubeletClient:
+    def __init__(self, address: str = "127.0.0.1", port: int = 10250,
+                 token: Optional[str] = None,
+                 token_path: Optional[str] = None,
+                 verify_tls: bool = False,
+                 scheme: str = "https",
+                 timeout: float = 10.0):
+        self.base_url = f"{scheme}://{address}:{port}"
+        self._token = token
+        self._token_path = token_path
+        self._timeout = timeout
+        if scheme == "https":
+            self._ctx = (ssl.create_default_context() if verify_tls
+                         else ssl._create_unverified_context())
+        else:
+            self._ctx = None
+
+    def _bearer(self) -> Optional[str]:
+        if self._token:
+            return self._token
+        if self._token_path:
+            try:
+                with open(self._token_path) as f:
+                    return f.read().strip()
+            except OSError:
+                return None
+        return None
+
+    def get_node_running_pods(self) -> List[dict]:
+        """GET /pods/ -> the kubelet's authoritative local pod list."""
+        req = urllib.request.Request(self.base_url + "/pods/")
+        tok = self._bearer()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        with urllib.request.urlopen(req, context=self._ctx,
+                                    timeout=self._timeout) as r:
+            podlist = json.loads(r.read())
+        return podlist.get("items", [])

@@ -1,0 +1,39 @@
+"""``tpushare-podgetter`` — dump kubelet's /pods/ output for debugging.
+
+Analog of the reference's standalone probe ``cmd/podgetter/main.go``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .client import KubeletClient
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-podgetter",
+        description="Dump the local kubelet's /pods/ list (debug tool).")
+    ap.add_argument("--address", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=10250)
+    ap.add_argument("--scheme", choices=["https", "http"], default="https")
+    ap.add_argument("--token-path",
+                    default="/var/run/secrets/kubernetes.io/serviceaccount/token")
+    args = ap.parse_args(argv)
+
+    client = KubeletClient(address=args.address, port=args.port,
+                           scheme=args.scheme, token_path=args.token_path)
+    try:
+        pods = client.get_node_running_pods()
+    except Exception as e:
+        print(f"error querying kubelet: {e}", file=sys.stderr)
+        return 1
+    json.dump({"items": pods}, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Allocation: TPU env/device injection + the pod-matching algorithm.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/allocate.go``.  Two halves:
+
+* :func:`container_response` — the TPU delta.  Where the reference only
+  sets ``NVIDIA_VISIBLE_DEVICES`` and lets nvidia-docker do the rest
+  (``allocate.go:113-128``), on TPU the plugin itself must hand kubelet
+  the device nodes and libtpu mount (DeviceSpec/Mount fields of the
+  v1beta1 API) *and* the env contract a co-located JAX process needs:
+  ``TPU_VISIBLE_CHIPS``, per-process topology bounds, and the HBM budget
+  as ``XLA_PYTHON_CLIENT_MEM_FRACTION``.
+
+* :func:`make_allocator` — the matching algorithm (``allocate.go:42-198``):
+  kubelet's AllocateRequest does not say *which pod* it is for, so we list
+  this node's pending assumed pods, take the oldest whose total tpu-mem
+  request equals the requested fake-device count, read the chip index the
+  scheduler extender chose from its annotation, and patch it ASSIGNED.
+  Faithfully replicated, including the known heuristic weakness (two
+  equal-size pending pods can swap — mitigated by FIFO assume-time order,
+  SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from . import const
+from .api import pb
+from .discovery import Chip, mem_units_per_chip
+
+log = logging.getLogger("tpushare.allocate")
+
+# Host paths where a TPU VM exposes libtpu; mounted read-only into the
+# workload container when present (the reference never needed Mounts —
+# nvidia-docker injected the driver — but on TPU the plugin must).
+LIBTPU_HOST_PATHS = (
+    "/usr/lib/libtpu.so",
+    "/lib/libtpu.so",
+    "/usr/share/tpu/libtpu.so",
+)
+
+
+def container_response(plugin, chip: Chip, container_units: int,
+                       pod_units: int,
+                       isolation_disabled: bool = False
+                       ) -> "pb.ContainerAllocateResponse":
+    """Build one container's allocation: env contract + devices + mounts."""
+    chip_units = mem_units_per_chip(chip, plugin.memory_unit)
+    # HBM budget: fraction of this chip's HBM this container may use.
+    # JAX reads XLA_PYTHON_CLIENT_MEM_FRACTION at process start; rounding
+    # down 2 decimals keeps co-located fractions summing <= 1.0.
+    frac = max(0.01, int(container_units / max(chip_units, 1) * 100) / 100.0)
+
+    envs = {
+        const.ENV_TPU_VISIBLE_CHIPS: str(chip.index),
+        const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS: "1,1,1",
+        const.ENV_TPU_PROCESS_BOUNDS: "1,1,1",
+        const.ENV_XLA_MEM_FRACTION: f"{frac:.2f}",
+        const.ENV_TPU_MEM_IDX: str(chip.index),
+        const.ENV_TPU_MEM_POD: str(pod_units),
+        const.ENV_TPU_MEM_CONTAINER: str(container_units),
+        const.ENV_TPU_MEM_DEV: str(chip_units),
+    }
+    if isolation_disabled:
+        envs[const.ENV_ISOLATION_DISABLE] = "true"
+
+    resp = pb.ContainerAllocateResponse(envs=envs)
+    for path in chip.dev_paths:
+        resp.devices.add(container_path=path, host_path=path,
+                         permissions="rwm")
+    for lib in LIBTPU_HOST_PATHS:
+        if _host_file_exists(lib):
+            resp.mounts.add(container_path=lib, host_path=lib, read_only=True)
+            break
+    return resp
+
+
+def _host_file_exists(path: str) -> bool:  # patchable in tests
+    import os
+    return os.path.exists(path)
+
+
+# --------------------------------------------------------------------------
+# Pod-matching allocator
+# --------------------------------------------------------------------------
+def make_allocator(pod_manager):
+    """Bind the matching algorithm to a pod-state manager (podmanager.py).
+
+    Returns an ``Allocator`` for :class:`~tpushare.plugin.server.TpuDevicePlugin`.
+    """
+    lock = threading.Lock()  # serialize concurrent Allocates (allocate.go:59)
+
+    def allocator(plugin, request: "pb.AllocateRequest") -> "pb.AllocateResponse":
+        from .server import failure_response
+
+        pod_req = sum(len(r.devicesIDs) for r in request.container_requests)
+        log.info("Allocate: request for %d %s", pod_req, plugin.memory_unit)
+
+        with lock:
+            pod = None
+            try:
+                candidates = pod_manager.candidate_pods()
+                for p in candidates:
+                    if pod_manager.pod_request_units(p) == pod_req:
+                        pod = p
+                        break
+            except Exception:
+                log.exception("listing candidate pods failed")
+                candidates = []
+
+            chip: Optional[Chip] = None
+            if pod is not None:
+                idx = pod_manager.pod_chip_index(pod)
+                chip = plugin.chip_for_index(idx)
+                if chip is None:
+                    log.warning("pod %s annotated with unknown chip %s",
+                                pod_manager.pod_name(pod), idx)
+            elif len(plugin.chips) == 1:
+                # Single-chip fast path: no ambiguity about placement
+                # (allocate.go:151-177).
+                chip = plugin.chips[0]
+
+            if chip is None:
+                log.warning("no assumed pod matches request of %d %s "
+                            "(candidates: %d)", pod_req, plugin.memory_unit,
+                            len(candidates))
+                return failure_response(request, pod_req, plugin.memory_unit)
+
+            isolation_off = pod_manager.isolation_disabled()
+            resp = pb.AllocateResponse()
+            for creq in request.container_requests:
+                resp.container_responses.append(container_response(
+                    plugin, chip, len(creq.devicesIDs), pod_req,
+                    isolation_off))
+
+            if pod is not None:
+                try:
+                    pod_manager.mark_assigned(pod)
+                except Exception:
+                    # Patch failure is logged, not fatal: kubelet keeps the
+                    # allocation; the pod stays "assumed" and ages out
+                    # (matches the reference's tolerance, allocate.go:135-149).
+                    log.exception("marking pod assigned failed")
+            return resp
+
+    return allocator

@@ -1,0 +1,124 @@
+"""kubelet device-plugin v1beta1 API: messages + gRPC glue.
+
+``deviceplugin_pb2`` is protoc-generated from ``deviceplugin.proto``
+(regenerate with ``make proto``).  The gRPC service glue below is written
+by hand against grpcio's generic handler API (the image ships grpcio but
+not grpc_tools); it is wire-identical to what ``protoc-gen-grpc`` would
+emit: full method names ``/v1beta1.Registration/Register`` etc.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import deviceplugin_pb2 as pb
+
+__all__ = [
+    "pb",
+    "DevicePluginServicer",
+    "add_device_plugin_servicer",
+    "RegistrationServicer",
+    "add_registration_servicer",
+    "RegistrationStub",
+    "DevicePluginStub",
+]
+
+_REG = "v1beta1.Registration"
+_DP = "v1beta1.DevicePlugin"
+
+
+# --------------------------------------------------------------------------
+# Server side
+# --------------------------------------------------------------------------
+class DevicePluginServicer:
+    """Override the four kubelet-facing RPCs."""
+
+    def GetDevicePluginOptions(self, request, context):
+        raise NotImplementedError
+
+    def ListAndWatch(self, request, context):
+        raise NotImplementedError
+
+    def Allocate(self, request, context):
+        raise NotImplementedError
+
+    def PreStartContainer(self, request, context):
+        raise NotImplementedError
+
+
+def add_device_plugin_servicer(servicer: DevicePluginServicer,
+                               server: grpc.Server) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_DP, handlers),))
+
+
+class RegistrationServicer:
+    """Kubelet's Registration service — implemented by the fake kubelet."""
+
+    def Register(self, request, context):
+        raise NotImplementedError
+
+
+def add_registration_servicer(servicer: RegistrationServicer,
+                              server: grpc.Server) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_REG, handlers),))
+
+
+# --------------------------------------------------------------------------
+# Client side
+# --------------------------------------------------------------------------
+class RegistrationStub:
+    """Plugin -> kubelet: announce ourselves on kubelet.sock."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{_REG}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString)
+
+
+class DevicePluginStub:
+    """Kubelet -> plugin (used by the fake kubelet and the self-dial probe)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{_DP}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString)
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_DP}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString)
+        self.Allocate = channel.unary_unary(
+            f"/{_DP}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString)
+        self.PreStartContainer = channel.unary_unary(
+            f"/{_DP}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString)

@@ -158,6 +158,9 @@ class ChipBackend:
     """
 
     name = "abstract"
+    # True when Chip.dev_paths are real host device nodes whose presence a
+    # HealthWatcher may poll; False for synthetic backends.
+    watch_device_nodes = False
 
     def init(self) -> None:  # pragma: no cover - trivial default
         pass
@@ -219,6 +222,7 @@ class MetadataBackend(ChipBackend):
     """
 
     name = "metadata"
+    watch_device_nodes = True
     METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
                     "instance/attributes/{attr}")
 
@@ -324,6 +328,7 @@ class LibtpuBackend(ChipBackend):
     """
 
     name = "libtpu"
+    watch_device_nodes = True
 
     def __init__(self, shim_path: Optional[str] = None):
         from ..utils import nativeshim  # lazy: optional native artifact

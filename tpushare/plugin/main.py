@@ -1,0 +1,104 @@
+"""Daemon entry point: ``tpushare-device-plugin``.
+
+TPU analog of the reference's ``cmd/nvidia/main.go``: flag parsing, kube
+client construction, then hand off to the lifecycle manager.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from . import const
+from .discovery import make_backend
+from .manager import SharedTPUManager
+
+log = logging.getLogger("tpushare.main")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-device-plugin",
+        description="Kubernetes device plugin advertising TPU HBM as a "
+                    "schedulable fractional resource (aliyun.com/tpu-mem).")
+    ap.add_argument("--backend", choices=["libtpu", "metadata", "fake"],
+                    default="libtpu",
+                    help="chip discovery backend (default: libtpu, falls "
+                         "back to metadata when libtpu.so is absent)")
+    ap.add_argument("--memory-unit", choices=["GiB", "MiB"], default="GiB",
+                    help="HBM advertisement granularity (reference: "
+                         "cmd/nvidia/main.go --memory-unit)")
+    ap.add_argument("--query-kubelet", action="store_true",
+                    help="list pending pods via the kubelet read-only API "
+                         "instead of the apiserver")
+    ap.add_argument("--kubelet-address", default="127.0.0.1")
+    ap.add_argument("--kubelet-port", type=int, default=10250)
+    ap.add_argument("--kubelet-token-path",
+                    default="/var/run/secrets/kubernetes.io/serviceaccount/token")
+    ap.add_argument("--socket", default=const.SERVER_SOCKET)
+    ap.add_argument("--kubelet-socket", default=const.KUBELET_SOCKET)
+    ap.add_argument("--resource-name", default=const.RESOURCE_NAME)
+    ap.add_argument("--fake-chips", type=int, default=1,
+                    help="chip count for --backend fake")
+    ap.add_argument("--fake-generation", default="v4")
+    ap.add_argument("--standalone", action="store_true",
+                    help="run without any cluster (no apiserver/kubelet pod "
+                         "queries; single-chip fast-path allocation only)")
+    ap.add_argument("-v", "--verbosity", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 4 else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.backend == "fake":
+        backend = make_backend("fake", n_chips=args.fake_chips,
+                               generation=args.fake_generation)
+    else:
+        backend = make_backend(args.backend)
+
+    allocator_factory = None
+    on_chips_ready = None
+    if not args.standalone:
+        from ..k8s.client import KubeClient
+        from ..kubelet.client import KubeletClient
+        from . import allocate
+        from .podmanager import PodManager
+
+        node_name = os.environ.get(const.ENV_NODE_NAME)
+        if not node_name:
+            log.error("%s env must be set (downward API)", const.ENV_NODE_NAME)
+            return 1
+        kube = KubeClient.from_env()
+        kubelet = None
+        if args.query_kubelet:
+            kubelet = KubeletClient(
+                address=args.kubelet_address, port=args.kubelet_port,
+                token_path=args.kubelet_token_path)
+        pm = PodManager(kube, node_name, kubelet_client=kubelet,
+                        resource_name=args.resource_name)
+        # Node-capacity patch runs after backend.init() via the manager
+        # hook — querying chips here would read an uninitialized backend.
+        on_chips_ready = lambda chips: pm.patch_chip_count(len(chips))
+        allocator_factory = lambda plugin: allocate.make_allocator(pm)
+
+    mgr = SharedTPUManager(
+        backend,
+        allocator_factory=allocator_factory,
+        memory_unit=args.memory_unit,
+        resource_name=args.resource_name,
+        socket_path=args.socket,
+        kubelet_socket=args.kubelet_socket,
+        on_chips_ready=on_chips_ready)
+    mgr.install_signal_handlers()
+    mgr.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

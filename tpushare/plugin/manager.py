@@ -1,0 +1,181 @@
+"""Daemon lifecycle: discovery → serve → watch → restart.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/gpumanager.go``:
+
+* block forever (visibly, not crash-loop) when no chips are present
+  (``gpumanager.go:36-47``) — the DaemonSet may land on a non-TPU node;
+* restart the plugin when kubelet recreates its registration socket
+  (kubelet restart ⇒ re-Register is mandatory device-plugin behavior,
+  ``gpumanager.go:83-88``, SURVEY.md §3.5) — detected here by polling the
+  socket inode instead of fsnotify;
+* SIGHUP → restart, SIGQUIT → all-thread stack dump, SIGINT/SIGTERM →
+  graceful stop (``gpumanager.go:90-107``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils import stackdump
+from . import const
+from .discovery import ChipBackend, HealthWatcher
+from .server import Allocator, TpuDevicePlugin
+
+log = logging.getLogger("tpushare.manager")
+
+
+class SocketWatcher(threading.Thread):
+    """Fire a callback when a path is (re)created — poll-based fsnotify."""
+
+    def __init__(self, path: str, on_create: Callable[[], None],
+                 interval: float = 1.0):
+        super().__init__(daemon=True, name="tpushare-sockwatch")
+        self.path = path
+        self.on_create = on_create
+        self.interval = interval
+        self._halt = threading.Event()
+        self._sig = self._signature()
+
+    def _signature(self) -> Optional[tuple]:
+        # (inode, ctime): inode alone is reusable within one poll interval,
+        # so a delete+recreate could otherwise go unseen.
+        try:
+            st = os.stat(self.path)
+            return (st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.interval):
+            sig = self._signature()
+            if sig is not None and sig != self._sig:
+                self._sig = sig
+                self.on_create()
+            elif sig is None:
+                self._sig = None
+
+
+class SharedTPUManager:
+    """Owns the restart loop around one TpuDevicePlugin instance."""
+
+    def __init__(self,
+                 backend: ChipBackend,
+                 allocator_factory: Optional[Callable[["TpuDevicePlugin"], Allocator]] = None,
+                 memory_unit: str = "GiB",
+                 resource_name: str = const.RESOURCE_NAME,
+                 socket_path: str = const.SERVER_SOCKET,
+                 kubelet_socket: str = const.KUBELET_SOCKET,
+                 health_check: bool = True,
+                 wait_forever_without_chips: bool = True,
+                 watcher_interval: float = 1.0,
+                 on_chips_ready: Optional[Callable[[list], None]] = None):
+        self.backend = backend
+        self.allocator_factory = allocator_factory
+        self.memory_unit = memory_unit
+        self.resource_name = resource_name
+        self.socket_path = socket_path
+        self.kubelet_socket = kubelet_socket
+        self.health_check = health_check
+        self.wait_forever_without_chips = wait_forever_without_chips
+        self.watcher_interval = watcher_interval
+        # Invoked once after backend.init() with the discovered chips —
+        # the node-capacity patch hooks in here so it never reads an
+        # uninitialized backend.
+        self.on_chips_ready = on_chips_ready
+
+        self.plugin: Optional[TpuDevicePlugin] = None
+        self._restart = threading.Event()
+        self._shutdown = threading.Event()
+        self._watcher: Optional[SocketWatcher] = None
+        self._health_watcher: Optional[HealthWatcher] = None
+
+    # -- signals ------------------------------------------------------------
+    def install_signal_handlers(self) -> None:
+        signal.signal(signal.SIGHUP, lambda *_: self.request_restart("SIGHUP"))
+        signal.signal(signal.SIGQUIT,
+                      lambda *_: log.warning("stack dump at %s", stackdump.dump()))
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: self.request_shutdown())
+
+    def request_restart(self, why: str) -> None:
+        log.info("restart requested (%s)", why)
+        self._restart.set()
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+        self._restart.set()  # unblock the loop
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> None:
+        self.backend.init()
+        chips = self.backend.chips()
+        if not chips:
+            log.error("no TPU chips found on this node")
+            if self.wait_forever_without_chips:
+                # Matches the reference: a plugin pod on a chipless node
+                # parks instead of crash-looping (gpumanager.go:36-47).
+                while not self._shutdown.wait(60):
+                    pass
+            return
+
+        if self.on_chips_ready is not None:
+            try:
+                self.on_chips_ready(chips)
+            except Exception:
+                log.exception("on_chips_ready hook failed")
+
+        self._watcher = SocketWatcher(
+            self.kubelet_socket,
+            lambda: self.request_restart("kubelet.sock recreated"),
+            interval=self.watcher_interval)
+        self._watcher.start()
+
+        while not self._shutdown.is_set():
+            self._restart.clear()
+            plugin = TpuDevicePlugin(
+                self.backend,
+                memory_unit=self.memory_unit,
+                resource_name=self.resource_name,
+                socket_path=self.socket_path,
+                kubelet_socket=self.kubelet_socket)
+            if self.allocator_factory is not None:
+                plugin.allocator = self.allocator_factory(plugin)
+            self.plugin = plugin
+            # Device-node polling only makes sense for backends whose
+            # dev_paths are real host nodes (a FakeBackend's are not, and
+            # watching them would instantly mark everything Unhealthy).
+            if self.health_check and self.backend.watch_device_nodes:
+                self._health_watcher = HealthWatcher(
+                    plugin.chips, self.backend.health_events())
+                self._health_watcher.start()
+            try:
+                plugin.serve()
+            except Exception:
+                log.exception("plugin serve failed; retrying in 5s")
+                self._teardown_plugin(plugin)
+                if self._shutdown.wait(5):
+                    break
+                continue
+            # Parked until a restart/shutdown trigger.
+            self._restart.wait()
+            self._teardown_plugin(plugin)
+
+        if self._watcher is not None:
+            self._watcher.stop()
+        self.backend.shutdown()
+        log.info("manager exited")
+
+    def _teardown_plugin(self, plugin: TpuDevicePlugin) -> None:
+        if self._health_watcher is not None:
+            self._health_watcher.stop()
+            self._health_watcher = None
+        plugin.stop()
+        self.plugin = None

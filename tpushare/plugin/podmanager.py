@@ -1,0 +1,130 @@
+"""Pod-state manager: the allocator's window into the cluster.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/podmanager.go``:
+
+* candidate pods = pending pods on this node, filtered to "assumed",
+  FIFO-sorted by assume-time (``podmanager.go:215-262``);
+* pending list comes from kubelet's ``/pods/`` (fresher; 8×100 ms retries
+  then apiserver fallback, ``podmanager.go:125-140``) or the apiserver
+  field-selector path (3×1 s retries, ``podmanager.go:142-160``);
+* acknowledges an allocation by patching ASSIGNED=true with one retry on
+  optimistic-lock conflict (``allocate.go:131-149``);
+* patches node capacity ``aliyun.com/tpu-count`` (``podmanager.go:74-99``)
+  and reads the isolation-disable node label (``podmanager.go:59-72``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+from ..k8s.client import ApiError, KubeClient
+from ..kubelet.client import KubeletClient
+from . import const, podutils
+
+log = logging.getLogger("tpushare.podmanager")
+
+KUBELET_RETRIES = 8
+KUBELET_RETRY_SLEEP = 0.1
+APISERVER_RETRIES = 3
+APISERVER_RETRY_SLEEP = 1.0
+
+
+class PodManager:
+    def __init__(self, kube: KubeClient, node_name: str,
+                 kubelet_client: Optional[KubeletClient] = None,
+                 resource_name: str = const.RESOURCE_NAME):
+        self.kube = kube
+        self.node_name = node_name
+        self.kubelet = kubelet_client
+        self.resource_name = resource_name
+        self._isolation_disabled: Optional[bool] = None
+
+    # -- pending/assumed pod listing ----------------------------------------
+    def _pending_via_kubelet(self) -> Optional[List[dict]]:
+        assert self.kubelet is not None
+        for attempt in range(KUBELET_RETRIES):
+            try:
+                pods = self.kubelet.get_node_running_pods()
+                return [p for p in pods if podutils.is_pending_pod(p)]
+            except Exception as e:
+                log.warning("kubelet /pods/ attempt %d failed: %s",
+                            attempt + 1, e)
+                time.sleep(KUBELET_RETRY_SLEEP)
+        return None
+
+    def _pending_via_apiserver(self) -> List[dict]:
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(APISERVER_RETRIES):
+            try:
+                return self.kube.list_pods(node_name=self.node_name,
+                                           phase="Pending")
+            except Exception as e:
+                last = e
+                log.warning("apiserver pod list attempt %d failed: %s",
+                            attempt + 1, e)
+                time.sleep(APISERVER_RETRY_SLEEP)
+        raise last
+
+    def pending_pods(self) -> List[dict]:
+        if self.kubelet is not None:
+            pods = self._pending_via_kubelet()
+            if pods is not None:
+                return pods
+            log.warning("kubelet queries exhausted; falling back to apiserver")
+        return self._pending_via_apiserver()
+
+    def candidate_pods(self) -> List[dict]:
+        """Assumed pods on this node, oldest assume-time first (FIFO)."""
+        cands = [p for p in self.pending_pods() if podutils.is_assumed_pod(p)]
+        cands.sort(key=lambda p: (podutils.assume_time(p) or 0))
+        return cands
+
+    # -- adapter surface used by allocate.make_allocator --------------------
+    def pod_request_units(self, pod: dict) -> int:
+        return podutils.pod_requested_units(pod, self.resource_name)
+
+    def pod_chip_index(self, pod: dict) -> Optional[int]:
+        return podutils.chip_index_from_annotation(pod)
+
+    def pod_name(self, pod: dict) -> str:
+        return podutils.pod_key(pod)
+
+    def mark_assigned(self, pod: dict) -> None:
+        """Patch ASSIGNED=true; one retry on optimistic-lock conflict
+        (allocate.go:135-149, const.go:15)."""
+        md = pod["metadata"]
+        anns = podutils.assigned_patch_annotations()
+        try:
+            self.kube.patch_pod_annotations(md["namespace"], md["name"], anns)
+        except ApiError as e:
+            if not (e.is_conflict
+                    or const.OPTIMISTIC_LOCK_ERROR_MSG in e.body):
+                raise
+            log.info("conflict patching %s; retrying once",
+                     podutils.pod_key(pod))
+            self.kube.patch_pod_annotations(md["namespace"], md["name"], anns)
+
+    # -- node state ----------------------------------------------------------
+    def patch_chip_count(self, count: int) -> None:
+        self.kube.patch_node_status(self.node_name,
+                                    {const.COUNT_NAME: str(count)})
+
+    def isolation_disabled(self) -> bool:
+        """Node label opt-out from advisory isolation (podmanager.go:59-72).
+
+        Resolved once and cached — the reference reads it at startup; an
+        apiserver round-trip per Allocate (inside the allocation lock)
+        would add latency to every container start.
+        """
+        if self._isolation_disabled is None:
+            try:
+                node = self.kube.get_node(self.node_name)
+                labels = node.get("metadata", {}).get("labels") or {}
+                self._isolation_disabled = labels.get(
+                    const.LABEL_ISOLATION_DISABLE, "").lower() == "true"
+            except Exception:
+                log.exception("reading node %s failed", self.node_name)
+                return False
+        return self._isolation_disabled

@@ -1,0 +1,112 @@
+"""Annotation-protocol codec and pod predicates over plain pod dicts.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/podutils.go``: the
+scheduler-extender handshake is three annotations — the chosen chip index,
+an assume-time, and an assigned flag — plus the ``aliyun.com/tpu-mem``
+container limits.  An "assumed" pod (``podutils.go:78-119``) is one the
+extender has placed but the device plugin has not yet acknowledged.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from . import const
+
+log = logging.getLogger("tpushare.podutils")
+
+
+# -- resource accounting -----------------------------------------------------
+def pod_requested_units(pod: dict, resource: str = const.RESOURCE_NAME) -> int:
+    """Sum the resource limits over all containers (podutils.go:122-131)."""
+    total = 0
+    for c in pod.get("spec", {}).get("containers", []):
+        lim = c.get("resources", {}).get("limits", {})
+        total += _parse_quantity(lim.get(resource, 0))
+    return total
+
+
+def container_requested_units(container: dict,
+                              resource: str = const.RESOURCE_NAME) -> int:
+    lim = container.get("resources", {}).get("limits", {})
+    return _parse_quantity(lim.get(resource, 0))
+
+
+def _parse_quantity(v) -> int:
+    """Device-plugin resources are plain integers (no milli-units)."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+# -- annotations -------------------------------------------------------------
+def _annotations(pod: dict) -> Dict[str, str]:
+    return pod.get("metadata", {}).get("annotations") or {}
+
+
+def chip_index_from_annotation(pod: dict) -> Optional[int]:
+    """The extender's chosen chip (podutils.go:37-61); None if unparseable."""
+    raw = _annotations(pod).get(const.ANN_TPU_MEM_IDX)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        log.warning("pod %s has malformed %s=%r", pod_key(pod),
+                    const.ANN_TPU_MEM_IDX, raw)
+        return None
+
+
+def assume_time(pod: dict) -> Optional[int]:
+    raw = _annotations(pod).get(const.ANN_TPU_MEM_ASSUME_TIME)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def is_assumed_pod(pod: dict) -> bool:
+    """Placed by the extender, not yet acknowledged by the plugin
+    (podutils.go:78-119): requests tpu-mem ∧ has assume-time ∧
+    assigned == "false"."""
+    anns = _annotations(pod)
+    if const.ANN_TPU_MEM_ASSUME_TIME not in anns:
+        return False
+    if pod_requested_units(pod) <= 0:
+        return False
+    return anns.get(const.ANN_TPU_MEM_ASSIGNED, "").lower() == "false"
+
+
+def assigned_patch_annotations() -> Dict[str, str]:
+    """The ASSIGNED=true acknowledgement patch (podutils.go:27-35).
+
+    A fresh assume-time is stamped alongside, as the reference does, so
+    the extender can expire stale assumptions uniformly.
+    """
+    return {
+        const.ANN_TPU_MEM_ASSIGNED: "true",
+        const.ANN_TPU_MEM_ASSUME_TIME: str(time.time_ns()),
+    }
+
+
+# -- lifecycle predicates ----------------------------------------------------
+def is_active_pod(pod: dict) -> bool:
+    """Not deleted, not terminally Succeeded/Failed (podutils.go:133-182)."""
+    if pod.get("metadata", {}).get("deletionTimestamp"):
+        return False
+    phase = pod.get("status", {}).get("phase")
+    return phase not in ("Succeeded", "Failed")
+
+
+def is_pending_pod(pod: dict) -> bool:
+    return pod.get("status", {}).get("phase") == "Pending"
+
+
+def pod_key(pod: dict) -> str:
+    md = pod.get("metadata", {})
+    return f"{md.get('namespace', '?')}/{md.get('name', '?')}"

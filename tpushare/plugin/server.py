@@ -1,0 +1,250 @@
+"""The kubelet-facing device-plugin gRPC server.
+
+TPU analog of the reference's ``pkg/gpu/nvidia/server.go``: a unix-socket
+gRPC server advertising fake per-GiB devices, with
+
+* ``serve()``        — listen, self-dial liveness probe, health relay
+  (``server.go:106-134``), then ``register()`` with kubelet
+  (``server.go:150-169``);
+* ``ListAndWatch``   — immediate full device list, re-sent on every chip
+  health transition (``server.go:172-185``); unlike the reference we also
+  send recovery transitions (its ``server.go:180`` FIXME);
+* ``Allocate``       — delegated to a pluggable allocator (the pod-matching
+  algorithm lives in ``allocate.py``);
+* chip-index → chip lookup for the allocator (``server.go:72-83``).
+
+Concurrency model: grpcio thread-pool server; device/health state guarded
+by one lock + condition; ListAndWatch streams are generator-based waiters
+on a version counter (replaces the Go channel dance).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+from concurrent import futures
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from . import const
+from .api import (DevicePluginServicer, RegistrationStub,
+                  add_device_plugin_servicer, pb)
+from .discovery import Chip, ChipBackend, HealthEvent, fan_out, real_chip_id
+
+log = logging.getLogger("tpushare.server")
+
+# An allocator takes (plugin, AllocateRequest) and returns AllocateResponse.
+Allocator = Callable[["TpuDevicePlugin", "pb.AllocateRequest"],
+                     "pb.AllocateResponse"]
+
+
+class TpuDevicePlugin(DevicePluginServicer):
+    """One running device-plugin endpoint for ``aliyun.com/tpu-mem``."""
+
+    def __init__(self,
+                 backend: ChipBackend,
+                 allocator: Optional[Allocator] = None,
+                 memory_unit: str = "GiB",
+                 resource_name: str = const.RESOURCE_NAME,
+                 socket_path: str = const.SERVER_SOCKET,
+                 kubelet_socket: str = const.KUBELET_SOCKET):
+        self.backend = backend
+        self.memory_unit = memory_unit
+        self.resource_name = resource_name
+        self.socket_path = socket_path
+        self.kubelet_socket = kubelet_socket
+        self.allocator: Allocator = allocator or default_allocator
+
+        self.chips: List[Chip] = backend.chips()
+        self.chip_by_index: Dict[int, Chip] = {c.index: c for c in self.chips}
+        # Advertised fake devices: [(fake_id, chip_index)].
+        self.devices: List[Tuple[str, int]] = fan_out(self.chips, memory_unit)
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._chip_health: Dict[int, bool] = {c.index: True for c in self.chips}
+        self._version = 0            # bumped on every health transition
+        self._stopped = threading.Event()
+
+        self._server: Optional[grpc.Server] = None
+        self._health_thread: Optional[threading.Thread] = None
+
+    # ---- gRPC handlers ----------------------------------------------------
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(pre_start_required=False)
+
+    def ListAndWatch(self, request, context):
+        last_sent = -1
+        while not self._stopped.is_set():
+            with self._cond:
+                while self._version == last_sent and not self._stopped.is_set():
+                    self._cond.wait(timeout=1.0)
+                if self._stopped.is_set():
+                    return
+                last_sent = self._version
+                devs = self._device_list_locked()
+            log.info("ListAndWatch: sending %d devices (version %d)",
+                     len(devs), last_sent)
+            yield pb.ListAndWatchResponse(devices=devs)
+
+    def Allocate(self, request, context):
+        return self.allocator(self, request)
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # ---- device/health state ---------------------------------------------
+    def _device_list_locked(self) -> List[pb.Device]:
+        return [
+            pb.Device(ID=fid,
+                      health=const.DEVICE_HEALTHY
+                      if self._chip_health.get(idx, True)
+                      else const.DEVICE_UNHEALTHY)
+            for fid, idx in self.devices
+        ]
+
+    def device_list(self) -> List[pb.Device]:
+        with self._lock:
+            return self._device_list_locked()
+
+    def apply_health_event(self, ev: HealthEvent) -> None:
+        with self._cond:
+            if ev.chip_index < 0:
+                # Unattributable failure: everything unhealthy
+                # (reference: nvidia.go:138-144).
+                for i in self._chip_health:
+                    self._chip_health[i] = ev.healthy
+            elif ev.chip_index in self._chip_health:
+                if self._chip_health[ev.chip_index] == ev.healthy:
+                    return
+                self._chip_health[ev.chip_index] = ev.healthy
+            else:
+                return
+            self._version += 1
+            self._cond.notify_all()
+        log.warning("chip %s -> %s (%s)", ev.chip_index,
+                    "Healthy" if ev.healthy else "Unhealthy", ev.reason)
+
+    def _health_relay(self) -> None:
+        events = self.backend.health_events()
+        while not self._stopped.is_set():
+            try:
+                ev = events.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self.apply_health_event(ev)
+
+    # ---- lookup used by the allocator ------------------------------------
+    def chip_for_index(self, idx: int) -> Optional[Chip]:
+        return self.chip_by_index.get(idx)
+
+    def chip_for_fake_id(self, fake_id: str) -> Optional[Chip]:
+        cid = real_chip_id(fake_id)
+        for c in self.chips:
+            if c.id == cid:
+                return c
+        return None
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        """Listen on the unix socket and confirm liveness by self-dial."""
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8,
+                                       thread_name_prefix="tpushare-grpc"))
+        add_device_plugin_servicer(self, self._server)
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+
+        # Self-dial probe: the reference dials its own socket before
+        # registering so kubelet never sees a half-up plugin
+        # (server.go:122-127).
+        ch = grpc.insecure_channel(f"unix://{self.socket_path}")
+        try:
+            grpc.channel_ready_future(ch).result(timeout=10)
+        finally:
+            ch.close()
+
+        self._health_thread = threading.Thread(
+            target=self._health_relay, daemon=True, name="tpushare-health-relay")
+        self._health_thread.start()
+        # First ListAndWatch response must go out immediately: version 0 is
+        # "dirty" relative to a fresh stream's last_sent=-1, so nothing to do.
+        log.info("device plugin listening on %s (%d fake devices, %d chips)",
+                 self.socket_path, len(self.devices), len(self.chips))
+
+    def register(self) -> None:
+        """Announce ourselves to kubelet over its registration socket."""
+        ch = grpc.insecure_channel(f"unix://{self.kubelet_socket}")
+        try:
+            grpc.channel_ready_future(ch).result(timeout=10)
+            RegistrationStub(ch).Register(pb.RegisterRequest(
+                version=const.API_VERSION,
+                endpoint=os.path.basename(self.socket_path),
+                resource_name=self.resource_name,
+                options=pb.DevicePluginOptions(pre_start_required=False),
+            ), timeout=10)
+        finally:
+            ch.close()
+        log.info("registered %s with kubelet", self.resource_name)
+
+    def serve(self) -> None:
+        self.start()
+        self.register()
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        log.info("device plugin stopped")
+
+
+# --------------------------------------------------------------------------
+# Fallback allocator (no cluster state needed)
+# --------------------------------------------------------------------------
+def failure_response(request: "pb.AllocateRequest", n_units: int,
+                     memory_unit: str) -> "pb.AllocateResponse":
+    """Encode allocation failure in env vars, not an RPC error.
+
+    kubelet starts the container anyway with a self-describing marker —
+    the reference's deliberate choice (allocate.go:24-39) so a mismatched
+    pod fails visibly inside the workload rather than wedging kubelet.
+    """
+    marker = const.ENV_ALLOC_FAILURE_FMT.format(n=n_units, unit=memory_unit)
+    resp = pb.AllocateResponse()
+    for _ in request.container_requests:
+        resp.container_responses.add(envs={
+            const.ENV_TPU_VISIBLE_CHIPS: marker,
+            const.ENV_TPU_MEM_IDX: "-1",
+        })
+    return resp
+
+
+def default_allocator(plugin: TpuDevicePlugin,
+                      request: "pb.AllocateRequest") -> "pb.AllocateResponse":
+    """Cluster-independent fallback: only safe when there is exactly one
+    chip (the reference's single-GPU fast path, allocate.go:151-177).
+    The real pod-matching allocator is wired in by ``allocate.py``.
+    """
+    n = sum(len(r.devicesIDs) for r in request.container_requests)
+    if len(plugin.chips) == 1:
+        from . import allocate  # local import: avoids cycle at module load
+        chip = plugin.chips[0]
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            resp.container_responses.append(
+                allocate.container_response(
+                    plugin, chip, len(creq.devicesIDs), n))
+        return resp
+    return failure_response(request, n, plugin.memory_unit)

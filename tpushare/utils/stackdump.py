@@ -1,0 +1,27 @@
+"""All-thread stack dump, the reference's SIGQUIT goroutine dump
+(``pkg/gpu/nvidia/coredump.go:10-30``) in Python form."""
+
+from __future__ import annotations
+
+import sys
+import time
+import traceback
+
+
+def stack_trace() -> str:
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"\n--- thread {tid} ---\n")
+        out.append("".join(traceback.format_stack(frame)))
+    return "".join(out)
+
+
+def dump(dir_path: str = "/etc/kubernetes") -> str:
+    path = f"{dir_path}/tpushare_stack_{int(time.time())}.txt"
+    try:
+        with open(path, "w") as f:
+            f.write(stack_trace())
+        return path
+    except OSError:
+        sys.stderr.write(stack_trace())
+        return "<stderr>"
