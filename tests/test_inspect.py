@@ -1,0 +1,121 @@
+"""Inspect CLI: node model reconstruction + rendering + end-to-end main()."""
+
+import json
+
+import pytest
+
+from tpushare.inspect import display, nodeinfo
+from tpushare.inspect.main import main as inspect_main
+from tpushare.plugin import const
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+def make_node(name="node-a", tpu_mem=64, tpu_count=2, ip="10.0.0.1"):
+    return {
+        "metadata": {"name": name, "labels": {}},
+        "status": {
+            "allocatable": {const.RESOURCE_NAME: str(tpu_mem),
+                            const.COUNT_NAME: str(tpu_count)},
+            "capacity": {const.RESOURCE_NAME: str(tpu_mem),
+                         const.COUNT_NAME: str(tpu_count)},
+            "addresses": [{"type": "InternalIP", "address": ip}],
+        },
+    }
+
+
+def test_build_node_infos_legacy_annotation():
+    node = make_node()
+    pods = [
+        make_pod("a", tpu_mem=8, chip_idx=0, assigned="true"),
+        make_pod("b", tpu_mem=8, chip_idx=0, assigned="true"),
+        make_pod("c", tpu_mem=4, chip_idx=1, assigned="true"),
+    ]
+    infos = nodeinfo.build_node_infos([node], pods)
+    assert len(infos) == 1
+    info = infos[0]
+    assert info.chip_count == 2 and info.total_mem == 64
+    assert info.devs[0].used_mem == 16 and len(info.devs[0].pods) == 2
+    assert info.devs[1].used_mem == 4
+    assert info.used_mem == 20
+    assert not info.has_pending()
+
+
+def test_new_style_json_allocation_annotation_wins():
+    node = make_node()
+    pod = make_pod("multi", tpu_mem=12, chip_idx=0, assigned="true")
+    pod["metadata"]["annotations"][const.ANN_TPU_ALLOCATION] = json.dumps(
+        {"main": {"0": 8, "1": 4}})
+    infos = nodeinfo.build_node_infos([node], [pod])
+    assert infos[0].devs[0].used_mem == 8
+    assert infos[0].devs[1].used_mem == 4
+
+
+def test_unannotated_pod_lands_in_pending_bucket():
+    node = make_node()
+    infos = nodeinfo.build_node_infos([node], [make_pod("p", tpu_mem=8)])
+    assert infos[0].has_pending()
+    assert infos[0].devs[nodeinfo.PENDING_IDX].used_mem == 8
+
+
+def test_malformed_json_falls_back_then_pending():
+    node = make_node()
+    pod = make_pod("bad", tpu_mem=8)
+    pod["metadata"]["annotations"][const.ANN_TPU_ALLOCATION] = "{not json"
+    infos = nodeinfo.build_node_infos([node], [pod])
+    assert infos[0].devs[nodeinfo.PENDING_IDX].used_mem == 8
+
+
+def test_memory_unit_heuristic():
+    assert nodeinfo.infer_memory_unit(
+        nodeinfo.build_node_infos([make_node(tpu_mem=64, tpu_count=2)], [])) \
+        == "GiB"
+    assert nodeinfo.infer_memory_unit(
+        nodeinfo.build_node_infos(
+            [make_node(tpu_mem=65536, tpu_count=2)], [])) == "MiB"
+
+
+def test_render_summary_table():
+    nodes = [make_node("node-a", ip="10.0.0.1"),
+             make_node("node-b", tpu_mem=32, tpu_count=1, ip="10.0.0.2")]
+    pods = [make_pod("a", tpu_mem=8, chip_idx=0, assigned="true"),
+            make_pod("b", node="node-b", tpu_mem=14, chip_idx=0,
+                     assigned="true")]
+    out = display.render_summary(nodeinfo.build_node_infos(nodes, pods))
+    assert "TPU0(Allocated/Total)" in out and "TPU1(Allocated/Total)" in out
+    assert "8/32" in out       # node-a chip 0
+    assert "14/32" in out      # node-b chip 0
+    assert "0/0" in out        # node-b has no chip 1
+    assert "22/96 (22%)" in out
+
+
+def test_render_details_lists_pods_once():
+    node = make_node()
+    pod = make_pod("multi", tpu_mem=12, assigned="true")
+    pod["metadata"]["annotations"][const.ANN_TPU_ALLOCATION] = json.dumps(
+        {"main": {"0": 8, "1": 4}})
+    out = display.render_details(nodeinfo.build_node_infos([node], [pod]))
+    assert out.count("multi") == 1  # spans 2 chips but renders one row
+    assert "Allocated : 12 (18%)" in out
+
+
+def test_inspect_main_end_to_end(monkeypatch, capsys):
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-a"] = make_node()
+        api.pods = [make_pod("a", tpu_mem=8, chip_idx=0, assigned="true",
+                             phase="Running"),
+                    make_pod("gone", tpu_mem=8, chip_idx=1, assigned="true",
+                             phase="Succeeded")]
+        from tpushare.k8s.client import KubeClient
+        import tpushare.inspect.main as im
+        monkeypatch.setattr(im.KubeClient, "from_env",
+                            classmethod(lambda cls: KubeClient(api.url)))
+        rc = inspect_main([])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "node-a" in out and "8/32" in out
+        # Succeeded pod excluded from accounting
+        assert "8/64" in out
+    finally:
+        api.stop()

@@ -1,0 +1,7 @@
+"""``kubectl-inspect-tpushare`` — cluster HBM binpacking report.
+
+Rebuild of the reference's ``cmd/inspect``: reconstructs per-chip
+allocation for every TPU-sharing node purely from node allocatable
+capacity and pod annotations (the cluster IS the database; the daemon
+keeps no state), then renders summary/details tables.
+"""

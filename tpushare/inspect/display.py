@@ -1,0 +1,118 @@
+"""Table rendering for the inspect CLI (rebuild of cmd/inspect/display.go).
+
+Summary: one row per node, ``TPU<i>(Allocated/Total)`` columns up to the
+cluster-max chip count, optional PENDING column, node and cluster totals.
+Details: per-node pod tables with per-chip columns.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List
+
+from .nodeinfo import (PENDING_IDX, NodeInfo, infer_memory_unit,
+                       pod_allocation)
+
+
+def _table(rows: List[List[str]], pad: int = 2) -> str:
+    """Minimal tabwriter: left-aligned columns sized to content."""
+    if not rows:
+        return ""
+    ncols = max(len(r) for r in rows)
+    widths = [0] * ncols
+    for r in rows:
+        for i, cell in enumerate(r):
+            widths[i] = max(widths[i], len(cell))
+    out = io.StringIO()
+    for r in rows:
+        line = (" " * pad).join(
+            cell.ljust(widths[i]) for i, cell in enumerate(r))
+        out.write(line.rstrip() + "\n")
+    return out.getvalue()
+
+
+def render_summary(infos: List[NodeInfo]) -> str:
+    unit = infer_memory_unit(infos)
+    sharing = [n for n in infos if n.total_mem > 0]
+    max_chips = max((n.chip_count for n in sharing), default=0)
+    has_pending = any(n.has_pending() for n in sharing)
+
+    header = ["NAME", "IPADDRESS"]
+    header += [f"TPU{i}(Allocated/Total)" for i in range(max_chips)]
+    if has_pending:
+        header.append("PENDING(Allocated)")
+    header.append(f"TPU Memory({unit})")
+
+    rows = [header]
+    used_cluster = total_cluster = 0
+    for info in sharing:
+        row = [info.name, info.address]
+        used_node = 0
+        for i in range(max_chips):
+            dev = info.devs.get(i)
+            row.append(dev.cell() if dev else "0/0")
+            if dev:
+                used_node += dev.used_mem
+        if has_pending:
+            pend = info.devs.get(PENDING_IDX)
+            row.append(str(pend.used_mem) if pend else "")
+            if pend:
+                used_node += pend.used_mem
+        row.append(f"{used_node}/{info.total_mem}")
+        rows.append(row)
+        used_cluster += used_node
+        total_cluster += info.total_mem
+
+    out = _table(rows)
+    pct = int(used_cluster / total_cluster * 100) if total_cluster else 0
+    out += "-" * 72 + "\n"
+    out += "Allocated/Total TPU Memory In Cluster:\n"
+    out += f"{used_cluster}/{total_cluster} ({pct}%)\n"
+    return out
+
+
+def render_details(infos: List[NodeInfo]) -> str:
+    out = io.StringIO()
+    used_cluster = total_cluster = 0
+    for info in infos:
+        if info.total_mem <= 0:
+            continue
+        out.write(f"\nNAME:       {info.name}\n")
+        out.write(f"IPADDRESS:  {info.address}\n\n")
+
+        header = ["NAME", "NAMESPACE"]
+        header += [f"TPU{i}(Allocated)" for i in range(info.chip_count)]
+        if info.has_pending():
+            header.append("Pending(Allocated)")
+        rows = [header]
+
+        seen = set()
+        used_node = 0
+        ncols = info.chip_count + (1 if info.has_pending() else 0)
+        for dev in info.devs.values():
+            used_node += dev.used_mem
+            for pod in dev.pods:
+                uid = pod.get("metadata", {}).get("uid")
+                if uid in seen:
+                    continue
+                seen.add(uid)
+                md = pod.get("metadata", {})
+                row = [md.get("name", "?"), md.get("namespace", "?")]
+                alloc = pod_allocation(pod)
+                for k in range(ncols):
+                    idx = k if k < info.chip_count else PENDING_IDX
+                    row.append(str(alloc.get(idx, 0)))
+                rows.append(row)
+        out.write(_table(rows))
+
+        pct = int(used_node / info.total_mem * 100) if info.total_mem else 0
+        out.write(f"Allocated : {used_node} ({pct}%)\n")
+        out.write(f"Total :     {info.total_mem}\n")
+        out.write("-" * 72 + "\n")
+        used_cluster += used_node
+        total_cluster += info.total_mem
+
+    pct = int(used_cluster / total_cluster * 100) if total_cluster else 0
+    out.write("\nAllocated/Total TPU Memory In Cluster:  "
+              f"{used_cluster}/{total_cluster} ({pct}%)\n")
+    return out.getvalue()

@@ -1,0 +1,68 @@
+"""``kubectl-inspect-tpushare`` entry point (rebuild of cmd/inspect/main.go).
+
+Usage: ``kubectl inspect tpushare [-d] [nodeName]`` — summary by default,
+``-d`` for per-pod details; optionally scoped to one node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from ..k8s.client import KubeClient
+from ..plugin import podutils
+from .display import render_details, render_summary
+from .nodeinfo import build_node_infos, is_tpu_sharing_node
+
+QUERY_RETRIES = 5
+
+
+def gather(kube: KubeClient, node_name: Optional[str] = None
+           ) -> Tuple[List[dict], List[dict]]:
+    """(tpu-sharing nodes, active pods) — cmd/inspect/podinfo.go."""
+    last: Exception = RuntimeError("unreachable")
+    for attempt in range(QUERY_RETRIES):
+        if attempt:
+            time.sleep(0.1)  # ride out transient blips (podinfo.go:69,87)
+        try:
+            if node_name:
+                nodes = [kube.get_node(node_name)]
+                pods = kube.list_pods(node_name=node_name)
+            else:
+                nodes = [n for n in kube.list_nodes()
+                         if is_tpu_sharing_node(n)]
+                pods = kube.list_pods()
+            active = [p for p in pods if podutils.is_active_pod(p)]
+            return nodes, active
+        except Exception as e:  # bounded retries (podinfo.go retries=5)
+            last = e
+    raise last
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubectl-inspect-tpushare",
+        description="Report per-chip TPU HBM binpacking across the cluster.")
+    ap.add_argument("-d", "--details", action="store_true",
+                    help="per-pod detail tables")
+    ap.add_argument("node", nargs="?", default=None,
+                    help="restrict to one node")
+    args = ap.parse_args(argv)
+
+    try:
+        kube = KubeClient.from_env()
+        nodes, pods = gather(kube, args.node)
+    except Exception as e:
+        print(f"Failed due to {e}", file=sys.stderr)
+        return 1
+
+    infos = build_node_infos(nodes, pods)
+    render = render_details if args.details else render_summary
+    sys.stdout.write(render(infos))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

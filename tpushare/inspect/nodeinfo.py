@@ -1,0 +1,157 @@
+"""Per-node allocation model reconstructed from cluster state.
+
+Rebuild of ``cmd/inspect/nodeinfo.go``: a node's chip inventory comes from
+its allocatable ``aliyun.com/tpu-mem`` / ``aliyun.com/tpu-count``; each
+pod's placement comes from (in priority order)
+
+1. the extender's JSON allocation annotation
+   ``scheduler.framework.tpushare.allocation`` = {container: {chipIdx:
+   mem}} (``nodeinfo.go:244-271``), or
+2. the legacy single-index annotation ``ALIYUN_COM_TPU_MEM_IDX``
+   (``nodeinfo.go:168-196``);
+
+pods with neither (or garbage) land in the **pending bucket** (index -1).
+The display unit is inferred per cluster: per-chip memory > 100 units
+means MiB, else GiB (``nodeinfo.go:227-243``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Dict, List
+
+from ..plugin import const, podutils
+
+log = logging.getLogger("tpushare.inspect")
+
+PENDING_IDX = -1
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    idx: int
+    total_mem: int
+    used_mem: int = 0
+    pods: List[dict] = dataclasses.field(default_factory=list)
+
+    def cell(self) -> str:
+        if self.idx == PENDING_IDX:
+            return str(self.used_mem)
+        return f"{self.used_mem}/{self.total_mem}"
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    node: dict
+    pods: List[dict] = dataclasses.field(default_factory=list)
+    devs: Dict[int, DeviceInfo] = dataclasses.field(default_factory=dict)
+    chip_count: int = 0
+    total_mem: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.node.get("metadata", {}).get("name", "?")
+
+    @property
+    def address(self) -> str:
+        for addr in self.node.get("status", {}).get("addresses", []):
+            if addr.get("type") == "InternalIP":
+                return addr.get("address", "unknown")
+        return "unknown"
+
+    @property
+    def used_mem(self) -> int:
+        return sum(d.used_mem for d in self.devs.values())
+
+    def has_pending(self) -> bool:
+        return PENDING_IDX in self.devs
+
+
+def node_total_mem(node: dict, resource: str = const.RESOURCE_NAME) -> int:
+    alloc = node.get("status", {}).get("allocatable", {})
+    try:
+        return int(alloc.get(resource, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def node_chip_count(node: dict, count_name: str = const.COUNT_NAME) -> int:
+    alloc = node.get("status", {}).get("allocatable", {})
+    try:
+        return int(alloc.get(count_name, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def is_tpu_sharing_node(node: dict) -> bool:
+    return node_total_mem(node) > 0
+
+
+def pod_allocation(pod: dict) -> Dict[int, int]:
+    """{chip_idx: mem_units} for one pod; {} when undeterminable.
+
+    New-style JSON annotation wins; legacy single-index annotation maps the
+    pod's whole request to one chip; garbage falls through to {} so the
+    caller buckets the pod as pending.
+    """
+    anns = pod.get("metadata", {}).get("annotations") or {}
+    raw = anns.get(const.ANN_TPU_ALLOCATION)
+    if raw:
+        try:
+            per_container = json.loads(raw)
+            out: Dict[int, int] = {}
+            for alloc in per_container.values():
+                for idx_str, mem in alloc.items():
+                    out[int(idx_str)] = out.get(int(idx_str), 0) + int(mem)
+            if out:
+                return out
+        except (ValueError, TypeError, AttributeError):
+            log.warning("malformed %s on pod %s", const.ANN_TPU_ALLOCATION,
+                        podutils.pod_key(pod))
+    idx = podutils.chip_index_from_annotation(pod)
+    if idx is None:
+        idx = PENDING_IDX
+    return {idx: podutils.pod_requested_units(pod)}
+
+
+def build_node_infos(nodes: List[dict], pods: List[dict]) -> List[NodeInfo]:
+    infos: List[NodeInfo] = []
+    for node in nodes:
+        info = NodeInfo(node=node,
+                        chip_count=node_chip_count(node),
+                        total_mem=node_total_mem(node))
+        per_chip = (info.total_mem // info.chip_count
+                    if info.chip_count else 0)
+        for i in range(info.chip_count):
+            info.devs[i] = DeviceInfo(idx=i, total_mem=per_chip)
+        info.pods = [p for p in pods
+                     if p.get("spec", {}).get("nodeName") == info.name]
+        if info.total_mem > 0:
+            _assign_pods(info, per_chip)
+        infos.append(info)
+    return infos
+
+
+def _assign_pods(info: NodeInfo, per_chip_mem: int) -> None:
+    for pod in info.pods:
+        if podutils.pod_requested_units(pod) <= 0:
+            continue
+        for idx, mem in pod_allocation(pod).items():
+            dev = info.devs.get(idx)
+            if dev is None:
+                dev = DeviceInfo(idx=idx, total_mem=per_chip_mem)
+                info.devs[idx] = dev
+            dev.used_mem += mem
+            dev.pods.append(pod)
+
+
+def infer_memory_unit(infos: List[NodeInfo]) -> str:
+    """Cluster-wide display-unit heuristic (nodeinfo.go:227-243)."""
+    for info in infos:
+        if info.chip_count > 0 and info.total_mem > 0:
+            if info.total_mem // info.chip_count > 100:
+                return "MiB"
+            return "GiB"
+    return "GiB"
