@@ -1,0 +1,160 @@
+/* tpushim — native TPU discovery shim for the tpushare device plugin.
+ *
+ * TPU analog of the reference's NVML dlopen shim (nvml_dl.c): libtpu.so is
+ * dlopen'd at RUNTIME so the daemon binary/wheel loads and runs on nodes
+ * without a TPU driver (CI, dev laptops, non-TPU nodes in a mixed
+ * DaemonSet rollout).  Exposed to Python via ctypes
+ * (tpushare/utils/nativeshim.py).
+ *
+ * Surface (all exported with default visibility):
+ *   int         tpushim_init(void);            1 iff libtpu.so present+sane
+ *   void        tpushim_shutdown(void);
+ *   int         tpushim_chip_count(void);      /dev/accel* (vfio fallback)
+ *   const char *tpushim_chip_info_json(int);   {"id","hbm_bytes","cores",
+ *                                               "generation","dev_path"}
+ *   const char *tpushim_version(void);
+ *
+ * Chip topology truth on a TPU VM is the device nodes plus the
+ * accelerator type (env TPU_ACCELERATOR_TYPE or GCE metadata, resolved by
+ * the Python side); the static generation table here mirrors
+ * tpushare/plugin/discovery.py:GENERATIONS.
+ */
+
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <glob.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define TPUSHIM_VERSION "0.1.0"
+#define MAX_CHIPS 64
+
+typedef struct {
+  char dev_path[256];
+  int devnum; /* the device node's own number (accel2 -> 2), NOT position */
+  long long hbm_bytes;
+  int cores;
+  char generation[16];
+} chip_t;
+
+static void *g_libtpu = NULL;
+static int g_inited = 0;
+static chip_t g_chips[MAX_CHIPS];
+static int g_nchips = 0;
+static char g_json_buf[512];
+
+static const long long GIB = 1024LL * 1024LL * 1024LL;
+
+typedef struct {
+  const char *key;   /* prefix in the accelerator-type string */
+  const char *name;  /* canonical display name */
+  long long hbm;
+  int cores;
+} gen_t;
+
+static const gen_t GENERATIONS[] = {
+    {"v2", "v2", 8, 2},          {"v3", "v3", 16, 2},
+    {"v4", "v4", 32, 1},         {"v5litepod", "v5e", 16, 1},
+    {"v5e", "v5e", 16, 1},       {"v5p", "v5p", 95, 1},
+    {"v6e", "v6e", 32, 1},
+};
+
+/* Fail-safe default when the generation is unknown: smallest HBM so the
+ * scheduler never over-binpacks (see discovery.py FALLBACK_GENERATION). */
+static const gen_t FALLBACK = {"unknown", "unknown", 8, 1};
+
+static const gen_t *resolve_generation(void) {
+  /* TPUSHIM_ACCELERATOR_TYPE wins: TPU_ACCELERATOR_TYPE can be rewritten
+   * by site hooks on some hosts, so tests/operators need a pure override. */
+  const char *acc = getenv("TPUSHIM_ACCELERATOR_TYPE");
+  if (acc == NULL) acc = getenv("TPU_ACCELERATOR_TYPE");
+  if (acc == NULL) return &FALLBACK;
+  for (size_t i = 0; i < sizeof(GENERATIONS) / sizeof(GENERATIONS[0]); i++) {
+    size_t n = strlen(GENERATIONS[i].key);
+    if (strncmp(acc, GENERATIONS[i].key, n) == 0 &&
+        (acc[n] == '-' || acc[n] == '\0'))
+      return &GENERATIONS[i];
+  }
+  return &FALLBACK;
+}
+
+static void scan_devices(void) {
+  glob_t g;
+  g_nchips = 0;
+  const gen_t *gen = resolve_generation();
+  /* TPUSHIM_DEV_GLOB overrides the scan root (tests, exotic layouts). */
+  const char *override = getenv("TPUSHIM_DEV_GLOB");
+  const char *patterns[] = {override ? override : "/dev/accel*",
+                            override ? override : "/dev/vfio/[0-9]*"};
+  for (int p = 0; p < 2 && g_nchips == 0; p++) {
+    if (glob(patterns[p], 0, NULL, &g) != 0) continue;
+    for (size_t i = 0; i < g.gl_pathc && g_nchips < MAX_CHIPS; i++) {
+      chip_t *c = &g_chips[g_nchips++];
+      snprintf(c->dev_path, sizeof(c->dev_path), "%s", g.gl_pathv[i]);
+      /* Chip identity = trailing number of the device node; with a sparse
+       * /dev (dead chip) a positional index would address wrong silicon. */
+      const char *p = g.gl_pathv[i] + strlen(g.gl_pathv[i]);
+      while (p > g.gl_pathv[i] && p[-1] >= '0' && p[-1] <= '9') p--;
+      c->devnum = (*p != '\0') ? atoi(p) : (int)i;
+      c->hbm_bytes = gen->hbm * GIB;
+      c->cores = gen->cores;
+      snprintf(c->generation, sizeof(c->generation), "%s", gen->name);
+    }
+    globfree(&g);
+  }
+}
+
+int tpushim_init(void) {
+  if (g_inited) return g_libtpu != NULL;
+  g_inited = 1;
+  /* Runtime dlopen — mirrors nvml_dl.c: probe well-known locations, accept
+   * absence.  RTLD_LAZY|RTLD_LOCAL: we only need a presence/sanity probe
+   * (the PJRT entry symbol), never to call into the TPU runtime here —
+   * owning the chip would conflict with the workload containers. */
+  const char *candidates[] = {
+      "libtpu.so",
+      "/usr/lib/libtpu.so",
+      "/lib/libtpu.so",
+      "/usr/share/tpu/libtpu.so",
+  };
+  for (size_t i = 0; i < sizeof(candidates) / sizeof(candidates[0]); i++) {
+    g_libtpu = dlopen(candidates[i], RTLD_LAZY | RTLD_LOCAL);
+    if (g_libtpu != NULL) break;
+  }
+  if (g_libtpu != NULL && dlsym(g_libtpu, "GetPjrtApi") == NULL) {
+    /* Not a PJRT-capable libtpu — treat as absent. */
+    dlclose(g_libtpu);
+    g_libtpu = NULL;
+  }
+  scan_devices();
+  return g_libtpu != NULL;
+}
+
+void tpushim_shutdown(void) {
+  if (g_libtpu != NULL) {
+    dlclose(g_libtpu);
+    g_libtpu = NULL;
+  }
+  g_inited = 0;
+  g_nchips = 0;
+}
+
+int tpushim_chip_count(void) {
+  if (!g_inited) tpushim_init();
+  return g_nchips;
+}
+
+const char *tpushim_chip_info_json(int index) {
+  if (!g_inited) tpushim_init();
+  if (index < 0 || index >= g_nchips) return NULL;
+  chip_t *c = &g_chips[index];
+  snprintf(g_json_buf, sizeof(g_json_buf),
+           "{\"id\": \"tpu-%s-%d\", \"index\": %d, \"dev_path\": \"%s\", "
+           "\"hbm_bytes\": %lld, \"cores\": %d, \"generation\": \"%s\"}",
+           c->generation, c->devnum, c->devnum, c->dev_path, c->hbm_bytes,
+           c->cores, c->generation);
+  return g_json_buf;
+}
+
+const char *tpushim_version(void) { return TPUSHIM_VERSION; }

@@ -11,7 +11,7 @@ import sys
 import time
 from typing import List, Optional, Tuple
 
-from ..k8s.client import KubeClient
+from ..k8s.client import ApiError, KubeClient
 from ..plugin import podutils
 from .display import render_details, render_summary
 from .nodeinfo import build_node_infos, is_tpu_sharing_node
@@ -29,6 +29,10 @@ def gather(kube: KubeClient, node_name: Optional[str] = None
         try:
             if node_name:
                 nodes = [kube.get_node(node_name)]
+                if not is_tpu_sharing_node(nodes[0]):
+                    print(f"warning: node {node_name} advertises no "
+                          f"tpu-mem (not a TPU-sharing node)",
+                          file=sys.stderr)
                 pods = kube.list_pods(node_name=node_name)
             else:
                 nodes = [n for n in kube.list_nodes()
@@ -36,6 +40,10 @@ def gather(kube: KubeClient, node_name: Optional[str] = None
                 pods = kube.list_pods()
             active = [p for p in pods if podutils.is_active_pod(p)]
             return nodes, active
+        except ApiError as e:
+            if 400 <= e.status < 500:
+                raise  # 404 etc. is not transient; retrying only adds load
+            last = e
         except Exception as e:  # bounded retries (podinfo.go retries=5)
             last = e
     raise last

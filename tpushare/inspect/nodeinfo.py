@@ -139,6 +139,16 @@ def _assign_pods(info: NodeInfo, per_chip_mem: int) -> None:
         if podutils.pod_requested_units(pod) <= 0:
             continue
         for idx, mem in pod_allocation(pod).items():
+            # A stale/bad index beyond this node's chip inventory would
+            # otherwise vanish from the summary columns while still being
+            # counted in node totals; bucket it as pending so the anomaly
+            # is visible — this is the exact situation a debugging tool
+            # should surface.
+            if idx >= info.chip_count:
+                log.warning("pod %s annotated with out-of-range chip %d "
+                            "(node has %d); showing as pending",
+                            podutils.pod_key(pod), idx, info.chip_count)
+                idx = PENDING_IDX
             dev = info.devs.get(idx)
             if dev is None:
                 dev = DeviceInfo(idx=idx, total_mem=per_chip_mem)

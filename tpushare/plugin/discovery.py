@@ -352,19 +352,24 @@ class LibtpuBackend(ChipBackend):
         n = self._shim.chip_count()
         md_chips = {c.index: c for c in self._fallback.chips()}
         out: List[Chip] = []
-        for i in range(n):
-            info = self._shim.chip_info(i)
-            md = md_chips.get(i)
+        for pos in range(n):
+            info = self._shim.chip_info(pos)
+            # The shim reports the device node's own number; positional
+            # numbering would misaddress chips on a sparse /dev.
+            idx = info.get("index", pos)
+            md = md_chips.get(idx)
+            shim_path = info.get("dev_path")
             out.append(Chip(
-                index=i,
-                id=info.get("id") or (md.id if md else f"tpu-chip-{i}"),
-                dev_paths=(md.dev_paths if md else (f"/dev/accel{i}",)),
+                index=idx,
+                id=info.get("id") or (md.id if md else f"tpu-chip-{idx}"),
+                dev_paths=((shim_path,) if shim_path
+                           else (md.dev_paths if md else (f"/dev/accel{idx}",))),
                 hbm_bytes=info.get("hbm_bytes")
-                or (md.hbm_bytes if md else GENERATIONS["v4"].hbm_bytes),
+                or (md.hbm_bytes if md else FALLBACK_GENERATION.hbm_bytes),
                 cores=info.get("cores")
                 or (md.cores if md else 1),
                 generation=info.get("generation")
-                or (md.generation if md else "v4"),
+                or (md.generation if md else FALLBACK_GENERATION.name),
             ))
         return out
 
