@@ -1,0 +1,98 @@
+"""tpushare benchmark: BERT-base inference throughput on one TPU chip.
+
+This is BASELINE config 2's workload (the co-location unit): a BERT-base
+encoder serving fixed-shape batches through the tpushare serving engine.
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
+reports the speedup of the TPU-first serving path (bf16, flash/fused
+attention, batched jit) over a naive single-query f32 path measured in
+the same run on the same chip — i.e. what a user gains over running one
+unoptimized pod per chip.
+
+Prints ONE JSON line:
+  {"metric": "bert_base_infer_qps", "value": N, "unit": "qps",
+   "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def main() -> int:
+    _log("importing jax...")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.models import bert
+    from tpushare.serving import InferenceEngine, measure_qps
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    _log(f"platform={platform}")
+
+    batch, seq = (32, 128) if on_tpu else (8, 64)
+    cfg = bert.bert_base() if on_tpu else bert.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+
+    # --- optimized path: tpushare serving engine ---------------------------
+    def fwd(tokens):
+        return bert.forward(params, tokens, cfg)
+
+    engine = InferenceEngine(fwd, batch_size=batch, seq_len=seq)
+    _log("compiling+warming optimized path...")
+    engine.warmup()
+    _log("measuring optimized path...")
+    n_batches = 30 if on_tpu else 5
+    stats = measure_qps(engine, n_batches=n_batches, warmup_batches=1)
+    _log(f"optimized qps={stats['qps']:.1f}")
+
+    # --- naive baseline: f32 params, reference attention, batch=1 ----------
+    naive_cfg = bert.BertConfig(
+        vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+        n_heads=cfg.n_heads, d_ff=cfg.d_ff, max_seq=cfg.max_seq,
+        n_types=cfg.n_types, dtype=jnp.float32)
+    naive_params = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), params)
+
+    def naive_fwd(tokens):
+        return bert.forward(naive_params, tokens, naive_cfg)
+
+    naive = InferenceEngine(naive_fwd, batch_size=1, seq_len=seq)
+    naive_queries = 8 if on_tpu else 3
+    tokens1 = np.random.randint(1, 100, size=(1, seq), dtype=np.int32)
+    _log("compiling naive baseline...")
+    naive.infer(tokens1)  # compile
+    _log("measuring naive baseline...")
+    t0 = time.perf_counter()
+    for _ in range(naive_queries):
+        naive.infer(tokens1)
+    naive_qps = naive_queries / (time.perf_counter() - t0)
+
+    result = {
+        "metric": "bert_base_infer_qps",
+        "value": round(stats["qps"], 2),
+        "unit": "qps",
+        "vs_baseline": round(stats["qps"] / max(naive_qps, 1e-9), 2),
+        "platform": platform,
+        "batch_size": batch,
+        "seq_len": seq,
+        "latency_ms_per_batch": round(stats["latency_ms"], 2),
+        "naive_qps_batch1_f32": round(naive_qps, 2),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

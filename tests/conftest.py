@@ -6,7 +6,18 @@ is validated without TPU hardware); env must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may pin JAX_PLATFORMS to a
+# remote TPU backend (axon) via sitecustomize. jax captures the env var
+# into its config at *import* time, so when sitecustomize has already
+# imported jax the env write alone does not land — update the live config
+# too (backend init itself is still lazy, so this works pre-first-use).
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys as _sys
+
+if "jax" in _sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -114,6 +114,9 @@ def test_e2e_jax_smoke_with_injected_env(api, plugin2):
     child_env = dict(os.environ)
     child_env.update(envs)
     child_env["JAX_PLATFORMS"] = "cpu"  # no TPU in CI; contract env rides along
+    # A site hook may dial a remote TPU tunnel at interpreter start when
+    # this is set; the smoke must run pure-CPU regardless of host state.
+    child_env.pop("PALLAS_AXON_POOL_IPS", None)
     out = subprocess.run(
         [sys.executable, "-c",
          "import os, jax, jax.numpy as jnp;"

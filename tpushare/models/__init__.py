@@ -1,0 +1,7 @@
+"""JAX model families served under tpushare allocations.
+
+``transformer`` — LLaMA-style decoder-only LM (BASELINE config 4 class);
+``bert`` — BERT/DistilBERT-style encoders (BASELINE configs 2–3 class).
+"""
+
+from . import bert, transformer  # noqa: F401

@@ -1,0 +1,138 @@
+"""BERT-style bidirectional encoder (BASELINE configs 2–3 workloads).
+
+Pure-JAX like ``transformer``; learned positional embeddings, GELU FFN,
+post-LN residuals (original BERT layout).  ``bert_base`` and
+``distilbert_base`` match the published architecture shapes so HBM
+footprints are realistic for the co-location benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    n_types: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def distilbert_base() -> BertConfig:
+    return BertConfig(n_layers=6, n_types=1)
+
+
+def tiny(dtype=jnp.float32) -> BertConfig:
+    return BertConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                      d_ff=128, max_seq=64, dtype=dtype)
+
+
+def init_params(key, cfg: BertConfig) -> Dict:
+    k_tok, k_pos, k_typ, k_stack = jax.random.split(key, 4)
+    d = cfg.d_model
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    def layer(k):
+        ks = jax.random.split(k, 6)
+        return {
+            "wq": dense(ks[0], d, (d, d)), "wq_bias": jnp.zeros((d,), cfg.dtype),
+            "wk": dense(ks[1], d, (d, d)), "wk_bias": jnp.zeros((d,), cfg.dtype),
+            "wv": dense(ks[2], d, (d, d)), "wv_bias": jnp.zeros((d,), cfg.dtype),
+            "wo": dense(ks[3], d, (d, d)), "wo_bias": jnp.zeros((d,), cfg.dtype),
+            "attn_ln_scale": jnp.ones((d,), cfg.dtype),
+            "attn_ln_bias": jnp.zeros((d,), cfg.dtype),
+            "w_up": dense(ks[4], d, (d, cfg.d_ff)),
+            "w_up_bias": jnp.zeros((cfg.d_ff,), cfg.dtype),
+            "w_down": dense(ks[5], cfg.d_ff, (cfg.d_ff, d)),
+            "w_down_bias": jnp.zeros((d,), cfg.dtype),
+            "ffn_ln_scale": jnp.ones((d,), cfg.dtype),
+            "ffn_ln_bias": jnp.zeros((d,), cfg.dtype),
+        }
+
+    # Stacked [L, ...] layer leaves + lax.scan in forward: one compiled
+    # layer body regardless of depth (same rationale as transformer.py).
+    layers = jax.vmap(layer)(jax.random.split(k_stack, cfg.n_layers))
+    return {
+        "tok_embed": dense(k_tok, d, (cfg.vocab, d)),
+        "pos_embed": dense(k_pos, d, (cfg.max_seq, d)),
+        "type_embed": dense(k_typ, d, (cfg.n_types, d)),
+        "embed_ln_scale": jnp.ones((d,), cfg.dtype),
+        "embed_ln_bias": jnp.zeros((d,), cfg.dtype),
+        "layers": layers,
+    }
+
+
+def layernorm(x, scale, bias, eps: float):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def forward(params, tokens, cfg: BertConfig, attention_mask=None,
+            token_types=None):
+    """tokens [B, S] -> final hidden states [B, S, d_model]."""
+    b, s = tokens.shape
+    x = params["tok_embed"][tokens]
+    x = x + params["pos_embed"][:s][None, :, :]
+    if token_types is None:
+        x = x + params["type_embed"][0][None, None, :]
+    else:
+        x = x + params["type_embed"][token_types]
+    x = layernorm(x, params["embed_ln_scale"], params["embed_ln_bias"],
+                  cfg.norm_eps)
+    x = x.astype(cfg.dtype)
+
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    def body(x, p):
+        q = (x @ p["wq"] + p["wq_bias"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        k = (x @ p["wk"] + p["wk_bias"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        v = (x @ p["wv"] + p["wv_bias"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+        if attention_mask is not None:
+            # padding mask path: dense attention with additive mask
+            scale = 1.0 / np.sqrt(hd)
+            logits = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
+            probs = jax.nn.softmax(
+                (logits + bias).astype(jnp.float32), axis=-1)
+            o = jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+        else:
+            o = attention(q, k, v, causal=False)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = layernorm(x + (o @ p["wo"] + p["wo_bias"]),
+                      p["attn_ln_scale"], p["attn_ln_bias"], cfg.norm_eps)
+        ffn = jax.nn.gelu(x @ p["w_up"] + p["w_up_bias"]) @ p["w_down"] \
+            + p["w_down_bias"]
+        x = layernorm(x + ffn, p["ffn_ln_scale"], p["ffn_ln_bias"],
+                      cfg.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x

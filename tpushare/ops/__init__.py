@@ -1,0 +1,3 @@
+"""TPU kernels (Pallas) with portable fallbacks."""
+
+from .attention import attention  # noqa: F401

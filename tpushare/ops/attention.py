@@ -1,0 +1,144 @@
+"""Attention: Pallas flash kernel for TPU, jnp reference elsewhere.
+
+The flash kernel streams K/V blocks through VMEM with an online-softmax
+accumulator, so the [S, S] score matrix never materializes in HBM — the
+standard memory-bound-to-compute-bound trade for TPU (MXU does the two
+matmuls per block; VPU the rescaling).  Block sizes honor the tiling
+constraints from the Pallas guide (last dim 128; second-to-last >= 8 for
+f32 / 16 for bf16).
+
+On CPU (tests, dev boxes) ``attention`` dispatches to the jnp reference —
+same math, XLA-fused, no Pallas dependency.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("tpushare.ops")
+
+NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        scale: Optional[float] = None):
+    """Plain softmax attention; q,k,v: [B, H, S, D] (k/v may have fewer
+    heads — GQA — already expanded by the caller)."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+    if causal:
+        s, t = q.shape[2], k.shape[2]
+        # offset supports cross-length (e.g. ring) blocks: positions are
+        # global, query i attends key j iff j <= i + (t - s)
+        mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, seq_k: int):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * scale          # [bq, d]
+    bq, d = q.shape
+    q_blk = pl.program_id(1)
+    q_start = q_blk * bq
+
+    m = jnp.full((bq, 1), NEG_INF, dtype=jnp.float32)   # running max
+    l = jnp.zeros((bq, 1), dtype=jnp.float32)           # running denom
+    acc = jnp.zeros((bq, d), dtype=jnp.float32)
+
+    n_kblocks = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_start = kb * block_k
+        k_blkd = pl.load(k_ref, (pl.dslice(k_start, block_k), slice(None)))
+        v_blkd = pl.load(v_ref, (pl.dslice(k_start, block_k), slice(None)))
+        s = q @ k_blkd.astype(jnp.float32).T             # [bq, bk] on MXU
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                           # [bq, bk]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + p @ v_blkd.astype(jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Skip fully-masked K blocks: for the q block ending at
+        # q_start+bq-1, only K blocks starting <= that position matter.
+        last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kblocks)
+    else:
+        last_kb = n_kblocks
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128):
+    """Pallas flash attention; q,k,v: [B, H, S, D], S % block == 0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    scale = 1.0 / np.sqrt(d)
+
+    qf = q.reshape(b * h, s, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               scale=scale, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def attention(q, k, v, causal: bool = True):
+    """Dispatch: Pallas flash on TPU (shape permitting), reference else.
+
+    The flash kernel masks in global coordinates assuming seq_q == seq_k;
+    cross-length causal attention (reference semantics: query i sees key
+    j <= i + (t - s)) must take the reference path.
+    """
+    s, d = q.shape[2], q.shape[3]
+    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d % 128 == 0):
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)

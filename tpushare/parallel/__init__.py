@@ -1,0 +1,3 @@
+"""Device meshes, sharding rules, and sequence-parallel attention."""
+
+from .mesh import make_mesh, shard_batch, shard_params  # noqa: F401

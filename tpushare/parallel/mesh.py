@@ -1,0 +1,145 @@
+"""Mesh construction and sharding rules (scaling-book style).
+
+The recipe: pick a mesh, annotate shardings with ``NamedSharding``, let
+XLA insert the collectives over ICI/DCN.  Axes used across tpushare:
+
+* ``dp``  — data parallel (batch dimension; gradient all-reduce)
+* ``tp``  — tensor parallel (attention heads / FFN hidden; all-gather +
+  reduce-scatter inserted by XLA from the shardings)
+* ``sp``  — sequence parallel (ring attention over sequence shards,
+  ``tpushare/parallel/ring.py``)
+
+The reference system contains no parallelism code (SURVEY.md §2.3) — the
+plugin partitions *chips between pods*; this package partitions *a model
+across the chips a pod was granted*.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger("tpushare.parallel")
+
+
+def make_mesh(axes: Dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from ``{axis_name: size}``; -1 means "the rest".
+
+    ``make_mesh({"dp": -1, "tp": 2})`` on 8 devices -> 4×2 mesh.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if known == 0:
+        raise ValueError(f"zero-size axis in {axes}")
+    if -1 in sizes:
+        if len(devs) % known:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by {known} for {axes}")
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {len(devs)}")
+    grid = np.array(devs[:total]).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+# A rule maps a parameter-name suffix to a PartitionSpec.  Megatron-style
+# layout: column-parallel in (wq/wk/wv/w_gate/w_up shard the output dim on
+# tp), row-parallel out (wo/w_down shard the input dim on tp) so each
+# transformer block needs exactly one reduction, which XLA emits as a
+# psum/reduce-scatter on ICI.
+TP_RULES: List[Tuple[str, P]] = [
+    ("embed", P(None, "tp")),
+    ("wq", P(None, "tp")),
+    ("wk", P(None, "tp")),
+    ("wv", P(None, "tp")),
+    ("wo", P("tp", None)),
+    ("w_gate", P(None, "tp")),
+    ("w_up", P(None, "tp")),
+    ("w_down", P("tp", None)),
+    ("lm_head", P(None, "tp")),
+    # norms / biases / small vectors replicate
+    ("scale", P()),
+    ("bias", P()),
+]
+
+
+def spec_for(path: str, rules: Sequence[Tuple[str, P]] = TP_RULES) -> P:
+    # Normalize jax.tree_util.keystr paths ("['layers'][0]['wq']") and
+    # plain "/"-joined paths to bare key names before suffix matching.
+    norm = path.replace("[", "/").replace("]", "").replace("'", "")
+    leaf_name = norm.rsplit("/", 1)[-1]
+    for suffix, spec in rules:
+        if leaf_name.endswith(suffix):
+            return spec
+    return P()
+
+
+def shard_params(params, mesh: Mesh,
+                 rules: Sequence[Tuple[str, P]] = TP_RULES):
+    """Place a param pytree onto the mesh per the rules (tp axis optional)."""
+    have_tp = "tp" in mesh.axis_names
+
+    def _place(path, leaf):
+        spec = spec_for(jax.tree_util.keystr(path), rules) if have_tp else P()
+        # Drop axes the array is too small to shard cleanly.
+        spec = _legalize(spec, leaf.shape, mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(_place, params)
+
+
+def param_shardings(params, mesh: Mesh,
+                    rules: Sequence[Tuple[str, P]] = TP_RULES):
+    """NamedSharding pytree (for jit in_shardings) without moving data."""
+    have_tp = "tp" in mesh.axis_names
+
+    def _spec(path, leaf):
+        spec = spec_for(jax.tree_util.keystr(path), rules) if have_tp else P()
+        return NamedSharding(mesh, _legalize(spec, leaf.shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(_spec, params)
+
+
+def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Right-align the spec to the array rank (stacked [L, ...] layer
+    leaves get a replicated leading layer axis) and clear entries that
+    don't divide their dimension evenly."""
+    entries = list(spec)
+    if len(entries) < len(shape):
+        entries = [None] * (len(shape) - len(entries)) + entries
+    out = []
+    for d, entry in enumerate(entries):
+        if entry is None or d >= len(shape):
+            out.append(None)
+            continue
+        axis_size = mesh.shape[entry]
+        out.append(None if shape[d] % axis_size else entry)
+    return P(*out)
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
+    """Shard array leaves along their leading (batch) dim on ``axis``."""
+    if axis not in mesh.axis_names:
+        return batch
+    def _place(leaf):
+        spec = _legalize(P(axis), leaf.shape, mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return jax.tree_util.tree_map(_place, batch)
+
+
+def replicated(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

@@ -1,0 +1,73 @@
+"""Ring attention: exact causal attention over sequence shards.
+
+Long-context path: the sequence is sharded over the ``sp`` mesh axis;
+each device keeps its Q shard resident and streams K/V shards around the
+ring with ``ppermute`` (one ICI hop per step), merging partial results
+with the same online-softmax rescaling the flash kernel uses.  Peak
+memory per device is O(S/n · S/n) for one block of scores instead of
+O(S²); comms overlap the next block's compute under XLA's async
+collectives.
+
+Built on ``shard_map`` so the collective schedule is explicit; the math
+is verified against dense attention in tests (CPU 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool):
+    """Per-device function: q,k,v are local shards [B, H, C, D]."""
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, h, c, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = me * c + jnp.arange(c)                       # global q positions
+
+    m0 = jnp.full((b, h, c, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, h, c, d), dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, acc, k_blk, v_blk = carry
+        src = (me - t) % n                               # who produced k_blk
+        k_pos = src * c + jnp.arange(c)
+        s = jnp.einsum("bhcd,bhtd->bhct", qf, k_blk.astype(jnp.float32))
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]      # [C, C] global
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhct,bhtd->bhcd", p, v_blk.astype(jnp.float32))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return m_new, l_new, acc_new, k_next, v_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True):
+    """q,k,v: [B, H, S, D] sharded (or shardable) on S over ``axis_name``."""
+    fn = functools.partial(_ring_body, axis_name=axis_name, causal=causal)
+    spec = P(None, None, axis_name, None)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return mapped(q, k, v)

@@ -1,0 +1,49 @@
+"""Sharded language-model training step (dp × tp, optax optimizer).
+
+The scaling-book recipe applied: params carry Megatron-style tp
+NamedShardings (``mesh.TP_RULES``), the batch is dp-sharded, the step is
+one ``jit`` — XLA inserts the gradient psums over dp and the activation
+collectives over tp on ICI.  Used by tests (8-device CPU mesh) and by
+``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import transformer
+
+
+def lm_loss(params, tokens, cfg: transformer.ModelConfig):
+    """Next-token cross-entropy; tokens [B, S+1] split into input/target."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = transformer.forward(params, inputs, cfg)   # [B, S, V] f32
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def make_train_step(cfg: transformer.ModelConfig, optimizer):
+    """Returns jitted (params, opt_state, tokens) -> (params, opt_state, loss).
+
+    ``jax.checkpoint`` on the loss trades recompute for HBM on long
+    sequences (rematerialized backward), the standard TPU memory lever.
+    """
+    loss_fn = jax.checkpoint(functools.partial(lm_loss, cfg=cfg))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -359,17 +359,25 @@ class LibtpuBackend(ChipBackend):
             idx = info.get("index", pos)
             md = md_chips.get(idx)
             shim_path = info.get("dev_path")
+            # The shim's generation comes from env only; when it fell back
+            # to "unknown" the metadata backend (GCE metadata server) may
+            # still know the real type — its data must win over the
+            # fail-safe, or a v4 node would advertise 8 of its 32 GiB.
+            shim_knows = info.get("generation") not in (None, "", "unknown")
             out.append(Chip(
                 index=idx,
                 id=info.get("id") or (md.id if md else f"tpu-chip-{idx}"),
                 dev_paths=((shim_path,) if shim_path
                            else (md.dev_paths if md else (f"/dev/accel{idx}",))),
-                hbm_bytes=info.get("hbm_bytes")
-                or (md.hbm_bytes if md else FALLBACK_GENERATION.hbm_bytes),
-                cores=info.get("cores")
-                or (md.cores if md else 1),
-                generation=info.get("generation")
-                or (md.generation if md else FALLBACK_GENERATION.name),
+                hbm_bytes=(info["hbm_bytes"] if shim_knows and
+                           info.get("hbm_bytes") else
+                           (md.hbm_bytes if md else
+                            FALLBACK_GENERATION.hbm_bytes)),
+                cores=(info["cores"] if shim_knows and info.get("cores")
+                       else (md.cores if md else 1)),
+                generation=(info["generation"] if shim_knows
+                            else (md.generation if md
+                                  else FALLBACK_GENERATION.name)),
             ))
         return out
 

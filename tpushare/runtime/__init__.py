@@ -1,0 +1,3 @@
+"""Workload-side consumer of the tpushare allocation contract."""
+
+from .contract import AllocationView, current_allocation  # noqa: F401

@@ -1,0 +1,108 @@
+"""Read and apply the env contract the device plugin injects.
+
+The plugin's ``Allocate`` response (``tpushare/plugin/allocate.py``) hands a
+container: ``TPU_VISIBLE_CHIPS``, ``TPU_PROCESS_BOUNDS`` /
+``TPU_CHIPS_PER_PROCESS_BOUNDS``, ``XLA_PYTHON_CLIENT_MEM_FRACTION`` and
+the ``ALIYUN_COM_TPU_MEM_*`` bookkeeping envs.  This module is the other
+half of that contract: a JAX workload calls :func:`current_allocation`
+before importing jax to discover its HBM budget and chip assignment, or
+:func:`enforce` to fail fast with a clear message when the scheduler could
+not place the pod (the plugin encodes failure *in* the env rather than
+failing the RPC — reference allocate.go:24-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("tpushare.runtime")
+
+# Keys mirror tpushare/plugin/const.py (kept literal here so the workload
+# package has no import dependency on the plugin package).
+_VISIBLE = "TPU_VISIBLE_CHIPS"
+_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+_POD = "ALIYUN_COM_TPU_MEM_POD"
+_CONTAINER = "ALIYUN_COM_TPU_MEM_CONTAINER"
+_DEV = "ALIYUN_COM_TPU_MEM_DEV"
+_IDX = "ALIYUN_COM_TPU_MEM_IDX"
+_FAILURE_PREFIX = "no-tpu-has-"
+
+
+class AllocationFailed(RuntimeError):
+    """The scheduler could not place this pod on any chip."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AllocationView:
+    """What the device plugin granted this container."""
+
+    chip_index: Optional[int]      # None when running unallocated (dev box)
+    hbm_fraction: Optional[float]
+    pod_units: Optional[int]       # tpu-mem units granted to the pod
+    container_units: Optional[int]
+    chip_units: Optional[int]      # whole chip's capacity in units
+    failure: Optional[str] = None  # failure marker, if allocation failed
+
+    @property
+    def allocated(self) -> bool:
+        return self.chip_index is not None and self.failure is None
+
+
+def current_allocation(env: Optional[dict] = None) -> AllocationView:
+    e = env if env is not None else os.environ
+    visible = e.get(_VISIBLE, "")
+    if visible.startswith(_FAILURE_PREFIX):
+        return AllocationView(None, None, None, None, None, failure=visible)
+
+    def _int(key):
+        try:
+            return int(e[key])
+        except (KeyError, ValueError):
+            return None
+
+    def _float(key):
+        try:
+            return float(e[key])
+        except (KeyError, ValueError):
+            return None
+
+    idx = _int(_IDX)
+    if idx is not None and idx < 0:
+        return AllocationView(None, None, None, None, None,
+                              failure=e.get(_VISIBLE) or "unallocated")
+    return AllocationView(
+        chip_index=idx,
+        hbm_fraction=_float(_FRACTION),
+        pod_units=_int(_POD),
+        container_units=_int(_CONTAINER),
+        chip_units=_int(_DEV),
+    )
+
+
+def enforce(env: Optional[dict] = None) -> AllocationView:
+    """Fail fast (with the scheduler's own words) on placement failure."""
+    view = current_allocation(env)
+    if view.failure and view.failure.startswith(_FAILURE_PREFIX):
+        raise AllocationFailed(
+            f"tpushare could not allocate this pod: {view.failure} — "
+            f"the node has no chip with the requested free HBM")
+    return view
+
+
+def apply_memory_budget(env: Optional[dict] = None) -> None:
+    """Make the granted HBM budget effective for this process.
+
+    Must run before the first ``import jax``.  XLA reads
+    ``XLA_PYTHON_CLIENT_MEM_FRACTION`` itself; we additionally disable
+    preallocation when sharing a chip so co-tenants fail on *their own*
+    overuse, not on startup reservation races.
+    """
+    e = env if env is not None else os.environ
+    view = current_allocation(e)
+    if view.allocated and view.hbm_fraction and view.hbm_fraction < 1.0:
+        e.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+        log.info("tpushare budget: chip %s, %.0f%% of HBM",
+                 view.chip_index, view.hbm_fraction * 100)

@@ -1,0 +1,3 @@
+"""Batched inference serving under a tpushare allocation."""
+
+from .engine import InferenceEngine, measure_qps  # noqa: F401

@@ -1,0 +1,114 @@
+"""Minimal batched inference engine + QPS measurement.
+
+This is the workload that runs *inside* an allocated container for the
+co-location benchmarks (BASELINE configs 2–4): a jitted forward, a
+background micro-batcher that coalesces concurrent requests (padding to a
+fixed batch so the jit cache stays warm), and a throughput probe.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class InferenceEngine:
+    """Wraps a jitted ``fn(batch_tokens) -> outputs`` with micro-batching."""
+
+    def __init__(self, fn: Callable, batch_size: int, seq_len: int,
+                 max_wait_ms: float = 2.0, pad_id: int = 0):
+        self.fn = jax.jit(fn)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.max_wait = max_wait_ms / 1000.0
+        self.pad_id = pad_id
+        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue]]" = queue.Queue()
+        self._halt = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # -- sync one-shot ------------------------------------------------------
+    def infer(self, tokens: np.ndarray):
+        """tokens [B, S] -> outputs, blocking."""
+        return jax.block_until_ready(self.fn(jnp.asarray(tokens)))
+
+    def warmup(self):
+        dummy = np.zeros((self.batch_size, self.seq_len), dtype=np.int32)
+        self.infer(dummy)
+
+    # -- server-style batching ---------------------------------------------
+    def start(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpushare-batcher")
+        self._worker.start()
+        return self
+
+    def stop(self):
+        self._halt.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        # Deliver a sentinel to requests still queued so no client blocks
+        # forever on its result queue.
+        while True:
+            try:
+                _, out_q = self._q.get_nowait()
+            except queue.Empty:
+                break
+            out_q.put(None)
+
+    def submit(self, tokens: np.ndarray) -> queue.Queue:
+        """Enqueue one request [S]; returns a queue delivering the result."""
+        out: queue.Queue = queue.Queue(maxsize=1)
+        self._q.put((tokens, out))
+        return out
+
+    def _loop(self):
+        while not self._halt.is_set():
+            batch: List[Tuple[np.ndarray, queue.Queue]] = []
+            try:
+                batch.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            deadline = time.monotonic() + self.max_wait
+            while len(batch) < self.batch_size:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=budget))
+                except queue.Empty:
+                    break
+            tokens = np.full((self.batch_size, self.seq_len), self.pad_id,
+                             dtype=np.int32)
+            for i, (toks, _) in enumerate(batch):
+                n = min(len(toks), self.seq_len)
+                tokens[i, :n] = toks[:n]
+            outputs = self.infer(tokens)
+            for i, (_, out_q) in enumerate(batch):
+                out_q.put(np.asarray(outputs[i]))
+
+
+def measure_qps(engine: InferenceEngine, n_batches: int = 20,
+                warmup_batches: int = 3) -> dict:
+    """Sustained throughput of full batches through the jitted forward."""
+    tokens = np.random.randint(
+        1, 100, size=(engine.batch_size, engine.seq_len), dtype=np.int32)
+    for _ in range(warmup_batches):
+        engine.infer(tokens)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        engine.infer(tokens)
+    dt = time.perf_counter() - t0
+    queries = n_batches * engine.batch_size
+    return {
+        "qps": queries / dt,
+        "latency_ms": dt / n_batches * 1000.0,
+        "batch_size": engine.batch_size,
+        "seq_len": engine.seq_len,
+        "seconds": dt,
+    }
