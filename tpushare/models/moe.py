@@ -1,0 +1,109 @@
+"""Mixture-of-Experts FFN with expert parallelism (``ep`` axis).
+
+Mesh-TensorFlow-style dense dispatch: a top-k router produces combine
+weights, tokens are dispatched to per-expert buffers with a capacity
+limit, expert FFNs run batched over the expert axis, and results combine
+back — all as einsums, so sharding the expert axis over ``ep``
+(``P("ep", ...)`` on the stacked expert weights) makes XLA insert the
+all-to-alls over ICI.  Load-balancing aux loss per Switch Transformer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    dtype: Any = jnp.float32
+
+
+def init_params(key, cfg: MoEConfig) -> Dict:
+    k_router, k_gate, k_up, k_down = jax.random.split(key, 4)
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                / np.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "router": dense(k_router, cfg.d_model, (cfg.d_model, cfg.n_experts)),
+        # stacked expert weights: leading expert axis shards over ep
+        "expert_gate": dense(k_gate, cfg.d_model,
+                             (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+        "expert_up": dense(k_up, cfg.d_model,
+                           (cfg.n_experts, cfg.d_model, cfg.d_ff)),
+        "expert_down": dense(k_down, cfg.d_ff,
+                             (cfg.n_experts, cfg.d_ff, cfg.d_model)),
+    }
+
+
+def forward(params, x, cfg: MoEConfig) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [B, S, d] -> (y [B, S, d], aux_loss scalar)."""
+    b, s, d = x.shape
+    n_tok = b * s
+    e = cfg.n_experts
+    cap = max(1, int(cfg.capacity_factor * n_tok * cfg.top_k / e))
+
+    xt = x.reshape(n_tok, d)
+    logits = (xt @ params["router"]).astype(jnp.float32)     # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k routing with per-expert capacity via cumulative position.
+    # Capacity positions must be unique across ALL slots of one expert:
+    # `counts` carries each expert's fill level from earlier slots, or two
+    # tokens arriving via different slots would share a buffer slot and
+    # their activations would silently mix.
+    topk_prob, topk_idx = jax.lax.top_k(probs, cfg.top_k)    # [T, k]
+    dispatch = jnp.zeros((n_tok, e, cap), dtype=x.dtype)
+    combine = jnp.zeros((n_tok, e, cap), dtype=jnp.float32)
+    counts = jnp.zeros((e,), dtype=jnp.float32)
+    for slot in range(cfg.top_k):
+        idx = topk_idx[:, slot]                              # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)   # [T, E]
+        within = jnp.cumsum(onehot, axis=0) - onehot         # rank this slot
+        pos = (((within + counts[None, :]) * onehot)
+               .sum(axis=-1)).astype(jnp.int32)              # [T]
+        keep = pos < cap
+        pos = jnp.clip(pos, 0, cap - 1)
+        slot_dispatch = (onehot * keep[:, None]).astype(x.dtype)
+        oh_cap = jax.nn.one_hot(pos, cap, dtype=x.dtype)     # [T, C]
+        dispatch = dispatch + slot_dispatch[:, :, None] * oh_cap[:, None, :]
+        combine = combine + (
+            (topk_prob[:, slot] * keep)[:, None, None]
+            * onehot[:, :, None] * oh_cap[:, None, :].astype(jnp.float32))
+        counts = counts + onehot.sum(axis=0)
+
+    # dispatch tokens: [E, C, d] (XLA all_to_all when experts are ep-sharded)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                               params["expert_gate"]))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, params["expert_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["expert_down"])
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+
+    # Switch-style load-balance aux loss
+    importance = probs.mean(axis=0)                          # [E]
+    load = jax.nn.one_hot(topk_idx[:, 0], e).mean(axis=0)    # top-1 load
+    aux = e * jnp.sum(importance * load)
+
+    return y.reshape(b, s, d), aux
+
+
+# sharding rule for tpushare.parallel.mesh: stacked expert weights shard
+# their leading axis over ep (and may additionally shard d_ff over tp).
+EP_RULES = [
+    ("router", None),           # replicated
+    ("expert_gate", ("ep", None, None)),
+    ("expert_up", ("ep", None, None)),
+    ("expert_down", ("ep", None, None)),
+]

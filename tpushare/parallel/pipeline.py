@@ -1,0 +1,89 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pp`` axis.
+
+The stacked layer params are split across pipeline stages (layer axis
+sharded over ``pp``); activations flow stage-to-stage with ``ppermute``
+(one ICI hop), microbatches keep every stage busy after the fill phase.
+Schedule length is ``n_micro + n_stages - 1`` steps; bubble fraction
+``(n_stages - 1) / (n_micro + n_stages - 1)`` — callers pick n_micro >>
+n_stages to amortize.
+
+shard_map keeps the schedule explicit (collectives and compute visible),
+matching the rest of ``tpushare.parallel``; correctness is tested against
+the sequential model on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(layer_fn: Callable, stacked_params, x_micro,
+                   mesh: Mesh, axis_name: str = "pp"):
+    """Run microbatches through layer stages spread over ``axis_name``.
+
+    * ``layer_fn(params_slice, x) -> x`` — one layer body (applied with
+      ``lax.scan`` over the stage's local layers).
+    * ``stacked_params`` — pytree with leading layer axis [L, ...],
+      L divisible by the pp size.
+    * ``x_micro`` — [M, mb, ...] microbatched activations, M divisible by
+      the pp size only for sharding simplicity of the output collect.
+
+    Returns [M, mb, ...] outputs (as produced by the last stage).
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = x_micro.shape[0]
+
+    def stage_fn(params_local, x_all):
+        # params_local: [L/n, ...] this stage's layers
+        # x_all: full [M, mb, ...] (replicated input; stage 0 feeds from it)
+        stage = jax.lax.axis_index(axis_name)
+
+        def run_stage(x):
+            return jax.lax.scan(
+                lambda h, p: (layer_fn(p, h), None), x, params_local)[0]
+
+        mb_shape = x_all.shape[1:]
+        buf = jnp.zeros(mb_shape, x_all.dtype)      # activation in flight
+        outs = jnp.zeros_like(x_all)                # last stage collects
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(t, carry):
+            buf, outs = carry
+            # Stage 0 ingests microbatch t (while it exists); other stages
+            # use what arrived from the previous stage last step.
+            feed = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
+            x_in = jnp.where(stage == 0, feed, buf)
+            y = run_stage(x_in)
+            # Last stage: microbatch index t - (n_stages - 1) completes.
+            done_idx = t - (n_stages - 1)
+            outs = jnp.where(
+                (stage == n_stages - 1) & (done_idx >= 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(done_idx, 0, n_micro - 1), axis=0),
+                outs)
+            buf = jax.lax.ppermute(y, axis_name, perm)
+            return buf, outs
+
+        _, outs = jax.lax.fori_loop(
+            0, n_micro + n_stages - 1, step, (buf, outs))
+        # Everyone but the last stage holds zeros; a psum broadcasts the
+        # completed outputs to all stages (replicated result).
+        outs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
+            axis_name)
+        return outs
+
+    layer_spec = P(axis_name)   # shard the layer axis across stages
+    param_specs = jax.tree_util.tree_map(lambda _: layer_spec, stacked_params)
+    mapped = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(param_specs, P()), out_specs=P(),
+        check_vma=False)
+    return mapped(stacked_params, x_micro)

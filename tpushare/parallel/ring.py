@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -69,5 +69,5 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     fn = functools.partial(_ring_body, axis_name=axis_name, causal=causal)
     spec = P(None, None, axis_name, None)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, check_vma=False)
     return mapped(q, k, v)
