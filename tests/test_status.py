@@ -1,0 +1,55 @@
+"""Daemon status endpoint: /metrics content, /healthz, /debug/stacks."""
+
+import urllib.request
+
+from tpushare.plugin import discovery, status
+from tpushare.plugin.server import TpuDevicePlugin
+from tpushare.plugin.status import StatusServer
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_status_endpoints(tmp_path):
+    backend = discovery.FakeBackend(n_chips=2, generation="v5e")
+    plugin = TpuDevicePlugin(backend,
+                             socket_path=str(tmp_path / "s.sock"),
+                             kubelet_socket=str(tmp_path / "k.sock"))
+    srv = StatusServer(0, plugin_ref=lambda: plugin).start()
+    try:
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and body == "ok\n"
+
+        status.inc("tpushare_allocations_total")
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        assert "tpushare_allocations_total" in body
+        assert 'tpushare_devices{state="healthy"} 32' in body
+        assert "tpushare_chips 2" in body
+
+        plugin.apply_health_event(
+            discovery.HealthEvent(0, healthy=False, reason="test"))
+        _, body = _get(srv.port, "/metrics")
+        assert 'tpushare_devices{state="healthy"} 16' in body
+        assert 'tpushare_devices{state="unhealthy"} 16' in body
+
+        code, body = _get(srv.port, "/debug/stacks")
+        assert code == 200 and "thread" in body
+    finally:
+        srv.stop()
+
+
+def test_status_404():
+    srv = StatusServer(0).start()
+    try:
+        import urllib.error
+        try:
+            _get(srv.port, "/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import attention
+from ..ops.quant import matmul_maybe_q as _mm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,9 +136,9 @@ def attention_block(p, x, cfg: ModelConfig, positions,
     b, s, d = x.shape
     h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    q = (x @ p["wq"]).reshape(b, s, h, hd)
-    k = (x @ p["wk"]).reshape(b, s, hkv, hd)
-    v = (x @ p["wv"]).reshape(b, s, hkv, hd)
+    q = _mm(x, p["wq"]).reshape(b, s, h, hd)
+    k = _mm(x, p["wk"]).reshape(b, s, hkv, hd)
+    v = _mm(x, p["wv"]).reshape(b, s, hkv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -169,11 +170,12 @@ def attention_block(p, x, cfg: ModelConfig, positions,
         o = attention(q, kk, vv, causal=True)
 
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
-    return o @ p["wo"], new_cache
+    return _mm(o, p["wo"]), new_cache
 
 
 def ffn_block(p, x):
-    return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
+    return _mm(jax.nn.silu(_mm(x, p["w_gate"])) * _mm(x, p["w_up"]),
+               p["w_down"])
 
 
 def forward(params, tokens, cfg: ModelConfig,
@@ -224,7 +226,7 @@ def forward(params, tokens, cfg: ModelConfig,
         new_caches = (new_ck, new_cv)
 
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     if new_caches is not None:
         return logits, new_caches
     return logits

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import List, Optional
 
-from . import const
+from . import const, status
 from .api import pb
 from .discovery import Chip, mem_units_per_chip
 
@@ -127,6 +127,7 @@ def make_allocator(pod_manager):
                 log.warning("no assumed pod matches request of %d %s "
                             "(candidates: %d)", pod_req, plugin.memory_unit,
                             len(candidates))
+                status.inc("tpushare_allocation_failures_total")
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
@@ -136,6 +137,7 @@ def make_allocator(pod_manager):
                     plugin, chip, len(creq.devicesIDs), pod_req,
                     isolation_off))
 
+            status.inc("tpushare_allocations_total")
             if pod is not None:
                 try:
                     pod_manager.mark_assigned(pod)

@@ -46,6 +46,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--standalone", action="store_true",
                     help="run without any cluster (no apiserver/kubelet pod "
                          "queries; single-chip fast-path allocation only)")
+    ap.add_argument("--status-port", type=int, default=0,
+                    help="serve /healthz /metrics /debug/stacks on this "
+                         "port (0 = disabled)")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     return ap
 
@@ -96,7 +99,17 @@ def main(argv=None) -> int:
         kubelet_socket=args.kubelet_socket,
         on_chips_ready=on_chips_ready)
     mgr.install_signal_handlers()
-    mgr.run()
+    status_srv = None
+    if args.status_port:
+        from .status import StatusServer
+        status_srv = StatusServer(args.status_port,
+                                  plugin_ref=lambda: mgr.plugin).start()
+        log.info("status endpoint on :%d", status_srv.port)
+    try:
+        mgr.run()
+    finally:
+        if status_srv is not None:
+            status_srv.stop()
     return 0
 
 

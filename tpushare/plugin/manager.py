@@ -107,6 +107,8 @@ class SharedTPUManager:
 
     def request_restart(self, why: str) -> None:
         log.info("restart requested (%s)", why)
+        from . import status
+        status.inc("tpushare_restarts_total")
         self._restart.set()
 
     def request_shutdown(self) -> None:

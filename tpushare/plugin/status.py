@@ -1,0 +1,91 @@
+"""Optional daemon status endpoint: /healthz, /metrics, /debug/stacks.
+
+The reference's only observability is leveled logging plus the inspect
+CLI (SURVEY.md §5); its one debug affordance is the SIGQUIT stack dump.
+This keeps both and adds an opt-in (``--status-port``) stdlib HTTP
+endpoint: Prometheus-text ``/metrics`` (allocation counters, device
+health) and ``/debug/stacks`` (the SIGQUIT dump, fetchable).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import stackdump
+
+_COUNTERS = {
+    "tpushare_allocations_total": 0,
+    "tpushare_allocation_failures_total": 0,
+    "tpushare_restarts_total": 0,
+}
+_LOCK = threading.Lock()
+
+
+def inc(name: str, by: int = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + by
+
+
+def counters() -> dict:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+class StatusServer:
+    def __init__(self, port: int, plugin_ref=None):
+        self.plugin_ref = plugin_ref   # callable returning current plugin
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body, ctype="text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok\n")
+                elif self.path == "/metrics":
+                    self._send(200, outer.render_metrics())
+                elif self.path == "/debug/stacks":
+                    self._send(200, stackdump.stack_trace())
+                else:
+                    self._send(404, "not found\n")
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="tpushare-status")
+
+    def render_metrics(self) -> str:
+        lines = []
+        for name, val in sorted(counters().items()):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {val}")
+        plugin = self.plugin_ref() if self.plugin_ref else None
+        if plugin is not None:
+            devs = plugin.device_list()
+            healthy = sum(d.health == "Healthy" for d in devs)
+            lines.append("# TYPE tpushare_devices gauge")
+            lines.append(f'tpushare_devices{{state="healthy"}} {healthy}')
+            lines.append(
+                f'tpushare_devices{{state="unhealthy"}} {len(devs) - healthy}')
+            lines.append("# TYPE tpushare_chips gauge")
+            lines.append(f"tpushare_chips {len(plugin.chips)}")
+        return "\n".join(lines) + "\n"
+
+    def start(self) -> "StatusServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

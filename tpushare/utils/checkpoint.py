@@ -1,0 +1,71 @@
+"""Parameter checkpointing: nested-dict pytrees ⇄ one ``.npz`` file.
+
+The control plane is deliberately stateless (SURVEY.md §5: all allocation
+state lives in the cluster); checkpointing is a *workload*-side need —
+model params (including int8 QTensors) saved atomically so a serving pod
+restarted by the scheduler reloads instead of re-initializing.
+
+Keys are ``/``-joined paths of the nested dicts; arrays round-trip with
+dtype (bf16 stored via uint16 view, which npz cannot hold natively).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BF16_SUFFIX = "__bf16"
+
+
+def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+        return out
+    key = prefix[:-1]
+    arr = np.asarray(tree)
+    if arr.dtype == jnp.bfloat16:
+        out[key + _BF16_SUFFIX] = arr.view(np.uint16)
+    else:
+        out[key] = arr
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> dict:
+    root: dict = {}
+    for key, arr in flat.items():
+        if key.endswith(_BF16_SUFFIX):
+            key = key[: -len(_BF16_SUFFIX)]
+            arr = arr.view(jnp.bfloat16)
+        node = root
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(arr)
+    return root
+
+
+def save_params(path: str, params: dict) -> None:
+    """Atomic save (write temp + rename) of a nested-dict param pytree."""
+    flat = _flatten(params)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_params(path: str) -> dict:
+    with np.load(path) as z:
+        return _unflatten({k: z[k] for k in z.files})
