@@ -54,6 +54,27 @@ def test_quantized_transformer_matches_dense_closely():
     assert q_bytes < dense_bytes / 2
 
 
+def test_quantized_params_keep_tp_sharding():
+    """quantize + shard must compose: int8 'q' leaves inherit the parent
+    weight's tp rule (silently replicating them would inflate per-chip
+    HBM by tp_size and defeat the quantization)."""
+    from tpushare.parallel import make_mesh, shard_params
+    cfg = transformer.tiny(d_model=64, n_heads=4, n_kv_heads=2)
+    qparams = quant.quantize_params(
+        transformer.init_params(jax.random.PRNGKey(0), cfg))
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    sharded = shard_params(qparams, mesh)
+    assert "tp" in str(sharded["layers"]["wq"]["q"].sharding.spec)
+    assert "tp" in str(sharded["layers"]["w_down"]["q"].sharding.spec)
+    # scales replicate (tiny; broadcast over the sharded output dim)
+    assert sharded["layers"]["wq"]["s"].sharding.spec == \
+        jax.sharding.PartitionSpec(None, None, None)
+    # and the sharded quantized model still runs
+    tokens = jnp.ones((2, 8), jnp.int32)
+    out = transformer.forward(sharded, tokens, cfg)
+    assert out.shape == (2, 8, cfg.vocab)
+
+
 def test_checkpoint_roundtrip_with_quantized_params(tmp_path):
     cfg = transformer.tiny(dtype=jnp.bfloat16)
     params = quant.quantize_params(
@@ -77,16 +98,18 @@ def test_checkpoint_roundtrip_with_quantized_params(tmp_path):
     assert out.shape == (1, 8, cfg.vocab)
 
 
-def test_checkpoint_atomicity(tmp_path):
+def test_checkpoint_atomicity(tmp_path, monkeypatch):
     path = str(tmp_path / "model.npz")
     checkpoint.save_params(path, {"a": jnp.ones((2, 2))})
     first = checkpoint.load_params(path)
-    # A failed save must not clobber the existing file.
-    class Boom(dict):
-        def items(self):
-            raise RuntimeError("boom")
+    # A save failing MID-WRITE (after the temp file opened) must not
+    # clobber the existing file and must clean up its temp file.
+    def boom(*a, **kw):
+        raise RuntimeError("disk full")
+    monkeypatch.setattr(checkpoint.np, "savez", boom)
     with pytest.raises(RuntimeError):
-        checkpoint.save_params(path, Boom())
+        checkpoint.save_params(path, {"a": jnp.zeros((2, 2))})
+    monkeypatch.undo()
     again = checkpoint.load_params(path)
     np.testing.assert_array_equal(np.asarray(first["a"]),
                                   np.asarray(again["a"]))

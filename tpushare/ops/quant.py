@@ -33,10 +33,6 @@ def dequantize(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
-class QTensor(Dict):
-    """Marker dict {'q': int8, 's': scale} so pytrees stay plain."""
-
-
 def qmatmul(x: jnp.ndarray, qw: Dict, dtype=None) -> jnp.ndarray:
     """x @ dequant(qw), with the dequant fused by XLA.
 
@@ -67,9 +63,8 @@ def quantize_params(params, suffixes=_QUANT_SUFFIXES):
     """Quantize matching 2D/stacked-3D weight leaves of a param pytree."""
 
     def visit(path, leaf):
-        name = jax.tree_util.keystr(path)
-        leaf_name = name.replace("[", "/").replace("]", "") \
-            .replace("'", "").rsplit("/", 1)[-1]
+        from ..utils.treepath import leaf_key
+        leaf_name = leaf_key(jax.tree_util.keystr(path))
         if leaf_name in suffixes and leaf.ndim >= 2:
             q, s = quantize(leaf)
             return {"q": q, "s": s}

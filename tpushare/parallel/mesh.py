@@ -78,12 +78,17 @@ TP_RULES: List[Tuple[str, P]] = [
 
 
 def spec_for(path: str, rules: Sequence[Tuple[str, P]] = TP_RULES) -> P:
-    # Normalize jax.tree_util.keystr paths ("['layers'][0]['wq']") and
-    # plain "/"-joined paths to bare key names before suffix matching.
-    norm = path.replace("[", "/").replace("]", "").replace("'", "")
-    leaf_name = norm.rsplit("/", 1)[-1]
+    from ..utils.treepath import leaf_key, param_key
+
+    # Quantized weights are {'q': int8, 's': scale} one level below the
+    # parameter name; they inherit the parameter's rule ('s' replicates —
+    # it broadcasts along the sharded output dim on every shard anyway,
+    # and is tiny).
+    if leaf_key(path) == "s":
+        return P()
+    name = param_key(path)
     for suffix, spec in rules:
-        if leaf_name.endswith(suffix):
+        if name.endswith(suffix):
             return spec
     return P()
 

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import List, Optional
 
-from . import const, status
+from . import const
 from .api import pb
 from .discovery import Chip, mem_units_per_chip
 
@@ -127,7 +127,6 @@ def make_allocator(pod_manager):
                 log.warning("no assumed pod matches request of %d %s "
                             "(candidates: %d)", pod_req, plugin.memory_unit,
                             len(candidates))
-                status.inc("tpushare_allocation_failures_total")
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
@@ -136,8 +135,9 @@ def make_allocator(pod_manager):
                 resp.container_responses.append(container_response(
                     plugin, chip, len(creq.devicesIDs), pod_req,
                     isolation_off))
-
+            from . import status
             status.inc("tpushare_allocations_total")
+
             if pod is not None:
                 try:
                     pod_manager.mark_assigned(pod)

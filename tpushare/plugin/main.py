@@ -49,6 +49,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--status-port", type=int, default=0,
                     help="serve /healthz /metrics /debug/stacks on this "
                          "port (0 = disabled)")
+    ap.add_argument("--status-addr", default="127.0.0.1",
+                    help="status bind address (loopback by default; the "
+                         "endpoint has no auth)")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     return ap
 
@@ -103,7 +106,8 @@ def main(argv=None) -> int:
     if args.status_port:
         from .status import StatusServer
         status_srv = StatusServer(args.status_port,
-                                  plugin_ref=lambda: mgr.plugin).start()
+                                  plugin_ref=lambda: mgr.plugin,
+                                  addr=args.status_addr).start()
         log.info("status endpoint on :%d", status_srv.port)
     try:
         mgr.run()

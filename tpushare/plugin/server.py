@@ -221,6 +221,8 @@ def failure_response(request: "pb.AllocateRequest", n_units: int,
     the reference's deliberate choice (allocate.go:24-39) so a mismatched
     pod fails visibly inside the workload rather than wedging kubelet.
     """
+    from . import status
+    status.inc("tpushare_allocation_failures_total")
     marker = const.ENV_ALLOC_FAILURE_FMT.format(n=n_units, unit=memory_unit)
     resp = pb.AllocateResponse()
     for _ in request.container_requests:
@@ -239,12 +241,13 @@ def default_allocator(plugin: TpuDevicePlugin,
     """
     n = sum(len(r.devicesIDs) for r in request.container_requests)
     if len(plugin.chips) == 1:
-        from . import allocate  # local import: avoids cycle at module load
+        from . import allocate, status  # local: avoids cycle at module load
         chip = plugin.chips[0]
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
             resp.container_responses.append(
                 allocate.container_response(
                     plugin, chip, len(creq.devicesIDs), n))
+        status.inc("tpushare_allocations_total")
         return resp
     return failure_response(request, n, plugin.memory_unit)

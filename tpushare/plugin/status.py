@@ -34,7 +34,10 @@ def counters() -> dict:
 
 
 class StatusServer:
-    def __init__(self, port: int, plugin_ref=None):
+    def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1"):
+        # Default loopback: /debug/stacks has no auth, and the daemon runs
+        # hostNetwork — exposing it node-wide must be an explicit choice
+        # (--status-addr 0.0.0.0).
         self.plugin_ref = plugin_ref   # callable returning current plugin
         outer = self
 
@@ -60,7 +63,7 @@ class StatusServer:
                 else:
                     self._send(404, "not found\n")
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._server = ThreadingHTTPServer((addr, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="tpushare-status")
@@ -72,8 +75,9 @@ class StatusServer:
             lines.append(f"{name} {val}")
         plugin = self.plugin_ref() if self.plugin_ref else None
         if plugin is not None:
+            from . import const
             devs = plugin.device_list()
-            healthy = sum(d.health == "Healthy" for d in devs)
+            healthy = sum(d.health == const.DEVICE_HEALTHY for d in devs)
             lines.append("# TYPE tpushare_devices gauge")
             lines.append(f'tpushare_devices{{state="healthy"}} {healthy}')
             lines.append(

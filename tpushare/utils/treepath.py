@@ -1,0 +1,30 @@
+"""Shared parsing of ``jax.tree_util.keystr`` paths.
+
+One canonical place to turn "['layers'][0]['wq']" (or "layers/0/wq")
+into key components — both the sharding rules and the quantizer match on
+these, and two private copies would drift when keystr's format changes.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def components(path: str) -> List[str]:
+    norm = path.replace("[", "/").replace("]", "").replace("'", "")
+    return [p for p in norm.split("/") if p]
+
+
+def leaf_key(path: str) -> str:
+    """Last component ('wq')."""
+    parts = components(path)
+    return parts[-1] if parts else ""
+
+
+def param_key(path: str) -> str:
+    """The parameter-name component: the last one, except that quantized
+    leaves ({'q','s'} one level down) report their parent ('wq', not 'q')."""
+    parts = components(path)
+    if len(parts) >= 2 and parts[-1] in ("q", "s"):
+        return parts[-2]
+    return parts[-1] if parts else ""
