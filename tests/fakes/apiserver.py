@@ -17,6 +17,7 @@ class FakeApiServer:
     def __init__(self):
         self.pods: List[dict] = []
         self.nodes: Dict[str, dict] = {}
+        self.bindings: List[tuple] = []     # (ns, name, node)
         self.patch_conflicts_remaining = 0  # inject 409s for retry tests
         self.requests: List[str] = []
         self._lock = threading.Lock()
@@ -44,6 +45,17 @@ class FakeApiServer:
                     self._send(200, {"kind": "PodList", "items": items})
                 elif parsed.path == "/pods/":  # kubelet read-only endpoint
                     self._send(200, {"kind": "PodList", "items": list(fake.pods)})
+                elif parsed.path.startswith("/api/v1/namespaces/"):
+                    parts = parsed.path.strip("/").split("/")
+                    # /api/v1/namespaces/<ns>/pods/<name>
+                    if len(parts) == 6 and parts[4] == "pods":
+                        pod = fake._find_pod(parts[3], parts[5])
+                        if pod is None:
+                            self._send(404, {"kind": "Status", "code": 404})
+                        else:
+                            self._send(200, pod)
+                    else:
+                        self._send(404, {"kind": "Status", "code": 404})
                 elif parsed.path.startswith("/api/v1/nodes/"):
                     name = parsed.path.rsplit("/", 1)[-1]
                     node = fake.nodes.get(name)
@@ -90,6 +102,28 @@ class FakeApiServer:
                             node.setdefault("status", {}).setdefault(
                                 field, {}).update(patch["status"][field])
                     self._send(200, node)
+                else:
+                    self._send(404, {"kind": "Status", "code": 404})
+
+            def do_POST(self):
+                with fake._lock:
+                    fake.requests.append(f"POST {self.path}")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                parts = urllib.parse.urlparse(self.path).path.strip("/").split("/")
+                # /api/v1/namespaces/<ns>/pods/<name>/binding
+                if len(parts) == 7 and parts[6] == "binding":
+                    pod = fake._find_pod(parts[3], parts[5])
+                    if pod is None:
+                        self._send(404, {"kind": "Status", "code": 404})
+                        return
+                    with fake._lock:
+                        fake.bindings.append(
+                            (parts[3], parts[5],
+                             body.get("target", {}).get("name")))
+                    pod.setdefault("spec", {})["nodeName"] = \
+                        body.get("target", {}).get("name")
+                    self._send(201, {"kind": "Status", "status": "Success"})
                 else:
                     self._send(404, {"kind": "Status", "code": 404})
 

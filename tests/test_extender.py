@@ -1,0 +1,185 @@
+"""Scheduler extender: binpack policy, webhook contract, and the full
+extender → device-plugin handshake."""
+
+import json
+import urllib.request
+
+import grpc
+import pytest
+
+from tpushare.extender import policy
+from tpushare.extender.server import ExtenderServer
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes.apiserver import FakeApiServer, make_pod
+from test_inspect import make_node
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def extender(api):
+    srv = ExtenderServer(KubeClient(api.url), port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+# -- policy ------------------------------------------------------------------
+def test_pick_chip_binpacks_tightest_fit():
+    node = make_node(tpu_mem=64, tpu_count=2)  # 2 chips x 32
+    pods = [make_pod("a", tpu_mem=20, chip_idx=0, assume_time=1,
+                     assigned="true", phase="Running")]
+    # chip0 has 12 free, chip1 has 32 free; request 10 -> chip0 (tightest)
+    fit = policy.pick_chip(node, pods, 10)
+    assert fit.chip_index == 0 and fit.free == 12
+    # request 14 only fits chip1
+    assert policy.pick_chip(node, pods, 14).chip_index == 1
+    # request 33 fits nothing
+    assert policy.pick_chip(node, pods, 33) is None
+
+
+def test_policy_counts_assumed_but_not_unannotated_pods():
+    node = make_node(tpu_mem=32, tpu_count=1)
+    assumed = make_pod("assumed", tpu_mem=30, chip_idx=0, assume_time=5,
+                       assigned="false")
+    unannotated = make_pod("plain", tpu_mem=30)  # no assume-time: not placed
+    assert policy.pick_chip(node, [assumed], 4) is None
+    assert policy.pick_chip(node, [unannotated], 4).chip_index == 0
+
+
+# -- webhook contract --------------------------------------------------------
+def test_filter_drops_full_nodes(api, extender):
+    api.nodes["node-full"] = make_node("node-full", tpu_mem=32, tpu_count=1)
+    api.nodes["node-free"] = make_node("node-free", tpu_mem=32, tpu_count=1)
+    api.pods = [make_pod("hog", node="node-full", tpu_mem=30, chip_idx=0,
+                         assume_time=1, assigned="true", phase="Running")]
+    result = _post(extender, "/filter", {
+        "Pod": make_pod("new", node="", tpu_mem=8),
+        "Nodes": {"items": [api.nodes["node-full"], api.nodes["node-free"]]},
+    })
+    names = [n["metadata"]["name"] for n in result["Nodes"]["items"]]
+    assert names == ["node-free"]
+    assert "node-full" in result["FailedNodes"]
+
+
+def test_priorities_prefer_utilized_node(api, extender):
+    api.nodes["empty"] = make_node("empty", tpu_mem=32, tpu_count=1)
+    api.nodes["busy"] = make_node("busy", tpu_mem=32, tpu_count=1)
+    api.pods = [make_pod("p", node="busy", tpu_mem=16, chip_idx=0,
+                         assume_time=1, assigned="true", phase="Running")]
+    scores = {s["Host"]: s["Score"] for s in _post(extender, "/priorities", {
+        "Pod": make_pod("new", node="", tpu_mem=8),
+        "NodeNames": ["empty", "busy"],
+    })}
+    assert scores["busy"] > scores["empty"]
+
+
+def test_bind_stamps_handshake_and_binds(api, extender):
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=64, tpu_count=2)
+    pod = make_pod("w", node="", tpu_mem=8)
+    api.pods = [pod]
+    result = _post(extender, "/bind", {
+        "PodName": "w", "PodNamespace": "default", "PodUID": "uid-w",
+        "Node": "node-a"})
+    assert result["Error"] == ""
+    anns = pod["metadata"]["annotations"]
+    assert anns[const.ANN_TPU_MEM_IDX] in ("0", "1")
+    assert anns[const.ANN_TPU_MEM_ASSIGNED] == "false"
+    assert int(anns[const.ANN_TPU_MEM_ASSUME_TIME]) > 0
+    alloc = json.loads(anns[const.ANN_TPU_ALLOCATION])
+    assert list(alloc["0"].values()) == [8]
+    assert api.bindings == [("default", "w", "node-a")]
+
+
+def test_bind_non_tpu_pod_binds_plainly(api, extender):
+    """A pod with no tpu-mem request must still get bound (no annotations) —
+    filter passes such pods through, so bind must not strand them."""
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=64, tpu_count=2)
+    pod = make_pod("plain", node="", tpu_mem=0)
+    api.pods = [pod]
+    result = _post(extender, "/bind", {
+        "PodName": "plain", "PodNamespace": "default", "Node": "node-a"})
+    assert result["Error"] == ""
+    assert api.bindings == [("default", "plain", "node-a")]
+    assert const.ANN_TPU_MEM_IDX not in pod["metadata"]["annotations"]
+
+
+def test_auth_token_rejects_unauthenticated(api):
+    srv = ExtenderServer(KubeClient(api.url), port=0,
+                         auth_token="sekrit").start()
+    try:
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv, "/filter", {})
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/healthz",
+            headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+    finally:
+        srv.stop()
+
+
+def test_bind_no_fit_reports_error_in_band(api, extender):
+    api.nodes["tiny"] = make_node("tiny", tpu_mem=8, tpu_count=1)
+    api.pods = [make_pod("big", node="", tpu_mem=9)]
+    result = _post(extender, "/bind", {
+        "PodName": "big", "PodNamespace": "default", "Node": "tiny"})
+    assert "no chip" in result["Error"]
+    assert api.bindings == []
+
+
+# -- full handshake: extender bind -> device plugin Allocate -----------------
+def test_extender_to_plugin_handshake(api, extender, tmp_path):
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=64, tpu_count=2)
+    # occupy chip 0 so binpack sends the new pod there (16 free < 32 free)
+    api.pods = [
+        make_pod("prior", tpu_mem=16, chip_idx=0, assume_time=1,
+                 assigned="true", phase="Running"),
+        make_pod("w", node="", tpu_mem=8, phase="Pending"),
+    ]
+    result = _post(extender, "/bind", {
+        "PodName": "w", "PodNamespace": "default", "Node": "node-a"})
+    assert result["Error"] == ""
+    assert api.pods[1]["metadata"]["annotations"][const.ANN_TPU_MEM_IDX] == "0"
+
+    # kubelet now calls Allocate on the device plugin of node-a
+    backend = discovery.FakeBackend(n_chips=2, generation="v4")
+    pm = PodManager(KubeClient(api.url), "node-a")
+    plugin = TpuDevicePlugin(backend, allocator=allocate.make_allocator(pm),
+                             socket_path=str(tmp_path / "s.sock"),
+                             kubelet_socket=str(tmp_path / "k.sock"))
+    plugin.start()
+    try:
+        ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+            container_requests=[pb.ContainerAllocateRequest(
+                devicesIDs=[fid for fid, _ in plugin.devices[:8]])]))
+        envs = dict(resp.container_responses[0].envs)
+        assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"  # extender's choice
+        assert envs[const.ENV_XLA_MEM_FRACTION] == "0.25"
+        assert api.pods[1]["metadata"]["annotations"][
+            const.ANN_TPU_MEM_ASSIGNED] == "true"
+        ch.close()
+    finally:
+        plugin.stop()

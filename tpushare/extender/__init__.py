@@ -1,0 +1,9 @@
+"""tpushare scheduler extender: HBM binpack placement for aliyun.com/tpu-mem.
+
+The reference delegates this to a companion repo (README.md:14 points at
+the gpushare scheduler extender); tpushare ships its own so the framework
+is self-contained.  It implements the standard kube-scheduler extender
+webhook contract (filter / priorities / bind) with the same mem-binpack
+policy and writes the same assume/assign annotation handshake the device
+plugin's ``Allocate`` consumes (SURVEY.md §0.2-0.3).
+"""

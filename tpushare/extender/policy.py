@@ -1,0 +1,82 @@
+"""Binpack placement policy over per-chip free HBM.
+
+State is reconstructed exactly the way the inspect CLI does it
+(``tpushare.inspect.nodeinfo``): node allocatable capacity + pod
+annotations — the extender keeps no database, so a restarted extender
+resumes correct placement immediately (the reference design's best
+property, kept deliberately).
+
+Policy: a pod fits a node if some single chip has enough free HBM for
+the pod's whole request (requests never span chips — same invariant as
+the reference's one-IDX annotation).  Among fitting chips, pick the one
+with the LEAST free HBM (classic binpack: keep big holes for big pods);
+node score for priorities = highest used fraction after placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..inspect import nodeinfo
+from ..plugin import const, podutils
+
+log = logging.getLogger("tpushare.extender")
+
+
+@dataclasses.dataclass
+class ChipFit:
+    chip_index: int
+    free: int
+    total: int
+
+
+def chip_free_hbm(info: nodeinfo.NodeInfo) -> Dict[int, ChipFit]:
+    """Free units per chip, counting BOTH assigned and assumed pods."""
+    out: Dict[int, ChipFit] = {}
+    for idx, dev in info.devs.items():
+        if idx == nodeinfo.PENDING_IDX:
+            continue
+        out[idx] = ChipFit(idx, dev.total_mem - dev.used_mem, dev.total_mem)
+    return out
+
+
+def _is_counted(pod: dict) -> bool:
+    """Pods holding HBM: active, and either assigned or still assumed."""
+    if not podutils.is_active_pod(pod):
+        return False
+    anns = pod.get("metadata", {}).get("annotations") or {}
+    if const.ANN_TPU_MEM_ASSUME_TIME not in anns:
+        return False
+    return podutils.pod_requested_units(pod) > 0
+
+
+def build_node_state(node: dict, pods: List[dict]) -> nodeinfo.NodeInfo:
+    counted = [p for p in pods if _is_counted(p)]
+    return nodeinfo.build_node_infos([node], counted)[0]
+
+
+def pick_chip(node: dict, pods: List[dict], request_units: int
+              ) -> Optional[ChipFit]:
+    """Binpack choice on one node; None when nothing fits."""
+    if request_units <= 0:
+        return None
+    info = build_node_state(node, pods)
+    fits = [c for c in chip_free_hbm(info).values()
+            if c.free >= request_units]
+    if not fits:
+        return None
+    # least free space that still fits => tightest packing
+    return min(fits, key=lambda c: (c.free, c.chip_index))
+
+
+def node_score(node: dict, pods: List[dict], request_units: int) -> int:
+    """0-10 priority: prefer nodes that end up most utilized (binpack)."""
+    info = build_node_state(node, pods)
+    fits = [c for c in chip_free_hbm(info).values()
+            if c.free >= request_units]
+    if not fits or info.total_mem <= 0:
+        return 0
+    used_after = info.used_mem + request_units
+    return max(1, min(10, int(10.0 * used_after / info.total_mem)))

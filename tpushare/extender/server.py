@@ -1,0 +1,199 @@
+"""HTTP webhook server speaking the kube-scheduler extender contract.
+
+Endpoints (configured in the scheduler policy/KubeSchedulerConfiguration):
+
+* ``POST /filter``     — drop nodes where no single chip fits the pod;
+* ``POST /priorities`` — binpack score (most-utilized-after wins);
+* ``POST /bind``       — the write side: choose the chip, stamp the
+  assume/assign annotations the device plugin's Allocate matches on
+  (chip index, assume-time, ASSIGNED=false, plus the new-style JSON
+  allocation map the inspect CLI prefers), then create the pod binding.
+  Pods without a tpu-mem request are bound plainly, mirroring filter's
+  don't-interfere pass-through.
+
+State lives entirely in the cluster (SURVEY.md §0.2-0.3).  The listener
+must be reachable by kube-scheduler, so it binds wide by default — put
+it behind the optional shared-token check (``--auth-token-file``) and/or
+network policy; the bind verb is scheduler-level write access.
+
+Efficiency: one pod list per webhook call, grouped by node locally —
+not one list per candidate node (a 100-node filter would otherwise fan
+out 100 field-selector list requests per scheduled pod).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+from ..k8s.client import KubeClient
+from ..plugin import const, podutils
+from ..utils.httpserver import JsonHTTPServer
+from . import policy
+
+log = logging.getLogger("tpushare.extender")
+
+
+class ExtenderServer:
+    def __init__(self, kube: KubeClient, port: int = 39999,
+                 addr: str = "0.0.0.0",
+                 resource_name: str = const.RESOURCE_NAME,
+                 auth_token: str = None):
+        self.kube = kube
+        self.resource_name = resource_name
+        self._http = JsonHTTPServer(port, addr, routes={
+            ("POST", "/filter"): lambda b: (200, self.filter(b or {})),
+            ("POST", "/priorities"): lambda b: (200, self.priorities(b or {})),
+            ("POST", "/bind"): lambda b: (200, self.bind(b or {})),
+            ("GET", "/healthz"): lambda _: (200, "ok\n"),
+        }, auth_token=auth_token)
+        self.port = self._http.port
+
+    # ------------------------------------------------------------------
+    def _request_units(self, pod: dict) -> int:
+        return podutils.pod_requested_units(pod, self.resource_name)
+
+    def _pods_by_node(self) -> Dict[str, List[dict]]:
+        by_node: Dict[str, List[dict]] = defaultdict(list)
+        for p in self.kube.list_pods():
+            node = p.get("spec", {}).get("nodeName")
+            if node:
+                by_node[node].append(p)
+        return by_node
+
+    def _nodes_from_args(self, args: dict) -> List[dict]:
+        nodes = (args.get("Nodes") or {}).get("Items") \
+            or (args.get("Nodes") or {}).get("items")
+        if nodes:
+            return nodes
+        names = args.get("NodeNames") or []
+        return [self.kube.get_node(n) for n in names]
+
+    # ------------------------------------------------------------------
+    def filter(self, args: dict) -> dict:
+        pod = args.get("Pod") or {}
+        req = self._request_units(pod)
+        nodes = self._nodes_from_args(args)
+        if req <= 0:
+            # not our resource; don't interfere
+            return {"Nodes": {"items": nodes}, "NodeNames": None,
+                    "FailedNodes": {}, "Error": ""}
+        by_node = self._pods_by_node()
+        passed, failed = [], {}
+        for node in nodes:
+            name = node.get("metadata", {}).get("name", "?")
+            fit = policy.pick_chip(node, by_node.get(name, []), req)
+            if fit is None:
+                failed[name] = (f"no single TPU chip with {req} free "
+                                f"{self.resource_name}")
+            else:
+                passed.append(node)
+        return {"Nodes": {"items": passed},
+                "NodeNames": None,
+                "FailedNodes": failed,
+                "Error": ""}
+
+    def priorities(self, args: dict) -> list:
+        pod = args.get("Pod") or {}
+        req = self._request_units(pod)
+        nodes = self._nodes_from_args(args)
+        if req <= 0:
+            return [{"Host": n.get("metadata", {}).get("name", "?"),
+                     "Score": 0} for n in nodes]
+        by_node = self._pods_by_node()
+        out = []
+        for node in nodes:
+            name = node.get("metadata", {}).get("name", "?")
+            out.append({"Host": name,
+                        "Score": policy.node_score(
+                            node, by_node.get(name, []), req)})
+        return out
+
+    def bind(self, args: dict) -> dict:
+        ns = args.get("PodNamespace", "default")
+        name = args.get("PodName")
+        node_name = args.get("Node")
+        pod = self.kube.get_pod(ns, name)
+        req = self._request_units(pod)
+
+        if req > 0:
+            node = self.kube.get_node(node_name)
+            fit = policy.pick_chip(
+                node, self._pods_by_node().get(node_name, []), req)
+            if fit is None:
+                return {"Error": f"no chip on {node_name} fits {req} "
+                                 f"{self.resource_name}"}
+            # The handshake the device plugin matches on (SURVEY.md §0.2):
+            annotations = {
+                const.ANN_TPU_MEM_IDX: str(fit.chip_index),
+                const.ANN_TPU_MEM_POD: str(req),
+                const.ANN_TPU_MEM_ASSUME_TIME: str(time.time_ns()),
+                const.ANN_TPU_MEM_ASSIGNED: "false",
+                # new-style allocation map: {container: {chip: mem}}
+                const.ANN_TPU_ALLOCATION: json.dumps(
+                    {"0": {str(fit.chip_index): req}}),
+            }
+            self.kube.patch_pod_annotations(ns, name, annotations)
+
+        try:
+            self.kube.bind_pod(ns, name, node_name, uid=args.get("PodUID"))
+        except Exception as e:
+            if req > 0:
+                # Roll the assumption back so capacity is not leaked.
+                self.kube.patch_pod_annotations(
+                    ns, name, {const.ANN_TPU_MEM_ASSIGNED: "rollback"})
+            return {"Error": f"binding failed: {e}"}
+        if req > 0:
+            log.info("bound %s/%s -> %s chip %s (%d units)",
+                     ns, name, node_name,
+                     annotations[const.ANN_TPU_MEM_IDX], req)
+        return {"Error": ""}
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ExtenderServer":
+        self._http.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._http.serve_forever()
+
+    def stop(self) -> None:
+        self._http.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpushare-scheduler-extender",
+        description="HBM binpack scheduler extender for aliyun.com/tpu-mem")
+    ap.add_argument("--port", type=int, default=39999)
+    ap.add_argument("--addr", default="0.0.0.0",
+                    help="bind address; kube-scheduler must reach it. The "
+                         "bind verb is scheduler-level write access — "
+                         "restrict with --auth-token-file / network policy")
+    ap.add_argument("--auth-token-file", default=None,
+                    help="require 'Authorization: Bearer <token>' matching "
+                         "this file's contents")
+    ap.add_argument("--resource-name", default=const.RESOURCE_NAME)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    token = None
+    if args.auth_token_file:
+        with open(args.auth_token_file) as f:
+            token = f.read().strip()
+    srv = ExtenderServer(KubeClient.from_env(), port=args.port,
+                         addr=args.addr, resource_name=args.resource_name,
+                         auth_token=token)
+    log.info("extender listening on %s:%d", args.addr, srv.port)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

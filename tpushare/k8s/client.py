@@ -161,6 +161,24 @@ class KubeClient:
         q = {"fieldSelector": ",".join(selectors)} if selectors else None
         return self._request("GET", path, query=q).get("items", [])
 
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._request("GET",
+                             f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def bind_pod(self, namespace: str, name: str, node: str,
+                 uid: Optional[str] = None) -> dict:
+        """POST pods/{name}/binding — the scheduler-extender bind verb."""
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace,
+                         **({"uid": uid} if uid else {})},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        return self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            body=body)
+
     def patch_pod_annotations(self, namespace: str, name: str,
                               annotations: Dict[str, str]) -> dict:
         return self._request(

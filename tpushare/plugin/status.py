@@ -4,16 +4,17 @@ The reference's only observability is leveled logging plus the inspect
 CLI (SURVEY.md §5); its one debug affordance is the SIGQUIT stack dump.
 This keeps both and adds an opt-in (``--status-port``) stdlib HTTP
 endpoint: Prometheus-text ``/metrics`` (allocation counters, device
-health) and ``/debug/stacks`` (the SIGQUIT dump, fetchable).
+health) and ``/debug/stacks`` (the SIGQUIT dump, fetchable).  Binds
+loopback by default — /debug/stacks has no auth and the daemon runs
+hostNetwork, so node-wide exposure must be an explicit choice.
 """
 
 from __future__ import annotations
 
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 from ..utils import stackdump
+from ..utils.httpserver import JsonHTTPServer
 
 _COUNTERS = {
     "tpushare_allocations_total": 0,
@@ -35,47 +36,22 @@ def counters() -> dict:
 
 class StatusServer:
     def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1"):
-        # Default loopback: /debug/stacks has no auth, and the daemon runs
-        # hostNetwork — exposing it node-wide must be an explicit choice
-        # (--status-addr 0.0.0.0).
         self.plugin_ref = plugin_ref   # callable returning current plugin
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, code, body, ctype="text/plain; charset=utf-8"):
-                data = body.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def do_GET(self):
-                if self.path == "/healthz":
-                    self._send(200, "ok\n")
-                elif self.path == "/metrics":
-                    self._send(200, outer.render_metrics())
-                elif self.path == "/debug/stacks":
-                    self._send(200, stackdump.stack_trace())
-                else:
-                    self._send(404, "not found\n")
-
-        self._server = ThreadingHTTPServer((addr, port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True, name="tpushare-status")
+        self._http = JsonHTTPServer(port, addr, routes={
+            ("GET", "/healthz"): lambda _: (200, "ok\n"),
+            ("GET", "/metrics"): lambda _: (200, self.render_metrics()),
+            ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
+        })
+        self.port = self._http.port
 
     def render_metrics(self) -> str:
+        from . import const
         lines = []
         for name, val in sorted(counters().items()):
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name} {val}")
         plugin = self.plugin_ref() if self.plugin_ref else None
         if plugin is not None:
-            from . import const
             devs = plugin.device_list()
             healthy = sum(d.health == const.DEVICE_HEALTHY for d in devs)
             lines.append("# TYPE tpushare_devices gauge")
@@ -87,9 +63,8 @@ class StatusServer:
         return "\n".join(lines) + "\n"
 
     def start(self) -> "StatusServer":
-        self._thread.start()
+        self._http.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        self._http.stop()

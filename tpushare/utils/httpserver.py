@@ -1,0 +1,92 @@
+"""Shared threaded-HTTP scaffold for the daemon's small endpoints.
+
+One lifecycle implementation (bind, port readback, daemon thread,
+start/stop) for the status endpoint and the scheduler extender, so
+hardening fixes land once.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class JsonHTTPServer:
+    """Routes: {(method, path): handler}; handler(body_dict|None) ->
+    (code, payload).  Payload str -> text/plain, else JSON."""
+
+    def __init__(self, port: int, addr: str,
+                 routes: dict,
+                 auth_token: Optional[str] = None):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, payload) -> None:
+                if isinstance(payload, str):
+                    data = payload.encode()
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    data = json.dumps(payload).encode()
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _authorized(self) -> bool:
+                if outer.auth_token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                return got == f"Bearer {outer.auth_token}"
+
+            def _dispatch(self, method: str):
+                if not self._authorized():
+                    self._send(401, {"Error": "unauthorized"})
+                    return
+                handler = outer.routes.get((method, self.path))
+                if handler is None:
+                    self._send(404, {"Error": "not found"})
+                    return
+                body = None
+                if method == "POST":
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                    except json.JSONDecodeError:
+                        self._send(400, {"Error": "bad json"})
+                        return
+                try:
+                    code, payload = handler(body)
+                except Exception as e:  # surface in-band, keep serving
+                    code, payload = 200, {"Error": str(e)}
+                self._send(code, payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self.routes = routes
+        self.auth_token = auth_token
+        self._server = ThreadingHTTPServer((addr, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="tpushare-http")
+
+    def start(self) -> "JsonHTTPServer":
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
