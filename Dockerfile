@@ -1,0 +1,18 @@
+# tpushare device-plugin image: Python daemon + native libtpu shim.
+# (Reference builds a static Go binary with dlopen'd NVML; here the C
+# shim provides the same driverless-build property — libtpu.so is
+# dlopened at runtime, so this image runs on non-TPU nodes and in CI.)
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends gcc make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY . .
+RUN make -C native && pip install --no-cache-dir grpcio protobuf pyyaml \
+    && pip install --no-cache-dir .
+
+FROM python:3.12-slim
+COPY --from=build /usr/local/lib/python3.12/site-packages \
+                  /usr/local/lib/python3.12/site-packages
+COPY --from=build /usr/local/bin/tpushare-* /usr/local/bin/
+COPY --from=build /usr/local/bin/kubectl-inspect-tpushare /usr/local/bin/
+ENTRYPOINT ["tpushare-device-plugin"]

@@ -105,6 +105,30 @@ def test_mark_assigned_patches_and_retries_on_conflict(api):
     assert len([r for r in api.requests if r.startswith("PATCH")]) == 2
 
 
+def test_patch_topology_labels_preserves_other_labels(api):
+    from tpushare.plugin import discovery
+    api.nodes["node-a"] = {"metadata": {"name": "node-a", "labels": {
+        "existing": "keep-me"}}, "status": {}}
+    pm = PodManager(kube_for(api), "node-a")
+    chips = discovery.FakeBackend(n_chips=4, generation="v5e").chips()
+    pm.patch_topology_labels(chips, accelerator_type="v5e-16", worker_id=2)
+    labels = api.nodes["node-a"]["metadata"]["labels"]
+    assert labels["existing"] == "keep-me"  # merge, never trample
+    assert labels[const.LABEL_CHIP_COUNT] == "4"
+    assert labels[const.LABEL_TPU_GENERATION] == "v5e"
+    assert labels[const.LABEL_ACCELERATOR_TYPE] == "v5e-16"
+    assert labels[const.LABEL_WORKER_ID] == "2"
+
+
+def test_metadata_backend_worker_id(monkeypatch):
+    from tpushare.plugin import discovery
+    be = discovery.MetadataBackend(metadata_timeout=0.01)
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    assert be.worker_id() == 3
+    monkeypatch.setenv("TPU_WORKER_ID", "banana")
+    assert be.worker_id() is None  # garbage env falls through safely
+
+
 def test_patch_chip_count_and_isolation_label(api):
     api.nodes["node-a"] = {"metadata": {"name": "node-a", "labels": {
         const.LABEL_ISOLATION_DISABLE: "true"}}, "status": {}}

@@ -190,6 +190,14 @@ class KubeClient:
     def get_node(self, name: str) -> dict:
         return self._request("GET", f"/api/v1/nodes/{name}")
 
+    def patch_node_labels(self, name: str, labels: Dict[str, str]) -> dict:
+        """Merge-patch metadata.labels — must not trample other labels
+        (strategic merge only touches the listed keys)."""
+        return self._request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            body={"metadata": {"labels": labels}},
+            content_type="application/strategic-merge-patch+json")
+
     def patch_node_status(self, name: str, capacity: Dict[str, str]) -> dict:
         body = {"status": {"capacity": capacity, "allocatable": capacity}}
         return self._request(

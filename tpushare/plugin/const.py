@@ -52,6 +52,16 @@ ENV_TPU_MEM_DEV = "ALIYUN_COM_TPU_MEM_DEV"
 ENV_ISOLATION_DISABLE = "TPUSHARE_DISABLE_ISOLATION"
 LABEL_ISOLATION_DISABLE = "tpushare.disable.isolation"
 
+# --- multi-host slice topology labels --------------------------------------
+# One daemon per worker host of a pod slice advertises its local chips;
+# these labels record where the host sits in the slice so the extender
+# (and operators) can reason about topology (SURVEY.md §5 distributed
+# note; the reference's single-host world needs none of this).
+LABEL_ACCELERATOR_TYPE = "tpushare.aliyun.com/accelerator-type"
+LABEL_WORKER_ID = "tpushare.aliyun.com/worker-id"
+LABEL_CHIP_COUNT = "tpushare.aliyun.com/chips"
+LABEL_TPU_GENERATION = "tpushare.aliyun.com/generation"
+
 # Allocate failure is encoded in env rather than an RPC error so kubelet
 # still starts the container with a self-describing failure marker
 # (reference: allocate.go:24-39).

@@ -269,6 +269,25 @@ class MetadataBackend(ChipBackend):
                 return self._acc_type_cache
         return None
 
+    def worker_id(self) -> Optional[int]:
+        """This host's index within a multi-host slice (None single-host).
+
+        Sources: TPU_WORKER_ID env, then GCE metadata agent-worker-number.
+        """
+        env = os.environ.get("TPU_WORKER_ID")
+        if env is not None:
+            try:
+                return int(env)
+            except ValueError:
+                pass
+        md = self._metadata("agent-worker-number")
+        if md is not None:
+            try:
+                return int(md.strip())
+            except ValueError:
+                pass
+        return None
+
     def device_paths(self) -> List[str]:
         paths = sorted(glob.glob(self._dev_glob),
                        key=lambda p: _trailing_int(p))

@@ -90,7 +90,18 @@ def main(argv=None) -> int:
                         resource_name=args.resource_name)
         # Node-capacity patch runs after backend.init() via the manager
         # hook — querying chips here would read an uninitialized backend.
-        on_chips_ready = lambda chips: pm.patch_chip_count(len(chips))
+        def on_chips_ready(chips):
+            pm.patch_chip_count(len(chips))
+            try:
+                from .discovery import MetadataBackend
+                md = (backend if isinstance(backend, MetadataBackend)
+                      else MetadataBackend())
+                pm.patch_topology_labels(
+                    chips, accelerator_type=md.accelerator_type(),
+                    worker_id=md.worker_id())
+            except Exception:
+                log.exception("topology label patch failed (non-fatal)")
+
         allocator_factory = lambda plugin: allocate.make_allocator(pm)
 
     mgr = SharedTPUManager(

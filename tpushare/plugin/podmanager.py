@@ -111,6 +111,23 @@ class PodManager:
         self.kube.patch_node_status(self.node_name,
                                     {const.COUNT_NAME: str(count)})
 
+    def patch_topology_labels(self, chips, accelerator_type=None,
+                              worker_id=None) -> None:
+        """Record slice topology on the node for the extender/operators.
+
+        Strategic-merge touches only our keys — other hosts'/components'
+        labels are never trampled (SURVEY.md hard part 3).
+        """
+        labels = {const.LABEL_CHIP_COUNT: str(len(chips))}
+        if chips:
+            labels[const.LABEL_TPU_GENERATION] = chips[0].generation
+        if accelerator_type:
+            # label values must be alphanumeric/-/_/.; acc types are.
+            labels[const.LABEL_ACCELERATOR_TYPE] = accelerator_type
+        if worker_id is not None:
+            labels[const.LABEL_WORKER_ID] = str(worker_id)
+        self.kube.patch_node_labels(self.node_name, labels)
+
     def isolation_disabled(self) -> bool:
         """Node label opt-out from advisory isolation (podmanager.go:59-72).
 
