@@ -93,14 +93,19 @@ class FakeApiServer:
                         "annotations", {})
                     anns.update(patch.get("metadata", {}).get("annotations", {}))
                     self._send(200, pod)
-                # /api/v1/nodes/<name> (labels merge-patch)
+                # /api/v1/nodes/<name> (labels merge-patch; null deletes)
                 elif len(parts) == 4 and parts[2] == "nodes":
                     node = fake.nodes.setdefault(parts[3], {
                         "metadata": {"name": parts[3]}, "status": {}})
                     labels = patch.get("metadata", {}).get("labels")
                     if labels:
-                        node.setdefault("metadata", {}).setdefault(
-                            "labels", {}).update(labels)
+                        cur = node.setdefault("metadata", {}).setdefault(
+                            "labels", {})
+                        for k, v in labels.items():
+                            if v is None:
+                                cur.pop(k, None)
+                            else:
+                                cur[k] = v
                     self._send(200, node)
                 # /api/v1/nodes/<name>/status
                 elif len(parts) == 5 and parts[2] == "nodes" and parts[4] == "status":

@@ -1,0 +1,66 @@
+"""Concurrent Allocate calls: the allocation lock must serialize matching
+so two same-size pods never double-assign (reference allocate.go:59)."""
+
+import threading
+
+import grpc
+
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+def test_concurrent_allocates_assign_each_pod_once(tmp_path):
+    api = FakeApiServer().start()
+    try:
+        # two pending assumed pods, same size, different chips
+        api.pods = [
+            make_pod("a", tpu_mem=4, assume_time=100, assigned="false",
+                     chip_idx=0),
+            make_pod("b", tpu_mem=4, assume_time=200, assigned="false",
+                     chip_idx=1),
+        ]
+        backend = discovery.FakeBackend(n_chips=2, generation="v4")
+        pm = PodManager(KubeClient(api.url), "node-a")
+        plugin = TpuDevicePlugin(
+            backend, allocator=allocate.make_allocator(pm),
+            socket_path=str(tmp_path / "s.sock"),
+            kubelet_socket=str(tmp_path / "k.sock"))
+        plugin.start()
+        try:
+            ids = [fid for fid, _ in plugin.devices[:4]]
+            results = []
+            lock = threading.Lock()
+
+            def one_allocate():
+                ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+                grpc.channel_ready_future(ch).result(timeout=5)
+                resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                    container_requests=[
+                        pb.ContainerAllocateRequest(devicesIDs=ids)]))
+                with lock:
+                    results.append(dict(resp.container_responses[0].envs))
+                ch.close()
+
+            threads = [threading.Thread(target=one_allocate)
+                       for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+
+            chips = sorted(r[const.ENV_TPU_VISIBLE_CHIPS] for r in results)
+            # both allocations succeeded, on the two distinct chips (FIFO:
+            # 'a' matched first -> chip 0, then 'b' -> chip 1)
+            assert chips == ["0", "1"], results
+            assert all(
+                p["metadata"]["annotations"][const.ANN_TPU_MEM_ASSIGNED]
+                == "true" for p in api.pods)
+        finally:
+            plugin.stop()
+    finally:
+        api.stop()

@@ -118,6 +118,12 @@ def test_patch_topology_labels_preserves_other_labels(api):
     assert labels[const.LABEL_TPU_GENERATION] == "v5e"
     assert labels[const.LABEL_ACCELERATOR_TYPE] == "v5e-16"
     assert labels[const.LABEL_WORKER_ID] == "2"
+    # re-provisioned as single-host: unknown values CLEAR stale topology
+    pm.patch_topology_labels(chips, accelerator_type=None, worker_id=None)
+    labels = api.nodes["node-a"]["metadata"]["labels"]
+    assert const.LABEL_WORKER_ID not in labels
+    assert const.LABEL_ACCELERATOR_TYPE not in labels
+    assert labels["existing"] == "keep-me"
 
 
 def test_metadata_backend_worker_id(monkeypatch):

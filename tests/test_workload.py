@@ -151,6 +151,20 @@ def test_ring_attention_matches_dense(causal):
     np.testing.assert_allclose(out_ring, out_ref, atol=2e-5)
 
 
+def test_transformer_with_ring_attention_matches_default():
+    """Long-context path: the model forward under sequence-parallel ring
+    attention must equal the single-device forward."""
+    import functools
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab)
+    mesh = make_mesh({"sp": 8})
+    ring_fn = functools.partial(ring_attention, mesh=mesh)
+    l_ring = transformer.forward(params, tokens, cfg, attention_fn=ring_fn)
+    l_ref = transformer.forward(params, tokens, cfg)
+    np.testing.assert_allclose(l_ring, l_ref, atol=3e-4)
+
+
 # -- train step --------------------------------------------------------------
 def test_sharded_train_step_runs_and_descends():
     cfg = transformer.tiny(d_model=64, n_heads=4, n_kv_heads=2, n_layers=2)

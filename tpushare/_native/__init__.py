@@ -1,0 +1,1 @@
+"""Native build artifacts (libtpushim.so) — populated by `make -C native`."""

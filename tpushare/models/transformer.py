@@ -132,7 +132,8 @@ def _expand_kv(k, n_rep: int):
 
 def attention_block(p, x, cfg: ModelConfig, positions,
                     kv_cache: Optional[Tuple] = None,
-                    cache_len: Optional[jnp.ndarray] = None):
+                    cache_len: Optional[jnp.ndarray] = None,
+                    attention_fn=None):
     b, s, d = x.shape
     h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -167,7 +168,7 @@ def attention_block(p, x, cfg: ModelConfig, positions,
     else:
         kk = _expand_kv(k, h // hkv)
         vv = _expand_kv(v, h // hkv)
-        o = attention(q, kk, vv, causal=True)
+        o = (attention_fn or attention)(q, kk, vv, causal=True)
 
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
     return _mm(o, p["wo"]), new_cache
@@ -181,12 +182,19 @@ def ffn_block(p, x):
 def forward(params, tokens, cfg: ModelConfig,
             kv_caches: Optional[Tuple] = None,
             cache_len: Optional[jnp.ndarray] = None,
-            positions: Optional[jnp.ndarray] = None):
+            positions: Optional[jnp.ndarray] = None,
+            attention_fn=None):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
     body for any depth).  ``kv_caches`` is the stacked pair from
     :func:`init_kv_caches`.
+
+    ``attention_fn(q, kk, vv, causal=)`` overrides the attention impl for
+    the no-cache path — the long-context hook: pass
+    ``functools.partial(tpushare.parallel.ring.ring_attention, mesh=mesh)``
+    to run exact causal attention over sequence shards (sp axis) instead
+    of the single-device kernel.
     """
     b, s = tokens.shape
     if positions is None:
@@ -201,7 +209,7 @@ def forward(params, tokens, cfg: ModelConfig,
         def body(x, layer):
             h_attn, _ = attention_block(
                 layer, rmsnorm(x, layer["attn_scale"], cfg.norm_eps), cfg,
-                positions)
+                positions, attention_fn=attention_fn)
             x = x + h_attn
             x = x + ffn_block(layer,
                               rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))

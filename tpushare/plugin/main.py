@@ -93,9 +93,16 @@ def main(argv=None) -> int:
         def on_chips_ready(chips):
             pm.patch_chip_count(len(chips))
             try:
-                from .discovery import MetadataBackend
-                md = (backend if isinstance(backend, MetadataBackend)
-                      else MetadataBackend())
+                from .discovery import LibtpuBackend, MetadataBackend
+                # Reuse the backend's own metadata instance (its caches are
+                # warm); a fresh one gets a short timeout so non-GCE nodes
+                # don't stall startup on dead metadata lookups.
+                if isinstance(backend, MetadataBackend):
+                    md = backend
+                elif isinstance(backend, LibtpuBackend):
+                    md = backend._fallback
+                else:
+                    md = MetadataBackend(metadata_timeout=0.5)
                 pm.patch_topology_labels(
                     chips, accelerator_type=md.accelerator_type(),
                     worker_id=md.worker_id())

@@ -118,14 +118,17 @@ class PodManager:
         Strategic-merge touches only our keys — other hosts'/components'
         labels are never trampled (SURVEY.md hard part 3).
         """
-        labels = {const.LABEL_CHIP_COUNT: str(len(chips))}
-        if chips:
-            labels[const.LABEL_TPU_GENERATION] = chips[0].generation
-        if accelerator_type:
-            # label values must be alphanumeric/-/_/.; acc types are.
-            labels[const.LABEL_ACCELERATOR_TYPE] = accelerator_type
-        if worker_id is not None:
-            labels[const.LABEL_WORKER_ID] = str(worker_id)
+        # Unknown values patch as null: a merge-patch that merely omitted
+        # the key would leave stale topology from a previous slice
+        # configuration on the node.
+        labels = {
+            const.LABEL_CHIP_COUNT: str(len(chips)),
+            const.LABEL_TPU_GENERATION:
+                chips[0].generation if chips else None,
+            const.LABEL_ACCELERATOR_TYPE: accelerator_type or None,
+            const.LABEL_WORKER_ID:
+                str(worker_id) if worker_id is not None else None,
+        }
         self.kube.patch_node_labels(self.node_name, labels)
 
     def isolation_disabled(self) -> bool:
