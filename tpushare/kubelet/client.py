@@ -24,6 +24,8 @@ class KubeletClient:
     def __init__(self, address: str = "127.0.0.1", port: int = 10250,
                  token: Optional[str] = None,
                  token_path: Optional[str] = None,
+                 client_cert: Optional[str] = None,
+                 client_key: Optional[str] = None,
                  verify_tls: bool = False,
                  scheme: str = "https",
                  timeout: float = 10.0):
@@ -32,8 +34,12 @@ class KubeletClient:
         self._token_path = token_path
         self._timeout = timeout
         if scheme == "https":
-            self._ctx = (ssl.create_default_context() if verify_tls
-                         else ssl._create_unverified_context())
+            ctx = (ssl.create_default_context() if verify_tls
+                   else ssl._create_unverified_context())
+            if client_cert and client_key:
+                # mTLS auth path (reference: main.go --client-cert/-key)
+                ctx.load_cert_chain(client_cert, client_key)
+            self._ctx = ctx
         else:
             self._ctx = None
 

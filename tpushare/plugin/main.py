@@ -37,6 +37,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--kubelet-port", type=int, default=10250)
     ap.add_argument("--kubelet-token-path",
                     default="/var/run/secrets/kubernetes.io/serviceaccount/token")
+    ap.add_argument("--client-cert", default=None,
+                    help="kubelet TLS client certificate (mTLS instead of "
+                         "bearer token)")
+    ap.add_argument("--client-key", default=None)
+    ap.add_argument("--token", default=None,
+                    help="explicit kubelet bearer token (default: service "
+                         "account token file)")
+    ap.add_argument("--timeout", type=int, default=10,
+                    help="kubelet client HTTP timeout seconds")
+    ap.add_argument("--health-check", action="store_true",
+                    help="enable device-node health watching (reference "
+                         "defaults this off too)")
     ap.add_argument("--socket", default=const.SERVER_SOCKET)
     ap.add_argument("--kubelet-socket", default=const.KUBELET_SOCKET)
     ap.add_argument("--resource-name", default=const.RESOURCE_NAME)
@@ -85,7 +97,10 @@ def main(argv=None) -> int:
         if args.query_kubelet:
             kubelet = KubeletClient(
                 address=args.kubelet_address, port=args.kubelet_port,
-                token_path=args.kubelet_token_path)
+                token=args.token,
+                token_path=None if args.token else args.kubelet_token_path,
+                client_cert=args.client_cert, client_key=args.client_key,
+                timeout=args.timeout)
         pm = PodManager(kube, node_name, kubelet_client=kubelet,
                         resource_name=args.resource_name)
         # Node-capacity patch runs after backend.init() via the manager
@@ -118,6 +133,7 @@ def main(argv=None) -> int:
         resource_name=args.resource_name,
         socket_path=args.socket,
         kubelet_socket=args.kubelet_socket,
+        health_check=args.health_check,
         on_chips_ready=on_chips_ready)
     mgr.install_signal_handlers()
     status_srv = None
