@@ -64,6 +64,11 @@ def container_response(plugin, chip: Chip, container_units: int,
         const.ENV_TPU_MEM_CONTAINER: str(container_units),
         const.ENV_TPU_MEM_DEV: str(chip_units),
     }
+    if container_units < chip_units:
+        # Fractional grant => co-tenants share the chip: disable startup
+        # preallocation so tenants fail on their own overuse, not on a
+        # boot-time reservation race (SURVEY hard part 4).
+        envs["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
     if isolation_disabled:
         envs[const.ENV_ISOLATION_DISABLE] = "true"
 

@@ -41,7 +41,6 @@ ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
 ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 ENV_XLA_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
-ENV_TPU_RUNTIME_METRICS_PORTS = "TPU_RUNTIME_METRICS_PORTS"
 # Bookkeeping envs (reference: allocate.go:113-128):
 ENV_TPU_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"
 ENV_TPU_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"
