@@ -1,0 +1,24 @@
+# Developer entry points (reference parity: .circleci/.travis drove
+# vet+test+build; here make wraps the same).
+PY ?= python3
+
+.PHONY: all native proto test bench clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+proto:
+	protoc --python_out=tpushare/plugin/api \
+	    -I tpushare/plugin/api tpushare/plugin/api/deviceplugin.proto
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
