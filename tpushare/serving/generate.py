@@ -1,0 +1,71 @@
+"""Autoregressive generation: prefill + jitted single-token decode.
+
+TPU-shaped decoding: the KV cache is a fixed-capacity buffer (static
+shapes; one compile for prefill, one for the decode step regardless of
+generation length), greedy or temperature sampling, early-exit handled
+host-side so the jitted step stays branch-free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer
+
+
+def make_decode_fns(params, cfg: transformer.ModelConfig):
+    """(prefill_fn, step_fn), both jitted once per (batch, lengths)."""
+
+    @functools.partial(jax.jit, static_argnames=("prompt_len",))
+    def prefill(params, tokens, caches, prompt_len: int):
+        logits, caches = transformer.forward(
+            params, tokens[:, :prompt_len], cfg, kv_caches=caches,
+            cache_len=0)
+        return logits[:, -1], caches
+
+    @jax.jit
+    def step(params, token, caches, pos):
+        logits, caches = transformer.forward(
+            params, token[:, None], cfg, kv_caches=caches, cache_len=pos)
+        return logits[:, 0], caches
+
+    return prefill, step
+
+
+def generate(params, cfg: transformer.ModelConfig, prompt: jnp.ndarray,
+             max_new_tokens: int = 32,
+             temperature: float = 0.0,
+             key: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jnp.ndarray:
+    """prompt [B, P] -> [B, P + max_new_tokens] (greedy when T=0)."""
+    b, prompt_len = prompt.shape
+    assert prompt_len + max_new_tokens <= cfg.max_seq, (
+        f"{prompt_len}+{max_new_tokens} exceeds max_seq {cfg.max_seq}")
+    caches = transformer.init_kv_caches(cfg, batch=b)
+    prefill, step = make_decode_fns(params, cfg)
+
+    logits, caches = prefill(params, prompt, caches, prompt_len)
+    out = [prompt]
+    token = None
+    finished = jnp.zeros((b,), dtype=bool)
+    for i in range(max_new_tokens):
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            token = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            token = jnp.argmax(logits, axis=-1)
+        if eos_id is not None:
+            token = jnp.where(finished, eos_id, token)
+            finished = finished | (token == eos_id)
+        out.append(token[:, None])
+        if eos_id is not None and bool(finished.all()):
+            pad = jnp.full((b, max_new_tokens - i - 1), eos_id, prompt.dtype)
+            if pad.shape[1]:
+                out.append(pad)
+            break
+        logits, caches = step(params, token, caches, prompt_len + i)
+    return jnp.concatenate(out, axis=1)
