@@ -1,0 +1,72 @@
+"""LLM serving endpoint over real HTTP on the tiny model."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare.serving.llm import LLMServer, build_model
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg, params = build_model("tiny", quantize_int8=True)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1").start()
+    yield srv
+    srv.stop()
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_generate_over_http(server):
+    out = _post(server, "/generate",
+                {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 4})
+    assert len(out["tokens"]) == 1
+    assert len(out["tokens"][0]) == 8
+    # deterministic greedy
+    again = _post(server, "/generate",
+                  {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 4})
+    assert out == again
+
+
+def _post_err(srv, path, payload):
+    try:
+        return 200, _post(srv, path, payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_generate_validates_input(server):
+    code, bad = _post_err(server, "/generate", {"tokens": "nope"})
+    assert code == 400 and "Error" in bad
+    code, too_long = _post_err(server, "/generate",
+                               {"tokens": [[1] * 110], "max_new_tokens": 30})
+    assert code == 400 and "max_seq" in too_long["Error"]
+    code, ragged = _post_err(server, "/generate",
+                             {"tokens": [[1, 2], [3]]})
+    assert code == 400 and "length" in ragged["Error"]
+    code, oob = _post_err(server, "/generate", {"tokens": [[999999]]})
+    assert code == 400 and "out of range" in oob["Error"]
+    code, neg = _post_err(server, "/generate",
+                          {"tokens": [[1, 2]], "max_new_tokens": -5})
+    assert code == 400
+    code, badtype = _post_err(server, "/generate",
+                              {"tokens": [[1, 2]], "max_new_tokens": "abc"})
+    assert code == 400
+
+
+def test_stats_track_throughput(server):
+    _post(server, "/generate", {"tokens": [[5, 6]], "max_new_tokens": 2})
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/stats", timeout=10) as r:
+        stats = json.loads(r.read())
+    assert stats["requests_served"] >= 1
+    assert stats["tokens_generated"] >= 2

@@ -17,8 +17,14 @@ import jax.numpy as jnp
 from ..models import transformer
 
 
-def make_decode_fns(params, cfg: transformer.ModelConfig):
-    """(prefill_fn, step_fn), both jitted once per (batch, lengths)."""
+@functools.lru_cache(maxsize=8)
+def make_decode_fns(cfg: transformer.ModelConfig):
+    """(prefill_fn, step_fn), jitted once per config.
+
+    Cached per cfg (hashable frozen dataclass): a fresh jit wrapper per
+    call would key a new XLA cache entry per request and recompile on
+    the serving hot path.
+    """
 
     @functools.partial(jax.jit, static_argnames=("prompt_len",))
     def prefill(params, tokens, caches, prompt_len: int):
@@ -45,12 +51,13 @@ def generate(params, cfg: transformer.ModelConfig, prompt: jnp.ndarray,
     b, prompt_len = prompt.shape
     assert prompt_len + max_new_tokens <= cfg.max_seq, (
         f"{prompt_len}+{max_new_tokens} exceeds max_seq {cfg.max_seq}")
+    if temperature > 0.0 and key is None:
+        key = jax.random.PRNGKey(0)
     caches = transformer.init_kv_caches(cfg, batch=b)
-    prefill, step = make_decode_fns(params, cfg)
+    prefill, step = make_decode_fns(cfg)
 
     logits, caches = prefill(params, prompt, caches, prompt_len)
     out = [prompt]
-    token = None
     finished = jnp.zeros((b,), dtype=bool)
     for i in range(max_new_tokens):
         if temperature > 0.0:

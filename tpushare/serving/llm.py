@@ -1,0 +1,165 @@
+"""``tpushare-llm-server`` — the workload that runs inside an allocation.
+
+The BASELINE config 2-4 pod: enforce the tpushare env contract, apply
+the HBM budget, build a (optionally int8) decoder model, and serve
+generation over HTTP:
+
+* ``POST /generate`` ``{"tokens": [[...]], "max_new_tokens": N,
+  "temperature": T}`` → ``{"tokens": [[...]]}``
+* ``GET /healthz`` / ``GET /stats``
+
+Single-model single-process by design: process isolation between
+co-tenants is the device plugin's job; this server only has to stay
+inside its granted fraction (budget applied before jax initializes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+import time
+
+log = logging.getLogger("tpushare.llm")
+
+
+def build_model(model_name: str, quantize_int8: bool, seed: int = 0):
+    import jax
+
+    from ..models import transformer
+    from ..ops import quant
+
+    cfgs = {
+        "llama2-7b": transformer.llama2_7b,
+        "flagship-small": lambda: transformer.ModelConfig(
+            vocab=32000, d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
+            d_ff=1408, max_seq=512),
+        "tiny": transformer.tiny,
+    }
+    if model_name not in cfgs:
+        raise ValueError(f"unknown model {model_name!r} "
+                         f"(have {sorted(cfgs)})")
+    cfg = cfgs[model_name]()
+    params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
+    if quantize_int8:
+        params = quant.quantize_params(params)
+    return cfg, params
+
+
+class LLMServer:
+    def __init__(self, cfg, params, port: int = 8000,
+                 addr: str = "0.0.0.0",
+                 default_max_new: int = 32):
+        from ..utils.httpserver import JsonHTTPServer
+
+        self.cfg = cfg
+        self.params = params
+        self.default_max_new = default_max_new
+        self._gen_lock = threading.Lock()   # decode caches are per-call;
+        # serialize so co-tenant HBM stays bounded by one batch
+        self.requests_served = 0
+        self.sequences_served = 0
+        self.tokens_generated = 0
+        self._t0 = time.monotonic()
+        self._http = JsonHTTPServer(port, addr, routes={
+            ("POST", "/generate"): self._generate,
+            ("GET", "/healthz"): lambda _: (200, "ok\n"),
+            ("GET", "/stats"): self._stats,
+        })
+        self.port = self._http.port
+
+    def _generate(self, body):
+        import jax
+        import jax.numpy as jnp
+
+        from .generate import generate
+
+        tokens = body.get("tokens")
+        if (not tokens or not isinstance(tokens, list)
+                or not all(isinstance(row, list) and row for row in tokens)):
+            return 400, {"Error": "body must contain tokens: [[int, ...]]"}
+        lengths = {len(row) for row in tokens}
+        if len(lengths) != 1:
+            return 400, {"Error": "token rows must share one length "
+                                  "(pad client-side)"}
+        try:
+            max_new = int(body.get("max_new_tokens", self.default_max_new))
+            temperature = float(body.get("temperature", 0.0))
+            seed = int(body.get("seed", 0))
+            flat = [int(t) for row in tokens for t in row]
+        except (TypeError, ValueError) as e:
+            return 400, {"Error": f"malformed field: {e}"}
+        if max_new < 1:
+            return 400, {"Error": "max_new_tokens must be >= 1"}
+        if any(t < 0 or t >= self.cfg.vocab for t in flat):
+            return 400, {"Error": f"token id out of range [0, "
+                                  f"{self.cfg.vocab})"}
+        prompt = jnp.asarray(tokens, dtype=jnp.int32)
+        if prompt.shape[1] + max_new > self.cfg.max_seq:
+            return 400, {"Error": f"prompt+max_new_tokens exceeds "
+                                  f"max_seq={self.cfg.max_seq}"}
+        key = jax.random.PRNGKey(seed)
+        with self._gen_lock:
+            out = generate(self.params, self.cfg, prompt,
+                           max_new_tokens=max_new,
+                           temperature=temperature, key=key)
+            self.requests_served += 1
+            self.sequences_served += len(tokens)
+            self.tokens_generated += max_new * len(tokens)
+        return 200, {"tokens": [list(map(int, row)) for row in out]}
+
+    def _stats(self, _):
+        dt = time.monotonic() - self._t0
+        return 200, {
+            "requests_served": self.requests_served,
+            "sequences_served": self.sequences_served,
+            "tokens_generated": self.tokens_generated,
+            "uptime_s": round(dt, 1),
+            "tokens_per_s": round(self.tokens_generated / dt, 2) if dt else 0,
+        }
+
+    def start(self):
+        self._http.start()
+        return self
+
+    def serve_forever(self):
+        self._http.serve_forever()
+
+    def stop(self):
+        self._http.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-llm-server",
+        description="LLM generation server for a tpushare allocation")
+    ap.add_argument("--model", default="flagship-small")
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 (the 14GiB Llama-2-7B config)")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--addr", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    # Contract first — fail fast with the scheduler's own words, and set
+    # the HBM budget before jax initializes.
+    from ..runtime import contract
+    view = contract.enforce()
+    contract.apply_memory_budget()
+    if view.allocated:
+        log.info("allocation: chip %s, %.0f%% HBM", view.chip_index,
+                 (view.hbm_fraction or 1.0) * 100)
+    else:
+        log.info("running unallocated (dev mode)")
+
+    cfg, params = build_model(args.model, args.int8)
+    srv = LLMServer(cfg, params, port=args.port, addr=args.addr)
+    log.info("llm server: model=%s int8=%s on :%d", args.model, args.int8,
+             srv.port)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
