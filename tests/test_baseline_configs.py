@@ -1,0 +1,171 @@
+"""BASELINE.json configs exercised end-to-end against the fake cluster.
+
+Config 1 (smoke) is covered by tests/test_allocate_e2e.py; here:
+config 2 (2×8 GiB co-located), config 3 (4×4 GiB fractional density),
+config 4 (14 GiB whole-chip path), config 5 (multi-host mixed sizes).
+Flow per pod: extender /bind (binpack + handshake) → device-plugin
+Allocate (env contract) → assertions on placement, fractions, and the
+inspect CLI's reconstruction.
+"""
+
+import json
+import urllib.request
+
+import grpc
+import pytest
+
+from tpushare.extender.server import ExtenderServer
+from tpushare.inspect import display, nodeinfo
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes.apiserver import FakeApiServer, make_pod
+from test_inspect import make_node
+
+
+@pytest.fixture
+def cluster():
+    api = FakeApiServer().start()
+    ext = ExtenderServer(KubeClient(api.url), port=0).start()
+    yield api, ext
+    ext.stop()
+    api.stop()
+
+
+def bind(ext, name, node, ns="default"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{ext.port}/bind",
+        data=json.dumps({"PodName": name, "PodNamespace": ns,
+                         "Node": node}).encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def start_plugin(api, tmp_path, node="node-a", chips=1, generation="v4"):
+    backend = discovery.FakeBackend(n_chips=chips, generation=generation)
+    pm = PodManager(KubeClient(api.url), node)
+    plugin = TpuDevicePlugin(
+        backend, allocator=allocate.make_allocator(pm),
+        socket_path=str(tmp_path / f"{node}.sock"),
+        kubelet_socket=str(tmp_path / f"{node}-kubelet.sock"))
+    plugin.start()
+    return plugin
+
+
+def kubelet_allocate(plugin, units):
+    ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(
+            devicesIDs=[fid for fid, _ in plugin.devices[:units]])]))
+    ch.close()
+    return dict(resp.container_responses[0].envs)
+
+
+def test_config2_two_bert_pods_colocate_one_chip(cluster, tmp_path):
+    api, ext = cluster
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=32, tpu_count=1)
+    api.pods = [make_pod(f"bert-{i}", node="", tpu_mem=8, phase="Pending")
+                for i in range(2)]
+    for i in range(2):
+        assert bind(ext, f"bert-{i}", "node-a")["Error"] == ""
+
+    plugin = start_plugin(api, tmp_path, chips=1)
+    try:
+        fracs = []
+        for _ in range(2):
+            envs = kubelet_allocate(plugin, 8)
+            assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+            assert envs["XLA_PYTHON_CLIENT_PREALLOCATE"] == "false"
+            fracs.append(float(envs[const.ENV_XLA_MEM_FRACTION]))
+        assert fracs == [0.25, 0.25]
+        assert sum(fracs) <= 1.0
+        assert all(p["metadata"]["annotations"][const.ANN_TPU_MEM_ASSIGNED]
+                   == "true" for p in api.pods)
+    finally:
+        plugin.stop()
+
+
+def test_config3_four_distilbert_pods_fractional_density(cluster, tmp_path):
+    api, ext = cluster
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=32, tpu_count=1)
+    api.pods = [make_pod(f"distil-{i}", node="", tpu_mem=4, phase="Pending")
+                for i in range(4)]
+    for i in range(4):
+        assert bind(ext, f"distil-{i}", "node-a")["Error"] == ""
+
+    plugin = start_plugin(api, tmp_path, chips=1)
+    try:
+        fracs = [float(kubelet_allocate(plugin, 4)[const.ENV_XLA_MEM_FRACTION])
+                 for _ in range(4)]
+        assert all(f == 0.12 for f in fracs)  # floor(4/32*100)/100
+        assert sum(fracs) <= 1.0
+    finally:
+        plugin.stop()
+
+    # a 5th pod beyond free HBM must NOT fit after 4x4=16 of 32 used...
+    # it does fit (16 free) — but an 18 GiB pod must not:
+    api.pods.append(make_pod("too-big", node="", tpu_mem=18, phase="Pending"))
+    result = bind(ext, "too-big", "node-a")
+    assert "no chip" in result["Error"]
+
+
+def test_config4_whole_chip_llama_int8(cluster, tmp_path):
+    api, ext = cluster
+    # v5e chip: 16 GiB; a 14 GiB int8-7B server takes most of the chip
+    api.nodes["node-a"] = make_node("node-a", tpu_mem=16, tpu_count=1)
+    api.pods = [make_pod("llama", node="", tpu_mem=14, phase="Pending")]
+    assert bind(ext, "llama", "node-a")["Error"] == ""
+
+    plugin = start_plugin(api, tmp_path, chips=1, generation="v5e")
+    try:
+        envs = kubelet_allocate(plugin, 14)
+        assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+        assert float(envs[const.ENV_XLA_MEM_FRACTION]) == 0.87  # 14/16
+        # second large pod cannot fit the remaining 2 GiB
+        api.pods.append(make_pod("second", node="", tpu_mem=8,
+                                 phase="Pending"))
+        assert "no chip" in bind(ext, "second", "node-a")["Error"]
+    finally:
+        plugin.stop()
+
+
+def test_config5_multihost_mixed_sizes_binpack(cluster, tmp_path):
+    """v4-16-style slice: 2 worker hosts × 2 chips, mixed 4/8/14 pods."""
+    api, ext = cluster
+    for host in ("worker-0", "worker-1"):
+        api.nodes[host] = make_node(host, tpu_mem=64, tpu_count=2)
+    sizes = {"a": 14, "b": 8, "c": 8, "d": 4, "e": 14, "f": 8}
+    api.pods = [make_pod(n, node="", tpu_mem=s, phase="Pending")
+                for n, s in sizes.items()]
+
+    # schedule greedily: filter then bind to the first passing node
+    for name in sizes:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ext.port}/filter",
+            data=json.dumps({
+                "Pod": next(p for p in api.pods
+                            if p["metadata"]["name"] == name),
+                "NodeNames": ["worker-0", "worker-1"],
+            }).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            result = json.loads(r.read())
+        passing = [n["metadata"]["name"] for n in result["Nodes"]["items"]]
+        assert passing, f"{name} fits nowhere"
+        assert bind(ext, name, passing[0])["Error"] == ""
+
+    # every pod placed; no chip over capacity
+    infos = nodeinfo.build_node_infos(list(api.nodes.values()), api.pods)
+    total_used = 0
+    for info in infos:
+        for idx, dev in info.devs.items():
+            assert idx != nodeinfo.PENDING_IDX
+            assert dev.used_mem <= dev.total_mem
+            total_used += dev.used_mem
+    assert total_used == sum(sizes.values())
+    # summary renders without pending column
+    out = display.render_summary(infos)
+    assert "PENDING" not in out
