@@ -37,7 +37,14 @@ def main() -> int:
     from tpushare.models import bert
     from tpushare.serving import InferenceEngine, measure_qps
 
-    platform = jax.devices()[0].platform
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError as e:
+        # Accelerator backend broken/unreachable: report CPU numbers
+        # rather than nothing (the record carries the platform).
+        _log(f"accelerator backend failed ({e}); falling back to cpu")
+        jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     _log(f"platform={platform}")
 
