@@ -1,0 +1,65 @@
+"""Property-based invariants (hypothesis): codec, fan-out, binpack, quant."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.extender import policy
+from tpushare.plugin import const, discovery
+from tpushare.ops import quant
+
+from fakes.apiserver import make_pod
+from test_inspect import make_node
+
+
+@given(chip_id=st.text(
+    alphabet=st.characters(whitelist_categories=("L", "N"),
+                           whitelist_characters="-_."),
+    min_size=1, max_size=48),
+    j=st.integers(min_value=0, max_value=10_000))
+def test_fake_id_codec_roundtrips(chip_id, j):
+    fid = discovery.fake_device_id(chip_id, j)
+    assert discovery.real_chip_id(fid) == chip_id
+    assert len(fid) <= 63 or len(chip_id) > 48  # k8s device-ID limit
+
+
+@given(n_chips=st.integers(1, 8), hbm_gib=st.integers(1, 96))
+@settings(max_examples=25, deadline=None)
+def test_fan_out_count_equals_total_hbm(n_chips, hbm_gib):
+    be = discovery.FakeBackend(n_chips=n_chips, hbm_gib=hbm_gib)
+    devs = discovery.fan_out(be.chips(), "GiB")
+    assert len(devs) == n_chips * hbm_gib
+    assert len({fid for fid, _ in devs}) == len(devs)  # IDs unique
+
+
+@given(
+    sizes=st.lists(st.integers(1, 16), min_size=0, max_size=10),
+    request=st.integers(1, 32),
+)
+@settings(max_examples=50, deadline=None)
+def test_binpack_never_overcommits(sizes, request):
+    """Whatever already sits on the node, a picked chip has room."""
+    node = make_node(tpu_mem=64, tpu_count=2)
+    pods = [make_pod(f"p{i}", tpu_mem=s, chip_idx=i % 2, assume_time=i + 1,
+                     assigned="true", phase="Running")
+            for i, s in enumerate(sizes)]
+    fit = policy.pick_chip(node, pods, request)
+    if fit is not None:
+        assert fit.free >= request
+        info = policy.build_node_state(node, pods)
+        used = info.devs[fit.chip_index].used_mem
+        assert used + request <= info.devs[fit.chip_index].total_mem
+
+
+@given(st.integers(0, 2**32 - 1))
+@settings(max_examples=10, deadline=None)
+def test_quantization_error_bounded(seed):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (32, 64)) \
+        * (1.0 + seed % 5)
+    q, s = quant.quantize(w)
+    deq = quant.dequantize(q, s, jnp.float32)
+    # symmetric per-channel int8: |err| <= scale/2 everywhere
+    bound = np.asarray(s)[0] / 2 + 1e-6
+    assert np.all(np.abs(np.asarray(deq - w)) <= bound)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, List
@@ -44,6 +45,10 @@ class ExtenderServer:
                  auth_token: str = None):
         self.kube = kube
         self.resource_name = resource_name
+        # Serialize binds: two concurrent binds could both observe the
+        # same free chip and overcommit it; after each bind the written
+        # assume annotations make the next bind see the updated state.
+        self._bind_lock = threading.Lock()
         self._http = JsonHTTPServer(port, addr, routes={
             ("POST", "/filter"): lambda b: (200, self.filter(b or {})),
             ("POST", "/priorities"): lambda b: (200, self.priorities(b or {})),
@@ -113,6 +118,10 @@ class ExtenderServer:
         return out
 
     def bind(self, args: dict) -> dict:
+        with self._bind_lock:
+            return self._bind_locked(args)
+
+    def _bind_locked(self, args: dict) -> dict:
         ns = args.get("PodNamespace", "default")
         name = args.get("PodName")
         node_name = args.get("Node")
