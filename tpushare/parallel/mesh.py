@@ -61,23 +61,32 @@ def make_mesh(axes: Dict[str, int],
 # tp), row-parallel out (wo/w_down shard the input dim on tp) so each
 # transformer block needs exactly one reduction, which XLA emits as a
 # psum/reduce-scatter on ICI.
-TP_RULES: List[Tuple[str, P]] = [
-    ("embed", P(None, "tp")),
-    ("wq", P(None, "tp")),
-    ("wk", P(None, "tp")),
-    ("wv", P(None, "tp")),
-    ("wo", P("tp", None)),
-    ("w_gate", P(None, "tp")),
-    ("w_up", P(None, "tp")),
-    ("w_down", P("tp", None)),
-    ("lm_head", P(None, "tp")),
+# One canonical rule list covering tp AND fsdp: FSDP (ZeRO-3-style)
+# shards the non-tp weight dim over 'fsdp' (XLA all-gathers params at use
+# and reduce-scatters grads).  _legalize drops entries whose axis is not
+# in the mesh, so on a dp×tp mesh these degenerate to pure Megatron tp
+# and on a dp-only mesh to full replication — one list serves every mesh.
+SHARDING_RULES: List[Tuple[str, P]] = [
+    ("embed", P("fsdp", "tp")),
+    ("wq", P("fsdp", "tp")),
+    ("wk", P("fsdp", "tp")),
+    ("wv", P("fsdp", "tp")),
+    ("wo", P("tp", "fsdp")),
+    ("w_gate", P("fsdp", "tp")),
+    ("w_up", P("fsdp", "tp")),
+    ("w_down", P("tp", "fsdp")),
+    ("lm_head", P("fsdp", "tp")),
     # norms / biases / small vectors replicate
     ("scale", P()),
     ("bias", P()),
 ]
 
+# Backwards-compatible aliases.
+TP_RULES = SHARDING_RULES
+FSDP_TP_RULES = SHARDING_RULES
 
-def spec_for(path: str, rules: Sequence[Tuple[str, P]] = TP_RULES) -> P:
+
+def spec_for(path: str, rules: Sequence[Tuple[str, P]] = SHARDING_RULES) -> P:
     from ..utils.treepath import leaf_key, param_key
 
     # Quantized weights are {'q': int8, 's': scale} one level below the
@@ -94,12 +103,12 @@ def spec_for(path: str, rules: Sequence[Tuple[str, P]] = TP_RULES) -> P:
 
 
 def shard_params(params, mesh: Mesh,
-                 rules: Sequence[Tuple[str, P]] = TP_RULES):
-    """Place a param pytree onto the mesh per the rules (tp axis optional)."""
-    have_tp = "tp" in mesh.axis_names
+                 rules: Sequence[Tuple[str, P]] = SHARDING_RULES):
+    """Place a param pytree onto the mesh (rule entries naming axes the
+    mesh lacks are dropped by legalization)."""
 
     def _place(path, leaf):
-        spec = spec_for(jax.tree_util.keystr(path), rules) if have_tp else P()
+        spec = spec_for(jax.tree_util.keystr(path), rules)
         # Drop axes the array is too small to shard cleanly.
         spec = _legalize(spec, leaf.shape, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -108,12 +117,11 @@ def shard_params(params, mesh: Mesh,
 
 
 def param_shardings(params, mesh: Mesh,
-                    rules: Sequence[Tuple[str, P]] = TP_RULES):
+                    rules: Sequence[Tuple[str, P]] = SHARDING_RULES):
     """NamedSharding pytree (for jit in_shardings) without moving data."""
-    have_tp = "tp" in mesh.axis_names
 
     def _spec(path, leaf):
-        spec = spec_for(jax.tree_util.keystr(path), rules) if have_tp else P()
+        spec = spec_for(jax.tree_util.keystr(path), rules)
         return NamedSharding(mesh, _legalize(spec, leaf.shape, mesh))
 
     return jax.tree_util.tree_map_with_path(_spec, params)
@@ -129,6 +137,14 @@ def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     out = []
     for d, entry in enumerate(entries):
         if entry is None or d >= len(shape):
+            out.append(None)
+            continue
+        if entry not in mesh.shape:
+            # Intended degeneration (fsdp rules on a tp-only mesh) — but
+            # also where a typo'd axis name would silently replicate, so
+            # leave a trace for debugging.
+            log.debug("dropping axis %r (not in mesh %s) for dim %d",
+                      entry, dict(mesh.shape), d)
             out.append(None)
             continue
         axis_size = mesh.shape[entry]

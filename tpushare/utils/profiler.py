@@ -1,0 +1,53 @@
+"""Lightweight tracing/profiling helpers (aux-subsystem parity-plus).
+
+The reference's only tracing is a SIGQUIT stack dump; tpushare keeps
+that (``stackdump``) and adds: a ``jax.profiler`` trace context for
+TensorBoard-consumable device traces, and a step timer that separates
+compile (first call) from steady-state.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a jax device trace viewable in TensorBoard/XProf."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def time_fn(fn: Callable, *args, iters: int = 10,
+            warmup: int = 1) -> Dict[str, float]:
+    """{'compile_s', 'mean_s', 'p50_s', 'best_s'} for a jitted callable.
+
+    The first call is measured separately: under jit it includes trace +
+    XLA compile, which steady-state numbers must exclude.
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    for _ in range(max(0, warmup - 1)):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return {
+        "compile_s": compile_s,
+        "mean_s": sum(samples) / len(samples),
+        "p50_s": samples[len(samples) // 2],
+        "best_s": samples[0],
+    }
