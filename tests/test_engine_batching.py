@@ -1,0 +1,94 @@
+"""Serving engine micro-batcher and multi-container allocation."""
+
+import threading
+
+import grpc
+import numpy as np
+
+import jax.numpy as jnp
+
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+from tpushare.serving import InferenceEngine
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+def test_engine_batches_concurrent_requests():
+    calls = []
+
+    def fwd(tokens):
+        calls.append(int(tokens.shape[0]))
+        return tokens * 2
+
+    engine = InferenceEngine(fwd, batch_size=4, seq_len=8, max_wait_ms=50)
+    engine.start()
+    try:
+        outs = [engine.submit(np.full((8,), i + 1, np.int32))
+                for i in range(3)]
+        results = [q.get(timeout=30) for q in outs]
+        for i, r in enumerate(results):
+            np.testing.assert_array_equal(r, np.full((8,), 2 * (i + 1)))
+        # all three coalesced into batches of the fixed size
+        assert all(c == 4 for c in calls)
+    finally:
+        engine.stop()
+
+
+def test_engine_stop_delivers_sentinel_to_queued_requests():
+    started = threading.Event()
+
+    def slow_fwd(tokens):
+        started.set()
+        return tokens
+
+    engine = InferenceEngine(slow_fwd, batch_size=1, seq_len=4)
+    # never started: submissions sit in the queue; stop must unblock them
+    q = engine.submit(np.ones((4,), np.int32))
+    engine.stop()
+    assert q.get(timeout=5) is None
+
+
+def test_allocate_multi_container_pod(tmp_path):
+    """A pod whose containers split the request still matches by total
+    (reference sums limits over containers, podutils.go:122-131)."""
+    api = FakeApiServer().start()
+    try:
+        pod = make_pod("split", tpu_mem=4, assume_time=1, assigned="false",
+                       chip_idx=0)
+        pod["spec"]["containers"].append({
+            "name": "side",
+            "resources": {"limits": {const.RESOURCE_NAME: "4"}}})
+        api.pods = [pod]
+
+        backend = discovery.FakeBackend(n_chips=1, generation="v4")
+        pm = PodManager(KubeClient(api.url), "node-a")
+        plugin = TpuDevicePlugin(
+            backend, allocator=allocate.make_allocator(pm),
+            socket_path=str(tmp_path / "s.sock"),
+            kubelet_socket=str(tmp_path / "k.sock"))
+        plugin.start()
+        try:
+            ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            grpc.channel_ready_future(ch).result(timeout=5)
+            ids = [f for f, _ in plugin.devices]
+            resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[
+                    pb.ContainerAllocateRequest(devicesIDs=ids[:4]),
+                    pb.ContainerAllocateRequest(devicesIDs=ids[4:8]),
+                ]))
+            assert len(resp.container_responses) == 2
+            for cr in resp.container_responses:
+                assert cr.envs[const.ENV_TPU_MEM_CONTAINER] == "4"
+                assert cr.envs[const.ENV_TPU_MEM_POD] == "8"
+                assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+            ch.close()
+        finally:
+            plugin.stop()
+        assert pod["metadata"]["annotations"][const.ANN_TPU_MEM_ASSIGNED] \
+            == "true"
+    finally:
+        api.stop()
