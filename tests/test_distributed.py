@@ -1,0 +1,40 @@
+"""Multi-host topology detection and single-host no-op path."""
+
+from tpushare.runtime import distributed
+
+
+def test_detect_single_host_default():
+    topo = distributed.detect_topology({})
+    assert topo.n_hosts == 1 and not topo.is_multihost
+    assert topo.worker_id == 0
+
+
+def test_detect_multihost_slice():
+    env = {"TPU_WORKER_HOSTNAMES": "t1v-n-0,t1v-n-1, t1v-n-2",
+           "TPU_WORKER_ID": "2"}
+    topo = distributed.detect_topology(env)
+    assert topo.n_hosts == 3 and topo.is_multihost
+    assert topo.worker_id == 2
+    assert topo.coordinator == "t1v-n-0:8476"
+    env["COORDINATOR_PORT"] = "9999"
+    # coordinator port comes from process env; simulate via os-level check
+    import os
+    os.environ["COORDINATOR_PORT"] = "9999"
+    try:
+        assert distributed.detect_topology(env).coordinator == "t1v-n-0:9999"
+    finally:
+        del os.environ["COORDINATOR_PORT"]
+
+
+def test_detect_garbage_worker_id_clamps():
+    topo = distributed.detect_topology(
+        {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "banana"})
+    assert topo.worker_id == 0
+    topo = distributed.detect_topology(
+        {"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "7"})
+    assert topo.worker_id == 1  # clamped into range
+
+
+def test_init_distributed_single_host_is_noop():
+    topo = distributed.init_distributed({})
+    assert not topo.is_multihost  # and no jax.distributed call was made

@@ -65,8 +65,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     def body(kb, carry):
         m, l, acc = carry
         k_start = kb * block_k
-        k_blkd = pl.load(k_ref, (pl.dslice(k_start, block_k), slice(None)))
-        v_blkd = pl.load(v_ref, (pl.dslice(k_start, block_k), slice(None)))
+        k_blkd = k_ref[pl.ds(k_start, block_k), :]
+        v_blkd = v_ref[pl.ds(k_start, block_k), :]
         s = q @ k_blkd.astype(jnp.float32).T             # [bq, bk] on MXU
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -91,10 +91,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 128, block_k: int = 128):
-    """Pallas flash attention; q,k,v: [B, H, S, D], S % block == 0."""
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Pallas flash attention; q,k,v: [B, H, S, D], S % block == 0.
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter —
+    same kernel code, any backend — which is how the kernel math is
+    unit-tested on CPU.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
@@ -120,6 +127,7 @@ def flash_attention(q, k, v, causal: bool = True,
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, s, d)
 

@@ -1,0 +1,101 @@
+"""Multi-host initialization for JAX workloads on TPU slices.
+
+The control plane advertises per-host chips (one daemon per worker,
+SURVEY.md §5); the *workload* spanning a multi-host slice must bring up
+jax.distributed so every host sees the global device set and XLA can lay
+collectives over ICI/DCN.  This module derives that bring-up from the
+same environment a TPU pod already has:
+
+* worker id:     ``TPU_WORKER_ID`` (or tpushare's node label via the
+  downward API)
+* peer hosts:    ``TPU_WORKER_HOSTNAMES`` (comma-separated)
+* coordinator:   first host in the list, port ``COORDINATOR_PORT``
+  (default 8476)
+
+Single-host (or unset) environments are a no-op — the same workload
+binary runs anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import List, Optional
+
+log = logging.getLogger("tpushare.distributed")
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    worker_id: int
+    hosts: List[str]
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def coordinator(self) -> str:
+        port = os.environ.get("COORDINATOR_PORT",
+                              str(DEFAULT_COORDINATOR_PORT))
+        return f"{self.hosts[0]}:{port}"
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.n_hosts > 1
+
+
+def detect_topology(env: Optional[dict] = None) -> SliceTopology:
+    e = env if env is not None else os.environ
+    hosts_raw = e.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = [h.strip() for h in hosts_raw.split(",") if h.strip()]
+    if not hosts:
+        hosts = ["localhost"]
+    try:
+        worker_id = int(e.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        worker_id = 0
+    if not 0 <= worker_id < len(hosts):
+        log.warning("worker id %d outside host list of %d; clamping",
+                    worker_id, len(hosts))
+        worker_id = max(0, min(worker_id, len(hosts) - 1))
+    return SliceTopology(worker_id=worker_id, hosts=hosts)
+
+
+def init_distributed(env: Optional[dict] = None) -> SliceTopology:
+    """Bring up jax.distributed when the env describes a multi-host slice.
+
+    Call before first jax use.  Idempotent-ish: a second call on an
+    initialized runtime logs and returns.
+    """
+    topo = detect_topology(env)
+    if not topo.is_multihost:
+        log.info("single-host topology; jax.distributed not needed")
+        return topo
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=topo.coordinator,
+            num_processes=topo.n_hosts,
+            process_id=topo.worker_id)
+        log.info("jax.distributed up: process %d/%d, coordinator %s",
+                 topo.worker_id, topo.n_hosts, topo.coordinator)
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            log.info("jax.distributed already initialized")
+        else:
+            raise
+    return topo
+
+
+def global_mesh(axes: dict, env: Optional[dict] = None):
+    """Multi-host-aware mesh: initialize distributed, then build the mesh
+    over jax.devices() (the GLOBAL device set once distributed is up)."""
+    from ..parallel.mesh import make_mesh
+
+    init_distributed(env)
+    return make_mesh(axes)
