@@ -39,6 +39,20 @@ def test_flash_kernel_causal_first_row_is_v0():
                                np.asarray(v[0, 0, 0]), atol=1e-5)
 
 
+@pytest.mark.parametrize("hkv", [1, 2, 4])
+def test_flash_kernel_gqa_native(hkv):
+    """GQA: kernel reads shared KV blocks via index mapping — must equal
+    the reference's explicit head expansion."""
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 4, 128, 128), jnp.float32)
+    k = jax.random.normal(kk, (2, hkv, 128, 128), jnp.float32)
+    v = jax.random.normal(kv, (2, hkv, 128, 128), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_kernel_bf16_io():
     key = jax.random.PRNGKey(3)
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.bfloat16)

@@ -165,10 +165,13 @@ def attention_block(p, x, cfg: ModelConfig, positions,
         logits = jnp.where(valid, logits, -1e30)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         o = jnp.einsum("bhst,bhtd->bhsd", probs.astype(vv.dtype), vv)
+    elif attention_fn is not None:
+        # custom impls (ring/ulysses) expect equal head counts
+        o = attention_fn(q, _expand_kv(k, h // hkv),
+                         _expand_kv(v, h // hkv), causal=True)
     else:
-        kk = _expand_kv(k, h // hkv)
-        vv = _expand_kv(v, h // hkv)
-        o = (attention_fn or attention)(q, kk, vv, causal=True)
+        # default path is GQA-aware: K/V stay at Hkv heads end-to-end
+        o = attention(q, k, v, causal=True)
 
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
     return _mm(o, p["wo"]), new_cache

@@ -28,8 +28,12 @@ NEG_INF = -1e30
 
 def reference_attention(q, k, v, causal: bool = True,
                         scale: Optional[float] = None):
-    """Plain softmax attention; q,k,v: [B, H, S, D] (k/v may have fewer
-    heads — GQA — already expanded by the caller)."""
+    """Plain softmax attention; q: [B, H, S, D], k/v: [B, Hkv, S, D]
+    (Hkv may divide H — GQA — and is expanded here)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     logits = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
@@ -106,14 +110,21 @@ def flash_attention(q, k, v, causal: bool = True,
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     b, h, s, d = q.shape
-    sk = k.shape[2]
+    hkv, sk = k.shape[1], k.shape[2]
+    n_rep = h // hkv   # GQA: the kernel reads shared K/V blocks directly —
+    # no jnp.repeat materialization, so KV HBM traffic stays 1/n_rep.
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     scale = 1.0 / np.sqrt(d)
 
     qf = q.reshape(b * h, s, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
+
+    def kv_index(bh, qb):
+        # program bh covers (batch, q-head); its kv row is batch*hkv +
+        # q_head // n_rep
+        return (bh // h) * hkv + (bh % h) // n_rep, 0, 0
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=sk)
@@ -122,8 +133,8 @@ def flash_attention(q, k, v, causal: bool = True,
         grid=(b * h, s // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), kv_index),
+            pl.BlockSpec((None, sk, d), kv_index),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
@@ -142,11 +153,14 @@ def _on_tpu() -> bool:
 def attention(q, k, v, causal: bool = True):
     """Dispatch: Pallas flash on TPU (shape permitting), reference else.
 
+    k/v may carry fewer (GQA) heads; both paths handle it — the flash
+    kernel natively (no KV expansion in HBM), the reference by repeat.
     The flash kernel masks in global coordinates assuming seq_q == seq_k;
     cross-length causal attention (reference semantics: query i sees key
     j <= i + (t - s)) must take the reference path.
     """
     s, d = q.shape[2], q.shape[3]
-    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d % 128 == 0):
+    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d % 128 == 0
+            and q.shape[1] % k.shape[1] == 0):
         return flash_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal)
