@@ -98,6 +98,38 @@ def test_checkpoint_roundtrip_with_quantized_params(tmp_path):
     assert out.shape == (1, 8, cfg.vocab)
 
 
+def test_train_state_roundtrip_orbax(tmp_path):
+    """Params + optax opt_state + step survive a save/restore cycle."""
+    import optax
+
+    from tpushare.parallel.train import make_optimizer, make_train_step
+
+    cfg = transformer.tiny(d_model=32, n_heads=2, n_kv_heads=1, n_layers=2,
+                           vocab=64, max_seq=32)
+    optimizer = make_optimizer(lr=1e-2)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = optimizer.init(params)
+    step = make_train_step(cfg, optimizer)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, cfg.vocab)
+    params, opt_state, _ = step(params, opt_state, tokens)
+
+    state = {"params": params, "opt_state": opt_state, "step": jnp.int32(1)}
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint.save_train_state(ckpt, state)
+    restored = checkpoint.load_train_state(ckpt, like=state)
+
+    a_leaves = jax.tree_util.tree_leaves(state)
+    b_leaves = jax.tree_util.tree_leaves(restored)
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # training continues bit-identically from the restored state
+    p1, o1, l1 = step(state["params"], state["opt_state"], tokens)
+    p2, o2, l2 = step(restored["params"], restored["opt_state"], tokens)
+    assert float(l1) == float(l2)
+
+
 def test_checkpoint_atomicity(tmp_path, monkeypatch):
     path = str(tmp_path / "model.npz")
     checkpoint.save_params(path, {"a": jnp.ones((2, 2))})

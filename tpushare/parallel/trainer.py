@@ -1,0 +1,78 @@
+"""Training loop with periodic checkpointing and resume.
+
+The checkpoint/resume aux-subsystem demonstrated end-to-end (the control
+plane stays stateless; training state is the workload's to keep): a
+restarted trainer resumes from the last checkpoint and continues
+bit-identically.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer
+from ..utils import checkpoint
+from .mesh import shard_batch, shard_params
+from .train import make_optimizer, make_train_step
+
+log = logging.getLogger("tpushare.trainer")
+
+
+class Trainer:
+    def __init__(self, cfg: transformer.ModelConfig, mesh=None,
+                 ckpt_dir: Optional[str] = None,
+                 save_every: int = 100,
+                 lr: float = 3e-4, seed: int = 0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.ckpt_dir = ckpt_dir
+        self.save_every = save_every
+        self.optimizer = make_optimizer(lr=lr)
+        self.step_fn = make_train_step(cfg, self.optimizer)
+
+        params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
+        if mesh is not None:
+            params = shard_params(params, mesh)
+        opt_state = self.optimizer.init(params)
+        self.state = {"params": params, "opt_state": opt_state,
+                      "step": jnp.int32(0)}
+        if ckpt_dir and os.path.exists(ckpt_dir):
+            self.state = checkpoint.load_train_state(ckpt_dir, like=self.state)
+            log.info("resumed from %s at step %d", ckpt_dir,
+                     int(self.state["step"]))
+
+    @property
+    def step(self) -> int:
+        return int(self.state["step"])
+
+    def run(self, batches: Iterator, n_steps: int,
+            on_step: Optional[Callable[[int, float], None]] = None) -> float:
+        """Run up to ``n_steps`` more steps; returns the last loss."""
+        loss = float("nan")
+        for _ in range(n_steps):
+            tokens = next(batches)
+            if self.mesh is not None:
+                tokens = shard_batch(jnp.asarray(tokens), self.mesh)
+            params, opt_state, loss_arr = self.step_fn(
+                self.state["params"], self.state["opt_state"], tokens)
+            loss = float(loss_arr)
+            self.state = {"params": params, "opt_state": opt_state,
+                          "step": self.state["step"] + 1}
+            if on_step:
+                on_step(self.step, loss)
+            if (self.ckpt_dir and self.save_every
+                    and self.step % self.save_every == 0):
+                self.save()
+        return loss
+
+    def save(self) -> None:
+        if not self.ckpt_dir:
+            return
+        checkpoint.save_train_state(self.ckpt_dir, self.state)
+        log.info("checkpointed step %d -> %s", self.step, self.ckpt_dir)

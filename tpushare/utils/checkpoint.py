@@ -69,3 +69,29 @@ def save_params(path: str, params: dict) -> None:
 def load_params(path: str) -> dict:
     with np.load(path) as z:
         return _unflatten({k: z[k] for k in z.files})
+
+
+# ---------------------------------------------------------------------------
+# Full train-state checkpointing (arbitrary pytrees, sharded arrays): orbax
+# ---------------------------------------------------------------------------
+def save_train_state(ckpt_dir: str, state) -> None:
+    """Save an arbitrary pytree (params + optax state + step ...).
+
+    Orbax handles structure, dtypes (incl. bf16) and sharded jax.Arrays;
+    the write is atomic (tmp dir + rename) by construction.
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(ckpt_dir), state, force=True)
+
+
+def load_train_state(ckpt_dir: str, like=None):
+    """Restore; pass ``like`` (a matching abstract/concrete pytree) to get
+    exact structure and shardings back."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(os.path.abspath(ckpt_dir), like)
+        return ckptr.restore(os.path.abspath(ckpt_dir))
