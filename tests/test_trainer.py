@@ -3,10 +3,8 @@
 import itertools
 
 import numpy as np
-import pytest
 
 import jax
-import jax.numpy as jnp
 
 from tpushare.models import transformer
 from tpushare.parallel import make_mesh

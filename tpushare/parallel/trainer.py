@@ -2,15 +2,15 @@
 
 The checkpoint/resume aux-subsystem demonstrated end-to-end (the control
 plane stays stateless; training state is the workload's to keep): a
-restarted trainer resumes from the last checkpoint and continues
-bit-identically.
+restarted trainer resumes from the latest step-numbered checkpoint and
+continues bit-identically.  Checkpoints go through orbax's
+CheckpointManager (step dirs + retention), which commits the new step
+before pruning old ones — no crash window loses state.
 """
 
 from __future__ import annotations
 
 import logging
-import os
-import time
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -28,51 +28,80 @@ class Trainer:
     def __init__(self, cfg: transformer.ModelConfig, mesh=None,
                  ckpt_dir: Optional[str] = None,
                  save_every: int = 100,
+                 max_to_keep: int = 3,
                  lr: float = 3e-4, seed: int = 0):
         self.cfg = cfg
         self.mesh = mesh
-        self.ckpt_dir = ckpt_dir
         self.save_every = save_every
         self.optimizer = make_optimizer(lr=lr)
         self.step_fn = make_train_step(cfg, self.optimizer)
+        self._mgr = (checkpoint.make_checkpoint_manager(ckpt_dir, max_to_keep)
+                     if ckpt_dir else None)
+        # step tracked as a host int: a jnp scalar would force a
+        # host-device sync every loop iteration just to decide whether to
+        # checkpoint.
+        self.step = 0
 
-        params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
-        if mesh is not None:
-            params = shard_params(params, mesh)
-        opt_state = self.optimizer.init(params)
-        self.state = {"params": params, "opt_state": opt_state,
-                      "step": jnp.int32(0)}
-        if ckpt_dir and os.path.exists(ckpt_dir):
-            self.state = checkpoint.load_train_state(ckpt_dir, like=self.state)
-            log.info("resumed from %s at step %d", ckpt_dir,
-                     int(self.state["step"]))
+        latest = self._mgr.latest_step() if self._mgr else None
+        if latest is not None:
+            # Restore against an ABSTRACT target (shapes/dtypes only):
+            # materializing a throwaway init first would transiently hold
+            # two full copies of params+opt_state — an OOM risk exactly at
+            # the resume path.
+            abstract = jax.eval_shape(lambda: self._fresh_state(seed))
+            import orbax.checkpoint as ocp
 
-    @property
-    def step(self) -> int:
-        return int(self.state["step"])
+            restored = self._mgr.restore(
+                latest, args=ocp.args.StandardRestore(abstract))
+            params, opt_state = restored["params"], restored["opt_state"]
+            if mesh is not None:
+                # optimizer moments mirror param leaf names, so the same
+                # sharding rules place both.
+                params = shard_params(params, mesh)
+                opt_state = shard_params(opt_state, mesh)
+            self.params, self.opt_state = params, opt_state
+            self.step = latest
+            log.info("resumed from step %d", latest)
+        else:
+            state = self._fresh_state(seed)
+            params = state["params"]
+            if mesh is not None:
+                params = shard_params(params, mesh)
+            self.params = params
+            self.opt_state = self.optimizer.init(params)
+
+    def _fresh_state(self, seed: int):
+        params = transformer.init_params(jax.random.PRNGKey(seed), self.cfg)
+        return {"params": params, "opt_state": self.optimizer.init(params)}
 
     def run(self, batches: Iterator, n_steps: int,
             on_step: Optional[Callable[[int, float], None]] = None) -> float:
-        """Run up to ``n_steps`` more steps; returns the last loss."""
-        loss = float("nan")
+        """Run up to ``n_steps`` more steps; returns the last loss.
+
+        Without ``on_step`` the loop never syncs on the loss, so steps
+        dispatch asynchronously; the single sync happens at return.
+        """
+        loss_arr = None
         for _ in range(n_steps):
             tokens = next(batches)
             if self.mesh is not None:
                 tokens = shard_batch(jnp.asarray(tokens), self.mesh)
-            params, opt_state, loss_arr = self.step_fn(
-                self.state["params"], self.state["opt_state"], tokens)
-            loss = float(loss_arr)
-            self.state = {"params": params, "opt_state": opt_state,
-                          "step": self.state["step"] + 1}
+            self.params, self.opt_state, loss_arr = self.step_fn(
+                self.params, self.opt_state, tokens)
+            self.step += 1
             if on_step:
-                on_step(self.step, loss)
-            if (self.ckpt_dir and self.save_every
-                    and self.step % self.save_every == 0):
+                on_step(self.step, float(loss_arr))
+            if self._mgr and self.save_every \
+                    and self.step % self.save_every == 0:
                 self.save()
-        return loss
+        return float(loss_arr) if loss_arr is not None else float("nan")
 
     def save(self) -> None:
-        if not self.ckpt_dir:
+        if not self._mgr:
             return
-        checkpoint.save_train_state(self.ckpt_dir, self.state)
-        log.info("checkpointed step %d -> %s", self.step, self.ckpt_dir)
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(self.step, args=ocp.args.StandardSave(
+            {"params": self.params, "opt_state": self.opt_state}))
+        self._mgr.wait_until_finished()
+        log.info("checkpointed step %d", self.step)

@@ -75,15 +75,23 @@ def load_params(path: str) -> dict:
 # Full train-state checkpointing (arbitrary pytrees, sharded arrays): orbax
 # ---------------------------------------------------------------------------
 def save_train_state(ckpt_dir: str, state) -> None:
-    """Save an arbitrary pytree (params + optax state + step ...).
+    """One-shot save of an arbitrary pytree to a FRESH directory.
 
-    Orbax handles structure, dtypes (incl. bf16) and sharded jax.Arrays;
-    the write is atomic (tmp dir + rename) by construction.
+    Refuses to overwrite: orbax's overwrite (``force=True``) deletes the
+    old checkpoint before committing the new one, leaving a crash window
+    that loses all state.  Periodic checkpointing must use
+    :func:`make_checkpoint_manager` (step-numbered dirs, retention), which
+    never deletes the old step before the new one is committed.
     """
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(ckpt_dir)
+    if os.path.exists(path):
+        raise FileExistsError(
+            f"{path} exists; use make_checkpoint_manager for periodic "
+            f"checkpointing (atomic across overwrites)")
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(ckpt_dir), state, force=True)
+        ckptr.save(path, state)
 
 
 def load_train_state(ckpt_dir: str, like=None):
@@ -95,3 +103,12 @@ def load_train_state(ckpt_dir: str, like=None):
         if like is not None:
             return ckptr.restore(os.path.abspath(ckpt_dir), like)
         return ckptr.restore(os.path.abspath(ckpt_dir))
+
+
+def make_checkpoint_manager(ckpt_dir: str, max_to_keep: int = 3):
+    """Step-numbered checkpoint manager (the crash-safe periodic form)."""
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
