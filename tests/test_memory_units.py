@@ -1,0 +1,75 @@
+"""MiB memory-unit end-to-end: fan-out, allocation fractions, inspect."""
+
+import grpc
+import pytest
+
+from tpushare.inspect import display, nodeinfo
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+
+from fakes.apiserver import FakeApiServer, make_pod
+from test_inspect import make_node
+
+
+def test_mib_unit_allocation_end_to_end(tmp_path):
+    """A 2-GiB chip advertised in MiB: 2048 fake devices; a 512-MiB pod
+    gets a 0.25 fraction; inspect infers MiB display units."""
+    api = FakeApiServer().start()
+    try:
+        api.pods = [make_pod("small", tpu_mem=512, assume_time=1,
+                             assigned="false", chip_idx=0)]
+        backend = discovery.FakeBackend(n_chips=1, hbm_gib=2)
+        pm = PodManager(KubeClient(api.url), "node-a")
+        plugin = TpuDevicePlugin(
+            backend, allocator=allocate.make_allocator(pm),
+            memory_unit="MiB",
+            socket_path=str(tmp_path / "s.sock"),
+            kubelet_socket=str(tmp_path / "k.sock"))
+        assert len(plugin.devices) == 2048
+        plugin.start()
+        try:
+            ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            grpc.channel_ready_future(ch).result(timeout=5)
+            resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(
+                    devicesIDs=[f for f, _ in plugin.devices[:512]])]))
+            envs = dict(resp.container_responses[0].envs)
+            assert envs[const.ENV_XLA_MEM_FRACTION] == "0.25"  # 512/2048
+            assert envs[const.ENV_TPU_MEM_DEV] == "2048"
+            ch.close()
+        finally:
+            plugin.stop()
+
+        # failure marker carries the MiB unit
+        plugin2 = TpuDevicePlugin(
+            discovery.FakeBackend(n_chips=2, hbm_gib=2),
+            memory_unit="MiB",
+            socket_path=str(tmp_path / "s2.sock"),
+            kubelet_socket=str(tmp_path / "k2.sock"))
+        plugin2.start()
+        try:
+            ch = grpc.insecure_channel(f"unix://{plugin2.socket_path}")
+            grpc.channel_ready_future(ch).result(timeout=5)
+            resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(
+                    devicesIDs=[f for f, _ in plugin2.devices[:64]])]))
+            assert dict(resp.container_responses[0].envs)[
+                const.ENV_TPU_VISIBLE_CHIPS] == "no-tpu-has-64MiB-to-run"
+            ch.close()
+        finally:
+            plugin2.stop()
+    finally:
+        api.stop()
+
+
+def test_inspect_infers_mib_display_unit():
+    node = make_node(tpu_mem=4096, tpu_count=2)  # 2048 MiB per chip
+    pods = [make_pod("p", tpu_mem=512, chip_idx=0, assigned="true")]
+    infos = nodeinfo.build_node_infos([node], pods)
+    assert nodeinfo.infer_memory_unit(infos) == "MiB"
+    out = display.render_summary(infos)
+    assert "TPU Memory(MiB)" in out
+    assert "512/2048" in out
