@@ -1,0 +1,77 @@
+"""Token-batch input pipeline for training.
+
+Deliberately simple and TPU-shaped: fixed-shape [batch, seq+1] windows
+(inputs+targets overlap by one), deterministic per-epoch shuffling keyed
+by (seed, epoch) so every host of a dp group can derive ITS shard of
+each global batch independently — no data service, no host-to-host
+coordination, resumable from (epoch, step) alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    batch: int
+    seq: int          # model sequence length; windows are seq+1 tokens
+    seed: int = 0
+    drop_remainder: bool = True
+
+
+class TokenDataset:
+    """Contiguous token ids (np.memmap or array) -> shuffled LM windows."""
+
+    def __init__(self, tokens: np.ndarray, cfg: DataConfig):
+        if tokens.ndim != 1:
+            raise ValueError("tokens must be a 1-D id array")
+        self.tokens = tokens
+        self.cfg = cfg
+        self.window = cfg.seq + 1
+        self.n_windows = len(tokens) // self.window
+        if self.n_windows < cfg.batch:
+            raise ValueError(
+                f"{len(tokens)} tokens yield {self.n_windows} windows "
+                f"< batch {cfg.batch}")
+
+    def _order(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng((self.cfg.seed, epoch))
+        return rng.permutation(self.n_windows)
+
+    def batches(self, epoch: int = 0,
+                start_step: int = 0,
+                dp_rank: int = 0, dp_size: int = 1
+                ) -> Iterator[np.ndarray]:
+        """Yield [batch/dp_size, seq+1] shards of each global batch.
+
+        ``start_step`` skips already-consumed batches after a resume.
+        """
+        if self.cfg.batch % dp_size:
+            raise ValueError(f"batch {self.cfg.batch} not divisible by "
+                             f"dp_size {dp_size}")
+        per_host = self.cfg.batch // dp_size
+        order = self._order(epoch)
+        n_batches = self.n_windows // self.cfg.batch
+        for b in range(start_step, n_batches):
+            idx = order[b * self.cfg.batch:(b + 1) * self.cfg.batch]
+            mine = idx[dp_rank * per_host:(dp_rank + 1) * per_host]
+            out = np.stack([
+                self.tokens[i * self.window:(i + 1) * self.window]
+                for i in mine])
+            yield out
+
+    def epochs(self, dp_rank: int = 0, dp_size: int = 1,
+               start_epoch: int = 0, start_step: int = 0
+               ) -> Iterator[np.ndarray]:
+        """Endless stream across epochs, resumable at (epoch, step)."""
+        epoch = start_epoch
+        step = start_step
+        while True:
+            yield from self.batches(epoch, start_step=step,
+                                    dp_rank=dp_rank, dp_size=dp_size)
+            epoch += 1
+            step = 0
