@@ -81,9 +81,6 @@ SHARDING_RULES: List[Tuple[str, P]] = [
     ("bias", P()),
 ]
 
-# Backwards-compatible aliases.
-TP_RULES = SHARDING_RULES
-FSDP_TP_RULES = SHARDING_RULES
 
 
 def spec_for(path: str, rules: Sequence[Tuple[str, P]] = SHARDING_RULES) -> P:

@@ -1,7 +1,8 @@
 """Sharded language-model training step (dp × tp, optax optimizer).
 
-The scaling-book recipe applied: params carry Megatron-style tp
-NamedShardings (``mesh.TP_RULES``), the batch is dp-sharded, the step is
+The scaling-book recipe applied: params carry Megatron-style tp (and
+optionally fsdp) NamedShardings (``mesh.SHARDING_RULES``), the batch is
+dp-sharded, the step is
 one ``jit`` — XLA inserts the gradient psums over dp and the activation
 collectives over tp on ICI.  Used by tests (8-device CPU mesh) and by
 ``__graft_entry__.dryrun_multichip``.

@@ -42,33 +42,25 @@ class Trainer:
         # checkpoint.
         self.step = 0
 
-        latest = self._mgr.latest_step() if self._mgr else None
-        if latest is not None:
-            # Restore against an ABSTRACT target (shapes/dtypes only):
-            # materializing a throwaway init first would transiently hold
-            # two full copies of params+opt_state — an OOM risk exactly at
-            # the resume path.
-            abstract = jax.eval_shape(lambda: self._fresh_state(seed))
-            import orbax.checkpoint as ocp
-
-            restored = self._mgr.restore(
-                latest, args=ocp.args.StandardRestore(abstract))
-            params, opt_state = restored["params"], restored["opt_state"]
-            if mesh is not None:
-                # optimizer moments mirror param leaf names, so the same
-                # sharding rules place both.
-                params = shard_params(params, mesh)
-                opt_state = shard_params(opt_state, mesh)
-            self.params, self.opt_state = params, opt_state
+        latest, restored = (checkpoint.restore_latest(
+            self._mgr, jax.eval_shape(lambda: self._fresh_state(seed)))
+            if self._mgr else (None, None))
+        # Restore goes against an ABSTRACT eval_shape target: materializing
+        # a throwaway init first would transiently hold two full copies of
+        # params+opt_state — an OOM risk exactly at the resume path.
+        if restored is not None:
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
             self.step = latest
             log.info("resumed from step %d", latest)
         else:
-            state = self._fresh_state(seed)
-            params = state["params"]
-            if mesh is not None:
-                params = shard_params(params, mesh)
-            self.params = params
-            self.opt_state = self.optimizer.init(params)
+            self.params = self._fresh_state(seed)["params"]
+            self.opt_state = self.optimizer.init(self.params)
+        if mesh is not None:
+            # optimizer moments mirror param leaf names, so the same
+            # sharding rules place both.
+            self.params = shard_params(self.params, mesh)
+            self.opt_state = shard_params(self.opt_state, mesh)
 
     def _fresh_state(self, seed: int):
         params = transformer.init_params(jax.random.PRNGKey(seed), self.cfg)
@@ -99,9 +91,7 @@ class Trainer:
     def save(self) -> None:
         if not self._mgr:
             return
-        import orbax.checkpoint as ocp
-
-        self._mgr.save(self.step, args=ocp.args.StandardSave(
-            {"params": self.params, "opt_state": self.opt_state}))
-        self._mgr.wait_until_finished()
+        checkpoint.save_step(self._mgr, self.step,
+                             {"params": self.params,
+                              "opt_state": self.opt_state})
         log.info("checkpointed step %d", self.step)

@@ -112,3 +112,24 @@ def make_checkpoint_manager(ckpt_dir: str, max_to_keep: int = 3):
     return ocp.CheckpointManager(
         os.path.abspath(ckpt_dir),
         options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+
+def save_step(mgr, step: int, state, wait: bool = True) -> None:
+    """Save ``state`` as step ``step`` through a manager from
+    :func:`make_checkpoint_manager` (all orbax API contact lives here)."""
+    import orbax.checkpoint as ocp
+
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    if wait:
+        mgr.wait_until_finished()
+
+
+def restore_latest(mgr, like):
+    """(step, state) for the manager's latest checkpoint, restored against
+    an abstract/concrete ``like`` pytree; (None, None) when empty."""
+    import orbax.checkpoint as ocp
+
+    step = mgr.latest_step()
+    if step is None:
+        return None, None
+    return step, mgr.restore(step, args=ocp.args.StandardRestore(like))

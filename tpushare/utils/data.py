@@ -37,6 +37,10 @@ class TokenDataset:
             raise ValueError(
                 f"{len(tokens)} tokens yield {self.n_windows} windows "
                 f"< batch {cfg.batch}")
+        # reshape view (no copy even over a memmap): batch assembly is one
+        # fancy index instead of a per-row python loop
+        self._windows = tokens[: self.n_windows * self.window].reshape(
+            self.n_windows, self.window)
 
     def _order(self, epoch: int) -> np.ndarray:
         rng = np.random.default_rng((self.cfg.seed, epoch))
@@ -59,10 +63,7 @@ class TokenDataset:
         for b in range(start_step, n_batches):
             idx = order[b * self.cfg.batch:(b + 1) * self.cfg.batch]
             mine = idx[dp_rank * per_host:(dp_rank + 1) * per_host]
-            out = np.stack([
-                self.tokens[i * self.window:(i + 1) * self.window]
-                for i in mine])
-            yield out
+            yield self._windows[mine]
 
     def epochs(self, dp_rank: int = 0, dp_size: int = 1,
                start_epoch: int = 0, start_step: int = 0
