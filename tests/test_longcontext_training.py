@@ -1,0 +1,76 @@
+"""Long-context training: gradients flow through sequence-parallel
+attention (ring and Ulysses) and match the dense single-device gradients."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.models import transformer
+from tpushare.parallel import make_mesh
+from tpushare.parallel.ring import ring_attention
+from tpushare.parallel.train import make_optimizer
+from tpushare.parallel.ulysses import ulysses_attention
+
+
+def _loss_fn(attention_fn):
+    cfg = transformer.tiny(max_seq=64, n_heads=4, n_kv_heads=2)
+
+    def loss(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = transformer.forward(params, inputs, cfg,
+                                     attention_fn=attention_fn)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    return cfg, loss
+
+
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_sp_attention_gradients_match_dense(sp_impl):
+    # ulysses needs n_heads (4) divisible by sp; ring has no such limit
+    mesh = make_mesh({"sp": 8 if sp_impl == "ring" else 4})
+    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[sp_impl]
+    sp_fn = functools.partial(impl, mesh=mesh)
+
+    cfg, loss_sp = _loss_fn(sp_fn)
+    _, loss_dense = _loss_fn(None)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab)
+
+    l_sp, g_sp = jax.value_and_grad(loss_sp)(params, tokens)
+    l_d, g_d = jax.value_and_grad(loss_dense)(params, tokens)
+
+    np.testing.assert_allclose(float(l_sp), float(l_d), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_sp),
+                    jax.tree_util.tree_leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_ring_training_descends():
+    """Full jitted train step with ring attention over sp, loss descends."""
+    import optax
+
+    mesh = make_mesh({"sp": 8})
+    sp_fn = functools.partial(ring_attention, mesh=mesh)
+    cfg, loss = _loss_fn(sp_fn)
+    optimizer = make_optimizer(lr=1e-2)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        l, g = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab)
+    losses = []
+    for _ in range(4):
+        params, opt_state, l = step(params, opt_state, tokens)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]

@@ -41,6 +41,31 @@ def test_pipeline_matches_sequential(n_stages, n_micro):
     np.testing.assert_allclose(out_pipe, out_seq, atol=1e-5)
 
 
+def test_pipelined_transformer_matches_sequential():
+    """The real model's layer stack over a pp mesh == plain forward."""
+    from tpushare.models import transformer
+
+    cfg = transformer.tiny(n_layers=4, max_seq=32)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+    mesh = make_mesh({"pp": 4})
+    out_pp = transformer.forward_pipelined(params, tokens, cfg, mesh)
+    out_seq = transformer.forward(params, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_seq),
+                               atol=3e-4)
+
+
+def test_pipelined_transformer_validates_batch():
+    from tpushare.models import transformer
+
+    cfg = transformer.tiny(n_layers=4, max_seq=32)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.ones((5, 16), jnp.int32)  # 5 % 4 != 0
+    mesh = make_mesh({"pp": 4})
+    with pytest.raises(ValueError):
+        transformer.forward_pipelined(params, tokens, cfg, mesh)
+
+
 def test_moe_forward_and_capacity():
     cfg = moe.MoEConfig(n_experts=4, top_k=2)
     params = moe.init_params(jax.random.PRNGKey(0), cfg)

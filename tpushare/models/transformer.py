@@ -243,6 +243,44 @@ def forward(params, tokens, cfg: ModelConfig,
     return logits
 
 
+def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
+                      n_micro: Optional[int] = None,
+                      axis_name: str = "pp"):
+    """Forward with the layer stack pipelined over the ``pp`` mesh axis.
+
+    Embedding and the LM head run replicated (they are cheap relative to
+    the stack); the stacked layers are split across stages and microbatches
+    stream through with one ``ppermute`` hop per step
+    (``tpushare.parallel.pipeline``).  Batch must divide into ``n_micro``
+    microbatches (default: the pp size).
+    """
+    from ..parallel.pipeline import pipeline_apply
+
+    b, s = tokens.shape
+    n_stages = mesh.shape[axis_name]
+    n_micro = n_micro or n_stages
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} "
+                         f"microbatches")
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // n_micro, s))
+
+    def layer_fn(layer, x):
+        h_attn, _ = attention_block(
+            layer, rmsnorm(x, layer["attn_scale"], cfg.norm_eps), cfg,
+            positions)
+        x = x + h_attn
+        return x + ffn_block(layer,
+                             rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x_micro = x.reshape(n_micro, b // n_micro, s, cfg.d_model)
+    out = pipeline_apply(layer_fn, params["layers"], x_micro, mesh,
+                         axis_name=axis_name)
+    x = out.reshape(b, s, cfg.d_model)
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
+
+
 def init_kv_caches(cfg: ModelConfig, batch: int):
     """Stacked KV cache: a (k, v) pair of [L, B, Hkv, max_seq, D] buffers."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, cfg.max_seq, cfg.head_dim)
