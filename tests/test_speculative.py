@@ -1,0 +1,47 @@
+"""Speculative decoding: greedy-exactness and acceptance accounting."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.models import transformer
+from tpushare.serving.generate import generate
+from tpushare.serving.speculative import speculative_generate
+
+
+def _models():
+    target_cfg = transformer.tiny(max_seq=96)
+    draft_cfg = transformer.tiny(d_model=32, n_layers=1, n_heads=2,
+                                 n_kv_heads=1, d_ff=64, max_seq=96)
+    target = transformer.init_params(jax.random.PRNGKey(0), target_cfg)
+    draft = transformer.init_params(jax.random.PRNGKey(1), draft_cfg)
+    return target, target_cfg, draft, draft_cfg
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_speculative_equals_plain_greedy(k):
+    target, target_cfg, draft, draft_cfg = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 1,
+                                draft_cfg.vocab)
+    plain = generate(target, target_cfg, prompt, max_new_tokens=16)
+    spec, stats = speculative_generate(target, target_cfg, draft, draft_cfg,
+                                       prompt, max_new_tokens=16, k=k)
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(plain))
+    assert stats.proposed > 0
+    assert 0.0 <= stats.acceptance_rate <= 1.0
+
+
+def test_self_speculation_accepts_everything():
+    """Draft == target: every proposal must be accepted and target
+    forwards collapse toward max_new/k."""
+    target, target_cfg, _, _ = _models()
+    prompt = jnp.asarray([[3, 5, 7, 9]], jnp.int32)
+    out, stats = speculative_generate(target, target_cfg, target, target_cfg,
+                                      prompt, max_new_tokens=12, k=4)
+    plain = generate(target, target_cfg, prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(plain))
+    assert stats.acceptance_rate == 1.0
+    # 12 tokens with k=4 and full acceptance: ~1 prefill + 3 verify passes
+    assert stats.target_forwards <= 5

@@ -1,0 +1,127 @@
+"""Greedy speculative decoding: draft proposes, target verifies.
+
+The draft model decodes ``k`` tokens autoregressively (cheap), then the
+target scores all of them in ONE forward (the MXU-friendly part: one
+seq-k matmul pass instead of k sequential decode steps).  The longest
+prefix where the draft agrees with the target's argmax is accepted, plus
+the target's own next token at the first disagreement — so the output
+is EXACTLY what plain greedy decoding of the target would produce, with
+fewer target forwards whenever the draft is any good.
+
+Static shapes throughout: both KV caches are fixed buffers; a rejection
+just leaves the cache-length pointer behind (stale entries beyond it are
+never attended thanks to position masking, and are overwritten by the
+next proposal round).  The draft keeps its own fed-position counter and
+catches up on accepted tokens it never processed, so its cache never has
+holes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer
+from .generate import make_decode_fns
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _verify(params, block, caches, pos, cfg):
+    return transformer.forward(params, block, cfg, kv_caches=caches,
+                               cache_len=pos)
+
+
+@dataclasses.dataclass
+class SpecStats:
+    proposed: int = 0
+    accepted: int = 0
+    target_forwards: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
+def speculative_generate(target_params, target_cfg: transformer.ModelConfig,
+                         draft_params, draft_cfg: transformer.ModelConfig,
+                         prompt: jnp.ndarray,
+                         max_new_tokens: int = 32,
+                         k: int = 4) -> Tuple[jnp.ndarray, SpecStats]:
+    """prompt [1, P] -> ([1, P + max_new_tokens], stats); greedy-exact."""
+    assert prompt.shape[0] == 1, "speculative path is per-sequence"
+    p_len = prompt.shape[1]
+    assert p_len + max_new_tokens <= min(target_cfg.max_seq,
+                                         draft_cfg.max_seq)
+    t_prefill, _ = make_decode_fns(target_cfg)
+    d_prefill, d_step = make_decode_fns(draft_cfg)
+
+    t_caches = transformer.init_kv_caches(target_cfg, 1)
+    d_caches = transformer.init_kv_caches(draft_cfg, 1)
+    t_logits, t_caches = t_prefill(target_params, prompt, t_caches, p_len)
+    _, d_caches = d_prefill(draft_params, prompt, d_caches, p_len)
+    stats = SpecStats(target_forwards=1)
+
+    tokens = [int(prompt[0, i]) for i in range(p_len)]
+    n_ctx = p_len         # tokens the TARGET cache covers
+    d_pos = p_len         # tokens the DRAFT cache covers
+    next_tok = int(jnp.argmax(t_logits[0]))
+
+    def draft_feed(tok: int, pos: int):
+        nonlocal d_caches
+        log, d_caches = d_step(draft_params, jnp.asarray([tok], jnp.int32),
+                               d_caches, pos)
+        return int(jnp.argmax(log[0]))
+
+    while len(tokens) - p_len < max_new_tokens:
+        tokens.append(next_tok)
+        if len(tokens) - p_len >= max_new_tokens:
+            break
+
+        # --- draft catches up on accepted tokens it never processed -----
+        while d_pos < len(tokens) - 1:
+            draft_feed(tokens[d_pos], d_pos)
+            d_pos += 1
+
+        budget = max_new_tokens - (len(tokens) - p_len)
+        kk = min(k, budget)
+
+        # --- draft proposes kk tokens after next_tok ---------------------
+        proposal = []
+        tok = next_tok
+        for _ in range(kk):
+            tok = draft_feed(tok, d_pos)
+            d_pos += 1
+            proposal.append(tok)
+        stats.proposed += kk
+
+        # --- target verifies next_tok + proposal in one forward ----------
+        block = jnp.asarray([[next_tok] + proposal], jnp.int32)
+        v_logits, t_caches = _verify(target_params, block, t_caches, n_ctx,
+                                     target_cfg)
+        stats.target_forwards += 1
+        greedy = [int(t) for t in jnp.argmax(v_logits[0], axis=-1)]
+        # greedy[i] = target's choice after seeing block[: i + 1]
+
+        n_accept = 0
+        while n_accept < kk and proposal[n_accept] == greedy[n_accept]:
+            n_accept += 1
+        stats.accepted += n_accept
+
+        tokens.extend(proposal[:n_accept])
+        old_ctx = n_ctx
+        n_ctx += 1 + n_accept          # next_tok + accepted proposals
+        # Draft cache validity: it fed next_tok + proposal[:kk-1], so its
+        # longest prefix matching the accepted context covers
+        # min(n_accept + 1, kk) entries; rewind to there — stale entries
+        # beyond are never attended and get overwritten.
+        d_pos = old_ctx + min(n_accept + 1, kk)
+        # target's token at the first mismatch, or the bonus token when
+        # everything was accepted (block has kk+1 logits)
+        next_tok = greedy[n_accept]
+
+    out = jnp.asarray([tokens[: p_len + max_new_tokens]], jnp.int32)
+    return out, stats
