@@ -150,8 +150,17 @@ def attention_block(p, x, cfg: ModelConfig, positions,
     new_cache = None
     if kv_cache is not None:
         ck, cv = kv_cache                       # [B, Hkv, max_seq, D]
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
+        if jnp.ndim(cache_len) == 0:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
+        else:
+            # per-sample positions (continuous batching): vmap the update
+            # over the batch with each slot's own offset
+            upd = jax.vmap(
+                lambda c, blk, p: jax.lax.dynamic_update_slice(
+                    c, blk, (0, p, 0)))
+            ck = upd(ck, k, cache_len)
+            cv = upd(cv, v, cache_len)
         new_cache = (ck, cv)
         # decode: attend over the filled prefix; positions mask the rest
         kk = _expand_kv(ck, h // hkv)
@@ -202,7 +211,11 @@ def forward(params, tokens, cfg: ModelConfig,
     b, s = tokens.shape
     if positions is None:
         if cache_len is not None:
-            positions = cache_len + jnp.arange(s)[None, :]
+            cl = jnp.asarray(cache_len)
+            # scalar cache_len broadcasts; a [B] vector (continuous
+            # batching: every slot at its own depth) goes per-row
+            positions = (cl[:, None] if cl.ndim else cl) \
+                + jnp.arange(s)[None, :]
         else:
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
 
