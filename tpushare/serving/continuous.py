@@ -29,18 +29,33 @@ import numpy as np
 from ..models import transformer
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"))
-def _prefill_row(params, tokens, caches_row, cfg, prompt_len: int):
-    """Single-request prefill against a [L, 1, ...] cache slice."""
-    logits, caches_row = transformer.forward(
-        params, tokens[:, :prompt_len], cfg, kv_caches=caches_row,
-        cache_len=0)
-    return logits[:, -1], caches_row
+@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"),
+                   donate_argnums=(2,))
+def _prefill_slot(params, tokens, caches, slot, cfg, prompt_len: int):
+    """Prefill one request directly into row ``slot`` of the pooled cache.
+
+    Slice, forward, and scatter all happen inside one jit (with the pool
+    donated), so admission never materializes a second copy of the
+    multi-GB cache on the host path.  ``slot`` is traced — one compile
+    serves every slot.
+    """
+    row = jax.tree_util.tree_map(
+        lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), caches)
+    logits, row = transformer.forward(
+        params, tokens[:, :prompt_len], cfg, kv_caches=row, cache_len=0)
+    caches = jax.tree_util.tree_map(
+        lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
+        caches, row)
+    return logits[:, -1], caches
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _tick(params, tokens, caches, lengths, cfg):
-    """Advance every slot one token; tokens [B,1], lengths [B]."""
+    """Advance every slot one token; tokens [B,1], lengths [B].
+
+    The pooled cache is donated: XLA updates it in place instead of
+    holding two full copies across the hot decode loop.
+    """
     logits, caches = transformer.forward(
         params, tokens, cfg, kv_caches=caches, cache_len=lengths)
     return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), caches
@@ -72,23 +87,25 @@ class ContinuousBatcher:
         return [i for i in range(self.n_slots) if i not in self.slots]
 
     def admit(self, prompt: List[int], max_new_tokens: int) -> Optional[int]:
-        """Prefill into a free slot; returns request id (None if full)."""
-        free = self.free_slots()
-        if not free or max_new_tokens < 1:
-            return None
+        """Prefill into a free slot; returns request id, or None when the
+        pool is FULL (backpressure).  Invalid requests raise instead —
+        None must stay unambiguous for retry loops."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt+max_new exceeds max_seq")
+        free = self.free_slots()
+        if not free:
+            return None
         slot = free[0]
         rid = self._next_id
         self._next_id += 1
 
-        row = jax.tree_util.tree_map(lambda c: c[:, slot:slot + 1],
-                                     self.caches)
         tokens = jnp.asarray([prompt], jnp.int32)
-        logits, row = _prefill_row(self.params, tokens, row, self.cfg,
-                                   len(prompt))
-        self.caches = jax.tree_util.tree_map(
-            lambda c, r: c.at[:, slot:slot + 1].set(r), self.caches, row)
+        logits, self.caches = _prefill_slot(
+            self.params, tokens, self.caches, slot, self.cfg, len(prompt))
         first = int(jnp.argmax(logits[0]))
         # prefill already produced the first generated token
         remaining = max_new_tokens - 1

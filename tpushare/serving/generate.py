@@ -26,14 +26,17 @@ def make_decode_fns(cfg: transformer.ModelConfig):
     the serving hot path.
     """
 
-    @functools.partial(jax.jit, static_argnames=("prompt_len",))
+    # Caches are donated: the caller always rebinds them, and in-place
+    # XLA updates avoid holding two cache copies across the decode loop.
+    @functools.partial(jax.jit, static_argnames=("prompt_len",),
+                       donate_argnums=(2,))
     def prefill(params, tokens, caches, prompt_len: int):
         logits, caches = transformer.forward(
             params, tokens[:, :prompt_len], cfg, kv_caches=caches,
             cache_len=0)
         return logits[:, -1], caches
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(2,))
     def step(params, token, caches, pos):
         logits, caches = transformer.forward(
             params, token[:, None], cfg, kv_caches=caches, cache_len=pos)
