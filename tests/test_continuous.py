@@ -64,6 +64,44 @@ def test_single_token_request_completes_at_admit(model):
     assert b.free_slots() == [0]  # no slot consumed
 
 
+def test_service_concurrent_submissions_match_plain(model):
+    """ContinuousService under concurrent submitters == per-request
+    greedy, including queueing beyond the slot pool."""
+    from tpushare.serving.continuous import ContinuousService
+
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2).start()
+    try:
+        requests = [([3, 5, 7], 6), ([11, 13], 4), ([2, 4, 6, 8], 5),
+                    ([1, 9], 3), ([8, 8, 8], 2)]   # 5 requests, 2 slots
+        sinks = [service.submit(p, n) for p, n in requests]
+        for sink, (prompt, n) in zip(sinks, requests):
+            out = sink.get(timeout=120)
+            assert out == _plain(params, cfg, prompt, n)
+    finally:
+        service.stop()
+
+
+def test_llm_server_with_slots_over_http(model):
+    import json
+    import urllib.request
+
+    from tpushare.serving.llm import LLMServer
+
+    params, cfg = model
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1", n_slots=2).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"tokens": [[1, 2, 3]],
+                             "max_new_tokens": 4}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["tokens"][0] == _plain(params, cfg, [1, 2, 3], 4)
+    finally:
+        srv.stop()
+
+
 def test_scalar_cache_len_paths_unchanged(model):
     """Regression: the vector-cache_len change must not disturb the
     scalar decode path used by generate()."""

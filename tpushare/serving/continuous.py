@@ -147,3 +147,78 @@ class ContinuousBatcher:
             if not self.tick():
                 return
         raise RuntimeError("batcher did not drain")
+
+
+class ContinuousService:
+    """Thread-safe front end over :class:`ContinuousBatcher`.
+
+    ``submit`` returns a queue delivering the finished token list; a
+    background thread ticks while work exists, admits queued requests as
+    slots free, and sleeps when idle.  Greedy-only (the batcher's tick
+    takes argmax); sampling requests belong on the per-request path.
+    """
+
+    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int):
+        import queue as _q
+        import threading
+
+        self._q = _q
+        self._batcher = ContinuousBatcher(params, cfg, n_slots)
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._halt = threading.Event()
+        self._waiting: List[Tuple[List[int], int, "object"]] = []
+        self._sinks: Dict[int, "object"] = {}
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpushare-continuous")
+
+    def start(self) -> "ContinuousService":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._work.set()
+        self._thread.join(timeout=10)
+        with self._lock:
+            for _, _, sink in self._waiting:
+                sink.put(None)
+            self._waiting.clear()
+
+    def submit(self, prompt: List[int], max_new_tokens: int):
+        """Returns a queue that yields the full token list (or None on
+        shutdown). Raises ValueError for invalid requests."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self._batcher.cfg.max_seq:
+            raise ValueError("prompt+max_new exceeds max_seq")
+        sink = self._q.Queue(maxsize=1)
+        with self._lock:
+            self._waiting.append((prompt, max_new_tokens, sink))
+        self._work.set()
+        return sink
+
+    # ------------------------------------------------------------------
+    def _admit_waiting_locked(self) -> None:
+        while self._waiting and self._batcher.free_slots():
+            prompt, max_new, sink = self._waiting.pop(0)
+            rid = self._batcher.admit(prompt, max_new)
+            if rid in self._batcher.completed:      # single-token request
+                sink.put(self._batcher.completed.pop(rid))
+            else:
+                self._sinks[rid] = sink
+
+    def _loop(self) -> None:
+        while not self._halt.is_set():
+            self._work.wait(timeout=0.1)
+            with self._lock:
+                self._admit_waiting_locked()
+                active = self._batcher.tick()
+                for rid in list(self._batcher.completed):
+                    sink = self._sinks.pop(rid, None)
+                    if sink is not None:
+                        sink.put(self._batcher.completed.pop(rid))
+                if not active and not self._waiting:
+                    self._work.clear()

@@ -50,7 +50,11 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0):
 class LLMServer:
     def __init__(self, cfg, params, port: int = 8000,
                  addr: str = "0.0.0.0",
-                 default_max_new: int = 32):
+                 default_max_new: int = 32,
+                 n_slots: int = 0):
+        """``n_slots > 0`` serves greedy requests through the continuous
+        batcher (concurrent decode, slot pool); sampling requests and
+        ``n_slots == 0`` use the serialized per-request path."""
         from ..utils.httpserver import JsonHTTPServer
 
         self.cfg = cfg
@@ -58,6 +62,11 @@ class LLMServer:
         self.default_max_new = default_max_new
         self._gen_lock = threading.Lock()   # decode caches are per-call;
         # serialize so co-tenant HBM stays bounded by one batch
+        self._service = None
+        if n_slots > 0:
+            from .continuous import ContinuousService
+
+            self._service = ContinuousService(params, cfg, n_slots).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -99,6 +108,19 @@ class LLMServer:
         if prompt.shape[1] + max_new > self.cfg.max_seq:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
+        if self._service is not None and temperature == 0.0:
+            # continuous batcher: concurrent greedy decode over the pool
+            sinks = [self._service.submit([int(t) for t in row], max_new)
+                     for row in tokens]
+            rows = [s.get(timeout=600) for s in sinks]
+            if any(r is None for r in rows):
+                return 503, {"Error": "server shutting down"}
+            with self._gen_lock:
+                self.requests_served += 1
+                self.sequences_served += len(tokens)
+                self.tokens_generated += max_new * len(tokens)
+            return 200, {"tokens": rows}
+
         key = jax.random.PRNGKey(seed)
         with self._gen_lock:
             out = generate(self.params, self.cfg, prompt,
@@ -128,6 +150,8 @@ class LLMServer:
 
     def stop(self):
         self._http.stop()
+        if self._service is not None:
+            self._service.stop()
 
 
 def main(argv=None) -> int:
@@ -139,6 +163,9 @@ def main(argv=None) -> int:
                     help="weight-only int8 (the 14GiB Llama-2-7B config)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--addr", default="0.0.0.0")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="continuous-batching slot count (0 = serialized "
+                         "per-request decoding)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -154,7 +181,8 @@ def main(argv=None) -> int:
         log.info("running unallocated (dev mode)")
 
     cfg, params = build_model(args.model, args.int8)
-    srv = LLMServer(cfg, params, port=args.port, addr=args.addr)
+    srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
+                    n_slots=args.slots)
     log.info("llm server: model=%s int8=%s on :%d", args.model, args.int8,
              srv.port)
     srv.serve_forever()
