@@ -102,6 +102,22 @@ def test_llm_server_with_slots_over_http(model):
         srv.stop()
 
 
+def test_service_stop_sentinels_inflight_and_queued(model):
+    """stop() must unblock BOTH queued and already-admitted requests."""
+    from tpushare.serving.continuous import ContinuousService
+
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=1).start()
+    sinks = [service.submit([1, 2], 60), service.submit([3, 4], 60),
+             service.submit([5, 6], 60)]
+    import time
+    time.sleep(0.5)  # let the loop admit the first request
+    service.stop()
+    results = [s.get(timeout=10) for s in sinks]
+    # every sink resolves: completed output or the None sentinel
+    assert all(r is None or isinstance(r, list) for r in results)
+
+
 def test_scalar_cache_len_paths_unchanged(model):
     """Regression: the vector-cache_len change must not disturb the
     scalar decode path used by generate()."""

@@ -164,11 +164,14 @@ class ContinuousService:
 
         self._q = _q
         self._batcher = ContinuousBatcher(params, cfg, n_slots)
+        # _lock guards ONLY the _waiting handoff; the batcher and _sinks
+        # are owned by the loop thread, so decode ticks run without the
+        # lock and submit() never waits on a model forward.
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
         self._waiting: List[Tuple[List[int], int, "object"]] = []
-        self._sinks: Dict[int, "object"] = {}
+        self._sinks: Dict[int, "object"] = {}   # loop-thread private
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpushare-continuous")
 
@@ -180,10 +183,15 @@ class ContinuousService:
         self._halt.set()
         self._work.set()
         self._thread.join(timeout=10)
+        # Sentinel BOTH queued and in-flight requests — a stranded sink
+        # would block its client until its own timeout.
         with self._lock:
-            for _, _, sink in self._waiting:
-                sink.put(None)
-            self._waiting.clear()
+            waiting, self._waiting = self._waiting, []
+        for _, _, sink in waiting:
+            sink.put(None)
+        for sink in self._sinks.values():
+            sink.put(None)
+        self._sinks.clear()
 
     def submit(self, prompt: List[int], max_new_tokens: int):
         """Returns a queue that yields the full token list (or None on
@@ -201,24 +209,27 @@ class ContinuousService:
         return sink
 
     # ------------------------------------------------------------------
-    def _admit_waiting_locked(self) -> None:
-        while self._waiting and self._batcher.free_slots():
-            prompt, max_new, sink = self._waiting.pop(0)
-            rid = self._batcher.admit(prompt, max_new)
-            if rid in self._batcher.completed:      # single-token request
-                sink.put(self._batcher.completed.pop(rid))
-            else:
-                self._sinks[rid] = sink
-
     def _loop(self) -> None:
         while not self._halt.is_set():
-            self._work.wait(timeout=0.1)
+            if not self._work.wait(timeout=0.5):
+                continue   # stay asleep while idle; submit() re-sets it
+            # Take the waiting handoff under the lock, then decode without
+            # it — admission and ticks only touch loop-owned state.
+            while self._batcher.free_slots():
+                with self._lock:
+                    if not self._waiting:
+                        break
+                    prompt, max_new, sink = self._waiting.pop(0)
+                rid = self._batcher.admit(prompt, max_new)
+                if rid in self._batcher.completed:  # single-token request
+                    sink.put(self._batcher.completed.pop(rid))
+                else:
+                    self._sinks[rid] = sink
+            active = self._batcher.tick()
+            for rid in list(self._batcher.completed):
+                sink = self._sinks.pop(rid, None)
+                if sink is not None:
+                    sink.put(self._batcher.completed.pop(rid))
             with self._lock:
-                self._admit_waiting_locked()
-                active = self._batcher.tick()
-                for rid in list(self._batcher.completed):
-                    sink = self._sinks.pop(rid, None)
-                    if sink is not None:
-                        sink.put(self._batcher.completed.pop(rid))
-                if not active and not self._waiting:
+                if not active and not self._waiting and not self._sinks:
                     self._work.clear()

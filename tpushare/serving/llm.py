@@ -108,11 +108,21 @@ class LLMServer:
         if prompt.shape[1] + max_new > self.cfg.max_seq:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
-        if self._service is not None and temperature == 0.0:
-            # continuous batcher: concurrent greedy decode over the pool
+        if self._service is not None:
+            if temperature != 0.0:
+                # A parallel per-request decode would allocate a second
+                # full KV cache next to the pool, busting the co-tenant
+                # HBM budget — refuse explicitly rather than OOM.
+                return 400, {"Error": "sampling (temperature>0) is not "
+                                      "supported in --slots mode"}
             sinks = [self._service.submit([int(t) for t in row], max_new)
                      for row in tokens]
-            rows = [s.get(timeout=600) for s in sinks]
+            import queue as _q
+
+            try:
+                rows = [s.get(timeout=600) for s in sinks]
+            except _q.Empty:
+                return 504, {"Error": "generation timed out"}
             if any(r is None for r in rows):
                 return 503, {"Error": "server shutting down"}
             with self._gen_lock:
