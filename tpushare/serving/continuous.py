@@ -50,15 +50,23 @@ def _prefill_slot(params, tokens, caches, slot, cfg, prompt_len: int):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
-def _tick(params, tokens, caches, lengths, cfg):
+def _tick(params, tokens, caches, lengths, temps, keys, cfg):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
-    The pooled cache is donated: XLA updates it in place instead of
-    holding two full copies across the hot decode loop.
+    Per-slot sampling: slot i draws from softmax(logits/temps[i]) with
+    its own key, or argmax where temps[i] == 0 — greedy and sampling
+    requests share one tick.  The pooled cache is donated: XLA updates
+    it in place instead of holding two full copies across the hot loop.
     """
     logits, caches = transformer.forward(
         params, tokens, cfg, kv_caches=caches, cache_len=lengths)
-    return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32), caches
+    logits = logits[:, 0]                                  # [B, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(keys, logits / safe_t)
+    nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+    return nxt, caches
 
 
 @dataclasses.dataclass
@@ -68,6 +76,8 @@ class _Slot:
     remaining: int       # tokens still to generate
     last_token: int
     output: List[int]
+    temperature: float = 0.0
+    key: Optional[jnp.ndarray] = None
 
 
 class ContinuousBatcher:
@@ -86,7 +96,9 @@ class ContinuousBatcher:
     def free_slots(self) -> List[int]:
         return [i for i in range(self.n_slots) if i not in self.slots]
 
-    def admit(self, prompt: List[int], max_new_tokens: int) -> Optional[int]:
+    def admit(self, prompt: List[int], max_new_tokens: int,
+              temperature: float = 0.0,
+              seed: int = 0) -> Optional[int]:
         """Prefill into a free slot; returns request id, or None when the
         pool is FULL (backpressure).  Invalid requests raise instead —
         None must stay unambiguous for retry loops."""
@@ -106,7 +118,12 @@ class ContinuousBatcher:
         tokens = jnp.asarray([prompt], jnp.int32)
         logits, self.caches = _prefill_slot(
             self.params, tokens, self.caches, slot, self.cfg, len(prompt))
-        first = int(jnp.argmax(logits[0]))
+        key = jax.random.PRNGKey(seed)
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            first = int(jax.random.categorical(sub, logits[0] / temperature))
+        else:
+            first = int(jnp.argmax(logits[0]))
         # prefill already produced the first generated token
         remaining = max_new_tokens - 1
         output = list(prompt) + [first]
@@ -115,7 +132,8 @@ class ContinuousBatcher:
             return rid
         self.slots[slot] = _Slot(request_id=rid, length=len(prompt),
                                  remaining=remaining, last_token=first,
-                                 output=output)
+                                 output=output, temperature=temperature,
+                                 key=key)
         return rid
 
     def tick(self) -> int:
@@ -124,11 +142,19 @@ class ContinuousBatcher:
             return 0
         tokens = np.zeros((self.n_slots, 1), np.int32)
         lengths = np.zeros((self.n_slots,), np.int32)
+        temps = np.zeros((self.n_slots,), np.float32)
+        keys = np.zeros((self.n_slots, 2), np.uint32)
         for i, s in self.slots.items():
             tokens[i, 0] = s.last_token
             lengths[i] = s.length
-        nxt, self.caches = _tick(self.params, jnp.asarray(tokens),
-                                 self.caches, jnp.asarray(lengths), self.cfg)
+            temps[i] = s.temperature
+            if s.temperature > 0.0:
+                s.key, sub = jax.random.split(s.key)
+                keys[i] = np.asarray(jax.random.key_data(sub))
+        nxt, self.caches = _tick(
+            self.params, jnp.asarray(tokens), self.caches,
+            jnp.asarray(lengths), jnp.asarray(temps),
+            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)), self.cfg)
         nxt = np.asarray(nxt)
         n_active = len(self.slots)
         for i in list(self.slots):
@@ -154,8 +180,8 @@ class ContinuousService:
 
     ``submit`` returns a queue delivering the finished token list; a
     background thread ticks while work exists, admits queued requests as
-    slots free, and sleeps when idle.  Greedy-only (the batcher's tick
-    takes argmax); sampling requests belong on the per-request path.
+    slots free, and sleeps when idle.  Greedy and sampling requests mix
+    freely (per-slot temperature/keys in the shared tick).
     """
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int):
@@ -187,13 +213,14 @@ class ContinuousService:
         # would block its client until its own timeout.
         with self._lock:
             waiting, self._waiting = self._waiting, []
-        for _, _, sink in waiting:
+        for *_, sink in waiting:
             sink.put(None)
         for sink in self._sinks.values():
             sink.put(None)
         self._sinks.clear()
 
-    def submit(self, prompt: List[int], max_new_tokens: int):
+    def submit(self, prompt: List[int], max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0):
         """Returns a queue that yields the full token list (or None on
         shutdown). Raises ValueError for invalid requests."""
         if not prompt:
@@ -204,7 +231,8 @@ class ContinuousService:
             raise ValueError("prompt+max_new exceeds max_seq")
         sink = self._q.Queue(maxsize=1)
         with self._lock:
-            self._waiting.append((prompt, max_new_tokens, sink))
+            self._waiting.append(
+                (prompt, max_new_tokens, temperature, seed, sink))
         self._work.set()
         return sink
 
@@ -219,8 +247,9 @@ class ContinuousService:
                 with self._lock:
                     if not self._waiting:
                         break
-                    prompt, max_new, sink = self._waiting.pop(0)
-                rid = self._batcher.admit(prompt, max_new)
+                    prompt, max_new, temp, seed, sink = self._waiting.pop(0)
+                rid = self._batcher.admit(prompt, max_new,
+                                          temperature=temp, seed=seed)
                 if rid in self._batcher.completed:  # single-token request
                     sink.put(self._batcher.completed.pop(rid))
                 else:

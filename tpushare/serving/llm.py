@@ -52,9 +52,9 @@ class LLMServer:
                  addr: str = "0.0.0.0",
                  default_max_new: int = 32,
                  n_slots: int = 0):
-        """``n_slots > 0`` serves greedy requests through the continuous
-        batcher (concurrent decode, slot pool); sampling requests and
-        ``n_slots == 0`` use the serialized per-request path."""
+        """``n_slots > 0`` serves requests (greedy or sampled) through the
+        continuous batcher; ``n_slots == 0`` uses the serialized
+        per-request path."""
         from ..utils.httpserver import JsonHTTPServer
 
         self.cfg = cfg
@@ -109,13 +109,10 @@ class LLMServer:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
         if self._service is not None:
-            if temperature != 0.0:
-                # A parallel per-request decode would allocate a second
-                # full KV cache next to the pool, busting the co-tenant
-                # HBM budget — refuse explicitly rather than OOM.
-                return 400, {"Error": "sampling (temperature>0) is not "
-                                      "supported in --slots mode"}
-            sinks = [self._service.submit([int(t) for t in row], max_new)
+            # greedy and sampling both ride the slot pool (per-slot
+            # temperature/keys) — no second KV cache beside the pool
+            sinks = [self._service.submit([int(t) for t in row], max_new,
+                                          temperature=temperature, seed=seed)
                      for row in tokens]
             import queue as _q
 
