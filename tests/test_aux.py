@@ -1,0 +1,40 @@
+"""Aux subsystems: stack dump, PreStartContainer, runtime init glue."""
+
+import os
+
+import grpc
+
+from tpushare.plugin import discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.server import TpuDevicePlugin
+from tpushare.utils import stackdump
+
+
+def test_stackdump_writes_all_threads(tmp_path):
+    path = stackdump.dump(str(tmp_path))
+    assert os.path.exists(path)
+    content = open(path).read()
+    assert "--- thread" in content
+    assert "test_stackdump_writes_all_threads" in content
+
+
+def test_stackdump_falls_back_to_stderr(capsys):
+    path = stackdump.dump("/nonexistent-dir-xyz")
+    assert path == "<stderr>"
+    assert "--- thread" in capsys.readouterr().err
+
+
+def test_pre_start_container_noop(tmp_path):
+    p = TpuDevicePlugin(discovery.FakeBackend(n_chips=1),
+                        socket_path=str(tmp_path / "s.sock"),
+                        kubelet_socket=str(tmp_path / "k.sock"))
+    p.start()
+    try:
+        ch = grpc.insecure_channel(f"unix://{p.socket_path}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        resp = DevicePluginStub(ch).PreStartContainer(
+            pb.PreStartContainerRequest(devicesIDs=["x-_-0"]))
+        assert isinstance(resp, pb.PreStartContainerResponse)
+        ch.close()
+    finally:
+        p.stop()
