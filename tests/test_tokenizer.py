@@ -1,0 +1,66 @@
+"""Byte tokenizer roundtrip and text-mode serving."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpushare.serving.tokenizer import BOS_ID, ByteTokenizer
+
+
+def test_roundtrip_ascii_and_unicode():
+    tok = ByteTokenizer()
+    for text in ("hello", "héllo wörld", "日本語", "a\nb\tc"):
+        ids = tok.encode(text)
+        assert ids[0] == BOS_ID
+        assert tok.decode(ids) == text
+
+
+def test_ids_stay_in_vocab_floor():
+    tok = ByteTokenizer()
+    ids = tok.encode("ÿ\xff")
+    assert max(ids) < tok.vocab_floor
+    assert min(ids) >= 0
+
+
+def test_llm_server_text_mode():
+    from tpushare.models import transformer
+    from tpushare.serving.llm import LLMServer
+
+    import jax
+
+    cfg = transformer.tiny(vocab=300, max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1").start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"text": "hi", "max_new_tokens": 4}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert len(out["tokens"][0]) == 3 + 4  # BOS + 2 bytes + generated
+        assert isinstance(out["text"][0], str)
+        assert out["text"][0].startswith("hi")
+    finally:
+        srv.stop()
+
+
+def test_llm_server_text_mode_requires_vocab():
+    from tpushare.models import transformer
+    from tpushare.serving.llm import LLMServer
+
+    import jax
+
+    cfg = transformer.tiny(vocab=128, max_seq=64)  # < 258
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1").start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"text": "hi"}).encode(), method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()

@@ -84,6 +84,19 @@ class LLMServer:
 
         from .generate import generate
 
+        text_mode = "text" in body and "tokens" not in body
+        if text_mode:
+            from .tokenizer import ByteTokenizer
+
+            if self.cfg.vocab < ByteTokenizer().vocab_floor:
+                return 400, {"Error": "model vocab too small for the "
+                                      "byte tokenizer; send tokens"}
+            text = body.get("text")
+            if not isinstance(text, str) or not text:
+                return 400, {"Error": "text must be a non-empty string"}
+            tok = ByteTokenizer()
+            body = dict(body)
+            body["tokens"] = [tok.encode(text)]
         tokens = body.get("tokens")
         if (not tokens or not isinstance(tokens, list)
                 or not all(isinstance(row, list) and row for row in tokens)):
@@ -126,7 +139,7 @@ class LLMServer:
                 self.requests_served += 1
                 self.sequences_served += len(tokens)
                 self.tokens_generated += max_new * len(tokens)
-            return 200, {"tokens": rows}
+            return 200, self._result(rows, text_mode)
 
         key = jax.random.PRNGKey(seed)
         with self._gen_lock:
@@ -136,7 +149,18 @@ class LLMServer:
             self.requests_served += 1
             self.sequences_served += len(tokens)
             self.tokens_generated += max_new * len(tokens)
-        return 200, {"tokens": [list(map(int, row)) for row in out]}
+        return 200, self._result([list(map(int, row)) for row in out],
+                                 text_mode)
+
+    @staticmethod
+    def _result(rows, text_mode: bool):
+        payload = {"tokens": rows}
+        if text_mode:
+            from .tokenizer import ByteTokenizer
+
+            tok = ByteTokenizer()
+            payload["text"] = [tok.decode(row) for row in rows]
+        return payload
 
     def _stats(self, _):
         dt = time.monotonic() - self._t0

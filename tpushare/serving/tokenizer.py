@@ -1,0 +1,39 @@
+"""Byte-level tokenizer: text ⇄ ids with zero external assets.
+
+Serving needs *a* tokenizer out of the box (this environment cannot
+download vocabularies); UTF-8 bytes offset past the special ids are the
+simplest fully-reversible scheme.  Any model with ``vocab >= 258``
+works; real deployments swap in their own tokenizer behind the same
+two-method surface.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+PAD_ID = 0
+BOS_ID = 1
+_OFFSET = 2
+VOCAB_FLOOR = 256 + _OFFSET
+
+
+class ByteTokenizer:
+    """ids = [BOS] + (utf8 byte + 2 per byte)."""
+
+    def __init__(self, add_bos: bool = True):
+        self.add_bos = add_bos
+
+    @property
+    def vocab_floor(self) -> int:
+        return VOCAB_FLOOR
+
+    def encode(self, text: str) -> List[int]:
+        ids = [b + _OFFSET for b in text.encode("utf-8")]
+        return ([BOS_ID] + ids) if self.add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        # specials (< _OFFSET) and ids beyond the byte range (a model may
+        # have vocab > 258 and emit them) drop out
+        data = bytes(i - _OFFSET for i in ids
+                     if _OFFSET <= i < 256 + _OFFSET)
+        return data.decode("utf-8", errors="replace")
