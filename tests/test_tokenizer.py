@@ -5,7 +5,7 @@ import urllib.request
 
 import pytest
 
-from tpushare.serving.tokenizer import BOS_ID, ByteTokenizer
+from tpushare.serving.tokenizer import BOS_ID, VOCAB_FLOOR, ByteTokenizer
 
 
 def test_roundtrip_ascii_and_unicode():
@@ -19,7 +19,7 @@ def test_roundtrip_ascii_and_unicode():
 def test_ids_stay_in_vocab_floor():
     tok = ByteTokenizer()
     ids = tok.encode("ÿ\xff")
-    assert max(ids) < tok.vocab_floor
+    assert max(ids) < VOCAB_FLOOR
     assert min(ids) >= 0
 
 

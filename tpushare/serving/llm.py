@@ -84,19 +84,20 @@ class LLMServer:
 
         from .generate import generate
 
-        text_mode = "text" in body and "tokens" not in body
+        if "text" in body and body.get("tokens") is not None:
+            return 400, {"Error": "send either text or tokens, not both"}
+        text_mode = "text" in body
         if text_mode:
-            from .tokenizer import ByteTokenizer
+            from .tokenizer import VOCAB_FLOOR, ByteTokenizer
 
-            if self.cfg.vocab < ByteTokenizer().vocab_floor:
+            if self.cfg.vocab < VOCAB_FLOOR:
                 return 400, {"Error": "model vocab too small for the "
                                       "byte tokenizer; send tokens"}
             text = body.get("text")
             if not isinstance(text, str) or not text:
                 return 400, {"Error": "text must be a non-empty string"}
-            tok = ByteTokenizer()
             body = dict(body)
-            body["tokens"] = [tok.encode(text)]
+            body["tokens"] = [ByteTokenizer().encode(text)]
         tokens = body.get("tokens")
         if (not tokens or not isinstance(tokens, list)
                 or not all(isinstance(row, list) and row for row in tokens)):

@@ -23,10 +23,6 @@ class ByteTokenizer:
     def __init__(self, add_bos: bool = True):
         self.add_bos = add_bos
 
-    @property
-    def vocab_floor(self) -> int:
-        return VOCAB_FLOOR
-
     def encode(self, text: str) -> List[int]:
         ids = [b + _OFFSET for b in text.encode("utf-8")]
         return ([BOS_ID] + ids) if self.add_bos else ids
