@@ -89,6 +89,17 @@ def test_metadata_backend_garbage_accelerator_type_falls_back(tmp_path):
     assert chips[0].hbm_bytes == discovery.FALLBACK_GENERATION.hbm_bytes
 
 
+def test_metadata_backend_hbm_override(tmp_path):
+    (tmp_path / "accel0").touch()
+    be = discovery.MetadataBackend(
+        dev_glob=str(tmp_path / "accel*"),
+        accelerator_type="v5e-4", metadata_timeout=0.01,
+        hbm_gib_override=24)
+    chips = be.chips()
+    assert chips[0].hbm_bytes == 24 * const.GIB  # table says 16; flag wins
+    assert len(discovery.fan_out(chips, "GiB")) == 24
+
+
 def test_metadata_backend_no_devices(tmp_path):
     be = discovery.MetadataBackend(
         dev_glob=str(tmp_path / "accel*"),

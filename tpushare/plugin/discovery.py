@@ -229,11 +229,16 @@ class MetadataBackend(ChipBackend):
     def __init__(self, dev_glob: str = "/dev/accel*",
                  vfio_glob: str = "/dev/vfio/[0-9]*",
                  accelerator_type: Optional[str] = None,
-                 metadata_timeout: float = 2.0):
+                 metadata_timeout: float = 2.0,
+                 hbm_gib_override: Optional[int] = None):
         self._dev_glob = dev_glob
         self._vfio_glob = vfio_glob
         self._acc_type = accelerator_type
         self._timeout = metadata_timeout
+        # operator override for new/odd generations the static table
+        # doesn't know (SURVEY.md §5 config row)
+        self._hbm_override = (hbm_gib_override * const.GIB
+                              if hbm_gib_override else None)
         self._events: "queue.Queue[HealthEvent]" = queue.Queue()
         self._acc_type_cache: Optional[str] = None
 
@@ -320,10 +325,11 @@ class MetadataBackend(ChipBackend):
         # Chip index = the device node's own number (accel2 -> 2), NOT the
         # enumerate position: with a sparse /dev (dead chip), positional
         # numbering would point TPU_VISIBLE_CHIPS at the wrong silicon.
+        hbm = self._hbm_override or gen.hbm_bytes
         return [
             Chip(index=_trailing_int(p),
                  id=f"tpu-{gen.name}-{os.path.basename(p)}",
-                 dev_paths=(p,), hbm_bytes=gen.hbm_bytes,
+                 dev_paths=(p,), hbm_bytes=hbm,
                  cores=gen.cores_per_chip, generation=gen.name)
             for p in paths
         ]

@@ -55,6 +55,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fake-chips", type=int, default=1,
                     help="chip count for --backend fake")
     ap.add_argument("--fake-generation", default="v4")
+    ap.add_argument("--hbm-gib", type=int, default=0,
+                    help="override per-chip HBM GiB (0 = use the "
+                         "generation table; for generations the table "
+                         "doesn't know)")
     ap.add_argument("--standalone", action="store_true",
                     help="run without any cluster (no apiserver/kubelet pod "
                          "queries; single-chip fast-path allocation only)")
@@ -76,9 +80,17 @@ def main(argv=None) -> int:
 
     if args.backend == "fake":
         backend = make_backend("fake", n_chips=args.fake_chips,
-                               generation=args.fake_generation)
+                               generation=args.fake_generation,
+                               hbm_gib=args.hbm_gib or None)
+    elif args.backend == "metadata":
+        backend = make_backend("metadata",
+                               hbm_gib_override=args.hbm_gib or None)
     else:
         backend = make_backend(args.backend)
+        if args.hbm_gib:
+            # libtpu backend falls back to metadata discovery internally
+            backend._fallback = type(backend._fallback)(
+                hbm_gib_override=args.hbm_gib)
 
     allocator_factory = None
     on_chips_ready = None
