@@ -153,7 +153,7 @@ def test_config5_multihost_mixed_sizes_binpack(cluster, tmp_path):
             }).encode(), method="POST")
         with urllib.request.urlopen(req, timeout=5) as r:
             result = json.loads(r.read())
-        passing = [n["metadata"]["name"] for n in result["Nodes"]["items"]]
+        passing = result["NodeNames"]  # NodeNames request => NodeNames reply
         assert passing, f"{name} fits nowhere"
         assert bind(ext, name, passing[0])["Error"] == ""
 

@@ -80,6 +80,21 @@ def test_filter_drops_full_nodes(api, extender):
     assert "node-full" in result["FailedNodes"]
 
 
+def test_filter_node_names_mode_mirrors_request_form(api, extender):
+    """nodeCacheCapable schedulers send NodeNames and expect NodeNames."""
+    api.nodes["node-full"] = make_node("node-full", tpu_mem=32, tpu_count=1)
+    api.nodes["node-free"] = make_node("node-free", tpu_mem=32, tpu_count=1)
+    api.pods = [make_pod("hog", node="node-full", tpu_mem=30, chip_idx=0,
+                         assume_time=1, assigned="true", phase="Running")]
+    result = _post(extender, "/filter", {
+        "Pod": make_pod("new", node="", tpu_mem=8),
+        "NodeNames": ["node-full", "node-free"],
+    })
+    assert result["NodeNames"] == ["node-free"]
+    assert result["Nodes"] is None
+    assert "node-full" in result["FailedNodes"]
+
+
 def test_priorities_prefer_utilized_node(api, extender):
     api.nodes["empty"] = make_node("empty", tpu_mem=32, tpu_count=1)
     api.nodes["busy"] = make_node("busy", tpu_mem=32, tpu_count=1)

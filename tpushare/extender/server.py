@@ -79,13 +79,26 @@ class ExtenderServer:
 
     # ------------------------------------------------------------------
     def filter(self, args: dict) -> dict:
+        # A nodeCacheCapable scheduler sends NodeNames and expects
+        # NodeNames back; a full-object scheduler sends Nodes and expects
+        # Nodes — mirror whichever form the request used.
+        names_mode = not ((args.get("Nodes") or {}).get("Items")
+                          or (args.get("Nodes") or {}).get("items"))
+
+        def result(passed_nodes, failed):
+            if names_mode:
+                return {"Nodes": None,
+                        "NodeNames": [n.get("metadata", {}).get("name", "?")
+                                      for n in passed_nodes],
+                        "FailedNodes": failed, "Error": ""}
+            return {"Nodes": {"items": passed_nodes}, "NodeNames": None,
+                    "FailedNodes": failed, "Error": ""}
+
         pod = args.get("Pod") or {}
         req = self._request_units(pod)
         nodes = self._nodes_from_args(args)
         if req <= 0:
-            # not our resource; don't interfere
-            return {"Nodes": {"items": nodes}, "NodeNames": None,
-                    "FailedNodes": {}, "Error": ""}
+            return result(nodes, {})   # not our resource; don't interfere
         by_node = self._pods_by_node()
         passed, failed = [], {}
         for node in nodes:
@@ -96,10 +109,7 @@ class ExtenderServer:
                                 f"{self.resource_name}")
             else:
                 passed.append(node)
-        return {"Nodes": {"items": passed},
-                "NodeNames": None,
-                "FailedNodes": failed,
-                "Error": ""}
+        return result(passed, failed)
 
     def priorities(self, args: dict) -> list:
         pod = args.get("Pod") or {}
