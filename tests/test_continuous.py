@@ -98,6 +98,11 @@ def test_llm_server_with_slots_over_http(model):
         with urllib.request.urlopen(req, timeout=120) as r:
             out = json.loads(r.read())
         assert out["tokens"][0] == _plain(params, cfg, [1, 2, 3], 4)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats", timeout=10) as r:
+            stats = json.loads(r.read())
+        assert stats["batcher"]["slots"] == 2
+        assert stats["batcher"]["active"] == 0  # drained
     finally:
         srv.stop()
 

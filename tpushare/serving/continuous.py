@@ -236,6 +236,18 @@ class ContinuousService:
         self._work.set()
         return sink
 
+    def snapshot(self) -> dict:
+        """Occupancy for observability: {slots, active, queued}.
+
+        active/queued are read without the loop's cadence in mind — a
+        point-in-time view for /stats, not a synchronization primitive.
+        """
+        with self._lock:
+            queued = len(self._waiting)
+        return {"slots": self._batcher.n_slots,
+                "active": len(self._batcher.slots),
+                "queued": queued}
+
     # ------------------------------------------------------------------
     def _loop(self) -> None:
         while not self._halt.is_set():

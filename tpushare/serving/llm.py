@@ -165,13 +165,16 @@ class LLMServer:
 
     def _stats(self, _):
         dt = time.monotonic() - self._t0
-        return 200, {
+        stats = {
             "requests_served": self.requests_served,
             "sequences_served": self.sequences_served,
             "tokens_generated": self.tokens_generated,
             "uptime_s": round(dt, 1),
             "tokens_per_s": round(self.tokens_generated / dt, 2) if dt else 0,
         }
+        if self._service is not None:
+            stats["batcher"] = self._service.snapshot()
+        return 200, stats
 
     def start(self):
         self._http.start()
