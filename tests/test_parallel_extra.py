@@ -55,6 +55,36 @@ def test_pipelined_transformer_matches_sequential():
                                atol=3e-4)
 
 
+def test_pipelined_transformer_gradients_match_sequential():
+    """pp TRAINING: gradients flow through the microbatch schedule's
+    ppermute/fori_loop and equal the sequential model's gradients."""
+    from tpushare.models import transformer
+
+    cfg = transformer.tiny(n_layers=4, max_seq=32)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab)
+    mesh = make_mesh({"pp": 4})
+
+    def nll(logits, targets):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None], -1).mean()
+
+    def loss_pp(p):
+        return nll(transformer.forward_pipelined(p, tokens[:, :-1], cfg,
+                                                 mesh), tokens[:, 1:])
+
+    def loss_seq(p):
+        return nll(transformer.forward(p, tokens[:, :-1], cfg),
+                   tokens[:, 1:])
+
+    l1, g1 = jax.value_and_grad(loss_pp)(params)
+    l2, g2 = jax.value_and_grad(loss_seq)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
 def test_pipelined_transformer_validates_batch():
     from tpushare.models import transformer
 
