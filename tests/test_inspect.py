@@ -99,6 +99,28 @@ def test_render_details_lists_pods_once():
     assert "Allocated : 12 (18%)" in out
 
 
+def test_inspect_json_output(monkeypatch, capsys):
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-a"] = make_node()
+        api.pods = [make_pod("a", tpu_mem=8, chip_idx=0, assigned="true",
+                             phase="Running")]
+        from tpushare.k8s.client import KubeClient
+        import tpushare.inspect.main as im
+        monkeypatch.setattr(im.KubeClient, "from_env",
+                            classmethod(lambda cls: KubeClient(api.url)))
+        rc = inspect_main(["-o", "json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["unit"] == "GiB"
+        node = out["nodes"][0]
+        assert node["name"] == "node-a"
+        assert node["devices"]["0"]["used"] == 8
+        assert node["devices"]["0"]["pods"] == ["default/a"]
+    finally:
+        api.stop()
+
+
 def test_inspect_main_end_to_end(monkeypatch, capsys):
     api = FakeApiServer().start()
     try:

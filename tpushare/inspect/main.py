@@ -55,6 +55,9 @@ def main(argv=None) -> int:
         description="Report per-chip TPU HBM binpacking across the cluster.")
     ap.add_argument("-d", "--details", action="store_true",
                     help="per-pod detail tables")
+    ap.add_argument("-o", "--output", choices=["table", "json"],
+                    default="table",
+                    help="table (default) or machine-readable json")
     ap.add_argument("node", nargs="?", default=None,
                     help="restrict to one node")
     args = ap.parse_args(argv)
@@ -67,6 +70,32 @@ def main(argv=None) -> int:
         return 1
 
     infos = build_node_infos(nodes, pods)
+    if args.output == "json":
+        import json
+
+        from .nodeinfo import PENDING_IDX, infer_memory_unit
+        out = {"unit": infer_memory_unit(infos), "nodes": []}
+        for info in infos:
+            out["nodes"].append({
+                "name": info.name,
+                "address": info.address,
+                "chips": info.chip_count,
+                "total_mem": info.total_mem,
+                "used_mem": info.used_mem,
+                "devices": {
+                    ("pending" if idx == PENDING_IDX else str(idx)): {
+                        "used": dev.used_mem,
+                        "total": dev.total_mem,
+                        "pods": [f"{p['metadata'].get('namespace', '?')}/"
+                                 f"{p['metadata'].get('name', '?')}"
+                                 for p in dev.pods],
+                    }
+                    for idx, dev in sorted(info.devs.items())
+                },
+            })
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0
     render = render_details if args.details else render_summary
     sys.stdout.write(render(infos))
     return 0
