@@ -24,6 +24,33 @@ def test_stackdump_falls_back_to_stderr(capsys):
     assert "--- thread" in capsys.readouterr().err
 
 
+def test_podgetter_dumps_kubelet_pods(capsys):
+    import json
+
+    from tpushare.kubelet.podgetter import main as podgetter_main
+    from fakes.apiserver import FakeApiServer, make_pod
+
+    api = FakeApiServer().start()
+    try:
+        api.pods = [make_pod("p1", tpu_mem=2)]
+        rc = podgetter_main(["--address", "127.0.0.1",
+                             "--port", str(api.port), "--scheme", "http"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["items"][0]["metadata"]["name"] == "p1"
+    finally:
+        api.stop()
+
+
+def test_podgetter_unreachable_kubelet_errors_cleanly(capsys):
+    from tpushare.kubelet.podgetter import main as podgetter_main
+
+    rc = podgetter_main(["--address", "127.0.0.1", "--port", "1",
+                         "--scheme", "http"])
+    assert rc == 1
+    assert "error querying kubelet" in capsys.readouterr().err
+
+
 def test_pre_start_container_noop(tmp_path):
     p = TpuDevicePlugin(discovery.FakeBackend(n_chips=1),
                         socket_path=str(tmp_path / "s.sock"),
