@@ -38,3 +38,22 @@ def test_detect_garbage_worker_id_clamps():
 def test_init_distributed_single_host_is_noop():
     topo = distributed.init_distributed({})
     assert not topo.is_multihost  # and no jax.distributed call was made
+
+
+def test_global_mesh_single_host_builds_over_local_devices():
+    mesh = distributed.global_mesh({"dp": -1}, env={})
+    assert mesh.shape["dp"] == 8  # the virtual CPU mesh
+
+
+def test_device_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    from tpushare.utils.profiler import device_trace
+
+    with device_trace(str(tmp_path)) as logdir:
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    import os
+    found = []
+    for root, _, files in os.walk(tmp_path):
+        found.extend(files)
+    assert found, "no trace artifacts written"
