@@ -38,6 +38,39 @@ def test_engine_batches_concurrent_requests():
         engine.stop()
 
 
+def test_engine_mask_isolates_ragged_requests():
+    """With pass_mask, a short request's output matches its unbatched
+    result exactly — pad rows/positions cannot bleed through bidirectional
+    attention."""
+    import jax
+
+    from tpushare.models import bert
+
+    cfg = bert.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+
+    def fwd(tokens, mask):
+        return bert.forward(params, tokens, cfg, attention_mask=mask)
+
+    engine = InferenceEngine(fwd, batch_size=4, seq_len=16,
+                             max_wait_ms=50, pass_mask=True)
+    engine.start()
+    try:
+        short = np.arange(1, 7, dtype=np.int32)        # 6 real tokens
+        long = np.arange(1, 17, dtype=np.int32)        # fills the row
+        q1 = engine.submit(short)
+        q2 = engine.submit(long)
+        out_short = q1.get(timeout=60)
+        q2.get(timeout=60)
+    finally:
+        engine.stop()
+
+    solo = np.asarray(bert.forward(
+        params, jnp.asarray(short[None, :]), cfg,
+        attention_mask=jnp.ones((1, 6), jnp.int32)))[0]
+    np.testing.assert_allclose(out_short[:6], solo, atol=1e-5)
+
+
 def test_engine_stop_delivers_sentinel_to_queued_requests():
     started = threading.Event()
 

@@ -19,22 +19,35 @@ import numpy as np
 
 
 class InferenceEngine:
-    """Wraps a jitted ``fn(batch_tokens) -> outputs`` with micro-batching."""
+    """Wraps a jitted ``fn(batch_tokens) -> outputs`` with micro-batching.
+
+    ``pass_mask=True`` calls ``fn(tokens, mask)`` with a [B, S] validity
+    mask instead — REQUIRED for encoder models when ragged requests are
+    padded to the fixed shape, or pad positions bleed into real outputs
+    through bidirectional attention.
+    """
 
     def __init__(self, fn: Callable, batch_size: int, seq_len: int,
-                 max_wait_ms: float = 2.0, pad_id: int = 0):
+                 max_wait_ms: float = 2.0, pad_id: int = 0,
+                 pass_mask: bool = False):
         self.fn = jax.jit(fn)
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.max_wait = max_wait_ms / 1000.0
         self.pad_id = pad_id
+        self.pass_mask = pass_mask
         self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue]]" = queue.Queue()
         self._halt = threading.Event()
         self._worker: Optional[threading.Thread] = None
 
     # -- sync one-shot ------------------------------------------------------
-    def infer(self, tokens: np.ndarray):
+    def infer(self, tokens: np.ndarray, mask: Optional[np.ndarray] = None):
         """tokens [B, S] -> outputs, blocking."""
+        if self.pass_mask:
+            if mask is None:
+                mask = np.ones_like(tokens, dtype=np.int32)
+            return jax.block_until_ready(
+                self.fn(jnp.asarray(tokens), jnp.asarray(mask)))
         return jax.block_until_ready(self.fn(jnp.asarray(tokens)))
 
     def warmup(self):
@@ -85,10 +98,12 @@ class InferenceEngine:
                     break
             tokens = np.full((self.batch_size, self.seq_len), self.pad_id,
                              dtype=np.int32)
+            mask = np.zeros((self.batch_size, self.seq_len), dtype=np.int32)
             for i, (toks, _) in enumerate(batch):
                 n = min(len(toks), self.seq_len)
                 tokens[i, :n] = toks[:n]
-            outputs = self.infer(tokens)
+                mask[i, :n] = 1
+            outputs = self.infer(tokens, mask)
             for i, (_, out_q) in enumerate(batch):
                 out_q.put(np.asarray(outputs[i]))
 
