@@ -103,6 +103,16 @@ def test_llm_server_with_slots_over_http(model):
             stats = json.loads(r.read())
         assert stats["batcher"]["slots"] == 2
         assert stats["batcher"]["active"] == 0  # drained
+
+        # ragged rows are fine in slots mode: each row is its own request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"tokens": [[1, 2, 3], [9, 8]],
+                             "max_new_tokens": 3}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            ragged = json.loads(r.read())
+        assert ragged["tokens"][0] == _plain(params, cfg, [1, 2, 3], 3)
+        assert ragged["tokens"][1] == _plain(params, cfg, [9, 8], 3)
     finally:
         srv.stop()
 

@@ -102,10 +102,12 @@ class LLMServer:
         if (not tokens or not isinstance(tokens, list)
                 or not all(isinstance(row, list) and row for row in tokens)):
             return 400, {"Error": "body must contain tokens: [[int, ...]]"}
-        lengths = {len(row) for row in tokens}
-        if len(lengths) != 1:
+        if self._service is None and len({len(row) for row in tokens}) != 1:
+            # the per-request path decodes rows as one rectangular batch;
+            # the slot pool serves each row independently, so ragged rows
+            # are fine there
             return 400, {"Error": "token rows must share one length "
-                                  "(pad client-side)"}
+                                  "(pad client-side, or run with --slots)"}
         try:
             max_new = int(body.get("max_new_tokens", self.default_max_new))
             temperature = float(body.get("temperature", 0.0))
@@ -118,8 +120,7 @@ class LLMServer:
         if any(t < 0 or t >= self.cfg.vocab for t in flat):
             return 400, {"Error": f"token id out of range [0, "
                                   f"{self.cfg.vocab})"}
-        prompt = jnp.asarray(tokens, dtype=jnp.int32)
-        if prompt.shape[1] + max_new > self.cfg.max_seq:
+        if max(len(row) for row in tokens) + max_new > self.cfg.max_seq:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
         if self._service is not None:
@@ -143,6 +144,7 @@ class LLMServer:
             return 200, self._result(rows, text_mode)
 
         key = jax.random.PRNGKey(seed)
+        prompt = jnp.asarray(tokens, dtype=jnp.int32)
         with self._gen_lock:
             out = generate(self.params, self.cfg, prompt,
                            max_new_tokens=max_new,
