@@ -1,0 +1,58 @@
+"""__graft_entry__ must self-defend against a hostile jax platform pin.
+
+Round-1 regression: the driver runs ``dryrun_multichip`` in a process whose
+sitecustomize pins ``JAX_PLATFORMS`` to a remote-TPU backend; initialising
+that backend dials a tunnel that stalls for minutes when dead (rc=124 in
+MULTICHIP_r01.json).  ``_force_cpu_mesh`` must flip the live jax config to
+an n-device CPU mesh before any backend init, even though jax was already
+imported (the env-var value was captured into config at import time).
+
+The subprocess here simulates the hostile pin with ``JAX_PLATFORMS=axon``
+but WITHOUT ``PALLAS_AXON_POOL_IPS`` — the axon plugin is never registered,
+so a broken defense fails fast ("unknown backend") instead of dialing.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT = """
+import os, jax  # import BEFORE the defense runs, like sitecustomize does
+assert jax.config.jax_platforms == "axon", jax.config.jax_platforms
+import __graft_entry__ as g
+g._force_cpu_mesh(4)
+devs = jax.devices()
+assert devs[0].platform == "cpu", devs
+assert len(devs) >= 4, devs
+print("DEFENDED", len(devs))
+"""
+
+
+def test_force_cpu_mesh_overrides_hostile_platform_pin():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("TPUSHARE_DRYRUN_REAL_DEVICES", None)
+    # Deliberate exception to the "subprocess tests force JAX_PLATFORMS=cpu"
+    # convention: the hostile pin IS the subject under test, and with
+    # POOL_IPS unset the axon plugin never registers, so nothing can dial.
+    env["JAX_PLATFORMS"] = "axon"
+    out = subprocess.run(
+        [sys.executable, "-c", SCRIPT],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DEFENDED" in out.stdout, out.stdout
+
+
+def test_force_cpu_mesh_tolerates_initialized_backend(monkeypatch):
+    # In-process: conftest already initialised the 8-device cpu backend;
+    # the defense must accept it rather than try to reconfigure.
+    monkeypatch.delenv("TPUSHARE_DRYRUN_REAL_DEVICES", raising=False)
+    import __graft_entry__ as g
+    import jax
+
+    jax.devices()  # ensure initialised
+    g._force_cpu_mesh(8)
+    assert len(jax.devices()) >= 8
