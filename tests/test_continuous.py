@@ -166,3 +166,27 @@ def test_scalar_cache_len_paths_unchanged(model):
     lp, _ = transformer.forward(params, prompt, cfg, kv_caches=caches,
                                 cache_len=0)
     np.testing.assert_allclose(np.asarray(lp), np.asarray(full), atol=2e-4)
+
+
+def test_slots_multirow_sampling_rows_draw_independently(model):
+    """Identical prompts in ONE multi-row sampling request must sample
+    independently (per-row derived seed), matching the batch path where a
+    single key yields independent per-row draws."""
+    import json
+    import urllib.request
+
+    from tpushare.serving.llm import LLMServer
+
+    params, cfg = model
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1", n_slots=2).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"tokens": [[3, 5, 7], [3, 5, 7]],
+                             "max_new_tokens": 12, "temperature": 1.0,
+                             "seed": 42}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["tokens"][0] != out["tokens"][1]
+    finally:
+        srv.stop()

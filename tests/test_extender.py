@@ -198,3 +198,18 @@ def test_extender_to_plugin_handshake(api, extender, tmp_path):
         ch.close()
     finally:
         plugin.stop()
+
+
+def test_node_score_excludes_pending_bucket():
+    """Pods with a missing/malformed chip annotation (pending bucket) must
+    not inflate the binpack priority score — fit decisions already
+    exclude them."""
+    node = make_node(tpu_mem=32, tpu_count=1)
+    placed = make_pod("placed", tpu_mem=8, chip_idx=0, assume_time=1,
+                      assigned="true", phase="Running")
+    # assumed but no chip index -> pending bucket
+    pending = make_pod("pending", tpu_mem=16, assume_time=2,
+                       assigned="false")
+    with_pending = policy.node_score(node, [placed, pending], 8)
+    without = policy.node_score(node, [placed], 8)
+    assert with_pending == without == 5  # (8 used + 8 request) / 32 -> 5

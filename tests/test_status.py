@@ -53,3 +53,34 @@ def test_status_404():
             assert e.code == 404
     finally:
         srv.stop()
+
+
+def test_handler_exceptions_are_500_by_default_200_for_extender():
+    """A crashing handler must read as failure to status-code-checking
+    clients; only the scheduler-extender webhook wants in-band-on-200."""
+    import json
+    import urllib.error
+
+    from tpushare.utils.httpserver import JsonHTTPServer
+
+    def boom(_):
+        raise RuntimeError("kaput")
+
+    srv = JsonHTTPServer(0, "127.0.0.1", {("GET", "/x"): boom}).start()
+    try:
+        try:
+            _get(srv.port, "/x")
+            raise AssertionError("expected HTTP 500")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            assert "kaput" in json.loads(e.read())["Error"]
+    finally:
+        srv.stop()
+
+    inband = JsonHTTPServer(0, "127.0.0.1", {("GET", "/x"): boom},
+                            inband_errors=True).start()
+    try:
+        code, body = _get(inband.port, "/x")
+        assert code == 200 and "kaput" in json.loads(body)["Error"]
+    finally:
+        inband.stop()

@@ -74,9 +74,13 @@ def pick_chip(node: dict, pods: List[dict], request_units: int
 def node_score(node: dict, pods: List[dict], request_units: int) -> int:
     """0-10 priority: prefer nodes that end up most utilized (binpack)."""
     info = build_node_state(node, pods)
-    fits = [c for c in chip_free_hbm(info).values()
-            if c.free >= request_units]
+    chips = chip_free_hbm(info)
+    fits = [c for c in chips.values() if c.free >= request_units]
     if not fits or info.total_mem <= 0:
         return 0
-    used_after = info.used_mem + request_units
+    # Sum usage over real chips only: the pending bucket (pods with
+    # malformed/missing chip annotations) must not inflate the score,
+    # mirroring how fit decisions already exclude it.
+    used = sum(c.total - c.free for c in chips.values())
+    used_after = used + request_units
     return max(1, min(10, int(10.0 * used_after / info.total_mem)))

@@ -54,7 +54,7 @@ class ExtenderServer:
             ("POST", "/priorities"): lambda b: (200, self.priorities(b or {})),
             ("POST", "/bind"): lambda b: (200, self.bind(b or {})),
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
-        }, auth_token=auth_token)
+        }, auth_token=auth_token, inband_errors=True)
         self.port = self._http.port
 
     # ------------------------------------------------------------------

@@ -81,6 +81,12 @@ class KubeClient:
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         token_path = os.path.join(SA_DIR, "token")
         ca = os.path.join(SA_DIR, "ca.crt")
+        if not os.path.exists(ca):
+            # A malformed in-cluster mount must not silently downgrade
+            # apiserver connections to unverified TLS.
+            log.warning(
+                "in-cluster CA bundle %s missing; apiserver TLS will NOT "
+                "be verified — fix the serviceaccount volume mount", ca)
         return cls(f"https://{host}:{port}",
                    token_path=token_path if os.path.exists(token_path) else None,
                    ca_file=ca if os.path.exists(ca) else None,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -27,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer
+
+log = logging.getLogger("tpushare.serving")
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"),
@@ -210,13 +213,30 @@ class ContinuousService:
         self._work.set()
         self._thread.join(timeout=10)
         # Sentinel BOTH queued and in-flight requests — a stranded sink
-        # would block its client until its own timeout.
+        # would block its client until its own timeout. put_nowait only:
+        # blocking on a full maxsize-1 sink could deadlock stop().
         with self._lock:
             waiting, self._waiting = self._waiting, []
         for *_, sink in waiting:
-            sink.put(None)
+            try:
+                sink.put_nowait(None)
+            except self._q.Full:
+                pass
+        if self._thread.is_alive():
+            # Worker outlived the join (e.g. stuck in a long XLA compile
+            # inside tick). _sinks is loop-owned — mutating it here would
+            # race the still-running loop; leave in-flight requests to
+            # their own client timeouts.
+            log.warning(
+                "continuous-service worker did not exit within 10s; "
+                "leaving %d in-flight sink(s) to client timeouts",
+                len(self._sinks))
+            return
         for sink in self._sinks.values():
-            sink.put(None)
+            try:
+                sink.put_nowait(None)
+            except self._q.Full:
+                pass
         self._sinks.clear()
 
     def submit(self, prompt: List[int], max_new_tokens: int,

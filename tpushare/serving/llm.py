@@ -126,9 +126,13 @@ class LLMServer:
         if self._service is not None:
             # greedy and sampling both ride the slot pool (per-slot
             # temperature/keys) — no second KV cache beside the pool
+            # Derive a per-row seed: identical prompts in one request must
+            # sample independently, matching the batch path where one key
+            # yields independent per-row draws.
             sinks = [self._service.submit([int(t) for t in row], max_new,
-                                          temperature=temperature, seed=seed)
-                     for row in tokens]
+                                          temperature=temperature,
+                                          seed=seed + i)
+                     for i, row in enumerate(tokens)]
             import queue as _q
 
             try:

@@ -19,7 +19,13 @@ class JsonHTTPServer:
 
     def __init__(self, port: int, addr: str,
                  routes: dict,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 inband_errors: bool = False):
+        # inband_errors: report handler exceptions as HTTP 200 with an
+        # {"Error": ...} body. That is the scheduler-extender webhook
+        # protocol (kube-scheduler reads the Error field and treats a
+        # non-200 as a transport failure); every other server wants a
+        # plain 500 so status-code-checking clients see the failure.
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -63,8 +69,9 @@ class JsonHTTPServer:
                         return
                 try:
                     code, payload = handler(body)
-                except Exception as e:  # surface in-band, keep serving
-                    code, payload = 200, {"Error": str(e)}
+                except Exception as e:  # keep serving either way
+                    code = 200 if outer.inband_errors else 500
+                    payload = {"Error": str(e)}
                 self._send(code, payload)
 
             def do_GET(self):
@@ -75,6 +82,7 @@ class JsonHTTPServer:
 
         self.routes = routes
         self.auth_token = auth_token
+        self.inband_errors = inband_errors
         self._server = ThreadingHTTPServer((addr, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever,
