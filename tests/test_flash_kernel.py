@@ -53,6 +53,32 @@ def test_flash_kernel_gqa_native(hkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("d", [64, 96])
+def test_flash_kernel_headdim_padding(causal, d):
+    """Lane-unaligned head dims (BERT-base's 64) are zero-padded to 128
+    inside the kernel wrapper; math must match the reference exactly."""
+    key = jax.random.PRNGKey(5)
+    q, k, v = (jax.random.normal(kk, (2, 3, 128, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    assert out.shape == q.shape
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_kernel_headdim64_gqa():
+    """BERT-ish head dim with GQA KV sharing through the padded path."""
+    key = jax.random.PRNGKey(6)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 4, 128, 64), jnp.float32)
+    k = jax.random.normal(kk, (1, 2, 128, 64), jnp.float32)
+    v = jax.random.normal(kv, (1, 2, 128, 64), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_kernel_bf16_io():
     key = jax.random.PRNGKey(3)
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.bfloat16)

@@ -105,6 +105,16 @@ def flash_attention(q, k, v, causal: bool = True,
     ``interpret=True`` runs the kernel through the Pallas interpreter —
     same kernel code, any backend — which is how the kernel math is
     unit-tested on CPU.
+
+    Head dims that are not a multiple of the 128-lane tile (BERT-base /
+    DistilBERT have D=64) are zero-padded to the next multiple before the
+    kernel and sliced after.  The math is unchanged: zero lanes add zero
+    to every QK^T dot product, and the padded V columns produce zeros
+    that the final slice drops.  On the MXU this padding is free FLOPs-
+    wise — a 64-deep contraction occupies the same 128x128 systolic pass
+    as a 128-deep one — but Q/K/V reads and the O write all pay the
+    padded width (2x HBM traffic at D=64), which the S^2-dominated
+    regime amortizes.  Softmax scale stays 1/sqrt(D_original).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -116,6 +126,12 @@ def flash_attention(q, k, v, causal: bool = True,
     block_q = min(block_q, s)
     block_k = min(block_k, sk)
     scale = 1.0 / np.sqrt(d)
+
+    d_orig = d
+    if d % 128 != 0:
+        d = -(-d // 128) * 128
+        pad = [(0, 0)] * 3 + [(0, d - d_orig)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
 
     qf = q.reshape(b * h, s, d)
     kf = k.reshape(b * hkv, sk, d)
@@ -140,7 +156,8 @@ def flash_attention(q, k, v, causal: bool = True,
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, s, d)
+    out = out.reshape(b, h, s, d)
+    return out[..., :d_orig] if d_orig != d else out
 
 
 def _on_tpu() -> bool:
@@ -157,10 +174,13 @@ def attention(q, k, v, causal: bool = True):
     kernel natively (no KV expansion in HBM), the reference by repeat.
     The flash kernel masks in global coordinates assuming seq_q == seq_k;
     cross-length causal attention (reference semantics: query i sees key
-    j <= i + (t - s)) must take the reference path.
+    j <= i + (t - s)) must take the reference path.  Head dims that are
+    not lane-aligned (64 for BERT-base/DistilBERT — the bench models) are
+    zero-padded to 128 inside ``flash_attention``; only tiny head dims
+    (< 32), where padding overhead dominates, fall back to the reference.
     """
     s, d = q.shape[2], q.shape[3]
-    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d % 128 == 0
+    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d >= 32
             and q.shape[1] % k.shape[1] == 0):
         return flash_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal)
