@@ -109,7 +109,7 @@ def test_e2e_jax_smoke_with_injected_env(api, plugin2):
                          chip_idx=0)]
     resp = _allocate(plugin2, 2)
     envs = dict(resp.container_responses[0].envs)
-    assert envs[const.ENV_XLA_MEM_FRACTION] == "0.06"  # 2/32 rounded down
+    assert envs[const.ENV_XLA_MEM_FRACTION] == "0.062500"  # 2/32 floored
 
     child_env = dict(os.environ)
     child_env.update(envs)
@@ -126,4 +126,4 @@ def test_e2e_jax_smoke_with_injected_env(api, plugin2):
          " os.environ['TPU_VISIBLE_CHIPS'])"],
         env=child_env, capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr
-    assert "SMOKE_OK 16384.0 0.06 0" in out.stdout
+    assert "SMOKE_OK 16384.0 0.062500 0" in out.stdout

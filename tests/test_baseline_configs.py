@@ -101,7 +101,7 @@ def test_config3_four_distilbert_pods_fractional_density(cluster, tmp_path):
     try:
         fracs = [float(kubelet_allocate(plugin, 4)[const.ENV_XLA_MEM_FRACTION])
                  for _ in range(4)]
-        assert all(f == 0.12 for f in fracs)  # floor(4/32*100)/100
+        assert all(f == 0.125 for f in fracs)  # exact 4/32
         assert sum(fracs) <= 1.0
     finally:
         plugin.stop()
@@ -124,7 +124,7 @@ def test_config4_whole_chip_llama_int8(cluster, tmp_path):
     try:
         envs = kubelet_allocate(plugin, 14)
         assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
-        assert float(envs[const.ENV_XLA_MEM_FRACTION]) == 0.87  # 14/16
+        assert float(envs[const.ENV_XLA_MEM_FRACTION]) == 0.875  # 14/16
         # second large pod cannot fit the remaining 2 GiB
         api.pods.append(make_pod("second", node="", tpu_mem=8,
                                  phase="Pending"))

@@ -192,12 +192,80 @@ def test_extender_to_plugin_handshake(api, extender, tmp_path):
                 devicesIDs=[fid for fid, _ in plugin.devices[:8]])]))
         envs = dict(resp.container_responses[0].envs)
         assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"  # extender's choice
-        assert envs[const.ENV_XLA_MEM_FRACTION] == "0.25"
+        assert envs[const.ENV_XLA_MEM_FRACTION] == "0.250000"
         assert api.pods[1]["metadata"]["annotations"][
             const.ANN_TPU_MEM_ASSIGNED] == "true"
         ch.close()
     finally:
         plugin.stop()
+
+
+def test_filter_scale_one_list_and_cached_cycle(api):
+    """O(100) nodes: a filter call costs ONE cluster pod list regardless of
+    node count, the filter+priorities pair of a scheduling cycle shares
+    the cached list, and bind re-lists fresh."""
+    import time as _time
+
+    # Long TTL: the assertions below are about list COUNTS, not timing —
+    # the default 1s TTL could expire between calls on a slow machine.
+    srv = ExtenderServer(KubeClient(api.url), port=0,
+                         pod_cache_ttl=300.0).start()
+    try:
+        n_nodes = 100
+        for i in range(n_nodes):
+            api.nodes[f"n{i}"] = make_node(f"n{i}", tpu_mem=32, tpu_count=1)
+        api.pods = [make_pod(f"p{i}", node=f"n{i % n_nodes}", tpu_mem=8,
+                             chip_idx=0, assume_time=i + 1, assigned="true",
+                             phase="Running") for i in range(200)]
+
+        def pod_lists():
+            return sum(1 for r in api.requests if r == "GET /api/v1/pods")
+
+        before = pod_lists()
+        t0 = _time.perf_counter()
+        result = _post(srv, "/filter", {
+            "Pod": make_pod("new", node="", tpu_mem=8),
+            "NodeNames": [f"n{i}" for i in range(n_nodes)],
+        })
+        filter_s = _time.perf_counter() - t0
+        assert len(result["NodeNames"]) == n_nodes
+        assert pod_lists() == before + 1          # one list for 100 nodes
+        assert filter_s < 5.0                     # latency sanity
+
+        _post(srv, "/priorities", {
+            "Pod": make_pod("new", node="", tpu_mem=8),
+            "NodeNames": [f"n{i}" for i in range(n_nodes)],
+        })
+        assert pod_lists() == before + 1          # served from cache
+
+        _post(srv, "/bind", {"PodName": "p0", "PodNamespace": "default",
+                             "Node": "n0"})
+        assert pod_lists() == before + 2          # bind always re-lists
+    finally:
+        srv.stop()
+
+
+def test_bind_sees_prior_bind_within_ttl(api):
+    """Two back-to-back binds: the second must observe the first's
+    annotations even though the TTL cache would still be warm."""
+    srv = ExtenderServer(KubeClient(api.url), port=0,
+                         pod_cache_ttl=60.0).start()
+    try:
+        api.nodes["n"] = make_node("n", tpu_mem=64, tpu_count=2)
+        a = make_pod("a", node="", tpu_mem=30)
+        b = make_pod("b", node="", tpu_mem=30)
+        api.pods = [a, b]
+        # warm the cache with the pre-bind state
+        _post(srv, "/filter", {"Pod": a, "NodeNames": ["n"]})
+        assert _post(srv, "/bind", {"PodName": "a", "PodNamespace": "default",
+                                    "Node": "n"})["Error"] == ""
+        assert _post(srv, "/bind", {"PodName": "b", "PodNamespace": "default",
+                                    "Node": "n"})["Error"] == ""
+        idx_a = a["metadata"]["annotations"][const.ANN_TPU_MEM_IDX]
+        idx_b = b["metadata"]["annotations"][const.ANN_TPU_MEM_IDX]
+        assert {idx_a, idx_b} == {"0", "1"}   # disjoint chips, no overcommit
+    finally:
+        srv.stop()
 
 
 def test_node_score_excludes_pending_bucket():

@@ -37,7 +37,7 @@ def test_mib_unit_allocation_end_to_end(tmp_path):
                 container_requests=[pb.ContainerAllocateRequest(
                     devicesIDs=[f for f, _ in plugin.devices[:512]])]))
             envs = dict(resp.container_responses[0].envs)
-            assert envs[const.ENV_XLA_MEM_FRACTION] == "0.25"  # 512/2048
+            assert envs[const.ENV_XLA_MEM_FRACTION] == "0.250000"  # 512/2048
             assert envs[const.ENV_TPU_MEM_DEV] == "2048"
             ch.close()
         finally:

@@ -53,6 +53,46 @@ def test_binpack_never_overcommits(sizes, request):
         assert used + request <= info.devs[fit.chip_index].total_mem
 
 
+@given(
+    # chip capacity in units: GiB chips are 8..96; MiB chips up to ~96 GiB.
+    # The >1e6 tail exercises the 12-decimal re-floor branch, where a
+    # 6-decimal floor of a sub-1e-6 share would hit zero.
+    chip_units=st.one_of(st.integers(8, 98_304),
+                         st.integers(1_000_001, 10_000_000)),
+    # a feasible binpack: grants are drawn then truncated to fit the chip
+    grants=st.lists(st.integers(1, 4096), min_size=1, max_size=120),
+)
+@settings(max_examples=200, deadline=None)
+def test_cotenant_fractions_never_oversubscribe(chip_units, grants):
+    """For ANY feasible binpack (sum of grants <= chip HBM), the emitted
+    XLA_PYTHON_CLIENT_MEM_FRACTION values must sum to <= 1.0 — the
+    invariant advisory HBM isolation rests on.  Regression: the old 0.01
+    floor let ~101 sub-1% MiB-unit pods sum past 1.0."""
+    from tpushare.plugin import allocate
+
+    feasible, total = [], 0
+    for g in grants:
+        g = min(g, chip_units - total)
+        if g <= 0:
+            break
+        feasible.append(g)
+        total += g
+
+    class _Plugin:
+        memory_unit = "MiB"
+
+    chip = discovery.Chip(index=0, id="c0", dev_paths=(),
+                          hbm_bytes=chip_units * (1 << 20), cores=1)
+    fracs = []
+    for g in feasible:
+        resp = allocate.container_response(_Plugin(), chip, g, g)
+        frac = float(resp.envs[const.ENV_XLA_MEM_FRACTION])
+        assert frac > 0.0, (g, chip_units)
+        assert frac <= g / chip_units + 1e-12, (g, chip_units, frac)
+        fracs.append(frac)
+    assert sum(fracs) <= 1.0 + 1e-9, (chip_units, feasible, sum(fracs))
+
+
 @given(st.integers(0, 2**32 - 1))
 @settings(max_examples=10, deadline=None)
 def test_quantization_error_bounded(seed):

@@ -91,7 +91,7 @@ def test_allocate_single_chip_fast_path(plugin_v4):
     assert cr.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
     assert cr.envs[const.ENV_TPU_MEM_CONTAINER] == "8"
     assert cr.envs[const.ENV_TPU_MEM_DEV] == "32"
-    assert cr.envs[const.ENV_XLA_MEM_FRACTION] == "0.25"
+    assert cr.envs[const.ENV_XLA_MEM_FRACTION] == "0.250000"
     assert [d.host_path for d in cr.devices] == ["/dev/accel0"]
     assert all(d.permissions == "rwm" for d in cr.devices)
     ch.close()

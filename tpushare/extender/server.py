@@ -18,7 +18,14 @@ network policy; the bind verb is scheduler-level write access.
 
 Efficiency: one pod list per webhook call, grouped by node locally —
 not one list per candidate node (a 100-node filter would otherwise fan
-out 100 field-selector list requests per scheduled pod).
+out 100 field-selector list requests per scheduled pod).  On top of
+that, read-only calls (filter/priorities) share a short-TTL cache of
+the grouped list, so the filter+priorities pair of one scheduling cycle
+costs ONE apiserver list.  ``bind`` — the only write — always re-lists
+under its lock and invalidates the cache after stamping annotations, so
+placement decisions never act on stale state; a stale read can only
+cause filter to pass a node that bind later rejects (the scheduler
+retries), never an overcommit.
 """
 
 from __future__ import annotations
@@ -42,9 +49,18 @@ class ExtenderServer:
     def __init__(self, kube: KubeClient, port: int = 39999,
                  addr: str = "0.0.0.0",
                  resource_name: str = const.RESOURCE_NAME,
-                 auth_token: str = None):
+                 auth_token: str = None,
+                 pod_cache_ttl: float = 1.0):
         self.kube = kube
         self.resource_name = resource_name
+        self.pod_cache_ttl = pod_cache_ttl
+        self._cache_lock = threading.Lock()
+        self._cached_pods: Dict[str, List[dict]] = None
+        self._cache_stamp = 0.0
+        # Bumped by every invalidation; a lister only stores its result if
+        # no invalidation happened while its list was in flight, so a bind
+        # can never be papered over by a concurrent stale read.
+        self._cache_gen = 0
         # Serialize binds: two concurrent binds could both observe the
         # same free chip and overcommit it; after each bind the written
         # assume annotations make the next bind see the updated state.
@@ -61,13 +77,35 @@ class ExtenderServer:
     def _request_units(self, pod: dict) -> int:
         return podutils.pod_requested_units(pod, self.resource_name)
 
-    def _pods_by_node(self) -> Dict[str, List[dict]]:
+    def _pods_by_node(self, fresh: bool = False) -> Dict[str, List[dict]]:
+        """Cluster pods grouped by node.
+
+        ``fresh=True`` (bind path) bypasses and refills the cache;
+        read-only callers accept a list up to ``pod_cache_ttl`` old.
+        """
+        now = time.monotonic()
+        with self._cache_lock:
+            if (not fresh and self._cached_pods is not None
+                    and now - self._cache_stamp < self.pod_cache_ttl):
+                return self._cached_pods
+            gen = self._cache_gen
         by_node: Dict[str, List[dict]] = defaultdict(list)
         for p in self.kube.list_pods():
             node = p.get("spec", {}).get("nodeName")
             if node:
                 by_node[node].append(p)
+        with self._cache_lock:
+            if self._cache_gen == gen:  # no invalidation while in flight
+                # plain dict: a shared defaultdict would let any future
+                # by_node[name] lookup mutate cross-request cached state
+                self._cached_pods = dict(by_node)
+                self._cache_stamp = time.monotonic()
         return by_node
+
+    def _invalidate_pod_cache(self) -> None:
+        with self._cache_lock:
+            self._cached_pods = None
+            self._cache_gen += 1
 
     def _nodes_from_args(self, args: dict) -> List[dict]:
         nodes = (args.get("Nodes") or {}).get("Items") \
@@ -141,7 +179,7 @@ class ExtenderServer:
         if req > 0:
             node = self.kube.get_node(node_name)
             fit = policy.pick_chip(
-                node, self._pods_by_node().get(node_name, []), req)
+                node, self._pods_by_node(fresh=True).get(node_name, []), req)
             if fit is None:
                 return {"Error": f"no chip on {node_name} fits {req} "
                                  f"{self.resource_name}"}
@@ -156,6 +194,9 @@ class ExtenderServer:
                     {"0": {str(fit.chip_index): req}}),
             }
             self.kube.patch_pod_annotations(ns, name, annotations)
+            # The write just changed placement state; readers must not
+            # keep serving the pre-bind snapshot for up to a TTL.
+            self._invalidate_pod_cache()
 
         try:
             self.kube.bind_pod(ns, name, node_name, uid=args.get("PodUID"))
@@ -164,6 +205,8 @@ class ExtenderServer:
                 # Roll the assumption back so capacity is not leaked.
                 self.kube.patch_pod_annotations(
                     ns, name, {const.ANN_TPU_MEM_ASSIGNED: "rollback"})
+                # The rollback released capacity; readers must see it.
+                self._invalidate_pod_cache()
             return {"Error": f"binding failed: {e}"}
         if req > 0:
             log.info("bound %s/%s -> %s chip %s (%d units)",
@@ -198,6 +241,9 @@ def main(argv=None) -> int:
                     help="require 'Authorization: Bearer <token>' matching "
                          "this file's contents")
     ap.add_argument("--resource-name", default=const.RESOURCE_NAME)
+    ap.add_argument("--pod-cache-ttl", type=float, default=1.0,
+                    help="seconds filter/priorities may serve a cached pod "
+                         "list; bind always re-lists (0 disables caching)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     token = None
@@ -206,7 +252,7 @@ def main(argv=None) -> int:
             token = f.read().strip()
     srv = ExtenderServer(KubeClient.from_env(), port=args.port,
                          addr=args.addr, resource_name=args.resource_name,
-                         auth_token=token)
+                         auth_token=token, pod_cache_ttl=args.pod_cache_ttl)
     log.info("extender listening on %s:%d", args.addr, srv.port)
     srv.serve_forever()
     return 0

@@ -50,15 +50,23 @@ def container_response(plugin, chip: Chip, container_units: int,
     """Build one container's allocation: env contract + devices + mounts."""
     chip_units = mem_units_per_chip(chip, plugin.memory_unit)
     # HBM budget: fraction of this chip's HBM this container may use.
-    # JAX reads XLA_PYTHON_CLIENT_MEM_FRACTION at process start; rounding
-    # down 2 decimals keeps co-located fractions summing <= 1.0.
-    frac = max(0.01, int(container_units / max(chip_units, 1) * 100) / 100.0)
+    # JAX reads XLA_PYTHON_CLIENT_MEM_FRACTION at process start.  The
+    # fraction is floored (6 decimals) and NEVER clamped upward: flooring
+    # can only shrink a tenant's share, so any feasible binpack
+    # (sum of grants <= chip HBM) yields fractions summing <= 1.0 — the
+    # invariant co-tenancy depends on.  The old 0.01 floor broke it with
+    # MiB units: ~101 sub-1% pods could sum past 1.0.  A grant so small
+    # it floors to zero at 6 decimals (chip_units > 1e6) re-floors at 12
+    # decimals — still a floor, so still never exceeds its true slice.
+    exact = container_units / max(chip_units, 1)
+    frac = int(exact * 1e6) / 1e6
+    frac_str = f"{frac:.6f}" if frac > 0.0 else f"{int(exact * 1e12) / 1e12:.12f}"
 
     envs = {
         const.ENV_TPU_VISIBLE_CHIPS: str(chip.index),
         const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS: "1,1,1",
         const.ENV_TPU_PROCESS_BOUNDS: "1,1,1",
-        const.ENV_XLA_MEM_FRACTION: f"{frac:.2f}",
+        const.ENV_XLA_MEM_FRACTION: frac_str,
         const.ENV_TPU_MEM_IDX: str(chip.index),
         const.ENV_TPU_MEM_POD: str(pod_units),
         const.ENV_TPU_MEM_CONTAINER: str(container_units),
