@@ -130,22 +130,45 @@ def _expand_kv(k, n_rep: int):
     return jnp.repeat(k, n_rep, axis=1)  # [B, Hkv, S, D] -> [B, H, S, D]
 
 
-def attention_block(p, x, cfg: ModelConfig, positions,
-                    kv_cache: Optional[Tuple] = None,
-                    cache_len: Optional[jnp.ndarray] = None,
-                    attention_fn=None):
-    b, s, d = x.shape
+def _qkv(p, x, cfg: ModelConfig, positions):
+    """Project + RoPE: x [B,S,d] -> q [B,H,S,D], k/v [B,Hkv,S,D]."""
+    b, s, _ = x.shape
     h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
     q = _mm(x, p["wq"]).reshape(b, s, h, hd)
     k = _mm(x, p["wk"]).reshape(b, s, hkv, hd)
     v = _mm(x, p["wv"]).reshape(b, s, hkv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3))
 
-    q = q.transpose(0, 2, 1, 3)                 # [B, H, S, D]
-    k = k.transpose(0, 2, 1, 3)
-    v = v.transpose(0, 2, 1, 3)
+
+def cached_attention(q, kk, vv, positions):
+    """Masked attention of q over a dense cache view (heads expanded).
+
+    The ONE copy of the decode-attention math: positions mask both
+    causality and the unwritten/garbage tail, softmax accumulates f32.
+    Dense and paged cache paths must both route here so their outputs
+    stay bit-identical.
+    """
+    hd = q.shape[-1]
+    t = kk.shape[2]
+    q_pos = positions[:, None, :, None]                      # [B,1,S,1]
+    k_pos = jnp.arange(t)[None, None, None, :]               # [1,1,1,T]
+    valid = k_pos <= q_pos                                   # causal+len
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, kk) / np.sqrt(hd)
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(vv.dtype), vv)
+
+
+def attention_block(p, x, cfg: ModelConfig, positions,
+                    kv_cache: Optional[Tuple] = None,
+                    cache_len: Optional[jnp.ndarray] = None,
+                    attention_fn=None):
+    b, s, d = x.shape
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    q, k, v = _qkv(p, x, cfg, positions)
 
     new_cache = None
     if kv_cache is not None:
@@ -163,17 +186,8 @@ def attention_block(p, x, cfg: ModelConfig, positions,
             cv = upd(cv, v, cache_len)
         new_cache = (ck, cv)
         # decode: attend over the filled prefix; positions mask the rest
-        kk = _expand_kv(ck, h // hkv)
-        vv = _expand_kv(cv, h // hkv)
-        t = ck.shape[2]
-        q_pos = positions[:, None, :, None]                      # [B,1,S,1]
-        k_pos = jnp.arange(t)[None, None, None, :]               # [1,1,1,T]
-        valid = k_pos <= q_pos                                   # causal+len
-        scale = 1.0 / np.sqrt(hd)
-        logits = jnp.einsum("bhsd,bhtd->bhst", q, kk) * scale
-        logits = jnp.where(valid, logits, -1e30)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        o = jnp.einsum("bhst,bhtd->bhsd", probs.astype(vv.dtype), vv)
+        o = cached_attention(q, _expand_kv(ck, h // hkv),
+                             _expand_kv(cv, h // hkv), positions)
     elif attention_fn is not None:
         # custom impls (ring/ulysses) expect equal head counts
         o = attention_fn(q, _expand_kv(k, h // hkv),
@@ -298,3 +312,124 @@ def init_kv_caches(cfg: ModelConfig, batch: int):
     """Stacked KV cache: a (k, v) pair of [L, B, Hkv, max_seq, D] buffers."""
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, cfg.max_seq, cfg.head_dim)
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache (block-pooled serving storage)
+# ---------------------------------------------------------------------------
+def init_paged_kv(cfg: ModelConfig, n_pages: int, page_size: int):
+    """Paged KV pool: a (k, v) pair of [L, n_pages, Hkv, page, D] buffers.
+
+    Persistent serving storage is a pool of fixed-size pages instead of a
+    dense [B, max_seq] row per slot; a host-managed page table maps each
+    slot's logical positions onto pool pages, so HBM holds only the pages
+    sequences actually reserve.  Page 0 is the TRASH page by convention:
+    unowned table entries and inactive slots point at it, their writes
+    land there, and the position mask keeps its garbage out of every
+    softmax — so the math is bit-identical to the dense cache path.
+    """
+    shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
+    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+def _paged_gather(pool, page_table):
+    """pool [n_pages, Hkv, P, D] + table [B, pages] -> [B, Hkv, pages*P, D].
+
+    The gather materializes a dense per-layer view TRANSIENTLY (inside the
+    layer scan, freed after the layer) — attention reads the whole KV
+    anyway, so HBM traffic matches the dense path; only the persistent
+    pool shrinks.
+    """
+    g = pool[page_table]                        # [B, pages, Hkv, P, D]
+    b, npg, hkv, p, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, npg * p, d)
+
+
+def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
+                         page_table, lengths):
+    """One decode step for every slot against the paged pool.
+
+    tokens [B, 1]; pools from :func:`init_paged_kv`; page_table
+    [B, max_seq//page] int32 (logical page order, 0-padded); lengths [B].
+    Returns (logits [B, 1, vocab], updated pools).  Same math as the
+    dense ``forward(..., cache_len=lengths)`` tick — garbage positions
+    (trash page, beyond-length lanes) are masked exactly like the dense
+    cache's unwritten tail.
+    """
+    b, s = tokens.shape
+    positions = lengths[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    kp, vp = pools
+    page = kp.shape[3]
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    # Each slot appends at logical position `length`: page length//P,
+    # lane length%P.  Distinct active slots own distinct pages, so the
+    # scatter never collides (inactive slots all hit the trash page).
+    page_ids = jnp.take_along_axis(
+        page_table, (lengths // page)[:, None], axis=1)[:, 0]
+    offsets = lengths % page
+
+    def body(x, layer_and_pool):
+        layer, kpool, vpool = layer_and_pool
+        xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
+        q, k, v = _qkv(layer, xin, cfg, positions)
+        kpool = kpool.at[page_ids, :, offsets, :].set(k[:, :, 0, :])
+        vpool = vpool.at[page_ids, :, offsets, :].set(v[:, :, 0, :])
+        o = cached_attention(
+            q, _expand_kv(_paged_gather(kpool, page_table), h // hkv),
+            _expand_kv(_paged_gather(vpool, page_table), h // hkv),
+            positions)
+        x = x + _mm(o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model),
+                    layer["wo"])
+        x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+        return x, (kpool, vpool)
+
+    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    return logits, (new_kp, new_vp)
+
+
+def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
+                          page_rows, prompt_len: int):
+    """Prefill ONE request into its reserved pages.
+
+    tokens [1, prompt_len]; page_rows [max_seq//page] int32 — this slot's
+    page-table row (logical order, 0-padded past the reservation).
+    Attention over the prompt needs no cache (plain causal self-attn via
+    the dispatching :func:`tpushare.ops.attention.attention`); the
+    computed K/V stream into the pool pages chunk by chunk.  Returns
+    (last-position logits [1, vocab], updated pools).
+    """
+    b, s = tokens.shape
+    if b != 1:
+        raise ValueError("paged prefill is per-request (batch 1)")
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    kp, vp = pools
+    page = kp.shape[3]
+    n_chunks = -(-prompt_len // page)           # static
+
+    def body(x, layer_and_pool):
+        layer, kpool, vpool = layer_and_pool
+        xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
+        q, k, v = _qkv(layer, xin, cfg, positions)   # k/v [1, Hkv, S, D]
+        for j in range(n_chunks):               # static page walk
+            cl = min(page, s - j * page)
+            # chunk [1, Hkv, cl, D] already matches pool rank/layout
+            kpool = jax.lax.dynamic_update_slice(
+                kpool, k[:, :, j * page:j * page + cl, :],
+                (page_rows[j], 0, 0, 0))
+            vpool = jax.lax.dynamic_update_slice(
+                vpool, v[:, :, j * page:j * page + cl, :],
+                (page_rows[j], 0, 0, 0))
+        o = attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = x + _mm(o, layer["wo"])
+        x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+        return x, (kpool, vpool)
+
+    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
+    return logits, (new_kp, new_vp)

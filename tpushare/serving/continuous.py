@@ -52,24 +52,28 @@ def _prefill_slot(params, tokens, caches, slot, cfg, prompt_len: int):
     return logits[:, -1], caches
 
 
+def _sample_next(logits, temps, keys):
+    """Per-slot next token: argmax where temps[i]==0, else categorical
+    from softmax(logits/temps[i]) with slot i's own key.  Shared by the
+    dense and paged ticks so greedy/sampling semantics cannot drift."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(keys, logits / safe_t)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _tick(params, tokens, caches, lengths, temps, keys, cfg):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
-    Per-slot sampling: slot i draws from softmax(logits/temps[i]) with
-    its own key, or argmax where temps[i] == 0 — greedy and sampling
+    Per-slot sampling via :func:`_sample_next` — greedy and sampling
     requests share one tick.  The pooled cache is donated: XLA updates
     it in place instead of holding two full copies across the hot loop.
     """
     logits, caches = transformer.forward(
         params, tokens, cfg, kv_caches=caches, cache_len=lengths)
-    logits = logits[:, 0]                                  # [B, V]
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
-    sampled = jax.vmap(
-        lambda k, l: jax.random.categorical(k, l))(keys, logits / safe_t)
-    nxt = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
-    return nxt, caches
+    return _sample_next(logits[:, 0], temps, keys), caches
 
 
 @dataclasses.dataclass
@@ -84,20 +88,64 @@ class _Slot:
 
 
 class ContinuousBatcher:
-    """Synchronous-core continuous batcher (drive ``admit``/``tick``)."""
+    """Synchronous-core continuous batcher (drive ``admit``/``tick``).
+
+    Storage is pluggable via four hooks (``_init_storage``, ``_reserve``/
+    ``_release``, ``_prefill_into``, ``_step``); the admission protocol,
+    per-slot sampling bookkeeping, and completion logic live here ONCE.
+    :class:`~tpushare.serving.paged.PagedContinuousBatcher` overrides
+    only the hooks to swap dense rows for a paged pool.
+    """
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int):
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
-        self.caches = transformer.init_kv_caches(cfg, batch=n_slots)
         self.slots: Dict[int, _Slot] = {}      # slot index -> live request
         self._next_id = 0
         self.completed: Dict[int, List[int]] = {}
+        self._init_storage()
+
+    # -- storage hooks -------------------------------------------------
+    def _init_storage(self) -> None:
+        self.caches = transformer.init_kv_caches(self.cfg, batch=self.n_slots)
+
+    def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
+        """Claim per-request storage; False = backpressure (no admit)."""
+        return True                     # dense rows are pre-reserved
+
+    def _release(self, slot: int) -> None:
+        """Return per-request storage on completion."""
+
+    def _prefill_into(self, slot: int, tokens, prompt_len: int):
+        logits, self.caches = _prefill_slot(
+            self.params, tokens, self.caches, slot, self.cfg, prompt_len)
+        return logits
+
+    def _step(self, tokens, lengths, temps, keys):
+        nxt, self.caches = _tick(
+            self.params, tokens, self.caches, lengths, temps, keys, self.cfg)
+        return nxt
 
     # ------------------------------------------------------------------
     def free_slots(self) -> List[int]:
         return [i for i in range(self.n_slots) if i not in self.slots]
+
+    def validate_request(self, prompt: List[int],
+                         max_new_tokens: int) -> None:
+        """Raise ValueError for a request this batcher can NEVER serve.
+
+        Admission's None return means "retry when capacity frees"; this
+        must reject everything a retry can't fix (subclasses extend with
+        their own hard capacity limits), or a front-end requeue loop
+        would head-of-line-block forever on an impossible request.
+        """
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.cfg.max_seq:
+            raise ValueError("prompt+max_new exceeds max_seq")
 
     def admit(self, prompt: List[int], max_new_tokens: int,
               temperature: float = 0.0,
@@ -105,22 +153,18 @@ class ContinuousBatcher:
         """Prefill into a free slot; returns request id, or None when the
         pool is FULL (backpressure).  Invalid requests raise instead —
         None must stay unambiguous for retry loops."""
-        if not prompt:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.cfg.max_seq:
-            raise ValueError("prompt+max_new exceeds max_seq")
+        self.validate_request(prompt, max_new_tokens)
         free = self.free_slots()
         if not free:
             return None
         slot = free[0]
+        if not self._reserve(slot, len(prompt), max_new_tokens):
+            return None
         rid = self._next_id
         self._next_id += 1
 
         tokens = jnp.asarray([prompt], jnp.int32)
-        logits, self.caches = _prefill_slot(
-            self.params, tokens, self.caches, slot, self.cfg, len(prompt))
+        logits = self._prefill_into(slot, tokens, len(prompt))
         key = jax.random.PRNGKey(seed)
         if temperature > 0.0:
             key, sub = jax.random.split(key)
@@ -132,6 +176,7 @@ class ContinuousBatcher:
         output = list(prompt) + [first]
         if remaining == 0:
             self.completed[rid] = output
+            self._release(slot)
             return rid
         self.slots[slot] = _Slot(request_id=rid, length=len(prompt),
                                  remaining=remaining, last_token=first,
@@ -154,11 +199,9 @@ class ContinuousBatcher:
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
-        nxt, self.caches = _tick(
-            self.params, jnp.asarray(tokens), self.caches,
-            jnp.asarray(lengths), jnp.asarray(temps),
-            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)), self.cfg)
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(self._step(
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
+            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys))))
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
@@ -168,6 +211,7 @@ class ContinuousBatcher:
             s.remaining -= 1
             if s.remaining <= 0:
                 self.completed[s.request_id] = s.output
+                self._release(i)
                 del self.slots[i]
         return n_active
 
@@ -187,12 +231,20 @@ class ContinuousService:
     freely (per-slot temperature/keys in the shared tick).
     """
 
-    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int):
+    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
+                 page_size: Optional[int] = None,
+                 n_pages: Optional[int] = None):
         import queue as _q
         import threading
 
         self._q = _q
-        self._batcher = ContinuousBatcher(params, cfg, n_slots)
+        if page_size is not None:
+            # paged KV storage: more in-flight sequences per HBM byte
+            from .paged import PagedContinuousBatcher
+            self._batcher = PagedContinuousBatcher(
+                params, cfg, n_slots, page_size=page_size, n_pages=n_pages)
+        else:
+            self._batcher = ContinuousBatcher(params, cfg, n_slots)
         # _lock guards ONLY the _waiting handoff; the batcher and _sinks
         # are owned by the loop thread, so decode ticks run without the
         # lock and submit() never waits on a model forward.
@@ -242,13 +294,9 @@ class ContinuousService:
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0):
         """Returns a queue that yields the full token list (or None on
-        shutdown). Raises ValueError for invalid requests."""
-        if not prompt:
-            raise ValueError("empty prompt")
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self._batcher.cfg.max_seq:
-            raise ValueError("prompt+max_new exceeds max_seq")
+        shutdown). Raises ValueError for invalid requests (including
+        ones the batcher's storage could never hold)."""
+        self._batcher.validate_request(prompt, max_new_tokens)
         sink = self._q.Queue(maxsize=1)
         with self._lock:
             self._waiting.append(
@@ -279,9 +327,18 @@ class ContinuousService:
                 with self._lock:
                     if not self._waiting:
                         break
-                    prompt, max_new, temp, seed, sink = self._waiting.pop(0)
+                    item = self._waiting.pop(0)
+                prompt, max_new, temp, seed, sink = item
                 rid = self._batcher.admit(prompt, max_new,
                                           temperature=temp, seed=seed)
+                if rid is None:
+                    # Backpressure beyond free slots (paged storage can
+                    # run out of pages with slots still free): requeue at
+                    # the FRONT and stop admitting until a tick releases
+                    # capacity — dropping here would strand the sink.
+                    with self._lock:
+                        self._waiting.insert(0, item)
+                    break
                 if rid in self._batcher.completed:  # single-token request
                     sink.put(self._batcher.completed.pop(rid))
                 else:

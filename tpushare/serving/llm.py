@@ -51,10 +51,13 @@ class LLMServer:
     def __init__(self, cfg, params, port: int = 8000,
                  addr: str = "0.0.0.0",
                  default_max_new: int = 32,
-                 n_slots: int = 0):
+                 n_slots: int = 0,
+                 page_size: int = 0,
+                 n_pages: int = 0):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
-        per-request path."""
+        per-request path.  ``page_size > 0`` stores the KV cache in a
+        paged pool (``n_pages`` pages, default dense-equivalent)."""
         from ..utils.httpserver import JsonHTTPServer
 
         self.cfg = cfg
@@ -66,7 +69,10 @@ class LLMServer:
         if n_slots > 0:
             from .continuous import ContinuousService
 
-            self._service = ContinuousService(params, cfg, n_slots).start()
+            self._service = ContinuousService(
+                params, cfg, n_slots,
+                page_size=page_size or None,
+                n_pages=n_pages or None).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -207,7 +213,17 @@ def main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=0,
                     help="continuous-batching slot count (0 = serialized "
                          "per-request decoding)")
+    ap.add_argument("--page-size", type=int, default=0,
+                    help="KV-cache page size in tokens (0 = dense per-slot "
+                         "cache); requires --slots")
+    ap.add_argument("--kv-pages", type=int, default=0,
+                    help="paged-KV pool size in pages (0 = dense-equivalent "
+                         "capacity); only with --page-size")
     args = ap.parse_args(argv)
+    if args.page_size and not args.slots:
+        ap.error("--page-size requires --slots")
+    if args.kv_pages and not args.page_size:
+        ap.error("--kv-pages requires --page-size")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -223,7 +239,8 @@ def main(argv=None) -> int:
 
     cfg, params = build_model(args.model, args.int8)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
-                    n_slots=args.slots)
+                    n_slots=args.slots, page_size=args.page_size,
+                    n_pages=args.kv_pages)
     log.info("llm server: model=%s int8=%s on :%d", args.model, args.int8,
              srv.port)
     srv.serve_forever()

@@ -1,0 +1,129 @@
+"""Paged-KV continuous batching: block-pooled cache storage for serving.
+
+The dense :class:`~tpushare.serving.continuous.ContinuousBatcher`
+reserves ``max_seq`` cache positions per slot, so HBM caps concurrency
+at ``pool_bytes / (max_seq row)`` even when requests are short.  Here
+the persistent cache is a pool of fixed-size pages
+(:func:`tpushare.models.transformer.init_paged_kv`) and each admission
+reserves only ``ceil((prompt+max_new)/page)`` pages — mixed-length
+traffic packs more in-flight sequences into the same HBM budget.
+
+Design notes (TPU-first):
+
+* all device shapes are static: pool [L, n_pages, Hkv, page, D], page
+  table [n_slots, max_seq/page].  Page allocation is host-side control
+  logic (a free list), touched only at admit/complete — never per tick;
+* reservation is worst-case at admit, so a slot can never starve for a
+  page mid-decode (no preemption machinery, the same "static shapes,
+  no surprises" rule the rest of the serving plane follows);
+* page 0 is the trash page: inactive slots and unowned table entries
+  write/read it, the position mask keeps it out of every softmax, and
+  the allocator never hands it out — decode math stays bit-identical to
+  the dense path (asserted in tests against ``generate()``).
+
+The batcher itself is the dense one with only the four storage hooks
+overridden — admission protocol, sampling, and completion bookkeeping
+are shared code, so the two paths cannot drift.
+
+Beyond-reference subsystem (the reference is cluster infrastructure
+only); the serving counterpart of its HBM binpacking idea applied inside
+one process: pages are to the KV pool what GiB fake-devices are to a
+chip (pkg/gpu/nvidia/nvidia.go:73-85 fan-out).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer
+from .continuous import ContinuousBatcher, _sample_next
+
+log = logging.getLogger("tpushare.serving")
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"),
+                   donate_argnums=(2,))
+def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int):
+    return transformer.forward_paged_prefill(
+        params, tokens, cfg, pools, page_rows, prompt_len)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _tick(params, tokens, pools, page_table, lengths, temps, keys, cfg):
+    """Paged twin of continuous._tick (same sampling helper)."""
+    logits, pools = transformer.forward_paged_decode(
+        params, tokens, cfg, pools, page_table, lengths)
+    return _sample_next(logits[:, 0], temps, keys), pools
+
+
+class PagedContinuousBatcher(ContinuousBatcher):
+    """Dense batcher with the storage hooks swapped for a paged pool."""
+
+    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
+                 page_size: int = 16, n_pages: Optional[int] = None):
+        if cfg.max_seq % page_size:
+            raise ValueError("max_seq must be a multiple of page_size")
+        self.page_size = page_size
+        self.pages_per_slot = cfg.max_seq // page_size
+        # Default pool: every slot can hold a full max_seq sequence (the
+        # dense equivalent + 1 trash page). Pass a smaller n_pages to
+        # overcommit slots against the real traffic mix — the point.
+        self.n_pages = (n_pages if n_pages is not None
+                        else n_slots * self.pages_per_slot + 1)
+        if self.n_pages < 2:
+            raise ValueError("need at least one non-trash page")
+        super().__init__(params, cfg, n_slots)
+
+    def validate_request(self, prompt: List[int],
+                         max_new_tokens: int) -> None:
+        super().validate_request(prompt, max_new_tokens)
+        need = -(-(len(prompt) + max_new_tokens) // self.page_size)
+        if need > self.n_pages - 1:     # page 0 is never allocatable
+            raise ValueError(
+                f"request needs {need} pages but the pool holds only "
+                f"{self.n_pages - 1} usable pages")
+
+    # -- storage hooks -------------------------------------------------
+    def _init_storage(self) -> None:
+        self.pools = transformer.init_paged_kv(
+            self.cfg, self.n_pages, self.page_size)
+        self.page_table = np.zeros(
+            (self.n_slots, self.pages_per_slot), np.int32)
+        self._free_pages: List[int] = list(range(1, self.n_pages))  # 0=trash
+        self._slot_pages: Dict[int, List[int]] = {}
+
+    def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
+        need = -(-(prompt_len + max_new) // self.page_size)
+        if need > len(self._free_pages):
+            return False                # page backpressure
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :len(pages)] = pages
+        self._slot_pages[slot] = pages
+        return True
+
+    def _release(self, slot: int) -> None:
+        self.page_table[slot, :] = 0
+        self._free_pages.extend(self._slot_pages.pop(slot, []))
+
+    def _prefill_into(self, slot: int, tokens, prompt_len: int):
+        logits, self.pools = _prefill(
+            self.params, tokens, self.pools,
+            jnp.asarray(self.page_table[slot]), self.cfg, prompt_len)
+        return logits
+
+    def _step(self, tokens, lengths, temps, keys):
+        nxt, self.pools = _tick(
+            self.params, tokens, self.pools, jnp.asarray(self.page_table),
+            lengths, temps, keys, self.cfg)
+        return nxt
+
+    # ------------------------------------------------------------------
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
