@@ -157,32 +157,92 @@ def main() -> int:
         mfu = round(flops * (stats["qps"] / batch) / peak, 4)
 
     # --- naive baseline: f32 params, reference attention, batch=1 ----------
-    naive_cfg = bert.BertConfig(
-        vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
-        n_heads=cfg.n_heads, d_ff=cfg.d_ff, max_seq=cfg.max_seq,
-        n_types=cfg.n_types, dtype=jnp.float32)
-    naive_params = jax.tree_util.tree_map(
-        lambda p: p.astype(jnp.float32), params)
+    # The f32 batch-1 compile has been observed to take 30+ minutes on the
+    # tunneled TPU backend — far beyond any sane bench budget, and a compile
+    # cannot be interrupted.  So the live naive measurement runs only when
+    # the remaining time budget allows, and its result is cached per
+    # (platform, device_kind, model, seq) in bench_naive.json so later runs
+    # (including the driver's) reuse it instead of re-paying the compile.
+    # Two-tier lookup: the gitignored runtime cache (written here) shadows
+    # the COMMITTED seed file, which carries known-good measurements across
+    # clones — e.g. the TPU naive number whose f32 compile once took the
+    # remote backend down.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cache_path = (os.environ.get("TPUSHARE_BENCH_NAIVE_CACHE")
+                  or os.path.join(repo, "bench_naive.json"))
+    seed_path = os.path.join(repo, "bench_naive_seed.json")
+    cache_key = (f"{platform}/{getattr(jax.devices()[0], 'device_kind', '?')}"
+                 f"/{model_name}/seq{seq}")
+    budget_s = float(os.environ.get("TPUSHARE_BENCH_BUDGET_S", "900"))
+    naive_qps, naive_src = None, "absent"
+    for path, src in ((cache_path, "cached"), (seed_path, "seeded")):
+        try:
+            with open(path) as f:
+                cached = json.load(f).get(cache_key)
+            if cached:
+                naive_qps, naive_src = float(cached["naive_qps"]), src
+                break
+        except Exception:
+            pass   # malformed/missing cache (wrong type, null, ...) = miss
 
-    def naive_fwd(tokens):
-        return bert.forward(naive_params, tokens, naive_cfg)
+    elapsed = time.perf_counter() - _T0
+    if naive_qps is None and elapsed < budget_s:
+        # Never let the OPTIONAL baseline kill the bench: the tunneled
+        # backend has hung its remote_compile on this very program for
+        # 50 min before dying with EOF (BENCH round-1/2 notes).
+        try:
+            naive_cfg = bert.BertConfig(
+                vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+                n_heads=cfg.n_heads, d_ff=cfg.d_ff, max_seq=cfg.max_seq,
+                n_types=cfg.n_types, dtype=jnp.float32)
+            naive_params = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), params)
 
-    naive = InferenceEngine(naive_fwd, batch_size=1, seq_len=seq)
-    naive_queries = 8 if on_tpu else 3
-    tokens1 = np.random.randint(1, 100, size=(1, seq), dtype=np.int32)
-    _log("compiling naive baseline...")
-    naive.infer(tokens1)  # compile
-    _log("measuring naive baseline...")
-    t0 = time.perf_counter()
-    for _ in range(naive_queries):
-        naive.infer(tokens1)
-    naive_qps = naive_queries / (time.perf_counter() - t0)
+            def naive_fwd(tokens):
+                return bert.forward(naive_params, tokens, naive_cfg)
+
+            naive = InferenceEngine(naive_fwd, batch_size=1, seq_len=seq)
+            naive_queries = 8 if on_tpu else 3
+            tokens1 = np.random.randint(1, 100, size=(1, seq),
+                                        dtype=np.int32)
+            _log("compiling naive baseline...")
+            naive.infer(tokens1)  # compile
+            _log("measuring naive baseline...")
+            t0 = time.perf_counter()
+            for _ in range(naive_queries):
+                naive.infer(tokens1)
+            naive_qps = naive_queries / (time.perf_counter() - t0)
+            naive_src = "live"
+        except Exception as e:
+            _log(f"naive baseline failed ({type(e).__name__}: "
+                 f"{str(e)[:200]}); recording without it")
+            naive_qps, naive_src = None, "failed"
+        if naive_qps is not None:
+            try:
+                try:
+                    with open(cache_path) as f:
+                        allc = json.load(f)
+                    if not isinstance(allc, dict):
+                        allc = {}
+                except Exception:
+                    allc = {}
+                allc[cache_key] = {"naive_qps": round(naive_qps, 3),
+                                   "measured_at": time.strftime("%Y-%m-%d")}
+                with open(cache_path, "w") as f:
+                    json.dump(allc, f, indent=1, sort_keys=True)
+            except OSError:
+                pass
+    elif naive_qps is None:
+        naive_src = "budget_skipped"
+        _log(f"skipping naive baseline: {elapsed:.0f}s elapsed exceeds "
+             f"budget {budget_s:.0f}s and no cached value for {cache_key}")
 
     result = {
         "metric": "bert_base_infer_qps",
         "value": round(stats["qps"], 2),
         "unit": "qps",
-        "vs_baseline": round(stats["qps"] / max(naive_qps, 1e-9), 2),
+        "vs_baseline": (round(stats["qps"] / max(naive_qps, 1e-9), 2)
+                        if naive_qps is not None else None),
         "platform": platform,
         "model": model_name,
         "mfu": mfu,
@@ -190,7 +250,9 @@ def main() -> int:
         "batch_size": batch,
         "seq_len": seq,
         "latency_ms_per_batch": round(stats["latency_ms"], 2),
-        "naive_qps_batch1_f32": round(naive_qps, 2),
+        "naive_qps_batch1_f32": (round(naive_qps, 2)
+                                 if naive_qps is not None else None),
+        "naive_qps_source": naive_src,
     }
     print(json.dumps(result))
     return 0

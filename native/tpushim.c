@@ -111,8 +111,13 @@ int tpushim_init(void) {
   /* Runtime dlopen — mirrors nvml_dl.c: probe well-known locations, accept
    * absence.  RTLD_LAZY|RTLD_LOCAL: we only need a presence/sanity probe
    * (the PJRT entry symbol), never to call into the TPU runtime here —
-   * owning the chip would conflict with the workload containers. */
+   * owning the chip would conflict with the workload containers.
+   * TPUSHIM_LIBTPU_PATH points at a non-standard install (e.g. the pip
+   * wheel's site-packages/libtpu/libtpu.so) and wins when set. */
+  const char *override = getenv("TPUSHIM_LIBTPU_PATH");
+  if (override != NULL && override[0] == '\0') override = NULL; /* ""≡unset */
   const char *candidates[] = {
+      override != NULL ? override : "libtpu.so",
       "libtpu.so",
       "/usr/lib/libtpu.so",
       "/lib/libtpu.so",
@@ -121,6 +126,7 @@ int tpushim_init(void) {
   for (size_t i = 0; i < sizeof(candidates) / sizeof(candidates[0]); i++) {
     g_libtpu = dlopen(candidates[i], RTLD_LAZY | RTLD_LOCAL);
     if (g_libtpu != NULL) break;
+    if (override != NULL && i == 0) break; /* explicit path: no fallback */
   }
   if (g_libtpu != NULL && dlsym(g_libtpu, "GetPjrtApi") == NULL) {
     /* Not a PJRT-capable libtpu — treat as absent. */

@@ -8,10 +8,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_emits_single_json_line():
+def test_bench_emits_single_json_line(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a TPU tunnel in CI
+    # keep the naive-qps cache out of the checkout (tests must not dirty it)
+    env["TPUSHARE_BENCH_NAIVE_CACHE"] = str(tmp_path / "naive.json")
+    # pin the budget: an operator's exported TPUSHARE_BENCH_BUDGET_S must
+    # not flip the naive phase (and vs_baseline) off under the test
+    env["TPUSHARE_BENCH_BUDGET_S"] = "900"
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO)

@@ -162,15 +162,14 @@ def cached_attention(q, kk, vv, positions):
     return jnp.einsum("bhst,bhtd->bhsd", probs.astype(vv.dtype), vv)
 
 
-def attention_block(p, x, cfg: ModelConfig, positions,
-                    kv_cache: Optional[Tuple] = None,
-                    cache_len: Optional[jnp.ndarray] = None,
-                    attention_fn=None):
-    b, s, d = x.shape
+def _attend_dense(p, xin, cfg: ModelConfig, positions,
+                  kv_cache: Optional[Tuple] = None,
+                  cache_len: Optional[jnp.ndarray] = None,
+                  attention_fn=None):
+    """Dense attention step: (o [B,H,S,D] pre-projection, new_cache)."""
     h, hkv = cfg.n_heads, cfg.n_kv_heads
-    q, k, v = _qkv(p, x, cfg, positions)
+    q, k, v = _qkv(p, xin, cfg, positions)
 
-    new_cache = None
     if kv_cache is not None:
         ck, cv = kv_cache                       # [B, Hkv, max_seq, D]
         if jnp.ndim(cache_len) == 0:
@@ -184,20 +183,33 @@ def attention_block(p, x, cfg: ModelConfig, positions,
                     c, blk, (0, p, 0)))
             ck = upd(ck, k, cache_len)
             cv = upd(cv, v, cache_len)
-        new_cache = (ck, cv)
         # decode: attend over the filled prefix; positions mask the rest
         o = cached_attention(q, _expand_kv(ck, h // hkv),
                              _expand_kv(cv, h // hkv), positions)
-    elif attention_fn is not None:
+        return o, (ck, cv)
+    if attention_fn is not None:
         # custom impls (ring/ulysses) expect equal head counts
-        o = attention_fn(q, _expand_kv(k, h // hkv),
-                         _expand_kv(v, h // hkv), causal=True)
-    else:
-        # default path is GQA-aware: K/V stay at Hkv heads end-to-end
-        o = attention(q, k, v, causal=True)
+        return attention_fn(q, _expand_kv(k, h // hkv),
+                            _expand_kv(v, h // hkv), causal=True), None
+    # default path is GQA-aware: K/V stay at Hkv heads end-to-end
+    return attention(q, k, v, causal=True), None
 
-    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
-    return _mm(o, p["wo"]), new_cache
+
+def _attn_ffn(layer, x, cfg: ModelConfig, attend):
+    """THE pre-norm decoder layer, once: rmsnorm -> attend -> o-proj
+    residual -> rmsnorm -> ffn residual.
+
+    ``attend(layer, xin) -> (o [B,H,S,D] pre-projection, carry)`` plugs
+    in the cache flavor (none / dense / paged); every forward variant
+    routes through here so the block wiring cannot drift between them.
+    """
+    b, s, _ = x.shape
+    xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
+    o, carry = attend(layer, xin)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+    x = x + _mm(o, layer["wo"])
+    x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+    return x, carry
 
 
 def ffn_block(p, x):
@@ -237,26 +249,21 @@ def forward(params, tokens, cfg: ModelConfig,
 
     if kv_caches is None:
         def body(x, layer):
-            h_attn, _ = attention_block(
-                layer, rmsnorm(x, layer["attn_scale"], cfg.norm_eps), cfg,
-                positions, attention_fn=attention_fn)
-            x = x + h_attn
-            x = x + ffn_block(layer,
-                              rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
-            return x, None
+            return _attn_ffn(
+                layer, x, cfg,
+                lambda lyr, xin: _attend_dense(
+                    lyr, xin, cfg, positions, attention_fn=attention_fn))
 
         x, _ = jax.lax.scan(body, x, params["layers"])
         new_caches = None
     else:
         def body(x, layer_and_cache):
             layer, ck, cv = layer_and_cache
-            h_attn, nc = attention_block(
-                layer, rmsnorm(x, layer["attn_scale"], cfg.norm_eps), cfg,
-                positions, kv_cache=(ck, cv), cache_len=cache_len)
-            x = x + h_attn
-            x = x + ffn_block(layer,
-                              rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
-            return x, nc
+            return _attn_ffn(
+                layer, x, cfg,
+                lambda lyr, xin: _attend_dense(
+                    lyr, xin, cfg, positions, kv_cache=(ck, cv),
+                    cache_len=cache_len))
 
         ck, cv = kv_caches
         x, (new_ck, new_cv) = jax.lax.scan(
@@ -292,12 +299,10 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // n_micro, s))
 
     def layer_fn(layer, x):
-        h_attn, _ = attention_block(
-            layer, rmsnorm(x, layer["attn_scale"], cfg.norm_eps), cfg,
-            positions)
-        x = x + h_attn
-        return x + ffn_block(layer,
-                             rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+        x, _ = _attn_ffn(
+            layer, x, cfg,
+            lambda lyr, xin: _attend_dense(lyr, xin, cfg, positions))
+        return x
 
     x = params["embed"][tokens].astype(cfg.dtype)
     x_micro = x.reshape(n_micro, b // n_micro, s, cfg.d_model)
@@ -371,18 +376,18 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
 
     def body(x, layer_and_pool):
         layer, kpool, vpool = layer_and_pool
-        xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
-        q, k, v = _qkv(layer, xin, cfg, positions)
-        kpool = kpool.at[page_ids, :, offsets, :].set(k[:, :, 0, :])
-        vpool = vpool.at[page_ids, :, offsets, :].set(v[:, :, 0, :])
-        o = cached_attention(
-            q, _expand_kv(_paged_gather(kpool, page_table), h // hkv),
-            _expand_kv(_paged_gather(vpool, page_table), h // hkv),
-            positions)
-        x = x + _mm(o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model),
-                    layer["wo"])
-        x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
-        return x, (kpool, vpool)
+
+        def attend(lyr, xin):
+            q, k, v = _qkv(lyr, xin, cfg, positions)
+            kp2 = kpool.at[page_ids, :, offsets, :].set(k[:, :, 0, :])
+            vp2 = vpool.at[page_ids, :, offsets, :].set(v[:, :, 0, :])
+            o = cached_attention(
+                q, _expand_kv(_paged_gather(kp2, page_table), h // hkv),
+                _expand_kv(_paged_gather(vp2, page_table), h // hkv),
+                positions)
+            return o, (kp2, vp2)
+
+        return _attn_ffn(layer, x, cfg, attend)
 
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
@@ -412,22 +417,22 @@ def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
 
     def body(x, layer_and_pool):
         layer, kpool, vpool = layer_and_pool
-        xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
-        q, k, v = _qkv(layer, xin, cfg, positions)   # k/v [1, Hkv, S, D]
-        for j in range(n_chunks):               # static page walk
-            cl = min(page, s - j * page)
-            # chunk [1, Hkv, cl, D] already matches pool rank/layout
-            kpool = jax.lax.dynamic_update_slice(
-                kpool, k[:, :, j * page:j * page + cl, :],
-                (page_rows[j], 0, 0, 0))
-            vpool = jax.lax.dynamic_update_slice(
-                vpool, v[:, :, j * page:j * page + cl, :],
-                (page_rows[j], 0, 0, 0))
-        o = attention(q, k, v, causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        x = x + _mm(o, layer["wo"])
-        x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
-        return x, (kpool, vpool)
+
+        def attend(lyr, xin):
+            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [1, Hkv, S, D]
+            kp2, vp2 = kpool, vpool
+            for j in range(n_chunks):           # static page walk
+                cl = min(page, s - j * page)
+                # chunk [1, Hkv, cl, D] already matches pool rank/layout
+                kp2 = jax.lax.dynamic_update_slice(
+                    kp2, k[:, :, j * page:j * page + cl, :],
+                    (page_rows[j], 0, 0, 0))
+                vp2 = jax.lax.dynamic_update_slice(
+                    vp2, v[:, :, j * page:j * page + cl, :],
+                    (page_rows[j], 0, 0, 0))
+            return attention(q, k, v, causal=True), (kp2, vp2)
+
+        return _attn_ffn(layer, x, cfg, attend)
 
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
