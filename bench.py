@@ -185,8 +185,16 @@ def main() -> int:
         except Exception:
             pass   # malformed/missing cache (wrong type, null, ...) = miss
 
+    # On the tunneled TPU the f32 batch-1 compile has hung remote_compile
+    # for ~50 min before dying with EOF — attempting it live there is
+    # OPT-IN (TPUSHARE_BENCH_NAIVE=1); rely on the seed/cache instead.
+    live_ok = (not on_tpu) or os.environ.get("TPUSHARE_BENCH_NAIVE") == "1"
     elapsed = time.perf_counter() - _T0
-    if naive_qps is None and elapsed < budget_s:
+    if naive_qps is None and not live_ok:
+        naive_src = "tpu_live_disabled"
+        _log("skipping live naive baseline on TPU (enable with "
+             "TPUSHARE_BENCH_NAIVE=1); no cached/seeded value")
+    elif naive_qps is None and elapsed < budget_s:
         # Never let the OPTIONAL baseline kill the bench: the tunneled
         # backend has hung its remote_compile on this very program for
         # 50 min before dying with EOF (BENCH round-1/2 notes).

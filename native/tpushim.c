@@ -116,17 +116,21 @@ int tpushim_init(void) {
    * wheel's site-packages/libtpu/libtpu.so) and wins when set. */
   const char *override = getenv("TPUSHIM_LIBTPU_PATH");
   if (override != NULL && override[0] == '\0') override = NULL; /* ""≡unset */
-  const char *candidates[] = {
-      override != NULL ? override : "libtpu.so",
-      "libtpu.so",
-      "/usr/lib/libtpu.so",
-      "/lib/libtpu.so",
-      "/usr/share/tpu/libtpu.so",
-  };
-  for (size_t i = 0; i < sizeof(candidates) / sizeof(candidates[0]); i++) {
-    g_libtpu = dlopen(candidates[i], RTLD_LAZY | RTLD_LOCAL);
-    if (g_libtpu != NULL) break;
-    if (override != NULL && i == 0) break; /* explicit path: no fallback */
+  if (override != NULL) {
+    /* Explicit path: no fallback — a broken override must read as
+     * absent, not silently pick up some other system libtpu. */
+    g_libtpu = dlopen(override, RTLD_LAZY | RTLD_LOCAL);
+  } else {
+    const char *candidates[] = {
+        "libtpu.so",
+        "/usr/lib/libtpu.so",
+        "/lib/libtpu.so",
+        "/usr/share/tpu/libtpu.so",
+    };
+    for (size_t i = 0; i < sizeof(candidates) / sizeof(candidates[0]); i++) {
+      g_libtpu = dlopen(candidates[i], RTLD_LAZY | RTLD_LOCAL);
+      if (g_libtpu != NULL) break;
+    }
   }
   if (g_libtpu != NULL && dlsym(g_libtpu, "GetPjrtApi") == NULL) {
     /* Not a PJRT-capable libtpu — treat as absent. */

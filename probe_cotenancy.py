@@ -1,0 +1,119 @@
+"""Real-chip co-tenancy probe: two JAX processes sharing one TPU.
+
+VERDICT r1 item 4 / SURVEY §7 hard part 1: the fraction-sharing story
+must be proven on silicon, not CPU.  This script runs the SAME workload
+(bf16 BERT-tiny-shaped matmul steps) three ways on the local accelerator:
+
+  solo     — one process, whole chip (baseline);
+  duo      — two processes CONCURRENTLY, each with the injected contract
+             env a fractional tpushare allocation provides
+             (XLA_PYTHON_CLIENT_MEM_FRACTION=0.45,
+             XLA_PYTHON_CLIENT_PREALLOCATE=false, TPU_VISIBLE_CHIPS=0);
+
+and prints ONE JSON line with per-process and aggregate throughput, so
+the record shows whether libtpu admits co-tenants at all (single-owner
+lock vs shared) and what fraction sharing costs.
+
+Run as the ONLY python tree on the host (CLAUDE.md: one TPU dial at a
+time per process; the two workers here are started together and each
+dials once).  Exit code 0 even when co-tenancy is refused — the refusal
+IS the measurement, recorded as duo_mode="exclusive-lock".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+WORKER = r"""
+import json, os, sys, time
+import jax, jax.numpy as jnp
+
+steps = int(os.environ.get("PROBE_STEPS", "30"))
+dim = int(os.environ.get("PROBE_DIM", "2048"))
+try:
+    dev = jax.devices()[0]
+    x = jnp.ones((dim, dim), jnp.bfloat16)
+
+    @jax.jit
+    def step(x):
+        for _ in range(4):
+            x = (x @ x) / dim
+        return x
+
+    step(x).block_until_ready()          # compile outside the window
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(steps):
+        y = step(y)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(json.dumps({"ok": True, "platform": dev.platform,
+                      "steps_per_s": steps / dt}))
+except Exception as e:
+    print(json.dumps({"ok": False,
+                      "error": f"{type(e).__name__}: {str(e)[:300]}"}))
+"""
+
+
+def run_workers(n: int, frac: str, timeout_s: float):
+    """Start n workers concurrently, wait, return parsed outputs."""
+    env = dict(os.environ)
+    env.update({
+        "TPU_VISIBLE_CHIPS": "0",
+        "ALIYUN_COM_TPU_MEM_IDX": "0",
+        "XLA_PYTHON_CLIENT_MEM_FRACTION": frac,
+        "XLA_PYTHON_CLIENT_PREALLOCATE": "false",
+    })
+    procs = [subprocess.Popen([sys.executable, "-c", WORKER], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL, text=True)
+             for _ in range(n)]
+    outs = []
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        left = max(5.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=left)
+            line = (out or "").strip().splitlines()
+            outs.append(json.loads(line[-1]) if line else
+                        {"ok": False, "error": "no output"})
+        except subprocess.TimeoutExpired:
+            # Abandon, never kill mid-dial (CLAUDE.md).
+            outs.append({"ok": False, "error": f"timeout {timeout_s:.0f}s"})
+    return outs
+
+
+def main() -> int:
+    timeout_s = float(os.environ.get("PROBE_TIMEOUT_S", "420"))
+    solo = run_workers(1, "0.90", timeout_s)[0]
+    result = {"metric": "cotenancy_probe", "solo": solo}
+    if not solo.get("ok"):
+        result["duo_mode"] = "solo-failed"
+        print(json.dumps(result))
+        return 0
+
+    duo = run_workers(2, "0.45", timeout_s)
+    result["duo"] = duo
+    ok = [d for d in duo if d.get("ok")]
+    if len(ok) == 2:
+        agg = sum(d["steps_per_s"] for d in ok)
+        result["duo_mode"] = "shared"
+        result["aggregate_steps_per_s"] = round(agg, 3)
+        result["solo_steps_per_s"] = round(solo["steps_per_s"], 3)
+        result["aggregate_vs_solo"] = round(agg / solo["steps_per_s"], 3)
+    elif len(ok) == 1:
+        # One worker got the chip, the other was locked out: libtpu's
+        # single-owner behavior — fraction sharing not admitted.
+        result["duo_mode"] = "exclusive-lock"
+    else:
+        result["duo_mode"] = "both-failed"
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

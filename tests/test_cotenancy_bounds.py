@@ -1,0 +1,175 @@
+"""Disjoint per-tenant topology for co-located pods (SURVEY §2.3).
+
+Sequential Allocates on one chip must hand each tenant its own
+TensorCore on multi-core generations — communicated via tpushare's OWN
+env namespace (TPUSHARE_VISIBLE_CORE: libtpu's TPU_VISIBLE_DEVICES takes
+chip indices, and no public libtpu env selects a single core, so the
+workload runtime maps the grant to a local jax device).  Departed
+tenants' cores are reused (occupancy reconstructed from the
+ALIYUN_COM_TPU_CORE annotations of live assigned pods); once all cores
+are taken, tenants share with core_exclusive=false.  Single-core
+generations share by HBM fraction only.
+"""
+
+import grpc
+import pytest
+
+from tpushare.k8s.client import KubeClient
+from tpushare.plugin import allocate, const, discovery
+from tpushare.plugin.api import DevicePluginStub, pb
+from tpushare.plugin.podmanager import PodManager
+from tpushare.plugin.server import TpuDevicePlugin
+from tpushare.runtime import contract
+
+from fakes.apiserver import FakeApiServer, make_pod
+
+
+@pytest.fixture
+def api():
+    srv = FakeApiServer().start()
+    yield srv
+    srv.stop()
+
+
+def _plugin(api, tmp_path, generation, n_chips=1):
+    backend = discovery.FakeBackend(n_chips=n_chips, generation=generation)
+    pm = PodManager(KubeClient(api.url), "node-a")
+    p = TpuDevicePlugin(backend, allocator=allocate.make_allocator(pm),
+                        socket_path=str(tmp_path / "tpushare.sock"),
+                        kubelet_socket=str(tmp_path / "kubelet.sock"))
+    p.start()
+    return p
+
+
+def _allocate(p, n_units):
+    ch = grpc.insecure_channel(f"unix://{p.socket_path}")
+    grpc.channel_ready_future(ch).result(timeout=5)
+    resp = DevicePluginStub(ch).Allocate(pb.AllocateRequest(
+        container_requests=[pb.ContainerAllocateRequest(
+            devicesIDs=[fid for fid, _ in p.devices[:n_units]])]))
+    ch.close()
+    return dict(resp.container_responses[0].envs)
+
+
+def test_multicore_chip_tenants_get_disjoint_cores(api, tmp_path):
+    """v3 (2 TensorCores/chip): tenants get cores 0,1 exclusively; the
+    third shares core 0 (advisory HBM fractions still apply)."""
+    plugin = _plugin(api, tmp_path, "v3")   # 16 GiB, 2 cores
+    try:
+        api.pods = [
+            make_pod(f"t{i}", tpu_mem=4, assume_time=i + 1, assigned="false",
+                     chip_idx=0, phase="Pending")
+            for i in range(3)
+        ]
+        envs = [_allocate(plugin, 4) for _ in range(3)]
+        assert [e[const.ENV_COTENANTS] for e in envs] == ["0", "1", "2"]
+        assert [e[const.ENV_VISIBLE_CORE] for e in envs] \
+            == ["0", "1", "0"]     # disjoint, disjoint, wrap
+        assert [e[const.ENV_CORE_EXCLUSIVE] for e in envs] \
+            == ["true", "true", "false"]
+        assert all(e[const.ENV_CHIP_CORES] == "2" for e in envs)
+        # the core grant is persisted so future Allocates see occupancy
+        anns = [p["metadata"]["annotations"] for p in api.pods]
+        assert all(a[const.ANN_TPU_MEM_ASSIGNED] == "true" for a in anns)
+        assert [a[const.ANN_TPU_CORE] for a in anns] == ["0", "1", "0"]
+        # no invented libtpu env: the chip stays the only TPU_* selector
+        assert all("TPU_VISIBLE_DEVICES" not in e for e in envs)
+    finally:
+        plugin.stop()
+
+
+def test_departed_tenant_core_is_reused(api, tmp_path):
+    """Core occupancy follows LIVE pods: when the tenant on core 0
+    terminates, the next tenant gets core 0 back (exclusively) instead
+    of colliding with the still-live tenant on core 1."""
+    plugin = _plugin(api, tmp_path, "v3")
+    try:
+        api.pods = [
+            make_pod("a", tpu_mem=4, assume_time=1, assigned="false",
+                     chip_idx=0, phase="Pending"),
+            make_pod("b", tpu_mem=4, assume_time=2, assigned="false",
+                     chip_idx=0, phase="Pending"),
+        ]
+        ea = _allocate(plugin, 4)
+        eb = _allocate(plugin, 4)
+        assert ea[const.ENV_VISIBLE_CORE] == "0"
+        assert eb[const.ENV_VISIBLE_CORE] == "1"
+        # tenant a finishes: phase Succeeded -> no longer live
+        api.pods[0]["status"]["phase"] = "Succeeded"
+        api.pods.append(make_pod("c", tpu_mem=4, assume_time=3,
+                                 assigned="false", chip_idx=0,
+                                 phase="Pending"))
+        ec = _allocate(plugin, 4)
+        assert ec[const.ENV_VISIBLE_CORE] == "0"   # reused
+        assert ec[const.ENV_CORE_EXCLUSIVE] == "true"
+    finally:
+        plugin.stop()
+
+
+def test_singlecore_chip_shares_by_fraction_only(api, tmp_path):
+    plugin = _plugin(api, tmp_path, "v5e")  # 1 core/chip
+    try:
+        api.pods = [
+            make_pod(f"t{i}", tpu_mem=4, assume_time=i + 1, assigned="false",
+                     chip_idx=0, phase="Pending")
+            for i in range(2)
+        ]
+        envs = [_allocate(plugin, 4) for _ in range(2)]
+        assert all(const.ENV_VISIBLE_CORE not in e for e in envs)
+        assert [e[const.ENV_COTENANTS] for e in envs] == ["0", "1"]
+        # first tenant alone on the chip; second shares it
+        assert [e[const.ENV_CORE_EXCLUSIVE] for e in envs] \
+            == ["true", "false"]
+    finally:
+        plugin.stop()
+
+
+def test_unaccounted_tenant_suppresses_exclusivity_claim():
+    """A live tenant with no core annotation (failed assigned-patch,
+    legacy plugin) may sit on any core — exclusivity must be UNKNOWN
+    (env omitted), not true."""
+    chip = discovery.Chip(index=0, id="c", dev_paths=(), hbm_bytes=16 << 30,
+                          cores=2, generation="v3")
+    core, exclusive = allocate.pick_core(chip, occupied=set(), cotenants=1)
+    assert core == 0 and exclusive is None
+
+    class _P:
+        memory_unit = "GiB"
+
+    resp = allocate.container_response(_P(), chip, 4, 4, cotenants=1,
+                                       core=core, core_exclusive=exclusive)
+    assert const.ENV_CORE_EXCLUSIVE not in resp.envs
+    assert resp.envs[const.ENV_VISIBLE_CORE] == "0"
+
+    # tenancy completely unknown: no tenancy envs at all
+    resp2 = allocate.container_response(_P(), chip, 4, 4)
+    for key in (const.ENV_COTENANTS, const.ENV_CHIP_CORES,
+                const.ENV_CORE_EXCLUSIVE, const.ENV_VISIBLE_CORE):
+        assert key not in resp2.envs
+
+
+def test_contract_surfaces_core_grant():
+    view = contract.current_allocation({
+        "TPU_VISIBLE_CHIPS": "1", "ALIYUN_COM_TPU_MEM_IDX": "1",
+        "XLA_PYTHON_CLIENT_MEM_FRACTION": "0.25",
+        "TPUSHARE_COTENANTS": "1", "TPUSHARE_CHIP_CORES": "2",
+        "TPUSHARE_CORE_EXCLUSIVE": "true", "TPUSHARE_VISIBLE_CORE": "1",
+    })
+    assert view.cotenants == 1 and view.chip_cores == 2
+    assert view.visible_core == 1
+    assert view.local_device_index() == 1
+    assert view.core_exclusive is True
+
+    shared = contract.current_allocation({
+        "TPU_VISIBLE_CHIPS": "0", "ALIYUN_COM_TPU_MEM_IDX": "0",
+        "TPUSHARE_COTENANTS": "2", "TPUSHARE_CHIP_CORES": "2",
+        "TPUSHARE_CORE_EXCLUSIVE": "false",
+    })
+    assert shared.core_exclusive is False
+    assert shared.local_device_index() is None
+
+    # legacy / tenancy-unknown plugins must not claim anything
+    legacy = contract.current_allocation({
+        "TPU_VISIBLE_CHIPS": "0", "ALIYUN_COM_TPU_MEM_IDX": "0"})
+    assert legacy.core_exclusive is None
+    assert legacy.cotenants is None

@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from . import const
 from .api import pb
@@ -43,11 +43,56 @@ LIBTPU_HOST_PATHS = (
 )
 
 
+def pick_core(chip: Chip, occupied,
+              cotenants: int = 0) -> Tuple[Optional[int], Optional[bool]]:
+    """(granted TensorCore, exclusive?) for a new tenant.
+
+    Lowest FREE core first (SURVEY §2.3 disjoint bounds — a departed
+    tenant's core is reused, reconstructed from live pods' annotations);
+    when every core is taken the lowest core is shared, isolation
+    degrading to the advisory HBM fraction — the same trade the
+    reference makes with cGPU off.  Single-core chips (v4 megacore,
+    v5e) never split and never annotate a core, so their exclusivity
+    comes from the live co-tenant COUNT, not core occupancy.
+
+    Exclusivity is ``None`` (unknown, env omitted) when some live
+    tenant has no core annotation (``cotenants > len(occupied)`` on a
+    multi-core chip): that tenant may sit on any core — e.g. its
+    assigned-patch failed (tolerated) or it predates core grants — so
+    an affirmative "alone on this silicon" claim would be unsound.
+    """
+    if chip.cores <= 1:
+        return None, cotenants == 0
+    unaccounted = cotenants > len(occupied)
+    for c in range(chip.cores):
+        if c not in occupied:
+            return c, (None if unaccounted else True)
+    return min(occupied) if occupied else 0, False
+
+
 def container_response(plugin, chip: Chip, container_units: int,
                        pod_units: int,
-                       isolation_disabled: bool = False
+                       isolation_disabled: bool = False,
+                       cotenants: Optional[int] = None,
+                       core: Optional[int] = None,
+                       core_exclusive: Optional[bool] = None
                        ) -> "pb.ContainerAllocateResponse":
-    """Build one container's allocation: env contract + devices + mounts."""
+    """Build one container's allocation: env contract + devices + mounts.
+
+    Tenancy facts (``cotenants`` = live ASSIGNED pods already on the
+    chip; ``core`` = granted TensorCore from :func:`pick_core`;
+    ``core_exclusive`` = whether that silicon is held alone) are emitted
+    ONLY when known: callers without cluster state (the standalone
+    ``server.default_allocator``) and tenancy-read failures pass None
+    and the envs are omitted — absence of data must never read as an
+    exclusivity claim.  The core is exported in
+    tpushare's own namespace (``TPUSHARE_VISIBLE_CORE``, the core index
+    WITHIN the chip): libtpu's ``TPU_VISIBLE_DEVICES`` takes chip
+    indices and no public libtpu env selects a single TensorCore, so the
+    workload runtime (``tpushare.runtime.contract``) maps the grant to a
+    local jax device instead (SURVEY §2.3; allocate.go:113-128
+    generalized).
+    """
     chip_units = mem_units_per_chip(chip, plugin.memory_unit)
     # HBM budget: fraction of this chip's HBM this container may use.
     # JAX reads XLA_PYTHON_CLIENT_MEM_FRACTION at process start.  The
@@ -72,6 +117,13 @@ def container_response(plugin, chip: Chip, container_units: int,
         const.ENV_TPU_MEM_CONTAINER: str(container_units),
         const.ENV_TPU_MEM_DEV: str(chip_units),
     }
+    if cotenants is not None:
+        envs[const.ENV_COTENANTS] = str(cotenants)
+        envs[const.ENV_CHIP_CORES] = str(chip.cores)
+    if core_exclusive is not None:
+        envs[const.ENV_CORE_EXCLUSIVE] = "true" if core_exclusive else "false"
+    if core is not None:
+        envs[const.ENV_VISIBLE_CORE] = str(core)
     if container_units < chip_units:
         # Fractional grant => co-tenants share the chip: disable startup
         # preallocation so tenants fail on their own overuse, not on a
@@ -143,17 +195,33 @@ def make_allocator(pod_manager):
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
+            try:
+                tenancy = pod_manager.chip_tenancy(chip.index)
+            except Exception:
+                log.exception("chip tenancy read failed; tenancy unknown")
+                tenancy = None
+            if tenancy is None:
+                # No cluster state: claim nothing (no core pin either —
+                # a fabricated "core 0, exclusive" could double-book a
+                # live tenant's silicon).
+                cotenants, core, exclusive = None, None, None
+            else:
+                cotenants, occupied = tenancy
+                core, exclusive = pick_core(chip, occupied, cotenants)
             resp = pb.AllocateResponse()
             for creq in request.container_requests:
                 resp.container_responses.append(container_response(
                     plugin, chip, len(creq.devicesIDs), pod_req,
-                    isolation_off))
+                    isolation_off, cotenants=cotenants, core=core,
+                    core_exclusive=exclusive))
             from . import status
             status.inc("tpushare_allocations_total")
 
             if pod is not None:
                 try:
-                    pod_manager.mark_assigned(pod)
+                    extra = ({const.ANN_TPU_CORE: str(core)}
+                             if core is not None else None)
+                    pod_manager.mark_assigned(pod, extra_annotations=extra)
                 except Exception:
                     # Patch failure is logged, not fatal: kubelet keeps the
                     # allocation; the pod stays "assumed" and ages out

@@ -32,6 +32,7 @@ ANN_TPU_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"          # chosen chip index
 ANN_TPU_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"          # pod's total tpu-mem
 ANN_TPU_MEM_ASSUME_TIME = "ALIYUN_COM_TPU_MEM_ASSUME_TIME"
 ANN_TPU_MEM_ASSIGNED = "ALIYUN_COM_TPU_MEM_ASSIGNED"  # "false" -> "true"
+ANN_TPU_CORE = "ALIYUN_COM_TPU_CORE"  # granted TensorCore (multi-core gens)
 # New-style extender annotation: JSON {devIndex: {podUID: mem}} allocation map.
 ANN_TPU_ALLOCATION = "scheduler.framework.tpushare.allocation"
 
@@ -41,6 +42,15 @@ ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
 ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 ENV_XLA_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+# Tenant placement facts for the workload runtime (tpushare's OWN
+# namespace — deliberately NOT a libtpu env: libtpu's TPU_VISIBLE_DEVICES
+# takes CHIP indices, and no public env selects a single TensorCore, so
+# the core grant is communicated to the workload runtime, which maps it
+# to a local jax device after TPU_VISIBLE_CHIPS narrowed to one chip):
+ENV_VISIBLE_CORE = "TPUSHARE_VISIBLE_CORE"    # granted core WITHIN the chip
+ENV_COTENANTS = "TPUSHARE_COTENANTS"          # live co-tenants at grant time
+ENV_CHIP_CORES = "TPUSHARE_CHIP_CORES"
+ENV_CORE_EXCLUSIVE = "TPUSHARE_CORE_EXCLUSIVE"
 # Bookkeeping envs (reference: allocate.go:113-128):
 ENV_TPU_MEM_IDX = "ALIYUN_COM_TPU_MEM_IDX"
 ENV_TPU_MEM_POD = "ALIYUN_COM_TPU_MEM_POD"

@@ -81,6 +81,58 @@ class PodManager:
         cands.sort(key=lambda p: (podutils.assume_time(p) or 0))
         return cands
 
+    def chip_tenancy(self, chip_index: int):
+        """(live tenant count, occupied TensorCores) for one chip, or
+        ``None`` when the cluster state could not be read at all — the
+        caller must then emit NO tenancy claims rather than fabricate
+        an empty chip.
+
+        The allocator grants each new co-tenant the lowest FREE core
+        (SURVEY §2.3 disjoint bounds) — occupancy is reconstructed from
+        the ``ALIYUN_COM_TPU_CORE`` annotation of live ASSIGNED pods, the
+        same cluster-state-is-truth channel the extender writes and the
+        inspect CLI reads (repo convention: all three agree).  Reading
+        the APISERVER first matters here (unlike pending_pods, which is
+        kubelet-first for phase freshness): annotations are patched at
+        the apiserver, and kubelet's /pods cache can lag them by
+        seconds — long enough for two back-to-back Allocates to
+        double-book a core.  3x1s apiserver retries, then one kubelet
+        attempt as fallback.
+        """
+        pods = None
+        for attempt in range(APISERVER_RETRIES):
+            try:
+                pods = self.kube.list_pods(node_name=self.node_name)
+                break
+            except Exception as e:
+                log.warning("apiserver tenancy list attempt %d failed: %s",
+                            attempt + 1, e)
+                if attempt < APISERVER_RETRIES - 1:  # last failure falls
+                    time.sleep(APISERVER_RETRY_SLEEP)  # through immediately
+        if pods is None and self.kubelet is not None:
+            try:
+                pods = self.kubelet.get_node_running_pods()
+            except Exception:
+                pass
+        if pods is None:
+            log.error("listing pods for chip tenancy failed; tenancy unknown")
+            return None
+        n, occupied = 0, set()
+        for p in pods:
+            if not podutils.is_active_pod(p):
+                continue
+            anns = p.get("metadata", {}).get("annotations") or {}
+            if anns.get(const.ANN_TPU_MEM_ASSIGNED, "").lower() != "true":
+                continue
+            if podutils.chip_index_from_annotation(p) != chip_index:
+                continue
+            n += 1
+            try:
+                occupied.add(int(anns[const.ANN_TPU_CORE]))
+            except (KeyError, ValueError):
+                pass   # single-core grant or pre-core-annotation pod
+        return n, occupied
+
     # -- adapter surface used by allocate.make_allocator --------------------
     def pod_request_units(self, pod: dict) -> int:
         return podutils.pod_requested_units(pod, self.resource_name)
@@ -91,11 +143,15 @@ class PodManager:
     def pod_name(self, pod: dict) -> str:
         return podutils.pod_key(pod)
 
-    def mark_assigned(self, pod: dict) -> None:
-        """Patch ASSIGNED=true; one retry on optimistic-lock conflict
-        (allocate.go:135-149, const.go:15)."""
+    def mark_assigned(self, pod: dict,
+                      extra_annotations: Optional[dict] = None) -> None:
+        """Patch ASSIGNED=true (+ grant facts, e.g. the TensorCore); one
+        retry on optimistic-lock conflict (allocate.go:135-149,
+        const.go:15)."""
         md = pod["metadata"]
         anns = podutils.assigned_patch_annotations()
+        if extra_annotations:
+            anns.update(extra_annotations)
         try:
             self.kube.patch_pod_annotations(md["namespace"], md["name"], anns)
         except ApiError as e:

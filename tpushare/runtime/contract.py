@@ -28,6 +28,10 @@ _POD = "ALIYUN_COM_TPU_MEM_POD"
 _CONTAINER = "ALIYUN_COM_TPU_MEM_CONTAINER"
 _DEV = "ALIYUN_COM_TPU_MEM_DEV"
 _IDX = "ALIYUN_COM_TPU_MEM_IDX"
+_COTENANTS = "TPUSHARE_COTENANTS"
+_CORES = "TPUSHARE_CHIP_CORES"
+_EXCLUSIVE = "TPUSHARE_CORE_EXCLUSIVE"
+_VISIBLE_CORE = "TPUSHARE_VISIBLE_CORE"
 _FAILURE_PREFIX = "no-tpu-has-"
 
 
@@ -45,10 +49,27 @@ class AllocationView:
     container_units: Optional[int]
     chip_units: Optional[int]      # whole chip's capacity in units
     failure: Optional[str] = None  # failure marker, if allocation failed
+    cotenants: Optional[int] = None        # live co-tenants at grant time
+    chip_cores: Optional[int] = None       # addressable cores on the chip
+    visible_core: Optional[int] = None     # granted TensorCore WITHIN chip
+    # The plugin's own verdict ("true"/"false") on whether this tenant
+    # holds its silicon alone — it knows the live core occupancy at grant
+    # time; None when the plugin predates the env or had no tenancy data.
+    core_exclusive: Optional[bool] = None
 
     @property
     def allocated(self) -> bool:
         return self.chip_index is not None and self.failure is None
+
+    def local_device_index(self) -> Optional[int]:
+        """Index into ``jax.local_devices()`` for the granted core.
+
+        After ``TPU_VISIBLE_CHIPS`` narrows the process to one chip, the
+        chip's cores enumerate as the local devices in core order, so the
+        granted core IS the local index.  None when no core grant exists
+        (single-core chips, legacy plugins) — use all local devices.
+        """
+        return self.visible_core
 
 
 def current_allocation(env: Optional[dict] = None) -> AllocationView:
@@ -79,6 +100,11 @@ def current_allocation(env: Optional[dict] = None) -> AllocationView:
         pod_units=_int(_POD),
         container_units=_int(_CONTAINER),
         chip_units=_int(_DEV),
+        cotenants=_int(_COTENANTS),
+        chip_cores=_int(_CORES),
+        visible_core=_int(_VISIBLE_CORE),
+        core_exclusive=({"true": True, "false": False}.get(
+            e.get(_EXCLUSIVE, "").lower())),
     )
 
 
