@@ -66,6 +66,22 @@ def main() -> int:
     _emit("llm_decode_tokens_per_s", total_tokens / dt, "tokens/s",
           platform=platform, slots=slots, ticks=ticks)
 
+    # 2b. same decode workload through the PAGED batcher: measures the
+    # gather/scatter overhead paged storage pays per tick (its win is
+    # capacity — more in-flight sequences per HBM byte — not speed).
+    from tpushare.serving.paged import PagedContinuousBatcher
+    pb = PagedContinuousBatcher(lparams, lcfg, n_slots=slots, page_size=16)
+    for i in range(slots):
+        pb.admit([1 + i, 2, 3], gen)
+    pb.tick()
+    t0 = time.perf_counter()
+    while pb.slots:
+        pb.tick()
+    dt_paged = time.perf_counter() - t0
+    _emit("llm_decode_tokens_per_s_paged", total_tokens / dt_paged,
+          "tokens/s", platform=platform, slots=slots, page_size=16,
+          vs_dense=round(dt / dt_paged, 3))
+
     # 3. speculative decoding ceiling: draft == target isolates the
     # mechanism (acceptance 1.0); with randomly-initialized models a
     # separate draft's acceptance is meaningless, while real deployments

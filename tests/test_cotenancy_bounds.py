@@ -124,13 +124,12 @@ def test_singlecore_chip_shares_by_fraction_only(api, tmp_path):
         plugin.stop()
 
 
-def test_unaccounted_tenant_suppresses_exclusivity_claim():
-    """A live tenant with no core annotation (failed assigned-patch,
-    legacy plugin) may sit on any core — exclusivity must be UNKNOWN
-    (env omitted), not true."""
+def test_unannotated_tenant_suppresses_exclusivity_claim():
+    """A live tenant with no core annotation (legacy plugin) may sit on
+    any core — exclusivity must be UNKNOWN (env omitted), not true."""
     chip = discovery.Chip(index=0, id="c", dev_paths=(), hbm_bytes=16 << 30,
                           cores=2, generation="v3")
-    core, exclusive = allocate.pick_core(chip, occupied=set(), cotenants=1)
+    core, exclusive = allocate.pick_core(chip, {}, cotenants=1, unannotated=1)
     assert core == 0 and exclusive is None
 
     class _P:
@@ -146,6 +145,40 @@ def test_unaccounted_tenant_suppresses_exclusivity_claim():
     for key in (const.ENV_COTENANTS, const.ENV_CHIP_CORES,
                 const.ENV_CORE_EXCLUSIVE, const.ENV_VISIBLE_CORE):
         assert key not in resp2.envs
+
+
+def test_pick_core_multiplicity_and_balancing():
+    """Core counts keep multiplicity: a legitimately-shared core is not
+    an accounting gap, and overflow tenants spread to the least-loaded
+    core instead of stacking on the lowest."""
+    chip = discovery.Chip(index=0, id="c", dev_paths=(), hbm_bytes=16 << 30,
+                          cores=2, generation="v3")
+    # A(0), C(0) share core 0 after B departed: core 1 provably free
+    core, exclusive = allocate.pick_core(chip, {0: 2}, cotenants=2)
+    assert (core, exclusive) == (1, True)
+    # full chip {0: 2, 1: 1}: overflow goes to the LEAST-loaded core 1
+    core, exclusive = allocate.pick_core(chip, {0: 2, 1: 1}, cotenants=3)
+    assert (core, exclusive) == (1, False)
+
+
+def test_failed_assign_patch_suppresses_tenancy_claims(api, tmp_path):
+    """If the ASSIGNED/core patch cannot be written, the core grant was
+    never recorded — the response must not claim it (an unrecorded pin
+    is invisible to every future tenancy read and would double-book)."""
+    plugin = _plugin(api, tmp_path, "v3")
+    try:
+        api.pods = [make_pod("w", tpu_mem=4, assume_time=1, assigned="false",
+                             chip_idx=0, phase="Pending")]
+        api.patch_conflicts_remaining = 2   # exhausts the single retry
+        envs = _allocate(plugin, 4)
+        assert envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"  # grant still works
+        for key in (const.ENV_VISIBLE_CORE, const.ENV_CORE_EXCLUSIVE,
+                    const.ENV_COTENANTS):
+            assert key not in envs
+        anns = api.pods[0]["metadata"]["annotations"]
+        assert anns[const.ANN_TPU_MEM_ASSIGNED] == "false"
+    finally:
+        plugin.stop()
 
 
 def test_contract_surfaces_core_grant():

@@ -43,31 +43,32 @@ LIBTPU_HOST_PATHS = (
 )
 
 
-def pick_core(chip: Chip, occupied,
-              cotenants: int = 0) -> Tuple[Optional[int], Optional[bool]]:
+def pick_core(chip: Chip, core_counts, cotenants: int = 0,
+              unannotated: int = 0) -> Tuple[Optional[int], Optional[bool]]:
     """(granted TensorCore, exclusive?) for a new tenant.
 
     Lowest FREE core first (SURVEY §2.3 disjoint bounds — a departed
     tenant's core is reused, reconstructed from live pods' annotations);
-    when every core is taken the lowest core is shared, isolation
-    degrading to the advisory HBM fraction — the same trade the
-    reference makes with cGPU off.  Single-core chips (v4 megacore,
-    v5e) never split and never annotate a core, so their exclusivity
-    comes from the live co-tenant COUNT, not core occupancy.
+    when every core is taken the LEAST-LOADED core is shared (``core_
+    counts`` keeps multiplicity so overflow tenants balance instead of
+    stacking on one core), isolation degrading to the advisory HBM
+    fraction — the same trade the reference makes with cGPU off.
+    Single-core chips (v4 megacore, v5e) never split and never annotate
+    a core, so their exclusivity comes from the live co-tenant COUNT.
 
-    Exclusivity is ``None`` (unknown, env omitted) when some live
-    tenant has no core annotation (``cotenants > len(occupied)`` on a
-    multi-core chip): that tenant may sit on any core — e.g. its
-    assigned-patch failed (tolerated) or it predates core grants — so
-    an affirmative "alone on this silicon" claim would be unsound.
+    Exclusivity is ``None`` (unknown, env omitted) when ``unannotated``
+    tenants exist on a multi-core chip: a tenant with no core
+    annotation (legacy plugin) may sit on any core, so an affirmative
+    "alone on this silicon" claim would be unsound.
     """
     if chip.cores <= 1:
         return None, cotenants == 0
-    unaccounted = cotenants > len(occupied)
+    unknown = unannotated > 0
     for c in range(chip.cores):
-        if c not in occupied:
-            return c, (None if unaccounted else True)
-    return min(occupied) if occupied else 0, False
+        if core_counts.get(c, 0) == 0:
+            return c, (None if unknown else True)
+    c = min(range(chip.cores), key=lambda k: (core_counts.get(k, 0), k))
+    return c, (None if unknown else False)
 
 
 def container_response(plugin, chip: Chip, container_units: int,
@@ -165,16 +166,22 @@ def make_allocator(pod_manager):
         log.info("Allocate: request for %d %s", pod_req, plugin.memory_unit)
 
         with lock:
-            pod = None
+            # ONE node-pod snapshot per Allocate: candidate matching and
+            # tenancy reconstruction both read it (a second full list per
+            # allocation would double apiserver load and retry latency
+            # inside the kubelet's RPC deadline).
+            pods_list, fresh = [], False
             try:
-                candidates = pod_manager.candidate_pods()
-                for p in candidates:
-                    if pod_manager.pod_request_units(p) == pod_req:
-                        pod = p
-                        break
+                pods_list, fresh = pod_manager.allocation_snapshot()
             except Exception:
-                log.exception("listing candidate pods failed")
-                candidates = []
+                log.exception("node pod snapshot failed")
+
+            pod = None
+            candidates = pod_manager.candidates_from(pods_list)
+            for p in candidates:
+                if pod_manager.pod_request_units(p) == pod_req:
+                    pod = p
+                    break
 
             chip: Optional[Chip] = None
             if pod is not None:
@@ -195,19 +202,39 @@ def make_allocator(pod_manager):
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
-            try:
-                tenancy = pod_manager.chip_tenancy(chip.index)
-            except Exception:
-                log.exception("chip tenancy read failed; tenancy unknown")
-                tenancy = None
-            if tenancy is None:
-                # No cluster state: claim nothing (no core pin either —
-                # a fabricated "core 0, exclusive" could double-book a
-                # live tenant's silicon).
-                cotenants, core, exclusive = None, None, None
+            if fresh:
+                cotenants, counts, unann = pod_manager.chip_tenancy_from(
+                    pods_list, chip.index)
+                core, exclusive = pick_core(chip, counts, cotenants, unann)
             else:
-                cotenants, occupied = tenancy
-                core, exclusive = pick_core(chip, occupied, cotenants)
+                # Stale (kubelet-cache) or missing snapshot: good enough
+                # to match a pending pod, NOT to claim core occupancy —
+                # a fabricated "core 0, exclusive" could double-book a
+                # live tenant's silicon.
+                cotenants, core, exclusive = None, None, None
+            if pod is None:
+                # Fast-path grant with no pod to annotate: the tenant
+                # will be invisible to every future tenancy read, so ANY
+                # claim (core pin, exclusivity, co-tenant count) would
+                # be unsound for it and for later tenants counting it —
+                # share by fraction, claim nothing.
+                cotenants, core, exclusive = None, None, None
+
+            # Acknowledge BEFORE building the response: if the assigned
+            # patch fails (tolerated — pod stays assumed and ages out,
+            # allocate.go:135-149), the core grant was never recorded,
+            # so the response must not claim it either: an unrecorded
+            # pin is invisible to every future tenancy read.
+            if pod is not None:
+                try:
+                    extra = ({const.ANN_TPU_CORE: str(core)}
+                             if core is not None else None)
+                    pod_manager.mark_assigned(pod, extra_annotations=extra)
+                except Exception:
+                    log.exception("marking pod assigned failed; "
+                                  "suppressing tenancy claims")
+                    cotenants, core, exclusive = None, None, None
+
             resp = pb.AllocateResponse()
             for creq in request.container_requests:
                 resp.container_responses.append(container_response(
@@ -216,17 +243,6 @@ def make_allocator(pod_manager):
                     core_exclusive=exclusive))
             from . import status
             status.inc("tpushare_allocations_total")
-
-            if pod is not None:
-                try:
-                    extra = ({const.ANN_TPU_CORE: str(core)}
-                             if core is not None else None)
-                    pod_manager.mark_assigned(pod, extra_annotations=extra)
-                except Exception:
-                    # Patch failure is logged, not fatal: kubelet keeps the
-                    # allocation; the pod stays "assumed" and ages out
-                    # (matches the reference's tolerance, allocate.go:135-149).
-                    log.exception("marking pod assigned failed")
             return resp
 
     return allocator

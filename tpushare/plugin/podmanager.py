@@ -77,47 +77,75 @@ class PodManager:
 
     def candidate_pods(self) -> List[dict]:
         """Assumed pods on this node, oldest assume-time first (FIFO)."""
-        cands = [p for p in self.pending_pods() if podutils.is_assumed_pod(p)]
+        return self.candidates_from(self.pending_pods())
+
+    def allocation_snapshot(self):
+        """ONE node-pod list serving a whole Allocate: (pods, fresh).
+
+        Both halves of an Allocate — candidate matching and chip-tenancy
+        reconstruction — derive from this single list, so an allocation
+        pays one listing round-trip, not two.  The APISERVER is tried
+        first (unlike pending_pods' kubelet-first order): annotations
+        (assume/assign handshake, core grants) are patched there and
+        kubelet's /pods cache can lag them by seconds — long enough for
+        two back-to-back Allocates to double-book a core.  ``fresh`` is
+        False on the kubelet fallback: good enough to MATCH a pending
+        pod, but tenancy claims built from a cache known to lag must be
+        suppressed by the caller.  Raises when both sources fail.
+        """
+        last: Exception = RuntimeError("unreachable")
+        for attempt in range(APISERVER_RETRIES):
+            try:
+                return self.kube.list_pods(node_name=self.node_name), True
+            except Exception as e:
+                last = e
+                log.warning("apiserver snapshot attempt %d failed: %s",
+                            attempt + 1, e)
+                if attempt < APISERVER_RETRIES - 1:  # last failure falls
+                    time.sleep(APISERVER_RETRY_SLEEP)  # through to kubelet
+        if self.kubelet is not None:
+            pods = self._all_pods_via_kubelet()
+            if pods is not None:
+                return pods, False
+        raise last
+
+    def _all_pods_via_kubelet(self) -> Optional[List[dict]]:
+        """Kubelet /pods with the standard retry budget, unfiltered."""
+        if self.kubelet is None:
+            return None
+        for attempt in range(KUBELET_RETRIES):
+            try:
+                return self.kubelet.get_node_running_pods()
+            except Exception as e:
+                log.warning("kubelet /pods/ attempt %d failed: %s",
+                            attempt + 1, e)
+                if attempt < KUBELET_RETRIES - 1:  # last failure returns
+                    time.sleep(KUBELET_RETRY_SLEEP)  # immediately
+        return None
+
+    def candidates_from(self, pods: List[dict]) -> List[dict]:
+        """Assumed pending pods, FIFO by assume-time, from a snapshot."""
+        cands = [p for p in pods
+                 if podutils.is_pending_pod(p) and podutils.is_assumed_pod(p)]
         cands.sort(key=lambda p: (podutils.assume_time(p) or 0))
         return cands
 
-    def chip_tenancy(self, chip_index: int):
-        """(live tenant count, occupied TensorCores) for one chip, or
-        ``None`` when the cluster state could not be read at all — the
-        caller must then emit NO tenancy claims rather than fabricate
-        an empty chip.
+    @staticmethod
+    def chip_tenancy_from(pods: List[dict], chip_index: int):
+        """(live tenants, {core: occupant count}, un-annotated tenants)
+        for one chip, from a snapshot.
 
         The allocator grants each new co-tenant the lowest FREE core
         (SURVEY §2.3 disjoint bounds) — occupancy is reconstructed from
-        the ``ALIYUN_COM_TPU_CORE`` annotation of live ASSIGNED pods, the
-        same cluster-state-is-truth channel the extender writes and the
-        inspect CLI reads (repo convention: all three agree).  Reading
-        the APISERVER first matters here (unlike pending_pods, which is
-        kubelet-first for phase freshness): annotations are patched at
-        the apiserver, and kubelet's /pods cache can lag them by
-        seconds — long enough for two back-to-back Allocates to
-        double-book a core.  3x1s apiserver retries, then one kubelet
-        attempt as fallback.
+        the ``ALIYUN_COM_TPU_CORE`` annotation of live ASSIGNED pods,
+        the same cluster-state-is-truth channel the extender writes and
+        the inspect CLI reads (repo convention: all three agree).  Core
+        counts keep MULTIPLICITY so overflow tenants spread to the
+        least-loaded core and a legitimately-shared core doesn't read
+        as an accounting gap; ``un-annotated`` counts tenants with no
+        core annotation (legacy plugins), whose whereabouts are unknown.
         """
-        pods = None
-        for attempt in range(APISERVER_RETRIES):
-            try:
-                pods = self.kube.list_pods(node_name=self.node_name)
-                break
-            except Exception as e:
-                log.warning("apiserver tenancy list attempt %d failed: %s",
-                            attempt + 1, e)
-                if attempt < APISERVER_RETRIES - 1:  # last failure falls
-                    time.sleep(APISERVER_RETRY_SLEEP)  # through immediately
-        if pods is None and self.kubelet is not None:
-            try:
-                pods = self.kubelet.get_node_running_pods()
-            except Exception:
-                pass
-        if pods is None:
-            log.error("listing pods for chip tenancy failed; tenancy unknown")
-            return None
-        n, occupied = 0, set()
+        n, counts, unannotated = 0, {}, 0
         for p in pods:
             if not podutils.is_active_pod(p):
                 continue
@@ -128,10 +156,11 @@ class PodManager:
                 continue
             n += 1
             try:
-                occupied.add(int(anns[const.ANN_TPU_CORE]))
+                core = int(anns[const.ANN_TPU_CORE])
+                counts[core] = counts.get(core, 0) + 1
             except (KeyError, ValueError):
-                pass   # single-core grant or pre-core-annotation pod
-        return n, occupied
+                unannotated += 1   # single-core grant or legacy pod
+        return n, counts, unannotated
 
     # -- adapter surface used by allocate.make_allocator --------------------
     def pod_request_units(self, pod: dict) -> int:
