@@ -143,3 +143,19 @@ def test_patch_chip_count_and_isolation_label(api):
     assert api.nodes["node-a"]["status"]["capacity"][const.COUNT_NAME] == "4"
     assert api.nodes["node-a"]["status"]["allocatable"][const.COUNT_NAME] == "4"
     assert pm.isolation_disabled()
+
+
+def test_isolation_label_flip_applies_after_ttl(api):
+    """The label cache has a TTL (improving on the reference, which only
+    re-reads at plugin restart): a flip takes effect once it expires,
+    and within the TTL no extra apiserver reads happen."""
+    api.nodes["node-a"] = {"metadata": {"name": "node-a", "labels": {}},
+                           "status": {}}
+    pm = PodManager(kube_for(api), "node-a", isolation_label_ttl=0.05)
+    assert pm.isolation_disabled() is False
+    api.nodes["node-a"]["metadata"]["labels"][
+        const.LABEL_ISOLATION_DISABLE] = "true"
+    assert pm.isolation_disabled() is False   # cache still warm
+    import time as _t
+    _t.sleep(0.06)
+    assert pm.isolation_disabled() is True    # TTL expired -> re-read

@@ -202,22 +202,17 @@ def make_allocator(pod_manager):
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
-            if fresh:
+            if fresh and pod is not None:
                 cotenants, counts, unann = pod_manager.chip_tenancy_from(
                     pods_list, chip.index)
                 core, exclusive = pick_core(chip, counts, cotenants, unann)
             else:
-                # Stale (kubelet-cache) or missing snapshot: good enough
-                # to match a pending pod, NOT to claim core occupancy —
-                # a fabricated "core 0, exclusive" could double-book a
-                # live tenant's silicon.
-                cotenants, core, exclusive = None, None, None
-            if pod is None:
-                # Fast-path grant with no pod to annotate: the tenant
-                # will be invisible to every future tenancy read, so ANY
-                # claim (core pin, exclusivity, co-tenant count) would
-                # be unsound for it and for later tenants counting it —
-                # share by fraction, claim nothing.
+                # Claim nothing when tenancy can't be trusted or
+                # recorded: a stale (kubelet-cache) or missing snapshot
+                # could double-book a live tenant's silicon, and a
+                # fast-path grant with no pod to annotate would be
+                # invisible to every future tenancy read — share by
+                # fraction instead.
                 cotenants, core, exclusive = None, None, None
 
             # Acknowledge BEFORE building the response: if the assigned

@@ -34,25 +34,22 @@ APISERVER_RETRY_SLEEP = 1.0
 class PodManager:
     def __init__(self, kube: KubeClient, node_name: str,
                  kubelet_client: Optional[KubeletClient] = None,
-                 resource_name: str = const.RESOURCE_NAME):
+                 resource_name: str = const.RESOURCE_NAME,
+                 isolation_label_ttl: float = 300.0):
         self.kube = kube
         self.node_name = node_name
         self.kubelet = kubelet_client
         self.resource_name = resource_name
+        self.isolation_label_ttl = isolation_label_ttl
         self._isolation_disabled: Optional[bool] = None
+        self._isolation_read_at = 0.0
 
     # -- pending/assumed pod listing ----------------------------------------
     def _pending_via_kubelet(self) -> Optional[List[dict]]:
-        assert self.kubelet is not None
-        for attempt in range(KUBELET_RETRIES):
-            try:
-                pods = self.kubelet.get_node_running_pods()
-                return [p for p in pods if podutils.is_pending_pod(p)]
-            except Exception as e:
-                log.warning("kubelet /pods/ attempt %d failed: %s",
-                            attempt + 1, e)
-                time.sleep(KUBELET_RETRY_SLEEP)
-        return None
+        pods = self._all_pods_via_kubelet()
+        if pods is None:
+            return None
+        return [p for p in pods if podutils.is_pending_pod(p)]
 
     def _pending_via_apiserver(self) -> List[dict]:
         last: Exception = RuntimeError("unreachable")
@@ -219,17 +216,29 @@ class PodManager:
     def isolation_disabled(self) -> bool:
         """Node label opt-out from advisory isolation (podmanager.go:59-72).
 
-        Resolved once and cached — the reference reads it at startup; an
-        apiserver round-trip per Allocate (inside the allocation lock)
-        would add latency to every container start.
+        Cached with a TTL: an apiserver round-trip per Allocate (inside
+        the allocation lock) would add latency to every container start,
+        but a forever-cache would pin a label flip until daemon restart.
+        The reference re-reads only at plugin restart
+        (``NewNvidiaDevicePlugin`` → ``disableCGPUIsolationOrNot``); the
+        TTL strictly improves on that — a flip takes effect within
+        ``isolation_label_ttl`` seconds with no restart at all.  On a
+        read failure the last known value (or False) is served.
         """
-        if self._isolation_disabled is None:
+        now = time.monotonic()
+        if (self._isolation_disabled is None
+                or now - self._isolation_read_at >= self.isolation_label_ttl):
             try:
                 node = self.kube.get_node(self.node_name)
                 labels = node.get("metadata", {}).get("labels") or {}
                 self._isolation_disabled = labels.get(
                     const.LABEL_ISOLATION_DISABLE, "").lower() == "true"
+                self._isolation_read_at = now
             except Exception:
                 log.exception("reading node %s failed", self.node_name)
-                return False
+                # Serve the stale value and restart the TTL clock: during
+                # an apiserver outage every Allocate would otherwise pay
+                # a get_node timeout inside the allocation lock.
+                self._isolation_read_at = now
+                return bool(self._isolation_disabled)
         return self._isolation_disabled
