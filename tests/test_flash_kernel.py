@@ -79,6 +79,31 @@ def test_flash_kernel_headdim64_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("d", [64, 128])
+def test_flash_kernel_grad_matches_reference(d):
+    """jax.grad through the flash path must work (custom VJP — the raw
+    pallas_call has no transpose rule) and match the reference's grads:
+    a TPU training step dispatching to flash depends on this."""
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 2, 128, d), jnp.float32)
+    k = jax.random.normal(kk, (1, 1, 128, d), jnp.float32)   # GQA
+    v = jax.random.normal(kv, (1, 1, 128, d), jnp.float32)
+
+    def flash_loss(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                interpret=True) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-4)
+
+
 def test_flash_kernel_bf16_io():
     key = jax.random.PRNGKey(3)
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.bfloat16)

@@ -148,14 +148,15 @@ def test_patch_chip_count_and_isolation_label(api):
 def test_isolation_label_flip_applies_after_ttl(api):
     """The label cache has a TTL (improving on the reference, which only
     re-reads at plugin restart): a flip takes effect once it expires,
-    and within the TTL no extra apiserver reads happen."""
+    and within the TTL no extra apiserver reads happen.  The warm-cache
+    half uses a long TTL (no wall-clock race on a loaded machine); the
+    expiry half rewinds the recorded read time instead of sleeping."""
     api.nodes["node-a"] = {"metadata": {"name": "node-a", "labels": {}},
                            "status": {}}
-    pm = PodManager(kube_for(api), "node-a", isolation_label_ttl=0.05)
+    pm = PodManager(kube_for(api), "node-a", isolation_label_ttl=300.0)
     assert pm.isolation_disabled() is False
     api.nodes["node-a"]["metadata"]["labels"][
         const.LABEL_ISOLATION_DISABLE] = "true"
     assert pm.isolation_disabled() is False   # cache still warm
-    import time as _t
-    _t.sleep(0.06)
+    pm._isolation_read_at -= 301.0            # force expiry, no sleep
     assert pm.isolation_disabled() is True    # TTL expired -> re-read

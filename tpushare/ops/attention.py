@@ -95,11 +95,53 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    """Differentiable flash attention core.
+
+    Forward is the Pallas kernel; backward recomputes attention with the
+    mathematically-identical jnp reference and differentiates that —
+    ``pallas_call`` has no transpose rule, so without this custom VJP
+    any ``jax.grad`` through a TPU training step that dispatched to the
+    flash kernel would crash.  The recompute backward costs the standard
+    flash-backward FLOPs class but materializes the [S, S] probabilities
+    (O(S^2) memory) — fine at training sequence lengths on one chip;
+    long-context training shards sequence via ring attention instead of
+    this kernel.  A fused flash backward kernel can replace it without
+    touching callers.
+    """
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_core(q, k, v, causal, block_q, block_k, interpret), \
+        (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g.astype(q.dtype))
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool = False):
+    """Differentiable Pallas flash attention (see :func:`_flash_core`)."""
+    return _flash_core(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_pallas(q, k, v, causal: bool = True,
+                  block_q: int = 128, block_k: int = 128,
+                  interpret: bool = False):
     """Pallas flash attention; q,k,v: [B, H, S, D], S % block == 0.
 
     ``interpret=True`` runs the kernel through the Pallas interpreter —

@@ -226,19 +226,18 @@ class PodManager:
         read failure the last known value (or False) is served.
         """
         now = time.monotonic()
-        if (self._isolation_disabled is None
+        if (not self._isolation_read_at
                 or now - self._isolation_read_at >= self.isolation_label_ttl):
             try:
                 node = self.kube.get_node(self.node_name)
                 labels = node.get("metadata", {}).get("labels") or {}
                 self._isolation_disabled = labels.get(
                     const.LABEL_ISOLATION_DISABLE, "").lower() == "true"
-                self._isolation_read_at = now
             except Exception:
                 log.exception("reading node %s failed", self.node_name)
-                # Serve the stale value and restart the TTL clock: during
-                # an apiserver outage every Allocate would otherwise pay
-                # a get_node timeout inside the allocation lock.
-                self._isolation_read_at = now
-                return bool(self._isolation_disabled)
-        return self._isolation_disabled
+                # Serve the last-known (or safe False) value; the clock
+                # below still restarts so an apiserver outage costs ONE
+                # get_node timeout per TTL — not one per Allocate — even
+                # when the very first read is the one failing.
+            self._isolation_read_at = now
+        return bool(self._isolation_disabled)
