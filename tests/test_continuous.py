@@ -64,6 +64,85 @@ def test_single_token_request_completes_at_admit(model):
     assert b.free_slots() == [0]  # no slot consumed
 
 
+def test_chunked_prefill_outputs_equal_unchunked(model):
+    """A prompt streamed through 4-token chunks must decode the exact
+    same tokens as whole-prompt admission (and generate())."""
+    params, cfg = model
+    prompt, n = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5], 7   # 11 tokens, 3 chunks
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    rid = b.admit_chunked(prompt, n, chunk=4)
+    assert rid is not None and b.free_slots() == [1]   # slot 0 reserved
+    assert not b.slots                                  # still prefilling
+    b.run_until_drained()
+    assert b.completed[rid] == _plain(params, cfg, prompt, n)
+
+
+def test_chunked_prefill_interleaves_with_decode(model):
+    """Decoding slots keep ticking while another slot's long prompt
+    prefills chunk by chunk; both outputs stay exact."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    r1 = b.admit([7, 8, 9], 10)          # decoding immediately
+    for _ in range(2):
+        b.tick()
+    r2 = b.admit_chunked([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 5, chunk=3)
+    # hand-interleave: one chunk, one tick, repeatedly
+    while b.prefilling:
+        b.advance_prefill()
+        b.tick()
+    b.run_until_drained()
+    assert b.completed[r1] == _plain(params, cfg, [7, 8, 9], 10)
+    assert b.completed[r2] == _plain(
+        params, cfg, [1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 5)
+
+
+def test_chunked_prefill_window_clamped_at_max_seq(model):
+    """Regression: when pos+chunk would cross max_seq, the padded window
+    must be clamped — the in-jit scatter clamps out-of-range starts and
+    would otherwise silently overwrite earlier real prompt K/V."""
+    params, cfg = model                      # max_seq 96
+    prompt = [1 + (i % 90) for i in range(70)]
+    b = ContinuousBatcher(params, cfg, n_slots=1)
+    rid = b.admit_chunked(prompt, 6, chunk=64)   # chunk 2: pos=64, 64+64>96
+    b.run_until_drained()
+    assert b.completed[rid] == _plain(params, cfg, prompt, 6)
+
+
+def test_chunked_prefill_single_token_and_sampling(model):
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=1)
+    rid = b.admit_chunked([4, 2, 4, 2, 4], 1, chunk=2)   # 1 new token
+    b.run_until_drained()
+    assert b.completed[rid] == _plain(params, cfg, [4, 2, 4, 2, 4], 1)
+    # sampling path: chunked == unchunked for the same seed
+    b2 = ContinuousBatcher(params, cfg, n_slots=1)
+    ra = b2.admit([5, 4, 3, 2, 1, 0, 6], 6, temperature=0.9, seed=11)
+    b2.run_until_drained()
+    b3 = ContinuousBatcher(params, cfg, n_slots=1)
+    rb = b3.admit_chunked([5, 4, 3, 2, 1, 0, 6], 6, temperature=0.9,
+                          seed=11, chunk=3)
+    b3.run_until_drained()
+    assert b2.completed[ra] == b3.completed[rb]
+
+
+def test_service_chunked_prefill_end_to_end(model):
+    """The service admits through the chunked path by default; outputs
+    must still match per-request greedy decoding."""
+    from tpushare.serving.continuous import ContinuousService
+
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2,
+                                prefill_chunk=4).start()
+    try:
+        reqs = [([3, 5, 7, 9, 11, 13, 15, 17, 19], 6), ([2, 4], 4),
+                ([1] * 13, 5)]
+        sinks = [service.submit(p, n) for p, n in reqs]
+        for sink, (p, n) in zip(sinks, reqs):
+            assert sink.get(timeout=120) == _plain(params, cfg, p, n)
+    finally:
+        service.stop()
+
+
 def test_service_concurrent_submissions_match_plain(model):
     """ContinuousService under concurrent submitters == per-request
     greedy, including queueing beyond the slot pool."""

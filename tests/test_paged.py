@@ -151,6 +151,43 @@ def test_impossible_request_raises_not_requeues(model):
         service.stop()
 
 
+def test_paged_chunked_prefill_matches_plain(model):
+    """Page-aligned chunked prefill (windows of 2 pages) must decode the
+    same tokens as whole-prompt paged admission and generate()."""
+    params, cfg = model                      # max_seq 96
+    prompt = [1 + (i % 90) for i in range(40)]
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+    rid = b.admit_chunked(prompt, 6, chunk=32)   # 2 windows: 32 + 8->32pad
+    assert not b.slots and rid is not None       # still prefilling
+    b.run_until_drained()
+    assert b.completed[rid] == _plain(params, cfg, prompt, 6)
+
+
+def test_paged_chunked_interleaves_with_decode(model):
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+    r1 = b.admit([7, 8, 9], 9)
+    b.tick()
+    r2 = b.admit_chunked([2] * 50, 4, chunk=16)
+    while b.prefilling:
+        b.advance_prefill()
+        b.tick()
+    b.run_until_drained()
+    assert b.completed[r1] == _plain(params, cfg, [7, 8, 9], 9)
+    assert b.completed[r2] == _plain(params, cfg, [2] * 50, 4)
+
+
+def test_paged_chunk_rounded_to_page_multiple(model):
+    """A chunk that is not a page multiple is rounded up, keeping every
+    window page-aligned."""
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=1, page_size=16)
+    rid = b.admit_chunked([3] * 20, 4, chunk=10)   # -> chunk 16
+    assert b.prefilling and list(b.prefilling.values())[0].chunk == 16
+    b.run_until_drained()
+    assert b.completed[rid] == _plain(params, cfg, [3] * 20, 4)
+
+
 def test_paged_sampling_is_reproducible(model):
     params, cfg = model
     outs = []

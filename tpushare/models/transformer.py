@@ -395,46 +395,78 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     return logits, (new_kp, new_vp)
 
 
-def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
-                          page_rows, prompt_len: int):
-    """Prefill ONE request into its reserved pages.
+def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
+                                page_rows, pos, last_idx):
+    """One prompt WINDOW into a slot's reserved pages at offset ``pos``.
 
-    tokens [1, prompt_len]; page_rows [max_seq//page] int32 — this slot's
-    page-table row (logical order, 0-padded past the reservation).
-    Attention over the prompt needs no cache (plain causal self-attn via
-    the dispatching :func:`tpushare.ops.attention.attention`); the
-    computed K/V stream into the pool pages chunk by chunk.  Returns
-    (last-position logits [1, vocab], updated pools).
+    tokens [1, W] with W a multiple of the page size and ``pos``
+    page-aligned (the paged batcher guarantees both); page_rows
+    [max_seq//page] int32 — this slot's page-table row (logical order,
+    0-padded past the reservation).  The window's queries attend the
+    already-written history THROUGH the pool (gather, exactly like
+    decode) plus themselves causally, so chunked and whole-prompt
+    prefill produce identical numbers.  Padded-tail garbage K/V is
+    doubly contained: within the reservation it occupies positions the
+    next window or the decode loop overwrites before they become
+    attendable, and a window overflowing the reservation writes whole
+    pages to the TRASH page (page_rows is 0-padded past the
+    reservation) which the position mask keeps out of every softmax.
+    Returns (logits [vocab] at ``last_idx``, updated pools).
     """
     b, s = tokens.shape
     if b != 1:
         raise ValueError("paged prefill is per-request (batch 1)")
-    positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
-    x = params["embed"][tokens].astype(cfg.dtype)
     kp, vp = pools
     page = kp.shape[3]
-    n_chunks = -(-prompt_len // page)           # static
+    if s % page:
+        raise ValueError("prefill window must be page-aligned")
+    positions = pos + jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    x = params["embed"][tokens].astype(cfg.dtype)
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    n_chunks = s // page                        # static
+    first_page = pos // page                    # traced
 
     def body(x, layer_and_pool):
         layer, kpool, vpool = layer_and_pool
 
         def attend(lyr, xin):
-            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [1, Hkv, S, D]
+            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [1, Hkv, W, D]
             kp2, vp2 = kpool, vpool
             for j in range(n_chunks):           # static page walk
-                cl = min(page, s - j * page)
-                # chunk [1, Hkv, cl, D] already matches pool rank/layout
+                pid = page_rows[first_page + j]
+                # piece [1, Hkv, page, D] already matches pool layout
                 kp2 = jax.lax.dynamic_update_slice(
-                    kp2, k[:, :, j * page:j * page + cl, :],
-                    (page_rows[j], 0, 0, 0))
+                    kp2, k[:, :, j * page:(j + 1) * page, :],
+                    (pid, 0, 0, 0))
                 vp2 = jax.lax.dynamic_update_slice(
-                    vp2, v[:, :, j * page:j * page + cl, :],
-                    (page_rows[j], 0, 0, 0))
-            return attention(q, k, v, causal=True), (kp2, vp2)
+                    vp2, v[:, :, j * page:(j + 1) * page, :],
+                    (pid, 0, 0, 0))
+            o = cached_attention(
+                q, _expand_kv(_paged_gather(kp2, page_rows[None]), h // hkv),
+                _expand_kv(_paged_gather(vp2, page_rows[None]), h // hkv),
+                positions)
+            return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
 
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[0, last_idx], params["lm_head"]).astype(jnp.float32)
     return logits, (new_kp, new_vp)
+
+
+def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
+                          page_rows, prompt_len: int):
+    """Prefill ONE whole request into its reserved pages: the page-
+    aligned chunk body (:func:`forward_paged_prefill_chunk`) at pos 0,
+    with the prompt padded to a page multiple.  Returns (last-position
+    logits [1, vocab], updated pools)."""
+    b, s = tokens.shape
+    kp, _ = pools
+    page = kp.shape[3]
+    w = -(-s // page) * page
+    if w != s:
+        tokens = jnp.pad(tokens[:, :s], ((0, 0), (0, w - s)))
+    logits, pools = forward_paged_prefill_chunk(
+        params, tokens, cfg, pools, page_rows, 0, prompt_len - 1)
+    return logits[None], pools

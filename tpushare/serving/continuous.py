@@ -32,24 +32,52 @@ from ..models import transformer
 log = logging.getLogger("tpushare.serving")
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"),
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len"),
                    donate_argnums=(2,))
-def _prefill_slot(params, tokens, caches, slot, cfg, prompt_len: int):
-    """Prefill one request directly into row ``slot`` of the pooled cache.
+def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
+                   chunk_len: int):
+    """One prompt chunk into row ``slot`` at cache offset ``pos`` —
+    whole-prompt prefill is just the ``pos=0`` single-chunk case, so
+    the slice-row/forward/scatter body exists ONCE.
 
-    Slice, forward, and scatter all happen inside one jit (with the pool
-    donated), so admission never materializes a second copy of the
-    multi-GB cache on the host path.  ``slot`` is traced — one compile
-    serves every slot.
+    Slice, forward, and scatter all happen inside one jit (with the
+    pool donated), so admission never materializes a second copy of the
+    multi-GB cache on the host path; ``slot``/``pos`` are traced.
+    Chunked prefill bounds how long a new request can stall decoding
+    slots (head-of-line blocking): a long prompt streams through in
+    fixed-size pieces interleaved with ticks.  ``tokens`` is padded to
+    ``chunk_len`` so one compile serves every like-sized chunk; the
+    caller must keep ``pos + chunk_len <= max_seq`` (the in-jit scatter
+    CLAMPS its start index — a window past the end would silently
+    overwrite earlier real positions).  Within that bound the padded
+    tail is harmless: causality keeps real queries from attending it,
+    and its garbage K/V occupies positions that the next chunk or the
+    decode loop overwrites before they ever become attendable (position
+    p is written at length==p before any query attends p).
+    ``last_idx`` selects the final REAL position's logits (only the
+    last chunk's are consumed).
     """
     row = jax.tree_util.tree_map(
         lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), caches)
     logits, row = transformer.forward(
-        params, tokens[:, :prompt_len], cfg, kv_caches=row, cache_len=0)
+        params, tokens[:, :chunk_len], cfg, kv_caches=row, cache_len=pos)
     caches = jax.tree_util.tree_map(
         lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
         caches, row)
-    return logits[:, -1], caches
+    return logits[0, last_idx], caches
+
+
+@dataclasses.dataclass
+class _Prefill:
+    """A slot mid-prefill (not yet decoding)."""
+
+    request_id: int
+    prompt: List[int]
+    pos: int             # prompt tokens already in the cache
+    max_new: int
+    temperature: float
+    seed: int
+    chunk: int = 64
 
 
 def _sample_next(logits, temps, keys):
@@ -102,6 +130,7 @@ class ContinuousBatcher:
         self.cfg = cfg
         self.n_slots = n_slots
         self.slots: Dict[int, _Slot] = {}      # slot index -> live request
+        self.prefilling: Dict[int, _Prefill] = {}   # slot -> mid-prefill
         self._next_id = 0
         self.completed: Dict[int, List[int]] = {}
         self._init_storage()
@@ -118,8 +147,11 @@ class ContinuousBatcher:
         """Return per-request storage on completion."""
 
     def _prefill_into(self, slot: int, tokens, prompt_len: int):
-        logits, self.caches = _prefill_slot(
-            self.params, tokens, self.caches, slot, self.cfg, prompt_len)
+        """Whole-prompt prefill = one chunk at pos 0; returns [V] logits
+        at the prompt's last position."""
+        logits, self.caches = _prefill_chunk(
+            self.params, tokens, self.caches, slot, 0, prompt_len - 1,
+            self.cfg, prompt_len)
         return logits
 
     def _step(self, tokens, lengths, temps, keys):
@@ -127,9 +159,19 @@ class ContinuousBatcher:
             self.params, tokens, self.caches, lengths, temps, keys, self.cfg)
         return nxt
 
+    def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
+                            last_idx: int, chunk_len: int):
+        """One padded prompt chunk into the slot's cache; returns the
+        logits at ``last_idx`` (the chunk's final real position)."""
+        logits, self.caches = _prefill_chunk(
+            self.params, jnp.asarray(padded_tokens), self.caches,
+            slot, pos, last_idx, self.cfg, chunk_len)
+        return logits
+
     # ------------------------------------------------------------------
     def free_slots(self) -> List[int]:
-        return [i for i in range(self.n_slots) if i not in self.slots]
+        return [i for i in range(self.n_slots)
+                if i not in self.slots and i not in self.prefilling]
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -164,25 +206,83 @@ class ContinuousBatcher:
         self._next_id += 1
 
         tokens = jnp.asarray([prompt], jnp.int32)
-        logits = self._prefill_into(slot, tokens, len(prompt))
+        logits_v = self._prefill_into(slot, tokens, len(prompt))
+        self._activate(slot, rid, list(prompt), logits_v, max_new_tokens,
+                       temperature, seed)
+        return rid
+
+    def _activate(self, slot: int, rid: int, prompt: List[int], logits_v,
+                  max_new_tokens: int, temperature: float, seed: int) -> None:
+        """Prompt fully prefilled: sample the first token and start (or
+        finish) decoding — shared by admit() and chunked prefill so the
+        two admission paths produce bit-identical streams."""
         key = jax.random.PRNGKey(seed)
         if temperature > 0.0:
             key, sub = jax.random.split(key)
-            first = int(jax.random.categorical(sub, logits[0] / temperature))
+            first = int(jax.random.categorical(sub, logits_v / temperature))
         else:
-            first = int(jnp.argmax(logits[0]))
+            first = int(jnp.argmax(logits_v))
         # prefill already produced the first generated token
         remaining = max_new_tokens - 1
         output = list(prompt) + [first]
         if remaining == 0:
             self.completed[rid] = output
             self._release(slot)
-            return rid
+            return
         self.slots[slot] = _Slot(request_id=rid, length=len(prompt),
                                  remaining=remaining, last_token=first,
                                  output=output, temperature=temperature,
                                  key=key)
+
+    def admit_chunked(self, prompt: List[int], max_new_tokens: int,
+                      temperature: float = 0.0, seed: int = 0,
+                      chunk: int = 64) -> Optional[int]:
+        """Admit with the prompt streamed ``chunk`` tokens at a time by
+        subsequent :meth:`advance_prefill` calls, so a long prompt never
+        stalls decoding slots for more than one chunk's forward (the
+        prefill/decode co-location trade).  Same validation and
+        backpressure contract as :meth:`admit`; outputs are
+        bit-identical to unchunked admission.
+        """
+        self.validate_request(prompt, max_new_tokens)
+        if chunk < 1:
+            raise ValueError("chunk must be >= 1")
+        free = self.free_slots()
+        if not free:
+            return None
+        slot = free[0]
+        if not self._reserve(slot, len(prompt), max_new_tokens):
+            return None
+        rid = self._next_id
+        self._next_id += 1
+        self.prefilling[slot] = _Prefill(
+            request_id=rid, prompt=list(prompt), pos=0,
+            max_new=max_new_tokens, temperature=temperature, seed=seed,
+            chunk=chunk)
         return rid
+
+    def advance_prefill(self) -> int:
+        """Process ONE chunk for every mid-prefill slot; returns the
+        number of slots still prefilling afterwards."""
+        for slot, st in list(self.prefilling.items()):
+            n = len(st.prompt)
+            # Clamp the padded window at max_seq: the in-jit scatter
+            # clamps out-of-range starts, so an over-long window would
+            # silently wrap back over real cached positions.  Window
+            # sizes stay static-shaped: {chunk, max_seq mod chunk}.
+            window = min(st.chunk, self.cfg.max_seq - st.pos)
+            end = min(st.pos + window, n)
+            piece = st.prompt[st.pos:end]
+            padded = np.zeros((1, window), np.int32)
+            padded[0, :len(piece)] = piece
+            logits_v = self._prefill_chunk_into(
+                slot, padded, st.pos, len(piece) - 1, window)
+            st.pos = end
+            if end >= n:
+                del self.prefilling[slot]
+                self._activate(slot, st.request_id, st.prompt, logits_v,
+                               st.max_new, st.temperature, st.seed)
+        return len(self.prefilling)
 
     def tick(self) -> int:
         """One decode step for all active slots; returns #active before."""
@@ -192,6 +292,13 @@ class ContinuousBatcher:
         lengths = np.zeros((self.n_slots,), np.int32)
         temps = np.zeros((self.n_slots,), np.float32)
         keys = np.zeros((self.n_slots, 2), np.uint32)
+        # The tick unconditionally writes one garbage K/V at lengths[i]
+        # for every non-active slot.  Empty rows don't care, but a slot
+        # MID-PREFILL holds real prompt data — aim its garbage write at
+        # the next chunk's offset, which that chunk's forward overwrites
+        # before the position ever becomes attendable.
+        for i, st in self.prefilling.items():
+            lengths[i] = st.pos
         for i, s in self.slots.items():
             tokens[i, 0] = s.last_token
             lengths[i] = s.length
@@ -217,7 +324,9 @@ class ContinuousBatcher:
 
     def run_until_drained(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):
-            if not self.tick():
+            if self.prefilling:
+                self.advance_prefill()
+            if not self.tick() and not self.prefilling:
                 return
         raise RuntimeError("batcher did not drain")
 
@@ -233,11 +342,17 @@ class ContinuousService:
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  page_size: Optional[int] = None,
-                 n_pages: Optional[int] = None):
+                 n_pages: Optional[int] = None,
+                 prefill_chunk: int = 64):
         import queue as _q
         import threading
 
         self._q = _q
+        # Admission streams prompts in prefill_chunk-token pieces so a
+        # long prompt cannot stall decoding slots for more than one
+        # chunk's forward (paged storage rounds the chunk up to a page
+        # multiple, see paged.py).
+        self._prefill_chunk = max(1, prefill_chunk)
         if page_size is not None:
             # paged KV storage: more in-flight sequences per HBM byte
             from .paged import PagedContinuousBatcher
@@ -305,7 +420,8 @@ class ContinuousService:
         return sink
 
     def snapshot(self) -> dict:
-        """Occupancy for observability: {slots, active, queued}.
+        """Occupancy for observability: {slots, active, prefilling,
+        queued}.
 
         active/queued are read without the loop's cadence in mind — a
         point-in-time view for /stats, not a synchronization primitive.
@@ -314,6 +430,7 @@ class ContinuousService:
             queued = len(self._waiting)
         return {"slots": self._batcher.n_slots,
                 "active": len(self._batcher.slots),
+                "prefilling": len(self._batcher.prefilling),
                 "queued": queued}
 
     # ------------------------------------------------------------------
@@ -329,8 +446,9 @@ class ContinuousService:
                         break
                     item = self._waiting.pop(0)
                 prompt, max_new, temp, seed, sink = item
-                rid = self._batcher.admit(prompt, max_new,
-                                          temperature=temp, seed=seed)
+                rid = self._batcher.admit_chunked(
+                    prompt, max_new, temperature=temp, seed=seed,
+                    chunk=self._prefill_chunk)
                 if rid is None:
                     # Backpressure beyond free slots (paged storage can
                     # run out of pages with slots still free): requeue at
@@ -339,15 +457,18 @@ class ContinuousService:
                     with self._lock:
                         self._waiting.insert(0, item)
                     break
-                if rid in self._batcher.completed:  # single-token request
-                    sink.put(self._batcher.completed.pop(rid))
-                else:
-                    self._sinks[rid] = sink
+                # chunked admission never completes at admit time (even a
+                # 1-token request finishes in advance_prefill); results
+                # are delivered by the post-tick completed drain below
+                self._sinks[rid] = sink
+            if self._batcher.prefilling:
+                self._batcher.advance_prefill()
             active = self._batcher.tick()
             for rid in list(self._batcher.completed):
                 sink = self._sinks.pop(rid, None)
                 if sink is not None:
                     sink.put(self._batcher.completed.pop(rid))
             with self._lock:
-                if not active and not self._waiting and not self._sinks:
+                if (not active and not self._batcher.prefilling
+                        and not self._waiting and not self._sinks):
                     self._work.clear()

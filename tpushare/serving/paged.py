@@ -54,6 +54,14 @@ def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int):
         params, tokens, cfg, pools, page_rows, prompt_len)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "window"),
+                   donate_argnums=(2,))
+def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
+                   window: int):
+    return transformer.forward_paged_prefill_chunk(
+        params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
 def _tick(params, tokens, pools, page_table, lengths, temps, keys, cfg):
     """Paged twin of continuous._tick (same sampling helper)."""
@@ -116,7 +124,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         logits, self.pools = _prefill(
             self.params, tokens, self.pools,
             jnp.asarray(self.page_table[slot]), self.cfg, prompt_len)
-        return logits
+        return logits[0]      # [V]: the prompt's last-position logits
 
     def _step(self, tokens, lengths, temps, keys):
         nxt, self.pools = _tick(
@@ -124,6 +132,28 @@ class PagedContinuousBatcher(ContinuousBatcher):
             lengths, temps, keys, self.cfg)
         return nxt
 
+    def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
+                            last_idx: int, chunk_len: int):
+        logits, self.pools = _prefill_chunk(
+            self.params, jnp.asarray(padded_tokens), self.pools,
+            jnp.asarray(self.page_table[slot]), pos, last_idx, self.cfg,
+            chunk_len)
+        return logits
+
     # ------------------------------------------------------------------
+    def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,
+                      seed: int = 0, chunk: int = 64):
+        """Chunked admission with the window rounded UP to a page
+        multiple: paged writes are page-aligned (pos stays a multiple of
+        the window, the window a multiple of the page — max_seq is a
+        page multiple too, so the max_seq clamp preserves alignment).
+        Invalid chunks (< 1) raise in the base class, keeping the two
+        admission paths' validation identical."""
+        if chunk >= 1:
+            chunk = -(-chunk // self.page_size) * self.page_size
+        return super().admit_chunked(prompt, max_new_tokens,
+                                     temperature=temperature, seed=seed,
+                                     chunk=chunk)
+
     def free_page_count(self) -> int:
         return len(self._free_pages)
