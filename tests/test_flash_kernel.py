@@ -104,6 +104,41 @@ def test_flash_kernel_grad_matches_reference(d):
                                    atol=2e-4, rtol=1e-4)
 
 
+def test_flash_bwd_blocking_invariance_and_noncausal():
+    """The fused backward must give identical grads for different block
+    sizes, and handle the non-causal path (BERT's shape)."""
+    key = jax.random.PRNGKey(8)
+    q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss(q, k, v, blk):
+        return (flash_attention(q, k, v, causal=False, block_q=blk,
+                                block_k=blk, interpret=True) ** 2).sum()
+
+    g128 = jax.grad(lambda *a: loss(*a, 128), argnums=(0, 1, 2))(q, k, v)
+    g64 = jax.grad(lambda *a: loss(*a, 64), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (reference_attention(
+        q, k, v, causal=False) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b, c in zip(g128, g64, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-4,
+                                   rtol=1e-4)
+
+
+def test_flash_bwd_bf16_grad_dtypes():
+    """Cotangents of bf16 primals must come back bf16 (custom_vjp
+    contract) and stay finite."""
+    key = jax.random.PRNGKey(9)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 64), jnp.bfloat16)
+               for kk in jax.random.split(key, 3))
+    g = jax.grad(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for t, p in zip(g, (q, k, v)):
+        assert t.dtype == p.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(t.astype(jnp.float32))))
+
+
 def test_flash_kernel_bf16_io():
     key = jax.random.PRNGKey(3)
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.bfloat16)

@@ -50,9 +50,13 @@ def reference_attention(q, k, v, causal: bool = True,
 # ---------------------------------------------------------------------------
 # Pallas flash attention
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, seq_k: int):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, scale: float, seq_k: int):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    Also writes the per-row logsumexp of the SCALED scores — the single
+    statistic the fused backward needs to reconstruct P blockwise.
+    """
     from jax.experimental import pallas as pl
 
     q = q_ref[...].astype(jnp.float32) * scale          # [bq, d]
@@ -92,7 +96,106 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     else:
         last_kb = n_kblocks
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, seq_q: int):
+    """One (batch*head, k-block) program of the fused backward: stream
+    q-blocks, accumulate this K/V block's grads.
+
+    FlashAttention-2 backward identities, per block pair (i, j):
+      P_ij = exp(S_ij - lse_i)          (S = scaled scores)
+      dV_j += P_ij^T dO_i
+      dS_ij = P_ij * (dO_i V_j^T - D_i),  D_i = rowsum(dO_i * O_i)
+      dK_j += dS_ij^T Q_i * scale
+    No [S, S] tensor ever materializes — the O(S^2) memory of a naive
+    recompute backward becomes O(block^2) VMEM.
+    """
+    from jax.experimental import pallas as pl
+
+    k = k_ref[...].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    k_blk = pl.program_id(1)
+    k_start = k_blk * bk
+    n_qblocks = seq_q // block_q
+
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_start = qb * block_q
+        q = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q_start, block_q)]
+        dvec = dvec_ref[pl.ds(q_start, block_q)]
+        s = (q @ k.T) * scale                            # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                    # [bq, bk]
+        dv = dv + p.T @ do
+        dp = do @ v.T                                    # [bq, bk]
+        ds = p * (dp - dvec[:, None])
+        dk = dk + (ds.T @ q) * scale
+        return dk, dv
+
+    # Causal skip: this K block only receives grads from q-blocks whose
+    # last row is at or past k_start.
+    first_qb = (k_start // block_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(first_qb, n_qblocks, body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         scale: float, seq_k: int):
+    """One (batch*head, q-block) program: stream K/V blocks, accumulate
+    dQ_i = sum_j dS_ij K_j * scale (see the dkv kernel's identities)."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32)                   # [bq, d]
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]
+    dvec = dvec_ref[...]
+    bq, d = q.shape
+    q_blk = pl.program_id(1)
+    q_start = q_blk * bq
+    n_kblocks = seq_k // block_k
+
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    def body(kb, dq):
+        k_start = kb * block_k
+        k = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - dvec[:, None])
+        return dq + ds @ k
+
+    if causal:
+        last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kblocks)
+    else:
+        last_kb = n_kblocks
+    dq = jax.lax.fori_loop(0, last_kb, body, dq)
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -100,31 +203,25 @@ def _flash_core(q, k, v, causal: bool, block_q: int, block_k: int,
                 interpret: bool):
     """Differentiable flash attention core.
 
-    Forward is the Pallas kernel; backward recomputes attention with the
-    mathematically-identical jnp reference and differentiates that —
+    Forward is the Pallas kernel (also emitting per-row logsumexp);
+    backward is the FUSED Pallas backward (:func:`_flash_bwd_pallas`) —
     ``pallas_call`` has no transpose rule, so without this custom VJP
     any ``jax.grad`` through a TPU training step that dispatched to the
-    flash kernel would crash.  The recompute backward costs the standard
-    flash-backward FLOPs class but materializes the [S, S] probabilities
-    (O(S^2) memory) — fine at training sequence lengths on one chip;
-    long-context training shards sequence via ring attention instead of
-    this kernel.  A fused flash backward kernel can replace it without
-    touching callers.
+    flash kernel would crash.  Both directions stream blocks: no [S, S]
+    tensor materializes in either pass, so training memory stays
+    O(S·D) like the forward.
     """
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_core(q, k, v, causal, block_q, block_k, interpret), \
-        (q, k, v)
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
-        q, k, v)
-    return vjp(g.astype(q.dtype))
+    return _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -186,7 +283,7 @@ def _flash_pallas(q, k, v, causal: bool = True,
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=sk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q),
         in_specs=[
@@ -194,12 +291,120 @@ def _flash_pallas(q, k, v, causal: bool = True,
             pl.BlockSpec((None, sk, d), kv_index),
             pl.BlockSpec((None, sk, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(b, h, s, d)
-    return out[..., :d_orig] if d_orig != d else out
+    if d_orig != d:
+        out = out[..., :d_orig]
+    return out, lse.reshape(b, h, s)
+
+
+def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
+    """Fused flash backward: (dq, dk, dv) from the saved (q, k, v, out,
+    lse) — no [S, S] materialization (see the dkv kernel docstring).
+
+    GQA is handled by expanding K/V to the full head count for the
+    kernels (an activation-sized transient, NOT an S^2 one) and summing
+    each kv-head group's grads afterwards — accumulating shared-KV grads
+    across grid programs inside the kernel would race.
+    """
+    from jax.experimental import pallas as pl
+
+    q, k, v, out, lse = res
+    b, h, s, d_orig = q.shape
+    hkv = k.shape[1]
+    n_rep = h // hkv
+    sk = k.shape[2]
+    bq = min(block_q, s)
+    bk = min(block_k, sk)
+    scale = 1.0 / np.sqrt(d_orig)
+
+    g = g.astype(jnp.float32)
+    # D_i = rowsum(dO_i * O_i): computed on unpadded tensors (padding
+    # lanes are zero in both factors anyway).
+    dvec = (g * out.astype(jnp.float32)).sum(-1)          # [B, H, S] f32
+
+    d = d_orig
+    if d % 128 != 0:
+        d = -(-d_orig // 128) * 128
+        pad = [(0, 0)] * 3 + [(0, d - d_orig)]
+        # out stays unpadded: it only feeds dvec, computed above
+        q, k, v, g = (jnp.pad(x, pad) for x in (q, k, v, g))
+    k_full = jnp.repeat(k, n_rep, axis=1) if n_rep > 1 else k
+    v_full = jnp.repeat(v, n_rep, axis=1) if n_rep > 1 else v
+
+    qf = q.reshape(b * h, s, d)
+    kf = k_full.reshape(b * h, sk, d)
+    vf = v_full.reshape(b * h, sk, d)
+    dof = g.reshape(b * h, s, d)
+    lsef = lse.reshape(b * h, s)
+    dvecf = dvec.reshape(b * h, s)
+
+    row = lambda bh, blk: (bh, 0, 0)        # noqa: E731  full-seq rows
+    vec = lambda bh, blk: (bh, 0)           # noqa: E731
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale,
+        seq_q=s)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((None, s, d), row),
+            pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, s, d), row),
+            pl.BlockSpec((None, s), vec),
+            pl.BlockSpec((None, s), vec),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
+            pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, dvecf)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=bk, causal=causal, scale=scale,
+        seq_k=sk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, s // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, sk, d), row),
+            pl.BlockSpec((None, sk, d), row),
+            pl.BlockSpec((None, bq, d), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, bq), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, bq), lambda bh, qb: (bh, qb)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda bh, qb: (bh, qb, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, dvecf)
+
+    dq = dq.reshape(b, h, s, d)[..., :d_orig]
+    dk = dk.reshape(b, h, sk, d)[..., :d_orig]
+    dv = dv.reshape(b, h, sk, d)[..., :d_orig]
+    if n_rep > 1:
+        # fold the repeated q-head groups back onto their shared kv head
+        dk = dk.reshape(b, hkv, n_rep, sk, d_orig).sum(2)
+        dv = dv.reshape(b, hkv, n_rep, sk, d_orig).sum(2)
+    orig_q, orig_k, orig_v = res[0], res[1], res[2]
+    return (dq.astype(orig_q.dtype), dk.astype(orig_k.dtype),
+            dv.astype(orig_v.dtype))
 
 
 def _on_tpu() -> bool:
