@@ -141,9 +141,26 @@ def main() -> int:
     def fwd(tokens):
         return bert.forward(params, tokens, cfg)
 
+    from tpushare.ops import attention as attn_mod
+
     engine = InferenceEngine(fwd, batch_size=batch, seq_len=seq)
     _log("compiling+warming optimized path...")
-    engine.warmup()
+    attn_path = ("flash" if on_tpu and not attn_mod.FORCE_REFERENCE
+                 else "reference")
+    try:
+        engine.warmup()
+    except Exception as e:
+        # A kernel regression must never leave the round without a JSON
+        # line: drop to the jnp reference attention (same math, XLA-fused)
+        # and record which path ran.
+        if not on_tpu:
+            raise
+        _log(f"optimized path failed on TPU ({type(e).__name__}: "
+             f"{str(e)[:200]}); retrying with reference attention")
+        attn_mod.FORCE_REFERENCE = True
+        attn_path = "reference_fallback"
+        engine = InferenceEngine(fwd, batch_size=batch, seq_len=seq)
+        engine.warmup()
     _log("measuring optimized path...")
     n_batches = 30 if on_tpu else 5
     stats = measure_qps(engine, n_batches=n_batches, warmup_batches=1)
@@ -253,6 +270,7 @@ def main() -> int:
                         if naive_qps is not None else None),
         "platform": platform,
         "model": model_name,
+        "attention": attn_path,
         "mfu": mfu,
         "device_kind": getattr(jax.devices()[0], "device_kind", None),
         "batch_size": batch,

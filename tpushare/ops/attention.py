@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -55,7 +56,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     Also writes the per-row logsumexp of the SCALED scores — the single
-    statistic the fused backward needs to reconstruct P blockwise.
+    statistic the fused backward needs to reconstruct P blockwise.  The
+    stats ride a [bq, 128] lane-broadcast tile (every lane of a row holds
+    the same value): Mosaic requires the last two dims of every block to
+    be (8k, 128) tiles, so a squeezed [bq] vector cannot lower on real
+    TPU hardware — the same layout jax's own TPU flash kernel uses for
+    its l/m outputs.
     """
     from jax.experimental import pallas as pl
 
@@ -98,7 +104,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l))[:, 0]
+    lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (bq, 128))
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
@@ -132,8 +138,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         q_start = qb * block_q
         q = q_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
         do = do_ref[pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(q_start, block_q)]
-        dvec = dvec_ref[pl.ds(q_start, block_q)]
+        # stats arrive lane-broadcast [bq, 128]; column 0 is the value
+        lse = lse_ref[pl.ds(q_start, block_q), :][:, :1]
+        dvec = dvec_ref[pl.ds(q_start, block_q), :][:, :1]
         s = (q @ k.T) * scale                            # [bq, bk]
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
@@ -141,10 +148,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                    # [bq, bk]
+        p = jnp.exp(s - lse)                             # [bq, bk]
         dv = dv + p.T @ do
         dp = do @ v.T                                    # [bq, bk]
-        ds = p * (dp - dvec[:, None])
+        ds = p * (dp - dvec)
         dk = dk + (ds.T @ q) * scale
         return dk, dv
 
@@ -165,8 +172,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
     q = q_ref[...].astype(jnp.float32)                   # [bq, d]
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...]
-    dvec = dvec_ref[...]
+    # stats arrive lane-broadcast [bq, 128]; column 0 is the value
+    lse = lse_ref[...][:, :1]
+    dvec = dvec_ref[...][:, :1]
     bq, d = q.shape
     q_blk = pl.program_id(1)
     q_start = q_blk * bq
@@ -185,9 +193,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = do @ v.T
-        ds = p * (dp - dvec[:, None])
+        ds = p * (dp - dvec)
         return dq + ds @ k
 
     if causal:
@@ -293,18 +301,19 @@ def _flash_pallas(q, k, v, causal: bool = True,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, block_q, 128), lambda bh, qb: (bh, qb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            # per-row stats ride 128 lanes (see _flash_kernel docstring)
+            jax.ShapeDtypeStruct((b * h, s, 128), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
     out = out.reshape(b, h, s, d)
     if d_orig != d:
         out = out[..., :d_orig]
-    return out, lse.reshape(b, h, s)
+    return out, lse[..., 0].reshape(b, h, s)
 
 
 def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
@@ -345,11 +354,17 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
     kf = k_full.reshape(b * h, sk, d)
     vf = v_full.reshape(b * h, sk, d)
     dof = g.reshape(b * h, s, d)
-    lsef = lse.reshape(b * h, s)
-    dvecf = dvec.reshape(b * h, s)
+    # Stats enter the kernels lane-broadcast [B*H, S, 128] (see
+    # _flash_kernel docstring): Mosaic cannot lower squeezed 1-D vector
+    # blocks.  A small f32 transient (S*128 lanes/row) next to the
+    # activation-sized q/k/v reads.
+    lsef = jnp.broadcast_to(
+        lse.reshape(b * h, s)[:, :, None], (b * h, s, 128))
+    dvecf = jnp.broadcast_to(
+        dvec.reshape(b * h, s)[:, :, None], (b * h, s, 128))
 
     row = lambda bh, blk: (bh, 0, 0)        # noqa: E731  full-seq rows
-    vec = lambda bh, blk: (bh, 0)           # noqa: E731
+    vec = lambda bh, blk: (bh, 0, 0)        # noqa: E731  full-seq stats
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale,
@@ -362,8 +377,8 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
             pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
             pl.BlockSpec((None, s, d), row),
-            pl.BlockSpec((None, s), vec),
-            pl.BlockSpec((None, s), vec),
+            pl.BlockSpec((None, s, 128), vec),
+            pl.BlockSpec((None, s, 128), vec),
         ],
         out_specs=[
             pl.BlockSpec((None, bk, d), lambda bh, kb: (bh, kb, 0)),
@@ -387,8 +402,8 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((None, sk, d), row),
             pl.BlockSpec((None, sk, d), row),
             pl.BlockSpec((None, bq, d), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((None, bq), lambda bh, qb: (bh, qb)),
-            pl.BlockSpec((None, bq), lambda bh, qb: (bh, qb)),
+            pl.BlockSpec((None, bq, 128), lambda bh, qb: (bh, qb, 0)),
+            pl.BlockSpec((None, bq, 128), lambda bh, qb: (bh, qb, 0)),
         ],
         out_specs=pl.BlockSpec((None, bq, d), lambda bh, qb: (bh, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), jnp.float32),
@@ -414,6 +429,16 @@ def _on_tpu() -> bool:
         return False
 
 
+#: Escape hatch: force the jnp reference path even on TPU.  Flipped by
+#: operators (env TPUSHARE_FORCE_REFERENCE_ATTN=1 at import) or by
+#: callers like bench.py that must survive a kernel regression and still
+#: record a number.  The flag is read at TRACE time: already-compiled
+#: callables keep their baked-in path — after flipping it, build a fresh
+#: ``jax.jit`` wrapper (bench.py constructs a new InferenceEngine) or
+#: clear the jit cache for it to take effect.
+FORCE_REFERENCE = os.environ.get("TPUSHARE_FORCE_REFERENCE_ATTN") == "1"
+
+
 def attention(q, k, v, causal: bool = True):
     """Dispatch: Pallas flash on TPU (shape permitting), reference else.
 
@@ -427,7 +452,8 @@ def attention(q, k, v, causal: bool = True):
     (< 32), where padding overhead dominates, fall back to the reference.
     """
     s, d = q.shape[2], q.shape[3]
-    if (_on_tpu() and s % 128 == 0 and k.shape[2] == s and d >= 32
+    if (not FORCE_REFERENCE and _on_tpu() and s % 128 == 0
+            and k.shape[2] == s and d >= 32
             and q.shape[1] % k.shape[1] == 0):
         return flash_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal)
