@@ -130,18 +130,33 @@ def measure_qps(engine: InferenceEngine, n_batches: int = 20,
     than the forward itself.  ``latency_ms`` is the sustained per-batch
     PERIOD (wall / batches), not a single-request latency.
     """
+    def fetch_barrier(result):
+        # block_until_ready is NOT a reliable barrier on remote backends
+        # (axon: observed returning before execution).  Executions are
+        # in-order per device, so host-fetching ONE element of a result
+        # forces completion of everything dispatched before it (the
+        # [0,...] index is computed on device; only a scalar crosses
+        # the wire).
+        leaf = jax.tree_util.tree_leaves(result)[0]
+        float(leaf[(0,) * leaf.ndim])
+
     tokens = np.random.randint(
         1, 100, size=(engine.batch_size, engine.seq_len), dtype=np.int32)
-    for _ in range(warmup_batches):
-        engine.infer(tokens)
+    last = None
+    for _ in range(max(warmup_batches, 1)):
+        last = engine.infer_async(tokens)
+    fetch_barrier(last)   # also compiles the barrier's index program
     in_flight: List = []
     t0 = time.perf_counter()
     for _ in range(n_batches):
-        in_flight.append(engine.infer_async(tokens))
+        last = engine.infer_async(tokens)
+        in_flight.append(last)
         if len(in_flight) >= max_in_flight:
-            jax.block_until_ready(in_flight.pop(0))
-    for r in in_flight:
-        jax.block_until_ready(r)
+            # fetch, not block_until_ready: in-order execution means
+            # fetching entry i waits only through i, so the pipeline
+            # stays full while the in-flight bound is actually enforced
+            fetch_barrier(in_flight.pop(0))
+    fetch_barrier(last)
     dt = time.perf_counter() - t0
     queries = n_batches * engine.batch_size
     return {
