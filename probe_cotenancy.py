@@ -44,12 +44,14 @@ try:
             x = (x @ x) / dim
         return x
 
-    step(x).block_until_ready()          # compile outside the window
+    # sync by host-fetching a scalar: block_until_ready has been observed
+    # returning before execution on the remote axon backend
+    float(step(x)[0, 0])                 # compile outside the window
     t0 = time.perf_counter()
     y = x
     for _ in range(steps):
         y = step(y)
-    y.block_until_ready()
+    float(y[0, 0])                       # fetch = true completion barrier
     dt = time.perf_counter() - t0
     print(json.dumps({"ok": True, "platform": dev.platform,
                       "steps_per_s": steps / dt}))
