@@ -6,9 +6,11 @@ The reference publishes no numbers (BASELINE.md), so the record carries
 two yardsticks:
 
 - ``vs_baseline``: speedup of the TPU-first serving path (bf16,
-  flash/fused attention, batched jit) over a naive single-query f32 path
-  measured in the same run on the same chip — what a user gains over
-  running one unoptimized pod per chip.
+  flash/fused attention, batched jit) over a naive single-query path
+  with plain XLA attention (f32 on CPU; bf16 on the tunneled TPU, where
+  f32 compiles are banned — see CLAUDE.md) measured the same way on the
+  same chip — what a user gains over running one unoptimized pod per
+  chip.
 - ``mfu``: model FLOPs utilisation — analytic forward FLOPs/batch times
   batches/sec divided by the chip's published bf16 peak — an absolute
   measure that makes "matching-or-beating" evaluable across rounds.
@@ -230,23 +232,26 @@ def main() -> int:
         flops = bert_fwd_flops_per_batch(cfg, batch, seq)
         mfu = round(flops * (headline_qps / batch) / peak, 4)
 
-    # --- naive baseline: f32 params, reference attention, batch=1 ----------
-    # The f32 batch-1 compile has been observed to take 30+ minutes on the
-    # tunneled TPU backend — far beyond any sane bench budget, and a compile
-    # cannot be interrupted.  So the live naive measurement runs only when
-    # the remaining time budget allows, and its result is cached per
-    # (platform, device_kind, model, seq) in bench_naive.json so later runs
-    # (including the driver's) reuse it instead of re-paying the compile.
-    # Two-tier lookup: the gitignored runtime cache (written here) shadows
-    # the COMMITTED seed file, which carries known-good measurements across
-    # clones — e.g. the TPU naive number whose f32 compile once took the
-    # remote backend down.
+    # --- naive baseline: batch=1, reference attention, no batching --------
+    # What one unoptimized pod gets per chip: single-query forwards with
+    # the plain XLA attention.  On CPU the naive path is f32 (the classic
+    # unoptimized default); on the tunneled TPU it is bf16, because f32
+    # batch-1 compiles have hung the remote_compile service for ~50 min
+    # before dying with EOF (round-1 notes) — f32 on the tunnel is
+    # banned, and bf16 is what any TPU pod would run anyway, making the
+    # recorded ratio the batching+flash gain, not a dtype trick.
+    # Measured with the SAME device-resident scan + host-fetch barrier as
+    # the headline so the two sides are comparable.  The result is
+    # cached per (platform, device_kind, model, seq, flavor) in
+    # bench_naive.json; the COMMITTED seed file carries known-good
+    # measurements across clones.
     repo = os.path.dirname(os.path.abspath(__file__))
     cache_path = (os.environ.get("TPUSHARE_BENCH_NAIVE_CACHE")
                   or os.path.join(repo, "bench_naive.json"))
     seed_path = os.path.join(repo, "bench_naive_seed.json")
+    naive_flavor = "bf16-b1-scan" if on_tpu else "f32-b1-scan"
     cache_key = (f"{platform}/{getattr(jax.devices()[0], 'device_kind', '?')}"
-                 f"/{model_name}/seq{seq}")
+                 f"/{model_name}/seq{seq}/{naive_flavor}")
     budget_s = float(os.environ.get("TPUSHARE_BENCH_BUDGET_S", "900"))
     naive_qps, naive_src = None, "absent"
     for path, src in ((cache_path, "cached"), (seed_path, "seeded")):
@@ -259,46 +264,50 @@ def main() -> int:
         except Exception:
             pass   # malformed/missing cache (wrong type, null, ...) = miss
 
-    # On the tunneled TPU the f32 batch-1 compile has hung remote_compile
-    # for ~50 min before dying with EOF — attempting it live there is
-    # OPT-IN (TPUSHARE_BENCH_NAIVE=1); rely on the seed/cache instead.
-    live_ok = (not on_tpu) or os.environ.get("TPUSHARE_BENCH_NAIVE") == "1"
     elapsed = time.perf_counter() - _T0
-    if naive_qps is None and not live_ok:
-        naive_src = "tpu_live_disabled"
-        _log("skipping live naive baseline on TPU (enable with "
-             "TPUSHARE_BENCH_NAIVE=1); no cached/seeded value")
-    elif naive_qps is None and elapsed < budget_s:
-        # Never let the OPTIONAL baseline kill the bench: the tunneled
-        # backend has hung its remote_compile on this very program for
-        # 50 min before dying with EOF (BENCH round-1/2 notes).
+    if naive_qps is None and elapsed < budget_s:
+        # Never let the OPTIONAL baseline kill the bench.
+        prior_force = attn_mod.FORCE_REFERENCE
         try:
+            naive_dtype = jnp.bfloat16 if on_tpu else jnp.float32
             naive_cfg = bert.BertConfig(
                 vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
                 n_heads=cfg.n_heads, d_ff=cfg.d_ff, max_seq=cfg.max_seq,
-                n_types=cfg.n_types, dtype=jnp.float32)
+                n_types=cfg.n_types, dtype=naive_dtype)
             naive_params = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.float32), params)
+                lambda p: p.astype(naive_dtype), params)
+            attn_mod.FORCE_REFERENCE = True   # naive = no flash kernel
 
             def naive_fwd(tokens):
                 return bert.forward(naive_params, tokens, naive_cfg)
 
-            naive = InferenceEngine(naive_fwd, batch_size=1, seq_len=seq)
-            naive_queries = 8 if on_tpu else 3
-            tokens1 = np.random.randint(1, 100, size=(1, seq),
-                                        dtype=np.int32)
-            _log("compiling naive baseline...")
-            naive.infer(tokens1)  # compile
+            n_naive = 50 if on_tpu else 3
+            toks_n = jnp.asarray(np.random.randint(
+                1, 100, size=(n_naive, 1, seq), dtype=np.int32))
+
+            @jax.jit
+            def run_naive(tokens_n):
+                def body(acc, toks):
+                    logits = naive_fwd(toks)
+                    return acc + logits[:, 0].astype(jnp.float32).sum(), None
+                return jax.lax.scan(body, jnp.float32(0), tokens_n)[0]
+
+            _log(f"compiling naive baseline ({naive_flavor})...")
+            float(run_naive(toks_n))
             _log("measuring naive baseline...")
+            reps_n = 2
             t0 = time.perf_counter()
-            for _ in range(naive_queries):
-                naive.infer(tokens1)
-            naive_qps = naive_queries / (time.perf_counter() - t0)
+            for _ in range(reps_n):
+                float(run_naive(toks_n))
+            naive_qps = reps_n * n_naive / (time.perf_counter() - t0)
             naive_src = "live"
         except Exception as e:
             _log(f"naive baseline failed ({type(e).__name__}: "
                  f"{str(e)[:200]}); recording without it")
             naive_qps, naive_src = None, "failed"
+        finally:
+            # don't leak the escape hatch past the naive measurement
+            attn_mod.FORCE_REFERENCE = prior_force
         if naive_qps is not None:
             try:
                 try:
@@ -319,12 +328,20 @@ def main() -> int:
         _log(f"skipping naive baseline: {elapsed:.0f}s elapsed exceeds "
              f"budget {budget_s:.0f}s and no cached value for {cache_key}")
 
+    # The naive side is scan-measured; comparing it against a
+    # dispatch-bound streamed headline (offline scan failed, on the
+    # tunnel where RPC dominates) would mix methodologies and could even
+    # read < 1, so the ratio is only recorded when the two sides are
+    # measured alike (offline headline, or CPU where dispatch cost is
+    # negligible either way).
+    comparable = (qps_offline is not None and headline_qps == qps_offline
+                  ) or not on_tpu
     result = {
         "metric": "bert_base_infer_qps",
         "value": round(headline_qps, 2),
         "unit": "qps",
         "vs_baseline": (round(headline_qps / max(naive_qps, 1e-9), 2)
-                        if naive_qps is not None else None),
+                        if naive_qps is not None and comparable else None),
         "platform": platform,
         "model": model_name,
         "attention": attn_path,
@@ -336,8 +353,9 @@ def main() -> int:
                         if qps_offline is not None else None),
         "qps_streamed": round(stats["qps"], 2),
         "latency_ms_per_batch": round(latency_ms, 2),
-        "naive_qps_batch1_f32": (round(naive_qps, 2)
-                                 if naive_qps is not None else None),
+        "naive_qps_batch1": (round(naive_qps, 2)
+                             if naive_qps is not None else None),
+        "naive_flavor": naive_flavor,
         "naive_qps_source": naive_src,
     }
     print(json.dumps(result))
