@@ -149,6 +149,30 @@ def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     return P(*out)
 
 
+def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp"):
+    """Place stacked KV serving storage onto the mesh, sharded on the
+    kv-head dim.
+
+    Both storage layouts put kv-heads at dim 2: dense caches are
+    [L, B, Hkv, max_seq, D] (:func:`transformer.init_kv_caches`), paged
+    pools are [L, n_pages, Hkv, page, D] (:func:`init_paged_kv`).
+    Sharding Hkv over ``axis`` splits persistent KV HBM across the
+    pod's chips — the serving-side counterpart of Megatron tp, and what
+    lets one co-tenant serve a model whose cache outgrows a single
+    fractional grant.  Falls back to replication (via the divisibility
+    legalization) when Hkv doesn't divide, e.g. deep-GQA models on a
+    wide tp axis.
+    """
+    if axis not in mesh.axis_names:
+        return storage
+
+    def _place(leaf):
+        spec = _legalize(P(None, None, axis, None, None), leaf.shape, mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_place, storage)
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
     """Shard array leaves along their leading (batch) dim on ``axis``."""
     if axis not in mesh.axis_names:

@@ -125,7 +125,20 @@ class ContinuousBatcher:
     only the hooks to swap dense rows for a paged pool.
     """
 
-    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int):
+    def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
+                 mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` for tensor-parallel
+        serving — params take the Megatron tp layout
+        (:func:`tpushare.parallel.mesh.shard_params`) and KV storage
+        shards its kv-head dim, so one decode tick runs SPMD across the
+        pod's chips with XLA-inserted collectives.  Host-side control
+        flow (slots, admission, sampling bookkeeping) is unchanged:
+        sharding is a placement property of the device arrays, not a
+        code path."""
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import shard_params
+            params = shard_params(params, mesh)
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -138,6 +151,9 @@ class ContinuousBatcher:
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
         self.caches = transformer.init_kv_caches(self.cfg, batch=self.n_slots)
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_kv_storage
+            self.caches = shard_kv_storage(self.caches, self.mesh)
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
         """Claim per-request storage; False = backpressure (no admit)."""
@@ -343,7 +359,8 @@ class ContinuousService:
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  page_size: Optional[int] = None,
                  n_pages: Optional[int] = None,
-                 prefill_chunk: int = 64):
+                 prefill_chunk: int = 64,
+                 mesh=None):
         import queue as _q
         import threading
 
@@ -357,9 +374,10 @@ class ContinuousService:
             # paged KV storage: more in-flight sequences per HBM byte
             from .paged import PagedContinuousBatcher
             self._batcher = PagedContinuousBatcher(
-                params, cfg, n_slots, page_size=page_size, n_pages=n_pages)
+                params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
+                mesh=mesh)
         else:
-            self._batcher = ContinuousBatcher(params, cfg, n_slots)
+            self._batcher = ContinuousBatcher(params, cfg, n_slots, mesh=mesh)
         # _lock guards ONLY the _waiting handoff; the batcher and _sinks
         # are owned by the loop thread, so decode ticks run without the
         # lock and submit() never waits on a model forward.

@@ -53,11 +53,15 @@ class LLMServer:
                  default_max_new: int = 32,
                  n_slots: int = 0,
                  page_size: int = 0,
-                 n_pages: int = 0):
+                 n_pages: int = 0,
+                 tp: int = 0):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
-        paged pool (``n_pages`` pages, default dense-equivalent)."""
+        paged pool (``n_pages`` pages, default dense-equivalent).
+        ``tp > 1`` builds a tensor-parallel mesh over the pod's visible
+        devices and serves SPMD (requires --slots; params and KV storage
+        shard per ``tpushare.parallel.mesh``)."""
         from ..utils.httpserver import JsonHTTPServer
 
         self.cfg = cfg
@@ -66,13 +70,24 @@ class LLMServer:
         self._gen_lock = threading.Lock()   # decode caches are per-call;
         # serialize so co-tenant HBM stays bounded by one batch
         self._service = None
+        if tp > 1 and n_slots <= 0:
+            # only the batcher path is mesh-aware; silently serving
+            # unsharded would defeat the point of asking for tp
+            raise ValueError("tp > 1 requires n_slots > 0 "
+                             "(tensor-parallel serving rides the "
+                             "continuous batcher)")
         if n_slots > 0:
             from .continuous import ContinuousService
 
+            mesh = None
+            if tp > 1:
+                from ..parallel.mesh import make_mesh
+                mesh = make_mesh({"tp": tp})
             self._service = ContinuousService(
                 params, cfg, n_slots,
                 page_size=page_size or None,
-                n_pages=n_pages or None).start()
+                n_pages=n_pages or None,
+                mesh=mesh).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -219,11 +234,16 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-pages", type=int, default=0,
                     help="paged-KV pool size in pages (0 = dense-equivalent "
                          "capacity); only with --page-size")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree over the pod's visible "
+                         "devices (0/1 = single device); requires --slots")
     args = ap.parse_args(argv)
     if args.page_size and not args.slots:
         ap.error("--page-size requires --slots")
     if args.kv_pages and not args.page_size:
         ap.error("--kv-pages requires --page-size")
+    if args.tp > 1 and not args.slots:
+        ap.error("--tp requires --slots")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -240,9 +260,9 @@ def main(argv=None) -> int:
     cfg, params = build_model(args.model, args.int8)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
-                    n_pages=args.kv_pages)
-    log.info("llm server: model=%s int8=%s on :%d", args.model, args.int8,
-             srv.port)
+                    n_pages=args.kv_pages, tp=args.tp)
+    log.info("llm server: model=%s int8=%s tp=%d on :%d", args.model,
+             args.int8, args.tp, srv.port)
     srv.serve_forever()
     return 0
 

@@ -74,7 +74,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
     """Dense batcher with the storage hooks swapped for a paged pool."""
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
-                 page_size: int = 16, n_pages: Optional[int] = None):
+                 page_size: int = 16, n_pages: Optional[int] = None,
+                 mesh=None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
@@ -86,7 +87,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                         else n_slots * self.pages_per_slot + 1)
         if self.n_pages < 2:
             raise ValueError("need at least one non-trash page")
-        super().__init__(params, cfg, n_slots)
+        super().__init__(params, cfg, n_slots, mesh=mesh)
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -101,6 +102,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def _init_storage(self) -> None:
         self.pools = transformer.init_paged_kv(
             self.cfg, self.n_pages, self.page_size)
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_kv_storage
+            self.pools = shard_kv_storage(self.pools, self.mesh)
         self.page_table = np.zeros(
             (self.n_slots, self.pages_per_slot), np.int32)
         self._free_pages: List[int] = list(range(1, self.n_pages))  # 0=trash
