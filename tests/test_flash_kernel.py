@@ -149,3 +149,45 @@ def test_flash_kernel_bf16_io():
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
                                np.asarray(ref, dtype=np.float32),
                                atol=3e-2)
+
+
+def test_flash_kernel_block_fits_nondivisible_seq():
+    """s % 128 == 0 but s % 512 != 0 (e.g. 384): the default 512 blocks
+    must shrink to a DIVISOR of s — a non-divisor grid would silently
+    drop the sequence tail."""
+    key = jax.random.PRNGKey(9)
+    q, k, v = (jax.random.normal(kk, (1, 2, 384, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # and through the fused backward
+    g = jax.grad(lambda q_: (flash_attention(
+        q_, k, v, causal=True, interpret=True) ** 2).sum())(q)
+    gr = jax.grad(lambda q_: (reference_attention(
+        q_, k, v, causal=True) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=3e-4)
+
+
+def test_flash_bf16_grads_match_f32_reference_values():
+    """The bf16 backward path (P/dS MXU downcasts, bf16 cotangents) must
+    produce VALUES near the f32 reference grads, not merely finite
+    bf16 outputs — a misplaced cast would pass dtype/finiteness checks."""
+    key = jax.random.PRNGKey(12)
+    qf, kf, vf = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
+                  for kk in jax.random.split(key, 3))
+    q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+
+    def loss(fn, q_, k_, v_):
+        return (fn(q_, k_, v_).astype(jnp.float32) ** 2).sum()
+
+    gb = jax.grad(lambda *a: loss(lambda q_, k_, v_: flash_attention(
+        q_, k_, v_, causal=True, interpret=True), *a), argnums=(0, 1, 2))(
+            q, k, v)
+    gr = jax.grad(lambda *a: loss(lambda q_, k_, v_: reference_attention(
+        q_, k_, v_, causal=True), *a), argnums=(0, 1, 2))(qf, kf, vf)
+    for name, a, b in zip("dq dk dv".split(), gb, gr):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(np.abs(b32).max(), 1e-9)
+        rel = np.abs(a32 - b32).max() / scale
+        assert rel < 0.05, f"{name}: rel_max_err {rel}"
