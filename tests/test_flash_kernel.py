@@ -191,3 +191,40 @@ def test_flash_bf16_grads_match_f32_reference_values():
         scale = max(np.abs(b32).max(), 1e-9)
         rel = np.abs(a32 - b32).max() / scale
         assert rel < 0.05, f"{name}: rel_max_err {rel}"
+
+
+def test_flash_attention_lse_matches_reference():
+    from tpushare.ops.attention import (flash_attention_lse,
+                                        reference_attention_lse)
+    key = jax.random.PRNGKey(14)
+    q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    out, lse = flash_attention_lse(q, k, v, causal=True, interpret=True)
+    ro, rl = reference_attention_lse(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(rl), atol=2e-5)
+
+
+def test_flash_attention_lse_grad_includes_lse_cotangent():
+    """A loss using BOTH outputs: the custom VJP's D_i - g_lse_i folding
+    must reproduce the reference grads (a dropped/mis-signed g_lse would
+    diverge here but pass output-only grad tests)."""
+    from tpushare.ops.attention import (flash_attention_lse,
+                                        reference_attention_lse)
+    key = jax.random.PRNGKey(15)
+    q, k, v = (jax.random.normal(kk, (1, 2, 128, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    w = jax.random.normal(jax.random.PRNGKey(16), (1, 2, 128), jnp.float32)
+
+    def loss(fn, q_, k_, v_):
+        out, lse = fn(q_, k_, v_)
+        return (out ** 2).sum() + (lse * w).sum()
+
+    gf = jax.grad(lambda *a: loss(lambda q_, k_, v_: flash_attention_lse(
+        q_, k_, v_, causal=True, interpret=True), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: loss(lambda q_, k_, v_: reference_attention_lse(
+        q_, k_, v_, causal=True), *a), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("dq dk dv".split(), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   err_msg=name)

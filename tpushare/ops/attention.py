@@ -53,6 +53,15 @@ def reference_attention(q, k, v, causal: bool = True,
                         scale: Optional[float] = None):
     """Plain softmax attention; q: [B, H, S, D], k/v: [B, Hkv, S, D]
     (Hkv may divide H — GQA — and is expanded here)."""
+    return reference_attention_lse(q, k, v, causal=causal, scale=scale)[0]
+
+
+def reference_attention_lse(q, k, v, causal: bool = True,
+                            scale: Optional[float] = None):
+    """Reference attention that ALSO returns the per-row logsumexp of the
+    scaled scores [B, H, S] — the statistic block-merging schedules (ring
+    attention) need; definition matches the flash kernel's lse output so
+    the two implementations merge interchangeably."""
     if k.shape[1] != q.shape[1]:
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
@@ -66,8 +75,11 @@ def reference_attention(q, k, v, causal: bool = True,
         # global, query i attends key j iff j <= i + (t - s)
         mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
         logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    probs = jnp.exp(lf - lse[..., None])
+    out = jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -264,6 +276,46 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core_lse(q, k, v, causal: bool, block_q: int, block_k: int,
+                    interpret: bool):
+    """Flash attention returning (out, lse) — the building block for
+    block-merging schedules (ring attention): partial results merge by
+    logaddexp-weighting, so the kernel's online-softmax statistic
+    becomes part of the public value and needs its own gradient.
+
+    The lse cotangent folds into the SAME fused backward kernels:
+    d lse_i / d s_ij = P_ij, so ds_ij = P_ij (dp_ij - D_i + g_lse_i) —
+    i.e. the backward runs unchanged with D_i replaced by
+    D_i - g_lse_i.  No extra kernel, no extra memory.
+    """
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
+    g_out, g_lse = g
+    return _flash_bwd_pallas(causal, block_q, block_k, interpret, res,
+                             g_out, g_lse=g_lse)
+
+
+_flash_core_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def flash_attention_lse(q, k, v, causal: bool = True,
+                        block_q: int = 512, block_k: int = 512,
+                        interpret: bool = False):
+    """Differentiable flash attention returning (out [B,H,S,D],
+    lse [B,H,S] of the scaled scores); see :func:`_flash_core_lse`."""
+    return _flash_core_lse(q, k, v, causal, block_q, block_k, interpret)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret"))
 def flash_attention(q, k, v, causal: bool = True,
@@ -355,9 +407,12 @@ def _flash_pallas(q, k, v, causal: bool = True,
     return out, lse[..., 0].reshape(b, h, s)
 
 
-def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
+                      g_lse=None):
     """Fused flash backward: (dq, dk, dv) from the saved (q, k, v, out,
     lse) — no [S, S] materialization (see the dkv kernel docstring).
+    ``g_lse`` (the lse output's cotangent, [B, H, S]) folds in as
+    D_i -> D_i - g_lse_i (see :func:`_flash_core_lse`).
 
     GQA is handled by expanding K/V to the full head count for the
     kernels (an activation-sized transient, NOT an S^2 one) and summing
@@ -379,6 +434,8 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g):
     # are zero in both factors anyway).  The kernels then take dO in the
     # input dtype so their matmuls ride the MXU's native bf16 mode.
     dvec = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    if g_lse is not None:
+        dvec = dvec - g_lse.astype(jnp.float32)
     g = g.astype(q.dtype)
 
     d = d_orig

@@ -2,71 +2,109 @@
 
 Long-context path: the sequence is sharded over the ``sp`` mesh axis;
 each device keeps its Q shard resident and streams K/V shards around the
-ring with ``ppermute`` (one ICI hop per step), merging partial results
-with the same online-softmax rescaling the flash kernel uses.  Peak
-memory per device is O(S/n · S/n) for one block of scores instead of
-O(S²); comms overlap the next block's compute under XLA's async
-collectives.
+ring with ``ppermute`` (one ICI hop per step).  Each step computes ONE
+cross-block attention — the Pallas flash kernel on TPU, the jnp
+reference elsewhere, both returning (out, lse) — and partials merge by
+logaddexp weighting (the associative online-softmax combine).  Peak
+memory per device is the kernel's O(block²) VMEM instead of O(S²), and
+under causal masking fully-masked blocks are SKIPPED via ``lax.cond``
+(device ``me`` only computes steps t <= me — the classic ring-causal
+load imbalance; a zigzag schedule could even it out later).
 
 Built on ``shard_map`` so the collective schedule is explicit; the math
-is verified against dense attention in tests (CPU 8-device mesh).
+is verified against dense attention in tests (CPU 8-device mesh), and
+the flash inner is differentiable end-to-end (``flash_attention_lse``'s
+custom VJP folds the lse cotangent into the fused backward).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-NEG_INF = -1e30
+from ..ops.attention import (NEG_INF, _on_tpu, flash_attention_lse,
+                             reference_attention_lse)
 
 
-def _ring_body(q, k, v, axis_name: str, causal: bool):
-    """Per-device function: q,k,v are local shards [B, H, C, D]."""
-    n = jax.lax.psum(1, axis_name)
+def _block_attention(q, k, v, causal: bool):
+    """One (q-shard x k/v-block) attention -> (out, lse [B,H,C]).
+
+    Same dispatch gate as :func:`tpushare.ops.attention.attention`
+    (including the FORCE_REFERENCE escape hatch and native GQA):
+    Pallas flash when the shapes fit the MXU tiling, reference
+    otherwise.  Equal q/k lengths always hold here (ring shards are
+    uniform); all blocks of one call trace the same branch, so lse
+    definitions (scaled scores) are consistent across merges.
+    """
+    import sys
+
+    # sys.modules, not `from ..ops import attention`: the package
+    # __init__ re-exports the attention FUNCTION under that name
+    _attn_mod = sys.modules["tpushare.ops.attention"]
+    s, d = q.shape[2], q.shape[3]
+    if (not _attn_mod.FORCE_REFERENCE and _on_tpu() and s % 128 == 0
+            and d >= 32 and q.shape[1] % k.shape[1] == 0):
+        return flash_attention_lse(q, k, v, causal=causal)
+    return reference_attention_lse(q, k, v, causal=causal)
+
+
+def _ring_body(q, k, v, axis_name: str, causal: bool, n: int):
+    """Per-device function: q,k,v are local shards [B, H, C, D].
+
+    At step t device ``me`` holds the K/V block produced by device
+    ``src = (me - t) % n``.  Causal in GLOBAL positions: block src is
+    fully visible iff src < me (t <= me), fully masked iff src > me
+    (skipped), and the t = 0 diagonal is ordinary causal attention.
+    """
     me = jax.lax.axis_index(axis_name)
     b, h, c, d = q.shape
-    scale = 1.0 / np.sqrt(d)
-
-    qf = q.astype(jnp.float32) * scale
-    q_pos = me * c + jnp.arange(c)                       # global q positions
-
-    m0 = jnp.full((b, h, c, 1), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((b, h, c, 1), dtype=jnp.float32)
-    acc0 = jnp.zeros((b, h, c, d), dtype=jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(t, carry):
-        m, l, acc, k_blk, v_blk = carry
-        src = (me - t) % n                               # who produced k_blk
-        k_pos = src * c + jnp.arange(c)
-        s = jnp.einsum("bhcd,bhtd->bhct", qf, k_blk.astype(jnp.float32))
-        if causal:
-            mask = k_pos[None, :] <= q_pos[:, None]      # [C, C] global
-            s = jnp.where(mask[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum(
-            "bhct,bhtd->bhcd", p, v_blk.astype(jnp.float32))
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return m_new, l_new, acc_new, k_next, v_next
+    # t = 0: the diagonal block (standard causal within the shard).
+    out, lse = _block_attention(q, k, v, causal=causal)
+    out = out.astype(jnp.float32)
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    def step(t, carry):
+        out, lse, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+        def compute(k_, v_):
+            o, s = _block_attention(q, k_, v_, causal=False)
+            return o.astype(jnp.float32), s
+
+        if causal:
+            def skip(k_, v_):
+                return (jnp.zeros((b, h, c, d), jnp.float32),
+                        jnp.full((b, h, c), NEG_INF, jnp.float32))
+
+            # t and me are traced; the kernel still traces ONCE (the
+            # loop body is one program) — compile size stays O(1) in n
+            blk_out, blk_lse = jax.lax.cond(t <= me, compute, skip,
+                                            k_blk, v_blk)
+        else:
+            blk_out, blk_lse = compute(k_blk, v_blk)
+
+        # associative online-softmax combine of two partials
+        lse_new = jnp.logaddexp(lse, blk_lse)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_blk = jnp.exp(blk_lse - lse_new)[..., None]
+        return out * w_old + blk_out * w_blk, lse_new, k_blk, v_blk
+
+    out, lse, _, _ = jax.lax.fori_loop(1, n, step, (out, lse, k, v))
+    return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True):
     """q,k,v: [B, H, S, D] sharded (or shardable) on S over ``axis_name``."""
-    fn = functools.partial(_ring_body, axis_name=axis_name, causal=causal)
+    n = mesh.shape[axis_name]
+    fn = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
+                           n=n)
     spec = P(None, None, axis_name, None)
     mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)

@@ -18,7 +18,7 @@ import jax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import reference_attention
+from ..ops.attention import attention
 
 
 def _ulysses_body(q, k, v, axis_name: str, causal: bool):
@@ -34,7 +34,11 @@ def _ulysses_body(q, k, v, axis_name: str, causal: bool):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    oh = reference_attention(qh, kh, vh, causal=causal)
+    # after the reshard every device holds FULL sequences for H/n heads —
+    # equal q/k lengths, so the dispatching attention() takes the Pallas
+    # flash kernel on TPU (per-device pallas_call inside shard_map) and
+    # the jnp reference on CPU
+    oh = attention(qh, kh, vh, causal=causal)
     return heads_to_seq(oh)
 
 
