@@ -97,17 +97,20 @@ def main() -> int:
           n_new / max(sstats.target_forwards, 1), "x",
           acceptance=round(sstats.acceptance_rate, 3), platform=platform)
 
-    # 4. train step rate
-    tcfg = (transformer.ModelConfig(vocab=32000, d_model=512, n_layers=4,
-                                    n_heads=8, n_kv_heads=4, d_ff=1408,
-                                    max_seq=512)
+    # 4. train step rate.  On TPU: a long-context shape (s=2048 through
+    # the flash kernel fwd+bwd, rematerialized backward) big enough that
+    # an MFU estimate means something; off-TPU: the tiny config.
+    tcfg = (transformer.ModelConfig(vocab=32000, d_model=1024, n_layers=8,
+                                    n_heads=8, n_kv_heads=8, d_ff=2816,
+                                    max_seq=2048)
             if on_tpu else transformer.tiny())
     opt = make_optimizer()
     tparams = transformer.init_params(jax.random.PRNGKey(3), tcfg)
     ostate = opt.init(tparams)
     step = make_train_step(tcfg, opt)
-    tokens = jax.random.randint(jax.random.PRNGKey(4),
-                                (8, 129 if on_tpu else 33), 0, tcfg.vocab)
+    bt, st = (4, 2049) if on_tpu else (8, 33)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (bt, st), 0,
+                                tcfg.vocab)
     tparams, ostate, loss = step(tparams, ostate, tokens)  # compile
     float(loss)   # host fetch: the only reliable barrier on axon
     t0 = time.perf_counter()
@@ -116,8 +119,23 @@ def main() -> int:
         tparams, ostate, loss = step(tparams, ostate, tokens)
     float(loss)   # chained steps + in-order execution: one fetch drains
     dt = time.perf_counter() - t0
+    tokens_per_step = int(bt * (st - 1))
+    extra = {}
+    if on_tpu:
+        # MODEL FLOPs only (PaLM/Chinchilla MFU convention): fwd matmuls
+        # = 2*tokens*(4 proj mats of d*d + SwiGLU's 3 mats of d*d_ff)
+        # plus CAUSAL-effective attention (s/2 keys per query — remat
+        # recompute and the skipped masked half are excluded, so this
+        # MFU is comparable to published numbers, not an HFU).
+        # Train = 3x forward (fwd + 2x bwd).
+        d, L, ff, s = tcfg.d_model, tcfg.n_layers, tcfg.d_ff, st - 1
+        per_tok = L * (2 * (4 * d * d + 3 * d * ff) + 2 * 2 * (s // 2) * d)
+        flops_step = 3.0 * tokens_per_step * per_tok
+        peak = 197e12
+        extra["train_mfu"] = round(flops_step * (n / dt) / peak, 4)
+        extra["seq_len"] = s
     _emit("train_steps_per_s", n / dt, "steps/s", platform=platform,
-          tokens_per_step=int(tokens.shape[0] * (tokens.shape[1] - 1)))
+          tokens_per_step=tokens_per_step, **extra)
     return 0
 
 

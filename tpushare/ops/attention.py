@@ -536,6 +536,18 @@ def _on_tpu() -> bool:
 FORCE_REFERENCE = os.environ.get("TPUSHARE_FORCE_REFERENCE_ATTN") == "1"
 
 
+def use_flash(q, k) -> bool:
+    """THE flash-dispatch gate, shared by :func:`attention` and the ring
+    schedule's block inner so the two cannot drift: flash needs a TPU,
+    equal q/k lengths in 128-lane-divisible sequence tiles, head dim
+    >= 32 (smaller dims drown in lane padding), GQA divisibility, and
+    the escape hatch open."""
+    s, d = q.shape[2], q.shape[3]
+    return (not FORCE_REFERENCE and _on_tpu() and s % 128 == 0
+            and k.shape[2] == s and d >= 32
+            and q.shape[1] % k.shape[1] == 0)
+
+
 def attention(q, k, v, causal: bool = True):
     """Dispatch: Pallas flash on TPU (shape permitting), reference else.
 
@@ -548,9 +560,6 @@ def attention(q, k, v, causal: bool = True):
     zero-padded to 128 inside ``flash_attention``; only tiny head dims
     (< 32), where padding overhead dominates, fall back to the reference.
     """
-    s, d = q.shape[2], q.shape[3]
-    if (not FORCE_REFERENCE and _on_tpu() and s % 128 == 0
-            and k.shape[2] == s and d >= 32
-            and q.shape[1] % k.shape[1] == 0):
+    if use_flash(q, k):
         return flash_attention(q, k, v, causal=causal)
     return reference_attention(q, k, v, causal=causal)

@@ -26,28 +26,20 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import (NEG_INF, _on_tpu, flash_attention_lse,
-                             reference_attention_lse)
+from ..ops.attention import (NEG_INF, flash_attention_lse,
+                             reference_attention_lse, use_flash)
 
 
 def _block_attention(q, k, v, causal: bool):
     """One (q-shard x k/v-block) attention -> (out, lse [B,H,C]).
 
-    Same dispatch gate as :func:`tpushare.ops.attention.attention`
-    (including the FORCE_REFERENCE escape hatch and native GQA):
-    Pallas flash when the shapes fit the MXU tiling, reference
-    otherwise.  Equal q/k lengths always hold here (ring shards are
-    uniform); all blocks of one call trace the same branch, so lse
-    definitions (scaled scores) are consistent across merges.
+    THE dispatch gate is shared with :func:`tpushare.ops.attention.
+    attention` (``use_flash``: escape hatch, tiling fit, native GQA) so
+    the two cannot drift.  Equal q/k lengths always hold here (ring
+    shards are uniform); all blocks of one call trace the same branch,
+    so lse definitions (scaled scores) are consistent across merges.
     """
-    import sys
-
-    # sys.modules, not `from ..ops import attention`: the package
-    # __init__ re-exports the attention FUNCTION under that name
-    _attn_mod = sys.modules["tpushare.ops.attention"]
-    s, d = q.shape[2], q.shape[3]
-    if (not _attn_mod.FORCE_REFERENCE and _on_tpu() and s % 128 == 0
-            and d >= 32 and q.shape[1] % k.shape[1] == 0):
+    if use_flash(q, k):
         return flash_attention_lse(q, k, v, causal=causal)
     return reference_attention_lse(q, k, v, causal=causal)
 
