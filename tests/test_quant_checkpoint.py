@@ -146,3 +146,103 @@ def test_checkpoint_atomicity(tmp_path, monkeypatch):
     np.testing.assert_array_equal(np.asarray(first["a"]),
                                   np.asarray(again["a"]))
     assert not [f for f in tmp_path.iterdir() if f.suffix == ".tmp"]
+
+
+# ---------------------------------------------------------------------------
+# int4 (grouped, packed)
+# ---------------------------------------------------------------------------
+def test_quantize4_pack_roundtrip_exact():
+    """Values already on the int4 grid must survive pack/unpack exactly
+    (scale = 1 requires each group x channel to reach amax 7, hence the
+    pinned rows)."""
+    key = jax.random.PRNGKey(21)
+    grid = jax.random.randint(key, (64, 32), -7, 8).astype(jnp.float32)
+    grid = grid.at[0, :].set(7.0).at[32, :].set(-7.0)
+    qw = quant.quantize4(grid, group=32)
+    deq = quant.dequantize4(qw, jnp.float32)
+    # symmetric grid: w = round(w/s)*s reproduces w when w/s is integral
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(grid),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantize4_grouped_error_smaller_than_whole_channel():
+    """Grouping bounds the error: a channel with one huge outlier must
+    quantize the other groups on their own (smaller) scales."""
+    key = jax.random.PRNGKey(22)
+    w = jax.random.normal(key, (256, 16), jnp.float32)
+    w = w.at[0, :].set(100.0)          # outlier in group 0 only
+    q_grouped = quant.dequantize4(quant.quantize4(w, group=64), jnp.float32)
+    q_whole = quant.dequantize4(quant.quantize4(w, group=256), jnp.float32)
+    err_g = float(jnp.abs(q_grouped[64:] - w[64:]).max())
+    err_w = float(jnp.abs(q_whole[64:] - w[64:]).max())
+    assert err_g < err_w / 4
+
+
+def test_q4matmul_close_to_dense():
+    key = jax.random.PRNGKey(23)
+    w = jax.random.normal(key, (128, 64), jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(24), (4, 128), jnp.float32)
+    qw = quant.quantize4(w, group=32)
+    np.testing.assert_allclose(np.asarray(quant.q4matmul(x, qw)),
+                               np.asarray(x @ w), atol=0.5)
+
+
+def test_int4_params_half_of_int8_and_model_runs():
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    q8 = quant.quantize_params(params)
+    q4 = quant.quantize_params(params, bits=4, group=32)
+
+    def weight_bytes(p, keys):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for path, leaf in jax.tree_util.tree_leaves_with_path(p)
+                   if any(k in jax.tree_util.keystr(path) for k in keys))
+
+    b8 = weight_bytes(q8, ["'q'"])
+    b4 = weight_bytes(q4, ["'q4'"])
+    assert b4 * 2 == b8                 # packed nibbles: exactly half
+
+    tokens = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+    logits8 = transformer.forward(q8, tokens, cfg)
+    logits4 = transformer.forward(q4, tokens, cfg)
+    assert logits4.shape == logits8.shape
+    assert bool(jnp.isfinite(logits4).all())
+    # int4 tracks the bf16 model loosely but must stay correlated
+    c = np.corrcoef(np.asarray(logits4).ravel(),
+                    np.asarray(transformer.forward(params, tokens,
+                                                   cfg)).ravel())[0, 1]
+    assert c > 0.95
+
+
+def test_int4_generation_runs_end_to_end():
+    from tpushare.serving.generate import generate
+
+    cfg = transformer.tiny()
+    params = quant.quantize_params(
+        transformer.init_params(jax.random.PRNGKey(1), cfg), bits=4,
+        group=32)
+    out = generate(params, cfg, jnp.asarray([[3, 1, 4]], jnp.int32),
+                   max_new_tokens=4)
+    assert out.shape == (1, 7)
+
+
+def test_int4_params_keep_tp_sharding():
+    """int4 'q4' leaves must inherit the parent weight's tp rule exactly
+    like int8 'q' — silent replication would put the whole packed model
+    on every tp shard and defeat the memory claim."""
+    from tpushare.parallel import make_mesh, shard_params
+    cfg = transformer.tiny(d_model=64, n_heads=4, n_kv_heads=2)
+    qparams = quant.quantize_params(
+        transformer.init_params(jax.random.PRNGKey(0), cfg), bits=4,
+        group=32)
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    sharded = shard_params(qparams, mesh)
+    # column-parallel: tp on the output dim
+    assert "tp" in str(sharded["layers"]["wq"]["q4"].sharding.spec)
+    # row-parallel: tp lands on the packed contraction-group dim
+    assert "tp" in str(sharded["layers"]["w_down"]["q4"].sharding.spec)
+    # scales replicate
+    assert not any(sharded["layers"]["wq"]["s"].sharding.spec)
+    # and the tp-sharded int4 model still runs
+    out = transformer.forward(sharded, jnp.ones((2, 8), jnp.int32), cfg)
+    assert out.shape == (2, 8, cfg.vocab)

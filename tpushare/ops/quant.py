@@ -1,11 +1,19 @@
-"""Weight-only int8 quantization for inference (BASELINE config 4 class).
+"""Weight-only int8 / int4 quantization for inference (BASELINE config 4).
 
-Per-output-channel symmetric int8: ``w ≈ w_q * scale`` with
+int8: per-output-channel symmetric — ``w ≈ w_q * scale`` with
 ``w_q ∈ int8 [L?, d_in, d_out]`` and ``scale`` over the output channel.
 Matmuls run ``bf16 activation × int8 weight`` — XLA keeps the weight in
 int8 HBM (halving weight bandwidth vs bf16, quartering vs f32, which is
 what lets a 7B model fit a 14 GiB ``tpu-mem`` grant) and fuses the
 dequant multiply into the matmul epilogue on the VPU.
+
+int4: grouped symmetric — contraction dim split into groups (default
+128) with one scale per (group, output channel), values in [-7, 7]
+packed two-per-byte along the contraction dim.  Scales vary along the
+contraction, so dequant happens before the matmul (a transient bf16
+weight per layer inside the scan — persistent HBM stays 4-bit, which is
+how a 7B model fits a ~7 GiB grant).  Grouping bounds the quantization
+error a 4-bit grid would otherwise smear over the whole channel.
 """
 
 from __future__ import annotations
@@ -45,8 +53,56 @@ def qmatmul(x: jnp.ndarray, qw: Dict, dtype=None) -> jnp.ndarray:
     return y * qw["s"].astype(dtype)   # scale [..., 1, d_out] broadcasts
 
 
+# ---------------------------------------------------------------------------
+# int4 (grouped, packed two-per-byte)
+# ---------------------------------------------------------------------------
+def quantize4(w: jnp.ndarray, group: int = 128):
+    """w [..., d_in, d_out] -> {'q4': uint8 [..., g, group/2, d_out],
+    's': f32 [..., g, 1, d_out]} with values in [-7, 7] packed
+    two-per-byte along the contraction dim (even positions in the low
+    nibble).  ``group`` falls back to the whole contraction dim when it
+    doesn't divide."""
+    wf = w.astype(jnp.float32)
+    d_in = wf.shape[-2]
+    if d_in % group or group % 2:
+        group = d_in
+    if group % 2:
+        raise ValueError(f"odd contraction dim {d_in} cannot pack int4")
+    lead = wf.shape[:-2]
+    g = d_in // group
+    wg = wf.reshape(*lead, g, group, wf.shape[-1])
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int32)
+    lo, hi = q[..., 0::2, :], q[..., 1::2, :]
+    packed = ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.uint8)
+    return {"q4": packed, "s": scale.astype(jnp.float32)}
+
+
+def dequantize4(qw: Dict, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """{'q4','s'} -> dense [..., d_in, d_out] weight."""
+    p = qw["q4"].astype(jnp.int32)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    q = jnp.stack([lo, hi], axis=-2)               # [..., group/2, 2, d_out]
+    *lead, g, half, two, d_out = q.shape
+    q = q.reshape(*lead, g, half * two, d_out)     # restore even/odd order
+    w = q.astype(jnp.float32) * qw["s"]
+    return w.reshape(*lead, g * half * two, d_out).astype(dtype)
+
+
+def q4matmul(x: jnp.ndarray, qw: Dict) -> jnp.ndarray:
+    """x @ dequant4(qw): the bf16 weight is a transient (XLA frees it
+    after the matmul); persistent HBM holds only the packed nibbles."""
+    return x @ dequantize4(qw, dtype=x.dtype)
+
+
 def matmul_maybe_q(x: jnp.ndarray, w) -> jnp.ndarray:
-    """Dispatch: quantized {'q','s'} weight or plain array."""
+    """Dispatch: int8 {'q','s'}, int4 {'q4','s'}, or plain array."""
+    if isinstance(w, dict) and "q4" in w:
+        return q4matmul(x, w)
     if isinstance(w, dict) and "q" in w:
         return qmatmul(x, w)
     return x @ w
@@ -59,13 +115,19 @@ _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
                    "lm_head")
 
 
-def quantize_params(params, suffixes=_QUANT_SUFFIXES):
-    """Quantize matching 2D/stacked-3D weight leaves of a param pytree."""
+def quantize_params(params, suffixes=_QUANT_SUFFIXES, bits: int = 8,
+                    group: int = 128):
+    """Quantize matching 2D/stacked-3D weight leaves of a param pytree
+    (``bits`` 8 = per-channel int8, 4 = grouped packed int4)."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
 
     def visit(path, leaf):
         from ..utils.treepath import leaf_key
         leaf_name = leaf_key(jax.tree_util.keystr(path))
         if leaf_name in suffixes and leaf.ndim >= 2:
+            if bits == 4:
+                return quantize4(leaf, group=group)
             q, s = quantize(leaf)
             return {"q": q, "s": s}
         return leaf

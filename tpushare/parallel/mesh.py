@@ -86,10 +86,12 @@ SHARDING_RULES: List[Tuple[str, P]] = [
 def spec_for(path: str, rules: Sequence[Tuple[str, P]] = SHARDING_RULES) -> P:
     from ..utils.treepath import leaf_key, param_key
 
-    # Quantized weights are {'q': int8, 's': scale} one level below the
-    # parameter name; they inherit the parameter's rule ('s' replicates —
-    # it broadcasts along the sharded output dim on every shard anyway,
-    # and is tiny).
+    # Quantized weights are {'q': int8, 's': scale} / {'q4': packed
+    # int4, 's': scale} one level below the parameter name; they inherit
+    # the parameter's rule ('s' replicates — it broadcasts along the
+    # sharded output dim on every shard anyway, and is tiny).  For 'q4'
+    # the right-aligned legalization lands the rule's contraction axis
+    # on the packed-group dim — the same Megatron intent, one axis in.
     if leaf_key(path) == "s":
         return P()
     name = param_key(path)

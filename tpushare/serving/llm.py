@@ -24,7 +24,8 @@ import time
 log = logging.getLogger("tpushare.llm")
 
 
-def build_model(model_name: str, quantize_int8: bool, seed: int = 0):
+def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
+                quantize_int4: bool = False):
     import jax
 
     from ..models import transformer
@@ -40,9 +41,13 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0):
     if model_name not in cfgs:
         raise ValueError(f"unknown model {model_name!r} "
                          f"(have {sorted(cfgs)})")
+    if quantize_int8 and quantize_int4:
+        raise ValueError("pick one of int8 / int4")
     cfg = cfgs[model_name]()
     params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
-    if quantize_int8:
+    if quantize_int4:
+        params = quant.quantize_params(params, bits=4)
+    elif quantize_int8:
         params = quant.quantize_params(params)
     return cfg, params
 
@@ -223,6 +228,9 @@ def main(argv=None) -> int:
     ap.add_argument("--model", default="flagship-small")
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 (the 14GiB Llama-2-7B config)")
+    ap.add_argument("--int4", action="store_true",
+                    help="weight-only grouped int4, packed two-per-byte "
+                         "(a 7B model in a ~7GiB grant)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--addr", default="0.0.0.0")
     ap.add_argument("--slots", type=int, default=0,
@@ -257,12 +265,14 @@ def main(argv=None) -> int:
     else:
         log.info("running unallocated (dev mode)")
 
-    cfg, params = build_model(args.model, args.int8)
+    cfg, params = build_model(args.model, args.int8,
+                              quantize_int4=args.int4)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp)
-    log.info("llm server: model=%s int8=%s tp=%d on :%d", args.model,
-             args.int8, args.tp, srv.port)
+    log.info("llm server: model=%s quant=%s tp=%d on :%d", args.model,
+             "int4" if args.int4 else ("int8" if args.int8 else "none"),
+             args.tp, srv.port)
     srv.serve_forever()
     return 0
 

@@ -23,8 +23,9 @@ def leaf_key(path: str) -> str:
 
 def param_key(path: str) -> str:
     """The parameter-name component: the last one, except that quantized
-    leaves ({'q','s'} one level down) report their parent ('wq', not 'q')."""
+    leaves ({'q','s'} int8 / {'q4','s'} int4, one level down) report
+    their parent ('wq', not 'q') so they inherit its sharding rule."""
     parts = components(path)
-    if len(parts) >= 2 and parts[-1] in ("q", "s"):
+    if len(parts) >= 2 and parts[-1] in ("q", "q4", "s"):
         return parts[-2]
     return parts[-1] if parts else ""
