@@ -84,6 +84,53 @@ def main() -> int:
           "tokens/s", platform=platform, slots=slots, page_size=16,
           vs_dense=round(dt / dt_paged, 3))
 
+    # 2c. fused greedy decode, bf16 vs int8 vs int4: batch-1 decode is
+    # WEIGHT-bound (every token re-reads all weights), so weight-only
+    # quantization should convert its bandwidth saving into tokens/s
+    # almost 1:1.  The whole decode loop is one jitted scan
+    # (generate_fused) so the tunnel RPC is paid once per run; the
+    # measured noop round trip is subtracted.
+    from tpushare.ops import quant
+    from tpushare.serving.generate import generate_fused
+
+    @jax.jit
+    def _noop(x):
+        return (x + 1.0).astype(jnp.float32)
+
+    float(_noop(jnp.float32(0)))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        float(_noop(jnp.float32(0)))
+    rtt = (time.perf_counter() - t0) / 4
+
+    dcfg = (transformer.ModelConfig(vocab=32000, d_model=2048, n_layers=16,
+                                    n_heads=16, n_kv_heads=16, d_ff=5632,
+                                    max_seq=256)
+            if on_tpu else transformer.tiny(max_seq=96))
+    dparams = transformer.init_params(jax.random.PRNGKey(5), dcfg)
+    n_gen = 64 if on_tpu else 8
+    prompt = jnp.asarray([[3, 1, 4, 1]], jnp.int32)
+    variants = [("bf16", dparams),
+                ("int8", quant.quantize_params(dparams)),
+                ("int4", quant.quantize_params(dparams, bits=4))]
+    base_tps = None
+    for qname, p in variants:
+        out = generate_fused(p, dcfg, prompt, max_new_tokens=n_gen)
+        int(out[0, -1])                       # compile + barrier
+        reps = 2
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = generate_fused(p, dcfg, prompt, max_new_tokens=n_gen)
+            int(out[0, -1])
+        dt = max((time.perf_counter() - t0) / reps - rtt, 1e-9)
+        tps = n_gen / dt
+        extra = {"vs_bf16": round(tps / base_tps, 3)} if base_tps else {}
+        if base_tps is None:
+            base_tps = tps
+        _emit(f"fused_decode_b1_tokens_per_s_{qname}", tps, "tokens/s",
+              platform=platform, n_layers=dcfg.n_layers,
+              d_model=dcfg.d_model, **extra)
+
     # 3. speculative decoding ceiling: draft == target isolates the
     # mechanism (acceptance 1.0); with randomly-initialized models a
     # separate draft's acceptance is meaningless, while real deployments

@@ -50,3 +50,33 @@ def test_eos_early_stop_pads_to_fixed_shape():
     assert out.shape == (2, 14)  # fixed shape regardless of early exit
     assert int(out[0, 8]) == eos
     assert np.all(np.asarray(out[0, 8:]) == eos)  # padded after finish
+
+
+def test_generate_fused_matches_loop_greedy():
+    from tpushare.serving.generate import generate, generate_fused
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[5, 9, 2], [7, 1, 3]], jnp.int32)
+    loop = generate(params, cfg, prompt, max_new_tokens=8)
+    fused = generate_fused(params, cfg, prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(loop), np.asarray(fused))
+
+
+def test_generate_fused_eos_masks_tail():
+    from tpushare.serving.generate import generate, generate_fused
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[5, 9, 2]], jnp.int32)
+    # find the greedy stream, then declare its 3rd generated token EOS:
+    # everything after it must read as EOS in the fused output
+    plain = np.asarray(generate(params, cfg, prompt, max_new_tokens=8))
+    eos = int(plain[0, 3 + 2])
+    fused = np.asarray(generate_fused(params, cfg, prompt,
+                                      max_new_tokens=8, eos_id=eos))
+    first_eos = list(fused[0, 3:]).index(eos)
+    assert all(t == eos for t in fused[0, 3 + first_eos:])
+    # and tokens before the first EOS match the plain stream
+    np.testing.assert_array_equal(fused[0, :3 + first_eos],
+                                  plain[0, :3 + first_eos])

@@ -59,9 +59,14 @@ def qmatmul(x: jnp.ndarray, qw: Dict, dtype=None) -> jnp.ndarray:
 def quantize4(w: jnp.ndarray, group: int = 128):
     """w [..., d_in, d_out] -> {'q4': uint8 [..., g, group/2, d_out],
     's': f32 [..., g, 1, d_out]} with values in [-7, 7] packed
-    two-per-byte along the contraction dim (even positions in the low
-    nibble).  ``group`` falls back to the whole contraction dim when it
-    doesn't divide."""
+    two-per-byte along the contraction dim.
+
+    Pack layout is HALF-INTERLEAVED for the TPU's sake: byte j of a
+    group holds contraction rows j (low nibble) and j + group/2 (high
+    nibble), so unpacking is two arithmetic shifts — no cross-sublane
+    interleave (an even/odd pairing needs a stack+reshape relayout that
+    measured 10x SLOWER than bf16 on a v5e).  ``group`` falls back to
+    the whole contraction dim when it doesn't divide."""
     wf = w.astype(jnp.float32)
     d_in = wf.shape[-2]
     if d_in % group or group % 2:
@@ -74,29 +79,46 @@ def quantize4(w: jnp.ndarray, group: int = 128):
     amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 7.0
     q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int32)
-    lo, hi = q[..., 0::2, :], q[..., 1::2, :]
+    lo, hi = q[..., :group // 2, :], q[..., group // 2:, :]
     packed = ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.uint8)
     return {"q4": packed, "s": scale.astype(jnp.float32)}
 
 
+def _unpack4(p: jnp.ndarray):
+    """packed uint8 -> (lo, hi) int8 nibbles, sign-extended by arithmetic
+    shifts (no comparisons, no relayout): lo is contraction rows
+    [0, group/2), hi is [group/2, group) of each group."""
+    i8 = p.astype(jnp.int8)
+    four = jnp.int8(4)
+    lo = jax.lax.shift_right_arithmetic(jax.lax.shift_left(i8, four), four)
+    hi = jax.lax.shift_right_arithmetic(i8, four)
+    return lo, hi
+
+
 def dequantize4(qw: Dict, dtype=jnp.bfloat16) -> jnp.ndarray:
     """{'q4','s'} -> dense [..., d_in, d_out] weight."""
-    p = qw["q4"].astype(jnp.int32)
-    lo = p & 0xF
-    hi = (p >> 4) & 0xF
-    lo = jnp.where(lo > 7, lo - 16, lo)
-    hi = jnp.where(hi > 7, hi - 16, hi)
-    q = jnp.stack([lo, hi], axis=-2)               # [..., group/2, 2, d_out]
-    *lead, g, half, two, d_out = q.shape
-    q = q.reshape(*lead, g, half * two, d_out)     # restore even/odd order
+    lo, hi = _unpack4(qw["q4"])
+    q = jnp.concatenate([lo, hi], axis=-2)         # [..., g, group, d_out]
     w = q.astype(jnp.float32) * qw["s"]
-    return w.reshape(*lead, g * half * two, d_out).astype(dtype)
+    *lead, g, group, d_out = w.shape
+    return w.reshape(*lead, g * group, d_out).astype(dtype)
 
 
 def q4matmul(x: jnp.ndarray, qw: Dict) -> jnp.ndarray:
-    """x @ dequant4(qw): the bf16 weight is a transient (XLA frees it
-    after the matmul); persistent HBM holds only the packed nibbles."""
-    return x @ dequantize4(qw, dtype=x.dtype)
+    """Grouped int4 matmul with the dequant DEFERRED to the output:
+    y = sum_g s_g * (x_lo_g @ lo_g + x_hi_g @ hi_g).
+
+    Like the int8 path, the only op touching weight-sized data is the
+    nibble upcast feeding the MXU (fusable); scales multiply the small
+    [..., g, d_out] per-group partials.  Persistent HBM stays 4-bit."""
+    lo, hi = _unpack4(qw["q4"])                    # [..., g, k, d_out]
+    g, k = lo.shape[-3], lo.shape[-2]
+    lead = x.shape[:-1]
+    xg = x.reshape(*lead, g, 2, k)                 # halves of each group
+    yl = jnp.einsum("...gk,gkd->...gd", xg[..., 0, :], lo.astype(x.dtype))
+    yh = jnp.einsum("...gk,gkd->...gd", xg[..., 1, :], hi.astype(x.dtype))
+    y = (yl + yh) * qw["s"][..., 0, :].astype(x.dtype)
+    return y.sum(axis=-2)
 
 
 def matmul_maybe_q(x: jnp.ndarray, w) -> jnp.ndarray:
