@@ -80,3 +80,20 @@ def test_generate_fused_eos_masks_tail():
     # and tokens before the first EOS match the plain stream
     np.testing.assert_array_equal(fused[0, :3 + first_eos],
                                   plain[0, :3 + first_eos])
+
+
+def test_generate_fused_matches_loop_sampled():
+    """Sampling: the fused scan carries the key with the same
+    split-per-step sequence as the host loop, so seeded streams are
+    bit-identical between the two paths."""
+    from tpushare.serving.generate import generate, generate_fused
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[5, 9, 2], [7, 1, 3]], jnp.int32)
+    key = jax.random.PRNGKey(42)
+    loop = generate(params, cfg, prompt, max_new_tokens=8,
+                    temperature=0.8, key=key)
+    fused = generate_fused(params, cfg, prompt, max_new_tokens=8,
+                           temperature=0.8, key=key)
+    np.testing.assert_array_equal(np.asarray(loop), np.asarray(fused))

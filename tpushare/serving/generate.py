@@ -47,58 +47,108 @@ def make_decode_fns(cfg: transformer.ModelConfig):
 
 @functools.lru_cache(maxsize=8)
 def make_fused_decode(cfg: transformer.ModelConfig):
-    """Greedy multi-token decode: ONE jitted call scans ``n`` steps on
-    device (token -> forward -> argmax -> next token) and returns all
+    """Multi-token decode: ONE jitted call scans ``n`` steps on device
+    (token -> forward -> argmax-or-sample -> next token) and returns all
     generated tokens.
 
     One host round trip per ``n`` tokens instead of per token — the
     difference between ~14 tokens/s (per-dispatch, ~70 ms RPC each on a
-    tunnel-attached chip) and compute-limited decode.  Greedy only: the
-    sampled path needs per-step host RNG bookkeeping and stays in
-    :func:`generate`'s loop.
+    tunnel-attached chip) and compute-limited decode.  Sampling carries
+    the PRNG key through the scan with the SAME split-per-step sequence
+    :func:`generate`'s host loop performs, so the two paths produce
+    bit-identical streams (PRNG splits are deterministic functions).
     """
 
-    @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=(2,))
-    def decode_n(params, token0, caches, pos0, n: int):
+    # Compile count must stay bounded on the serving hot path: ``n`` is
+    # BUCKETED by the caller (powers of two) and ``temperature`` is a
+    # TRACED operand — only the sample/greedy choice is static.  A raw
+    # client float as a static arg would recompile the whole n-step scan
+    # per distinct value (~20-140 s each on a tunneled backend).
+    @functools.partial(jax.jit, static_argnames=("n", "sample"),
+                       donate_argnums=(2,))
+    def decode_n(params, token0, caches, pos0, key, temperature, n: int,
+                 sample: bool):
         def body(carry, _):
-            token, caches, pos = carry
+            token, caches, pos, key = carry
             logits, caches = transformer.forward(
                 params, token[:, None], cfg, kv_caches=caches,
                 cache_len=pos)
-            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(token.dtype)
-            return (nxt, caches, pos + 1), nxt
+            if sample:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits[:, 0] / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits[:, 0], axis=-1)
+            nxt = nxt.astype(token.dtype)
+            return (nxt, caches, pos + 1, key), nxt
 
-        (_, caches, _), toks = jax.lax.scan(
-            body, (token0, caches, jnp.asarray(pos0, jnp.int32)), None,
-            length=n)
+        (_, caches, _, _), toks = jax.lax.scan(
+            body, (token0, caches, jnp.asarray(pos0, jnp.int32), key),
+            None, length=n)
         return toks.T, caches                       # [B, n]
 
     return decode_n
 
 
+_DUMMY_KEY = None
+
+
+def _greedy_dummy_key():
+    """One shared placeholder key for the greedy specialization (never
+    read) — building PRNGKey(0) per request would add a device dispatch
+    to the very hot path the fusion exists to shrink."""
+    global _DUMMY_KEY
+    if _DUMMY_KEY is None:
+        _DUMMY_KEY = jax.random.PRNGKey(0)
+    return _DUMMY_KEY
+
+
 def generate_fused(params, cfg: transformer.ModelConfig, prompt: jnp.ndarray,
                    max_new_tokens: int = 32,
+                   temperature: float = 0.0,
+                   key: Optional[jax.Array] = None,
                    eos_id: Optional[int] = None) -> jnp.ndarray:
-    """Greedy :func:`generate` with the whole decode loop fused into one
+    """:func:`generate` with the whole decode loop fused into one
     device-resident scan.  Token streams are identical to ``generate``'s
-    (same forwards, same argmax); with ``eos_id`` the post-EOS tail is
-    masked host-side afterwards (the scan itself stays branch-free, so
-    compute past an early EOS is spent, not saved — the continuous
-    batcher is the tool when early exit matters)."""
+    (same forwards, same argmax / same key-split sequence when
+    sampling); with ``eos_id`` the post-EOS tail is masked host-side
+    afterwards (the scan itself stays branch-free, so compute past an
+    early EOS is spent, not saved — the continuous batcher is the tool
+    when early exit matters)."""
     b, prompt_len = prompt.shape
     assert prompt_len + max_new_tokens <= cfg.max_seq, (
         f"{prompt_len}+{max_new_tokens} exceeds max_seq {cfg.max_seq}")
     if max_new_tokens < 1:
         return prompt                        # mirror generate(): no tokens
+    if temperature > 0.0 and key is None:
+        key = jax.random.PRNGKey(0)
     caches = transformer.init_kv_caches(cfg, batch=b)
     prefill, _ = make_decode_fns(cfg)
     logits, caches = prefill(params, prompt, caches, prompt_len)
-    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    if temperature > 0.0:
+        key, sub = jax.random.split(key)
+        first = jax.random.categorical(sub, logits / temperature, axis=-1)
+    else:
+        first = jnp.argmax(logits, axis=-1)
+    first = first.astype(prompt.dtype)
     pieces = [prompt, first[:, None]]
     if max_new_tokens > 1:
+        n = max_new_tokens - 1
+        # Bucket the static scan length to the next power of two (capped
+        # by cache capacity) so organic max_new_tokens variance compiles
+        # O(log max_seq) programs, not one per distinct length; the
+        # surplus steps decode past the request and are sliced off
+        # (causality: they cannot affect earlier tokens).
+        n_run = 1
+        while n_run < n:
+            n_run *= 2
+        n_run = min(n_run, cfg.max_seq - prompt_len - 1)
         rest, _ = make_fused_decode(cfg)(
-            params, first, caches, prompt_len, n=max_new_tokens - 1)
-        pieces.append(rest.astype(prompt.dtype))
+            params, first, caches, prompt_len,
+            key if temperature > 0.0 else _greedy_dummy_key(),
+            jnp.float32(temperature if temperature > 0.0 else 1.0),
+            n=n_run, sample=temperature > 0.0)
+        pieces.append(rest[:, :n].astype(prompt.dtype))
     out = jnp.concatenate(pieces, axis=1)
     if eos_id is not None:
         gen = out[:, prompt_len:]

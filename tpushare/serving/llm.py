@@ -108,8 +108,6 @@ class LLMServer:
         import jax
         import jax.numpy as jnp
 
-        from .generate import generate
-
         if "text" in body and body.get("tokens") is not None:
             return 400, {"Error": "send either text or tokens, not both"}
         text_mode = "text" in body
@@ -176,9 +174,13 @@ class LLMServer:
         key = jax.random.PRNGKey(seed)
         prompt = jnp.asarray(tokens, dtype=jnp.int32)
         with self._gen_lock:
-            out = generate(self.params, self.cfg, prompt,
-                           max_new_tokens=max_new,
-                           temperature=temperature, key=key)
+            # the whole decode loop is one device-resident scan (one host
+            # round trip per request, not per token); streams are
+            # identical to the per-token loop path (tested)
+            from .generate import generate_fused
+            out = generate_fused(self.params, self.cfg, prompt,
+                                 max_new_tokens=max_new,
+                                 temperature=temperature, key=key)
             self.requests_served += 1
             self.sequences_served += len(tokens)
             self.tokens_generated += max_new * len(tokens)
