@@ -68,6 +68,27 @@ def main() -> int:
     _emit("llm_decode_tokens_per_s", timed_tokens / dt, "tokens/s",
           platform=platform, slots=slots, ticks=ticks)
 
+    # 2a. the same workload with the FUSED batcher loop: decode_chunk
+    # ticks per host round trip (tick_fused's device-resident scan) —
+    # the serving answer to the ~70 ms-per-dispatch tunnel RPC tax.
+    chunk = 16 if on_tpu else 4
+    assert gen - 1 > chunk, "warm chunk would drain the slots untimed"
+    bf = ContinuousBatcher(lparams, lcfg, n_slots=slots)
+    for i in range(slots):
+        bf.admit([1 + i, 2, 3], gen)
+    bf.tick_fused(chunk)  # warm the fused compile before timing
+    t0 = time.perf_counter()
+    chunks = 0
+    while bf.slots:
+        bf.tick_fused(chunk)
+        chunks += 1
+    dt_fused = time.perf_counter() - t0
+    fused_timed = slots * (gen - 1 - chunk)  # admit + warm chunk untimed
+    _emit("llm_decode_tokens_per_s_fused", fused_timed / dt_fused,
+          "tokens/s", platform=platform, slots=slots, decode_chunk=chunk,
+          chunks=chunks, vs_ticked=round((fused_timed / dt_fused)
+                                         / (timed_tokens / dt), 3))
+
     # 2b. same decode workload through the PAGED batcher: measures the
     # gather/scatter overhead paged storage pays per tick (its win is
     # capacity — more in-flight sequences per HBM byte — not speed).

@@ -1,0 +1,149 @@
+"""Fused multi-tick decode: tick_fused must be bit-identical to single
+ticks (and hence to per-request generate()) under any interleaving,
+for dense and paged storage, greedy and sampling."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.generate import generate
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _plain(params, cfg, prompt, n):
+    return [int(t) for t in generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), max_new_tokens=n)[0]]
+
+
+def _drain_fused(b, chunk, max_chunks=1000):
+    for _ in range(max_chunks):
+        if b.prefilling:
+            b.advance_prefill()
+            b.tick()
+        elif not b.tick_fused(chunk):
+            return
+    raise RuntimeError("did not drain")
+
+
+def test_fused_greedy_matches_generate_with_midchunk_completion(model):
+    """Requests whose lengths are NOT multiples of the chunk finish
+    mid-chunk; surplus garbage steps must never leak into outputs."""
+    params, cfg = model
+    requests = [([3, 5, 7], 6), ([11, 13], 9), ([2, 4, 6, 8, 10], 5)]
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    rids = [b.admit(p, n) for p, n in requests]
+    _drain_fused(b, chunk=4)
+    for rid, (prompt, n) in zip(rids, requests):
+        assert b.completed[rid] == _plain(params, cfg, prompt, n), rid
+
+
+def test_fused_sampling_bitidentical_to_single_ticks(model):
+    """Same seed through tick() vs tick_fused() must emit the same
+    stream — the in-scan key chain replays the host loop's splits."""
+    params, cfg = model
+    prompt, n = [5, 4, 3, 2, 1, 0, 6], 11
+
+    b1 = ContinuousBatcher(params, cfg, n_slots=2)
+    ra = b1.admit(prompt, n, temperature=0.9, seed=17)
+    rg = b1.admit([9, 9], n)                       # greedy neighbour
+    b1.run_until_drained()
+
+    b2 = ContinuousBatcher(params, cfg, n_slots=2)
+    rb = b2.admit(prompt, n, temperature=0.9, seed=17)
+    rh = b2.admit([9, 9], n)
+    _drain_fused(b2, chunk=4)
+
+    assert b1.completed[ra] == b2.completed[rb]
+    assert b1.completed[rg] == b2.completed[rh]
+
+
+def test_fused_interleaved_with_single_ticks_and_admission(model):
+    """tick / tick_fused interleave freely; a slot freed at a chunk
+    boundary is reused mid-flight with exact outputs."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    r1 = b.admit([1, 2, 3], 10, temperature=1.1, seed=3)
+    r2 = b.admit([9, 8], 3)
+    b.tick()
+    b.tick_fused(2)
+    while r2 not in b.completed:
+        b.tick_fused(4)
+    r3 = b.admit([5, 6, 7, 8], 5)
+    b.tick()
+    _drain_fused(b, chunk=4)
+    # sampled stream must match the pure single-tick replay
+    ref = ContinuousBatcher(params, cfg, n_slots=1)
+    rr = ref.admit([1, 2, 3], 10, temperature=1.1, seed=3)
+    ref.run_until_drained()
+    assert b.completed[r1] == ref.completed[rr]
+    assert b.completed[r2] == _plain(params, cfg, [9, 8], 3)
+    assert b.completed[r3] == _plain(params, cfg, [5, 6, 7, 8], 5)
+
+
+def test_fused_with_prefilling_neighbour_slot(model):
+    """A fused chunk while another slot is mid-(chunked-)prefill: the
+    chunk's wandering garbage writes must not disturb the prefill."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    r1 = b.admit([7, 8, 9], 12)
+    r2 = b.admit_chunked(list(range(1, 11)), 5, chunk=3)
+    while b.prefilling:
+        b.tick_fused(4)          # decode r1 fused while r2 prefills
+        b.advance_prefill()
+    _drain_fused(b, chunk=4)
+    assert b.completed[r1] == _plain(params, cfg, [7, 8, 9], 12)
+    assert b.completed[r2] == _plain(params, cfg, list(range(1, 11)), 5)
+
+
+def test_paged_fused_matches_generate(model):
+    params, cfg = model
+    requests = [([3, 5, 7], 6), ([11, 13], 9), ([2, 4, 6, 8, 10], 5)]
+    b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=16)
+    rids = [b.admit(p, n) for p, n in requests]
+    _drain_fused(b, chunk=4)
+    for rid, (prompt, n) in zip(rids, requests):
+        assert b.completed[rid] == _plain(params, cfg, prompt, n), rid
+    assert b.free_page_count() == b.n_pages - 1     # all pages returned
+
+
+def test_paged_fused_sampling_and_page_reuse(model):
+    """Sampling bit-identity on paged storage + a second request reusing
+    the first one's (garbage-tainted) pages decodes exactly."""
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=1, page_size=16,
+                               n_pages=3)       # trash + 2 usable
+    r1 = b.admit([4, 2, 4], 7, temperature=0.8, seed=5)
+    _drain_fused(b, chunk=4)                    # overruns into garbage
+    r2 = b.admit([6, 6, 6, 1], 8)               # reuses r1's pages
+    _drain_fused(b, chunk=4)
+    ref = PagedContinuousBatcher(params, cfg, n_slots=1, page_size=16,
+                                 n_pages=3)
+    rr = ref.admit([4, 2, 4], 7, temperature=0.8, seed=5)
+    ref.run_until_drained()
+    assert b.completed[r1] == ref.completed[rr]
+    assert b.completed[r2] == _plain(params, cfg, [6, 6, 6, 1], 8)
+
+
+def test_service_fused_decode_end_to_end(model):
+    """ContinuousService with decode_chunk > 1 (the default) still
+    matches per-request greedy, including queueing beyond the pool."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4).start()
+    try:
+        reqs = [([3, 5, 7, 9, 11], 6), ([2, 4], 9), ([1] * 13, 5),
+                ([8, 8], 3)]
+        sinks = [service.submit(p, n) for p, n in reqs]
+        for sink, (p, n) in zip(sinks, reqs):
+            assert sink.get(timeout=120) == _plain(params, cfg, p, n)
+    finally:
+        service.stop()

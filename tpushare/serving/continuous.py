@@ -104,6 +104,38 @@ def _tick(params, tokens, caches, lengths, temps, keys, cfg):
     return _sample_next(logits[:, 0], temps, keys), caches
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(2,))
+def _tick_n(params, tokens, caches, lengths, temps, keys, cfg, n: int):
+    """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
+    round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
+    per token, the same fusion :func:`tpushare.serving.generate
+    .make_fused_decode` applies to single requests, applied to the whole
+    slot pool.
+
+    Bit-identity with the single-step :func:`_tick` loop: each scan step
+    runs the identical forward + :func:`_sample_next`, and the per-slot
+    PRNG keys are carried through the scan with the SAME
+    ``key, sub = split(key)`` sequence the host loop performs — splits
+    are deterministic, so any interleaving of ``tick``/``tick_fused``
+    yields the same stream.  Returns (tokens [B, n], final keys, caches);
+    the caller consumes only each slot's first ``remaining`` tokens —
+    steps past a finished slot write garbage K/V that is contained
+    exactly like an inactive slot's (position p is overwritten at
+    length==p before any query attends p, even across slot reuse).
+    """
+    def body(carry, _):
+        tok, caches, lengths, keys = carry
+        ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
+        logits, caches = transformer.forward(
+            params, tok, cfg, kv_caches=caches, cache_len=lengths)
+        nxt = _sample_next(logits[:, 0], temps, ks[:, 1])
+        return (nxt[:, None], caches, lengths + 1, ks[:, 0]), nxt
+
+    (_, caches, _, keys), toks = jax.lax.scan(
+        body, (tokens, caches, lengths, keys), None, length=n)
+    return toks.T, keys, caches
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -174,6 +206,12 @@ class ContinuousBatcher:
         nxt, self.caches = _tick(
             self.params, tokens, self.caches, lengths, temps, keys, self.cfg)
         return nxt
+
+    def _step_n(self, tokens, lengths, temps, keys, n_steps: int):
+        toks, keys, self.caches = _tick_n(
+            self.params, tokens, self.caches, lengths, temps, keys,
+            self.cfg, n_steps)
+        return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
                             last_idx: int, chunk_len: int):
@@ -300,25 +338,42 @@ class ContinuousBatcher:
                                st.max_new, st.temperature, st.seed)
         return len(self.prefilling)
 
-    def tick(self) -> int:
-        """One decode step for all active slots; returns #active before."""
-        if not self.slots:
-            return 0
+    def _gather_slot_arrays(self):
+        """Assemble the per-slot device operands (tokens, lengths, temps,
+        key-data) for a tick — shared by the single and fused paths so
+        the mid-prefill garbage-write aiming cannot drift between them.
+        ``keys[i]`` is slot i's CURRENT key data (unsplit); each caller
+        advances the split chain its own way (host split per tick vs
+        in-scan split per step — the same deterministic chain).
+
+        A tick unconditionally writes one garbage K/V at lengths[i] for
+        every non-active slot.  Empty rows don't care, but a slot
+        MID-PREFILL holds real prompt data — aim its garbage write at
+        the next chunk's offset, which that chunk's forward overwrites
+        before the position ever becomes attendable.  (A fused chunk's
+        writes wander pos..pos+n-1; the same position-by-position
+        argument contains them.)
+        """
         tokens = np.zeros((self.n_slots, 1), np.int32)
         lengths = np.zeros((self.n_slots,), np.int32)
         temps = np.zeros((self.n_slots,), np.float32)
         keys = np.zeros((self.n_slots, 2), np.uint32)
-        # The tick unconditionally writes one garbage K/V at lengths[i]
-        # for every non-active slot.  Empty rows don't care, but a slot
-        # MID-PREFILL holds real prompt data — aim its garbage write at
-        # the next chunk's offset, which that chunk's forward overwrites
-        # before the position ever becomes attendable.
         for i, st in self.prefilling.items():
             lengths[i] = st.pos
         for i, s in self.slots.items():
             tokens[i, 0] = s.last_token
             lengths[i] = s.length
             temps[i] = s.temperature
+            if s.temperature > 0.0:
+                keys[i] = np.asarray(jax.random.key_data(s.key))
+        return tokens, lengths, temps, keys
+
+    def tick(self) -> int:
+        """One decode step for all active slots; returns #active before."""
+        if not self.slots:
+            return 0
+        tokens, lengths, temps, keys = self._gather_slot_arrays()
+        for i, s in self.slots.items():
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
@@ -336,6 +391,46 @@ class ContinuousBatcher:
                 self.completed[s.request_id] = s.output
                 self._release(i)
                 del self.slots[i]
+        return n_active
+
+    def tick_fused(self, n_steps: int) -> int:
+        """Up to ``n_steps`` decode ticks in ONE jitted scan (one host
+        round trip); returns #active slots before the chunk.
+
+        Token streams are bit-identical to ``n_steps`` calls of
+        :meth:`tick` (see :func:`_tick_n`); the two may be interleaved
+        freely.  Slots finishing mid-chunk complete at chunk end (their
+        surplus steps decode garbage that is never consumed), so a
+        fused chunk trades ≤ ``n_steps-1`` ticks of completion/admission
+        latency for per-token host-RPC amortization.  Keep ``n_steps``
+        fixed (or bucketed) across calls — it is a static arg and every
+        distinct value compiles a fresh n-step program.
+        """
+        if not self.slots:
+            return 0
+        tokens, lengths, temps, keys = self._gather_slot_arrays()
+        toks, new_keys = self._step_n(
+            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
+            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)), n_steps)
+        toks = np.asarray(toks)
+        new_keys = np.asarray(jax.random.key_data(new_keys))
+        n_active = len(self.slots)
+        for i in list(self.slots):
+            s = self.slots[i]
+            take = min(n_steps, s.remaining)
+            s.output.extend(int(t) for t in toks[i, :take])
+            s.length += take
+            s.last_token = int(toks[i, take - 1])
+            s.remaining -= take
+            if s.remaining <= 0:
+                self.completed[s.request_id] = s.output
+                self._release(i)
+                del self.slots[i]
+            elif s.temperature > 0.0:
+                # the device carried key split exactly `take` == n_steps
+                # times for a continuing slot — same chain the host loop
+                # would have walked
+                s.key = jax.random.wrap_key_data(jnp.asarray(new_keys[i]))
         return n_active
 
     def run_until_drained(self, max_ticks: int = 10_000) -> None:
@@ -360,11 +455,19 @@ class ContinuousService:
                  page_size: Optional[int] = None,
                  n_pages: Optional[int] = None,
                  prefill_chunk: int = 64,
+                 decode_chunk: int = 8,
                  mesh=None):
         import queue as _q
         import threading
 
         self._q = _q
+        # Steady-state decoding runs decode_chunk ticks per host round
+        # trip (tick_fused) — the host-RPC amortization that closes most
+        # of the per-dispatch vs fused-scan throughput gap.  1 disables
+        # fusion.  The trade is ≤ decode_chunk-1 ticks of completion/
+        # admission latency per chunk; prefilling slots force single
+        # ticks so chunked prompts keep streaming at tick cadence.
+        self._decode_chunk = max(1, decode_chunk)
         # Admission streams prompts in prefill_chunk-token pieces so a
         # long prompt cannot stall decoding slots for more than one
         # chunk's forward (paged storage rounds the chunk up to a page
@@ -481,7 +584,11 @@ class ContinuousService:
                 self._sinks[rid] = sink
             if self._batcher.prefilling:
                 self._batcher.advance_prefill()
-            active = self._batcher.tick()
+                active = self._batcher.tick()
+            elif self._decode_chunk > 1:
+                active = self._batcher.tick_fused(self._decode_chunk)
+            else:
+                active = self._batcher.tick()
             for rid in list(self._batcher.completed):
                 sink = self._sinks.pop(rid, None)
                 if sink is not None:

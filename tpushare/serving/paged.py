@@ -70,6 +70,29 @@ def _tick(params, tokens, pools, page_table, lengths, temps, keys, cfg):
     return _sample_next(logits[:, 0], temps, keys), pools
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(2,))
+def _tick_n(params, tokens, pools, page_table, lengths, temps, keys, cfg,
+            n: int):
+    """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
+    device scan.  The page table is FIXED across the chunk — safe because
+    reservation is worst-case at admit (a slot can never need a new page
+    mid-decode), and a finished slot's surplus steps land on the trash
+    page / its own already-released lanes, contained like every other
+    garbage write (rewritten before attendable, even across page reuse).
+    """
+    def body(carry, _):
+        tok, pools, lengths, keys = carry
+        ks = jax.vmap(jax.random.split)(keys)
+        logits, pools = transformer.forward_paged_decode(
+            params, tok, cfg, pools, page_table, lengths)
+        nxt = _sample_next(logits[:, 0], temps, ks[:, 1])
+        return (nxt[:, None], pools, lengths + 1, ks[:, 0]), nxt
+
+    (_, pools, _, keys), toks = jax.lax.scan(
+        body, (tokens, pools, lengths, keys), None, length=n)
+    return toks.T, keys, pools
+
+
 class PagedContinuousBatcher(ContinuousBatcher):
     """Dense batcher with the storage hooks swapped for a paged pool."""
 
@@ -135,6 +158,12 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, self.cfg)
         return nxt
+
+    def _step_n(self, tokens, lengths, temps, keys, n_steps: int):
+        toks, keys, self.pools = _tick_n(
+            self.params, tokens, self.pools, jnp.asarray(self.page_table),
+            lengths, temps, keys, self.cfg, n_steps)
+        return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
                             last_idx: int, chunk_len: int):
