@@ -166,8 +166,12 @@ def main() -> int:
           acceptance=round(sstats.acceptance_rate, 3), platform=platform)
 
     # 4. train step rate.  On TPU: a long-context shape (s=2048 through
-    # the flash kernel fwd+bwd, rematerialized backward) big enough that
-    # an MFU estimate means something; off-TPU: the tiny config.
+    # the flash kernel fwd+bwd) big enough that an MFU estimate means
+    # something; off-TPU: the tiny config.  remat="none" is the honest
+    # default at this shape (activations fit; any remat is pure FLOPs
+    # overhead — round 2 paid ~25% of its train MFU to a blanket
+    # checkpoint); the "layer" variant below prices the long-context
+    # lever (per-layer remat saving the flash residuals).
     tcfg = (transformer.ModelConfig(vocab=32000, d_model=1024, n_layers=8,
                                     n_heads=8, n_kv_heads=8, d_ff=2816,
                                     max_seq=2048)
@@ -203,7 +207,26 @@ def main() -> int:
         extra["train_mfu"] = round(flops_step * (n / dt) / peak, 4)
         extra["seq_len"] = s
     _emit("train_steps_per_s", n / dt, "steps/s", platform=platform,
-          tokens_per_step=tokens_per_step, **extra)
+          tokens_per_step=tokens_per_step, remat="none", **extra)
+
+    # 4b. the same step with per-layer remat (flash residuals saved):
+    # the long-context memory lever's FLOPs price at a shape where it
+    # isn't needed — recompute is projections+FFN only, never the
+    # O(S^2) kernel.
+    if on_tpu:
+        step_l = make_train_step(tcfg, opt, remat="layer")
+        tparams, ostate, loss = step_l(tparams, ostate, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tparams, ostate, loss = step_l(tparams, ostate, tokens)
+        float(loss)
+        dt_l = time.perf_counter() - t0
+        extra_l = dict(extra)
+        extra_l["train_mfu"] = round(flops_step * (n / dt_l) / peak, 4)
+        _emit("train_steps_per_s_layer_remat", n / dt_l, "steps/s",
+              platform=platform, tokens_per_step=tokens_per_step,
+              remat="layer", vs_none=round(dt / dt_l, 3), **extra_l)
     return 0
 
 

@@ -221,7 +221,8 @@ def forward(params, tokens, cfg: ModelConfig,
             kv_caches: Optional[Tuple] = None,
             cache_len: Optional[jnp.ndarray] = None,
             positions: Optional[jnp.ndarray] = None,
-            attention_fn=None):
+            attention_fn=None,
+            remat_policy=None):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -233,6 +234,14 @@ def forward(params, tokens, cfg: ModelConfig,
     ``functools.partial(tpushare.parallel.ring.ring_attention, mesh=mesh)``
     to run exact causal attention over sequence shards (sp axis) instead
     of the single-device kernel.
+
+    ``remat_policy`` (no-cache path only) wraps the scanned layer body
+    in per-layer ``jax.checkpoint``: the backward holds one layer's
+    internals at a time plus whatever the policy saves — pass
+    ``jax.checkpoint_policies.save_only_these_names('flash_attn_out',
+    'flash_attn_lse')`` to pin the flash kernel's residuals so remat
+    never re-runs the O(S^2) forward kernel (the fused backward consumes
+    them directly), or ``True`` for plain save-nothing remat.
     """
     b, s = tokens.shape
     if positions is None:
@@ -254,6 +263,10 @@ def forward(params, tokens, cfg: ModelConfig,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, attention_fn=attention_fn))
 
+        if remat_policy is not None:
+            body = jax.checkpoint(
+                body, policy=None if remat_policy is True else remat_policy,
+                prevent_cse=False)   # scan carries already block CSE
         x, _ = jax.lax.scan(body, x, params["layers"])
         new_caches = None
     else:

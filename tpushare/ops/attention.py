@@ -264,8 +264,21 @@ def _flash_core(q, k, v, causal: bool, block_q: int, block_k: int,
     return out
 
 
+def _name_residuals(out, lse):
+    """Tag the flash residuals for remat policies: under a per-layer
+    ``jax.checkpoint`` with ``save_only_these_names('flash_attn_out',
+    'flash_attn_lse')`` (see ``tpushare.parallel.train``), the backward
+    keeps (out, lse) and the recompute drops the whole forward kernel —
+    the fused backward needs nothing else beyond q/k/v, which the cheap
+    projection recompute regenerates."""
+    from jax.ad_checkpoint import checkpoint_name
+    return (checkpoint_name(out, "flash_attn_out"),
+            checkpoint_name(lse, "flash_attn_lse"))
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _name_residuals(out, lse)
     return out, (q, k, v, out, lse)
 
 
@@ -294,6 +307,7 @@ def _flash_core_lse(q, k, v, causal: bool, block_q: int, block_k: int,
 
 def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    out, lse = _name_residuals(out, lse)
     return (out, lse), (q, k, v, out, lse)
 
 

@@ -29,12 +29,13 @@ class Trainer:
                  ckpt_dir: Optional[str] = None,
                  save_every: int = 100,
                  max_to_keep: int = 3,
-                 lr: float = 3e-4, seed: int = 0):
+                 lr: float = 3e-4, seed: int = 0,
+                 remat: str = "none"):
         self.cfg = cfg
         self.mesh = mesh
         self.save_every = save_every
         self.optimizer = make_optimizer(lr=lr)
-        self.step_fn = make_train_step(cfg, self.optimizer)
+        self.step_fn = make_train_step(cfg, self.optimizer, remat=remat)
         self._mgr = (checkpoint.make_checkpoint_manager(ckpt_dir, max_to_keep)
                      if ckpt_dir else None)
         # step tracked as a host int: a jnp scalar would force a
