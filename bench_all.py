@@ -52,7 +52,11 @@ def main() -> int:
     lparams = transformer.init_params(jax.random.PRNGKey(1), lcfg)
     slots = 8 if on_tpu else 4
     b = ContinuousBatcher(lparams, lcfg, n_slots=slots)
-    gen = 64 if on_tpu else 8
+    # gen - 1 must be a multiple of the fused decode_chunk below: the
+    # fused drain then has NO final partial chunk, so no surplus garbage
+    # steps sit inside its timed window while being excluded from its
+    # token count (which would understate fused throughput vs ticked).
+    gen = 65 if on_tpu else 9
     for i in range(slots):
         b.admit([1 + i, 2, 3], gen)
     b.tick()  # warm the tick compile before timing
@@ -73,6 +77,7 @@ def main() -> int:
     # the serving answer to the ~70 ms-per-dispatch tunnel RPC tax.
     chunk = 16 if on_tpu else 4
     assert gen - 1 > chunk, "warm chunk would drain the slots untimed"
+    assert (gen - 1) % chunk == 0, "fused drain must end chunk-aligned"
     bf = ContinuousBatcher(lparams, lcfg, n_slots=slots)
     for i in range(slots):
         bf.admit([1 + i, 2, 3], gen)
@@ -88,6 +93,39 @@ def main() -> int:
           "tokens/s", platform=platform, slots=slots, decode_chunk=chunk,
           chunks=chunks, vs_ticked=round((fused_timed / dt_fused)
                                          / (timed_tokens / dt), 3))
+
+    # 2a-mixed. sustained ADMIT-WHILE-DECODE throughput through
+    # ContinuousService: a backlog of multi-chunk prompts streams in
+    # while earlier requests decode, so the loop constantly interleaves
+    # prompt chunks with fused decode chunks — the ragged-traffic regime
+    # the batcher exists for, and the one a drain-only number hides.
+    from tpushare.serving.continuous import ContinuousService
+    svc_chunk = 16 if on_tpu else 4
+    n_reqs = 3 * slots
+    prompt_len = (3 * 16) if on_tpu else 8     # multi-chunk prefill
+    svc_gen = 33 if on_tpu else 7
+    svc = ContinuousService(lparams, lcfg, n_slots=slots,
+                            prefill_chunk=16 if on_tpu else 4,
+                            decode_chunk=svc_chunk).start()
+    try:
+        # warm wave: compiles prefill-chunk + fused-chunk programs
+        warm = [svc.submit([7] * prompt_len, svc_gen)
+                for _ in range(slots)]
+        for s in warm:
+            s.get(timeout=600)
+        t0 = time.perf_counter()
+        sinks = [svc.submit([1 + (i % 50)] * prompt_len, svc_gen)
+                 for i in range(n_reqs)]
+        for s in sinks:
+            s.get(timeout=600)
+        dt_mixed = time.perf_counter() - t0
+    finally:
+        svc.stop()
+    _emit("llm_decode_tokens_per_s_mixed", n_reqs * svc_gen / dt_mixed,
+          "tokens/s", platform=platform, slots=slots, n_requests=n_reqs,
+          prompt_len=prompt_len, gen=svc_gen, decode_chunk=svc_chunk,
+          note="admit-while-decode: generated tokens only; prefill work "
+               "inside the timed window")
 
     # 2b. same decode workload through the PAGED batcher: measures the
     # gather/scatter overhead paged storage pays per tick (its win is

@@ -147,3 +147,70 @@ def test_service_fused_decode_end_to_end(model):
             assert sink.get(timeout=120) == _plain(params, cfg, p, n)
     finally:
         service.stop()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_fused_overrun_at_max_seq_boundary(model, paged):
+    """A request sized exactly to max_seq, drained with a fused chunk
+    that OVERRUNS the boundary: the surplus scan steps advance lengths
+    past max_seq, and containment rests on the storage's index clamping
+    (dense dynamic_update_slice clamps into the slot's own row; paged
+    take_along_axis clamps into its last page-table entry / trash page).
+    Outputs must stay bit-identical to generate() — on both storages —
+    so an index-mode change that breaks the implicit clamp fails here
+    instead of corrupting a neighbour in production."""
+    params, cfg = model
+    prompt = [3, 5, 7]
+    max_new = cfg.max_seq - len(prompt)            # fills the last position
+    if paged:
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+    else:
+        b = ContinuousBatcher(params, cfg, n_slots=2)
+    rid = b.admit(prompt, max_new)
+    r2 = b.admit([9, 8], 5)          # neighbour that finishes early
+    chunk = 8
+    assert (max_new - 1) % chunk, "chunk must overrun the boundary"
+    _drain_fused(b, chunk=chunk)
+    assert b.completed[rid] == _plain(params, cfg, prompt, max_new)
+    assert b.completed[r2] == _plain(params, cfg, [9, 8], 5)
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_service_fused_engages_while_prefilling(model, paged):
+    """Under admit-while-decode traffic the loop must interleave FUSED
+    decode chunks with prompt chunks — not fall back to single ticks
+    whenever anything is prefilling (which starved the fused path under
+    exactly the ragged traffic the batcher exists for) — and outputs
+    must still match per-request greedy, on BOTH storages (the paged
+    garbage-write containment is load-bearing here too)."""
+    params, cfg = model
+    # paged admission rounds the prefill chunk UP to a page multiple, so
+    # the page must not exceed the chunk or prompts prefill in one piece
+    # and the interleave window this test observes never opens
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4,
+                                page_size=4 if paged else None)
+    fused_while_prefilling = []
+    b = service._batcher
+    real_fused = b.tick_fused
+
+    def spy(n):
+        if b.prefilling:
+            fused_while_prefilling.append(n)
+        return real_fused(n)
+
+    b.tick_fused = spy
+    service.start()
+    try:
+        # long prompts (multiple prefill chunks) arriving while earlier
+        # requests decode long generations: prefilling is non-empty for
+        # many loop iterations mid-decode
+        reqs = [([3, 5, 7], 24), ([1] * 14, 20), ([2] * 11, 16),
+                ([6, 6, 6], 12)]
+        sinks = [service.submit(p, n) for p, n in reqs]
+        for sink, (p, n) in zip(sinks, reqs):
+            assert sink.get(timeout=120) == _plain(params, cfg, p, n)
+    finally:
+        service.stop()
+    assert fused_while_prefilling, \
+        "no fused chunk ran while a slot was prefilling"

@@ -125,3 +125,20 @@ def test_allocate_multi_container_pod(tmp_path):
             == "true"
     finally:
         api.stop()
+
+
+def test_measure_qps_honors_zero_warmup():
+    """warmup_batches=0 must mean ZERO hidden dispatches before the timed
+    window — an explicit 0 asks to measure cold-start throughput."""
+    from tpushare.serving import measure_qps
+
+    engine = InferenceEngine(lambda t: t * 2, batch_size=2, seq_len=4)
+    dispatches = []
+    real = engine.infer_async
+    engine.infer_async = lambda *a, **k: (dispatches.append(1),
+                                          real(*a, **k))[1]
+    measure_qps(engine, n_batches=3, warmup_batches=0)
+    assert len(dispatches) == 3
+    dispatches.clear()
+    measure_qps(engine, n_batches=3, warmup_batches=2)
+    assert len(dispatches) == 5

@@ -228,3 +228,19 @@ def test_flash_attention_lse_grad_includes_lse_cotangent():
     for name, a, b in zip("dq dk dv".split(), gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
                                    err_msg=name)
+
+
+def test_fit_block_rejects_sublane_misaligned_seq():
+    """Out-of-gate sequences whose only fitting block is not a multiple
+    of 8 must raise at trace time: the Pallas INTERPRETER would happily
+    run such a block while Mosaic refuses to lower it on real TPU, so a
+    silent fit here is an interpret/hardware divergence."""
+    from tpushare.ops.attention import _fit_block
+
+    assert _fit_block(512, 384) == 384        # in-gate shapes unaffected
+    assert _fit_block(512, 2048) == 512
+    assert _fit_block(128, 24) == 24          # 24 = 3*8: aligned divisor
+    with pytest.raises(ValueError, match="sublane"):
+        _fit_block(512, 12)                   # divisors: 12, 6, 3, ...
+    with pytest.raises(ValueError, match="sublane"):
+        _fit_block(64, 36)                    # 36 -> 36, 18, 9: none %8

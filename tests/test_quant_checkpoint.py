@@ -246,3 +246,23 @@ def test_int4_params_keep_tp_sharding():
     # and the tp-sharded int4 model still runs
     out = transformer.forward(sharded, jnp.ones((2, 8), jnp.int32), cfg)
     assert out.shape == (2, 8, cfg.vocab)
+
+
+def test_q4matmul_stacked_leaf_raises_clearly():
+    """quantize_params packs stacked [L, d_in, d_out] leaves into 4-D
+    {'q4','s'}; feeding one straight to q4matmul (instead of slicing a
+    layer out first, as the model's layer scan does) must raise a clear
+    ValueError — not an opaque einsum rank error."""
+    w = jax.random.normal(jax.random.PRNGKey(7), (2, 64, 32))  # stacked
+    qw = quant.quantize4(w, group=32)
+    assert qw["q4"].ndim == 4
+    x = jnp.ones((3, 64))
+    with pytest.raises(ValueError, match="slice the stacked leaf"):
+        quant.q4matmul(x, qw)
+    # the per-layer slice (what the scan feeds) works, and matches the
+    # explicit dequantized matmul (same values, deferred-scale order)
+    one = {"q4": qw["q4"][0], "s": qw["s"][0]}
+    y = quant.q4matmul(x, one)
+    ref = x @ quant.dequantize4(one, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-3)

@@ -29,12 +29,22 @@ NEG_INF = -1e30
 
 def _fit_block(block: int, seq: int) -> int:
     """Largest block <= requested that DIVIDES the sequence (the grid is
-    seq // block; a non-divisor would silently drop the tail).  Halving
-    from a 512 default over the s % 128 == 0 dispatch domain always
-    lands on a valid (multiple-of-8 sublane) size."""
+    seq // block; a non-divisor would silently drop the tail) AND is a
+    multiple of the 8-row sublane tile.  Over the s % 128 == 0 dispatch
+    domain halving always lands on a valid size; out-of-gate callers
+    (direct ``flash_attention`` with an odd seq) get a loud error here
+    instead of a kernel that passes the Pallas INTERPRETER and then
+    refuses to lower on real TPU (Mosaic requires (8k, 128) block
+    tiles — the interpreter does not enforce them)."""
     block = min(block, seq)
     while seq % block:
         block //= 2
+    if block % 8:
+        raise ValueError(
+            f"flash attention cannot tile seq={seq}: largest divisor "
+            f"<= the requested block is {block}, not a multiple of the "
+            "8-row sublane tile; pad the sequence (or use the jnp "
+            "reference path)")
     return block
 
 
@@ -344,7 +354,10 @@ def flash_attention(q, k, v, causal: bool = True,
     4.0x XLA's fused attention.  VMEM stays comfortable: the f32 score
     block is 1 MiB and K/V full-seq rows are 4 MiB even at s=8192.
     Blocks clamp to the sequence length, so short-seq callers are
-    unaffected."""
+    unaffected — unless the largest block that divides the sequence is
+    not a multiple of the 8-row sublane tile, which raises (see
+    :func:`_fit_block`; such shapes would only lower on the interpreter,
+    never on real TPU)."""
     return _flash_core(q, k, v, causal, block_q, block_k, interpret)
 
 

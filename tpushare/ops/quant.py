@@ -111,6 +111,15 @@ def q4matmul(x: jnp.ndarray, qw: Dict) -> jnp.ndarray:
     Like the int8 path, the only op touching weight-sized data is the
     nibble upcast feeding the MXU (fusable); scales multiply the small
     [..., g, d_out] per-group partials.  Persistent HBM stays 4-bit."""
+    if qw["q4"].ndim != 3:
+        # The einsum below contracts one LAYER's [g, k, d_out] nibbles;
+        # a stacked [L, ...] leaf (quantize_params on stacked params)
+        # must be sliced per layer first — e.g. by the model's layer
+        # scan — or the einsum dies with an opaque rank error.
+        raise ValueError(
+            f"q4matmul takes one layer's packed weight (ndim 3), got "
+            f"ndim {qw['q4'].ndim}; slice the stacked leaf per layer "
+            "before the matmul")
     lo, hi = _unpack4(qw["q4"])                    # [..., g, k, d_out]
     g, k = lo.shape[-3], lo.shape[-2]
     lead = x.shape[:-1]

@@ -456,6 +456,7 @@ class ContinuousService:
                  n_pages: Optional[int] = None,
                  prefill_chunk: int = 64,
                  decode_chunk: int = 8,
+                 prefill_decode_chunk: Optional[int] = None,
                  mesh=None):
         import queue as _q
         import threading
@@ -465,9 +466,23 @@ class ContinuousService:
         # trip (tick_fused) — the host-RPC amortization that closes most
         # of the per-dispatch vs fused-scan throughput gap.  1 disables
         # fusion.  The trade is ≤ decode_chunk-1 ticks of completion/
-        # admission latency per chunk; prefilling slots force single
-        # ticks so chunked prompts keep streaming at tick cadence.
+        # admission latency per chunk.
         self._decode_chunk = max(1, decode_chunk)
+        # While any slot is mid-prefill the loop interleaves ONE prompt
+        # chunk with a fused decode chunk of this size (default: the
+        # steady-state size, so only one n-step program ever compiles).
+        # Fusion alongside prefilling slots is safe — the fused chunk's
+        # garbage writes into a mid-prefill row wander pos..pos+n-1 and
+        # every such position is overwritten before it becomes
+        # attendable (see _gather_slot_arrays; bit-identity asserted in
+        # tests).  A SMALLER value trades decode amortization for prompt
+        # admission latency: each prefill chunk waits one fused chunk.
+        # Without this interleave the service fell back to single ticks
+        # whenever anything was prefilling, so under steady mixed
+        # admit-while-decode traffic the fused path rarely engaged.
+        self._prefill_decode_chunk = max(1, prefill_decode_chunk
+                                         if prefill_decode_chunk is not None
+                                         else self._decode_chunk)
         # Admission streams prompts in prefill_chunk-token pieces so a
         # long prompt cannot stall decoding slots for more than one
         # chunk's forward (paged storage rounds the chunk up to a page
@@ -583,8 +598,15 @@ class ContinuousService:
                 # are delivered by the post-tick completed drain below
                 self._sinks[rid] = sink
             if self._batcher.prefilling:
+                # One prompt chunk, then a fused decode chunk: prompts
+                # keep streaming while decoding slots keep their host-RPC
+                # amortization (see __init__ on _prefill_decode_chunk).
                 self._batcher.advance_prefill()
-                active = self._batcher.tick()
+                if self._prefill_decode_chunk > 1:
+                    active = self._batcher.tick_fused(
+                        self._prefill_decode_chunk)
+                else:
+                    active = self._batcher.tick()
             elif self._decode_chunk > 1:
                 active = self._batcher.tick_fused(self._decode_chunk)
             else:
