@@ -188,7 +188,43 @@ def main() -> int:
             base_tps = tps
         _emit(f"fused_decode_b1_tokens_per_s_{qname}", tps, "tokens/s",
               platform=platform, n_layers=dcfg.n_layers,
-              d_model=dcfg.d_model, **extra)
+              d_model=dcfg.d_model,
+              weights_gib=round(quant.hbm_bytes(p) / 2**30, 3), **extra)
+
+    # 2d. fused prompt-lookup speculation vs fused greedy, SAME model and
+    # prompt: the whole propose/verify/accept loop is one device-resident
+    # while_loop (host RPC paid once), the draft is n-gram lookup in the
+    # context (no second model), verification of k+1 tokens is nearly
+    # free at batch 1 (weight-bound).  A repetitive prompt is the honest
+    # showcase: prompt-lookup targets repetition-heavy serving (code,
+    # logs, RAG contexts).
+    from tpushare.serving.speculative import lookup_speculative_generate
+    rep_prompt = jnp.asarray([[7, 3, 9, 4] * 4], jnp.int32)    # [1, 16]
+    out = generate_fused(dparams, dcfg, rep_prompt, max_new_tokens=n_gen)
+    int(out[0, -1])
+    t0 = time.perf_counter()
+    for _ in range(2):
+        out = generate_fused(dparams, dcfg, rep_prompt,
+                             max_new_tokens=n_gen)
+        int(out[0, -1])
+    dt_greedy = max((time.perf_counter() - t0) / 2 - rtt, 1e-9)
+    out_s, nv = lookup_speculative_generate(dparams, dcfg, rep_prompt,
+                                            max_new_tokens=n_gen, k=8)
+    int(out_s[0, -1])
+    t0 = time.perf_counter()
+    for _ in range(2):
+        out_s, nv = lookup_speculative_generate(
+            dparams, dcfg, rep_prompt, max_new_tokens=n_gen, k=8)
+        int(out_s[0, -1])
+    dt_spec = max((time.perf_counter() - t0) / 2 - rtt, 1e-9)
+    assert (np.asarray(out_s) == np.asarray(out)).all(), \
+        "lookup speculation broke greedy exactness"
+    _emit("lookup_spec_decode_tokens_per_s", n_gen / dt_spec, "tokens/s",
+          platform=platform, n_layers=dcfg.n_layers, k=8,
+          target_forwards=int(nv), tokens=n_gen,
+          vs_fused_greedy=round(dt_greedy / dt_spec, 3),
+          note="greedy-exact; draft = in-context n-gram lookup, "
+               "device-resident loop")
 
     # 3. speculative decoding ceiling: draft == target isolates the
     # mechanism (acceptance 1.0); with randomly-initialized models a

@@ -12,7 +12,19 @@
  *   int         tpushim_chip_count(void);      /dev/accel* (vfio fallback)
  *   const char *tpushim_chip_info_json(int);   {"id","hbm_bytes","cores",
  *                                               "generation","dev_path"}
+ *   const char *tpushim_poll_events_json(void); health TRANSITIONS since
+ *                 the last poll, as a JSON array of {"chip","healthy",
+ *                 "reason"} — the TPU analog of the reference's NVML XID
+ *                 event watch (nvidia.go:100-152 over bindings.go:68-141).
+ *                 chip -1 = unattributable (libtpu runtime itself).
  *   const char *tpushim_version(void);
+ *
+ * Health probing goes BEYOND node presence: each poll open()s the device
+ * node (O_RDONLY|O_NONBLOCK).  EBUSY/EACCES/EPERM mean a workload owns
+ * the chip — healthy; ENXIO/EIO/ENODEV mean present-but-wedged silicon
+ * that a pure existence poll would keep reporting healthy.  The libtpu
+ * runtime file is also re-stat()ed so a driver uninstall/reinstall
+ * surfaces as an unattributable down/up pair.
  *
  * Chip topology truth on a TPU VM is the device nodes plus the
  * accelerator type (env TPU_ACCELERATOR_TYPE or GCE metadata, resolved by
@@ -22,10 +34,13 @@
 
 #define _GNU_SOURCE
 #include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <glob.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #define TPUSHIM_VERSION "0.1.0"
 #define MAX_CHIPS 64
@@ -43,6 +58,12 @@ static int g_inited = 0;
 static chip_t g_chips[MAX_CHIPS];
 static int g_nchips = 0;
 static char g_json_buf[512];
+
+/* health-event channel state */
+static int g_chip_health[MAX_CHIPS];    /* last reported state per chip */
+static char g_libtpu_path[512];         /* "" = not monitorable */
+static int g_libtpu_health = 1;
+static char g_events_buf[4096];
 
 static const long long GIB = 1024LL * 1024LL * 1024LL;
 
@@ -105,6 +126,67 @@ static void scan_devices(void) {
   }
 }
 
+int tpushim_init(void);  /* forward: the poll baselines lazily via init */
+
+/* Probe one device node.  Presence alone is not health: a wedged chip
+ * keeps its node.  open() distinguishes — but a refusal because the node
+ * is OWNED (EBUSY) or this daemon lacks permission (EACCES/EPERM) is a
+ * healthy chip doing its job, not a failure. */
+static int chip_node_healthy(const chip_t *c, const char **why) {
+  if (access(c->dev_path, F_OK) != 0) {
+    *why = "device node missing";
+    return 0;
+  }
+  int fd = open(c->dev_path, O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd >= 0) {
+    close(fd);
+    *why = "device node back";
+    return 1;
+  }
+  if (errno == EBUSY || errno == EACCES || errno == EPERM) {
+    *why = "device node busy (owned)";
+    return 1;
+  }
+  *why = strerror(errno); /* ENXIO/EIO/ENODEV: present but wedged */
+  return 0;
+}
+
+const char *tpushim_poll_events_json(void) {
+  if (!g_inited) tpushim_init();
+  size_t off = 0;
+  int emitted = 0;
+  off += (size_t)snprintf(g_events_buf + off, sizeof(g_events_buf) - off,
+                          "[");
+  /* libtpu runtime file: a driver uninstall is unattributable (-1). */
+  if (g_libtpu != NULL && g_libtpu_path[0] != '\0') {
+    int ok = access(g_libtpu_path, F_OK) == 0;
+    if (ok != g_libtpu_health && off + 128 < sizeof(g_events_buf)) {
+      g_libtpu_health = ok;
+      off += (size_t)snprintf(
+          g_events_buf + off, sizeof(g_events_buf) - off,
+          "%s{\"chip\": -1, \"healthy\": %s, \"reason\": \"libtpu.so %s\"}",
+          emitted ? ", " : "", ok ? "true" : "false",
+          ok ? "restored" : "removed");
+      emitted++;
+    }
+  }
+  for (int i = 0; i < g_nchips; i++) {
+    const char *why = "";
+    int h = chip_node_healthy(&g_chips[i], &why);
+    if (h != g_chip_health[i] && off + 192 < sizeof(g_events_buf)) {
+      g_chip_health[i] = h;
+      off += (size_t)snprintf(
+          g_events_buf + off, sizeof(g_events_buf) - off,
+          "%s{\"chip\": %d, \"healthy\": %s, \"reason\": \"%s\"}",
+          emitted ? ", " : "", g_chips[i].devnum, h ? "true" : "false",
+          why);
+      emitted++;
+    }
+  }
+  snprintf(g_events_buf + off, sizeof(g_events_buf) - off, "]");
+  return g_events_buf;
+}
+
 int tpushim_init(void) {
   if (g_inited) return g_libtpu != NULL;
   g_inited = 1;
@@ -116,10 +198,13 @@ int tpushim_init(void) {
    * wheel's site-packages/libtpu/libtpu.so) and wins when set. */
   const char *override = getenv("TPUSHIM_LIBTPU_PATH");
   if (override != NULL && override[0] == '\0') override = NULL; /* ""≡unset */
+  g_libtpu_path[0] = '\0';
   if (override != NULL) {
     /* Explicit path: no fallback — a broken override must read as
      * absent, not silently pick up some other system libtpu. */
     g_libtpu = dlopen(override, RTLD_LAZY | RTLD_LOCAL);
+    if (g_libtpu != NULL)
+      snprintf(g_libtpu_path, sizeof(g_libtpu_path), "%s", override);
   } else {
     const char *candidates[] = {
         "libtpu.so",
@@ -129,15 +214,30 @@ int tpushim_init(void) {
     };
     for (size_t i = 0; i < sizeof(candidates) / sizeof(candidates[0]); i++) {
       g_libtpu = dlopen(candidates[i], RTLD_LAZY | RTLD_LOCAL);
-      if (g_libtpu != NULL) break;
+      if (g_libtpu != NULL) {
+        /* Monitorable only when we know the actual file (the bare
+         * soname resolves through the loader search path). */
+        if (candidates[i][0] == '/')
+          snprintf(g_libtpu_path, sizeof(g_libtpu_path), "%s",
+                   candidates[i]);
+        break;
+      }
     }
   }
   if (g_libtpu != NULL && dlsym(g_libtpu, "GetPjrtApi") == NULL) {
     /* Not a PJRT-capable libtpu — treat as absent. */
     dlclose(g_libtpu);
     g_libtpu = NULL;
+    g_libtpu_path[0] = '\0';
   }
   scan_devices();
+  /* Baseline the health channel: transitions are relative to NOW (the
+   * daemon reports initial state from discovery, not from events). */
+  g_libtpu_health = 1;
+  for (int i = 0; i < g_nchips; i++) {
+    const char *why = "";
+    g_chip_health[i] = chip_node_healthy(&g_chips[i], &why);
+  }
   return g_libtpu != NULL;
 }
 

@@ -2,6 +2,7 @@
 
 import os
 import queue
+import time
 
 import pytest
 
@@ -145,3 +146,82 @@ def test_libtpu_backend_falls_back_without_shim(tmp_path):
         metadata_timeout=0.01)
     be.init()
     assert be.chips() == []  # no devices in tmp; no crash without shim
+
+
+def test_health_watcher_forwards_native_poll_without_duplicates(tmp_path):
+    """The backend's active probe (libtpu shim) rides the watcher thread;
+    when it reports a transition the presence poll would also see, only
+    ONE event reaches the queue (the watcher keeps its state coherent
+    with the native source)."""
+    import queue as q_mod
+
+    dev = tmp_path / "accel0"
+    dev.touch()
+    chip = discovery.Chip(index=0, id="tpu-v5e-accel0",
+                          dev_paths=(str(dev),), hbm_bytes=16 * const.GIB,
+                          cores=1, generation="v5e")
+    q: "q_mod.Queue" = q_mod.Queue()
+    polled = []
+
+    def native_poll():
+        if not dev.exists() and not polled:
+            polled.append(1)
+            return [discovery.HealthEvent(0, False, "ENXIO (native)")]
+        return []
+
+    w = discovery.HealthWatcher([chip], q, interval=0.02, poll=native_poll)
+    w.start()
+    try:
+        time.sleep(0.08)
+        dev.unlink()
+        time.sleep(0.3)
+        events = []
+        while not q.empty():
+            events.append(q.get_nowait())
+        # exactly one unhealthy transition, sourced from the native poll
+        assert [(e.chip_index, e.healthy) for e in events] == [(0, False)]
+        assert "native" in events[0].reason
+    finally:
+        w.stop()
+
+
+def test_health_watcher_native_unhealthy_not_overridden_by_presence(tmp_path):
+    """A chip the NATIVE probe marks unhealthy while its device node
+    still exists (wedged silicon: open() fails ENXIO on a present node)
+    must stay unhealthy — the presence poll may only recover chips it
+    itself marked down, or it would undo exactly the detection the
+    native channel adds."""
+    import queue as q_mod
+
+    dev = tmp_path / "accel0"
+    dev.touch()                                    # node PRESENT throughout
+    chip = discovery.Chip(index=0, id="tpu-v5e-accel0",
+                          dev_paths=(str(dev),), hbm_bytes=16 * const.GIB,
+                          cores=1, generation="v5e")
+    q: "q_mod.Queue" = q_mod.Queue()
+    fired = []
+
+    def native_poll():
+        if not fired:
+            fired.append(1)
+            return [discovery.HealthEvent(0, False, "ENXIO (wedged)")]
+        return []
+
+    w = discovery.HealthWatcher([chip], q, interval=0.02, poll=native_poll)
+    w.start()
+    try:
+        time.sleep(0.3)
+        events = []
+        while not q.empty():
+            events.append(q.get_nowait())
+        # one unhealthy event and NO spurious 'device node back' recovery
+        assert [(e.chip_index, e.healthy) for e in events] == [(0, False)]
+        # a later native recovery is honored
+        w._poll = lambda: [discovery.HealthEvent(0, True, "probe ok")]
+        time.sleep(0.1)
+        recov = []
+        while not q.empty():
+            recov.append(q.get_nowait())
+        assert (0, True) in [(e.chip_index, e.healthy) for e in recov]
+    finally:
+        w.stop()

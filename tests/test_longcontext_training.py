@@ -30,11 +30,13 @@ def _loss_fn(attention_fn):
     return cfg, loss
 
 
-@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("sp_impl", ["ring", "zigzag", "ulysses"])
 def test_sp_attention_gradients_match_dense(sp_impl):
     # ulysses needs n_heads (4) divisible by sp; ring has no such limit
-    mesh = make_mesh({"sp": 8 if sp_impl == "ring" else 4})
-    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[sp_impl]
+    mesh = make_mesh({"sp": 4 if sp_impl == "ulysses" else 8})
+    impl = {"ring": ring_attention,
+            "zigzag": functools.partial(ring_attention, schedule="zigzag"),
+            "ulysses": ulysses_attention}[sp_impl]
     sp_fn = functools.partial(impl, mesh=mesh)
 
     cfg, loss_sp = _loss_fn(sp_fn)

@@ -161,3 +161,58 @@ def test_shim_sparse_dev_numbering(tmp_path):
                      TPUSHIM_ACCELERATOR_TYPE="v4-8"),
         capture_output=True, text=True, check=True)
     assert out.stdout.strip() == "[1, 3]"
+
+
+def test_shim_event_channel_node_lifecycle(tmp_path):
+    """The native health-event channel: removing a device node yields an
+    unhealthy transition, restoring it a healthy one, polls in between
+    are empty, and a node that was ALREADY dead at init is baselined
+    (its recovery, not its deadness, is the first event)."""
+    for i in range(2):
+        (tmp_path / f"accel{i}").touch()
+    (tmp_path / "accel7").symlink_to(tmp_path / "gone")  # dead at init
+    code = (
+        "import sys, os, json; sys.path.insert(0, %r)\n"
+        "from tpushare.utils import nativeshim\n"
+        "s = nativeshim.load(); s.init()\n"
+        "print(json.dumps(s.poll_events()))\n"
+        "os.unlink(%r)\n"
+        "print(json.dumps(s.poll_events()))\n"
+        "print(json.dumps(s.poll_events()))\n"
+        "open(%r, 'w').close()\n"
+        "open(%r, 'w').close()\n"          # accel7's target appears
+        "print(json.dumps(s.poll_events()))\n"
+        % (REPO, str(tmp_path / "accel1"), str(tmp_path / "accel1"),
+           str(tmp_path / "gone")))
+    out = subprocess.run(
+        ["python3", "-c", code],
+        env=_cpu_env(TPUSHIM_DEV_GLOB=str(tmp_path / "accel*"),
+                     TPUSHIM_ACCELERATOR_TYPE="v5e-4"),
+        capture_output=True, text=True, check=True)
+    import json
+    p1, p2, p3, p4 = (json.loads(l) for l in out.stdout.strip().splitlines())
+    assert p1 == []                       # baseline, no transitions
+    assert p2 == [{"chip": 1, "healthy": False,
+                   "reason": "device node missing"}]
+    assert p3 == []                       # no re-announcement
+    assert {(e["chip"], e["healthy"]) for e in p4} == {(1, True), (7, True)}
+
+
+def test_libtpu_backend_translates_native_events():
+    """LibtpuBackend.poll_health maps the shim's JSON transitions onto
+    HealthEvents (chip -1 = unattributable passes through)."""
+    from tpushare.plugin.discovery import LibtpuBackend
+
+    class StubShim:
+        def poll_events(self):
+            return [{"chip": 2, "healthy": False, "reason": "ENXIO"},
+                    {"chip": -1, "healthy": False,
+                     "reason": "libtpu.so removed"}]
+
+    b = LibtpuBackend.__new__(LibtpuBackend)
+    b._shim = StubShim()
+    evs = b.poll_health()
+    assert [(e.chip_index, e.healthy) for e in evs] == [(2, False),
+                                                        (-1, False)]
+    b._shim = None
+    assert b.poll_health() == []

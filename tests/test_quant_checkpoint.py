@@ -266,3 +266,16 @@ def test_q4matmul_stacked_leaf_raises_clearly():
     ref = x @ quant.dequantize4(one, dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                rtol=1e-4, atol=1e-3)
+
+
+def test_quantize4_group_halves_to_divisor():
+    """A non-dividing group halves toward a divisor (768 @ default 512
+    -> 256) instead of collapsing to whole-channel, preserving the
+    grouped error bound; quantize_params carries the 512 default."""
+    w = jax.random.normal(jax.random.PRNGKey(11), (768, 32))
+    qw = quant.quantize4(w)                 # default group=512 -> 256
+    assert qw["q4"].shape == (3, 128, 32)   # 3 groups of 256, packed /2
+    stacked = {"w_up": jax.random.normal(jax.random.PRNGKey(12),
+                                         (2, 1024, 64))}
+    qp = quant.quantize_params(stacked, bits=4)
+    assert qp["w_up"]["q4"].shape == (2, 2, 256, 64)  # groups of 512

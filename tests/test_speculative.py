@@ -45,3 +45,62 @@ def test_self_speculation_accepts_everything():
     assert stats.acceptance_rate == 1.0
     # 12 tokens with k=4 and full acceptance: ~1 prefill + 3 verify passes
     assert stats.target_forwards <= 5
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=128)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+# -- fused prompt-lookup speculation ----------------------------------------
+def test_lookup_spec_exact_on_repetitive_prompt(model):
+    """Device-resident prompt-lookup speculation must equal plain greedy
+    BIT-exactly while using fewer target forwards than tokens on
+    repetitive context (the acceptance win is the whole point)."""
+    import numpy as np
+
+    from tpushare.serving.generate import generate
+    from tpushare.serving.speculative import lookup_speculative_generate
+
+    params, cfg = model
+    rep = jnp.asarray([[5, 9, 2, 5, 9, 2, 5, 9, 2, 5, 9, 2]], jnp.int32)
+    out, nv = lookup_speculative_generate(params, cfg, rep,
+                                          max_new_tokens=40, k=8)
+    ref = generate(params, cfg, rep, max_new_tokens=40)
+    assert (np.asarray(out) == np.asarray(ref)).all()
+    assert nv < 40, f"no forward reduction: {nv} verifies for 40 tokens"
+
+
+def test_lookup_spec_exact_on_random_prompt(model):
+    """No-match rounds degrade to one-token-per-forward but stay exact."""
+    import numpy as np
+
+    from tpushare.serving.generate import generate
+    from tpushare.serving.speculative import lookup_speculative_generate
+
+    params, cfg = model
+    rnd = jax.random.randint(jax.random.PRNGKey(3), (1, 17), 0, cfg.vocab)
+    out, nv = lookup_speculative_generate(params, cfg, rnd,
+                                          max_new_tokens=30, k=6, ngram=3)
+    ref = generate(params, cfg, rnd, max_new_tokens=30)
+    assert (np.asarray(out) == np.asarray(ref)).all()
+    assert nv <= 30
+
+
+def test_lookup_spec_validates_and_handles_edges(model):
+    import numpy as np
+    import pytest
+
+    from tpushare.serving.generate import generate
+    from tpushare.serving.speculative import lookup_speculative_generate
+
+    params, cfg = model
+    p = jnp.asarray([[1, 2, 1, 2]], jnp.int32)
+    out, nv = lookup_speculative_generate(params, cfg, p, max_new_tokens=1)
+    ref = generate(params, cfg, p, max_new_tokens=1)
+    assert (np.asarray(out) == np.asarray(ref)).all() and nv == 1
+    with pytest.raises(ValueError, match="fit max_seq"):
+        lookup_speculative_generate(params, cfg, p,
+                                    max_new_tokens=cfg.max_seq, k=8)

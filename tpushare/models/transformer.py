@@ -103,6 +103,22 @@ def param_count(params) -> int:
     return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
 
 
+def _head_mm(x, w):
+    """LM-head projection with an f32-ACCUMULATED f32 output: bf16
+    operands still ride the MXU's native mode, but logits never round
+    through bf16 on the way out.  This keeps near-tie argmaxes stable
+    across the reshaped evaluations of the same positions (chunked
+    prefill vs single-token decode vs speculative k-token verify) —
+    bf16 output rounding was flipping ties and eroding speculative
+    acceptance on TPU.  Quantized heads already scale in f32-safe
+    order; they just upcast their result."""
+    if isinstance(w, dict):
+        return _mm(x, w).astype(jnp.float32)
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # Blocks
 # ---------------------------------------------------------------------------
@@ -284,7 +300,7 @@ def forward(params, tokens, cfg: ModelConfig,
         new_caches = (new_ck, new_cv)
 
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    logits = _head_mm(x, params["lm_head"])
     if new_caches is not None:
         return logits, new_caches
     return logits
@@ -323,7 +339,7 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
                          axis_name=axis_name)
     x = out.reshape(b, s, cfg.d_model)
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    return _mm(x, params["lm_head"]).astype(jnp.float32)
+    return _head_mm(x, params["lm_head"])
 
 
 def init_kv_caches(cfg: ModelConfig, batch: int):
@@ -404,7 +420,7 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
 
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
+    logits = _head_mm(x, params["lm_head"])
     return logits, (new_kp, new_vp)
 
 
@@ -464,7 +480,7 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
 
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
-    logits = _mm(x[0, last_idx], params["lm_head"]).astype(jnp.float32)
+    logits = _head_mm(x[0, last_idx], params["lm_head"])
     return logits, (new_kp, new_vp)
 
 

@@ -1,21 +1,46 @@
-"""Pipeline parallelism: GPipe-style microbatching over the ``pp`` axis.
+"""Pipeline parallelism over the ``pp`` axis: GPipe forward + 1F1B train.
 
 The stacked layer params are split across pipeline stages (layer axis
 sharded over ``pp``); activations flow stage-to-stage with ``ppermute``
 (one ICI hop), microbatches keep every stage busy after the fill phase.
-Schedule length is ``n_micro + n_stages - 1`` steps; bubble fraction
-``(n_stages - 1) / (n_micro + n_stages - 1)`` — callers pick n_micro >>
-n_stages to amortize.
+
+Two schedules:
+
+* :func:`pipeline_apply` — GPipe forward (``n_micro + n_stages - 1``
+  steps, bubble ``(n_stages-1)/(n_micro+n_stages-1)``).  Differentiable
+  by ``jax.grad`` straight through the fori_loop/ppermute schedule, but
+  the transposed backward then holds ALL n_micro microbatch residuals
+  live per stage — GPipe's memory profile.
+* :func:`pipeline_train_1f1b` — explicit one-forward-one-backward
+  training schedule.  Each stage holds at most ``n_stages - stage``
+  stage-INPUTS in flight (not n_micro), recomputing its forward at
+  backward time (stage-granularity remat, standard 1F1B practice), so
+  activation memory is O(S·mb) instead of O(M·mb).  Same bubble
+  fraction as GPipe — 1F1B's win is memory, which is what bounds
+  n_micro and therefore how far the bubble can be amortized.
+
+The 1F1B schedule is SIMULATED ON THE HOST at trace time
+(:func:`schedule_1f1b`): a discrete-event pass computes, for every
+(tick, stage), whether to forward/backward which microbatch and which
+queue/stash slot to touch.  The device program is then a lockstep
+``fori_loop`` over ticks indexing those static tables — SPMD-friendly
+(no data-dependent control flow; every device runs the same program and
+``lax.cond`` selects its action), correct by construction (arrival
+latency and in-flight bounds are enforced by the simulator), and
+inspectable (the tables ARE the schedule).
 
 shard_map keeps the schedule explicit (collectives and compute visible),
 matching the rest of ``tpushare.parallel``; correctness is tested against
-the sequential model on the CPU mesh.
+the sequential model on the CPU mesh (forward AND gradients).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Callable
+from typing import Callable, Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -87,3 +112,304 @@ def pipeline_apply(layer_fn: Callable, stacked_params, x_micro,
         in_specs=(param_specs, P()), out_specs=P(),
         check_vma=False)
     return mapped(stacked_params, x_micro)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B training schedule
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class Schedule1F1B:
+    """Static per-(tick, stage) action tables for the 1F1B schedule.
+
+    All arrays are [T, S] int32; ``-1`` means "nothing this tick".
+    Slot columns index fixed-size ring buffers whose safety the
+    simulator guarantees (entries alive at once are consecutive
+    microbatch ids, fewer than the buffer length, hence distinct
+    modulo it).
+    """
+
+    n_stages: int
+    n_micro: int
+    n_ticks: int
+    fwd_m: np.ndarray        # microbatch forwarded (or -1)
+    bwd_m: np.ndarray        # microbatch backwarded (or -1)
+    arr_act_m: np.ndarray    # microbatch whose activation arrives (or -1)
+    arr_grad_m: np.ndarray   # microbatch whose cotangent arrives (or -1)
+    act_q: int               # activation-queue depth (slot = m % act_q)
+    grad_q: int              # grad-queue depth (slot = m % grad_q)
+    stash: int               # input-stash depth (slot = m % stash)
+
+
+def schedule_1f1b(n_stages: int, n_micro: int) -> Schedule1F1B:
+    """Discrete-event simulation of non-interleaved 1F1B (PipeDream-
+    flush): per tick every stage does at most ONE action — prefer a
+    ready backward, else forward if an activation is available AND the
+    stage's in-flight count is under its 1F1B bound ``S - s`` (the
+    bound IS the warmup: stage s naturally admits S-s forwards before
+    its first backward unblocks).  Messages sent at tick t are readable
+    from tick t+1 (one ppermute hop).  Returns the dense action tables
+    the device program indexes.
+    """
+    S, M = n_stages, n_micro
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    fwd_rows, bwd_rows, aa_rows, ag_rows = [], [], [], []
+    fwds = [0] * S               # forwards done per stage
+    bwds = [0] * S               # backwards done per stage
+    act_q = [[] for _ in range(S)]    # microbatches queued for fwd
+    grad_q = [[] for _ in range(S)]   # cotangents queued for bwd
+    max_aq = [0] * S
+    max_gq = [0] * S
+    max_stash = [0] * S
+    # messages in flight: lists of (dest_stage, microbatch)
+    flying_act: list = []
+    flying_grad: list = []
+    t = 0
+    while any(b < M for b in bwds):
+        if t > 4 * (M + S) + 8:   # simulator bug guard, not a real bound
+            raise RuntimeError("1F1B schedule did not converge")
+        aa = [-1] * S
+        ag = [-1] * S
+        for dst, m in flying_act:
+            act_q[dst].append(m)
+            aa[dst] = m
+        for dst, m in flying_grad:
+            grad_q[dst].append(m)
+            ag[dst] = m
+        flying_act, flying_grad = [], []
+        for s in range(S):
+            max_aq[s] = max(max_aq[s], len(act_q[s]))
+            max_gq[s] = max(max_gq[s], len(grad_q[s]))
+        fw = [-1] * S
+        bw = [-1] * S
+        for s in range(S):
+            last = s == S - 1
+            bwd_ready = (fwds[s] > bwds[s]) if last else bool(grad_q[s])
+            fwd_ready = (fwds[s] < M
+                         and (s == 0 or bool(act_q[s]))
+                         and fwds[s] - bwds[s] < S - s)
+            if bwd_ready:
+                m = bwds[s]
+                if not last:
+                    assert grad_q[s][0] == m, "grad order broke"
+                    grad_q[s].pop(0)
+                bw[s] = m
+                bwds[s] += 1
+                if s > 0:
+                    flying_grad.append((s - 1, m))
+            elif fwd_ready:
+                m = fwds[s]
+                if s > 0:
+                    assert act_q[s][0] == m, "act order broke"
+                    act_q[s].pop(0)
+                fw[s] = m
+                fwds[s] += 1
+                max_stash[s] = max(max_stash[s], fwds[s] - bwds[s])
+                if s < S - 1:
+                    flying_act.append((s + 1, m))
+        fwd_rows.append(fw)
+        bwd_rows.append(bw)
+        aa_rows.append(aa)
+        ag_rows.append(ag)
+        t += 1
+    as_np = lambda rows: np.asarray(rows, np.int32)      # noqa: E731
+    return Schedule1F1B(
+        n_stages=S, n_micro=M, n_ticks=t,
+        fwd_m=as_np(fwd_rows), bwd_m=as_np(bwd_rows),
+        arr_act_m=as_np(aa_rows), arr_grad_m=as_np(ag_rows),
+        act_q=max(1, max(max_aq)), grad_q=max(1, max(max_gq)),
+        stash=max(1, max(max_stash)))
+
+
+def pipeline_train_1f1b(layer_fn: Callable, stacked_params, head_params,
+                        loss_fn: Callable, x_micro, targets_micro,
+                        mesh: Mesh, axis_name: str = "pp",
+                        dp_axis: Optional[str] = None):
+    """One 1F1B-scheduled training pass; returns
+    ``(loss, layer_grads, head_grads, dx_micro)``.
+
+    * ``layer_fn(params_slice, x) -> x`` — one layer body (the stage
+      applies its local layers with ``lax.scan``, exactly like
+      :func:`pipeline_apply`).
+    * ``stacked_params`` — pytree with leading layer axis [L, ...]
+      (L divisible by the pp size); gradients come back in the same
+      layout, f32, layer axis sharded over ``axis_name``.
+    * ``head_params``/``loss_fn(head_params, y, targets) -> scalar`` —
+      the LAST stage maps its output to a per-microbatch mean loss
+      (norm + projection + NLL for an LM); head gradients come back
+      replicated.  The final loss is the mean over microbatches.
+    * ``x_micro`` [M, mb, ...] / ``targets_micro`` [M, ...]; the
+      returned ``dx_micro`` (cotangents of ``x_micro``) lets the caller
+      backprop into whatever produced the pipeline input (embeddings)
+      with one outer ``jax.vjp`` — the pipeline does not need to know
+      about it.
+    * ``dp_axis`` — optional data-parallel axis: microbatches are
+      sharded over it (in_specs on the mb dim), gradients/loss are
+      psum/pmean-reduced over it; ``dx_micro`` stays dp-sharded like
+      ``x_micro``.
+
+    Memory: each stage stashes at most its 1F1B bound of stage INPUTS
+    and recomputes the stage forward inside the backward's ``jax.vjp``
+    (stage-granularity remat).  The loss/grads are exact — equality
+    with the sequential model's gradients is asserted in tests.
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = x_micro.shape[0]
+    sched = schedule_1f1b(n_stages, n_micro)
+    fwd_t = jnp.asarray(sched.fwd_m)
+    bwd_t = jnp.asarray(sched.bwd_m)
+    arr_a_t = jnp.asarray(sched.arr_act_m)
+    arr_g_t = jnp.asarray(sched.arr_grad_m)
+    Qa, Qg, K = sched.act_q, sched.grad_q, sched.stash
+
+    lead = jax.tree_util.tree_leaves(stacked_params)[0]
+    if lead.shape[0] % n_stages:
+        raise ValueError(f"layer count {lead.shape[0]} not divisible "
+                         f"into {n_stages} stages")
+
+    f32zeros = functools.partial(jax.tree_util.tree_map,
+                                 lambda p: jnp.zeros(p.shape, jnp.float32))
+    tof32 = functools.partial(jax.tree_util.tree_map,
+                              lambda g: g.astype(jnp.float32))
+    tadd = functools.partial(jax.tree_util.tree_map, jnp.add)
+
+    def stage_fn(params_local, head_p, x_all, tgt_all):
+        stage = jax.lax.axis_index(axis_name)
+        mb_shape = x_all.shape[1:]
+        dtype = x_all.dtype
+
+        def run_stage(p, x):
+            return jax.lax.scan(
+                lambda h, pl: (layer_fn(pl, h), None), x, p)[0]
+
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            (act_q, grad_q, stash, dlayers, dhead, dx_buf, loss_sum,
+             act_in, grad_in) = carry
+            # -- deliver last tick's messages into the ring queues -----
+            arr_a = arr_a_t[t, stage]
+            act_q = jnp.where(
+                arr_a >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    act_q, act_in, jnp.clip(arr_a, 0) % Qa, 0), act_q)
+            arr_g = arr_g_t[t, stage]
+            grad_q = jnp.where(
+                arr_g >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    grad_q, grad_in, jnp.clip(arr_g, 0) % Qg, 0), grad_q)
+
+            # -- forward action ----------------------------------------
+            fm = fwd_t[t, stage]
+            fmc = jnp.clip(fm, 0)
+            feed = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(fmc, 0, n_micro - 1), 0, keepdims=False)
+            queued = jax.lax.dynamic_index_in_dim(
+                act_q, fmc % Qa, 0, keepdims=False)
+            x_src = jnp.where(stage == 0, feed, queued)
+            stash = jnp.where(
+                fm >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    stash, x_src, fmc % K, 0), stash)
+            # the LAST stage's forward only stashes: its compute happens
+            # once, inside the backward's value_and_grad (1F1B cost)
+            y = jax.lax.cond(
+                (fm >= 0) & (stage < n_stages - 1),
+                lambda x: run_stage(params_local, x).astype(dtype),
+                lambda x: jnp.zeros(mb_shape, dtype), x_src)
+
+            # -- backward action ---------------------------------------
+            bm = bwd_t[t, stage]
+            bmc = jnp.clip(bm, 0)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                stash, bmc % K, 0, keepdims=False)
+            g_have = jax.lax.dynamic_index_in_dim(
+                grad_q, bmc % Qg, 0, keepdims=False)
+            tgt = jax.lax.dynamic_index_in_dim(
+                tgt_all, jnp.clip(bmc, 0, n_micro - 1), 0, keepdims=False)
+
+            def bwd_any(op):
+                x_s, g_i, tg = op
+
+                def last(_):
+                    def lfn(p, hp, x):
+                        return loss_fn(hp, run_stage(p, x), tg)
+                    lm, (dp, dh, dx) = jax.value_and_grad(
+                        lfn, argnums=(0, 1, 2))(params_local, head_p, x_s)
+                    return (tof32(dp), tof32(dh), dx.astype(dtype),
+                            lm.astype(jnp.float32))
+
+                def mid(_):
+                    _, pull = jax.vjp(
+                        lambda p, x: run_stage(p, x), params_local, x_s)
+                    dp, dx = pull(g_i.astype(dtype))
+                    return (tof32(dp), f32zeros(head_p), dx.astype(dtype),
+                            jnp.float32(0.0))
+
+                return jax.lax.cond(stage == n_stages - 1, last, mid, None)
+
+            def no_bwd(op):
+                return (f32zeros(params_local), f32zeros(head_p),
+                        jnp.zeros(mb_shape, dtype), jnp.float32(0.0))
+
+            dp, dh, dx, lm = jax.lax.cond(
+                bm >= 0, bwd_any, no_bwd, (x_saved, g_have, tgt))
+            dlayers = tadd(dlayers, dp)
+            dhead = tadd(dhead, dh)
+            loss_sum = loss_sum + lm
+            dx_buf = jnp.where(
+                (bm >= 0) & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dx_buf, dx, jnp.clip(bmc, 0, n_micro - 1), 0), dx_buf)
+
+            # -- one ppermute hop each way ----------------------------
+            act_in = jax.lax.ppermute(y, axis_name, fwd_perm)
+            grad_in = jax.lax.ppermute(dx, axis_name, bwd_perm)
+            return (act_q, grad_q, stash, dlayers, dhead, dx_buf,
+                    loss_sum, act_in, grad_in)
+
+        mb_shape = x_all.shape[1:]
+        dtype = x_all.dtype
+        init = (jnp.zeros((Qa,) + mb_shape, dtype),
+                jnp.zeros((Qg,) + mb_shape, dtype),
+                jnp.zeros((K,) + mb_shape, dtype),
+                f32zeros(params_local), f32zeros(head_p),
+                jnp.zeros_like(x_all), jnp.float32(0.0),
+                jnp.zeros(mb_shape, dtype), jnp.zeros(mb_shape, dtype))
+        (_, _, _, dlayers, dhead, dx_buf, loss_sum, _, _) = \
+            jax.lax.fori_loop(0, sched.n_ticks, tick, init)
+
+        is_last = stage == n_stages - 1
+        loss = jax.lax.psum(
+            jnp.where(is_last, loss_sum, 0.0), axis_name) / n_micro
+        dhead = jax.lax.psum(dhead, axis_name)          # last stage only
+        dx_buf = jax.lax.psum(dx_buf, axis_name)        # stage 0 only
+        dhead = jax.tree_util.tree_map(lambda g: g / n_micro, dhead)
+        dlayers = jax.tree_util.tree_map(lambda g: g / n_micro, dlayers)
+        dx_buf = dx_buf / n_micro
+        if dp_axis is not None:
+            dp_size = mesh.shape[dp_axis]
+            loss = jax.lax.psum(loss, dp_axis) / dp_size
+            dlayers = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, dp_axis) / dp_size, dlayers)
+            dhead = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(g, dp_axis) / dp_size, dhead)
+            # dx_buf stays dp-sharded alongside x_micro, but its scale
+            # must still reflect the GLOBAL loss: each shard's loss_fn
+            # took a mean over its local microbatch slice, which is
+            # dp_size× the per-element weight of the global mean
+            dx_buf = dx_buf / dp_size
+        return loss, dlayers, dhead, dx_buf
+
+    layer_spec = P(axis_name)
+    param_specs = jax.tree_util.tree_map(lambda _: layer_spec,
+                                         stacked_params)
+    head_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+    data_spec = P(None, dp_axis) if dp_axis else P()
+    mapped = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(param_specs, head_specs, data_spec, data_spec),
+        out_specs=(P(), param_specs, head_specs, data_spec),
+        check_vma=False)
+    return mapped(stacked_params, head_params, x_micro, targets_micro)

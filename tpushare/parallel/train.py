@@ -78,3 +78,83 @@ def make_train_step(cfg: transformer.ModelConfig, optimizer,
         return params, opt_state, loss
 
     return train_step
+
+
+def make_pipeline_train_step(cfg: transformer.ModelConfig, optimizer,
+                             mesh, n_micro: int = 0,
+                             axis_name: str = "pp",
+                             dp_axis: str | None = None):
+    """Pipelined LM train step: layers 1F1B-scheduled over ``axis_name``
+    (optionally data-parallel over ``dp_axis``), embedding and the
+    norm+lm_head loss handled at the pipeline's edges.
+
+    Returns jitted ``(params, opt_state, tokens [B, S+1]) ->
+    (params, opt_state, loss)``; B must divide into ``n_micro``
+    (default: the pp size) microbatches.  The 1F1B schedule bounds
+    in-flight stage inputs at ``n_stages - stage`` and recomputes each
+    stage forward inside its backward (:func:`tpushare.parallel.pipeline
+    .pipeline_train_1f1b`) — the memory shape that lets n_micro (and so
+    bubble amortization) grow without activation HBM growing with it.
+    Gradients are exact: equality with the sequential step is asserted
+    in tests.
+    """
+    from .pipeline import pipeline_train_1f1b
+
+    M = n_micro or mesh.shape[axis_name]
+
+    def loss_and_grads(params, tokens):
+        b, s1 = tokens.shape
+        s = s1 - 1
+        if b % M:
+            raise ValueError(f"batch {b} not divisible into {M} "
+                             f"microbatches")
+        mb = b // M
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:].reshape(M, mb, s)
+
+        def layer_fn(layer, x):
+            # positions sized from the LOCAL microbatch: under a dp axis
+            # shard_map hands the layer a dp-shard of each microbatch
+            positions = jnp.broadcast_to(jnp.arange(s)[None, :],
+                                         (x.shape[0], s))
+            x, _ = transformer._attn_ffn(
+                layer, x, cfg,
+                lambda lyr, xin: transformer._attend_dense(
+                    lyr, xin, cfg, positions))
+            return x
+
+        def loss_fn(hp, y, tgt):
+            h = transformer.rmsnorm(y, hp["final_scale"], cfg.norm_eps)
+            # _head_mm, not _mm+astype: the pipelined step must produce
+            # the same f32-accumulated logits as the sequential forward
+            logits = transformer._head_mm(h, hp["lm_head"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1).mean()
+
+        def embed_fn(emb):
+            x = emb[inputs].astype(cfg.dtype)
+            return x.reshape(M, mb, s, cfg.d_model)
+
+        x_micro, emb_pull = jax.vjp(embed_fn, params["embed"])
+        head = {"final_scale": params["final_scale"],
+                "lm_head": params["lm_head"]}
+        loss, g_layers, g_head, dx_micro = pipeline_train_1f1b(
+            layer_fn, params["layers"], head, loss_fn, x_micro, targets,
+            mesh, axis_name=axis_name, dp_axis=dp_axis)
+        (g_embed,) = emb_pull(dx_micro.astype(x_micro.dtype))
+        grads = {"embed": g_embed, "layers": g_layers,
+                 "final_scale": g_head["final_scale"],
+                 "lm_head": g_head["lm_head"]}
+        return loss, grads
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens):
+        loss, grads = loss_and_grads(params, tokens)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: g.astype(p.dtype), grads, params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from ..models import transformer
 from ..utils import checkpoint
 from .mesh import shard_batch, shard_params
-from .train import make_optimizer, make_train_step
+from .train import (make_optimizer, make_pipeline_train_step,
+                    make_train_step)
 
 log = logging.getLogger("tpushare.trainer")
 
@@ -35,7 +36,16 @@ class Trainer:
         self.mesh = mesh
         self.save_every = save_every
         self.optimizer = make_optimizer(lr=lr)
-        self.step_fn = make_train_step(cfg, self.optimizer, remat=remat)
+        if mesh is not None and "pp" in mesh.axis_names:
+            # a pp axis selects the 1F1B pipelined step (optionally
+            # data-parallel over a dp axis of the same mesh); dp/tp-only
+            # meshes keep the single-program step, whose collectives XLA
+            # inserts from the shardings
+            self.step_fn = make_pipeline_train_step(
+                cfg, self.optimizer, mesh,
+                dp_axis="dp" if "dp" in mesh.axis_names else None)
+        else:
+            self.step_fn = make_train_step(cfg, self.optimizer, remat=remat)
         self._mgr = (checkpoint.make_checkpoint_manager(ckpt_dir, max_to_keep)
                      if ckpt_dir else None)
         # step tracked as a host int: a jnp scalar would force a

@@ -33,7 +33,8 @@ import re
 import threading
 import time
 import urllib.request
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
 
 from . import const
 
@@ -173,6 +174,13 @@ class ChipBackend:
 
     def health_events(self) -> "queue.Queue[HealthEvent]":
         raise NotImplementedError
+
+    def poll_health(self) -> List[HealthEvent]:
+        """Backend-specific ACTIVE health probe, called each watcher
+        interval (the analog of the reference's per-iteration NVML event
+        wait).  Returns transition events beyond what the generic
+        device-node presence poll sees; default: none."""
+        return []
 
 
 class FakeBackend(ChipBackend):
@@ -409,6 +417,21 @@ class LibtpuBackend(ChipBackend):
     def health_events(self) -> "queue.Queue[HealthEvent]":
         return self._events
 
+    def poll_health(self) -> List[HealthEvent]:
+        """Native health channel: the shim open()-probes each device node
+        (ENXIO/EIO on a PRESENT node = wedged silicon the existence poll
+        would call healthy; EBUSY/EACCES = owned by a workload, healthy)
+        and re-stats the libtpu runtime file (reported as chip -1,
+        unattributable — ListAndWatch then marks every device).  TPU
+        analog of the reference's XID event channel
+        (pkg/gpu/nvidia/nvidia.go:100-152, vendor nvml bindings.go:68-141).
+        """
+        if self._shim is None:
+            return []
+        return [HealthEvent(ev.get("chip", -1), bool(ev.get("healthy")),
+                            str(ev.get("reason", "")))
+                for ev in self._shim.poll_events()]
+
 
 class HealthWatcher(threading.Thread):
     """Re-check device-node presence and emit :class:`HealthEvent`s.
@@ -421,26 +444,60 @@ class HealthWatcher(threading.Thread):
 
     def __init__(self, chips: Sequence[Chip],
                  events: "queue.Queue[HealthEvent]",
-                 interval: float = 5.0):
+                 interval: float = 5.0,
+                 poll: Optional[Callable[[], List[HealthEvent]]] = None):
         super().__init__(daemon=True, name="tpushare-health")
         self._chips = list(chips)
         self._events = events
         self._interval = interval
         self._halt = threading.Event()
         self._state = {c.index: True for c in chips}
+        # chips the PRESENCE poll itself marked down: only those may be
+        # recovered by the presence poll.  A chip the native probe marked
+        # unhealthy while its node still exists (wedged silicon, ENXIO on
+        # open) must NOT be re-marked healthy just because the node is
+        # there — that would undo exactly the detection the native
+        # channel adds.  Its recovery comes from the native probe's own
+        # healthy transition.
+        self._node_down: set = set()
+        # backend-specific active probe (ChipBackend.poll_health): the
+        # libtpu shim's open()-probe + runtime-file watch ride the same
+        # thread cadence as the generic presence poll
+        self._poll = poll
 
     def stop(self) -> None:
         self._halt.set()
 
     def run(self) -> None:
         while not self._halt.wait(self._interval):
+            if self._poll is not None:
+                try:
+                    native = self._poll()
+                except Exception as e:     # a probe bug must not kill health
+                    log.warning("native health poll failed: %s", e)
+                    native = []
+                for ev in native:
+                    # keep the presence poll's view coherent so the two
+                    # sources do not re-announce each other's transitions;
+                    # ownership of the unhealthy state moves to the native
+                    # source
+                    if ev.chip_index in self._state:
+                        self._state[ev.chip_index] = ev.healthy
+                        self._node_down.discard(ev.chip_index)
+                    self._events.put(ev)
             for chip in self._chips:
+                idx = chip.index
                 ok = all(os.path.exists(p) for p in chip.dev_paths)
-                if ok != self._state[chip.index]:
-                    self._state[chip.index] = ok
-                    self._events.put(HealthEvent(
-                        chip.index, ok,
-                        "device node missing" if not ok else "device node back"))
+                if not ok and self._state[idx]:
+                    self._state[idx] = False
+                    self._node_down.add(idx)
+                    self._events.put(HealthEvent(idx, False,
+                                                 "device node missing"))
+                elif ok and not self._state[idx] and idx in self._node_down:
+                    self._state[idx] = True
+                    self._node_down.discard(idx)
+                    self._events.put(HealthEvent(idx, True,
+                                                 "device node back"))
 
 
 def make_backend(kind: str, **kw) -> ChipBackend:

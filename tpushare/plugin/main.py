@@ -68,6 +68,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--status-addr", default="127.0.0.1",
                     help="status bind address (loopback by default; the "
                          "endpoint has no auth)")
+    ap.add_argument("--dev-glob", default=os.environ.get(
+                        "TPUSHARE_DEV_GLOB", "/dev/accel*"),
+                    help="device-node glob for metadata discovery (env "
+                         "TPUSHARE_DEV_GLOB; the native shim honors "
+                         "TPUSHIM_DEV_GLOB) — tests and exotic layouts")
     ap.add_argument("-v", "--verbosity", type=int, default=0)
     return ap
 
@@ -84,6 +89,7 @@ def main(argv=None) -> int:
                                hbm_gib=args.hbm_gib or None)
     elif args.backend == "metadata":
         backend = make_backend("metadata",
+                               dev_glob=args.dev_glob,
                                hbm_gib_override=args.hbm_gib or None)
     else:
         backend = make_backend(args.backend)

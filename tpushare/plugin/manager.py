@@ -156,7 +156,8 @@ class SharedTPUManager:
             # watching them would instantly mark everything Unhealthy).
             if self.health_check and self.backend.watch_device_nodes:
                 self._health_watcher = HealthWatcher(
-                    plugin.chips, self.backend.health_events())
+                    plugin.chips, self.backend.health_events(),
+                    poll=self.backend.poll_health)
                 self._health_watcher.start()
             try:
                 plugin.serve()

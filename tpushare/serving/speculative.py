@@ -125,3 +125,133 @@ def speculative_generate(target_params, target_cfg: transformer.ModelConfig,
 
     out = jnp.asarray([tokens[: p_len + max_new_tokens]], jnp.int32)
     return out, stats
+
+
+# ---------------------------------------------------------------------------
+# Fused prompt-lookup speculation: the whole loop on device
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=8)
+def _make_lookup_spec(cfg: transformer.ModelConfig, prompt_len: int,
+                      max_new: int, k: int, ngram: int):
+    """Build the jitted device-resident lookup-speculative decoder.
+
+    TPU-native speculative decoding: the draft is not a second model but
+    PROMPT LOOKUP — propose the ``k`` tokens that followed the most
+    recent earlier occurrence of the trailing ``ngram`` — and the entire
+    propose/verify/accept loop runs in ONE jitted ``lax.while_loop``, so
+    the host (and on a tunnel-attached chip, the ~70 ms RPC) is paid
+    once per generation, not per round.  The win stacks two effects:
+
+    * batch-1 decode is WEIGHT-bound, so verifying k+1 tokens in one
+      forward costs about the same HBM traffic as decoding one token —
+      accepted proposals are nearly free tokens;
+    * the n-gram scan is a handful of vector compares over the token
+      buffer — noise next to a forward.
+
+    Output is EXACTLY greedy decoding of the model (the speculative
+    contract); on text with repetition (code, logs, retrieval contexts —
+    prompt-lookup's home turf) acceptance is high and tokens/s multiplies.
+    """
+    if prompt_len + max_new + k > cfg.max_seq:
+        raise ValueError("prompt + max_new + k must fit max_seq")
+    if ngram < 1 or k < 1:
+        raise ValueError("ngram and k must be >= 1")
+    S = cfg.max_seq
+    W = S - ngram + 1            # candidate match positions
+
+    @jax.jit
+    def run(params, prompt):                       # prompt [1, P]
+        logits, caches = transformer.forward(
+            params, prompt, cfg,
+            kv_caches=transformer.init_kv_caches(cfg, 1), cache_len=0)
+        next_tok = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+        buf = jnp.zeros((S,), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt[0], (0,))
+
+        def cond(st):
+            return st[4] < max_new
+
+        def body(st):
+            buf, buf_len, n_ctx, next_tok, produced, caches, n_verify = st
+            # commit the pending known-correct token
+            buf = jax.lax.dynamic_update_slice(
+                buf, next_tok[None], (buf_len,))
+            buf_len = buf_len + 1
+            produced = produced + 1
+            remaining = max_new - produced
+
+            def round_(op):
+                buf, buf_len, n_ctx, next_tok, caches, n_verify = op
+                # -- propose: most recent earlier match of the tail ----
+                tail = jax.lax.dynamic_slice(buf, (buf_len - ngram,),
+                                             (ngram,))
+                match = jnp.ones((W,), bool)
+                for j in range(ngram):
+                    match &= buf[j:j + W] == tail[j]
+                idx = jnp.arange(W)
+                match &= idx <= buf_len - ngram - 1   # strictly earlier
+                i_best = jnp.max(jnp.where(match, idx, -1))
+                has = i_best >= 0
+                start = jnp.clip(i_best + ngram, 0, S - k)
+                proposal = jax.lax.dynamic_slice(buf, (start,), (k,))
+                prop_len = jnp.where(
+                    has, jnp.clip(buf_len - (i_best + ngram), 0, k), 0)
+
+                # -- verify next_tok + proposal in one forward ---------
+                block = jnp.concatenate([next_tok[None], proposal]
+                                        )[None, :]
+                v_logits, caches = _verify(params, block, caches, n_ctx,
+                                           cfg)
+                greedy = jnp.argmax(v_logits[0], axis=-1).astype(jnp.int32)
+
+                # -- longest agreeing prefix, bounded ------------------
+                agree = (proposal == greedy[:k]) & (jnp.arange(k) < prop_len)
+                n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32)))
+                n_acc = jnp.minimum(n_acc, remaining - 1)
+                n_acc = jnp.maximum(n_acc, 0)
+                # append accepted proposals (garbage beyond n_acc lands
+                # past buf_len and is overwritten before it matters)
+                buf = jax.lax.dynamic_update_slice(buf, proposal,
+                                                   (buf_len,))
+                buf_len = buf_len + n_acc
+                n_ctx = n_ctx + 1 + n_acc
+                next_tok = greedy[n_acc]
+                return buf, buf_len, n_ctx, next_tok, caches, n_verify + 1
+
+            def done(op):
+                return op
+
+            buf, buf_len, n_ctx, next_tok, caches, n_verify = jax.lax.cond(
+                remaining > 0, round_, done,
+                (buf, buf_len, n_ctx, next_tok, caches, n_verify))
+            # produced = committed tokens (next_tok commits + accepted
+            # proposals), which is exactly how far buf has grown
+            produced = buf_len - prompt_len
+            return (buf, buf_len, n_ctx, next_tok, produced, caches,
+                    n_verify)
+
+        st = (buf, jnp.int32(prompt_len), jnp.int32(prompt_len), next_tok,
+              jnp.int32(0), caches, jnp.int32(1))
+        buf, buf_len, *_rest = jax.lax.while_loop(cond, body, st)
+        n_verify = _rest[-1]
+        return buf[None, :prompt_len + max_new], n_verify
+
+    return run
+
+
+def lookup_speculative_generate(params, cfg: transformer.ModelConfig,
+                                prompt, max_new_tokens: int = 32,
+                                k: int = 8, ngram: int = 2):
+    """Greedy-exact prompt-lookup speculative decode, fully on device.
+
+    prompt [1, P] -> ([1, P + max_new_tokens], n_target_forwards).
+    See :func:`_make_lookup_spec`; outputs are bit-identical to
+    :func:`tpushare.serving.generate.generate` (asserted in tests),
+    with ``n_target_forwards <= max_new_tokens`` — well below it
+    whenever the context repeats itself.
+    """
+    assert prompt.shape[0] == 1, "lookup speculation is per-sequence"
+    run = _make_lookup_spec(cfg, int(prompt.shape[1]), int(max_new_tokens),
+                            int(k), int(ngram))
+    out, n_verify = run(params, prompt)
+    return out, int(n_verify)

@@ -38,6 +38,11 @@ class Shim:
         lib.tpushim_chip_info_json.restype = ctypes.c_char_p
         lib.tpushim_chip_info_json.argtypes = [ctypes.c_int]
         lib.tpushim_version.restype = ctypes.c_char_p
+        # older prebuilt shims may lack the event surface; degrade to
+        # "no native events" instead of failing to load
+        self._has_events = hasattr(lib, "tpushim_poll_events_json")
+        if self._has_events:
+            lib.tpushim_poll_events_json.restype = ctypes.c_char_p
 
     def init(self) -> bool:
         """True iff libtpu.so was dlopen-able and initialized."""
@@ -60,6 +65,23 @@ class Shim:
             return json.loads(raw.decode())
         except json.JSONDecodeError:
             return {}
+
+    def poll_events(self) -> list:
+        """Health TRANSITIONS since the last poll:
+        ``[{"chip": N|-1, "healthy": bool, "reason": str}, ...]`` — the
+        shim open()-probes each device node (catching present-but-wedged
+        chips an existence check misses) and re-stats the libtpu runtime
+        file (chip -1 = unattributable)."""
+        if not self._has_events:
+            return []
+        raw = self._lib.tpushim_poll_events_json()
+        if not raw:
+            return []
+        try:
+            out = json.loads(raw.decode())
+            return out if isinstance(out, list) else []
+        except json.JSONDecodeError:
+            return []
 
 
 def load(path: Optional[str] = None) -> Optional[Shim]:
