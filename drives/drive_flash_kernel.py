@@ -1,0 +1,100 @@
+"""On-chip flash-kernel drive: compile + correctness + timing, fwd AND bwd.
+
+The committed, reproducible form of the round-2 `/tmp/drive_flash_bwd.py`
+(CLAUDE.md "On-hardware results") — every on-chip kernel claim in
+README/DESIGN should be re-derivable by running this on the TPU host:
+
+    python drives/drive_flash_kernel.py          # real chip (axon ok)
+
+Prints ONE JSON line: compile status, max |grad - reference| for the
+fused backward at the training shape, and fwd kernel time at s=2048.
+
+Run as the ONLY python process on the host (CLAUDE.md: one TPU dial at a
+time).  Synchronization is by host-fetching a scalar — block_until_ready
+is not a reliable barrier on the axon backend.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.ops.attention import (flash_attention,
+                                        reference_attention)
+
+    dev = jax.devices()[0]
+    out = {"metric": "flash_kernel_drive", "platform": dev.platform,
+           "device_kind": getattr(dev, "device_kind", "?")}
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        # still useful off-chip: interpret-mode correctness
+        out["note"] = "no TPU: interpret-mode correctness only"
+
+    # -- correctness at the training shape (b2 h8 s1024 d128 bf16) -----
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (2, 8, 1024, 128)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, interpret=not on_tpu)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    t0 = time.perf_counter()
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    float(gf[0][0, 0, 0, 0])          # host fetch = true barrier
+    out["bwd_compile_s"] = round(time.perf_counter() - t0, 1)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    float(gr[0][0, 0, 0, 0])
+    errs = [float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                  - b.astype(jnp.float32))))
+            for a, b in zip(gf, gr)]
+    scale = float(jnp.max(jnp.abs(gr[0].astype(jnp.float32))))
+    out["bwd_max_abs_err_dq_dk_dv"] = [round(e, 4) for e in errs]
+    out["bwd_ref_grad_scale"] = round(scale, 2)
+    out["bwd_ok"] = bool(max(errs) < max(0.05 * scale, 1.0))
+
+    # -- fwd timing at s=2048 (the tuned-block headline shape) ---------
+    if on_tpu:
+        shape2 = (2, 8, 2048, 128)
+        q2 = jax.random.normal(kq, shape2, jnp.bfloat16)
+        fwd = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
+        float(fwd(q2)[0, 0, 0, 0].astype(jnp.float32))
+        reps = 20
+
+        @jax.jit
+        def loop(q):
+            def body(c, _):
+                o = flash_attention(c, q, q, causal=True)
+                return o, ()
+            return jax.lax.scan(body, q, None, length=reps)[0]
+
+        float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))  # compile
+        t0 = time.perf_counter()
+        float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))
+        dt = (time.perf_counter() - t0) / reps
+        b, h, s, d = shape2
+        flops = 2 * 2 * b * h * (s * s // 2) * d      # causal-effective
+        out["fwd_ms_s2048"] = round(dt * 1e3, 3)
+        out["fwd_tflops_causal_effective"] = round(flops / dt / 1e12, 1)
+
+    print(json.dumps(out))
+    return 0 if out["bwd_ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

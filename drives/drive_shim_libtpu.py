@@ -1,0 +1,45 @@
+"""On-host native-shim drive: tpushim against the REAL libtpu install.
+
+    python drives/drive_shim_libtpu.py
+
+Prints ONE JSON line: whether libtpu.so dlopen'd (PJRT symbol present),
+the chips the shim walked, and a health-event poll.  Safe next to a
+running workload — the shim never initializes the TPU runtime (dlopen
+RTLD_LAZY + a symbol probe only; the open() health probe treats EBUSY as
+healthy-owned).
+
+Builds the shim first if needed: `make -C native`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    subprocess.run(["make", "-C", os.path.join(repo, "native")],
+                   check=True, capture_output=True)
+    sys.path.insert(0, repo)
+    from tpushare.utils import nativeshim
+
+    shim = nativeshim.load()
+    out = {"metric": "shim_libtpu_drive", "shim_loaded": shim is not None}
+    if shim is None:
+        print(json.dumps(out))
+        return 1
+    out["libtpu_present"] = shim.init()
+    out["version"] = shim.version()
+    n = shim.chip_count()
+    out["chip_count"] = n
+    out["chips"] = [shim.chip_info(i) for i in range(min(n, 8))]
+    out["events_poll"] = shim.poll_events()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

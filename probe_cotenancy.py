@@ -1,23 +1,27 @@
-"""Real-chip co-tenancy probe: two JAX processes sharing one TPU.
+"""Real-chip co-tenancy probe: fractional tenants sharing one TPU.
 
-VERDICT r1 item 4 / SURVEY §7 hard part 1: the fraction-sharing story
-must be proven on silicon, not CPU.  This script runs the SAME workload
-(bf16 BERT-tiny-shaped matmul steps) three ways on the local accelerator:
+VERDICT r1 item 4 / r3 item 4 / SURVEY §7 hard part 1: the
+fraction-sharing story must be proven on silicon.  Four sections, all
+driven by the SAME env contract a tpushare allocation injects
+(XLA_PYTHON_CLIENT_MEM_FRACTION, XLA_PYTHON_CLIENT_PREALLOCATE=false,
+TPU_VISIBLE_CHIPS):
 
-  solo     — one process, whole chip (baseline);
-  duo      — two processes CONCURRENTLY, each with the injected contract
-             env a fractional tpushare allocation provides
-             (XLA_PYTHON_CLIENT_MEM_FRACTION=0.45,
-             XLA_PYTHON_CLIENT_PREALLOCATE=false, TPU_VISIBLE_CHIPS=0);
+  solo      — one process, whole chip (throughput baseline);
+  duo       — two concurrent 0.45-fraction tenants (BASELINE config 2);
+  quad      — four concurrent 0.22-fraction tenants (BASELINE config 3:
+              4 pods/chip);
+  hbm_alloc — four concurrent 0.22 tenants each allocating device
+              buffers until REFUSED: per-tenant |ceiling − grant| is the
+              HBM-accuracy number, and the refusals must be
+              tenant-local (every process exits cleanly with its
+              ceiling; nobody else crashes) — the TPU analog of the
+              advisory-isolation question at the reference's
+              podmanager.go:59-72.
 
-and prints ONE JSON line with per-process and aggregate throughput, so
-the record shows whether libtpu admits co-tenants at all (single-owner
-lock vs shared) and what fraction sharing costs.
-
-Run as the ONLY python tree on the host (CLAUDE.md: one TPU dial at a
-time per process; the two workers here are started together and each
-dials once).  Exit code 0 even when co-tenancy is refused — the refusal
-IS the measurement, recorded as duo_mode="exclusive-lock".
+Prints ONE JSON line.  Run as the ONLY python tree on the host
+(CLAUDE.md: one TPU dial at a time; workers of one section start
+together and each dials once).  Exit code 0 even when co-tenancy is
+refused — the refusal IS the measurement.
 """
 
 from __future__ import annotations
@@ -32,36 +36,70 @@ WORKER = r"""
 import json, os, sys, time
 import jax, jax.numpy as jnp
 
+mode = os.environ.get("PROBE_MODE", "matmul")
 steps = int(os.environ.get("PROBE_STEPS", "30"))
 dim = int(os.environ.get("PROBE_DIM", "2048"))
 try:
     dev = jax.devices()[0]
-    x = jnp.ones((dim, dim), jnp.bfloat16)
+    if mode == "alloc":
+        # Allocate fixed chunks until the backend refuses; host-fetch
+        # one element per chunk so the allocation is materialized, not
+        # queued.  The per-process ceiling is the accuracy measurement.
+        mib = int(os.environ.get("PROBE_ALLOC_CHUNK_MIB", "256"))
+        # Hard stop: 24 GiB on a real chip (past any v5e grant), but a
+        # token amount off-TPU — CPU backends don't enforce mem-fraction
+        # caps, so the default would otherwise eat 4x24 GiB of host RAM.
+        default_max = "24" if dev.platform == "tpu" else "0.25"
+        max_mib = int(float(os.environ.get("PROBE_ALLOC_MAX_GIB",
+                                           default_max)) * 1024)
+        chunk_elems = mib * 1024 * 1024 // 4     # f32 elements
+        held, total = [], 0
+        err = "hard-stop"
+        for i in range(max(1, max_mib // mib)):
+            try:
+                buf = jnp.zeros((chunk_elems,), jnp.float32)
+                float(buf[0])
+                held.append(buf)
+                total += chunk_elems * 4
+            except Exception as e:
+                err = f"{type(e).__name__}: {str(e)[:160]}"
+                break
+        print(json.dumps({"ok": True, "platform": dev.platform,
+                          "ceiling_bytes": total,
+                          "refused_with": err}))
+    else:
+        x = jnp.ones((dim, dim), jnp.bfloat16)
 
-    @jax.jit
-    def step(x):
-        for _ in range(4):
-            x = (x @ x) / dim
-        return x
+        @jax.jit
+        def step(x):
+            for _ in range(4):
+                x = (x @ x) / dim
+            return x
 
-    # sync by host-fetching a scalar: block_until_ready has been observed
-    # returning before execution on the remote axon backend
-    float(step(x)[0, 0])                 # compile outside the window
-    t0 = time.perf_counter()
-    y = x
-    for _ in range(steps):
-        y = step(y)
-    float(y[0, 0])                       # fetch = true completion barrier
-    dt = time.perf_counter() - t0
-    print(json.dumps({"ok": True, "platform": dev.platform,
-                      "steps_per_s": steps / dt}))
+        # sync by host-fetching a scalar: block_until_ready has been
+        # observed returning before execution on the remote axon backend
+        float(step(x)[0, 0])                 # compile outside the window
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(steps):
+            y = step(y)
+        float(y[0, 0])                       # fetch = true completion
+        dt = time.perf_counter() - t0
+        print(json.dumps({"ok": True, "platform": dev.platform,
+                          "steps_per_s": steps / dt}))
 except Exception as e:
     print(json.dumps({"ok": False,
                       "error": f"{type(e).__name__}: {str(e)[:300]}"}))
 """
 
 
-def run_workers(n: int, frac: str, timeout_s: float):
+#: BASELINE config 3 fraction (4 pods/chip); ONE constant so the env
+#: value the workers receive and the hbm-accuracy denominator cannot
+#: drift apart.
+QUAD_FRACTION = "0.22"
+
+
+def run_workers(n: int, frac: str, timeout_s: float, mode: str = "matmul"):
     """Start n workers concurrently, wait, return parsed outputs."""
     env = dict(os.environ)
     env.update({
@@ -69,6 +107,7 @@ def run_workers(n: int, frac: str, timeout_s: float):
         "ALIYUN_COM_TPU_MEM_IDX": "0",
         "XLA_PYTHON_CLIENT_MEM_FRACTION": frac,
         "XLA_PYTHON_CLIENT_PREALLOCATE": "false",
+        "PROBE_MODE": mode,
     })
     procs = [subprocess.Popen([sys.executable, "-c", WORKER], env=env,
                               stdout=subprocess.PIPE,
@@ -89,30 +128,67 @@ def run_workers(n: int, frac: str, timeout_s: float):
     return outs
 
 
+def _shared_section(result, name, n, frac, timeout_s, solo_rate):
+    outs = run_workers(n, frac, timeout_s)
+    ok = [d for d in outs if d.get("ok")]
+    sec = {"workers": outs, "n": n, "fraction": frac}
+    if len(ok) == n:
+        sec["mode"] = "shared"
+    elif len(ok) == 1:
+        # exactly one got the chip: libtpu single-owner behavior
+        sec["mode"] = "exclusive-lock"
+    elif ok:
+        # the chip admitted SOME co-tenants (so no single-owner lock);
+        # the others' failures are their own (OOM/timeout), recorded in
+        # workers[] — do not misreport this as a lockout
+        sec["mode"] = f"partial-{len(ok)}-of-{n}"
+    else:
+        sec["mode"] = "all-failed"
+    if ok:
+        agg = sum(d["steps_per_s"] for d in ok)
+        sec["aggregate_steps_per_s"] = round(agg, 3)
+        if solo_rate:
+            sec["aggregate_vs_solo"] = round(agg / solo_rate, 3)
+    result[name] = sec
+
+
 def main() -> int:
     timeout_s = float(os.environ.get("PROBE_TIMEOUT_S", "420"))
-    solo = run_workers(1, "0.90", timeout_s)[0]
-    result = {"metric": "cotenancy_probe", "solo": solo}
-    if not solo.get("ok"):
-        result["duo_mode"] = "solo-failed"
-        print(json.dumps(result))
-        return 0
+    sections = os.environ.get("PROBE_SECTIONS", "solo,duo,quad,hbm").split(",")
+    result = {"metric": "cotenancy_probe"}
 
-    duo = run_workers(2, "0.45", timeout_s)
-    result["duo"] = duo
-    ok = [d for d in duo if d.get("ok")]
-    if len(ok) == 2:
-        agg = sum(d["steps_per_s"] for d in ok)
-        result["duo_mode"] = "shared"
-        result["aggregate_steps_per_s"] = round(agg, 3)
-        result["solo_steps_per_s"] = round(solo["steps_per_s"], 3)
-        result["aggregate_vs_solo"] = round(agg / solo["steps_per_s"], 3)
-    elif len(ok) == 1:
-        # One worker got the chip, the other was locked out: libtpu's
-        # single-owner behavior — fraction sharing not admitted.
-        result["duo_mode"] = "exclusive-lock"
-    else:
-        result["duo_mode"] = "both-failed"
+    solo_rate = None
+    if "solo" in sections:
+        solo = run_workers(1, "0.90", timeout_s)[0]
+        result["solo"] = solo
+        if not solo.get("ok"):
+            result["mode"] = "solo-failed"
+            print(json.dumps(result))
+            return 0
+        solo_rate = solo["steps_per_s"]
+
+    if "duo" in sections:
+        _shared_section(result, "duo", 2, "0.45", timeout_s, solo_rate)
+    if "quad" in sections:
+        _shared_section(result, "quad", 4, QUAD_FRACTION, timeout_s,
+                        solo_rate)
+
+    if "hbm" in sections:
+        # HBM-accuracy: every tenant allocates until refused.  grant =
+        # fraction × 16 GiB (v5e); accuracy = ceiling / grant.  All four
+        # must EXIT CLEANLY with a ceiling (ok=true): a tenant crashing
+        # a neighbour would show up as a missing/failed worker here.
+        grant = float(QUAD_FRACTION) * 16 * 2**30
+        outs = run_workers(4, QUAD_FRACTION, timeout_s, mode="alloc")
+        ok = [d for d in outs if d.get("ok")]
+        sec = {"workers": outs, "grant_bytes": int(grant)}
+        if ok:
+            sec["ceilings_bytes"] = [d["ceiling_bytes"] for d in ok]
+            sec["ceiling_vs_grant"] = [
+                round(d["ceiling_bytes"] / grant, 3) for d in ok]
+            sec["all_refused_tenant_locally"] = len(ok) == 4
+        result["hbm_alloc"] = sec
+
     print(json.dumps(result))
     return 0
 

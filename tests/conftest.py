@@ -12,6 +12,13 @@ import os
 # imported jax the env write alone does not land — update the live config
 # too (backend init itself is still lazy, so this works pre-first-use).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Pop the tunnel-dial trigger from the pytest process itself and STASH it:
+# the parent must never dial (the tunnel admits one process), while the
+# `-m tpu` lane's drive subprocesses re-inject it from the stash
+# (tests/test_tpu_lane.py).
+_pool = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _pool is not None:
+    os.environ.setdefault("TPUSHARE_SAVED_POOL_IPS", _pool)
 import sys as _sys
 
 if "jax" in _sys.modules:

@@ -11,6 +11,8 @@ from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
 from tpushare.serving.generate import generate
 from tpushare.serving.paged import PagedContinuousBatcher
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 @pytest.fixture(scope="module")
 def model():

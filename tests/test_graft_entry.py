@@ -16,12 +16,17 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SCRIPT = """
 import os, jax  # import BEFORE the defense runs, like sitecustomize does
 assert jax.config.jax_platforms == "axon", jax.config.jax_platforms
 import __graft_entry__ as g
+
 g._force_cpu_mesh(4)
 devs = jax.devices()
 assert devs[0].platform == "cpu", devs

@@ -8,6 +8,8 @@ import pytest
 
 from tpushare.serving.llm import LLMServer, build_model
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 @pytest.fixture(scope="module")
 def server():

@@ -15,6 +15,8 @@ from tpushare.parallel.ring import ring_attention
 from tpushare.parallel.train import make_optimizer
 from tpushare.parallel.ulysses import ulysses_attention
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 def _loss_fn(attention_fn):
     cfg = transformer.tiny(max_seq=64, n_heads=4, n_kv_heads=2)

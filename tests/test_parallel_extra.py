@@ -11,6 +11,8 @@ from tpushare.models import moe
 from tpushare.parallel import make_mesh
 from tpushare.parallel.pipeline import pipeline_apply
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 def _mlp_layer(p, x):
     return jax.nn.relu(x @ p["w"]) + p["b"]

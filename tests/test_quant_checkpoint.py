@@ -10,6 +10,8 @@ from tpushare.models import transformer
 from tpushare.ops import quant
 from tpushare.utils import checkpoint
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 def test_quantize_roundtrip_error_small():
     w = jax.random.normal(jax.random.PRNGKey(0), (64, 128)) * 0.1

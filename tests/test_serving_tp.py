@@ -10,6 +10,7 @@ tests use a fixed seed and modest depth — any tie-flip would fail both
 assertions loudly rather than silently diverge.
 """
 
+import pytest
 import numpy as np
 
 import jax
@@ -19,6 +20,8 @@ from tpushare.parallel import make_mesh
 from tpushare.parallel.mesh import shard_kv_storage, shard_params
 from tpushare.serving.continuous import ContinuousBatcher
 from tpushare.serving.paged import PagedContinuousBatcher
+
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
 
 CFG = transformer.tiny(max_seq=96)
 

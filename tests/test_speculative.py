@@ -10,6 +10,8 @@ from tpushare.models import transformer
 from tpushare.serving.generate import generate
 from tpushare.serving.speculative import speculative_generate
 
+pytestmark = pytest.mark.slow  # >30s on the CPU mesh
+
 
 def _models():
     target_cfg = transformer.tiny(max_seq=96)

@@ -1,0 +1,79 @@
+"""The ``pytest -m tpu`` lane: committed on-hardware drives as tests.
+
+Skipped unless ``TPUSHARE_RUN_TPU=1`` — these subprocess REAL-chip jobs
+through the axon tunnel, which admits one python process at a time, so
+the lane must be run ALONE:
+
+    TPUSHARE_RUN_TPU=1 python -m pytest -m tpu -q -p no:cacheprovider
+
+Each test wraps a script from ``drives/`` (see drives/README.md); the
+scripts are the canonical reproduction path for every on-chip claim.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_on = os.environ.get("TPUSHARE_RUN_TPU") == "1"
+_skip = pytest.mark.skipif(
+    not _on, reason="real-chip lane: set TPUSHARE_RUN_TPU=1 and run alone")
+
+
+def _tpu_env():
+    """The real environment, NOT the conftest's CPU pin: conftest popped
+    PALLAS_AXON_POOL_IPS from the pytest process (the parent must never
+    dial — the tunnel admits one process at a time) and stashed it; the
+    drive subprocess gets it back here, so IT is the one dialing
+    process."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon,tpu,cpu"
+    saved = env.get("TPUSHARE_SAVED_POOL_IPS")
+    if saved:
+        env["PALLAS_AXON_POOL_IPS"] = saved
+    return env
+
+
+def _run(script, timeout=2400):
+    # Popen + abandon-on-timeout, NOT subprocess.run: run() SIGKILLs the
+    # child on timeout, and killing a process mid-TPU-dial wedges the
+    # tunnel for a long time (CLAUDE.md).  A timed-out drive is left to
+    # finish or die on its own; the test just fails.
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "drives", script)],
+        env=_tpu_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        stdout, stderr = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        pytest.fail(f"{script} exceeded {timeout}s; left running "
+                    "(never kill mid-TPU-dial)")
+    assert p.returncode == 0, (stdout[-2000:], stderr[-2000:])
+    return json.loads(stdout.strip().splitlines()[-1])
+
+
+@_skip
+def test_flash_kernel_on_chip():
+    rec = _run("drive_flash_kernel.py")
+    assert rec["bwd_ok"], rec
+    assert rec["platform"] == "tpu", rec
+
+
+@_skip
+def test_shim_against_real_libtpu():
+    rec = _run("drive_shim_libtpu.py", timeout=120)
+    assert rec["shim_loaded"], rec
+    # chip_count may be 0 on a tunnel-attached host (no local /dev/accel)
+    assert "events_poll" in rec, rec
+
+
+@_skip
+def test_ring_zigzag_workload_on_chip():
+    rec = _run("drive_ring_zigzag.py")
+    assert rec["zigzag_speedup_vs_plain_slowest"] > 1.2, rec
