@@ -17,7 +17,11 @@ is not a reliable barrier on the axon backend.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
@@ -68,26 +72,37 @@ def main() -> int:
 
     # -- fwd timing at s=2048 (the tuned-block headline shape) ---------
     if on_tpu:
+        # two-scan-length DIFFERENCE timing: the ~70 ms tunnel dispatch
+        # cost is identical in both runs and cancels exactly — a single
+        # rtt-subtraction leaves jitter comparable to the 0.15 ms op
+        # (CLAUDE.md, sub-ms timings through the tunnel)
         shape2 = (2, 8, 2048, 128)
         q2 = jax.random.normal(kq, shape2, jnp.bfloat16)
-        fwd = jax.jit(lambda q: flash_attention(q, q, q, causal=True))
-        float(fwd(q2)[0, 0, 0, 0].astype(jnp.float32))
-        reps = 20
 
-        @jax.jit
-        def loop(q):
-            def body(c, _):
-                o = flash_attention(c, q, q, causal=True)
-                return o, ()
-            return jax.lax.scan(body, q, None, length=reps)[0]
+        def make_loop(reps):
+            @jax.jit
+            def loop(q):
+                def body(c, _):
+                    o = flash_attention(c, q, q, causal=True)
+                    return o, ()
+                return jax.lax.scan(body, q, None, length=reps)[0]
+            return loop
 
-        float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))  # compile
-        t0 = time.perf_counter()
-        float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))
-        dt = (time.perf_counter() - t0) / reps
+        def timed(loop):
+            float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))  # compile
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(loop(q2)[0, 0, 0, 0].astype(jnp.float32))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        lo, hi = 64, 576
+        d_t = (timed(make_loop(hi)) - timed(make_loop(lo))) / (hi - lo)
+        dt = d_t if d_t > 0 else float("nan")    # loud on a failed run
         b, h, s, d = shape2
         flops = 2 * 2 * b * h * (s * s // 2) * d      # causal-effective
-        out["fwd_ms_s2048"] = round(dt * 1e3, 3)
+        out["fwd_ms_s2048_b2h8"] = round(dt * 1e3, 3)
         out["fwd_tflops_causal_effective"] = round(flops / dt / 1e12, 1)
 
     print(json.dumps(out))
@@ -95,6 +110,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())

@@ -19,7 +19,11 @@ at S=8192, n=4, and the implied speedup of the balanced schedule.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
@@ -38,22 +42,44 @@ def main() -> int:
     key = jax.random.PRNGKey(0)
     dt = jnp.bfloat16 if on_tpu else jnp.float32
 
-    def t_block(bq, bk, causal, reps=8):
+    def t_block(bq, bk, causal):
+        """Per-op kernel time by the DIFFERENCE of two scan lengths:
+        t = (T(reps_hi) - T(reps_lo)) / (reps_hi - reps_lo).  The ~70 ms
+        tunnel dispatch cost is identical in both runs and cancels
+        exactly — subtracting a separately-measured rtt leaves noise
+        bigger than a sub-millisecond block's whole runtime."""
         q = jax.random.normal(key, (B, H, bq, D), dt)
         k = jax.random.normal(key, (B, H, bk, D), dt)
 
-        @jax.jit
-        def loop(q, k):
-            def body(carry, _):
-                o, _l = flash_attention_lse(carry, k, k, causal=causal,
-                                            interpret=not on_tpu)
-                return o, ()
-            return jax.lax.scan(body, q, None, length=reps)[0]
+        def make_loop(reps):
+            @jax.jit
+            def loop(q, k):
+                def body(carry, _):
+                    o, _l = flash_attention_lse(carry, k, k,
+                                                causal=causal,
+                                                interpret=not on_tpu)
+                    return o, ()
+                return jax.lax.scan(body, q, None, length=reps)[0]
+            return loop
 
-        float(loop(q, k)[0, 0, 0, 0].astype(jnp.float32))   # compile
-        t0 = time.perf_counter()
-        float(loop(q, k)[0, 0, 0, 0].astype(jnp.float32))
-        return (time.perf_counter() - t0) / reps
+        lo, hi = (64, 576) if on_tpu else (2, 6)
+
+        def timed(loop):
+            float(loop(q, k)[0, 0, 0, 0].astype(jnp.float32))  # compile
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(loop(q, k)[0, 0, 0, 0].astype(jnp.float32))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_lo = timed(make_loop(lo))
+        t_hi = timed(make_loop(hi))
+        d = (t_hi - t_lo) / (hi - lo)
+        # a noise-negative difference means the measurement failed; NaN
+        # poisons every derived number (and the -m tpu lane's assertion)
+        # instead of minting an absurd speedup from a clamped epsilon
+        return d if d > 0 else float("nan")
 
     # plain: slowest device (me = n-1) does 1 causal + (n-1) full C-blocks
     t_causal_C = t_block(C, C, True)
@@ -78,6 +104,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())

@@ -55,18 +55,35 @@ try:
         chunk_elems = mib * 1024 * 1024 // 4     # f32 elements
         held, total = [], 0
         err = "hard-stop"
+        t_start = time.time()
+        import numpy as np
+        rng = np.random.default_rng(os.getpid())
         for i in range(max(1, max_mib // mib)):
             try:
-                buf = jnp.zeros((chunk_elems,), jnp.float32)
-                float(buf[0])
+                # HOST-sourced random data, device_put per chunk: not
+                # rematerializable from any formula, so a backend that
+                # admits more than physical HBM is necessarily SPILLING
+                # (remote host RAM/disk), not recomputing — the record
+                # distinguishes a hard cap, advisory admission, and
+                # virtualization-by-spill.  (An earlier iota-based probe
+                # was rematerializable and measured nothing.)
+                host = rng.integers(0, 2**31, chunk_elems // 1,
+                                    dtype=np.int32).view(np.float32)
+                buf = jax.device_put(host)
+                float(buf[1])
                 held.append(buf)
                 total += chunk_elems * 4
             except Exception as e:
                 err = f"{type(e).__name__}: {str(e)[:160]}"
                 break
+        # timestamps make overlap auditable: concurrent tenants must
+        # show interleaved [t_start, t_end] windows or the "shared"
+        # ceiling claim is meaningless
         print(json.dumps({"ok": True, "platform": dev.platform,
                           "ceiling_bytes": total,
-                          "refused_with": err}))
+                          "refused_with": err,
+                          "t_start": round(t_start, 2),
+                          "t_end": round(time.time(), 2)}))
     else:
         x = jnp.ones((dim, dim), jnp.bfloat16)
 
