@@ -102,8 +102,60 @@ def _probe_platform(deadline_s: float):
         return None
 
 
+def _start_watchdog(budget_s: float, state: dict) -> None:
+    """Emit a degraded-but-valid JSON record and exit if the bench stalls.
+
+    A tunnel fetch can hang FOREVER mid-measure (observed round 4: the
+    streamed measurement blocked >25 min after a chip-stress run), and a
+    blocked gRPC recv cannot be interrupted from Python.  The driver
+    would eventually kill the process anyway — this watchdog beats it to
+    the punch with whatever numbers exist so far, so the round records a
+    degraded measurement instead of nothing.  ``state['best']`` is the
+    best record assembled so far; stage 'done' disarms.
+    """
+    import threading
+
+    def run():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < budget_s:
+            time.sleep(5)
+            if state.get("stage") == "done":
+                return
+        stage = state.get("stage")
+        if stage == "done":
+            return
+        rec = dict(state.get("best") or {})
+        rec.setdefault("metric", "bert_base_infer_qps")
+        rec.setdefault("value", None)
+        rec.setdefault("unit", "qps")
+        rec.setdefault("vs_baseline", None)
+        rec["degraded"] = (f"watchdog fired at stage {stage!r} after "
+                           f"{budget_s:.0f}s (hung tunnel fetch?)")
+        _log(f"WATCHDOG: stalled at {stage!r}; emitting degraded record")
+        print(json.dumps(rec), flush=True)
+        if stage in ("probe", "import-jax"):
+            # Mid-DIAL: exiting here is exactly the kill CLAUDE.md bans
+            # (it wedges the tunnel for a long time).  The record is out
+            # on stdout; leave the process to finish or to the caller's
+            # own policy.
+            _log("WATCHDOG: stage is mid-dial; NOT exiting (record "
+                 "emitted; kill policy is the caller's)")
+            return
+        os._exit(2)
+
+    threading.Thread(target=run, daemon=True,
+                     name="tpushare-bench-watchdog").start()
+
+
 def main() -> int:
     deadline = float(os.environ.get("TPUSHARE_BENCH_PROBE_S", "120"))
+    watch = {"stage": "probe", "best": None}
+    # the watchdog must outlast the naive-baseline budget, or raising
+    # TPUSHARE_BENCH_BUDGET_S would get a healthy bench killed mid-naive
+    budget_s = float(os.environ.get("TPUSHARE_BENCH_BUDGET_S", "900"))
+    _start_watchdog(
+        float(os.environ.get("TPUSHARE_BENCH_WATCHDOG_S",
+                             str(max(1500.0, budget_s + 600.0)))), watch)
     probed = _probe_platform(deadline)
     if probed is None:
         # Probe stalled or died: pin cpu BEFORE the first backend touch
@@ -112,6 +164,7 @@ def main() -> int:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
 
+    watch["stage"] = "import-jax"
     _log("importing jax...")
     import jax
     import jax.numpy as jnp
@@ -137,6 +190,18 @@ def main() -> int:
     batch, seq = (32, 128) if on_tpu else (8, 64)
     cfg = bert.bert_base() if on_tpu else bert.tiny()
     model_name = "bert_base" if on_tpu else "bert_tiny"
+    # THE record: one dict, updated in place at each milestone.  The
+    # watchdog prints this same object on a stall, so degraded records
+    # carry exactly the fields measured so far — no parallel snapshots
+    # to drift.
+    result = {
+        "metric": "bert_base_infer_qps", "value": None, "unit": "qps",
+        "vs_baseline": None, "platform": platform, "model": model_name,
+        "attention": None, "mfu": None,
+        "device_kind": getattr(jax.devices()[0], "device_kind", None),
+        "batch_size": batch, "seq_len": seq,
+    }
+    watch["best"] = result
     params = bert.init_params(jax.random.PRNGKey(0), cfg)
 
     # --- optimized path: tpushare serving engine ---------------------------
@@ -150,6 +215,7 @@ def main() -> int:
     attn_mod = sys.modules["tpushare.ops.attention"]
 
     engine = InferenceEngine(fwd, batch_size=batch, seq_len=seq)
+    watch["stage"] = "warmup"
     _log("compiling+warming optimized path...")
     attn_path = ("flash" if on_tpu and not attn_mod.FORCE_REFERENCE
                  else "reference")
@@ -167,10 +233,13 @@ def main() -> int:
         attn_path = "reference_fallback"
         engine = InferenceEngine(fwd, batch_size=batch, seq_len=seq)
         engine.warmup()
+    watch["stage"] = "streamed-measure"
     _log("measuring optimized path (streamed)...")
     n_batches = 30 if on_tpu else 5
     stats = measure_qps(engine, n_batches=n_batches, warmup_batches=1)
     _log(f"streamed qps={stats['qps']:.1f}")
+    result.update(value=round(stats["qps"], 2), attention=attn_path,
+                  qps_streamed=round(stats["qps"], 2))
 
     # --- offline (device-resident) throughput: the headline ---------------
     # The tunnel-attached chip pays ~70 ms of RPC overhead PER DISPATCH
@@ -209,6 +278,7 @@ def main() -> int:
 
     qps_offline = lat_offline = None
     try:
+        watch["stage"] = "offline-scan"
         _log("compiling offline scan...")
         qps_offline, lat_offline = scan_qps(fwd, 100 if on_tpu else 5, batch)
         _log(f"offline qps={qps_offline:.1f} "
@@ -255,7 +325,6 @@ def main() -> int:
     naive_flavor = "bf16-b1-scan" if on_tpu else "f32-b1-scan"
     cache_key = (f"{platform}/{getattr(jax.devices()[0], 'device_kind', '?')}"
                  f"/{model_name}/seq{seq}/{naive_flavor}")
-    budget_s = float(os.environ.get("TPUSHARE_BENCH_BUDGET_S", "900"))
     naive_qps, naive_src = None, "absent"
     for path, src in ((cache_path, "cached"), (seed_path, "seeded")):
         try:
@@ -267,6 +336,12 @@ def main() -> int:
         except Exception:
             pass   # malformed/missing cache (wrong type, null, ...) = miss
 
+    watch["stage"] = "naive-baseline"
+    result.update(
+        value=round(headline_qps, 2), attention=attn_path, mfu=mfu,
+        qps_offline=(round(qps_offline, 2) if qps_offline is not None
+                     else None),
+        latency_ms_per_batch=round(latency_ms, 2))
     elapsed = time.perf_counter() - _T0
     if naive_qps is None and elapsed < budget_s:
         # Never let the OPTIONAL baseline kill the bench.
@@ -322,28 +397,15 @@ def main() -> int:
     # negligible either way).
     comparable = (qps_offline is not None and headline_qps == qps_offline
                   ) or not on_tpu
-    result = {
-        "metric": "bert_base_infer_qps",
-        "value": round(headline_qps, 2),
-        "unit": "qps",
-        "vs_baseline": (round(headline_qps / max(naive_qps, 1e-9), 2)
-                        if naive_qps is not None and comparable else None),
-        "platform": platform,
-        "model": model_name,
-        "attention": attn_path,
-        "mfu": mfu,
-        "device_kind": getattr(jax.devices()[0], "device_kind", None),
-        "batch_size": batch,
-        "seq_len": seq,
-        "qps_offline": (round(qps_offline, 2)
-                        if qps_offline is not None else None),
-        "qps_streamed": round(stats["qps"], 2),
-        "latency_ms_per_batch": round(latency_ms, 2),
-        "naive_qps_batch1": (round(naive_qps, 2)
-                             if naive_qps is not None else None),
-        "naive_flavor": naive_flavor,
-        "naive_qps_source": naive_src,
-    }
+    result.update(
+        vs_baseline=(round(headline_qps / max(naive_qps, 1e-9), 2)
+                     if naive_qps is not None and comparable else None),
+        naive_qps_batch1=(round(naive_qps, 2)
+                          if naive_qps is not None else None),
+        naive_flavor=naive_flavor,
+        naive_qps_source=naive_src,
+    )
+    watch["stage"] = "done"
     print(json.dumps(result))
     return 0
 
