@@ -216,3 +216,152 @@ def test_service_fused_engages_while_prefilling(model, paged):
         service.stop()
     assert fused_while_prefilling, \
         "no fused chunk ran while a slot was prefilling"
+
+
+def _find_eos_case(params, cfg, prompt, n):
+    """Pick an eos id that greedy ACTUALLY emits mid-generation — and
+    whose chosen occurrence is its FIRST (truncation happens at the
+    first hit, so picking a repeated token would mis-compute `want`)."""
+    full = _plain(params, cfg, prompt, n)
+    gen = full[len(prompt):]
+    for pos in range(1, len(gen) - 2):
+        tok = gen[pos]
+        if tok not in gen[:pos]:
+            return tok, full[:len(prompt) + pos + 1]
+    return None, None
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_eos_finishes_early_and_frees_slot(model, paged):
+    """EOS must complete the request AT the eos token (ticked AND fused
+    paths, dense AND paged), match generate()'s eos semantics, and
+    release the slot for the next request."""
+    params, cfg = model
+    prompt, n = [3, 5, 7], 24
+    eos, want = _find_eos_case(params, cfg, prompt, n)
+    assert eos is not None, "tiny model produced no usable eos case"
+
+    mk = ((lambda: PagedContinuousBatcher(params, cfg, n_slots=1,
+                                          page_size=16))
+          if paged else (lambda: ContinuousBatcher(params, cfg, n_slots=1)))
+    # ticked path
+    b = mk()
+    rid = b.admit(prompt, n, eos_id=eos)
+    b.run_until_drained()
+    assert b.completed[rid] == want
+    # fused path — chunk overruns the eos position
+    b2 = mk()
+    rid2 = b2.admit(prompt, n, eos_id=eos)
+    _drain_fused(b2, chunk=8)
+    assert b2.completed[rid2] == want
+    # the freed slot serves a follow-up request exactly
+    rid3 = b2.admit([9, 8], 5)
+    _drain_fused(b2, chunk=4)
+    assert b2.completed[rid3] == _plain(params, cfg, [9, 8], 5)
+
+
+def test_service_eos_end_to_end(model):
+    """eos_id through ContinuousService (chunked admission + fused
+    decode) and matching generate() semantics."""
+    params, cfg = model
+    prompt, n = [2, 4, 6], 20
+    eos, want = _find_eos_case(params, cfg, prompt, n)
+    assert eos is not None
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=2,
+                                decode_chunk=4).start()
+    try:
+        sink = service.submit(prompt, n, eos_id=eos)
+        plain = service.submit(prompt, n)          # no eos: full length
+        assert sink.get(timeout=120) == want
+        assert plain.get(timeout=120) == _plain(params, cfg, prompt, n)
+    finally:
+        service.stop()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_top_k1_and_tiny_top_p_equal_greedy(model, paged):
+    """top_k=1 (and a vanishing nucleus) must reduce ANY temperature to
+    greedy — the strongest exactness check on the filter masks — on both
+    storages and on both the ticked and fused paths."""
+    params, cfg = model
+    prompt, n = [3, 5, 7], 10
+    want = _plain(params, cfg, prompt, n)
+    mk = ((lambda: PagedContinuousBatcher(params, cfg, n_slots=2,
+                                          page_size=16))
+          if paged else (lambda: ContinuousBatcher(params, cfg, n_slots=2)))
+    b = mk()
+    r1 = b.admit(prompt, n, temperature=1.3, seed=11, top_k=1)
+    r2 = b.admit(prompt, n, temperature=0.9, seed=12, top_p=1e-6)
+    b.run_until_drained()
+    assert b.completed[r1] == want
+    assert b.completed[r2] == want
+    bf = mk()
+    r3 = bf.admit(prompt, n, temperature=1.3, seed=11, top_k=1)
+    _drain_fused(bf, chunk=4)
+    assert bf.completed[r3] == want
+
+
+def test_no_op_filters_match_plain_sampling_stream(model):
+    """top_k=vocab + top_p=1.0 must not change the sampled stream: the
+    rich program's draw sees identical logits, so the same seed yields
+    the SAME tokens as the plain sampler (and the fused path agrees)."""
+    params, cfg = model
+    prompt, n = [5, 4, 3], 9
+    b1 = ContinuousBatcher(params, cfg, n_slots=1)
+    ra = b1.admit(prompt, n, temperature=0.8, seed=7)
+    b1.run_until_drained()
+    b2 = ContinuousBatcher(params, cfg, n_slots=1)
+    rb = b2.admit(prompt, n, temperature=0.8, seed=7, top_k=cfg.vocab)
+    b2.run_until_drained()
+    assert b1.completed[ra] == b2.completed[rb]
+    b3 = ContinuousBatcher(params, cfg, n_slots=1)
+    rc = b3.admit(prompt, n, temperature=0.8, seed=7, top_k=cfg.vocab)
+    _drain_fused(b3, chunk=4)
+    assert b1.completed[ra] == b3.completed[rc]
+
+
+def test_top_k_restricts_support(model):
+    """Every sampled token must come from the top-k of ITS step's
+    distribution: replay the greedy path's logits to check membership."""
+    import numpy as np
+
+    from tpushare.models import transformer as tf
+
+    params, cfg = model
+    prompt, n, k = [2, 9, 4], 8, 3
+    b = ContinuousBatcher(params, cfg, n_slots=1)
+    rid = b.admit(prompt, n, temperature=1.0, seed=3, top_k=k)
+    b.run_until_drained()
+    out = b.completed[rid]
+    gen = out[len(prompt):]
+    # teacher-force the emitted sequence; logits at position i produced
+    # token gen[i+1]
+    toks = jnp.asarray([out[:-1]], jnp.int32)
+    logits = np.asarray(tf.forward(params, toks, cfg))[0]
+    for i in range(len(prompt) - 1, len(out) - 1):
+        step_logits = logits[i]
+        topk = set(np.argsort(step_logits)[-k:].tolist())
+        assert out[i + 1] in topk, (i, out[i + 1])
+
+
+def test_service_top_p_sampling_end_to_end(model):
+    """top_p through the service: runs, differs from greedy at high
+    temperature (distribution check, not bit-exact), and validation
+    rejects bad filter values."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4).start()
+    try:
+        greedy = service.submit([1, 2, 3], 8)
+        nucleus = service.submit([1, 2, 3], 8, temperature=1.2, seed=5,
+                                 top_p=0.9)
+        g = greedy.get(timeout=120)
+        s = nucleus.get(timeout=120)
+        assert g == _plain(params, cfg, [1, 2, 3], 8)
+        assert len(s) == len(g)
+        with pytest.raises(ValueError, match="top_p"):
+            service.submit([1], 2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            service.submit([1], 2, top_k=-1)
+    finally:
+        service.stop()

@@ -72,3 +72,37 @@ def test_stats_track_throughput(server):
         stats = json.loads(r.read())
     assert stats["requests_served"] >= 1
     assert stats["tokens_generated"] >= 2
+
+
+def test_generate_eos_and_filters_over_http():
+    """eos_id and top_k/top_p ride the HTTP surface on a slotted server:
+    eos truncates early, top_k=1 reduces a hot temperature to greedy,
+    and bad filter values are 400s."""
+    cfg, params = build_model("tiny", quantize_int8=False)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                    n_slots=2).start()
+    try:
+        greedy = _post(srv, "/generate",
+                       {"tokens": [[1, 2, 3]], "max_new_tokens": 8})
+        gen = greedy["tokens"][0][3:]
+        # top_k=1 at high temperature == greedy
+        k1 = _post(srv, "/generate",
+                   {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                    "temperature": 1.5, "top_k": 1})
+        assert k1 == greedy
+        # pick an eos the greedy stream actually emits mid-generation
+        eos_pos = next((i for i, t in enumerate(gen[:-1]) if i >= 1), None)
+        if eos_pos is not None:
+            eos = gen[eos_pos]
+            out = _post(srv, "/generate",
+                        {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                         "eos_id": eos})
+            row = out["tokens"][0]
+            assert row == greedy["tokens"][0][:len(row)]
+            assert row[-1] == eos and len(row) < len(greedy["tokens"][0])
+        code, err = _post_err(srv, "/generate",
+                              {"tokens": [[1]], "max_new_tokens": 2,
+                               "top_p": 0})
+        assert code == 400 and "top_" in err["Error"]
+    finally:
+        srv.stop()

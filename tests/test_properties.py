@@ -103,3 +103,55 @@ def test_quantization_error_bounded(seed):
     # symmetric per-channel int8: |err| <= scale/2 everywhere
     bound = np.asarray(s)[0] / 2 + 1e-6
     assert np.all(np.abs(np.asarray(deq - w)) <= bound)
+
+
+@given(n_stages=st.integers(1, 8), n_micro=st.integers(1, 24))
+@settings(max_examples=40, deadline=None)
+def test_1f1b_schedule_invariants(n_stages, n_micro):
+    """For ANY (S, M): every stage forwards and backwards each
+    microbatch exactly once in order; in-flight stage inputs never
+    exceed the 1F1B bound S - s; message arrivals precede their
+    consumption; the schedule length is the analytic 2(M + S - 1)."""
+    from tpushare.parallel.pipeline import schedule_1f1b
+
+    sc = schedule_1f1b(n_stages, n_micro)
+    assert sc.n_ticks == 2 * (n_micro + n_stages - 1)
+    for s in range(n_stages):
+        fwd = [m for m in sc.fwd_m[:, s] if m >= 0]
+        bwd = [m for m in sc.bwd_m[:, s] if m >= 0]
+        assert fwd == list(range(n_micro))
+        assert bwd == list(range(n_micro))
+        inflight = 0
+        for t in range(sc.n_ticks):
+            if sc.fwd_m[t, s] >= 0:
+                inflight += 1
+                # non-zero stages may only forward AFTER the activation
+                # arrived (same tick or earlier)
+                if s > 0:
+                    arr = [u for u in range(t + 1)
+                           if sc.arr_act_m[u, s] == sc.fwd_m[t, s]]
+                    assert arr, (s, t)
+            assert inflight <= n_stages - s
+            if sc.bwd_m[t, s] >= 0:
+                inflight -= 1
+                if s < n_stages - 1:
+                    arr = [u for u in range(t + 1)
+                           if sc.arr_grad_m[u, s] == sc.bwd_m[t, s]]
+                    assert arr, (s, t)
+
+
+@given(seq_blocks=st.integers(1, 16), n=st.integers(1, 8))
+@settings(max_examples=40, deadline=None)
+def test_zigzag_permutation_is_bijection(seq_blocks, n):
+    """zigzag_indices is a permutation whose inverse really inverts,
+    for any divisible (seq, n)."""
+    import numpy as np
+
+    from tpushare.parallel.ring import zigzag_indices, zigzag_inverse
+
+    seq = 2 * n * seq_blocks
+    idx = zigzag_indices(seq, n)
+    inv = zigzag_inverse(seq, n)
+    assert sorted(idx) == list(range(seq))
+    x = np.arange(seq)
+    assert (x[idx][inv] == x).all()

@@ -78,34 +78,70 @@ class _Prefill:
     temperature: float
     seed: int
     chunk: int = 64
+    eos_id: Optional[int] = None
+    top_k: int = 0
+    top_p: float = 1.0
 
 
-def _sample_next(logits, temps, keys):
+def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
     """Per-slot next token: argmax where temps[i]==0, else categorical
     from softmax(logits/temps[i]) with slot i's own key.  Shared by the
-    dense and paged ticks so greedy/sampling semantics cannot drift."""
+    dense and paged ticks so greedy/sampling semantics cannot drift.
+
+    ``top_ks``/``top_ps`` (passed together or not at all — the "rich"
+    sampler) add per-slot top-k and nucleus filtering: logits outside
+    slot i's k largest (k<=0 = off) or outside its smallest
+    cumulative-p nucleus (p>=1 = off) are masked to -inf BEFORE the
+    categorical draw.  Both operate on temperature-scaled
+    probabilities, the standard composition.  The rich path costs one
+    [B, V] sort per step, so ticks only compile it in when some live
+    slot asked for it (static arg on the tick programs)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+    lf = logits.astype(jnp.float32) / safe_t
+    if top_ks is not None:
+        v = lf.shape[-1]
+        sorted_l = jnp.sort(lf, axis=-1)[:, ::-1]          # descending
+        kk = jnp.clip(top_ks, 1, v)
+        kth = jnp.take_along_axis(sorted_l, (kk - 1)[:, None], axis=1)
+        mask = (top_ks[:, None] > 0) & (lf < kth)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose cumulative mass BEFORE them is < p (the
+        # smallest prefix reaching p always includes its last member)
+        keep = (csum - probs) < top_ps[:, None]
+        cut = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1)
+        mask |= (top_ps[:, None] < 1.0) & (lf < cut[:, None])
+        lf = jnp.where(mask, -1e30, lf)
     sampled = jax.vmap(
-        lambda k, l: jax.random.categorical(k, l))(keys, logits / safe_t)
+        lambda k, l: jax.random.categorical(k, l))(keys, lf)
     return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
-def _tick(params, tokens, caches, lengths, temps, keys, cfg):
+@functools.partial(jax.jit, static_argnames=("cfg", "rich"),
+                   donate_argnums=(2,))
+def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
+          rich: bool = False):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
     Per-slot sampling via :func:`_sample_next` — greedy and sampling
-    requests share one tick.  The pooled cache is donated: XLA updates
-    it in place instead of holding two full copies across the hot loop.
+    requests share one tick.  ``rich`` (static) compiles in the
+    top-k/top-p filter only when some live slot uses it, so plain
+    greedy/temperature serving never pays the [B, V] sort.  The pooled
+    cache is donated: XLA updates it in place instead of holding two
+    full copies across the hot loop.
     """
     logits, caches = transformer.forward(
         params, tokens, cfg, kv_caches=caches, cache_len=lengths)
-    return _sample_next(logits[:, 0], temps, keys), caches
+    nxt = _sample_next(logits[:, 0], temps, keys,
+                       tks if rich else None, tps if rich else None)
+    return nxt, caches
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(2,))
-def _tick_n(params, tokens, caches, lengths, temps, keys, cfg, n: int):
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
+                   donate_argnums=(2,))
+def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
+            n: int, rich: bool = False):
     """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
     round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
     per token, the same fusion :func:`tpushare.serving.generate
@@ -128,7 +164,8 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, cfg, n: int):
         ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
         logits, caches = transformer.forward(
             params, tok, cfg, kv_caches=caches, cache_len=lengths)
-        nxt = _sample_next(logits[:, 0], temps, ks[:, 1])
+        nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
+                           tks if rich else None, tps if rich else None)
         return (nxt[:, None], caches, lengths + 1, ks[:, 0]), nxt
 
     (_, caches, _, keys), toks = jax.lax.scan(
@@ -145,6 +182,9 @@ class _Slot:
     output: List[int]
     temperature: float = 0.0
     key: Optional[jnp.ndarray] = None
+    eos_id: Optional[int] = None
+    top_k: int = 0                  # 0 = off
+    top_p: float = 1.0              # 1.0 = off
 
 
 class ContinuousBatcher:
@@ -202,15 +242,17 @@ class ContinuousBatcher:
             self.cfg, prompt_len)
         return logits
 
-    def _step(self, tokens, lengths, temps, keys):
+    def _step(self, tokens, lengths, temps, keys, tks, tps, rich):
         nxt, self.caches = _tick(
-            self.params, tokens, self.caches, lengths, temps, keys, self.cfg)
+            self.params, tokens, self.caches, lengths, temps, keys,
+            tks, tps, self.cfg, rich)
         return nxt
 
-    def _step_n(self, tokens, lengths, temps, keys, n_steps: int):
+    def _step_n(self, tokens, lengths, temps, keys, tks, tps, rich,
+                n_steps: int):
         toks, keys, self.caches = _tick_n(
             self.params, tokens, self.caches, lengths, temps, keys,
-            self.cfg, n_steps)
+            tks, tps, self.cfg, n_steps, rich)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -223,6 +265,12 @@ class ContinuousBatcher:
         return logits
 
     # ------------------------------------------------------------------
+    def _rich(self) -> bool:
+        """True when any live slot needs the top-k/top-p sampler — the
+        static flag picking between the two compiled tick programs."""
+        return any(s.top_k > 0 or s.top_p < 1.0
+                   for s in self.slots.values())
+
     def free_slots(self) -> List[int]:
         return [i for i in range(self.n_slots)
                 if i not in self.slots and i not in self.prefilling]
@@ -243,13 +291,27 @@ class ContinuousBatcher:
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt+max_new exceeds max_seq")
 
+    @staticmethod
+    def validate_sampling(top_k: int, top_p: float) -> None:
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 = off)")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1] (1 = off)")
+
     def admit(self, prompt: List[int], max_new_tokens: int,
               temperature: float = 0.0,
-              seed: int = 0) -> Optional[int]:
+              seed: int = 0,
+              eos_id: Optional[int] = None,
+              top_k: int = 0, top_p: float = 1.0) -> Optional[int]:
         """Prefill into a free slot; returns request id, or None when the
         pool is FULL (backpressure).  Invalid requests raise instead —
-        None must stay unambiguous for retry loops."""
+        None must stay unambiguous for retry loops.  ``eos_id`` finishes
+        the request EARLY when sampled, releasing the slot — output is
+        the prompt + generated tokens up to and including the eos (what
+        ``generate(..., eos_id=...)`` yields once its masked tail is
+        dropped; asserted in tests)."""
         self.validate_request(prompt, max_new_tokens)
+        self.validate_sampling(top_k, top_p)
         free = self.free_slots()
         if not free:
             return None
@@ -262,35 +324,47 @@ class ContinuousBatcher:
         tokens = jnp.asarray([prompt], jnp.int32)
         logits_v = self._prefill_into(slot, tokens, len(prompt))
         self._activate(slot, rid, list(prompt), logits_v, max_new_tokens,
-                       temperature, seed)
+                       temperature, seed, eos_id, top_k, top_p)
         return rid
 
     def _activate(self, slot: int, rid: int, prompt: List[int], logits_v,
-                  max_new_tokens: int, temperature: float, seed: int) -> None:
+                  max_new_tokens: int, temperature: float, seed: int,
+                  eos_id: Optional[int] = None,
+                  top_k: int = 0, top_p: float = 1.0) -> None:
         """Prompt fully prefilled: sample the first token and start (or
         finish) decoding — shared by admit() and chunked prefill so the
-        two admission paths produce bit-identical streams."""
+        two admission paths produce bit-identical streams.  The first
+        token goes through the SAME shared sampler as ticks so top-k/p
+        semantics cannot drift between admission and decode."""
         key = jax.random.PRNGKey(seed)
         if temperature > 0.0:
             key, sub = jax.random.split(key)
-            first = int(jax.random.categorical(sub, logits_v / temperature))
+            rich = top_k > 0 or top_p < 1.0
+            first = int(_sample_next(
+                logits_v[None, :], jnp.asarray([temperature], jnp.float32),
+                sub[None, :] if sub.ndim == 1 else jnp.stack([sub]),
+                jnp.asarray([top_k], jnp.int32) if rich else None,
+                jnp.asarray([top_p], jnp.float32) if rich else None)[0])
         else:
             first = int(jnp.argmax(logits_v))
         # prefill already produced the first generated token
         remaining = max_new_tokens - 1
         output = list(prompt) + [first]
-        if remaining == 0:
+        if remaining == 0 or (eos_id is not None and first == eos_id):
             self.completed[rid] = output
             self._release(slot)
             return
         self.slots[slot] = _Slot(request_id=rid, length=len(prompt),
                                  remaining=remaining, last_token=first,
                                  output=output, temperature=temperature,
-                                 key=key)
+                                 key=key, eos_id=eos_id,
+                                 top_k=top_k, top_p=top_p)
 
     def admit_chunked(self, prompt: List[int], max_new_tokens: int,
                       temperature: float = 0.0, seed: int = 0,
-                      chunk: int = 64) -> Optional[int]:
+                      chunk: int = 64,
+                      eos_id: Optional[int] = None,
+                      top_k: int = 0, top_p: float = 1.0) -> Optional[int]:
         """Admit with the prompt streamed ``chunk`` tokens at a time by
         subsequent :meth:`advance_prefill` calls, so a long prompt never
         stalls decoding slots for more than one chunk's forward (the
@@ -299,6 +373,7 @@ class ContinuousBatcher:
         bit-identical to unchunked admission.
         """
         self.validate_request(prompt, max_new_tokens)
+        self.validate_sampling(top_k, top_p)
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
         free = self.free_slots()
@@ -312,7 +387,7 @@ class ContinuousBatcher:
         self.prefilling[slot] = _Prefill(
             request_id=rid, prompt=list(prompt), pos=0,
             max_new=max_new_tokens, temperature=temperature, seed=seed,
-            chunk=chunk)
+            chunk=chunk, eos_id=eos_id, top_k=top_k, top_p=top_p)
         return rid
 
     def advance_prefill(self) -> int:
@@ -335,7 +410,8 @@ class ContinuousBatcher:
             if end >= n:
                 del self.prefilling[slot]
                 self._activate(slot, st.request_id, st.prompt, logits_v,
-                               st.max_new, st.temperature, st.seed)
+                               st.max_new, st.temperature, st.seed,
+                               st.eos_id, st.top_k, st.top_p)
         return len(self.prefilling)
 
     def _gather_slot_arrays(self):
@@ -358,28 +434,33 @@ class ContinuousBatcher:
         lengths = np.zeros((self.n_slots,), np.int32)
         temps = np.zeros((self.n_slots,), np.float32)
         keys = np.zeros((self.n_slots, 2), np.uint32)
+        tks = np.zeros((self.n_slots,), np.int32)
+        tps = np.ones((self.n_slots,), np.float32)
         for i, st in self.prefilling.items():
             lengths[i] = st.pos
         for i, s in self.slots.items():
             tokens[i, 0] = s.last_token
             lengths[i] = s.length
             temps[i] = s.temperature
+            tks[i] = s.top_k
+            tps[i] = s.top_p
             if s.temperature > 0.0:
                 keys[i] = np.asarray(jax.random.key_data(s.key))
-        return tokens, lengths, temps, keys
+        return tokens, lengths, temps, keys, tks, tps
 
     def tick(self) -> int:
         """One decode step for all active slots; returns #active before."""
         if not self.slots:
             return 0
-        tokens, lengths, temps, keys = self._gather_slot_arrays()
+        tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
         for i, s in self.slots.items():
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
         nxt = np.asarray(self._step(
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
-            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys))))
+            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+            jnp.asarray(tks), jnp.asarray(tps), self._rich()))
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
@@ -387,7 +468,8 @@ class ContinuousBatcher:
             s.last_token = int(nxt[i])
             s.output.append(s.last_token)
             s.remaining -= 1
-            if s.remaining <= 0:
+            if s.remaining <= 0 or (s.eos_id is not None
+                                    and s.last_token == s.eos_id):
                 self.completed[s.request_id] = s.output
                 self._release(i)
                 del self.slots[i]
@@ -408,21 +490,31 @@ class ContinuousBatcher:
         """
         if not self.slots:
             return 0
-        tokens, lengths, temps, keys = self._gather_slot_arrays()
+        tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
         toks, new_keys = self._step_n(
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
-            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)), n_steps)
+            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+            jnp.asarray(tks), jnp.asarray(tps), self._rich(), n_steps)
         toks = np.asarray(toks)
         new_keys = np.asarray(jax.random.key_data(new_keys))
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
             take = min(n_steps, s.remaining)
+            if s.eos_id is not None:
+                row = [int(t) for t in toks[i, :take]]
+                if s.eos_id in row:
+                    # finish AT the eos; the scan's surplus steps past it
+                    # decoded garbage that is contained exactly like a
+                    # finished slot's (never consumed, overwritten before
+                    # attendable) — identical streams to ticking
+                    take = row.index(s.eos_id) + 1
             s.output.extend(int(t) for t in toks[i, :take])
             s.length += take
             s.last_token = int(toks[i, take - 1])
             s.remaining -= take
-            if s.remaining <= 0:
+            if s.remaining <= 0 or (s.eos_id is not None
+                                    and s.last_token == s.eos_id):
                 self.completed[s.request_id] = s.output
                 self._release(i)
                 del self.slots[i]
@@ -502,7 +594,7 @@ class ContinuousService:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple[List[int], int, "object"]] = []
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, sink)
         self._sinks: Dict[int, "object"] = {}   # loop-thread private
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpushare-continuous")
@@ -543,15 +635,21 @@ class ContinuousService:
         self._sinks.clear()
 
     def submit(self, prompt: List[int], max_new_tokens: int,
-               temperature: float = 0.0, seed: int = 0):
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None,
+               top_k: int = 0, top_p: float = 1.0):
         """Returns a queue that yields the full token list (or None on
         shutdown). Raises ValueError for invalid requests (including
-        ones the batcher's storage could never hold)."""
+        ones the batcher's storage could never hold).  ``eos_id``
+        finishes the request early, releasing its slot; ``top_k``/
+        ``top_p`` filter the sampling distribution per request."""
         self._batcher.validate_request(prompt, max_new_tokens)
+        self._batcher.validate_sampling(top_k, top_p)
         sink = self._q.Queue(maxsize=1)
         with self._lock:
             self._waiting.append(
-                (prompt, max_new_tokens, temperature, seed, sink))
+                (prompt, max_new_tokens, temperature, seed, eos_id,
+                 top_k, top_p, sink))
         self._work.set()
         return sink
 
@@ -581,10 +679,11 @@ class ContinuousService:
                     if not self._waiting:
                         break
                     item = self._waiting.pop(0)
-                prompt, max_new, temp, seed, sink = item
+                prompt, max_new, temp, seed, eos_id, tk, tp, sink = item
                 rid = self._batcher.admit_chunked(
                     prompt, max_new, temperature=temp, seed=seed,
-                    chunk=self._prefill_chunk)
+                    chunk=self._prefill_chunk, eos_id=eos_id,
+                    top_k=tk, top_p=tp)
                 if rid is None:
                     # Backpressure beyond free slots (paged storage can
                     # run out of pages with slots still free): requeue at

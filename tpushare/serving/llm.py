@@ -136,9 +136,26 @@ class LLMServer:
             max_new = int(body.get("max_new_tokens", self.default_max_new))
             temperature = float(body.get("temperature", 0.0))
             seed = int(body.get("seed", 0))
+            eos_id = body.get("eos_id")
+            eos_id = int(eos_id) if eos_id is not None else None
+            top_k = int(body.get("top_k", 0))
+            top_p = float(body.get("top_p", 1.0))
             flat = [int(t) for row in tokens for t in row]
         except (TypeError, ValueError) as e:
             return 400, {"Error": f"malformed field: {e}"}
+        if eos_id is not None and not 0 <= eos_id < self.cfg.vocab:
+            return 400, {"Error": f"eos_id out of range [0, "
+                                  f"{self.cfg.vocab})"}
+        try:
+            # the batcher's rules are THE filter contract; re-encoding
+            # them here would let the two drift
+            from .continuous import ContinuousBatcher
+            ContinuousBatcher.validate_sampling(top_k, top_p)
+        except ValueError as e:
+            return 400, {"Error": str(e)}
+        if (top_k or top_p < 1.0) and self._service is None:
+            return 400, {"Error": "top_k/top_p need the slot pool; run "
+                                  "with --slots"}
         if max_new < 1:
             return 400, {"Error": "max_new_tokens must be >= 1"}
         if any(t < 0 or t >= self.cfg.vocab for t in flat):
@@ -155,7 +172,8 @@ class LLMServer:
             # yields independent per-row draws.
             sinks = [self._service.submit([int(t) for t in row], max_new,
                                           temperature=temperature,
-                                          seed=seed + i)
+                                          seed=seed + i, eos_id=eos_id,
+                                          top_k=top_k, top_p=top_p)
                      for i, row in enumerate(tokens)]
             import queue as _q
 
@@ -168,7 +186,9 @@ class LLMServer:
             with self._gen_lock:
                 self.requests_served += 1
                 self.sequences_served += len(tokens)
-                self.tokens_generated += max_new * len(tokens)
+                # actual production, not the cap: eos can stop early
+                self.tokens_generated += sum(
+                    len(r) - len(row) for r, row in zip(rows, tokens))
             return 200, self._result(rows, text_mode)
 
         key = jax.random.PRNGKey(seed)
@@ -180,12 +200,26 @@ class LLMServer:
             from .generate import generate_fused
             out = generate_fused(self.params, self.cfg, prompt,
                                  max_new_tokens=max_new,
-                                 temperature=temperature, key=key)
+                                 temperature=temperature, key=key,
+                                 eos_id=eos_id)
+            rows = [list(map(int, row)) for row in out]
+            if eos_id is not None:
+                # generate_fused masks the post-eos tail to eos_id at
+                # FULL length; the HTTP contract is the slot-pool one —
+                # truncate after the first generated eos so both server
+                # modes answer identically
+                cut = []
+                for row, src_row in zip(rows, tokens):
+                    gen = row[len(src_row):]
+                    if eos_id in gen:
+                        row = row[:len(src_row) + gen.index(eos_id) + 1]
+                    cut.append(row)
+                rows = cut
             self.requests_served += 1
             self.sequences_served += len(tokens)
-            self.tokens_generated += max_new * len(tokens)
-        return 200, self._result([list(map(int, row)) for row in out],
-                                 text_mode)
+            self.tokens_generated += sum(
+                len(r) - len(row) for r, row in zip(rows, tokens))
+        return 200, self._result(rows, text_mode)
 
     @staticmethod
     def _result(rows, text_mode: bool):

@@ -62,17 +62,22 @@ def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
         params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
-def _tick(params, tokens, pools, page_table, lengths, temps, keys, cfg):
+@functools.partial(jax.jit, static_argnames=("cfg", "rich"),
+                   donate_argnums=(2,))
+def _tick(params, tokens, pools, page_table, lengths, temps, keys,
+          tks, tps, cfg, rich: bool = False):
     """Paged twin of continuous._tick (same sampling helper)."""
     logits, pools = transformer.forward_paged_decode(
         params, tokens, cfg, pools, page_table, lengths)
-    return _sample_next(logits[:, 0], temps, keys), pools
+    nxt = _sample_next(logits[:, 0], temps, keys,
+                       tks if rich else None, tps if rich else None)
+    return nxt, pools
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n"), donate_argnums=(2,))
-def _tick_n(params, tokens, pools, page_table, lengths, temps, keys, cfg,
-            n: int):
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
+                   donate_argnums=(2,))
+def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
+            tks, tps, cfg, n: int, rich: bool = False):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
@@ -85,7 +90,8 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys, cfg,
         ks = jax.vmap(jax.random.split)(keys)
         logits, pools = transformer.forward_paged_decode(
             params, tok, cfg, pools, page_table, lengths)
-        nxt = _sample_next(logits[:, 0], temps, ks[:, 1])
+        nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
+                           tks if rich else None, tps if rich else None)
         return (nxt[:, None], pools, lengths + 1, ks[:, 0]), nxt
 
     (_, pools, _, keys), toks = jax.lax.scan(
@@ -153,16 +159,17 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.asarray(self.page_table[slot]), self.cfg, prompt_len)
         return logits[0]      # [V]: the prompt's last-position logits
 
-    def _step(self, tokens, lengths, temps, keys):
+    def _step(self, tokens, lengths, temps, keys, tks, tps, rich):
         nxt, self.pools = _tick(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
-            lengths, temps, keys, self.cfg)
+            lengths, temps, keys, tks, tps, self.cfg, rich)
         return nxt
 
-    def _step_n(self, tokens, lengths, temps, keys, n_steps: int):
+    def _step_n(self, tokens, lengths, temps, keys, tks, tps, rich,
+                n_steps: int):
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
-            lengths, temps, keys, self.cfg, n_steps)
+            lengths, temps, keys, tks, tps, self.cfg, n_steps, rich)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -175,7 +182,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     # ------------------------------------------------------------------
     def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,
-                      seed: int = 0, chunk: int = 64):
+                      seed: int = 0, chunk: int = 64, eos_id=None,
+                      top_k: int = 0, top_p: float = 1.0):
         """Chunked admission with the window rounded UP to a page
         multiple: paged writes are page-aligned (pos stays a multiple of
         the window, the window a multiple of the page — max_seq is a
@@ -186,7 +194,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             chunk = -(-chunk // self.page_size) * self.page_size
         return super().admit_chunked(prompt, max_new_tokens,
                                      temperature=temperature, seed=seed,
-                                     chunk=chunk)
+                                     chunk=chunk, eos_id=eos_id,
+                                     top_k=top_k, top_p=top_p)
 
     def free_page_count(self) -> int:
         return len(self._free_pages)
