@@ -365,3 +365,60 @@ def test_service_top_p_sampling_end_to_end(model):
             service.submit([1], 2, top_k=-1)
     finally:
         service.stop()
+
+
+def test_service_streaming_deltas_reassemble_exactly(model):
+    """submit_stream: concatenated deltas + done == submit()'s output ==
+    per-request greedy; eos streams stop early; stop() aborts cleanly."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4).start()
+    try:
+        prompt, n = [3, 5, 7], 12
+        sink = service.submit_stream(prompt, n)
+        got, deltas = list(prompt), 0
+        while True:
+            kind, val = sink.get(timeout=120)
+            if kind == "delta":
+                got.extend(val)
+                deltas += 1
+            else:
+                assert kind == "done"
+                assert val == got, "done payload != reassembled deltas"
+                break
+        assert got == _plain(params, cfg, prompt, n)
+        assert deltas >= 2, "streaming never streamed"
+
+        eos, want = _find_eos_case(params, cfg, prompt, 20)
+        if eos is not None:
+            s2 = service.submit_stream(prompt, 20, eos_id=eos)
+            acc = list(prompt)
+            while True:
+                kind, val = s2.get(timeout=120)
+                if kind == "delta":
+                    acc.extend(val)
+                else:
+                    break
+            assert acc == want
+    finally:
+        service.stop()
+
+
+def test_service_streaming_aborts_on_stop(model):
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=1, prefill_chunk=4,
+                                decode_chunk=4).start()
+    sink = service.submit_stream([1, 2], 60)
+    import time as _t
+    _t.sleep(0.3)
+    service.stop()
+    kinds = []
+    while True:
+        try:
+            kind, _ = sink.get(timeout=5)
+        except Exception:
+            break
+        kinds.append(kind)
+        if kind in ("done", "aborted"):
+            break
+    assert kinds and kinds[-1] in ("done", "aborted")

@@ -106,3 +106,35 @@ def test_generate_eos_and_filters_over_http():
         assert code == 400 and "top_" in err["Error"]
     finally:
         srv.stop()
+
+
+def test_generate_stream_ndjson_over_http():
+    """The /generate_stream endpoint streams NDJSON deltas that
+    reassemble to exactly the non-streaming /generate output."""
+    cfg, params = build_model("tiny", quantize_int8=False)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                    n_slots=2).start()
+    try:
+        plain = _post(srv, "/generate",
+                      {"tokens": [[4, 5, 6]], "max_new_tokens": 10})
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate_stream",
+            data=json.dumps({"tokens": [[4, 5, 6]],
+                             "max_new_tokens": 10}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers.get("Content-Type") == "application/x-ndjson"
+            for raw in r:
+                lines.append(json.loads(raw))
+        assert "done" in lines[-1]
+        acc = [4, 5, 6]
+        for item in lines[:-1]:
+            acc.extend(item["delta"])
+        assert acc == lines[-1]["done"] == plain["tokens"][0]
+        # validation still crisp
+        code, err = _post_err(srv, "/generate_stream",
+                              {"tokens": [[1], [2]], "max_new_tokens": 2})
+        assert code == 400 and "one row" in err["Error"]
+    finally:
+        srv.stop()

@@ -594,8 +594,12 @@ class ContinuousService:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, sink)
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink)
         self._sinks: Dict[int, "object"] = {}   # loop-thread private
+        # streaming requests: rid -> [sink, tokens_already_pushed].
+        # Deltas are pushed after every loop iteration; the terminal item
+        # is ("done", full_output) or ("aborted", None) on shutdown.
+        self._stream_sinks: Dict[int, list] = {}   # loop-thread private
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpushare-continuous")
 
@@ -612,9 +616,9 @@ class ContinuousService:
         # blocking on a full maxsize-1 sink could deadlock stop().
         with self._lock:
             waiting, self._waiting = self._waiting, []
-        for *_, sink in waiting:
+        for *_, stream, sink in waiting:
             try:
-                sink.put_nowait(None)
+                sink.put_nowait(("aborted", None) if stream else None)
             except self._q.Full:
                 pass
         if self._thread.is_alive():
@@ -633,6 +637,21 @@ class ContinuousService:
             except self._q.Full:
                 pass
         self._sinks.clear()
+        for sink, _ in self._stream_sinks.values():
+            sink.put_nowait(("aborted", None))
+        self._stream_sinks.clear()
+
+    def submit_stream(self, prompt: List[int], max_new_tokens: int,
+                      temperature: float = 0.0, seed: int = 0,
+                      eos_id: Optional[int] = None,
+                      top_k: int = 0, top_p: float = 1.0):
+        """Streaming submit: the returned queue yields ``("delta",
+        [new generated tokens])`` items as decoding progresses (chunk
+        granularity under fused decode), then ``("done", full_output)``
+        — or ``("aborted", None)`` on shutdown.  Same admission
+        contract and exact same token streams as :meth:`submit`."""
+        return self._submit(prompt, max_new_tokens, temperature, seed,
+                            eos_id, top_k, top_p, stream=True)
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
@@ -643,13 +662,20 @@ class ContinuousService:
         ones the batcher's storage could never hold).  ``eos_id``
         finishes the request early, releasing its slot; ``top_k``/
         ``top_p`` filter the sampling distribution per request."""
+        return self._submit(prompt, max_new_tokens, temperature, seed,
+                            eos_id, top_k, top_p, stream=False)
+
+    def _submit(self, prompt, max_new_tokens, temperature, seed, eos_id,
+                top_k, top_p, stream: bool):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
-        sink = self._q.Queue(maxsize=1)
+        # streaming sinks are unbounded (many deltas); final-only sinks
+        # hold exactly one item
+        sink = self._q.Queue() if stream else self._q.Queue(maxsize=1)
         with self._lock:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
-                 top_k, top_p, sink))
+                 top_k, top_p, stream, sink))
         self._work.set()
         return sink
 
@@ -679,7 +705,8 @@ class ContinuousService:
                     if not self._waiting:
                         break
                     item = self._waiting.pop(0)
-                prompt, max_new, temp, seed, eos_id, tk, tp, sink = item
+                (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
+                 sink) = item
                 rid = self._batcher.admit_chunked(
                     prompt, max_new, temperature=temp, seed=seed,
                     chunk=self._prefill_chunk, eos_id=eos_id,
@@ -695,7 +722,10 @@ class ContinuousService:
                 # chunked admission never completes at admit time (even a
                 # 1-token request finishes in advance_prefill); results
                 # are delivered by the post-tick completed drain below
-                self._sinks[rid] = sink
+                if stream:
+                    self._stream_sinks[rid] = [sink, len(prompt)]
+                else:
+                    self._sinks[rid] = sink
             if self._batcher.prefilling:
                 # One prompt chunk, then a fused decode chunk: prompts
                 # keep streaming while decoding slots keep their host-RPC
@@ -710,11 +740,33 @@ class ContinuousService:
                 active = self._batcher.tick_fused(self._decode_chunk)
             else:
                 active = self._batcher.tick()
+            # streaming deltas: push whatever each live streaming slot
+            # grew this iteration (the loop thread owns slot outputs)
+            if self._stream_sinks:
+                by_rid = {s.request_id: s
+                          for s in self._batcher.slots.values()}
+                for rid, entry in list(self._stream_sinks.items()):
+                    sink, pushed = entry
+                    out = None
+                    s = by_rid.get(rid)
+                    if s is not None:
+                        out = s.output
+                    elif rid in self._batcher.completed:
+                        out = self._batcher.completed[rid]
+                    if out is not None and len(out) > pushed:
+                        sink.put(("delta", out[pushed:]))
+                        entry[1] = len(out)
             for rid in list(self._batcher.completed):
                 sink = self._sinks.pop(rid, None)
                 if sink is not None:
                     sink.put(self._batcher.completed.pop(rid))
+                    continue
+                entry = self._stream_sinks.pop(rid, None)
+                if entry is not None:
+                    entry[0].put(("done",
+                                  self._batcher.completed.pop(rid)))
             with self._lock:
                 if (not active and not self._batcher.prefilling
-                        and not self._waiting and not self._sinks):
+                        and not self._waiting and not self._sinks
+                        and not self._stream_sinks):
                     self._work.clear()

@@ -16,6 +16,7 @@ inside its granted fraction (budget applied before jax initializes).
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import sys
 import threading
@@ -99,6 +100,7 @@ class LLMServer:
         self._t0 = time.monotonic()
         self._http = JsonHTTPServer(port, addr, routes={
             ("POST", "/generate"): self._generate,
+            ("POST", "/generate_stream"): self._generate_stream,
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
             ("GET", "/stats"): self._stats,
         })
@@ -132,32 +134,19 @@ class LLMServer:
             # are fine there
             return 400, {"Error": "token rows must share one length "
                                   "(pad client-side, or run with --slots)"}
+        fields, err = self._parse_gen_fields(body)
+        if err is not None:
+            return err
+        max_new = fields["max_new"]
+        temperature = fields["temperature"]
+        seed = fields["seed"]
+        eos_id = fields["eos_id"]
+        top_k = fields["top_k"]
+        top_p = fields["top_p"]
         try:
-            max_new = int(body.get("max_new_tokens", self.default_max_new))
-            temperature = float(body.get("temperature", 0.0))
-            seed = int(body.get("seed", 0))
-            eos_id = body.get("eos_id")
-            eos_id = int(eos_id) if eos_id is not None else None
-            top_k = int(body.get("top_k", 0))
-            top_p = float(body.get("top_p", 1.0))
             flat = [int(t) for row in tokens for t in row]
         except (TypeError, ValueError) as e:
             return 400, {"Error": f"malformed field: {e}"}
-        if eos_id is not None and not 0 <= eos_id < self.cfg.vocab:
-            return 400, {"Error": f"eos_id out of range [0, "
-                                  f"{self.cfg.vocab})"}
-        try:
-            # the batcher's rules are THE filter contract; re-encoding
-            # them here would let the two drift
-            from .continuous import ContinuousBatcher
-            ContinuousBatcher.validate_sampling(top_k, top_p)
-        except ValueError as e:
-            return 400, {"Error": str(e)}
-        if (top_k or top_p < 1.0) and self._service is None:
-            return 400, {"Error": "top_k/top_p need the slot pool; run "
-                                  "with --slots"}
-        if max_new < 1:
-            return 400, {"Error": "max_new_tokens must be >= 1"}
         if any(t < 0 or t >= self.cfg.vocab for t in flat):
             return 400, {"Error": f"token id out of range [0, "
                                   f"{self.cfg.vocab})"}
@@ -220,6 +209,103 @@ class LLMServer:
             self.tokens_generated += sum(
                 len(r) - len(row) for r, row in zip(rows, tokens))
         return 200, self._result(rows, text_mode)
+
+    def _parse_gen_fields(self, body):
+        """The ONE parse/validate path for /generate and /generate_stream
+        (fields must not drift between endpoints): returns
+        (fields_dict, None) or (None, (code, error_payload))."""
+        from .continuous import ContinuousBatcher
+
+        try:
+            f = {
+                "max_new": int(body.get("max_new_tokens",
+                                        self.default_max_new)),
+                "temperature": float(body.get("temperature", 0.0)),
+                "seed": int(body.get("seed", 0)),
+                "top_k": int(body.get("top_k", 0)),
+                "top_p": float(body.get("top_p", 1.0)),
+            }
+            eos = body.get("eos_id")
+            f["eos_id"] = int(eos) if eos is not None else None
+        except (TypeError, ValueError) as e:
+            return None, (400, {"Error": f"malformed field: {e}"})
+        if f["max_new"] < 1:
+            return None, (400, {"Error": "max_new_tokens must be >= 1"})
+        if (f["eos_id"] is not None
+                and not 0 <= f["eos_id"] < self.cfg.vocab):
+            return None, (400, {"Error": f"eos_id out of range [0, "
+                                         f"{self.cfg.vocab})"})
+        try:
+            ContinuousBatcher.validate_sampling(f["top_k"], f["top_p"])
+        except ValueError as e:
+            return None, (400, {"Error": str(e)})
+        if (f["top_k"] or f["top_p"] < 1.0) and self._service is None:
+            return None, (400, {"Error": "top_k/top_p need the slot "
+                                         "pool; run with --slots"})
+        return f, None
+
+    def _generate_stream(self, body):
+        """NDJSON token streaming over the slot pool: one line per decode
+        progress event — {"delta": [new tokens...]} as they are produced
+        (chunk granularity under fused decode), then {"done": [full
+        row]}.  Single prompt per request; tokens only (byte-tokenizer
+        text can split multibyte sequences across deltas, so decoding is
+        the client's call)."""
+        from ..utils.httpserver import StreamingBody
+
+        if self._service is None:
+            return 400, {"Error": "streaming needs the slot pool; run "
+                                  "with --slots"}
+        tokens = body.get("tokens")
+        if (not tokens or not isinstance(tokens, list) or len(tokens) != 1
+                or not isinstance(tokens[0], list) or not tokens[0]):
+            return 400, {"Error": "body must contain tokens: [[int, ...]] "
+                                  "with exactly one row"}
+        fields, err = self._parse_gen_fields(body)
+        if err is not None:
+            return err
+        max_new = fields["max_new"]
+        temperature = fields["temperature"]
+        seed = fields["seed"]
+        eos_id = fields["eos_id"]
+        top_k = fields["top_k"]
+        top_p = fields["top_p"]
+        try:
+            row = [int(t) for t in tokens[0]]
+        except (TypeError, ValueError) as e:
+            return 400, {"Error": f"malformed field: {e}"}
+        if any(t < 0 or t >= self.cfg.vocab for t in row):
+            return 400, {"Error": f"token id out of range [0, "
+                                  f"{self.cfg.vocab})"}
+        if len(row) + max_new > self.cfg.max_seq:
+            return 400, {"Error": f"prompt+max_new_tokens exceeds "
+                                  f"max_seq={self.cfg.max_seq}"}
+        sink = self._service.submit_stream(
+            row, max_new, temperature=temperature, seed=seed,
+            eos_id=eos_id, top_k=top_k, top_p=top_p)
+        import queue as _q
+
+        def chunks():
+            while True:
+                try:
+                    kind, val = sink.get(timeout=600)
+                except _q.Empty:
+                    yield (json.dumps({"Error": "timeout"}) + "\n").encode()
+                    return
+                if kind == "delta":
+                    yield (json.dumps({"delta": val}) + "\n").encode()
+                elif kind == "done":
+                    with self._gen_lock:
+                        self.requests_served += 1
+                        self.sequences_served += 1
+                        self.tokens_generated += len(val) - len(row)
+                    yield (json.dumps({"done": val}) + "\n").encode()
+                    return
+                else:
+                    yield (json.dumps({"Error": "aborted"}) + "\n").encode()
+                    return
+
+        return 200, StreamingBody(chunks())
 
     @staticmethod
     def _result(rows, text_mode: bool):

@@ -13,9 +13,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 
+class StreamingBody:
+    """Marks a handler payload as a STREAM: ``chunks`` yields byte
+    strings written (and flushed) one at a time, with no Content-Length
+    — the body ends when the handler closes the connection (HTTP/1.0
+    delimiting, which every client speaks).  Used for NDJSON token
+    streaming from the LLM server."""
+
+    def __init__(self, chunks, content_type: str = "application/x-ndjson"):
+        self.chunks = chunks
+        self.content_type = content_type
+
+
 class JsonHTTPServer:
     """Routes: {(method, path): handler}; handler(body_dict|None) ->
-    (code, payload).  Payload str -> text/plain, else JSON."""
+    (code, payload).  Payload str -> text/plain, StreamingBody ->
+    incremental write, else JSON."""
 
     def __init__(self, port: int, addr: str,
                  routes: dict,
@@ -33,6 +46,19 @@ class JsonHTTPServer:
                 pass
 
             def _send(self, code: int, payload) -> None:
+                if isinstance(payload, StreamingBody):
+                    self.send_response(code)
+                    self.send_header("Content-Type", payload.content_type)
+                    # no Content-Length: body is delimited by close
+                    self.end_headers()
+                    try:
+                        for chunk in payload.chunks:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass            # client went away mid-stream
+                    self.close_connection = True
+                    return
                 if isinstance(payload, str):
                     data = payload.encode()
                     ctype = "text/plain; charset=utf-8"
