@@ -2,6 +2,8 @@
 
 import itertools
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -48,3 +50,66 @@ def test_trainer_sharded_descends():
     t.run(iter(fixed), 5, on_step=lambda s, l: losses.append(l))
     assert losses[-1] < losses[0]
     assert t.step == 5
+
+
+def test_optimizer_schedules_and_clipping():
+    """The WIRED schedules produce the documented LR envelope; grad
+    clipping bounds what enters adam's moments; a scheduled+clipped
+    step still descends."""
+    import optax  # noqa: F401  (envelope comparison uses optax types)
+
+    import jax.numpy as jnp
+
+    from tpushare.parallel.train import (make_lr_schedule, make_optimizer,
+                                         make_train_step)
+
+    # the ACTUAL schedule make_optimizer wires (not a lookalike)
+    for kind in ("cosine", "linear"):
+        sched = make_lr_schedule(1e-3, kind, warmup_steps=10,
+                                 total_steps=100)
+        assert float(sched(0)) <= 1e-4            # warming up
+        assert abs(float(sched(10)) - 1e-3) < 1e-9   # peak at warmup end
+        assert abs(float(sched(100)) - 1e-4) < 1e-7  # end_lr AT total
+    # warmup_steps=0: no wasted LR-0 step beyond step 0, end hit on time
+    lin = make_lr_schedule(1.0, "linear", warmup_steps=0, total_steps=10)
+    assert abs(float(lin(10)) - 0.1) < 1e-6
+    assert make_lr_schedule(1e-3) == 1e-3         # constant passthrough
+
+    with pytest.raises(ValueError, match="total_steps"):
+        make_optimizer(schedule="cosine")
+    with pytest.raises(ValueError, match="constant"):
+        make_optimizer(schedule="nope")
+
+    # a clipped, scheduled step runs and descends
+    cfg = transformer.tiny(d_model=32, n_heads=2, n_kv_heads=1,
+                           n_layers=2, vocab=64, max_seq=32)
+    opt = make_optimizer(lr=5e-3, schedule="cosine", warmup_steps=2,
+                         total_steps=50, grad_clip_norm=1.0)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    state = opt.init(params)
+    step = make_train_step(cfg, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab)
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # clipping bounds what enters adam's moments (adam's normalized
+    # update hides the clip at step 1, so check the SECOND MOMENT: with
+    # a 1e3 gradient spike and clip_norm=1, nu must see <=1-norm grads)
+    p0 = {"w": jnp.zeros((4,), jnp.float32)}
+    spike = {"w": jnp.full((4,), 1e3, jnp.float32)}
+    nus = {}
+    for name, clip in (("clipped", 1.0), ("unclipped", 0.0)):
+        opt2 = make_optimizer(lr=0.1, grad_clip_norm=clip)
+        s2 = opt2.init(p0)
+        _, s2 = opt2.update(spike, s2, p0)
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(s2)]
+        # after one spike the largest state magnitude is adam's nu for
+        # the unclipped run (~(1e3)^2 * (1-b2)) but only the step COUNT
+        # (1.0) for the clipped run, whose nu saw <=1-norm grads
+        nus[name] = max(float(np.abs(l).max()) for l in leaves)
+    assert nus["clipped"] <= 1.0 + 1e-6, nus
+    assert nus["unclipped"] >= 1e4, nus

@@ -30,8 +30,56 @@ def lm_loss(params, tokens, cfg: transformer.ModelConfig,
     return nll.mean()
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_lr_schedule(lr: float, schedule: str = "constant",
+                     warmup_steps: int = 0, total_steps: int = 0,
+                     end_lr_frac: float = 0.1):
+    """The LR envelope (factored out so tests assert on the WIRED
+    schedule, not a lookalike): constant, or warmup to ``lr`` over
+    ``max(warmup_steps, 1)`` steps then cosine/linear decay reaching
+    ``lr * end_lr_frac`` AT ``total_steps``."""
+    if schedule == "constant":
+        return lr
+    if schedule not in ("cosine", "linear"):
+        raise ValueError(
+            f"schedule must be constant|cosine|linear, got {schedule!r}")
+    if total_steps <= 0:
+        raise ValueError(f"{schedule} schedule needs total_steps")
+    warm = max(warmup_steps, 1)
+    if schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warm,
+            decay_steps=total_steps, end_value=lr * end_lr_frac)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warm),
+         optax.linear_schedule(lr, lr * end_lr_frac,
+                               max(total_steps - warm, 1))],
+        [warm])
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
+                   schedule: str = "constant", warmup_steps: int = 0,
+                   total_steps: int = 0, end_lr_frac: float = 0.1,
+                   grad_clip_norm: float = 0.0):
+    """AdamW with the standard LM training envelope.
+
+    * ``schedule``: ``"constant"`` (default), ``"cosine"`` (linear
+      warmup over ``warmup_steps`` then cosine decay to
+      ``lr * end_lr_frac`` at ``total_steps``), or ``"linear"`` (warmup
+      then linear decay).  Schedules need ``total_steps``.
+    * ``grad_clip_norm`` > 0 prepends global-norm clipping — the usual
+      guard for loss spikes at long context.
+
+    The optimizer state stays an optax pytree, so the Trainer's orbax
+    checkpointing and the sharding rules apply unchanged (schedule
+    position rides in the adamw count leaf).
+    """
+    opt = optax.adamw(
+        make_lr_schedule(lr, schedule, warmup_steps, total_steps,
+                         end_lr_frac),
+        b1=0.9, b2=0.95, weight_decay=weight_decay)
+    if grad_clip_norm > 0:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip_norm), opt)
+    return opt
 
 
 #: Per-layer remat policy: keep the flash kernel's (out, lse) residuals

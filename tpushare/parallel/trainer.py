@@ -31,11 +31,15 @@ class Trainer:
                  save_every: int = 100,
                  max_to_keep: int = 3,
                  lr: float = 3e-4, seed: int = 0,
-                 remat: str = "none"):
+                 remat: str = "none",
+                 schedule: str = "constant", warmup_steps: int = 0,
+                 total_steps: int = 0, grad_clip_norm: float = 0.0):
         self.cfg = cfg
         self.mesh = mesh
         self.save_every = save_every
-        self.optimizer = make_optimizer(lr=lr)
+        self.optimizer = make_optimizer(
+            lr=lr, schedule=schedule, warmup_steps=warmup_steps,
+            total_steps=total_steps, grad_clip_norm=grad_clip_norm)
         if mesh is not None and "pp" in mesh.axis_names:
             # a pp axis selects the 1F1B pipelined step (optionally
             # data-parallel over a dp axis of the same mesh); dp/tp-only
