@@ -1,0 +1,236 @@
+"""LoRA adapters: low-rank fine-tuning for the transformer stack.
+
+A LoRA-ized weight leaf is the dict ``{"w": base, "a": [.., d_in, r],
+"b": [.., r, d_out], "scale": alpha/r}``; the matmul dispatcher
+(:func:`tpushare.ops.quant.matmul_maybe_q`) computes
+``x @ base + (x @ a) @ b * scale``.  TPU-first consequences:
+
+* the base weight may itself be int8/int4-quantized (QLoRA-style:
+  frozen quantized base + bf16 adapters) — dispatch recurses, so the
+  base matmul keeps its weight-bandwidth saving;
+* the adapter path is two thin matmuls ([.., d_in, r] with r ~ 8-64):
+  rank is padded to nothing special — XLA tiles them fine, and their
+  FLOPs/HBM are noise next to the base matmul;
+* ``b`` starts at ZERO, so a freshly loraized model computes the same
+  function as the base (asserted in tests; bit-identical for a plain
+  base — a quantized base can drift by float-epsilon because the extra
+  adapter ops shift XLA's fusion boundaries, never the math);
+* training updates ONLY adapters via an optax mask
+  (:func:`lora_mask`): optimizer state for the frozen base is
+  zero-size, which is the point — a 7B base fine-tunes with optimizer
+  memory proportional to the adapters.
+
+``merge_lora`` folds adapters back into dense weights for serving
+(requantize afterwards if the base was quantized).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import quant
+
+
+def _is_weight_dict(x) -> bool:
+    """A WEIGHT-dict node (quantized and/or loraized) — NOT any dict:
+    the params tree itself is a dict of dicts, so a bare isinstance
+    check would make the whole tree one 'leaf'."""
+    return isinstance(x, dict) and ("w" in x or "q" in x or "q4" in x)
+
+
+#: Leaves that accept adapters (the attention + FFN projections; embed
+#: and lm_head stay dense — the usual LoRA recipe).
+LORA_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _leaf_dims(leaf) -> tuple:
+    """(d_in, d_out) of a 2D or stacked [L, d_in, d_out] weight leaf —
+    or of its quantized dict form."""
+    if isinstance(leaf, dict):
+        if "q4" in leaf:
+            # [.., g, group/2, d_out] packed: d_in = g * group
+            g, half, d_out = leaf["q4"].shape[-3:]
+            return g * half * 2, d_out
+        return leaf["q"].shape[-2], leaf["q"].shape[-1]
+    return leaf.shape[-2], leaf.shape[-1]
+
+
+def loraize_params(params, rank: int = 8, alpha: float = 16.0,
+                   suffixes=LORA_SUFFIXES, seed: int = 0,
+                   adapter_dtype=None):
+    """Wrap matching weight leaves (plain OR quantized) with zero-init
+    adapters.  Stacked [L, ...] leaves get stacked adapters, so the
+    model's layer ``lax.scan`` slices base and adapters together."""
+    if rank < 1:
+        raise ValueError("rank must be >= 1")
+    key_holder = [jax.random.PRNGKey(seed)]
+
+    def visit(path, leaf):
+        from ..utils.treepath import leaf_key
+        name = leaf_key(jax.tree_util.keystr(path))
+        if name not in suffixes:
+            return leaf
+        is_dict = isinstance(leaf, dict)
+        if is_dict and ("a" in leaf or "b" in leaf):
+            return leaf                      # already loraized
+        d_in, d_out = _leaf_dims(leaf)
+        lead = (leaf["q4"].shape[:-3] if is_dict and "q4" in leaf
+                else leaf["q"].shape[:-2] if is_dict
+                else leaf.shape[:-2])
+        key_holder[0], sub = jax.random.split(key_holder[0])
+        if adapter_dtype is not None:
+            dtype = adapter_dtype
+        elif is_dict:
+            # quantized base: the scale is always f32 by construction,
+            # so infer nothing from it — bf16 adapters are the QLoRA
+            # layout (half the adapter + optimizer memory)
+            dtype = jnp.bfloat16
+        else:
+            dtype = leaf.dtype
+        a = (jax.random.normal(sub, (*lead, d_in, rank), jnp.float32)
+             / np.sqrt(d_in)).astype(dtype)
+        b = jnp.zeros((*lead, rank, d_out), dtype)
+        base = leaf if is_dict else {"w": leaf}
+        # scale carries the leaf's lead shape ([L] for stacked layers):
+        # the model's layer scan slices EVERY dict leaf's leading dim,
+        # so a bare scalar would break it
+        return {**base, "a": a, "b": b,
+                "scale": jnp.full(lead, alpha / rank, jnp.float32)}
+
+    return jax.tree_util.tree_map_with_path(visit, params,
+                                            is_leaf=_is_weight_dict)
+
+
+def lora_mask(params):
+    """Boolean pytree (same treedef as ``params``) marking adapter
+    leaves ("a"/"b") True, so the frozen base gets no optimizer state
+    and no updates."""
+    def visit(path, leaf):
+        from ..utils.treepath import leaf_key
+        return leaf_key(jax.tree_util.keystr(path)) in ("a", "b")
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def make_lora_optimizer(base_optimizer, params):
+    """Wrap an optimizer so ONLY adapter leaves train (others frozen via
+    ``optax.set_to_zero``)."""
+    import optax
+
+    mask = lora_mask(params)
+    return optax.multi_transform(
+        {"train": base_optimizer, "freeze": optax.set_to_zero()},
+        jax.tree_util.tree_map(
+            lambda m: "train" if m else "freeze", mask))
+
+
+def partition(params):
+    """Split into (adapters, frozen): ``adapters`` is a flat
+    {keystr: array} dict of the trainable leaves, ``frozen`` the full
+    tree with adapter leaves replaced by None placeholders.  The split
+    exists because ``jax.grad`` refuses int8/int4 leaves — a QLoRA tree
+    can never be differentiated whole; gradients flow through the
+    adapter dict only (:func:`combine` re-inserts them functionally, so
+    the base still participates in the forward)."""
+    mask = lora_mask(params)
+    adapters = {}
+    maskflat = dict(
+        (jax.tree_util.keystr(p), v)
+        for p, v in jax.tree_util.tree_leaves_with_path(mask))
+
+    def visit(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        if maskflat.get(ks):
+            adapters[ks] = leaf
+            return None
+        return leaf
+
+    frozen = jax.tree_util.tree_map_with_path(visit, params)
+    return adapters, frozen
+
+
+def combine(adapters: Dict, frozen):
+    """Inverse of :func:`partition`: re-insert the adapter dict into the
+    frozen tree (which carries None at adapter positions)."""
+    def visit(path, leaf):
+        return adapters.get(jax.tree_util.keystr(path), leaf)
+
+    # None placeholders vanish from tree_leaves, so walk with is_leaf
+    # that keeps them visible
+    return jax.tree_util.tree_map_with_path(
+        visit, frozen, is_leaf=lambda x: x is None)
+
+
+def make_lora_train_step(cfg, optimizer):
+    """Jitted LoRA fine-tune step differentiating ONLY the adapters:
+    ``(params, opt_state, tokens) -> (params, opt_state, loss)`` with
+    ``opt_state = optimizer.init(partition(params)[0])``.  Works for
+    plain AND quantized (QLoRA) bases — the frozen tree never enters
+    ``jax.grad``, so int8/int4 leaves are fine, and optimizer memory is
+    proportional to the adapters alone.
+
+    The step DONATES ``params`` (the unchanged frozen base aliases
+    straight through to the output instead of being copied every step —
+    the memory-right choice for a big base).  Consequence: do not reuse
+    the input tree after the first call, and note that ``loraize_params``
+    passes through non-matching leaves by reference — copy first if the
+    source tree must stay alive."""
+    import functools
+
+    import optax
+
+    from ..parallel.train import lm_loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        adapters, frozen = partition(params)
+
+        def loss_fn(ad):
+            return lm_loss(combine(ad, frozen), tokens, cfg)
+
+        loss, grads = jax.value_and_grad(loss_fn)(adapters)
+        updates, opt_state = optimizer.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return combine(adapters, frozen), opt_state, loss
+
+    return step
+
+
+def merge_lora(params, requantize_bits: int = 0):
+    """Fold adapters into dense weights for serving: ``w + a @ b *
+    scale``.  A quantized base is dequantized first; pass
+    ``requantize_bits`` (8 or 4) to re-quantize the merged result."""
+    def visit(leaf):
+        if not (isinstance(leaf, dict) and "a" in leaf and "b" in leaf):
+            return leaf
+        if "q4" in leaf:
+            base = quant.dequantize4({"q4": leaf["q4"], "s": leaf["s"]},
+                                     dtype=jnp.float32)
+        elif "q" in leaf:
+            base = quant.dequantize(leaf["q"], leaf["s"], jnp.float32)
+        else:
+            base = leaf["w"].astype(jnp.float32)
+        scale = leaf["scale"]
+        if scale.ndim:                       # stacked [L] -> [L, 1, 1]
+            scale = scale[..., None, None]
+        delta = (leaf["a"].astype(jnp.float32)
+                 @ leaf["b"].astype(jnp.float32)) * scale
+        merged = (base + delta).astype(leaf["a"].dtype)
+        if requantize_bits == 8:
+            q, s = quant.quantize(merged)
+            return {"q": q, "s": s}
+        if requantize_bits == 4:
+            # preserve the base's ORIGINAL group size (shape [.., g,
+            # group/2, d_out]); a default re-group would silently
+            # coarsen the error grid the deployment chose
+            group = (leaf["q4"].shape[-2] * 2 if "q4" in leaf
+                     else 512)
+            return quant.quantize4(merged, group=group)
+        return merged
+
+    return jax.tree_util.tree_map(visit, params,
+                                  is_leaf=_is_weight_dict)

@@ -145,7 +145,17 @@ def q4matmul(x: jnp.ndarray, qw: Dict) -> jnp.ndarray:
 
 
 def matmul_maybe_q(x: jnp.ndarray, w) -> jnp.ndarray:
-    """Dispatch: int8 {'q','s'}, int4 {'q4','s'}, or plain array."""
+    """Dispatch: LoRA {'a','b',...}, int8 {'q','s'}, int4 {'q4','s'},
+    or plain array.  LoRA recurses on its base, so adapters compose
+    with a quantized frozen base (QLoRA-style) for free."""
+    if isinstance(w, dict) and "a" in w and "b" in w:
+        base = {k: v for k, v in w.items()
+                if k not in ("a", "b", "scale")}
+        if list(base) == ["w"]:
+            base = base["w"]
+        y = matmul_maybe_q(x, base)
+        adapter = (x @ w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
+        return y + adapter * w["scale"].astype(y.dtype)
     if isinstance(w, dict) and "q4" in w:
         return q4matmul(x, w)
     if isinstance(w, dict) and "q" in w:

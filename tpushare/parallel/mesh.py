@@ -133,6 +133,11 @@ def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     entries = list(spec)
     if len(entries) < len(shape):
         entries = [None] * (len(shape) - len(entries)) + entries
+    elif len(entries) > len(shape):
+        # right-alignment also means a LOWER-rank leaf inheriting a
+        # bigger rule keeps only the trailing entries (a [L] or [d]
+        # member of a wrapped weight dict must not get a rank-2 spec)
+        entries = entries[len(entries) - len(shape):]
     out = []
     for d, entry in enumerate(entries):
         if entry is None or d >= len(shape):

@@ -22,10 +22,15 @@ def leaf_key(path: str) -> str:
 
 
 def param_key(path: str) -> str:
-    """The parameter-name component: the last one, except that quantized
-    leaves ({'q','s'} int8 / {'q4','s'} int4, one level down) report
-    their parent ('wq', not 'q') so they inherit its sharding rule."""
+    """The parameter-name component: the last one, except that WRAPPED
+    leaves one level down — quantized ({'q','q4','s'}) and/or LoRA
+    ({'w','a','b','scale'}) — report their parent ('wq', not 'q'/'w')
+    so they inherit its sharding rule (spec legalization right-aligns
+    and drops non-dividing axes, so the small adapter dims degrade to
+    replication where the rule doesn't fit)."""
     parts = components(path)
-    if len(parts) >= 2 and parts[-1] in ("q", "q4", "s"):
+    # 'scale' stays itself (a tiny per-layer vector; replicate) — the
+    # weight-sized members inherit the parent's rule
+    if len(parts) >= 2 and parts[-1] in ("q", "q4", "s", "w", "a", "b"):
         return parts[-2]
     return parts[-1] if parts else ""
