@@ -244,3 +244,43 @@ def test_fit_block_rejects_sublane_misaligned_seq():
         _fit_block(512, 12)                   # divisors: 12, 6, 3, ...
     with pytest.raises(ValueError, match="sublane"):
         _fit_block(64, 36)                    # 36 -> 36, 18, 9: none %8
+
+
+@pytest.mark.parametrize("w", [32, 100, 256, 1000])
+def test_flash_kernel_sliding_window_matches_reference(w):
+    """Mistral-style sliding window: kernel (with whole out-of-window
+    K-blocks skipped) == masked reference, forward AND fused backward;
+    w >= seq degenerates to full causal."""
+    key = jax.random.PRNGKey(17)
+    q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    ref = reference_attention(q, k, v, causal=True, window=w)
+    fl = flash_attention(q, k, v, causal=True, interpret=True, window=w)
+    np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), atol=2e-5)
+    g1 = jax.grad(lambda q_: (flash_attention(
+        q_, k, v, causal=True, interpret=True, window=w) ** 2).sum())(q)
+    g2 = jax.grad(lambda q_: (reference_attention(
+        q_, k, v, causal=True, window=w) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=3e-4)
+    if w >= 256:
+        full = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(full),
+                                   atol=1e-6)
+
+
+def test_window_validation():
+    """window=0 / negatives are rejected at the config (they would mean
+    different things to the block-masked and position-masked paths),
+    and non-causal window raises on BOTH attention implementations."""
+    from tpushare.models import transformer
+    from tpushare.ops.attention import flash_attention
+
+    with pytest.raises(ValueError, match="window"):
+        transformer.tiny(window=0)
+    with pytest.raises(ValueError, match="window"):
+        transformer.tiny(window=-4)
+    q = jnp.ones((1, 2, 128, 64), jnp.float32)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, causal=False, interpret=True, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        reference_attention(q, q, q, causal=False, window=8)

@@ -38,6 +38,23 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    #: sliding-window attention (Mistral-style): each position attends
+    #: its last ``window`` tokens; None = full causal.  Enforced in the
+    #: no-cache forward (flash kernel skips out-of-window K-blocks) AND
+    #: the cached decode paths (position masking).  KNOWN LIMITATION:
+    #: the KV cache is still ``max_seq``-sized and decode attends (then
+    #: masks) the whole of it — a rolling window-sized cache, which is
+    #: the sliding window's memory/FLOPs payoff at decode time, is
+    #: future work; today the window is a MODELING feature (training
+    #: and prefill do skip out-of-window blocks in the flash kernel).
+    window: Optional[int] = None
+
+    def __post_init__(self):
+        if self.window is not None and self.window < 1:
+            # window=0 would mean "no window" to the block-masked flash
+            # path but "mask everything" to the position-masked decode
+            # path — normalize to None instead of diverging silently
+            raise ValueError("window must be None or >= 1")
 
     @property
     def head_dim(self) -> int:
@@ -48,12 +65,28 @@ def llama2_7b() -> ModelConfig:
     return ModelConfig()
 
 
+def mistral_7b() -> ModelConfig:
+    """Mistral-7B architecture: GQA 8 kv-heads, SwiGLU ff 14336,
+    sliding window 4096 over a 32k context."""
+    return ModelConfig(vocab=32000, d_model=4096, n_layers=32, n_heads=32,
+                       n_kv_heads=8, d_ff=14336, max_seq=32768,
+                       rope_theta=1e4, window=4096)
+
+
+def llama3_8b() -> ModelConfig:
+    """Llama-3-8B architecture: GQA 8 kv-heads, 128k vocab, theta 5e5."""
+    return ModelConfig(vocab=128256, d_model=4096, n_layers=32, n_heads=32,
+                       n_kv_heads=8, d_ff=14336, max_seq=8192,
+                       rope_theta=5e5)
+
+
 def tiny(vocab: int = 256, d_model: int = 64, n_layers: int = 2,
          n_heads: int = 4, n_kv_heads: int = 2, d_ff: int = 128,
-         max_seq: int = 128, dtype=jnp.float32) -> ModelConfig:
+         max_seq: int = 128, dtype=jnp.float32,
+         window: Optional[int] = None) -> ModelConfig:
     return ModelConfig(vocab=vocab, d_model=d_model, n_layers=n_layers,
                        n_heads=n_heads, n_kv_heads=n_kv_heads, d_ff=d_ff,
-                       max_seq=max_seq, dtype=dtype)
+                       max_seq=max_seq, dtype=dtype, window=window)
 
 
 # ---------------------------------------------------------------------------
@@ -159,19 +192,22 @@ def _qkv(p, x, cfg: ModelConfig, positions):
             v.transpose(0, 2, 1, 3))
 
 
-def cached_attention(q, kk, vv, positions):
+def cached_attention(q, kk, vv, positions, window: Optional[int] = None):
     """Masked attention of q over a dense cache view (heads expanded).
 
     The ONE copy of the decode-attention math: positions mask both
-    causality and the unwritten/garbage tail, softmax accumulates f32.
-    Dense and paged cache paths must both route here so their outputs
-    stay bit-identical.
+    causality and the unwritten/garbage tail (and the sliding window
+    when the config has one), softmax accumulates f32.  Dense and paged
+    cache paths must both route here so their outputs stay
+    bit-identical.
     """
     hd = q.shape[-1]
     t = kk.shape[2]
     q_pos = positions[:, None, :, None]                      # [B,1,S,1]
     k_pos = jnp.arange(t)[None, None, None, :]               # [1,1,1,T]
     valid = k_pos <= q_pos                                   # causal+len
+    if window is not None:
+        valid &= k_pos > q_pos - window
     logits = jnp.einsum("bhsd,bhtd->bhst", q, kk) / np.sqrt(hd)
     logits = jnp.where(valid, logits, -1e30)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -201,14 +237,18 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
             cv = upd(cv, v, cache_len)
         # decode: attend over the filled prefix; positions mask the rest
         o = cached_attention(q, _expand_kv(ck, h // hkv),
-                             _expand_kv(cv, h // hkv), positions)
+                             _expand_kv(cv, h // hkv), positions,
+                             window=cfg.window)
         return o, (ck, cv)
     if attention_fn is not None:
+        if cfg.window is not None:
+            raise ValueError("sliding-window configs are not supported "
+                             "by custom attention_fn (ring/ulysses) yet")
         # custom impls (ring/ulysses) expect equal head counts
         return attention_fn(q, _expand_kv(k, h // hkv),
                             _expand_kv(v, h // hkv), causal=True), None
     # default path is GQA-aware: K/V stay at Hkv heads end-to-end
-    return attention(q, k, v, causal=True), None
+    return attention(q, k, v, causal=True, window=cfg.window), None
 
 
 def _attn_ffn(layer, x, cfg: ModelConfig, attend):
@@ -413,7 +453,7 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
             o = cached_attention(
                 q, _expand_kv(_paged_gather(kp2, page_table), h // hkv),
                 _expand_kv(_paged_gather(vp2, page_table), h // hkv),
-                positions)
+                positions, window=cfg.window)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -473,7 +513,7 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
             o = cached_attention(
                 q, _expand_kv(_paged_gather(kp2, page_rows[None]), h // hkv),
                 _expand_kv(_paged_gather(vp2, page_rows[None]), h // hkv),
-                positions)
+                positions, window=cfg.window)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)

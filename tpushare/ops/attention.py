@@ -60,14 +60,19 @@ def _dotf32(a, b, transpose_a: bool = False, transpose_b: bool = False):
 
 
 def reference_attention(q, k, v, causal: bool = True,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        window: Optional[int] = None):
     """Plain softmax attention; q: [B, H, S, D], k/v: [B, Hkv, S, D]
-    (Hkv may divide H — GQA — and is expanded here)."""
-    return reference_attention_lse(q, k, v, causal=causal, scale=scale)[0]
+    (Hkv may divide H — GQA — and is expanded here).  ``window`` limits
+    each query to its last ``window`` keys (sliding-window / Mistral
+    attention; None = full causal)."""
+    return reference_attention_lse(q, k, v, causal=causal, scale=scale,
+                                   window=window)[0]
 
 
 def reference_attention_lse(q, k, v, causal: bool = True,
-                            scale: Optional[float] = None):
+                            scale: Optional[float] = None,
+                            window: Optional[int] = None):
     """Reference attention that ALSO returns the per-row logsumexp of the
     scaled scores [B, H, S] — the statistic block-merging schedules (ring
     attention) need; definition matches the flash kernel's lse output so
@@ -84,7 +89,13 @@ def reference_attention_lse(q, k, v, causal: bool = True,
         # offset supports cross-length (e.g. ring) blocks: positions are
         # global, query i attends key j iff j <= i + (t - s)
         mask = jnp.tril(jnp.ones((s, t), dtype=bool), k=t - s)
+        if window is not None:
+            # sliding window: ... and j > i + (t - s) - window
+            mask &= ~jnp.tril(jnp.ones((s, t), dtype=bool),
+                              k=t - s - window)
         logits = jnp.where(mask, logits, NEG_INF)
+    elif window is not None:
+        raise ValueError("window requires causal attention")
     lf = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(lf, axis=-1)
     probs = jnp.exp(lf - lse[..., None])
@@ -96,7 +107,8 @@ def reference_attention_lse(q, k, v, causal: bool = True,
 # Pallas flash attention
 # ---------------------------------------------------------------------------
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  causal: bool, scale: float, seq_k: int):
+                  causal: bool, scale: float, seq_k: int,
+                  window: int = 0):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     Also writes the per-row logsumexp of the SCALED scores — the single
@@ -134,7 +146,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                 jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            keep = k_pos <= q_pos
+            if window:
+                keep &= k_pos > q_pos - window
+            s = jnp.where(keep, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                           # [bq, bk] f32
         alpha = jnp.exp(m - m_new)
@@ -150,7 +165,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kblocks)
     else:
         last_kb = n_kblocks
-    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    if causal and window:
+        # sliding window: blocks entirely BEFORE the window's left edge
+        # (q_start - window + 1 for this block's first row) are skipped
+        first_kb = jnp.maximum((q_start - window + 1) // block_k, 0)
+    else:
+        first_kb = 0
+    m, l, acc = jax.lax.fori_loop(first_kb, last_kb, body, (m, l, acc))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
     lse_ref[...] = jnp.broadcast_to(m + jnp.log(l), (bq, 128))
@@ -158,7 +179,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                           dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          scale: float, seq_q: int):
+                          scale: float, seq_q: int, window: int = 0):
     """One (batch*head, k-block) program of the fused backward: stream
     q-blocks, accumulate this K/V block's grads.
 
@@ -198,7 +219,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                 jnp.int32, (block_q, bk), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            keep = k_pos <= q_pos
+            if window:
+                keep &= k_pos > q_pos - window
+            s = jnp.where(keep, s, NEG_INF)
         pf = jnp.exp(s - lse)                            # [bq, bk] f32
         dv = dv + _dotf32(pf.astype(k.dtype), do, transpose_a=True)
         dp = _dotf32(do, v, transpose_b=True)            # [bq, bk] f32
@@ -209,14 +233,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     # Causal skip: this K block only receives grads from q-blocks whose
     # last row is at or past k_start.
     first_qb = (k_start // block_q) if causal else 0
-    dk, dv = jax.lax.fori_loop(first_qb, n_qblocks, body, (dk, dv))
+    if causal and window:
+        # ...and, under a sliding window, none past the window's reach:
+        # q rows attending this block satisfy q_pos < k_end + window
+        last_qb = jnp.minimum(
+            (k_start + bk - 1 + window - 1) // block_q + 1, n_qblocks)
+    else:
+        last_qb = n_qblocks
+    dk, dv = jax.lax.fori_loop(first_qb, last_qb, body, (dk, dv))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                          dq_ref, *, block_k: int, causal: bool,
-                         scale: float, seq_k: int):
+                         scale: float, seq_k: int, window: int = 0):
     """One (batch*head, q-block) program: stream K/V blocks, accumulate
     dQ_i = sum_j dS_ij K_j * scale (see the dkv kernel's identities)."""
     from jax.experimental import pallas as pl
@@ -243,7 +274,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
                 jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            keep = k_pos <= q_pos
+            if window:
+                keep &= k_pos > q_pos - window
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = _dotf32(do, v, transpose_b=True)
         ds = (p * (dp - dvec)).astype(k.dtype)
@@ -253,13 +287,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         last_kb = jnp.minimum((q_start + bq - 1) // block_k + 1, n_kblocks)
     else:
         last_kb = n_kblocks
-    dq = jax.lax.fori_loop(0, last_kb, body, dq)
+    if causal and window:
+        first_kb = jnp.maximum((q_start - window + 1) // block_k, 0)
+    else:
+        first_kb = 0
+    dq = jax.lax.fori_loop(first_kb, last_kb, body, dq)
     dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_core(q, k, v, causal: bool, block_q: int, block_k: int,
-                interpret: bool):
+                interpret: bool, window: int = 0):
     """Differentiable flash attention core.
 
     Forward is the Pallas kernel (also emitting per-row logsumexp);
@@ -270,7 +308,8 @@ def _flash_core(q, k, v, causal: bool, block_q: int, block_k: int,
     tensor materializes in either pass, so training memory stays
     O(S·D) like the forward.
     """
-    out, _ = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                           window)
     return out
 
 
@@ -286,22 +325,24 @@ def _name_residuals(out, lse):
             checkpoint_name(lse, "flash_attn_lse"))
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                             window)
     out, lse = _name_residuals(out, lse)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g)
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, g):
+    return _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
+                             window=window)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_core_lse(q, k, v, causal: bool, block_q: int, block_k: int,
-                    interpret: bool):
+                    interpret: bool, window: int = 0):
     """Flash attention returning (out, lse) — the building block for
     block-merging schedules (ring attention): partial results merge by
     logaddexp-weighting, so the kernel's online-softmax statistic
@@ -312,39 +353,42 @@ def _flash_core_lse(q, k, v, causal: bool, block_q: int, block_k: int,
     i.e. the backward runs unchanged with D_i replaced by
     D_i - g_lse_i.  No extra kernel, no extra memory.
     """
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                         window)
 
 
-def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret, window=0):
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret,
+                             window)
     out, lse = _name_residuals(out, lse)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, block_q, block_k, interpret, window, res, g):
     g_out, g_lse = g
     return _flash_bwd_pallas(causal, block_q, block_k, interpret, res,
-                             g_out, g_lse=g_lse)
+                             g_out, g_lse=g_lse, window=window)
 
 
 _flash_core_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "window"))
 def flash_attention_lse(q, k, v, causal: bool = True,
                         block_q: int = 512, block_k: int = 512,
-                        interpret: bool = False):
+                        interpret: bool = False, window: int = 0):
     """Differentiable flash attention returning (out [B,H,S,D],
     lse [B,H,S] of the scaled scores); see :func:`_flash_core_lse`."""
-    return _flash_core_lse(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_core_lse(q, k, v, causal, block_q, block_k, interpret,
+                           window)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
-                                             "interpret"))
+                                             "interpret", "window"))
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 512, block_k: int = 512,
-                    interpret: bool = False):
+                    interpret: bool = False, window: int = 0):
     """Differentiable Pallas flash attention (see :func:`_flash_core`).
 
     Default 512x512 blocks: measured on a v5e at s=2048/d=128, the
@@ -357,13 +401,16 @@ def flash_attention(q, k, v, causal: bool = True,
     unaffected — unless the largest block that divides the sequence is
     not a multiple of the 8-row sublane tile, which raises (see
     :func:`_fit_block`; such shapes would only lower on the interpreter,
-    never on real TPU)."""
-    return _flash_core(q, k, v, causal, block_q, block_k, interpret)
+    never on real TPU).  ``window`` > 0 adds Mistral-style sliding-window
+    masking (each query sees its last ``window`` keys), with whole
+    K-blocks outside the window skipped in forward AND backward."""
+    return _flash_core(q, k, v, causal, block_q, block_k, interpret,
+                       window)
 
 
 def _flash_pallas(q, k, v, causal: bool = True,
                   block_q: int = 512, block_k: int = 512,
-                  interpret: bool = False):
+                  interpret: bool = False, window: int = 0):
     """Pallas flash attention; q,k,v: [B, H, S, D], S % 128 == 0 (the
     requested blocks shrink to divisors of S via :func:`_fit_block`).
 
@@ -384,6 +431,11 @@ def _flash_pallas(q, k, v, causal: bool = True,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+    if window and not causal:
+        # mirror the reference path's guard: silently dropping the
+        # window on one platform while the other raises would make
+        # behavior shape/backend-dependent
+        raise ValueError("window requires causal attention")
     b, h, s, d = q.shape
     hkv, sk = k.shape[1], k.shape[2]
     n_rep = h // hkv   # GQA: the kernel reads shared K/V blocks directly —
@@ -408,7 +460,7 @@ def _flash_pallas(q, k, v, causal: bool = True,
         return (bh // h) * hkv + (bh % h) // n_rep, 0, 0
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_k=sk)
+                               scale=scale, seq_k=sk, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, s // block_q),
@@ -435,7 +487,7 @@ def _flash_pallas(q, k, v, causal: bool = True,
 
 
 def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
-                      g_lse=None):
+                      g_lse=None, window: int = 0):
     """Fused flash backward: (dq, dk, dv) from the saved (q, k, v, out,
     lse) — no [S, S] materialization (see the dkv kernel docstring).
     ``g_lse`` (the lse output's cotangent, [B, H, S]) folds in as
@@ -492,7 +544,7 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=bq, causal=causal, scale=scale,
-        seq_q=s)
+        seq_q=s, window=window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b * h, sk // bk),
@@ -517,7 +569,7 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=bk, causal=causal, scale=scale,
-        seq_k=sk)
+        seq_k=sk, window=window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * h, s // bq),
@@ -575,7 +627,8 @@ def use_flash(q, k) -> bool:
             and q.shape[1] % k.shape[1] == 0)
 
 
-def attention(q, k, v, causal: bool = True):
+def attention(q, k, v, causal: bool = True,
+              window: Optional[int] = None):
     """Dispatch: Pallas flash on TPU (shape permitting), reference else.
 
     k/v may carry fewer (GQA) heads; both paths handle it — the flash
@@ -588,5 +641,6 @@ def attention(q, k, v, causal: bool = True):
     (< 32), where padding overhead dominates, fall back to the reference.
     """
     if use_flash(q, k):
-        return flash_attention(q, k, v, causal=causal)
-    return reference_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               window=int(window or 0))
+    return reference_attention(q, k, v, causal=causal, window=window)

@@ -34,6 +34,8 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
 
     cfgs = {
         "llama2-7b": transformer.llama2_7b,
+        "llama3-8b": transformer.llama3_8b,
+        "mistral-7b": transformer.mistral_7b,
         "flagship-small": lambda: transformer.ModelConfig(
             vocab=32000, d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
             d_ff=1408, max_seq=512),
