@@ -275,8 +275,6 @@ def test_sliding_window_config_serves_exactly():
     """A Mistral-style window config through the continuous batcher
     (dense AND paged storage, ticked AND fused) matches per-request
     generate() — the cached decode paths apply the same window mask."""
-    import numpy as np
-
     from tpushare.serving.paged import PagedContinuousBatcher
 
     wcfg = transformer.tiny(max_seq=96, window=16)

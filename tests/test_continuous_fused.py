@@ -422,3 +422,36 @@ def test_service_streaming_aborts_on_stop(model):
         if kind in ("done", "aborted"):
             break
     assert kinds and kinds[-1] in ("done", "aborted")
+
+
+def test_feature_composition_window_qlora_stream_filters(model):
+    """The round's features COMPOSE: a sliding-window config with a
+    quantized+LoRA-adapted (then merged) model, served through the
+    streaming path with eos + top_k=1 at hot temperature, must equal
+    plain greedy generate() of the same merged model."""
+    from tpushare.ops import lora, quant
+
+    _params, _ = model
+    wcfg = transformer.tiny(max_seq=96, window=16)
+    params = transformer.init_params(jax.random.PRNGKey(4), wcfg)
+    qlp = lora.loraize_params(quant.quantize_params(params), rank=2)
+    merged = lora.merge_lora(qlp, requantize_bits=8)
+
+    prompt, n = [2, 7, 1, 8], 18
+    want = _plain(merged, wcfg, prompt, n)
+    service = ContinuousService(merged, wcfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4).start()
+    try:
+        sink = service.submit_stream(prompt, n, temperature=1.7,
+                                     top_k=1)            # == greedy
+        acc = list(prompt)
+        while True:
+            kind, val = sink.get(timeout=120)
+            if kind == "delta":
+                acc.extend(val)
+            else:
+                assert kind == "done" and val == acc
+                break
+        assert acc == want
+    finally:
+        service.stop()

@@ -268,6 +268,37 @@ def test_flash_kernel_sliding_window_matches_reference(w):
                                    atol=1e-6)
 
 
+def test_flash_window_block_skip_bounds_multiblock():
+    """Exercise the block-SKIP arithmetic (first_kb in fwd/dq, last_qb
+    in dkv): seq=512 with 128-blocks and window=64 makes first_kb > 0
+    and last_qb < n_qblocks for interior blocks — an off-by-one in the
+    skip bounds corrupts output/grads here while single-block shapes
+    stay green."""
+    key = jax.random.PRNGKey(23)
+    q, k, v = (jax.random.normal(kk, (1, 2, 512, 64), jnp.float32)
+               for kk in jax.random.split(key, 3))
+    for w in (64, 130, 200):
+        ref = reference_attention(q, k, v, causal=True, window=w)
+        fl = flash_attention(q, k, v, causal=True, interpret=True,
+                             window=w, block_q=128, block_k=128)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(ref),
+                                   atol=2e-5, err_msg=f"w={w}")
+        g1 = jax.grad(lambda q_: (flash_attention(
+            q_, k, v, causal=True, interpret=True, window=w,
+            block_q=128, block_k=128) ** 2).sum())(q)
+        gk = jax.grad(lambda k_: (flash_attention(
+            q, k_, v, causal=True, interpret=True, window=w,
+            block_q=128, block_k=128) ** 2).sum())(k)
+        g2 = jax.grad(lambda q_: (reference_attention(
+            q_, k, v, causal=True, window=w) ** 2).sum())(q)
+        gk2 = jax.grad(lambda k_: (reference_attention(
+            q, k_, v, causal=True, window=w) ** 2).sum())(k)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=5e-4, err_msg=f"dq w={w}")
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gk2),
+                                   atol=5e-4, err_msg=f"dk w={w}")
+
+
 def test_window_validation():
     """window=0 / negatives are rejected at the config (they would mean
     different things to the block-masked and position-masked paths),
