@@ -103,6 +103,7 @@ class LLMServer:
         self._http = JsonHTTPServer(port, addr, routes={
             ("POST", "/generate"): self._generate,
             ("POST", "/generate_stream"): self._generate_stream,
+            ("POST", "/score"): self._score,
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
             ("GET", "/stats"): self._stats,
         })
@@ -245,6 +246,70 @@ class LLMServer:
             return None, (400, {"Error": "top_k/top_p need the slot "
                                          "pool; run with --slots"})
         return f, None
+
+    def _score(self, body):
+        """Teacher-forced scoring: per-token log-probabilities of given
+        sequences under the model — the eval-workload endpoint
+        (perplexity, reranking, answer scoring).  One forward per
+        request; no sampling, no cache.
+
+        ``{"tokens": [[...], ...]}`` scores each row's tokens[1:] given
+        its prefix; optional ``{"prompt_len": P}`` restricts the summed
+        score to positions >= P (score a continuation given a prompt).
+        Rows must share one length.  Returns per-row
+        ``{"logprobs": [...], "total": t, "scored_tokens": n}``.
+        """
+        import jax.numpy as jnp
+
+        from .score import score_tokens
+
+        tokens = body.get("tokens")
+        if (not tokens or not isinstance(tokens, list)
+                or not all(isinstance(r, list) and len(r) >= 2
+                           for r in tokens)):
+            return 400, {"Error": "body must contain tokens: "
+                                  "[[int, int, ...], ...] (>= 2 tokens)"}
+        if len({len(r) for r in tokens}) != 1:
+            return 400, {"Error": "token rows must share one length"}
+        try:
+            rows = [[int(t) for t in r] for r in tokens]
+            prompt_len = int(body.get("prompt_len", 1))
+        except (TypeError, ValueError) as e:
+            return 400, {"Error": f"malformed field: {e}"}
+        flat = [t for r in rows for t in r]
+        if any(t < 0 or t >= self.cfg.vocab for t in flat):
+            return 400, {"Error": f"token id out of range [0, "
+                                  f"{self.cfg.vocab})"}
+        if len(rows[0]) > self.cfg.max_seq:
+            return 400, {"Error": f"sequence exceeds max_seq="
+                                  f"{self.cfg.max_seq}"}
+        if not 1 <= prompt_len < len(rows[0]):
+            return 400, {"Error": "prompt_len must be in [1, len-1]"}
+        if self._service is not None and self._service._batcher.mesh \
+                is not None:
+            # tp serving shards the BATCHER's param copy; self.params is
+            # the unsharded original, and a model needing tp won't fit
+            # (or shouldn't double-exist) on one device
+            return 400, {"Error": "/score is not mesh-aware yet; "
+                                  "run without --tp to score"}
+        with self._gen_lock:
+            lp = score_tokens(self.params, self.cfg,
+                              jnp.asarray(rows, jnp.int32))
+            # the HOST FETCH is the real completion barrier (CLAUDE.md:
+            # block_until_ready is unreliable on remote backends), so it
+            # must happen INSIDE the lock for the lock to actually bound
+            # device residency to one in-flight batch
+            rows_lp = [[round(float(x), 4) for x in lp[i]]
+                       for i in range(len(rows))]
+            self.requests_served += 1
+            self.sequences_served += len(rows)
+        out = []
+        for row_lp in rows_lp:
+            scored = row_lp[prompt_len - 1:]
+            out.append({"logprobs": scored,
+                        "total": round(sum(scored), 4),
+                        "scored_tokens": len(scored)})
+        return 200, {"scores": out}
 
     def _generate_stream(self, body):
         """NDJSON token streaming over the slot pool: one line per decode

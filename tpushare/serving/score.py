@@ -1,0 +1,28 @@
+"""Teacher-forced sequence scoring: per-token log-probabilities.
+
+The eval-workload primitive (perplexity, reranking, answer scoring):
+ONE forward over the whole sequence — the MXU-friendly way to score,
+instead of decoding token by token.  Exposed over HTTP as the LLM
+server's ``POST /score``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def score_tokens(params, cfg: transformer.ModelConfig, tokens):
+    """tokens [B, S] -> logprobs [B, S-1]: position i holds
+    log P(tokens[:, i+1] | tokens[:, :i+1]).  f32 log-softmax over the
+    f32-accumulated head logits (the same numerics the speculative
+    verify path relies on)."""
+    logits = transformer.forward(params, tokens[:, :-1], cfg)  # [B,S-1,V]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        logp, tokens[:, 1:, None], axis=-1)[..., 0]
