@@ -164,3 +164,29 @@ def test_merge_requantize_preserves_group(base):
     merged = lora.merge_lora(lp, requantize_bits=4)
     # original group 32 -> packed dim 16, not the 512 default
     assert merged["layers"]["wq"]["q4"].shape[-2] == 16
+
+
+def test_lora_train_step_remat_variants(base):
+    """remat plumbing: layer/full rematerialized LoRA steps produce the
+    same loss trajectory as remat='none' (recompute changes memory, not
+    math); bad values raise."""
+    from tpushare.parallel.train import make_optimizer
+
+    cfg, params, tokens = base
+    with pytest.raises(ValueError, match="remat"):
+        lora.make_lora_train_step(cfg, make_optimizer(), remat="bogus")
+    ref = None
+    for remat in ("none", "layer", "full"):
+        lp = jax.tree_util.tree_map(
+            jnp.copy, lora.loraize_params(params, rank=2))
+        opt = make_optimizer(lr=5e-3)
+        state = opt.init(lora.partition(lp)[0])
+        step = lora.make_lora_train_step(cfg, opt, remat=remat)
+        losses = []
+        for _ in range(3):
+            lp, state, l = step(lp, state, tokens)
+            losses.append(float(l))
+        if ref is None:
+            ref = losses
+        else:
+            np.testing.assert_allclose(losses, ref, rtol=1e-5)

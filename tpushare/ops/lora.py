@@ -165,13 +165,14 @@ def combine(adapters: Dict, frozen):
         visit, frozen, is_leaf=lambda x: x is None)
 
 
-def make_lora_train_step(cfg, optimizer):
+def make_lora_train_step(cfg, optimizer, remat: str = "none"):
     """Jitted LoRA fine-tune step differentiating ONLY the adapters:
     ``(params, opt_state, tokens) -> (params, opt_state, loss)`` with
     ``opt_state = optimizer.init(partition(params)[0])``.  Works for
     plain AND quantized (QLoRA) bases — the frozen tree never enters
     ``jax.grad``, so int8/int4 leaves are fine, and optimizer memory is
-    proportional to the adapters alone.
+    proportional to the adapters alone.  ``remat`` mirrors
+    ``make_train_step`` (none/layer/full) for long-sequence fine-tunes.
 
     The step DONATES ``params`` (the unchanged frozen base aliases
     straight through to the output instead of being copied every step —
@@ -183,14 +184,24 @@ def make_lora_train_step(cfg, optimizer):
 
     import optax
 
-    from ..parallel.train import lm_loss
+    from ..parallel.train import ATTN_SAVING_POLICY, lm_loss
+
+    if remat == "full":
+        base_loss = jax.checkpoint(functools.partial(lm_loss, cfg=cfg))
+    elif remat == "layer":
+        base_loss = functools.partial(lm_loss, cfg=cfg,
+                                      remat_policy=ATTN_SAVING_POLICY)
+    elif remat == "none":
+        base_loss = functools.partial(lm_loss, cfg=cfg)
+    else:
+        raise ValueError(f"remat must be none|layer|full, got {remat!r}")
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
         adapters, frozen = partition(params)
 
         def loss_fn(ad):
-            return lm_loss(combine(ad, frozen), tokens, cfg)
+            return base_loss(combine(ad, frozen), tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(adapters)
         updates, opt_state = optimizer.update(grads, opt_state, adapters)

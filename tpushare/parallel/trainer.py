@@ -33,14 +33,28 @@ class Trainer:
                  lr: float = 3e-4, seed: int = 0,
                  remat: str = "none",
                  schedule: str = "constant", warmup_steps: int = 0,
-                 total_steps: int = 0, grad_clip_norm: float = 0.0):
+                 total_steps: int = 0, grad_clip_norm: float = 0.0,
+                 lora_rank: int = 0, lora_alpha: float = 16.0):
         self.cfg = cfg
         self.mesh = mesh
         self.save_every = save_every
+        self.lora_rank = lora_rank
+        self.lora_alpha = lora_alpha
         self.optimizer = make_optimizer(
             lr=lr, schedule=schedule, warmup_steps=warmup_steps,
             total_steps=total_steps, grad_clip_norm=grad_clip_norm)
-        if mesh is not None and "pp" in mesh.axis_names:
+        if lora_rank > 0:
+            # adapter-only fine-tuning: params are the loraized tree,
+            # opt_state covers ONLY the adapter dict, and the step
+            # differentiates just the adapters (QLoRA-safe)
+            if mesh is not None and "pp" in mesh.axis_names:
+                raise ValueError("lora_rank with a pp mesh is not "
+                                 "supported (the 1F1B step differentiates "
+                                 "whole stage params)")
+            from ..ops.lora import make_lora_train_step
+            self.step_fn = make_lora_train_step(cfg, self.optimizer,
+                                                remat=remat)
+        elif mesh is not None and "pp" in mesh.axis_names:
             # a pp axis selects the 1F1B pipelined step (optionally
             # data-parallel over a dp axis of the same mesh); dp/tp-only
             # meshes keep the single-program step, whose collectives XLA
@@ -69,8 +83,11 @@ class Trainer:
             self.step = latest
             log.info("resumed from step %d", latest)
         else:
-            self.params = self._fresh_state(seed)["params"]
-            self.opt_state = self.optimizer.init(self.params)
+            fresh = self._fresh_state(seed)
+            self.params = fresh["params"]
+            # _fresh_state already built the matching opt_state (over
+            # the ADAPTER dict when lora_rank > 0, full params else)
+            self.opt_state = fresh["opt_state"]
         if mesh is not None:
             # optimizer moments mirror param leaf names, so the same
             # sharding rules place both.
@@ -79,6 +96,13 @@ class Trainer:
 
     def _fresh_state(self, seed: int):
         params = transformer.init_params(jax.random.PRNGKey(seed), self.cfg)
+        if self.lora_rank > 0:
+            from ..ops import lora
+            params = lora.loraize_params(params, rank=self.lora_rank,
+                                         alpha=self.lora_alpha)
+            return {"params": params,
+                    "opt_state": self.optimizer.init(
+                        lora.partition(params)[0])}
         return {"params": params, "opt_state": self.optimizer.init(params)}
 
     def run(self, batches: Iterator, n_steps: int,
