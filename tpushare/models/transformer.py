@@ -41,12 +41,13 @@ class ModelConfig:
     #: sliding-window attention (Mistral-style): each position attends
     #: its last ``window`` tokens; None = full causal.  Enforced in the
     #: no-cache forward (flash kernel skips out-of-window K-blocks) AND
-    #: the cached decode paths (position masking).  KNOWN LIMITATION:
-    #: the KV cache is still ``max_seq``-sized and decode attends (then
-    #: masks) the whole of it — a rolling window-sized cache, which is
-    #: the sliding window's memory/FLOPs payoff at decode time, is
-    #: future work; today the window is a MODELING feature (training
-    #: and prefill do skip out-of-window blocks in the flash kernel).
+    #: the cached decode paths (position masking).  Single-request
+    #: decode (``generate``/``generate_fused``) uses a ROLLING
+    #: window-sized ring cache — O(window) HBM and attended keys
+    #: instead of O(max_seq), bit-identical outputs.  The continuous
+    #: batcher still allocates max_seq-sized slots (its pooled storage
+    #: is shared by non-window requests; a rolling slot pool is future
+    #: work).
     window: Optional[int] = None
 
     def __post_init__(self):
@@ -192,7 +193,8 @@ def _qkv(p, x, cfg: ModelConfig, positions):
             v.transpose(0, 2, 1, 3))
 
 
-def cached_attention(q, kk, vv, positions, window: Optional[int] = None):
+def cached_attention(q, kk, vv, positions, window: Optional[int] = None,
+                     k_positions=None):
     """Masked attention of q over a dense cache view (heads expanded).
 
     The ONE copy of the decode-attention math: positions mask both
@@ -200,12 +202,22 @@ def cached_attention(q, kk, vv, positions, window: Optional[int] = None):
     when the config has one), softmax accumulates f32.  Dense and paged
     cache paths must both route here so their outputs stay
     bit-identical.
+
+    ``k_positions`` overrides the key positions (default: cache slot ==
+    position) — the ROLLING window cache stores position p in slot
+    p % W, so each slot's CURRENT position is data-dependent; negative
+    entries mark never-written slots and are masked.
     """
     hd = q.shape[-1]
     t = kk.shape[2]
     q_pos = positions[:, None, :, None]                      # [B,1,S,1]
-    k_pos = jnp.arange(t)[None, None, None, :]               # [1,1,1,T]
-    valid = k_pos <= q_pos                                   # causal+len
+    if k_positions is None:
+        k_pos = jnp.arange(t)[None, None, None, :]           # [1,1,1,T]
+    else:
+        kp = jnp.asarray(k_positions)
+        k_pos = (kp[None, None, None, :] if kp.ndim == 1
+                 else kp[:, None, None, :])                  # [B,1,1,T]
+    valid = (k_pos <= q_pos) & (k_pos >= 0)                  # causal+len
     if window is not None:
         valid &= k_pos > q_pos - window
     logits = jnp.einsum("bhsd,bhtd->bhst", q, kk) / np.sqrt(hd)
@@ -223,7 +235,48 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
     q, k, v = _qkv(p, xin, cfg, positions)
 
     if kv_cache is not None:
-        ck, cv = kv_cache                       # [B, Hkv, max_seq, D]
+        ck, cv = kv_cache                       # [B, Hkv, max_seq|W, D]
+        W = ck.shape[2]
+        if W < cfg.max_seq:
+            # ROLLING window cache (init_kv_caches(..., rolling=True)):
+            # position p lives in ring slot p % W, so persistent HBM and
+            # per-step attended keys are O(window), not O(max_seq) — the
+            # sliding window's decode payoff.  Writes > W keys keep the
+            # last W (only they are ever attendable).  Within a multi-
+            # token write, only queries in the LAST window of positions
+            # see every key they are entitled to — the decode contract
+            # (consume the final position's logits) is exact, asserted
+            # bit-identical to the full cache in tests.
+            if cfg.window is None or cfg.window != W:
+                raise ValueError(
+                    f"rolling cache of {W} requires cfg.window == {W}")
+            s_new = k.shape[2]
+            if s_new > W:
+                k = k[:, :, s_new - W:]
+                v = v[:, :, s_new - W:]
+            n_wr = min(s_new, W)
+            if jnp.ndim(cache_len) == 0:
+                idx = (cache_len + max(s_new - W, 0)
+                       + jnp.arange(n_wr)) % W
+                ck = ck.at[:, :, idx, :].set(k)
+                cv = cv.at[:, :, idx, :].set(v)
+                l_end = cache_len + s_new
+                r = jnp.arange(W)
+                k_pos = r + W * ((l_end - 1 - r) // W)       # [W]
+            else:
+                idx = (cache_len[:, None] + max(s_new - W, 0)
+                       + jnp.arange(n_wr)[None, :]) % W      # [B, n]
+                upd = jax.vmap(lambda c, blk, ix:
+                               c.at[:, ix, :].set(blk))
+                ck = upd(ck, k, idx)
+                cv = upd(cv, v, idx)
+                l_end = cache_len + s_new                    # [B]
+                r = jnp.arange(W)[None, :]
+                k_pos = r + W * ((l_end[:, None] - 1 - r) // W)
+            o = cached_attention(q, _expand_kv(ck, h // hkv),
+                                 _expand_kv(cv, h // hkv), positions,
+                                 window=cfg.window, k_positions=k_pos)
+            return o, (ck, cv)
         if jnp.ndim(cache_len) == 0:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
             cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
@@ -382,9 +435,27 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
     return _head_mm(x, params["lm_head"])
 
 
-def init_kv_caches(cfg: ModelConfig, batch: int):
-    """Stacked KV cache: a (k, v) pair of [L, B, Hkv, max_seq, D] buffers."""
-    shape = (cfg.n_layers, batch, cfg.n_kv_heads, cfg.max_seq, cfg.head_dim)
+def wants_rolling(cfg: ModelConfig) -> bool:
+    """THE rolling-cache eligibility predicate (one place): a sliding-
+    window config whose window is smaller than its context decodes from
+    a ring cache."""
+    return cfg.window is not None and cfg.window < cfg.max_seq
+
+
+def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False):
+    """Stacked KV cache: a (k, v) pair of [L, B, Hkv, T, D] buffers with
+    T = max_seq, or T = cfg.window for a ROLLING ring cache (sliding-
+    window configs only): position p lives in slot p % window, so cache
+    HBM is O(window) instead of O(max_seq) — for mistral_7b that is a
+    4096-entry cache against a 32768 context, 8x less KV memory and 8x
+    fewer attended keys per decode step."""
+    if rolling:
+        if cfg.window is None:
+            raise ValueError("rolling caches need a sliding-window cfg")
+        t = cfg.window
+    else:
+        t = cfg.max_seq
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, t, cfg.head_dim)
     return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
 
 

@@ -122,7 +122,11 @@ def generate_fused(params, cfg: transformer.ModelConfig, prompt: jnp.ndarray,
         return prompt                        # mirror generate(): no tokens
     if temperature > 0.0 and key is None:
         key = jax.random.PRNGKey(0)
-    caches = transformer.init_kv_caches(cfg, batch=b)
+    # sliding-window configs decode from a ROLLING window-sized cache:
+    # O(window) HBM and attended keys instead of O(max_seq), with
+    # bit-identical outputs (tests)
+    caches = transformer.init_kv_caches(
+        cfg, batch=b, rolling=transformer.wants_rolling(cfg))
     prefill, _ = make_decode_fns(cfg)
     logits, caches = prefill(params, prompt, caches, prompt_len)
     if temperature > 0.0:
@@ -170,7 +174,8 @@ def generate(params, cfg: transformer.ModelConfig, prompt: jnp.ndarray,
         f"{prompt_len}+{max_new_tokens} exceeds max_seq {cfg.max_seq}")
     if temperature > 0.0 and key is None:
         key = jax.random.PRNGKey(0)
-    caches = transformer.init_kv_caches(cfg, batch=b)
+    caches = transformer.init_kv_caches(
+        cfg, batch=b, rolling=transformer.wants_rolling(cfg))
     prefill, step = make_decode_fns(cfg)
 
     logits, caches = prefill(params, prompt, caches, prompt_len)
