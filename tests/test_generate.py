@@ -207,8 +207,6 @@ def test_rolling_cache_batched_cache_len_branch():
     k_pos) — unreachable from generate today but the future batcher
     hook — pinned against the full cache at forward() level with slots
     at DIFFERENT depths."""
-    import numpy as np
-
     wcfg = transformer.tiny(max_seq=96, window=16)
     params = transformer.init_params(jax.random.PRNGKey(1), wcfg)
     B = 2
