@@ -1,0 +1,169 @@
+"""Round-5 advisor fixes: sequential top-k→top-p composition, and
+cancel/abandoned-stream slot release with loop-side stats accounting.
+
+The sampling test pins the HF/vLLM semantics (nucleus over the
+RENORMALIZED top-k survivors); the cancel tests pin that an abandoned
+request frees its slot/storage instead of decoding to completion, and
+that completion stats fire on the service loop even when no client is
+consuming the stream.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving.continuous import (ContinuousBatcher,
+                                         ContinuousService, _sample_next)
+from tpushare.serving.generate import generate
+
+
+def test_top_p_composes_over_renormalized_topk_survivors():
+    """probs (.4,.3,.2,.1), top_k=3, top_p=0.75: the renormalized top-3
+    survivors are (4/9, 3/9, 2/9), whose cumulative-before masses are
+    (0, .444, .778) — token 2 falls OUTSIDE the nucleus.  Under the old
+    independent-masks composition the full-distribution nucleus kept
+    token 2 (cumulative-before 0.7 < 0.75), so this distinguishes the
+    two orders.  Nucleus alone at the same p must still keep token 2."""
+    probs = np.array([0.4, 0.3, 0.2, 0.1])
+    n = 256
+    logits = jnp.asarray(np.tile(np.log(probs), (n, 1)), jnp.float32)
+    temps = jnp.ones((n,), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+
+    seq = np.asarray(_sample_next(
+        logits, temps, keys,
+        top_ks=jnp.full((n,), 3, jnp.int32),
+        top_ps=jnp.full((n,), 0.75, jnp.float32)))
+    assert set(np.unique(seq)) <= {0, 1}, "token 2 leaked into the nucleus"
+    assert 1 in seq                      # not collapsed to greedy
+
+    only_p = np.asarray(_sample_next(
+        logits, temps, keys,
+        top_ks=jnp.zeros((n,), jnp.int32),
+        top_ps=jnp.full((n,), 0.75, jnp.float32)))
+    assert 2 in only_p, "full-dist nucleus should keep token 2"
+    assert 3 not in only_p
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.mark.slow
+def test_batcher_cancel_releases_decoding_and_prefilling(model):
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    ra = b.admit([3, 5, 7], 20)
+    rb = b.admit([2, 4], 6)
+    b.tick()
+    assert len(b.free_slots()) == 0
+    assert b.cancel(ra)
+    assert len(b.free_slots()) == 1
+    assert not b.cancel(ra)              # idempotent / unknown -> False
+    b.run_until_drained()
+    exp = [int(t) for t in generate(
+        params, cfg, jnp.asarray([[2, 4]], jnp.int32), max_new_tokens=6)[0]]
+    assert b.completed[rb] == exp        # survivor unaffected
+    assert ra not in b.completed
+
+    # mid-prefill cancel frees the slot before activation
+    b2 = ContinuousBatcher(params, cfg, n_slots=1)
+    rc = b2.admit_chunked(list(range(1, 17)), 4, chunk=4)
+    b2.advance_prefill()
+    assert b2.prefilling and b2.cancel(rc)
+    assert not b2.prefilling and len(b2.free_slots()) == 1
+
+
+@pytest.mark.slow
+def test_service_cancel_frees_slot_for_next_request(model):
+    params, cfg = model
+    svc = ContinuousService(params, cfg, n_slots=1).start()
+    try:
+        sink_a = svc.submit_stream([1, 2, 3], 60)
+        svc.cancel(sink_a)
+        sink_b = svc.submit([7, 8], 5)
+        out = sink_b.get(timeout=120)
+        exp = [int(t) for t in generate(
+            params, cfg, jnp.asarray([[7, 8]], jnp.int32),
+            max_new_tokens=5)[0]]
+        assert out == exp                # slot was really released
+        # the cancelled stream never completes
+        items = []
+        while not sink_a.empty():
+            items.append(sink_a.get_nowait())
+        assert all(kind != "done" for kind, _ in items)
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_stream_on_complete_fires_without_consumer(model):
+    """Stats accounting must not depend on the client draining the
+    stream: on_complete fires on the loop thread at batcher completion."""
+    params, cfg = model
+    svc = ContinuousService(params, cfg, n_slots=1).start()
+    done = threading.Event()
+    got = {}
+
+    def on_complete(out):
+        got["out"] = out
+        done.set()
+
+    try:
+        svc.submit_stream([4, 5, 6], 7, on_complete=on_complete)
+        assert done.wait(timeout=120)
+        exp = [int(t) for t in generate(
+            params, cfg, jnp.asarray([[4, 5, 6]], jnp.int32),
+            max_new_tokens=7)[0]]
+        assert got["out"] == exp
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_http_stream_disconnect_releases_slot():
+    """A client that drops the NDJSON stream mid-flight must not pin its
+    slot: on a 1-slot server, a follow-up /generate completes."""
+    from tpushare.serving.llm import LLMServer, build_model
+
+    cfg, params = build_model("tiny", quantize_int8=False)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                    n_slots=1).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate_stream",
+            data=json.dumps({"tokens": [[4, 5, 6]],
+                             "max_new_tokens": 60}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        r = urllib.request.urlopen(req, timeout=120)
+        r.readline()                     # first delta arrived
+        r.close()                        # ... and the client walks away
+        # The server notices on its next write and cancels; the single
+        # slot must come back for the next request.
+        body = json.dumps({"tokens": [[9, 9]],
+                           "max_new_tokens": 3}).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req2, timeout=120) as r2:
+            out = json.loads(r2.read())
+        assert len(out["tokens"][0]) == 5
+        # the abandoned request was cancelled, not completed: give the
+        # loop a beat, then check it never entered served stats
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and srv._service.snapshot()["active"] > 0):
+            time.sleep(0.1)
+        assert srv._service.snapshot()["active"] == 0
+    finally:
+        srv.stop()

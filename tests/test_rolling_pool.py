@@ -1,0 +1,132 @@
+"""ROLLING slot pool for the continuous batcher (sliding-window
+configs): window-sized per-slot KV storage must be bit-identical to the
+max_seq pool through every serving path — chunked (padded) prefill,
+single ticks, fused chunks, admit-while-decode, sampling, eos — while
+costing max_seq/window× less HBM per slot.
+
+The two hazards this file pins (see _tick_n / _attend_dense docstrings):
+padded final-chunk writes must be DROPPED from the ring (they would
+wrap onto still-attendable keys), and fused-chunk garbage writes into
+mid-prefill rows must stay FROZEN at the aimed position instead of
+wandering across the ring.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.generate import generate
+
+pytestmark = pytest.mark.slow  # JAX compiles on the CPU mesh
+
+W = 16
+
+
+@pytest.fixture(scope="module")
+def model():
+    # window much smaller than max_seq, prompts longer than the window,
+    # decode lengths that wrap the ring several times
+    cfg = transformer.tiny(max_seq=96, window=W)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _drain(b, fused_chunk=None, max_iters=2000):
+    for _ in range(max_iters):
+        if b.prefilling:
+            b.advance_prefill()
+            if fused_chunk:
+                b.tick_fused(fused_chunk)
+            else:
+                b.tick()
+        elif fused_chunk:
+            if not b.tick_fused(fused_chunk):
+                return
+        elif not b.tick():
+            return
+    raise RuntimeError("did not drain")
+
+
+def test_rolling_pool_is_auto_and_window_sized(model):
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    assert b.rolling_slots
+    assert b.caches[0].shape[3] == W          # [L, B, Hkv, W, D]
+    info = b.storage_info()
+    assert info["kind"] == "rolling" and info["slot_tokens"] == W
+    full = ContinuousBatcher(params, cfg, n_slots=2, rolling_slots=False)
+    assert full.caches[0].shape[3] == cfg.max_seq
+    assert info["bytes_per_slot"] * cfg.max_seq \
+        == full.storage_info()["bytes_per_slot"] * W
+
+
+def test_rolling_matches_full_pool_chunked_padded_prefill(model):
+    """Prompts longer than the window, chunk sizes that force PADDED
+    final chunks, decode far past one ring revolution."""
+    params, cfg = model
+    requests = [(list(range(1, 2 * W + 4)), 25),   # prompt 35 > 2W, pad 35%4
+                (list(range(3, W)), 40),           # short prompt, long decode
+                ([7, 11, 13, 17, 19], 3 * W)]      # 3 revolutions
+    outs = {}
+    for rolling in (False, True):
+        b = ContinuousBatcher(params, cfg, n_slots=3,
+                              rolling_slots=rolling)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in requests]
+        _drain(b)
+        outs[rolling] = [b.completed[r] for r in rids]
+    assert outs[True] == outs[False]
+    # and the full pool itself matches per-request generate()
+    for (p, n), got in zip(requests, outs[False]):
+        exp = [int(t) for t in generate(
+            params, cfg, jnp.asarray([p], jnp.int32), max_new_tokens=n)[0]]
+        assert got == exp
+
+
+def test_rolling_matches_full_pool_fused_admit_while_decode(model):
+    """The frozen-garbage invariant under fire: new (long, padded)
+    prompts admitted while other slots decode through FUSED chunks, on
+    both layouts, must produce identical streams — including sampling."""
+    params, cfg = model
+
+    def run(rolling):
+        b = ContinuousBatcher(params, cfg, n_slots=3,
+                              rolling_slots=rolling)
+        r1 = b.admit_chunked(list(range(5, W + 12)), 30, chunk=8,
+                             temperature=0.9, seed=42)
+        # get r1 decoding before admitting the long second prompt
+        while b.prefilling:
+            b.advance_prefill()
+            b.tick_fused(4)
+        r2 = b.admit_chunked(list(range(2, 2 * W + 9)), 20, chunk=8)
+        r3 = b.admit_chunked([9, 8, 7], W + 9, chunk=8,
+                             temperature=0.7, seed=7, top_k=5, top_p=0.9)
+        _drain(b, fused_chunk=4)
+        return [b.completed[r] for r in (r1, r2, r3)]
+
+    assert run(True) == run(False)
+
+
+def test_rolling_pool_through_service_with_eos(model):
+    params, cfg = model
+    svc = ContinuousService(params, cfg, n_slots=2).start()
+    try:
+        assert svc._batcher.rolling_slots
+        prompt = list(range(1, W + 6))
+        out = svc.submit(prompt, 2 * W).get(timeout=120)
+        exp = [int(t) for t in generate(
+            params, cfg, jnp.asarray([prompt], jnp.int32),
+            max_new_tokens=2 * W)[0]]
+        assert out == exp
+        # eos early-stop unaffected by the ring
+        eos = exp[len(prompt) + 2] if len(exp) > len(prompt) + 2 else None
+        if eos is not None:
+            out2 = svc.submit(prompt, 2 * W, eos_id=int(eos)).get(
+                timeout=120)
+            assert out2 == exp[:exp.index(int(eos),
+                                          len(prompt)) + 1] \
+                or out2[-1] == int(eos)
+    finally:
+        svc.stop()

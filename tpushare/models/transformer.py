@@ -45,9 +45,9 @@ class ModelConfig:
     #: decode (``generate``/``generate_fused``) uses a ROLLING
     #: window-sized ring cache — O(window) HBM and attended keys
     #: instead of O(max_seq), bit-identical outputs.  The continuous
-    #: batcher still allocates max_seq-sized slots (its pooled storage
-    #: is shared by non-window requests; a rolling slot pool is future
-    #: work).
+    #: batcher's DENSE slot pool is rolling too for windowed configs
+    #: (auto; see ContinuousBatcher rolling_slots): window-sized slots,
+    #: so HBM buys max_seq/window× more concurrent sequences.
     window: Optional[int] = None
 
     def __post_init__(self):
@@ -229,8 +229,21 @@ def cached_attention(q, kk, vv, positions, window: Optional[int] = None,
 def _attend_dense(p, xin, cfg: ModelConfig, positions,
                   kv_cache: Optional[Tuple] = None,
                   cache_len: Optional[jnp.ndarray] = None,
-                  attention_fn=None):
-    """Dense attention step: (o [B,H,S,D] pre-projection, new_cache)."""
+                  attention_fn=None,
+                  kv_write_len=None):
+    """Dense attention step: (o [B,H,S,D] pre-projection, new_cache).
+
+    ``kv_write_len`` (traced scalar, ROLLING caches only): number of
+    REAL tokens in this multi-token write; ring writes for padded
+    positions >= kv_write_len are DROPPED (out-of-range scatter index,
+    ``mode='drop'``) instead of committed.  A full-size cache tolerates
+    padded writes (positions beyond the real prefix are overwritten at
+    length==p before attendable), but a ring of exactly W slots has no
+    spare positions: a padded write at position q would wrap onto slot
+    q % W and clobber the still-attendable key of position q - W.
+    Dropping keeps the ring's invariant — every slot holds the real key
+    of the highest position ≡ slot (mod W) below the true length — so
+    the next forward's k_pos reconstruction stays exact."""
     h, hkv = cfg.n_heads, cfg.n_kv_heads
     q, k, v = _qkv(p, xin, cfg, positions)
 
@@ -241,59 +254,84 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
             # ROLLING window cache (init_kv_caches(..., rolling=True)):
             # position p lives in ring slot p % W, so persistent HBM and
             # per-step attended keys are O(window), not O(max_seq) — the
-            # sliding window's decode payoff.  Writes > W keys keep the
-            # last W (only they are ever attendable).  Within a multi-
-            # token write, only queries in the LAST window of positions
-            # see every key they are entitled to — the decode contract
-            # (consume the final position's logits) is exact, asserted
-            # bit-identical to the full cache in tests.
+            # sliding window's decode payoff.
+            #
+            # Single-token (decode): commit first, then attend the ring
+            # — the one evicted key (position cache_len - W) is outside
+            # the new query's window, so the step is EXACT.
+            #
+            # Multi-token (prefill chunk / whole prompt): committing
+            # first would evict keys the chunk's EARLIER queries are
+            # still entitled to (writing c keys drops the c oldest, but
+            # query cache_len still needs them).  Instead, attend over
+            # the PRE-CHUNK ring plus the chunk's own K/V — every query
+            # sees its full window, all S positions' outputs are exact
+            # — then commit the last W REAL keys per ring slot with a
+            # gather+select (deterministic; no duplicate-index scatter).
+            # ``kv_write_len`` bounds the commit so a padded tail is
+            # never written (it would wrap onto still-attendable keys).
             if cfg.window != W:
                 raise ValueError(
                     f"rolling cache of {W} requires cfg.window == {W}")
             s_new = k.shape[2]
-            if s_new > W:
-                k = k[:, :, s_new - W:]
-                v = v[:, :, s_new - W:]
-            n_wr = min(s_new, W)
-            if jnp.ndim(cache_len) == 0:
-                if n_wr == 1:
+            r = jnp.arange(W)
+            if s_new == 1:
+                if jnp.ndim(cache_len) == 0:
                     # the per-token decode HOT PATH: a contiguous
                     # dynamic-update-slice lowers much better on TPU
                     # than a 1-element scatter
-                    slot = (cache_len + max(s_new - W, 0)) % W
+                    slot = cache_len % W
                     ck = jax.lax.dynamic_update_slice(
                         ck, k, (0, 0, slot, 0))
                     cv = jax.lax.dynamic_update_slice(
                         cv, v, (0, 0, slot, 0))
+                    l_end = cache_len + 1
+                    k_pos = r + W * ((l_end - 1 - r) // W)       # [W]
                 else:
-                    idx = (cache_len + max(s_new - W, 0)
-                           + jnp.arange(n_wr)) % W
-                    ck = ck.at[:, :, idx, :].set(k)
-                    cv = cv.at[:, :, idx, :].set(v)
-                l_end = cache_len + s_new
-                r = jnp.arange(W)
-                k_pos = r + W * ((l_end - 1 - r) // W)       # [W]
-            else:
-                if n_wr == 1:
-                    slots = (cache_len + max(s_new - W, 0)) % W   # [B]
+                    slots = cache_len % W                        # [B]
                     upd = jax.vmap(lambda c, blk, p:
                                    jax.lax.dynamic_update_slice(
                                        c, blk, (0, p, 0)))
                     ck = upd(ck, k, slots)
                     cv = upd(cv, v, slots)
-                else:
-                    idx = (cache_len[:, None] + max(s_new - W, 0)
-                           + jnp.arange(n_wr)[None, :]) % W  # [B, n]
-                    upd = jax.vmap(lambda c, blk, ix:
-                                   c.at[:, ix, :].set(blk))
-                    ck = upd(ck, k, idx)
-                    cv = upd(cv, v, idx)
-                l_end = cache_len + s_new                    # [B]
-                r = jnp.arange(W)[None, :]
-                k_pos = r + W * ((l_end[:, None] - 1 - r) // W)
-            o = cached_attention(q, _expand_kv(ck, h // hkv),
-                                 _expand_kv(cv, h // hkv), positions,
-                                 window=cfg.window, k_positions=k_pos)
+                    l_end = cache_len + 1                        # [B]
+                    k_pos = (r[None, :]
+                             + W * ((l_end[:, None] - 1 - r[None, :]) // W))
+                o = cached_attention(q, _expand_kv(ck, h // hkv),
+                                     _expand_kv(cv, h // hkv), positions,
+                                     window=cfg.window, k_positions=k_pos)
+                return o, (ck, cv)
+            nv = s_new if kv_write_len is None else kv_write_len
+            if jnp.ndim(cache_len) == 0:
+                ring_pos = r + W * ((cache_len - 1 - r) // W)    # [W]
+                new_pos = cache_len + jnp.arange(s_new)          # [S]
+                k_pos = jnp.concatenate([ring_pos, new_pos])     # [W+S]
+                a = (r - cache_len) % W     # first chunk offset -> slot r
+            else:
+                ring_pos = (r[None, :]
+                            + W * ((cache_len[:, None] - 1 - r[None, :])
+                                   // W))                        # [B, W]
+                new_pos = cache_len[:, None] + jnp.arange(s_new)[None, :]
+                k_pos = jnp.concatenate([ring_pos, new_pos], axis=1)
+                a = (r[None, :] - cache_len[:, None]) % W        # [B, W]
+            o = cached_attention(
+                q, _expand_kv(jnp.concatenate([ck, k], axis=2), h // hkv),
+                _expand_kv(jnp.concatenate([cv, v], axis=2), h // hkv),
+                positions, window=cfg.window, k_positions=k_pos)
+            # commit: per ring slot, the LATEST real chunk offset that
+            # maps to it (a + W*floor((nv-1-a)/W)); slots no real offset
+            # reaches keep their old key
+            j_r = jnp.clip(a + W * ((nv - 1 - a) // W), 0, s_new - 1)
+            write = a < nv                        # [W] or [B, W]
+            if jnp.ndim(cache_len) == 0:
+                sel_k, sel_v = k[:, :, j_r, :], v[:, :, j_r, :]
+                wmask = write[None, None, :, None]
+            else:
+                take = jax.vmap(lambda blk, ix: blk[:, ix, :])
+                sel_k, sel_v = take(k, j_r), take(v, j_r)
+                wmask = write[:, None, :, None]
+            ck = jnp.where(wmask, sel_k, ck)
+            cv = jnp.where(wmask, sel_v, cv)
             return o, (ck, cv)
         if jnp.ndim(cache_len) == 0:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
@@ -349,7 +387,8 @@ def forward(params, tokens, cfg: ModelConfig,
             cache_len: Optional[jnp.ndarray] = None,
             positions: Optional[jnp.ndarray] = None,
             attention_fn=None,
-            remat_policy=None):
+            remat_policy=None,
+            kv_write_len=None):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -361,6 +400,14 @@ def forward(params, tokens, cfg: ModelConfig,
     ``functools.partial(tpushare.parallel.ring.ring_attention, mesh=mesh)``
     to run exact causal attention over sequence shards (sp axis) instead
     of the single-device kernel.
+
+    ROLLING caches (from ``init_kv_caches(..., rolling=True)``, storage
+    W < cfg.max_seq) are EXACT at every position, including S > 1
+    writes: a multi-token chunk attends the pre-chunk ring plus its own
+    K/V before committing, so no query loses keys it is entitled to
+    (see the commit discussion in :func:`_attend_dense`).
+    ``kv_write_len`` (rolling only) marks how many of the S tokens are
+    REAL — a padded tail is attendable-masked and never committed.
 
     ``remat_policy`` (no-cache path only) wraps the scanned layer body
     in per-layer ``jax.checkpoint``: the backward holds one layer's
@@ -403,7 +450,7 @@ def forward(params, tokens, cfg: ModelConfig,
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, kv_cache=(ck, cv),
-                    cache_len=cache_len))
+                    cache_len=cache_len, kv_write_len=kv_write_len))
 
         ck, cv = kv_caches
         x, (new_ck, new_cv) = jax.lax.scan(

@@ -59,8 +59,14 @@ def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
     """
     row = jax.tree_util.tree_map(
         lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), caches)
+    # kv_write_len = #real tokens in the (padded) chunk: a ROLLING pool
+    # drops the padded tail's ring writes (they would wrap onto
+    # still-attendable keys); a full-size pool ignores it (padded
+    # writes land beyond the real prefix and are overwritten at
+    # length==p before attendable).
     logits, row = transformer.forward(
-        params, tokens[:, :chunk_len], cfg, kv_caches=row, cache_len=pos)
+        params, tokens[:, :chunk_len], cfg, kv_caches=row, cache_len=pos,
+        kv_write_len=last_idx + 1)
     caches = jax.tree_util.tree_map(
         lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
         caches, row)
@@ -89,11 +95,12 @@ def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
     dense and paged ticks so greedy/sampling semantics cannot drift.
 
     ``top_ks``/``top_ps`` (passed together or not at all — the "rich"
-    sampler) add per-slot top-k and nucleus filtering: logits outside
-    slot i's k largest (k<=0 = off) or outside its smallest
-    cumulative-p nucleus (p>=1 = off) are masked to -inf BEFORE the
-    categorical draw.  Both operate on temperature-scaled
-    probabilities, the standard composition.  The rich path costs one
+    sampler) add per-slot top-k and nucleus filtering: slot i's k
+    largest logits survive top-k (k<=0 = off), then the nucleus is
+    computed over the RENORMALIZED top-k survivors (p>=1 = off) — the
+    sequential composition HF/vLLM users expect, so a request setting
+    both filters migrates without a distribution shift.  Both operate
+    on temperature-scaled probabilities.  The rich path costs one
     [B, V] sort per step, so ticks only compile it in when some live
     slot asked for it (static arg on the tick programs)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -105,12 +112,21 @@ def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
         kk = jnp.clip(top_ks, 1, v)
         kth = jnp.take_along_axis(sorted_l, (kk - 1)[:, None], axis=1)
         mask = (top_ks[:, None] > 0) & (lf < kth)
-        probs = jax.nn.softmax(sorted_l, axis=-1)
+        # nucleus over the top-k-filtered, renormalized distribution:
+        # positions >= k in the sorted order are dropped before the
+        # softmax, so the cumulative mass is of the SURVIVORS only.
+        # (Positional drop vs the value-threshold top-k mask above can
+        # differ on exact ties at the kth value — ties stay in the
+        # final mask; their mass is just not counted toward p.)
+        idx = jnp.arange(v)[None, :]
+        sorted_k = jnp.where((top_ks[:, None] > 0) & (idx >= kk[:, None]),
+                             -1e30, sorted_l)
+        probs = jax.nn.softmax(sorted_k, axis=-1)
         csum = jnp.cumsum(probs, axis=-1)
         # keep tokens whose cumulative mass BEFORE them is < p (the
         # smallest prefix reaching p always includes its last member)
         keep = (csum - probs) < top_ps[:, None]
-        cut = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1)
+        cut = jnp.min(jnp.where(keep, sorted_k, jnp.inf), axis=-1)
         mask |= (top_ps[:, None] < 1.0) & (lf < cut[:, None])
         lf = jnp.where(mask, -1e30, lf)
     sampled = jax.vmap(
@@ -140,8 +156,8 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
                    donate_argnums=(2,))
-def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
-            n: int, rich: bool = False):
+def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
+            cfg, n: int, rich: bool = False):
     """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
     round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
     per token, the same fusion :func:`tpushare.serving.generate
@@ -158,6 +174,16 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
     steps past a finished slot write garbage K/V that is contained
     exactly like an inactive slot's (position p is overwritten at
     length==p before any query attends p, even across slot reuse).
+
+    ``incs`` [B] is each row's per-step length increment: 1 for rows
+    that were DECODING at chunk start, 0 for everything else (empty,
+    mid-prefill).  A frozen row garbage-writes the same position every
+    step instead of wandering pos..pos+n-1 — required for ROLLING
+    pools, where a wandering write at position q would wrap onto ring
+    slot q % W and clobber the still-attendable key of position q - W
+    in a mid-prefill row.  (A write at exactly pos is safe in both
+    layouts: ring slot pos % W holds position pos - W, attendable only
+    by queries < pos, all already computed.)
     """
     def body(carry, _):
         tok, caches, lengths, keys = carry
@@ -166,7 +192,7 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
             params, tok, cfg, kv_caches=caches, cache_len=lengths)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
-        return (nxt[:, None], caches, lengths + 1, ks[:, 0]), nxt
+        return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
 
     (_, caches, _, keys), toks = jax.lax.scan(
         body, (tokens, caches, lengths, keys), None, length=n)
@@ -198,7 +224,7 @@ class ContinuousBatcher:
     """
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
-                 mesh=None):
+                 mesh=None, rolling_slots: Optional[bool] = None):
         """``mesh``: optional ``jax.sharding.Mesh`` for tensor-parallel
         serving — params take the Megatron tp layout
         (:func:`tpushare.parallel.mesh.shard_params`) and KV storage
@@ -206,8 +232,21 @@ class ContinuousBatcher:
         pod's chips with XLA-inserted collectives.  Host-side control
         flow (slots, admission, sampling bookkeeping) is unchanged:
         sharding is a placement property of the device arrays, not a
-        code path."""
+        code path.
+
+        ``rolling_slots``: None (default) = AUTO — sliding-window
+        configs get a ROLLING W-sized slot pool (each slot's KV storage
+        is ``cfg.window`` entries instead of ``cfg.max_seq``:
+        max_seq/window× more slots per HBM byte, same outputs); full-
+        causal configs get max_seq rows.  Pass False to force max_seq
+        rows for a windowed config (the bit-identity reference)."""
         self.mesh = mesh
+        if rolling_slots is None:
+            rolling_slots = (cfg.window is not None
+                             and cfg.window < cfg.max_seq)
+        if rolling_slots and cfg.window is None:
+            raise ValueError("rolling_slots needs a sliding-window cfg")
+        self.rolling_slots = bool(rolling_slots)
         if mesh is not None:
             from ..parallel.mesh import shard_params
             params = shard_params(params, mesh)
@@ -222,10 +261,27 @@ class ContinuousBatcher:
 
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
-        self.caches = transformer.init_kv_caches(self.cfg, batch=self.n_slots)
+        self.caches = transformer.init_kv_caches(
+            self.cfg, batch=self.n_slots, rolling=self.rolling_slots)
         if self.mesh is not None:
             from ..parallel.mesh import shard_kv_storage
             self.caches = shard_kv_storage(self.caches, self.mesh)
+
+    def storage_info(self) -> dict:
+        """HBM accounting for the slot pool: what one slot costs and how
+        many slots a GiB of KV budget buys — the economics the rolling
+        pool changes (window-sized slots: max_seq/window× more slots
+        per byte for sliding-window models)."""
+        cfg = self.cfg
+        slot_tokens = (cfg.window if self.rolling_slots else cfg.max_seq)
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        bytes_per_slot = (2 * cfg.n_layers * cfg.n_kv_heads * slot_tokens
+                          * cfg.head_dim * itemsize)
+        return {"kind": "rolling" if self.rolling_slots else "dense",
+                "slot_tokens": int(slot_tokens),
+                "bytes_per_slot": int(bytes_per_slot),
+                "slots_per_gib": (2 ** 30) // bytes_per_slot,
+                "pool_bytes": int(bytes_per_slot * self.n_slots)}
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
         """Claim per-request storage; False = backpressure (no admit)."""
@@ -248,11 +304,11 @@ class ContinuousBatcher:
             tks, tps, self.cfg, rich)
         return nxt
 
-    def _step_n(self, tokens, lengths, temps, keys, tks, tps, rich,
+    def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int):
         toks, keys, self.caches = _tick_n(
             self.params, tokens, self.caches, lengths, temps, keys,
-            tks, tps, self.cfg, n_steps, rich)
+            tks, tps, incs, self.cfg, n_steps, rich)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -491,10 +547,18 @@ class ContinuousBatcher:
         if not self.slots:
             return 0
         tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
+        # rows decoding at chunk start advance one position per step;
+        # everything else (empty, mid-prefill) stays FROZEN at its
+        # aimed garbage position — see _tick_n on why rolling pools
+        # require this
+        incs = np.zeros((self.n_slots,), np.int32)
+        for i in self.slots:
+            incs[i] = 1
         toks, new_keys = self._step_n(
             jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
             jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
-            jnp.asarray(tks), jnp.asarray(tps), self._rich(), n_steps)
+            jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
+            self._rich(), n_steps)
         toks = np.asarray(toks)
         new_keys = np.asarray(jax.random.key_data(new_keys))
         n_active = len(self.slots)
@@ -524,6 +588,26 @@ class ContinuousBatcher:
                 # would have walked
                 s.key = jax.random.wrap_key_data(jnp.asarray(new_keys[i]))
         return n_active
+
+    def cancel(self, rid: int) -> bool:
+        """Release request ``rid`` wherever it lives — decoding slot,
+        mid-prefill, or the completed buffer — freeing its slot/storage
+        immediately.  Returns False when unknown (already drained or
+        never admitted).  Owner-thread only, like every batcher method:
+        the service loop calls this for abandoned streams so a client
+        that disconnected mid-stream does not keep decoding to
+        completion in a slot someone else could use."""
+        for i, s in list(self.slots.items()):
+            if s.request_id == rid:
+                self._release(i)
+                del self.slots[i]
+                return True
+        for i, p in list(self.prefilling.items()):
+            if p.request_id == rid:
+                self._release(i)
+                del self.prefilling[i]
+                return True
+        return self.completed.pop(rid, None) is not None
 
     def run_until_drained(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):
@@ -594,11 +678,19 @@ class ContinuousService:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink)
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete)
+        # cancel(sink) handoff: the loop drains this each iteration and
+        # releases the matching request wherever it is (waiting queue,
+        # prefilling, decoding, or completed-but-undelivered)
+        self._cancels: List[object] = []
         self._sinks: Dict[int, "object"] = {}   # loop-thread private
-        # streaming requests: rid -> [sink, tokens_already_pushed].
-        # Deltas are pushed after every loop iteration; the terminal item
-        # is ("done", full_output) or ("aborted", None) on shutdown.
+        # streaming requests: rid -> [sink, tokens_already_pushed,
+        # on_complete].  Deltas are pushed after every loop iteration;
+        # the terminal item is ("done", full_output) or
+        # ("aborted", None) on shutdown.  on_complete (or None) fires on
+        # the LOOP thread when the batcher finishes the request — stats
+        # accounting lives there, not in the consumer, so an abandoned
+        # stream still counts (see llm.py /generate_stream).
         self._stream_sinks: Dict[int, list] = {}   # loop-thread private
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpushare-continuous")
@@ -616,7 +708,8 @@ class ContinuousService:
         # blocking on a full maxsize-1 sink could deadlock stop().
         with self._lock:
             waiting, self._waiting = self._waiting, []
-        for *_, stream, sink in waiting:
+        for item in waiting:
+            stream, sink = item[7], item[8]
             try:
                 sink.put_nowait(("aborted", None) if stream else None)
             except self._q.Full:
@@ -637,21 +730,29 @@ class ContinuousService:
             except self._q.Full:
                 pass
         self._sinks.clear()
-        for sink, _ in self._stream_sinks.values():
-            sink.put_nowait(("aborted", None))
+        for entry in self._stream_sinks.values():
+            entry[0].put_nowait(("aborted", None))
         self._stream_sinks.clear()
 
     def submit_stream(self, prompt: List[int], max_new_tokens: int,
                       temperature: float = 0.0, seed: int = 0,
                       eos_id: Optional[int] = None,
-                      top_k: int = 0, top_p: float = 1.0):
+                      top_k: int = 0, top_p: float = 1.0,
+                      on_complete=None):
         """Streaming submit: the returned queue yields ``("delta",
         [new generated tokens])`` items as decoding progresses (chunk
         granularity under fused decode), then ``("done", full_output)``
         — or ``("aborted", None)`` on shutdown.  Same admission
-        contract and exact same token streams as :meth:`submit`."""
+        contract and exact same token streams as :meth:`submit`.
+
+        ``on_complete(full_output)`` (optional) fires on the service
+        loop thread when the batcher FINISHES the request — before the
+        "done" item is consumed, and regardless of whether the stream
+        consumer is still there.  Keep it cheap (it runs inside the
+        decode loop); exceptions are swallowed with a log line."""
         return self._submit(prompt, max_new_tokens, temperature, seed,
-                            eos_id, top_k, top_p, stream=True)
+                            eos_id, top_k, top_p, stream=True,
+                            on_complete=on_complete)
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
@@ -666,7 +767,7 @@ class ContinuousService:
                             eos_id, top_k, top_p, stream=False)
 
     def _submit(self, prompt, max_new_tokens, temperature, seed, eos_id,
-                top_k, top_p, stream: bool):
+                top_k, top_p, stream: bool, on_complete=None):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
         # streaming sinks are unbounded (many deltas); final-only sinks
@@ -675,9 +776,41 @@ class ContinuousService:
         with self._lock:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
-                 top_k, top_p, stream, sink))
+                 top_k, top_p, stream, sink, on_complete))
         self._work.set()
         return sink
+
+    def cancel(self, sink) -> None:
+        """Abandon the request behind ``sink`` (the queue a submit
+        returned): if still waiting it is dropped; if admitted, its
+        slot and storage are released on the loop's next iteration
+        (≤ one decode chunk away).  Callable from any thread; idempotent
+        and a no-op for already-delivered requests.  The sink receives
+        no further items — the canceller, by definition, is not
+        listening."""
+        with self._lock:
+            self._cancels.append(sink)
+        self._work.set()
+
+    def _drain_cancels(self) -> None:
+        """Loop-thread half of :meth:`cancel`."""
+        with self._lock:
+            cancels, self._cancels = self._cancels, []
+            for sink in cancels:
+                self._waiting = [item for item in self._waiting
+                                 if item[8] is not sink]
+        for sink in cancels:
+            for rid, entry in list(self._stream_sinks.items()):
+                if entry[0] is sink:
+                    self._batcher.cancel(rid)
+                    del self._stream_sinks[rid]
+                    break
+            else:
+                for rid, s in list(self._sinks.items()):
+                    if s is sink:
+                        self._batcher.cancel(rid)
+                        del self._sinks[rid]
+                        break
 
     def snapshot(self) -> dict:
         """Occupancy for observability: {slots, active, prefilling,
@@ -698,6 +831,7 @@ class ContinuousService:
         while not self._halt.is_set():
             if not self._work.wait(timeout=0.5):
                 continue   # stay asleep while idle; submit() re-sets it
+            self._drain_cancels()
             # Take the waiting handoff under the lock, then decode without
             # it — admission and ticks only touch loop-owned state.
             while self._batcher.free_slots():
@@ -706,7 +840,7 @@ class ContinuousService:
                         break
                     item = self._waiting.pop(0)
                 (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
-                 sink) = item
+                 sink, on_cb) = item
                 rid = self._batcher.admit_chunked(
                     prompt, max_new, temperature=temp, seed=seed,
                     chunk=self._prefill_chunk, eos_id=eos_id,
@@ -723,7 +857,7 @@ class ContinuousService:
                 # 1-token request finishes in advance_prefill); results
                 # are delivered by the post-tick completed drain below
                 if stream:
-                    self._stream_sinks[rid] = [sink, len(prompt)]
+                    self._stream_sinks[rid] = [sink, len(prompt), on_cb]
                 else:
                     self._sinks[rid] = sink
             if self._batcher.prefilling:
@@ -746,7 +880,7 @@ class ContinuousService:
                 by_rid = {s.request_id: s
                           for s in self._batcher.slots.values()}
                 for rid, entry in list(self._stream_sinks.items()):
-                    sink, pushed = entry
+                    sink, pushed = entry[0], entry[1]
                     out = None
                     s = by_rid.get(rid)
                     if s is not None:
@@ -763,8 +897,14 @@ class ContinuousService:
                     continue
                 entry = self._stream_sinks.pop(rid, None)
                 if entry is not None:
-                    entry[0].put(("done",
-                                  self._batcher.completed.pop(rid)))
+                    out = self._batcher.completed.pop(rid)
+                    if entry[2] is not None:
+                        try:
+                            entry[2](out)
+                        except Exception:
+                            log.exception("stream on_complete callback "
+                                          "raised; continuing")
+                    entry[0].put(("done", out))
             with self._lock:
                 if (not active and not self._batcher.prefilling
                         and not self._waiting and not self._sinks

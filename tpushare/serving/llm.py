@@ -40,6 +40,9 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
             vocab=32000, d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
             d_ff=1408, max_seq=512),
         "tiny": transformer.tiny,
+        # sliding-window tiny: serves through the ROLLING slot pool
+        # (window-sized KV slots; transformer.ModelConfig.window)
+        "tiny-window": lambda: transformer.tiny(max_seq=128, window=16),
     }
     if model_name not in cfgs:
         raise ValueError(f"unknown model {model_name!r} "
@@ -347,30 +350,49 @@ class LLMServer:
         if len(row) + max_new > self.cfg.max_seq:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
+        # Stats are accounted when the BATCHER completes the request (on
+        # the service loop thread), not when the client consumes the
+        # stream to "done" — a disconnected client's request still ran
+        # and must still count in /stats.
+        def on_complete(out):
+            with self._gen_lock:
+                self.requests_served += 1
+                self.sequences_served += 1
+                self.tokens_generated += len(out) - len(row)
+
         sink = self._service.submit_stream(
             row, max_new, temperature=temperature, seed=seed,
-            eos_id=eos_id, top_k=top_k, top_p=top_p)
+            eos_id=eos_id, top_k=top_k, top_p=top_p,
+            on_complete=on_complete)
         import queue as _q
 
         def chunks():
-            while True:
-                try:
-                    kind, val = sink.get(timeout=600)
-                except _q.Empty:
-                    yield (json.dumps({"Error": "timeout"}) + "\n").encode()
-                    return
-                if kind == "delta":
-                    yield (json.dumps({"delta": val}) + "\n").encode()
-                elif kind == "done":
-                    with self._gen_lock:
-                        self.requests_served += 1
-                        self.sequences_served += 1
-                        self.tokens_generated += len(val) - len(row)
-                    yield (json.dumps({"done": val}) + "\n").encode()
-                    return
-                else:
-                    yield (json.dumps({"Error": "aborted"}) + "\n").encode()
-                    return
+            finished = False
+            try:
+                while True:
+                    try:
+                        kind, val = sink.get(timeout=600)
+                    except _q.Empty:
+                        yield (json.dumps({"Error": "timeout"})
+                               + "\n").encode()
+                        return
+                    if kind == "delta":
+                        yield (json.dumps({"delta": val}) + "\n").encode()
+                    elif kind == "done":
+                        finished = True
+                        yield (json.dumps({"done": val}) + "\n").encode()
+                        return
+                    else:
+                        finished = True   # service shutdown; nothing left
+                        yield (json.dumps({"Error": "aborted"})
+                               + "\n").encode()
+                        return
+            finally:
+                # Abandoned stream (client disconnect -> the server
+                # closes this generator, or the sink timed out): release
+                # the slot instead of decoding to completion for nobody.
+                if not finished:
+                    self._service.cancel(sink)
 
         return 200, StreamingBody(chunks())
 

@@ -116,7 +116,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
                         else n_slots * self.pages_per_slot + 1)
         if self.n_pages < 2:
             raise ValueError("need at least one non-trash page")
-        super().__init__(params, cfg, n_slots, mesh=mesh)
+        # paged storage is position-indexed (no ring wraparound); the
+        # rolling-slot layout is a dense-pool concern
+        super().__init__(params, cfg, n_slots, mesh=mesh,
+                         rolling_slots=False)
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -126,6 +129,18 @@ class PagedContinuousBatcher(ContinuousBatcher):
             raise ValueError(
                 f"request needs {need} pages but the pool holds only "
                 f"{self.n_pages - 1} usable pages")
+
+    def storage_info(self) -> dict:
+        """HBM accounting for the page pool (vs the base class's
+        per-slot rows): persistent KV cost is pages, not slots."""
+        cfg = self.cfg
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        bytes_per_page = (2 * cfg.n_layers * cfg.n_kv_heads
+                          * self.page_size * cfg.head_dim * itemsize)
+        return {"kind": "paged", "page_tokens": self.page_size,
+                "bytes_per_page": int(bytes_per_page),
+                "n_pages": self.n_pages,
+                "pool_bytes": int(bytes_per_page * self.n_pages)}
 
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
@@ -165,8 +180,13 @@ class PagedContinuousBatcher(ContinuousBatcher):
             lengths, temps, keys, tks, tps, self.cfg, rich)
         return nxt
 
-    def _step_n(self, tokens, lengths, temps, keys, tks, tps, rich,
+    def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int):
+        # incs is the dense ROLLING pool's wander freeze; paged garbage
+        # writes are contained by the trash page / overwrite-before-
+        # attendable argument, so the paged scan keeps advancing all rows
+        # (bit-exact with its committed behavior).
+        del incs
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, self.cfg, n_steps, rich)

@@ -57,6 +57,13 @@ class JsonHTTPServer:
                             self.wfile.flush()
                     except (BrokenPipeError, ConnectionResetError):
                         pass            # client went away mid-stream
+                    finally:
+                        # Deterministically close the generator so its
+                        # finally-cleanup (e.g. the LLM server's
+                        # cancel-on-disconnect) runs NOW, not at gc.
+                        close = getattr(payload.chunks, "close", None)
+                        if close is not None:
+                            close()
                     self.close_connection = True
                     return
                 if isinstance(payload, str):
