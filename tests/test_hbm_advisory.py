@@ -1,0 +1,141 @@
+"""Advisory-HBM visibility loop (COTENANCY_r04 finding, consumed):
+
+fraction caps are ADVISORY on some backends — tenants reach full-chip
+ceilings.  The repo now ACTS on that: the workload runtime verifies
+enforcement and warns (contract.verify_budget), reports observed peaks
+to the daemon (contract.report_usage -> POST /usage), the daemon
+exports grant-vs-peak per tenant in /metrics and mirrors the reports
+onto the node annotation, and the inspect CLI renders an OVER flag.
+Reference posture: podmanager.go:59-72 (isolation is an env contract).
+"""
+
+import json
+import logging
+import urllib.request
+
+from tpushare.inspect import display, nodeinfo
+from tpushare.plugin import const, status
+from tpushare.plugin.status import StatusServer
+from tpushare.runtime import contract
+
+GIB = 2 ** 30
+
+# env contract for a 0.25 grant on a 16-GiB chip (units=16 -> GiB)
+ENV = {
+    "TPU_VISIBLE_CHIPS": "0",
+    "XLA_PYTHON_CLIENT_MEM_FRACTION": "0.250000",
+    "ALIYUN_COM_TPU_MEM_IDX": "0",
+    "ALIYUN_COM_TPU_MEM_POD": "4",
+    "ALIYUN_COM_TPU_MEM_CONTAINER": "4",
+    "ALIYUN_COM_TPU_MEM_DEV": "16",
+    "HOSTNAME": "tenant-a",
+}
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_verify_budget_flags_advisory_backend(caplog):
+    # backend ignores the fraction: process limit == full chip
+    dev = FakeDevice({"bytes_limit": 16 * GIB, "peak_bytes_in_use": GIB})
+    with caplog.at_level(logging.WARNING, logger="tpushare.runtime"):
+        rec = contract.verify_budget(device=dev, env=ENV)
+    assert rec == {"enforced": False, "grant_bytes": 4 * GIB,
+                   "limit_bytes": 16 * GIB}
+    assert any("ADVISORY" in r.message for r in caplog.records)
+
+
+def test_verify_budget_accepts_enforcing_backend(caplog):
+    dev = FakeDevice({"bytes_limit": 4 * GIB})
+    with caplog.at_level(logging.WARNING, logger="tpushare.runtime"):
+        rec = contract.verify_budget(device=dev, env=ENV)
+    assert rec["enforced"] is True
+    assert not any("ADVISORY" in r.message for r in caplog.records)
+
+
+def test_verify_budget_none_when_not_fractional():
+    env = dict(ENV)
+    env["XLA_PYTHON_CLIENT_MEM_FRACTION"] = "1.000000"
+    assert contract.verify_budget(device=FakeDevice({}), env=env) is None
+
+
+def test_usage_report_roundtrip_metrics_and_inspect():
+    """Tenant exceeding its grant -> visible in daemon /metrics AND the
+    inspect CLI (via the node-annotation mirror)."""
+    seen = {}
+    srv = StatusServer(0, on_usage=lambda reports: seen.update(reports))
+    srv.start()
+    try:
+        env = dict(ENV)
+        env[const.ENV_STATUS_PORT] = str(srv.port)
+        before = status.counters()["tpushare_hbm_overshoot_total"]
+        # peak 6 GiB against a 4 GiB grant: OVER
+        dev = FakeDevice({"bytes_limit": 16 * GIB,
+                          "peak_bytes_in_use": 6 * GIB})
+        assert contract.report_usage(device=dev, env=env)
+        assert status.counters()["tpushare_hbm_overshoot_total"] \
+            == before + 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert ('tpushare_tenant_hbm_grant_bytes{pod="tenant-a",'
+                'over_grant="true"}') in body
+        assert f"{6 * GIB}" in body
+        # a well-behaved tenant reports ok
+        dev2 = FakeDevice({"bytes_limit": 16 * GIB,
+                           "peak_bytes_in_use": 2 * GIB})
+        assert contract.report_usage(device=dev2, env=env, pod="tenant-b")
+        assert status.counters()["tpushare_hbm_overshoot_total"] \
+            == before + 1                      # no new overshoot
+        # on_usage saw both (this is what main.py mirrors to the node)
+        assert set(seen) == {"tenant-a", "tenant-b"}
+    finally:
+        srv.stop()
+
+    # inspect side: node annotation -> OVER flag in the details render
+    node = {
+        "metadata": {"name": "n1",
+                     "annotations": {const.ANN_USAGE_REPORT:
+                                     json.dumps(seen)}},
+        "status": {"allocatable": {const.RESOURCE_NAME: "16",
+                                   const.COUNT_NAME: "1"},
+                   "addresses": [{"type": "InternalIP",
+                                  "address": "10.0.0.1"}]},
+    }
+    infos = nodeinfo.build_node_infos([node], [])
+    reports = infos[0].usage_reports()
+    assert reports["tenant-a"]["peak_bytes"] == 6 * GIB
+    out = display.render_details(infos)
+    assert "HBM usage (reported):" in out
+    assert "OVER" in out and "tenant-a" in out
+    # tenant-b within budget
+    row_b = [ln for ln in out.splitlines() if "tenant-b" in ln][0]
+    assert "ok" in row_b
+
+
+def test_report_usage_noop_without_contract():
+    assert contract.report_usage(device=FakeDevice({}), env={}) is False
+
+
+def test_allocate_injects_status_port(tmp_path):
+    from tpushare.plugin import discovery
+    from tpushare.plugin.allocate import container_response
+    from tpushare.plugin.server import TpuDevicePlugin
+
+    backend = discovery.FakeBackend(n_chips=1, generation="v5e")
+    backend.init()
+    plugin = TpuDevicePlugin(backend,
+                             socket_path=str(tmp_path / "s.sock"),
+                             kubelet_socket=str(tmp_path / "k.sock"))
+    chip = plugin.chips[0]
+    plugin.status_port = 9406
+    resp = container_response(plugin, chip, 2, 2)
+    assert resp.envs[const.ENV_STATUS_PORT] == "9406"
+    plugin.status_port = None
+    resp = container_response(plugin, chip, 2, 2)
+    assert const.ENV_STATUS_PORT not in resp.envs

@@ -105,6 +105,27 @@ def render_details(infos: List[NodeInfo]) -> str:
                 rows.append(row)
         out.write(_table(rows))
 
+        reports = info.usage_reports()
+        if reports:
+            # grant vs OBSERVED peak per tenant (reported by the
+            # workload runtime via the daemon's /usage): on backends
+            # where the HBM fraction is advisory, OVER here is the
+            # operator's isolation signal
+            urows = [["POD", "CHIP", "GRANT(GiB)", "PEAK(GiB)", "HBM"]]
+            for pod_name in sorted(reports):
+                r = reports[pod_name]
+                grant, peak = r.get("grant_bytes"), r.get("peak_bytes")
+                state = "?"
+                if grant and peak:
+                    state = "OVER" if peak > grant else "ok"
+                urows.append([
+                    pod_name, str(r.get("chip", "?")),
+                    f"{grant / 2**30:.2f}" if grant else "?",
+                    f"{peak / 2**30:.2f}" if peak else "?",
+                    state])
+            out.write("\nHBM usage (reported):\n")
+            out.write(_table(urows))
+
         pct = int(used_node / info.total_mem * 100) if info.total_mem else 0
         out.write(f"Allocated : {used_node} ({pct}%)\n")
         out.write(f"Total :     {info.total_mem}\n")

@@ -68,6 +68,20 @@ class NodeInfo:
     def has_pending(self) -> bool:
         return PENDING_IDX in self.devs
 
+    def usage_reports(self) -> Dict[str, dict]:
+        """Per-tenant HBM usage reports the node daemon mirrored into
+        the node annotation (grant vs observed peak — the operator's
+        view of advisory isolation; see plugin/status.py /usage)."""
+        raw = (self.node.get("metadata", {}).get("annotations", {})
+               or {}).get(const.ANN_USAGE_REPORT)
+        if not raw:
+            return {}
+        try:
+            data = json.loads(raw)
+            return data if isinstance(data, dict) else {}
+        except (ValueError, TypeError):
+            return {}
+
 
 def node_total_mem(node: dict, resource: str = const.RESOURCE_NAME) -> int:
     alloc = node.get("status", {}).get("allocatable", {})

@@ -204,6 +204,16 @@ class KubeClient:
             body={"metadata": {"labels": labels}},
             content_type="application/strategic-merge-patch+json")
 
+    def patch_node_annotations(self, name: str,
+                               annotations: Dict[str, str]) -> dict:
+        """Merge-patch metadata.annotations (same contract as
+        :meth:`patch_node_labels`) — carries the per-tenant HBM usage
+        report for the inspect CLI."""
+        return self._request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            body={"metadata": {"annotations": annotations}},
+            content_type="application/strategic-merge-patch+json")
+
     def patch_node_status(self, name: str, capacity: Dict[str, str]) -> dict:
         body = {"status": {"capacity": capacity, "allocatable": capacity}}
         return self._request(

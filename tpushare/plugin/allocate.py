@@ -130,6 +130,12 @@ def container_response(plugin, chip: Chip, container_units: int,
         # preallocation so tenants fail on their own overuse, not on a
         # boot-time reservation race (SURVEY hard part 4).
         envs["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
+    status_port = getattr(plugin, "status_port", None)
+    if status_port:
+        # lets the workload runtime report observed HBM peaks to the
+        # daemon's /usage — operator visibility for advisory-isolation
+        # backends (COTENANCY_r04; reference posture podmanager.go:59-72)
+        envs[const.ENV_STATUS_PORT] = str(status_port)
     if isolation_disabled:
         envs[const.ENV_ISOLATION_DISABLE] = "true"
 

@@ -60,6 +60,19 @@ ENV_TPU_MEM_DEV = "ALIYUN_COM_TPU_MEM_DEV"
 # podmanager.go:59-72, allocate.go:124-126, const.go:32):
 ENV_ISOLATION_DISABLE = "TPUSHARE_DISABLE_ISOLATION"
 LABEL_ISOLATION_DISABLE = "tpushare.disable.isolation"
+# Where this node's daemon serves /usage — injected into allocated
+# containers so the workload runtime (tpushare.runtime.contract) can
+# report observed HBM peaks back for operator visibility.  HBM fraction
+# caps are ADVISORY on some backends (COTENANCY_r04: every 0.22-grant
+# tenant reached the full-chip ceiling, matching the reference's
+# posture, podmanager.go:59-72) — the report loop is how operators SEE
+# a tenant exceeding its grant.
+ENV_STATUS_PORT = "TPUSHARE_STATUS_PORT"
+ENV_STATUS_HOST = "TPUSHARE_STATUS_HOST"   # default 127.0.0.1 (hostNetwork)
+# Node annotation carrying the latest per-tenant usage reports (JSON:
+# {pod: {chip, grant_bytes, peak_bytes, limit_bytes, enforced}}), so
+# the inspect CLI can show grant-vs-observed cluster-wide.
+ANN_USAGE_REPORT = "tpushare.aliyun.com/usage-report"
 
 # --- multi-host slice topology labels --------------------------------------
 # One daemon per worker host of a pod slice advertises its local chips;

@@ -152,14 +152,43 @@ def main(argv=None) -> int:
         socket_path=args.socket,
         kubelet_socket=args.kubelet_socket,
         health_check=args.health_check,
-        on_chips_ready=on_chips_ready)
+        on_chips_ready=on_chips_ready,
+        status_port=args.status_port or None)
     mgr.install_signal_handlers()
     status_srv = None
     if args.status_port:
         from .status import StatusServer
+
+        on_usage = None
+        if not args.standalone:
+            import json as _json
+            import time as _time
+
+            last = {"payload": None, "t": 0.0}
+
+            def on_usage(reports, _pm=pm, _node=node_name):
+                # mirror the latest usage reports onto the node object
+                # so the inspect CLI shows grant-vs-observed cluster-
+                # wide (non-fatal: metrics still carry the data).
+                # Debounced: identical payloads are skipped and writes
+                # are rate-limited, so periodic per-tenant reports don't
+                # amplify into a steady node-PATCH stream.
+                payload = _json.dumps(reports, sort_keys=True)
+                now = _time.monotonic()
+                if (payload == last["payload"]
+                        or now - last["t"] < 10.0):
+                    return
+                try:
+                    _pm.kube.patch_node_annotations(
+                        _node, {const.ANN_USAGE_REPORT: payload})
+                    last["payload"], last["t"] = payload, now
+                except Exception:
+                    log.debug("usage annotation patch failed",
+                              exc_info=True)
         status_srv = StatusServer(args.status_port,
                                   plugin_ref=lambda: mgr.plugin,
-                                  addr=args.status_addr).start()
+                                  addr=args.status_addr,
+                                  on_usage=on_usage).start()
         log.info("status endpoint on :%d", status_srv.port)
     try:
         mgr.run()

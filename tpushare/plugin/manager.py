@@ -76,7 +76,8 @@ class SharedTPUManager:
                  health_check: bool = True,
                  wait_forever_without_chips: bool = True,
                  watcher_interval: float = 1.0,
-                 on_chips_ready: Optional[Callable[[list], None]] = None):
+                 on_chips_ready: Optional[Callable[[list], None]] = None,
+                 status_port: Optional[int] = None):
         self.backend = backend
         self.allocator_factory = allocator_factory
         self.memory_unit = memory_unit
@@ -90,6 +91,9 @@ class SharedTPUManager:
         # the node-capacity patch hooks in here so it never reads an
         # uninitialized backend.
         self.on_chips_ready = on_chips_ready
+        # Advertised to allocated containers (ENV_STATUS_PORT) so their
+        # runtime can report observed HBM peaks to /usage.
+        self.status_port = status_port
 
         self.plugin: Optional[TpuDevicePlugin] = None
         self._restart = threading.Event()
@@ -148,6 +152,7 @@ class SharedTPUManager:
                 resource_name=self.resource_name,
                 socket_path=self.socket_path,
                 kubelet_socket=self.kubelet_socket)
+            plugin.status_port = self.status_port
             if self.allocator_factory is not None:
                 plugin.allocator = self.allocator_factory(plugin)
             self.plugin = plugin

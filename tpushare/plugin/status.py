@@ -12,6 +12,7 @@ hostNetwork, so node-wide exposure must be an explicit choice.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..utils import stackdump
 from ..utils.httpserver import JsonHTTPServer
@@ -20,6 +21,9 @@ _COUNTERS = {
     "tpushare_allocations_total": 0,
     "tpushare_allocation_failures_total": 0,
     "tpushare_restarts_total": 0,
+    # tenants whose reported HBM peak exceeded their grant (advisory-
+    # isolation visibility; see /usage)
+    "tpushare_hbm_overshoot_total": 0,
 }
 _LOCK = threading.Lock()
 
@@ -35,14 +39,83 @@ def counters() -> dict:
 
 
 class StatusServer:
-    def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1"):
+    def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1",
+                 on_usage=None):
         self.plugin_ref = plugin_ref   # callable returning current plugin
+        # latest usage report per tenant pod: the workload runtime
+        # (tpushare.runtime.contract.report_usage) POSTs observed HBM
+        # peaks here, because fraction caps are ADVISORY on some
+        # backends (COTENANCY_r04) and the daemon cannot see inside
+        # tenant processes.  on_usage(reports) fires after each ingest
+        # (main.py wires it to a node-annotation patch for inspect).
+        self.usage_reports: dict = {}
+        self.on_usage = on_usage
+        # Reports age out (tenant pods churn; the daemon never learns of
+        # deletions through this channel) and are capped so label
+        # cardinality in /metrics and the node-annotation payload stay
+        # bounded (k8s caps total annotations at 256 KiB).
+        self.usage_ttl_s = 900.0
+        self.usage_max = 64
         self._http = JsonHTTPServer(port, addr, routes={
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
             ("GET", "/metrics"): lambda _: (200, self.render_metrics()),
             ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
+            ("POST", "/usage"): self._ingest_usage,
         })
         self.port = self._http.port
+
+    def _ingest_usage(self, body):
+        if not isinstance(body, dict) or not body.get("pod"):
+            return 400, {"Error": "body must be a JSON object with 'pod'"}
+
+        def _num(key):
+            # tenant-supplied: coerce-or-drop BEFORE storing, so one
+            # malformed report can never poison /metrics or the
+            # annotation mirror (a str here would TypeError every
+            # later render)
+            v = body.get(key)
+            try:
+                return int(v) if v is not None else None
+            except (TypeError, ValueError):
+                return None
+
+        rec = {"pod": str(body["pod"])[:253],      # k8s name length cap
+               "chip": _num("chip"),
+               "grant_bytes": _num("grant_bytes"),
+               "peak_bytes": _num("peak_bytes"),
+               "limit_bytes": _num("limit_bytes"),
+               "enforced": (bool(body["enforced"])
+                            if isinstance(body.get("enforced"), bool)
+                            else None),
+               "ts": time.time()}
+        with _LOCK:
+            self.usage_reports[rec["pod"]] = rec
+            self._evict_locked()
+            reports = {p: {k: v for k, v in r.items() if k != "ts"}
+                       for p, r in self.usage_reports.items()}
+        grant, peak = rec.get("grant_bytes"), rec.get("peak_bytes")
+        if grant and peak and peak > grant:
+            inc("tpushare_hbm_overshoot_total")
+        if self.on_usage is not None:
+            try:
+                self.on_usage(reports)
+            except Exception:
+                import logging
+                logging.getLogger("tpushare.status").exception(
+                    "on_usage hook failed (non-fatal)")
+        return 200, {"ok": True}
+
+    def _evict_locked(self) -> None:
+        """Drop expired / excess usage reports (callers hold _LOCK)."""
+        now = time.time()
+        stale = [p for p, r in self.usage_reports.items()
+                 if now - r.get("ts", now) > self.usage_ttl_s]
+        for p in stale:
+            del self.usage_reports[p]
+        while len(self.usage_reports) > self.usage_max:
+            oldest = min(self.usage_reports,
+                         key=lambda p: self.usage_reports[p].get("ts", 0))
+            del self.usage_reports[oldest]
 
     def render_metrics(self) -> str:
         from . import const
@@ -60,6 +133,33 @@ class StatusServer:
                 f'tpushare_devices{{state="unhealthy"}} {len(devs) - healthy}')
             lines.append("# TYPE tpushare_chips gauge")
             lines.append(f"tpushare_chips {len(plugin.chips)}")
+        with _LOCK:
+            self._evict_locked()
+            reports = list(self.usage_reports.values())
+        if reports:
+            # grant vs OBSERVED per tenant: on advisory-isolation
+            # backends this is the only place an operator sees a
+            # co-tenant exceeding its HBM grant
+            lines.append("# TYPE tpushare_tenant_hbm_grant_bytes gauge")
+            lines.append("# TYPE tpushare_tenant_hbm_peak_bytes gauge")
+            for r in reports:
+                # exposition-format label escaping — the pod name is
+                # tenant-supplied, so \ , " and newlines must not be
+                # able to break or inject metric lines
+                pod = (str(r.get("pod", "?"))
+                       .replace("\\", r"\\").replace('"', r"\"")
+                       .replace("\n", r"\n").replace("\r", ""))
+                over = (r.get("grant_bytes") and r.get("peak_bytes")
+                        and r["peak_bytes"] > r["grant_bytes"])
+                tag = f'pod="{pod}",over_grant="{"true" if over else "false"}"'
+                if r.get("grant_bytes") is not None:
+                    lines.append(
+                        f'tpushare_tenant_hbm_grant_bytes{{{tag}}} '
+                        f'{r["grant_bytes"]}')
+                if r.get("peak_bytes") is not None:
+                    lines.append(
+                        f'tpushare_tenant_hbm_peak_bytes{{{tag}}} '
+                        f'{r["peak_bytes"]}')
         return "\n".join(lines) + "\n"
 
     def start(self) -> "StatusServer":

@@ -32,6 +32,8 @@ _COTENANTS = "TPUSHARE_COTENANTS"
 _CORES = "TPUSHARE_CHIP_CORES"
 _EXCLUSIVE = "TPUSHARE_CORE_EXCLUSIVE"
 _VISIBLE_CORE = "TPUSHARE_VISIBLE_CORE"
+_STATUS_PORT = "TPUSHARE_STATUS_PORT"
+_STATUS_HOST = "TPUSHARE_STATUS_HOST"
 _FAILURE_PREFIX = "no-tpu-has-"
 
 
@@ -132,3 +134,132 @@ def apply_memory_budget(env: Optional[dict] = None) -> None:
         e.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
         log.info("tpushare budget: chip %s, %.0f%% of HBM",
                  view.chip_index, view.hbm_fraction * 100)
+
+
+def chip_capacity_bytes(view: AllocationView) -> Optional[int]:
+    """Chip HBM in bytes from the bookkeeping envs.  The unit follows
+    the cluster heuristic the inspect CLI uses (nodeinfo.go:227-243):
+    per-chip counts above 100 are MiB, else GiB."""
+    if not view.chip_units or view.chip_units <= 0:
+        return None
+    unit = 2 ** 20 if view.chip_units > 100 else 2 ** 30
+    return view.chip_units * unit
+
+
+def verify_budget(device=None, env: Optional[dict] = None,
+                  slack: float = 0.05, warn: bool = True) -> Optional[dict]:
+    """Does the backend actually ENFORCE the granted HBM fraction?
+
+    ``XLA_PYTHON_CLIENT_MEM_FRACTION`` is ADVISORY on some backends:
+    COTENANCY_r04 measured every 0.22-grant tenant reaching the
+    full-chip allocation ceiling (the reference shares this posture —
+    its isolation is an env contract too, podmanager.go:59-72).  This
+    check makes that visible to the tenant itself: call it AFTER
+    importing jax; it compares the process's real allocator limit
+    (``device.memory_stats()['bytes_limit']``) against the grant and
+    logs a WARNING when the backend will not stop this process from
+    exceeding its share.
+
+    Returns ``{"enforced", "grant_bytes", "limit_bytes"}`` or None when
+    not fractionally allocated / the backend exposes no stats.
+    """
+    view = current_allocation(env)
+    if not (view.allocated and view.hbm_fraction
+            and view.hbm_fraction < 1.0):
+        return None
+    if device is None:
+        try:
+            import jax
+            device = jax.local_devices()[0]
+        except Exception:
+            return None
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        return None
+    limit = stats.get("bytes_limit")
+    total = chip_capacity_bytes(view)
+    if not limit or not total:
+        return None
+    grant = int(view.hbm_fraction * total)
+    enforced = limit <= grant * (1 + slack)
+    if not enforced and warn:
+        log.warning(
+            "tpushare: HBM fraction %.6f is ADVISORY on this backend — "
+            "granted %.2f GiB but the allocator limit is %.2f GiB; "
+            "isolation relies on tenants respecting their budget "
+            "(report_usage() gives the operator visibility)",
+            view.hbm_fraction, grant / 2 ** 30, limit / 2 ** 30)
+    return {"enforced": enforced, "grant_bytes": grant,
+            "limit_bytes": int(limit)}
+
+
+def report_usage(device=None, env: Optional[dict] = None,
+                 peak_bytes: Optional[int] = None,
+                 pod: Optional[str] = None,
+                 timeout: float = 2.0) -> bool:
+    """POST this tenant's observed HBM peak to the node daemon's
+    ``/usage`` endpoint (the other half of :func:`verify_budget`: on an
+    advisory backend only the tenant can see its own usage, so it
+    reports — the daemon exports grant-vs-peak per pod in /metrics and
+    annotates the node for the inspect CLI).  Address comes from the
+    injected ``TPUSHARE_STATUS_PORT`` (+ optional ``_HOST``, default
+    loopback — the daemon runs hostNetwork).  Best-effort: returns
+    False, never raises, when unallocated or the daemon is unreachable.
+    """
+    import json as _json
+    import urllib.request
+
+    e = env if env is not None else os.environ
+    view = current_allocation(e)
+    port = e.get(_STATUS_PORT)
+    if not port or not view.allocated:
+        return False
+    if device is None and peak_bytes is None:
+        try:
+            import jax
+            device = jax.local_devices()[0]
+        except Exception:
+            return False
+    stats = {}
+    if device is not None:
+        try:
+            stats = device.memory_stats() or {}
+        except Exception:
+            stats = {}
+    if peak_bytes is None:
+        peak_bytes = stats.get("peak_bytes_in_use",
+                               stats.get("bytes_in_use"))
+    if peak_bytes is None:
+        return False
+    # one enforcement definition: reuse verify_budget (quietly — the
+    # caller already got its warning) rather than re-deriving the
+    # grant/limit comparison here
+    ver = (verify_budget(device=device, env=e, warn=False)
+           if device is not None else None)
+    if ver is not None:
+        grant, limit, enforced = (ver["grant_bytes"], ver["limit_bytes"],
+                                  ver["enforced"])
+    else:
+        total = chip_capacity_bytes(view)
+        grant = (int(view.hbm_fraction * total)
+                 if (total and view.hbm_fraction) else None)
+        limit, enforced = stats.get("bytes_limit"), None
+    body = {"pod": pod or e.get("HOSTNAME", "unknown"),
+            "chip": view.chip_index,
+            "grant_bytes": grant,
+            "peak_bytes": int(peak_bytes),
+            "limit_bytes": limit,
+            "enforced": enforced}
+    host = e.get(_STATUS_HOST, "127.0.0.1")
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/usage",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status == 200
+    except Exception:
+        log.debug("usage report failed (daemon unreachable?)",
+                  exc_info=True)
+        return False
