@@ -1,0 +1,141 @@
+"""The int4 CAPACITY demo (round-4 verdict weak #5, closure path b).
+
+int4's decode bandwidth win trails int8's (the nibble unpack is
+weight-sized VPU work — BENCH_EXTENDED_TPU.json), but capacity is the
+argument that was recorded and never demonstrated: a model whose
+weights fit a fractional-share HBM grant ONLY at int4, still decoding
+at useful speed.
+
+This drive builds a ~2.2B-parameter model (d2560, 26 layers, ff6912)
+ON-DEVICE (no host transfer through the tunnel), quantizes it in place,
+and measures b1 greedy fused decode for every precision that fits the
+chip.  Against a 1.5 GiB tpu-mem grant (a quarter-chip share on v5e
+16 GiB — BASELINE config-4 economics; scale d_model/L ~2.4x for the
+13B-in-7GiB version of the same demo):
+
+  bf16  ~4.4 GiB  does not fit the grant
+  int8  ~2.2 GiB  does not fit the grant
+  int4  ~1.1 GiB  FITS, with room for KV cache + activations
+
+    python drives/drive_int4_capacity.py        # real chip; ~8 min
+
+Prints ONE JSON line (INT4_CAPACITY_TPU.json when committed).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRANT_GIB = 1.5
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpushare.models import transformer
+    from tpushare.ops import quant
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2560, n_layers=26, n_heads=20,
+            n_kv_heads=4, d_ff=6912, max_seq=2048)
+        n_dec, prompt_len = 64, 32
+    else:
+        cfg = transformer.tiny(max_seq=96)
+        n_dec, prompt_len = 8, 8
+
+    grant_bytes = int(GRANT_GIB * 2 ** 30)
+    out = {"metric": "int4_capacity", "platform": dev.platform,
+           "model": f"d{cfg.d_model} L{cfg.n_layers} ff{cfg.d_ff} "
+                    f"vocab{cfg.vocab}",
+           "grant_gib": GRANT_GIB, "flavors": {}}
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, prompt_len), 0,
+                                cfg.vocab)
+
+    @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=(1,))
+    def decode_n(params, caches, tok0, pos0, n: int):
+        def body(carry, _):
+            tok, caches, pos = carry
+            logits, caches = transformer.forward(
+                params, tok[:, None], cfg, kv_caches=caches, cache_len=pos)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+            return (nxt, caches, pos + 1), nxt
+        (_, caches, _), toks = jax.lax.scan(
+            body, (tok0, caches, jnp.asarray(pos0, jnp.int32)), None,
+            length=n)
+        return toks.T
+
+    def measure(params):
+        caches = transformer.init_kv_caches(cfg, batch=1)
+        logits, caches = jax.jit(
+            lambda p, t, c: transformer.forward(
+                p, t, cfg, kv_caches=c, cache_len=0),
+            donate_argnums=(2,))(params, prompt, caches)
+        tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        t0 = time.perf_counter()
+        toks = decode_n(params, caches, tok0, prompt_len, n_dec)
+        int(toks[0, -1])
+        compile_s = time.perf_counter() - t0
+        caches2 = transformer.init_kv_caches(cfg, batch=1)
+        logits, caches2 = jax.jit(
+            lambda p, t, c: transformer.forward(
+                p, t, cfg, kv_caches=c, cache_len=0),
+            donate_argnums=(2,))(params, prompt, caches2)
+        t0 = time.perf_counter()
+        toks = decode_n(params, caches2, tok0, prompt_len, n_dec)
+        int(toks[0, -1])                 # host fetch = the barrier
+        dt = time.perf_counter() - t0
+        return compile_s, round(n_dec / dt, 1)
+
+    # bf16 base, initialized ON the device (random weights decode at
+    # full speed like trained ones; no multi-GiB tunnel transfer)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params)
+    # host-fetch = the only reliable barrier on the axon backend
+    # (CLAUDE.md; block_until_ready has returned early there)
+    float(params["embed"][0, 0])
+
+    for flavor in ("bf16", "int8", "int4"):
+        if flavor == "int8":
+            qparams = quant.quantize_params(params, bits=8)
+        elif flavor == "int4":
+            qparams = quant.quantize_params(params, bits=4)
+        else:
+            qparams = params
+        wb = quant.hbm_bytes(qparams)
+        rec = {"weight_bytes": int(wb),
+               "weight_gib": round(wb / 2 ** 30, 3),
+               "fits_grant": bool(wb <= grant_bytes)}
+        try:
+            compile_s, tps = measure(qparams)
+            rec["compile_s"] = round(compile_s, 1)
+            rec["decode_tokens_per_s_b1"] = tps
+        except Exception as e:
+            rec["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        out["flavors"][flavor] = rec
+        if flavor != "bf16":
+            del qparams
+
+    fits = [f for f, r in out["flavors"].items() if r["fits_grant"]]
+    out["only_int4_fits_grant"] = fits == ["int4"]
+    if "decode_tokens_per_s_b1" in out["flavors"].get("int4", {}):
+        out["int4_decode_tokens_per_s"] = \
+            out["flavors"]["int4"]["decode_tokens_per_s_b1"]
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""On-chip LoRA fine-tune step cost vs full fine-tuning, same shape.
+
+Round-4 shipped LoRA/QLoRA chip-unmeasured (verdict missing #2).  Two
+numbers matter to a user picking a recipe:
+
+* step cost — LoRA's backward touches only adapter grads, but the
+  matmul FLOPs still run; how much faster is a LoRA step really?
+* state memory — optimizer moments exist only for adapters (rank·(d+d)
+  per matrix instead of d·d), the reason LoRA fits where full FT won't.
+
+Method: the train_mfu drive's device-resident scan (n steps per
+dispatch, host-fetch barrier), once with ``make_train_step`` and once
+with ``make_lora_train_step`` on the same d1024/8-layer model at b8
+s2048 bf16.
+
+    python drives/drive_lora_step.py        # real chip; ~5 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _tree_bytes(tree):
+    import jax
+
+    return sum(int(x.size) * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "dtype"))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpushare.models import transformer
+    from tpushare.ops import lora
+    from tpushare.parallel.train import make_optimizer, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=1024, n_layers=8, n_heads=8, n_kv_heads=8,
+            d_ff=2816, max_seq=2048)
+        bt, s, n = 8, 2048, 10
+    else:
+        cfg = transformer.tiny(max_seq=64)
+        bt, s, n = 2, 48, 3
+    peak = 197e12
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (bt, s + 1), 0,
+                                cfg.vocab)
+    out = {"metric": "lora_step_cost", "platform": dev.platform,
+           "model": "8-layer d1024 ff2816 bf16", "batch": bt, "seq": s,
+           "rank": 16, "flavors": {}}
+
+    def measure(step_fn, params, ostate):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run_n(params, ostate, tokens):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = step_fn(p, o, tokens)
+                return (p, o), loss
+            (p, o), losses = jax.lax.scan(body, (params, ostate), None,
+                                          length=n)
+            return p, o, losses[-1]
+
+        t0 = time.perf_counter()
+        params, ostate, loss = run_n(params, ostate, tokens)
+        float(loss)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        params, ostate, loss = run_n(params, ostate, tokens)
+        float(loss)                       # host fetch = the barrier
+        dt = time.perf_counter() - t0
+        return compile_s, dt, ostate
+
+    # full fine-tune
+    opt = make_optimizer()
+    params = transformer.init_params(jax.random.PRNGKey(3), cfg)
+    ostate = opt.init(params)
+    step = make_train_step(cfg, opt)
+    compile_s, dt, ostate = measure(step, params, ostate)
+    rec = {"steps_per_s": round(n / dt, 3), "compile_s": round(compile_s, 1),
+           "opt_state_bytes": _tree_bytes(ostate)}
+    if on_tpu:
+        d, L, ff = cfg.d_model, cfg.n_layers, cfg.d_ff
+        per_tok = L * (2 * (4 * d * d + 3 * d * ff) + 2 * 2 * (s // 2) * d)
+        rec["mfu"] = round(3.0 * bt * s * per_tok * (n / dt) / peak, 4)
+    out["flavors"]["full_ft"] = rec
+    del params, ostate, step
+
+    # LoRA rank 16 (the step optimizes the adapter partition only, so a
+    # plain optimizer over adapters is the right state — test_lora.py's
+    # construction)
+    lopt = make_optimizer()
+    lparams = lora.loraize_params(
+        transformer.init_params(jax.random.PRNGKey(3), cfg), rank=16)
+    lostate = lopt.init(lora.partition(lparams)[0])
+    lstep = lora.make_lora_train_step(cfg, lopt)
+    compile_s, dt, lostate = measure(lstep, lparams, lostate)
+    adapters, _ = lora.partition(lparams)
+    rec = {"steps_per_s": round(n / dt, 3), "compile_s": round(compile_s, 1),
+           "opt_state_bytes": _tree_bytes(lostate),
+           "adapter_bytes": _tree_bytes(adapters)}
+    if on_tpu:
+        rec["mfu_vs_full_model_flops"] = round(
+            3.0 * bt * s * per_tok * (n / dt) / peak, 4)
+    out["flavors"]["lora_r16"] = rec
+
+    f, l = out["flavors"]["full_ft"], out["flavors"]["lora_r16"]
+    out["lora_step_speedup"] = round(
+        l["steps_per_s"] / f["steps_per_s"], 3)
+    out["opt_state_ratio_full_vs_lora"] = round(
+        f["opt_state_bytes"] / max(l["opt_state_bytes"], 1), 1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

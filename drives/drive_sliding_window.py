@@ -1,0 +1,115 @@
+"""On-chip sliding-window decode: ROLLING ring cache vs full cache.
+
+Round-4 shipped the rolling O(window) KV cache chip-unmeasured (verdict
+missing #2).  This drive quantifies both of its claims at a long
+context (s >> window):
+
+* decode throughput — each step attends W keys instead of max_seq;
+* persistent HBM — the cache is [.., W, ..] instead of [.., max_seq, ..].
+
+Method (CLAUDE.md tunnel rules): prefill a long prompt once, then time
+a device-resident ``lax.scan`` decode of n tokens (ONE dispatch;
+host-fetch barrier), identically for the rolling and full caches.  The
+two streams are also compared for agreement (the rolling path is exact;
+argmax can still differ on fp ties between the differently-ordered
+reductions, so agreement is reported, not asserted).
+
+    python drives/drive_sliding_window.py        # real chip; ~4 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpushare.models import transformer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        # mistral-shaped slice: GQA 4 kv-heads, long context, 2k window
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=1024, n_layers=8, n_heads=8, n_kv_heads=4,
+            d_ff=2816, max_seq=16384, window=2048)
+        prompt_len, n_dec = 12288, 128
+    else:
+        cfg = transformer.tiny(max_seq=192, window=16)
+        prompt_len, n_dec = 48, 16
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, prompt_len), 0,
+                                cfg.vocab)
+
+    @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=(1,))
+    def decode_n(tok0, caches, pos0, n: int):
+        def body(carry, _):
+            tok, caches, pos = carry
+            logits, caches = transformer.forward(
+                params, tok[:, None], cfg, kv_caches=caches, cache_len=pos)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+            return (nxt, caches, pos + 1), nxt
+        (_, caches, _), toks = jax.lax.scan(
+            body, (tok0, caches, jnp.asarray(pos0, jnp.int32)), None,
+            length=n)
+        return toks.T, caches
+
+    out = {"metric": "sliding_window_decode", "platform": dev.platform,
+           "window": cfg.window, "max_seq": cfg.max_seq,
+           "prompt_len": prompt_len, "decoded": n_dec, "flavors": {}}
+    streams = {}
+    for rolling in (False, True):
+        name = "rolling" if rolling else "full"
+        caches = transformer.init_kv_caches(cfg, batch=1, rolling=rolling)
+        kv_bytes = sum(int(c.size) * c.dtype.itemsize for c in caches)
+        logits, caches = jax.jit(
+            lambda p, c: transformer.forward(
+                params, p, cfg, kv_caches=c, cache_len=0),
+            donate_argnums=(1,))(prompt, caches)
+        tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        t0 = time.perf_counter()
+        toks, caches = decode_n(tok0, caches, prompt_len, n_dec)
+        first = [int(t) for t in toks[0]]
+        compile_s = time.perf_counter() - t0
+        # re-prefill for the timed pass (caches were donated+advanced)
+        caches = transformer.init_kv_caches(cfg, batch=1, rolling=rolling)
+        logits, caches = jax.jit(
+            lambda p, c: transformer.forward(
+                params, p, cfg, kv_caches=c, cache_len=0),
+            donate_argnums=(1,))(prompt, caches)
+        tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        t0 = time.perf_counter()
+        toks, caches = decode_n(tok0, caches, prompt_len, n_dec)
+        last = int(toks[0, -1])          # host fetch = the barrier
+        dt = time.perf_counter() - t0
+        streams[name] = first
+        out["flavors"][name] = {
+            "kv_cache_bytes": kv_bytes,
+            "kv_cache_gib": round(kv_bytes / 2 ** 30, 4),
+            "compile_s": round(compile_s, 1),
+            "tokens_per_s": round(n_dec / dt, 1),
+            "ms_per_token": round(1e3 * dt / n_dec, 3),
+        }
+    f, r = out["flavors"]["full"], out["flavors"]["rolling"]
+    out["speedup_rolling_vs_full"] = round(
+        r["tokens_per_s"] / f["tokens_per_s"], 3)
+    out["hbm_ratio_full_vs_rolling"] = round(
+        f["kv_cache_bytes"] / r["kv_cache_bytes"], 2)
+    agree = sum(a == b for a, b in zip(streams["full"], streams["rolling"]))
+    out["stream_agreement"] = f"{agree}/{n_dec}"
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
