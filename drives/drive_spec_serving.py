@@ -1,0 +1,108 @@
+"""On-chip SERVING speculation: tick_spec vs tick_fused through the
+continuous batcher, bracketed by traffic repetitiveness.
+
+Round-4's lookup speculation was standalone generate-only and measured
+0.95x fused greedy on non-repetitive output; the claimed winning regime
+(repetition-heavy traffic) was asserted, not measured (verdict #3), and
+the batcher never speculated at all (verdict missing #6).  This drive
+measures the INTEGRATED path on both brackets:
+
+* repetitive — prompts with heavy n-gram reuse whose continuations
+  echo the prompt (retrieval/code/log-shaped traffic);
+* fresh — random-token prompts (worst case: ~zero acceptance).
+
+Each flavor serves the same requests through ContinuousService twice —
+spec_k=8 vs plain fused decode — and reports generated-token
+throughput plus the device-side tokens-per-verify-round.
+
+    python drives/drive_spec_serving.py        # real chip; ~8 min
+
+Prints ONE JSON line (SPEC_SERVING_TPU.json when committed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving.continuous import ContinuousService
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
+            d_ff=1408, max_seq=512)
+        slots, n_req, gen = 8, 16, 64
+    else:
+        cfg = transformer.tiny(max_seq=256)
+        slots, n_req, gen = 3, 6, 24
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+
+    import numpy as np
+    rng = np.random.default_rng(7)
+    prompts = {
+        # heavy n-gram reuse: repeated 4-token motifs
+        "repetitive": [([(3 * i + j) % 17 + 1 for j in range(4)] * 8)
+                       for i in range(n_req)],
+        # i.i.d. tokens: lookup should accept ~nothing
+        "fresh": [[int(t) for t in rng.integers(1, cfg.vocab, 32)]
+                  for _ in range(n_req)],
+    }
+
+    out = {"metric": "spec_serving", "platform": dev.platform,
+           "slots": slots, "n_requests": n_req, "gen": gen, "k": 8,
+           "brackets": {}}
+
+    def run(prompt_set, spec_k):
+        svc = ContinuousService(params, cfg, n_slots=slots,
+                                decode_chunk=16, spec_k=spec_k).start()
+        try:
+            svc.submit(prompt_set[0], gen).get(timeout=1200)   # warm
+            # the warm request ran SOLO (frozen neighbour rows), so its
+            # rounds would drag tokens_per_round below steady state —
+            # reset the accounting before the measured batch
+            svc._batcher._spec_stats.update(
+                {"calls": 0, "rounds": 0, "tokens": 0})
+            t0 = time.perf_counter()
+            sinks = [svc.submit(p, gen) for p in prompt_set]
+            outs = [s.get(timeout=1200) for s in sinks]
+            dt = time.perf_counter() - t0
+            n_tok = sum(len(o) - len(p) for o, p in zip(outs, prompt_set))
+            snap = svc.snapshot()
+            rec = {"tokens_per_s": round(n_tok / dt, 1),
+                   "wall_s": round(dt, 2)}
+            if spec_k:
+                rec["tokens_per_round"] = (
+                    snap.get("speculation") or {}).get("tokens_per_round")
+            return rec, outs
+        finally:
+            svc.stop()
+
+    for name, pset in prompts.items():
+        plain, ref_outs = run(pset, spec_k=0)
+        spec, spec_outs = run(pset, spec_k=8)
+        assert spec_outs == ref_outs, "speculation broke greedy exactness"
+        out["brackets"][name] = {
+            "plain_fused": plain, "spec": spec,
+            "speedup": round(spec["tokens_per_s"]
+                             / plain["tokens_per_s"], 3),
+            "exact": True,
+        }
+    out["best_speedup"] = max(b["speedup"]
+                              for b in out["brackets"].values())
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,28 +34,48 @@ def main() -> int:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     out = {"metric": "train_mfu_sweep", "platform": dev.platform,
-           "model": "8-layer d1024 ff2816 bf16", "results": []}
-    shapes = ([(4, 2048, "none"), (8, 2048, "none"), (16, 2048, "none"),
-               (8, 4096, "layer"), (4, 8192, "layer")]
-              if on_tpu else [(2, 64, "none")])
+           "model": "d1024 L8 / d2048 L12 bf16", "results": []}
+    # (batch, seq, remat, head_chunk, model): head_chunk > 0 = the
+    # chunked loss (lm_loss head_chunk — the [B,S,V] logits tail was
+    # un-credited HBM traffic: head FLOPs are ~32% of layer FLOPs at
+    # d1024/v32k and the monolithic loss materializes GiBs of f32
+    # logits); model "big" = d2048 L12 (higher arithmetic intensity —
+    # the d1024 slice may simply be too small to saturate the MXU).
+    shapes = ([(8, 2048, "none", 0, "base"),
+               (16, 2048, "none", 0, "base"),
+               (8, 2048, "none", 256, "base"),
+               (16, 2048, "none", 256, "base"),
+               (8, 4096, "layer", 512, "base"),
+               (4, 8192, "layer", 512, "base"),
+               (8, 2048, "none", 256, "big"),
+               (4, 4096, "layer", 512, "big")]
+              if on_tpu else [(2, 64, "none", 0, "base"),
+                              (2, 64, "none", 32, "base")])
     peak = 197e12
 
     cfg_cache = {}
-    for bt, s, remat in shapes:
-        cfg = cfg_cache.get(s)
+    for bt, s, remat, hc, size in shapes:
+        cfg = cfg_cache.get((s, size))
         if cfg is None:
-            cfg = (transformer.ModelConfig(
-                vocab=32000, d_model=1024, n_layers=8, n_heads=8,
-                n_kv_heads=8, d_ff=2816, max_seq=s)
-                if on_tpu else transformer.tiny(max_seq=s))
-            cfg_cache[s] = cfg
+            if not on_tpu:
+                cfg = transformer.tiny(max_seq=s)
+            elif size == "big":
+                cfg = transformer.ModelConfig(
+                    vocab=32000, d_model=2048, n_heads=16, n_kv_heads=16,
+                    n_layers=12, d_ff=5632, max_seq=s)
+            else:
+                cfg = transformer.ModelConfig(
+                    vocab=32000, d_model=1024, n_layers=8, n_heads=8,
+                    n_kv_heads=8, d_ff=2816, max_seq=s)
+            cfg_cache[(s, size)] = cfg
         opt = make_optimizer()
         params = transformer.init_params(jax.random.PRNGKey(3), cfg)
         ostate = opt.init(params)
-        step = make_train_step(cfg, opt, remat=remat)
+        step = make_train_step(cfg, opt, remat=remat, head_chunk=hc)
         tokens = jax.random.randint(jax.random.PRNGKey(4), (bt, s + 1), 0,
                                     cfg.vocab)
-        rec = {"batch": bt, "seq": s, "remat": remat}
+        rec = {"batch": bt, "seq": s, "remat": remat, "head_chunk": hc,
+               "model": size}
         n = 10
 
         # DEVICE-RESIDENT step loop: n steps inside one jitted scan, so
@@ -85,10 +105,18 @@ def main() -> int:
             rec["steps_per_s"] = round(n / dt, 3)
             if on_tpu:
                 d, L, ff = cfg.d_model, cfg.n_layers, cfg.d_ff
-                per_tok = L * (2 * (4 * d * d + 3 * d * ff)
-                               + 2 * 2 * (s // 2) * d)
-                rec["mfu"] = round(3.0 * bt * s * per_tok * (n / dt)
-                                   / peak, 4)
+                per_tok_layers = L * (2 * (4 * d * d + 3 * d * ff)
+                                      + 2 * 2 * (s // 2) * d)
+                # the LM head is real model compute (2*d*vocab fwd —
+                # ~32% of layer FLOPs at d1024/v32k, ~11% at d2048);
+                # excluding it understated MFU and skewed cross-model
+                # comparison toward big-d shapes. mfu_layers_only keeps
+                # continuity with the round-4 records.
+                per_tok = per_tok_layers + 2 * d * cfg.vocab
+                rate = n / dt
+                rec["mfu"] = round(3.0 * bt * s * per_tok * rate / peak, 4)
+                rec["mfu_layers_only"] = round(
+                    3.0 * bt * s * per_tok_layers * rate / peak, 4)
                 rec["tokens_per_s"] = int(bt * s * n / dt)
         except Exception as e:
             rec["error"] = f"{type(e).__name__}: {str(e)[:160]}"
@@ -98,7 +126,8 @@ def main() -> int:
     done = [r for r in out["results"] if "mfu" in r]
     if done:
         best = max(done, key=lambda r: r["mfu"])
-        out["best"] = {k: best[k] for k in ("batch", "seq", "remat", "mfu")}
+        out["best"] = {k: best[k] for k in ("batch", "seq", "remat",
+                                            "head_chunk", "model", "mfu")}
     print(json.dumps(out))
     return 0
 

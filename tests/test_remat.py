@@ -98,3 +98,31 @@ def test_attn_saving_policy_drops_forward_kernel_recompute():
                                   np.asarray(grads["none"]))
     np.testing.assert_array_equal(np.asarray(grads["plain"]),
                                   np.asarray(grads["none"]))
+
+
+def test_chunked_head_loss_matches_monolithic(model):
+    """lm_loss(head_chunk=C) is the same loss and the same gradients as
+    the monolithic path — the [B,S,V] logits tensor is an HBM
+    optimization, not a different objective.  Composes with layer
+    remat; non-dividing chunks fall back to monolithic."""
+    params, cfg, tokens = model
+    l0, g0 = jax.value_and_grad(lm_loss)(params, tokens, cfg)
+    l1, g1 = jax.value_and_grad(lm_loss)(params, tokens, cfg,
+                                         head_chunk=8)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6)
+    l2 = lm_loss(params, tokens, cfg, head_chunk=8,
+                 remat_policy=ATTN_SAVING_POLICY)
+    np.testing.assert_allclose(float(l0), float(l2), rtol=1e-6)
+    # 7 does not divide 32: silently (and correctly) monolithic
+    l3 = lm_loss(params, tokens, cfg, head_chunk=7)
+    np.testing.assert_allclose(float(l0), float(l3), rtol=1e-6)
+    # and through make_train_step
+    opt = make_optimizer(lr=1e-3)
+    step = make_train_step(cfg, opt, head_chunk=8)
+    p2, o2, loss = step(jax.tree_util.tree_map(jnp.copy, params),
+                        opt.init(params), tokens)
+    np.testing.assert_allclose(float(loss), float(l0), rtol=1e-6)

@@ -187,6 +187,21 @@ def test_serving_sampled_streamed_on_chip():
 
 
 @_skip
+def test_spec_serving_on_chip():
+    """Serving-integrated lookup speculation: must WIN on the
+    repetition-heavy bracket (the round-2..4 carried claim) and stay
+    exact everywhere; an honest loss on fresh traffic is recorded, not
+    hidden."""
+    rec = _run("drive_spec_serving.py", timeout=3600)
+    assert all(b["exact"] for b in rec["brackets"].values()), rec
+    committed = _committed("SPEC_SERVING_TPU.json", "brackets",
+                           "repetitive", "speedup", default=None)
+    got = rec["brackets"]["repetitive"]["speedup"]
+    if committed:
+        assert got >= _GUARD * committed, (rec, committed)
+
+
+@_skip
 def test_int4_capacity_demo_on_chip():
     rec = _run("drive_int4_capacity.py", timeout=3600)
     assert rec["only_int4_fits_grant"], rec

@@ -388,7 +388,8 @@ def forward(params, tokens, cfg: ModelConfig,
             positions: Optional[jnp.ndarray] = None,
             attention_fn=None,
             remat_policy=None,
-            kv_write_len=None):
+            kv_write_len=None,
+            return_hidden: bool = False):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -458,6 +459,14 @@ def forward(params, tokens, cfg: ModelConfig,
         new_caches = (new_ck, new_cv)
 
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    if return_hidden:
+        # pre-head hidden states (post final norm): the chunked-loss
+        # path applies the LM head itself, one sequence chunk at a
+        # time, so [B, S, vocab] f32 logits are never materialized
+        # whole (tpushare.parallel.train.lm_loss head_chunk)
+        if new_caches is not None:
+            return x, new_caches
+        return x
     logits = _head_mm(x, params["lm_head"])
     if new_caches is not None:
         return logits, new_caches

@@ -199,6 +199,95 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     return toks.T, keys, caches
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
+                                             "n_rounds"),
+                   donate_argnums=(2,))
+def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
+               remainings, actives, cfg, k: int, ngram: int,
+               n_rounds: int):
+    """``n_rounds`` of batched PROMPT-LOOKUP speculative decoding in one
+    dispatch — the continuous batcher's speculation path (the serving
+    integration of :mod:`.speculative`'s single-request while_loop).
+
+    Per round, per slot: commit the pending known-correct token, propose
+    the ``k`` tokens that followed the most recent earlier occurrence of
+    the trailing ``ngram`` in that slot's OWN token buffer, verify
+    pending+proposal in ONE ``[B, 1+k]`` forward (batch-1 decode is
+    weight-bound, so the k extra positions are nearly free), and accept
+    the longest agreeing prefix — greedy-exact per slot, like the
+    single-request path.
+
+    ``bufs`` [B, S] is each slot's token history (prompt + committed
+    output, device-resident so the n-gram scan never leaves the chip);
+    ``next_toks`` holds each slot's pending token (generated, not yet in
+    cache).  ``actives``/``remainings`` freeze exhausted or inactive
+    rows: a frozen row re-verifies at a fixed position every round
+    (writes beyond its committed length are never attended — the same
+    containment as a finished slot in ``_tick_n``).  DENSE full-size
+    pools only: a rejected proposal must be retractable by position
+    masking alone, which a rolling ring cannot do (its writes evict).
+
+    Returns (bufs, buf_lens, n_ctxs, next_toks, produced, caches):
+    ``produced[i]`` counts tokens committed into row i's buf this call;
+    the caller drains ``bufs[i, old_len : old_len + produced[i]]``.
+    """
+    S = cfg.max_seq
+    B = bufs.shape[0]
+    rows = jnp.arange(B)
+
+    def round_(st, _):
+        bufs, buf_lens, n_ctxs, next_toks, produced, caches = st
+        live = actives & (produced < remainings)             # [B] bool
+        # -- commit the pending token ------------------------------
+        upd = jax.vmap(lambda b, t, p: jax.lax.dynamic_update_slice(
+            b, t[None], (p,)))
+        bufs = jnp.where(live[:, None],
+                         upd(bufs, next_toks, buf_lens), bufs)
+        buf_lens = buf_lens + live
+        produced = produced + live
+        rem_after = remainings - produced                    # [B]
+
+        # -- propose from each row's own history (the ONE lookup
+        # definition, vmapped — see speculative.propose_lookup) -----
+        from .speculative import propose_lookup
+        proposals, prop_lens = jax.vmap(
+            propose_lookup, in_axes=(0, 0, None, None))(
+                bufs, buf_lens, k, ngram)                    # [B,k],[B]
+
+        # -- verify pending + proposal in one forward --------------
+        blocks = jnp.concatenate([next_toks[:, None], proposals], axis=1)
+        logits, caches = transformer.forward(
+            params, blocks, cfg, kv_caches=caches, cache_len=n_ctxs)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,1+k]
+
+        # -- longest agreeing prefix, bounded per row --------------
+        agree = ((proposals == greedy[:, :k])
+                 & (jnp.arange(k)[None, :] < prop_lens[:, None]))
+        n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                        axis=1)
+        n_acc = jnp.clip(n_acc, 0, jnp.maximum(rem_after, 0))
+        n_acc = jnp.where(live, n_acc, 0)
+        # append accepted proposals (the garbage tail beyond n_acc sits
+        # past buf_len and is overwritten before it is ever read)
+        bufs = jnp.where(live[:, None],
+                         jax.vmap(lambda b, pr, p:
+                                  jax.lax.dynamic_update_slice(
+                                      b, pr, (p,)))(bufs, proposals,
+                                                    buf_lens),
+                         bufs)
+        buf_lens = buf_lens + n_acc
+        produced = produced + n_acc
+        n_ctxs = n_ctxs + (1 + n_acc) * live
+        next_toks = jnp.where(live, greedy[rows, n_acc], next_toks)
+        return (bufs, buf_lens, n_ctxs, next_toks, produced, caches), None
+
+    produced0 = jnp.zeros((B,), jnp.int32)
+    (bufs, buf_lens, n_ctxs, next_toks, produced, caches), _ = \
+        jax.lax.scan(round_, (bufs, buf_lens, n_ctxs, next_toks,
+                              produced0, caches), None, length=n_rounds)
+    return bufs, buf_lens, n_ctxs, next_toks, produced, caches
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -257,6 +346,10 @@ class ContinuousBatcher:
         self.prefilling: Dict[int, _Prefill] = {}   # slot -> mid-prefill
         self._next_id = 0
         self.completed: Dict[int, List[int]] = {}
+        # tick_spec accounting: tokens committed per speculative round —
+        # tokens/rounds > 1 is the acceptance win (each round costs one
+        # verify forward, like one plain tick)
+        self._spec_stats = {"calls": 0, "rounds": 0, "tokens": 0}
         self._init_storage()
 
     # -- storage hooks -------------------------------------------------
@@ -609,6 +702,98 @@ class ContinuousBatcher:
                 return True
         return self.completed.pop(rid, None) is not None
 
+    def tick_spec(self, n_rounds: int, k: int = 8, ngram: int = 2) -> int:
+        """``n_rounds`` of batched prompt-lookup SPECULATIVE decoding in
+        one dispatch (see :func:`_tick_spec`); returns #active slots
+        before the call.  Greedy-exact: token streams are identical to
+        :meth:`tick`/:meth:`tick_fused` and the two may be interleaved
+        freely, so the service can speculate opportunistically.
+
+        Constraints (the caller routes around them):
+        * every ACTIVE slot must be greedy (temperature == 0) — the
+          speculative contract is argmax equality;
+        * dense full-size storage only (a rolling ring cannot retract a
+          rejected proposal's write; pages would need +k headroom);
+        * each request needs ``prompt + max_new + k <= max_seq`` of
+          cache headroom (rejected tails write up to k past the end).
+        """
+        if self.rolling_slots:
+            raise ValueError("tick_spec needs a full-size dense pool")
+        if not self.slots:
+            return 0
+        if any(s.temperature > 0.0 for s in self.slots.values()):
+            raise ValueError("tick_spec is greedy-only; route sampling "
+                             "batches through tick/tick_fused")
+        S, B = self.cfg.max_seq, self.n_slots
+        bufs = np.zeros((B, S), np.int32)
+        buf_lens = np.zeros((B,), np.int32)
+        n_ctxs = np.zeros((B,), np.int32)
+        next_toks = np.zeros((B,), np.int32)
+        remainings = np.zeros((B,), np.int32)
+        actives = np.zeros((B,), np.int32)
+        for i, st in self.prefilling.items():
+            # frozen garbage aim (see _gather) — and the (1+k)-wide
+            # garbage verify-write needs headroom too: a clamped write
+            # would land on committed, still-attendable prompt keys
+            if len(st.prompt) + st.max_new + k > S:
+                raise ValueError(
+                    f"prefilling slot {i}: speculation needs {k} tokens "
+                    f"of cache headroom past prompt+max_new (max_seq {S})")
+            n_ctxs[i] = st.pos
+        for i, s in self.slots.items():
+            if len(s.output) + s.remaining + k > S:
+                raise ValueError(
+                    f"slot {i}: speculation needs {k} tokens of cache "
+                    f"headroom past prompt+max_new (max_seq {S})")
+            hist = s.output
+            bufs[i, :len(hist) - 1] = hist[:-1]
+            buf_lens[i] = len(hist) - 1
+            n_ctxs[i] = s.length
+            next_toks[i] = s.last_token
+            remainings[i] = s.remaining
+            actives[i] = 1
+        bufs_j, buf_lens_j, n_ctxs_j, next_toks_j, produced, self.caches = \
+            _tick_spec(self.params, jnp.asarray(bufs), self.caches,
+                       jnp.asarray(buf_lens), jnp.asarray(n_ctxs),
+                       jnp.asarray(next_toks), jnp.asarray(remainings),
+                       jnp.asarray(actives).astype(bool), self.cfg,
+                       k, ngram, n_rounds)
+        bufs_h = np.asarray(bufs_j)
+        produced = np.asarray(produced)
+        n_ctxs_h = np.asarray(n_ctxs_j)
+        next_h = np.asarray(next_toks_j)
+        n_active = len(self.slots)
+        for i in list(self.slots):
+            s = self.slots[i]
+            got = int(produced[i])
+            if got == 0:
+                continue
+            old_len = len(s.output) - 1
+            committed = [int(t) for t in bufs_h[i, old_len:old_len + got]]
+            # committed[0] re-commits the pending s.output[-1]; the new
+            # tokens are committed[1:] plus the fresh pending token
+            new_toks = committed[1:] + [int(next_h[i])]
+            take = min(len(new_toks), s.remaining)
+            new_toks = new_toks[:take]
+            if s.eos_id is not None and s.eos_id in new_toks:
+                take = new_toks.index(s.eos_id) + 1
+                new_toks = new_toks[:take]
+            s.output.extend(new_toks)
+            s.remaining -= take
+            s.last_token = s.output[-1]
+            # cache coverage: everything except the new pending token
+            # (== the device's final n_ctx for untruncated rows)
+            s.length = len(s.output) - 1
+            self._spec_stats["tokens"] += take
+            if s.remaining <= 0 or (s.eos_id is not None
+                                    and s.last_token == s.eos_id):
+                self.completed[s.request_id] = s.output
+                self._release(i)
+                del self.slots[i]
+        self._spec_stats["rounds"] += n_rounds
+        self._spec_stats["calls"] += 1
+        return n_active
+
     def run_until_drained(self, max_ticks: int = 10_000) -> None:
         for _ in range(max_ticks):
             if self.prefilling:
@@ -633,7 +818,10 @@ class ContinuousService:
                  prefill_chunk: int = 64,
                  decode_chunk: int = 8,
                  prefill_decode_chunk: Optional[int] = None,
-                 mesh=None):
+                 mesh=None,
+                 spec_k: int = 0,
+                 spec_ngram: int = 2,
+                 spec_rounds: Optional[int] = None):
         import queue as _q
         import threading
 
@@ -644,6 +832,19 @@ class ContinuousService:
         # fusion.  The trade is ≤ decode_chunk-1 ticks of completion/
         # admission latency per chunk.
         self._decode_chunk = max(1, decode_chunk)
+        # spec_k > 0 enables OPPORTUNISTIC prompt-lookup speculation:
+        # steady-state rounds with an all-greedy active set route
+        # through tick_spec (greedy-exact, so mixing with fused ticks is
+        # safe); any sampling slot falls back to the plain fused path.
+        # Dense full-size pools only (tick_spec's constraint); requests
+        # then need prompt + max_new + spec_k <= max_seq (checked at
+        # submit).  spec_rounds defaults to half the decode chunk: at
+        # acceptance ~1 token/round speculation matches the fused path's
+        # per-dispatch token yield, and beats it as acceptance grows.
+        self._spec_k = int(spec_k)
+        self._spec_ngram = int(spec_ngram)
+        self._spec_rounds = (int(spec_rounds) if spec_rounds is not None
+                             else max(1, self._decode_chunk // 2))
         # While any slot is mid-prefill the loop interleaves ONE prompt
         # chunk with a fused decode chunk of this size (default: the
         # steady-state size, so only one n-step program ever compiles).
@@ -672,6 +873,10 @@ class ContinuousService:
                 mesh=mesh)
         else:
             self._batcher = ContinuousBatcher(params, cfg, n_slots, mesh=mesh)
+        if self._spec_k and (page_size is not None
+                             or self._batcher.rolling_slots):
+            raise ValueError("speculation (spec_k) requires the dense "
+                             "full-size slot pool")
         # _lock guards ONLY the _waiting handoff; the batcher and _sinks
         # are owned by the loop thread, so decode ticks run without the
         # lock and submit() never waits on a model forward.
@@ -702,7 +907,8 @@ class ContinuousService:
     def stop(self) -> None:
         self._halt.set()
         self._work.set()
-        self._thread.join(timeout=10)
+        if self._thread.ident is not None:   # never-started is a no-op
+            self._thread.join(timeout=10)
         # Sentinel BOTH queued and in-flight requests — a stranded sink
         # would block its client until its own timeout. put_nowait only:
         # blocking on a full maxsize-1 sink could deadlock stop().
@@ -770,6 +976,12 @@ class ContinuousService:
                 top_k, top_p, stream: bool, on_complete=None):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
+        if self._spec_k and (len(prompt) + max_new_tokens + self._spec_k
+                             > self._batcher.cfg.max_seq):
+            raise ValueError(
+                f"speculation needs {self._spec_k} tokens of cache "
+                f"headroom: prompt+max_new_tokens+spec_k exceeds "
+                f"max_seq={self._batcher.cfg.max_seq}")
         # streaming sinks are unbounded (many deltas); final-only sinks
         # hold exactly one item
         sink = self._q.Queue() if stream else self._q.Queue(maxsize=1)
@@ -821,10 +1033,16 @@ class ContinuousService:
         """
         with self._lock:
             queued = len(self._waiting)
-        return {"slots": self._batcher.n_slots,
+        snap = {"slots": self._batcher.n_slots,
                 "active": len(self._batcher.slots),
                 "prefilling": len(self._batcher.prefilling),
                 "queued": queued}
+        if self._spec_k:
+            st = dict(self._batcher._spec_stats)
+            st["tokens_per_round"] = (round(st["tokens"] / st["rounds"], 3)
+                                      if st["rounds"] else None)
+            snap["speculation"] = st
+        return snap
 
     # ------------------------------------------------------------------
     def _loop(self) -> None:
@@ -870,6 +1088,15 @@ class ContinuousService:
                         self._prefill_decode_chunk)
                 else:
                     active = self._batcher.tick()
+            elif (self._spec_k
+                  and all(s.temperature == 0.0
+                          for s in self._batcher.slots.values())):
+                # all-greedy steady state: speculative rounds (exact,
+                # so interleaving with the fused path below is safe
+                # when a sampling request joins later)
+                active = self._batcher.tick_spec(
+                    self._spec_rounds, k=self._spec_k,
+                    ngram=self._spec_ngram)
             elif self._decode_chunk > 1:
                 active = self._batcher.tick_fused(self._decode_chunk)
             else:

@@ -65,14 +65,17 @@ class LLMServer:
                  n_slots: int = 0,
                  page_size: int = 0,
                  n_pages: int = 0,
-                 tp: int = 0):
+                 tp: int = 0,
+                 spec_k: int = 0):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
         paged pool (``n_pages`` pages, default dense-equivalent).
         ``tp > 1`` builds a tensor-parallel mesh over the pod's visible
         devices and serves SPMD (requires --slots; params and KV storage
-        shard per ``tpushare.parallel.mesh``)."""
+        shard per ``tpushare.parallel.mesh``).  ``spec_k > 0`` turns on
+        opportunistic prompt-lookup speculation for all-greedy batches
+        (greedy-exact; see ContinuousService)."""
         from ..utils.httpserver import JsonHTTPServer
 
         self.cfg = cfg
@@ -98,7 +101,8 @@ class LLMServer:
                 params, cfg, n_slots,
                 page_size=page_size or None,
                 n_pages=n_pages or None,
-                mesh=mesh).start()
+                mesh=mesh,
+                spec_k=spec_k).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -456,7 +460,15 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel degree over the pod's visible "
                          "devices (0/1 = single device); requires --slots")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="prompt-lookup speculation depth for all-greedy "
+                         "batches (0 = off; greedy-exact; requires "
+                         "--slots, dense pool)")
     args = ap.parse_args(argv)
+    if args.spec_k and not args.slots:
+        ap.error("--spec-k requires --slots")
+    if args.spec_k and args.page_size:
+        ap.error("--spec-k requires the dense pool (no --page-size)")
     if args.page_size and not args.slots:
         ap.error("--page-size requires --slots")
     if args.kv_pages and not args.page_size:
@@ -480,7 +492,8 @@ def main(argv=None) -> int:
                               quantize_int4=args.int4)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
-                    n_pages=args.kv_pages, tp=args.tp)
+                    n_pages=args.kv_pages, tp=args.tp,
+                    spec_k=args.spec_k)
     log.info("llm server: model=%s quant=%s tp=%d on :%d", args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
              args.tp, srv.port)

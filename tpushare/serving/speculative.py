@@ -130,6 +130,35 @@ def speculative_generate(target_params, target_cfg: transformer.ModelConfig,
 # ---------------------------------------------------------------------------
 # Fused prompt-lookup speculation: the whole loop on device
 # ---------------------------------------------------------------------------
+def propose_lookup(buf, buf_len, k: int, ngram: int):
+    """THE prompt-lookup proposal, for one token row: the ``k`` tokens
+    that followed the most recent strictly-earlier occurrence of the
+    trailing ``ngram`` in ``buf[:buf_len]``.
+
+    Returns ``(proposal [k], prop_len)`` — ``prop_len`` = how many
+    proposal entries are real (0 when no earlier match).  One
+    definition shared by the single-request while_loop and the
+    continuous batcher's ``_tick_spec`` (which vmaps it), so a fix to
+    the lookup reaches both paths.
+    """
+    S = buf.shape[0]
+    W = S - ngram + 1
+    tail = jax.lax.dynamic_slice(buf, (buf_len - ngram,), (ngram,))
+    match = jnp.ones((W,), bool)
+    for j in range(ngram):
+        match &= buf[j:j + W] == tail[j]
+    idx = jnp.arange(W)
+    match &= idx <= buf_len - ngram - 1          # strictly earlier
+    i_best = jnp.max(jnp.where(match, idx, -1))
+    has = i_best >= 0
+    start = jnp.clip(i_best + ngram, 0, S - k)
+    proposal = jax.lax.dynamic_slice(buf, (start,), (k,))
+    prop_len = jnp.where(
+        has, jnp.clip(buf_len - (i_best + ngram), 0, k), 0)
+    return proposal, prop_len
+
+
+
 @functools.lru_cache(maxsize=8)
 def _make_lookup_spec(cfg: transformer.ModelConfig, prompt_len: int,
                       max_new: int, k: int, ngram: int):
@@ -183,19 +212,7 @@ def _make_lookup_spec(cfg: transformer.ModelConfig, prompt_len: int,
             def round_(op):
                 buf, buf_len, n_ctx, next_tok, caches, n_verify = op
                 # -- propose: most recent earlier match of the tail ----
-                tail = jax.lax.dynamic_slice(buf, (buf_len - ngram,),
-                                             (ngram,))
-                match = jnp.ones((W,), bool)
-                for j in range(ngram):
-                    match &= buf[j:j + W] == tail[j]
-                idx = jnp.arange(W)
-                match &= idx <= buf_len - ngram - 1   # strictly earlier
-                i_best = jnp.max(jnp.where(match, idx, -1))
-                has = i_best >= 0
-                start = jnp.clip(i_best + ngram, 0, S - k)
-                proposal = jax.lax.dynamic_slice(buf, (start,), (k,))
-                prop_len = jnp.where(
-                    has, jnp.clip(buf_len - (i_best + ngram), 0, k), 0)
+                proposal, prop_len = propose_lookup(buf, buf_len, k, ngram)
 
                 # -- verify next_tok + proposal in one forward ---------
                 block = jnp.concatenate([next_tok[None], proposal]
