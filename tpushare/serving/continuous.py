@@ -870,7 +870,7 @@ class ContinuousService:
             from .paged import PagedContinuousBatcher
             self._batcher = PagedContinuousBatcher(
                 params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
-                mesh=mesh)
+                mesh=mesh, max_prefill_chunk=self._prefill_chunk)
         else:
             self._batcher = ContinuousBatcher(params, cfg, n_slots, mesh=mesh)
         if self._spec_k and (page_size is not None

@@ -77,13 +77,21 @@ def _tick(params, tokens, pools, page_table, lengths, temps, keys,
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
-            tks, tps, cfg, n: int, rich: bool = False):
+            tks, tps, incs, cfg, n: int, rich: bool = False):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
     mid-decode), and a finished slot's surplus steps land on the trash
     page / its own already-released lanes, contained like every other
     garbage write (rewritten before attendable, even across page reuse).
+
+    ``incs`` freezes non-active rows at their aimed garbage position,
+    exactly like the dense scan: for full-causal storage the wander was
+    merely harmless, but for a sliding-window PAGE RING a wandering
+    mid-prefill garbage write at position q would recycle the ring lane
+    of q - held*page — still-attendable window content — whenever the
+    decode chunk outruns the ring's prefill margin.  Freezing removes
+    the coupling between decode_chunk and the ring size entirely.
     """
     def body(carry, _):
         tok, pools, lengths, keys = carry
@@ -92,7 +100,7 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
             params, tok, cfg, pools, page_table, lengths)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
-        return (nxt[:, None], pools, lengths + 1, ks[:, 0]), nxt
+        return (nxt[:, None], pools, lengths + incs, ks[:, 0]), nxt
 
     (_, pools, _, keys), toks = jax.lax.scan(
         body, (tokens, pools, lengths, keys), None, length=n)
@@ -104,11 +112,17 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  page_size: int = 16, n_pages: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, max_prefill_chunk: int = 64):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq // page_size
+        # Upper bound on any prefill chunk through this batcher —
+        # admission clamps to it.  Sized into the windowed page ring
+        # (see _held_pages); irrelevant for full-causal requests.
+        self.max_prefill_chunk = max(
+            page_size,
+            -(-max_prefill_chunk // page_size) * page_size)
         # Default pool: every slot can hold a full max_seq sequence (the
         # dense equivalent + 1 trash page). Pass a smaller n_pages to
         # overcommit slots against the real traffic mix — the point.
@@ -124,7 +138,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
         super().validate_request(prompt, max_new_tokens)
-        need = -(-(len(prompt) + max_new_tokens) // self.page_size)
+        need = self._held_pages(len(prompt), max_new_tokens)
         if need > self.n_pages - 1:     # page 0 is never allocatable
             raise ValueError(
                 f"request needs {need} pages but the pool holds only "
@@ -154,13 +168,43 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._free_pages: List[int] = list(range(1, self.n_pages))  # 0=trash
         self._slot_pages: Dict[int, List[int]] = {}
 
+    def _held_pages(self, prompt_len: int, max_new: int) -> int:
+        """Physical pages a request occupies SIMULTANEOUSLY.
+
+        Full-causal: every page of the sequence (the whole history is
+        attendable).  Sliding-window: a RING of
+        ``ceil(window/page) + ceil(max_prefill_chunk/page) + 1`` pages.
+        The ring must cover the window PLUS one whole prefill chunk,
+        because a chunk's page walk writes every chunk page BEFORE its
+        attention runs: a write at position p evicts position
+        p - held*page, and the chunk's earliest query (at the chunk
+        start) is entitled to the window behind it — the chunk-sized
+        margin keeps every in-dispatch eviction strictly older than
+        that.  Decode writes are one token per scan step (earlier
+        queries already attended), so they need no margin; the window
+        mask (already applied by the paged attention) keeps recycled
+        pages' aliased old-range claims out of every softmax.
+        """
+        n_ranges = -(-(prompt_len + max_new) // self.page_size)
+        if transformer.wants_rolling(self.cfg):
+            w_pages = -(-self.cfg.window // self.page_size)
+            c_pages = -(-self.max_prefill_chunk // self.page_size)
+            return min(n_ranges, w_pages + c_pages + 1)
+        return n_ranges
+
     def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
-        need = -(-(prompt_len + max_new) // self.page_size)
-        if need > len(self._free_pages):
+        n_ranges = -(-(prompt_len + max_new) // self.page_size)
+        held = self._held_pages(prompt_len, max_new)
+        if held > len(self._free_pages):
             return False                # page backpressure
-        pages = [self._free_pages.pop() for _ in range(need)]
+        pages = [self._free_pages.pop() for _ in range(held)]
         self.page_table[slot, :] = 0
-        self.page_table[slot, :len(pages)] = pages
+        # STATIC ring mapping: position range j -> pages[j % held]; for
+        # full-causal requests held == n_ranges so this is the identity
+        # layout.  No mid-decode table updates, ever — the fixed-table
+        # invariant _tick_n depends on holds by construction.
+        for j in range(n_ranges):
+            self.page_table[slot, j] = pages[j % held]
         self._slot_pages[slot] = pages
         return True
 
@@ -169,6 +213,29 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self._free_pages.extend(self._slot_pages.pop(slot, []))
 
     def _prefill_into(self, slot: int, tokens, prompt_len: int):
+        span = len(self._slot_pages.get(slot, ())) * self.page_size
+        if (transformer.wants_rolling(self.cfg) and span
+                and prompt_len > span):
+            # whole-prompt prefill wider than the page ring would alias
+            # ranges inside one static page walk — stream it through
+            # max_prefill_chunk-sized page-aligned chunks (the bound the
+            # ring is sized for), the bit-exact chunk body chunked
+            # admission uses
+            row = np.asarray(tokens).reshape(-1)[:prompt_len]
+            step = self.max_prefill_chunk
+            pos, logits_v = 0, None
+            while pos < prompt_len:
+                # FIXED window width (advance_prefill's compile-count
+                # discipline: widths stay in {step, max_seq - pos}, so a
+                # short final piece never keys a fresh XLA program)
+                window = min(step, self.cfg.max_seq - pos)
+                piece = row[pos:pos + window]
+                padded = np.zeros((1, window), np.int32)
+                padded[0, :len(piece)] = piece
+                logits_v = self._prefill_chunk_into(
+                    slot, padded, pos, len(piece) - 1, window)
+                pos += len(piece)
+            return logits_v
         logits, self.pools = _prefill(
             self.params, tokens, self.pools,
             jnp.asarray(self.page_table[slot]), self.cfg, prompt_len)
@@ -182,14 +249,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int):
-        # incs is the dense ROLLING pool's wander freeze; paged garbage
-        # writes are contained by the trash page / overwrite-before-
-        # attendable argument, so the paged scan keeps advancing all rows
-        # (bit-exact with its committed behavior).
-        del incs
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
-            lengths, temps, keys, tks, tps, self.cfg, n_steps, rich)
+            lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -212,6 +274,11 @@ class PagedContinuousBatcher(ContinuousBatcher):
         admission paths' validation identical."""
         if chunk >= 1:
             chunk = -(-chunk // self.page_size) * self.page_size
+            # the windowed page ring is sized for chunks up to
+            # max_prefill_chunk (see _held_pages) — larger ones would
+            # evict window content their own earlier queries attend
+            if transformer.wants_rolling(self.cfg):
+                chunk = min(chunk, self.max_prefill_chunk)
         return super().admit_chunked(prompt, max_new_tokens,
                                      temperature=temperature, seed=seed,
                                      chunk=chunk, eos_id=eos_id,
