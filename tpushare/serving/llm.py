@@ -421,6 +421,9 @@ class LLMServer:
         }
         if self._service is not None:
             stats["batcher"] = self._service.snapshot()
+            # KV storage economics (what a slot/page costs, slots per
+            # GiB) — the number the rolling pool / page ring change
+            stats["kv_storage"] = self._service._batcher.storage_info()
         return 200, stats
 
     def start(self):
