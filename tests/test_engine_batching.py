@@ -142,3 +142,46 @@ def test_measure_qps_honors_zero_warmup():
     dispatches.clear()
     measure_qps(engine, n_batches=3, warmup_batches=2)
     assert len(dispatches) == 5
+
+
+def test_pipelined_server_loop_delivers_everything():
+    """With pipeline_depth > 1 several batches ride the device queue at
+    once; every submit must still get ITS result (order within a
+    request is its own queue), including requests in flight at stop()."""
+    import numpy as np
+
+    from tpushare.serving.engine import InferenceEngine
+
+    def fn(tokens, mask):
+        return tokens * 2 * mask[..., None].squeeze(-1)
+
+    eng = InferenceEngine(fn, batch_size=2, seq_len=4, pass_mask=True,
+                          max_wait_ms=1.0, pipeline_depth=3).start()
+    try:
+        subs = [(i, eng.submit(np.full((4,), i + 1, np.int32)))
+                for i in range(12)]
+        for i, q in subs:
+            got = q.get(timeout=30)
+            assert got is not None
+            assert (got == (i + 1) * 2).all(), (i, got)
+    finally:
+        eng.stop()
+
+
+def test_stop_drains_inflight_batches():
+    """stop() must deliver (or sentinel) every outstanding request —
+    results already on the device queue are fetched, not dropped."""
+    import numpy as np
+
+    from tpushare.serving.engine import InferenceEngine
+
+    def fn(tokens, mask):
+        return tokens + mask
+
+    eng = InferenceEngine(fn, batch_size=1, seq_len=4, pass_mask=True,
+                          max_wait_ms=0.5, pipeline_depth=4).start()
+    qs = [eng.submit(np.full((4,), i, np.int32)) for i in range(6)]
+    eng.stop()
+    for i, q in enumerate(qs):
+        got = q.get(timeout=10)          # result or sentinel, never hang
+        assert got is None or (got == i + 1).all()

@@ -8,6 +8,7 @@ fixed batch so the jit cache stays warm), and a throughput probe.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -29,14 +30,27 @@ class InferenceEngine:
 
     def __init__(self, fn: Callable, batch_size: int, seq_len: int,
                  max_wait_ms: float = 2.0, pad_id: int = 0,
-                 pass_mask: bool = False):
+                 pass_mask: bool = False, pipeline_depth: int = 2):
         self.fn = jax.jit(fn)
         self.batch_size = batch_size
         self.seq_len = seq_len
         self.max_wait = max_wait_ms / 1000.0
         self.pad_id = pad_id
         self.pass_mask = pass_mask
+        # Server-loop dispatch pipelining: up to this many batches ride
+        # the device queue before the oldest is fetched and delivered —
+        # the same dispatch-latency hiding measure_qps documents, for
+        # REAL request traffic (a blocking per-batch loop pays the full
+        # host<->device round trip per batch; ~70 ms on a tunnel-attached
+        # chip).  Depth bounds per-request latency at ~depth x batch
+        # time; 1 restores strictly serial behavior.
+        self.pipeline_depth = max(1, pipeline_depth)
         self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue]]" = queue.Queue()
+        # dispatched-but-undelivered batches; loop-owned in normal
+        # operation, but engine-level so stop() can sentinel these
+        # clients if the worker wedges in a device fetch (a tunnel
+        # outage can hang np.asarray for ~25 min)
+        self._inflight: "collections.deque" = collections.deque()
         self._halt = threading.Event()
         self._worker: Optional[threading.Thread] = None
 
@@ -74,6 +88,17 @@ class InferenceEngine:
         self._halt.set()
         if self._worker is not None:
             self._worker.join(timeout=5)
+        if self._worker is not None and self._worker.is_alive():
+            # Worker wedged (most likely a hung device fetch): sentinel
+            # the DISPATCHED clients too — their results may never
+            # arrive, and the zombie worker's late put_nowait will just
+            # hit a full queue and be dropped.
+            for _, b in list(self._inflight):
+                for _, out_q in b:
+                    try:
+                        out_q.put_nowait(None)
+                    except queue.Full:
+                        pass
         # Deliver a sentinel to requests still queued so no client blocks
         # forever on its result queue.
         while True:
@@ -90,11 +115,32 @@ class InferenceEngine:
         return out
 
     def _loop(self):
+        inflight = self._inflight
+
+        def deliver_oldest():
+            outputs, b = inflight.popleft()
+            # host fetch, not block_until_ready (unreliable on remote
+            # backends): executions are in-order per device, so pulling
+            # this batch's outputs drains everything dispatched before
+            host = np.asarray(outputs)
+            for i, (_, out_q) in enumerate(b):
+                try:
+                    # put_nowait: if stop() already sentineled this
+                    # client (hung-fetch recovery), don't wedge the
+                    # worker on its full maxsize-1 queue
+                    out_q.put_nowait(host[i])
+                except queue.Full:
+                    pass
+
         while not self._halt.is_set():
             batch: List[Tuple[np.ndarray, queue.Queue]] = []
             try:
-                batch.append(self._q.get(timeout=0.05))
+                # stay responsive while results are pending delivery
+                batch.append(self._q.get(timeout=0.002 if inflight
+                                         else 0.05))
             except queue.Empty:
+                if inflight:
+                    deliver_oldest()   # idle: drain the pipeline
                 continue
             deadline = time.monotonic() + self.max_wait
             while len(batch) < self.batch_size:
@@ -112,9 +158,11 @@ class InferenceEngine:
                 n = min(len(toks), self.seq_len)
                 tokens[i, :n] = toks[:n]
                 mask[i, :n] = 1
-            outputs = self.infer(tokens, mask)
-            for i, (_, out_q) in enumerate(batch):
-                out_q.put(np.asarray(outputs[i]))
+            inflight.append((self.infer_async(tokens, mask), batch))
+            if len(inflight) >= self.pipeline_depth:
+                deliver_oldest()
+        while inflight:                # halt: nothing may stay undelivered
+            deliver_oldest()
 
 
 def measure_qps(engine: InferenceEngine, n_batches: int = 20,
