@@ -40,6 +40,20 @@ class FakeDevice:
         return self._stats
 
 
+def _node_with_reports(reports: dict) -> dict:
+    """A 1-chip/16-GiB node whose annotation mirrors ``reports`` — the
+    shape main.py's on_usage hook patches."""
+    return {
+        "metadata": {"name": "n1",
+                     "annotations": {const.ANN_USAGE_REPORT:
+                                     json.dumps(reports)}},
+        "status": {"allocatable": {const.RESOURCE_NAME: "16",
+                                   const.COUNT_NAME: "1"},
+                   "addresses": [{"type": "InternalIP",
+                                  "address": "10.0.0.1"}]},
+    }
+
+
 def test_verify_budget_flags_advisory_backend(caplog):
     # backend ignores the fraction: process limit == full chip
     dev = FakeDevice({"bytes_limit": 16 * GIB, "peak_bytes_in_use": GIB})
@@ -98,16 +112,7 @@ def test_usage_report_roundtrip_metrics_and_inspect():
         srv.stop()
 
     # inspect side: node annotation -> OVER flag in the details render
-    node = {
-        "metadata": {"name": "n1",
-                     "annotations": {const.ANN_USAGE_REPORT:
-                                     json.dumps(seen)}},
-        "status": {"allocatable": {const.RESOURCE_NAME: "16",
-                                   const.COUNT_NAME: "1"},
-                   "addresses": [{"type": "InternalIP",
-                                  "address": "10.0.0.1"}]},
-    }
-    infos = nodeinfo.build_node_infos([node], [])
+    infos = nodeinfo.build_node_infos([_node_with_reports(seen)], [])
     reports = infos[0].usage_reports()
     assert reports["tenant-a"]["peak_bytes"] == 6 * GIB
     out = display.render_details(infos)
@@ -139,3 +144,26 @@ def test_allocate_injects_status_port(tmp_path):
     plugin.status_port = None
     resp = container_response(plugin, chip, 2, 2)
     assert const.ENV_STATUS_PORT not in resp.envs
+
+
+def test_inspect_json_carries_usage_reports(monkeypatch, capsys):
+    """-o json exposes the usage mirror machine-readably."""
+    from tests.fakes.apiserver import FakeApiServer
+    from tpushare.inspect.main import main as inspect_main
+
+    api = FakeApiServer().start()
+    try:
+        api.nodes["n1"] = _node_with_reports(
+            {"tenant-a": {"chip": 0, "grant_bytes": 4 * GIB,
+                          "peak_bytes": 6 * GIB}})
+        from tpushare.k8s.client import KubeClient
+        import tpushare.inspect.main as im
+        monkeypatch.setattr(im.KubeClient, "from_env",
+                            classmethod(lambda cls: KubeClient(api.url)))
+        rc = inspect_main(["-o", "json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        rep = out["nodes"][0]["hbm_usage"]["tenant-a"]
+        assert rep["peak_bytes"] == 6 * GIB
+    finally:
+        api.stop()

@@ -94,3 +94,20 @@ def test_tp_service_end_to_end():
     base = _drain(ContinuousBatcher(_params(), CFG, n_slots=2), [[5, 9, 2]],
                   gen=6)[0]
     assert out == base
+
+
+def test_tp_rolling_pool_matches_single_device():
+    """Rolling window-sized slots compose with tensor parallelism: the
+    ring storage shards its kv-head dim like any other KV tensor."""
+    wcfg = transformer.tiny(max_seq=96, window=16)
+    params = transformer.init_params(jax.random.PRNGKey(7), wcfg)
+    prompts = [list(range(1, 22)), [7, 8, 9]]      # one prompt > window
+
+    solo = ContinuousBatcher(params, wcfg, n_slots=2)
+    assert solo.rolling_slots
+    ref = _drain(solo, prompts, gen=20)
+
+    mesh = make_mesh({"tp": 2})
+    tp = ContinuousBatcher(params, wcfg, n_slots=2, mesh=mesh)
+    assert tp.rolling_slots and tp.caches[0].shape[3] == 16
+    assert _drain(tp, prompts, gen=20) == ref

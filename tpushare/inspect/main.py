@@ -92,6 +92,10 @@ def main(argv=None) -> int:
                     }
                     for idx, dev in sorted(info.devs.items())
                 },
+                # per-tenant HBM grant-vs-observed (daemon /usage mirror;
+                # {} when the node has no reports) — the machine-readable
+                # face of the -d table's GRANT/PEAK/OVER column
+                "hbm_usage": info.usage_reports(),
             })
         json.dump(out, sys.stdout, indent=2)
         print()
