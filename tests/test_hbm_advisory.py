@@ -148,7 +148,7 @@ def test_allocate_injects_status_port(tmp_path):
 
 def test_inspect_json_carries_usage_reports(monkeypatch, capsys):
     """-o json exposes the usage mirror machine-readably."""
-    from tests.fakes.apiserver import FakeApiServer
+    from fakes.apiserver import FakeApiServer
     from tpushare.inspect.main import main as inspect_main
 
     api = FakeApiServer().start()
