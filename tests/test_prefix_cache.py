@@ -1,0 +1,221 @@
+"""Paged PREFIX CACHE: completed requests donate their prompt's
+full-page K/V to a registry; same-prefix admissions map those pages
+read-only and prefill only the remainder.
+
+Exact by construction (a position's K/V depends only on its causal
+prefix) — asserted as token equality with per-request generate().  The
+economics: page accounting shows the shared pages are reserved once,
+and the registry evicts LRU idle prefixes under page pressure.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving.continuous import ContinuousService
+from tpushare.serving.generate import generate
+from tpushare.serving.paged import PagedContinuousBatcher
+
+pytestmark = pytest.mark.slow  # JAX compiles on the CPU mesh
+
+P = 4
+SYSTEM = list(range(1, 13))          # 12 tokens = 3 full pages
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=128)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _exp(params, cfg, p, n):
+    return [int(t) for t in generate(
+        params, cfg, jnp.asarray([p], jnp.int32), max_new_tokens=n)[0]]
+
+
+def _batcher(params, cfg, **kw):
+    kw.setdefault("page_size", P)
+    kw.setdefault("prefix_cache", True)
+    return PagedContinuousBatcher(params, cfg, n_slots=2, **kw)
+
+
+def test_prefix_registered_then_reused_exactly(model):
+    params, cfg = model
+    b = _batcher(params, cfg)
+    p1 = SYSTEM + [50, 51]
+    r1 = b.admit(p1, 6)
+    b.run_until_drained()
+    assert b.completed[r1] == _exp(params, cfg, p1, 6)
+    # completion registered the pure-prompt full pages (12+2=14 tokens
+    # -> 3 full pages of 4)
+    assert len(b._prefixes) == 1
+    (key,) = b._prefixes
+    assert list(key) == p1[:12]
+    assert b._prefixes[key].active == 0
+
+    # a same-prefix request reserves ONLY its own remainder pages
+    free_before = b.free_page_count()
+    p2 = SYSTEM + [77, 78, 79]
+    r2 = b.admit_chunked(p2, 9, chunk=P)
+    st = list(b.prefilling.values())[0]
+    assert st.pos == 12                  # shared region skipped
+    need_full = -(-(len(p2) + 9) // P)   # 6 pages without sharing
+    assert free_before - b.free_page_count() == need_full - 3
+    b.run_until_drained()
+    assert b.completed[r2] == _exp(params, cfg, p2, 9)
+    assert b._prefixes[key].active == 0  # decref on completion
+
+
+def test_shared_pages_are_never_written(model):
+    params, cfg = model
+    b = _batcher(params, cfg)
+    p1 = SYSTEM + [50]
+    b.admit(p1, 4)
+    b.run_until_drained()
+    (key,) = b._prefixes
+    pages = b._prefixes[key].pages
+    kp_before = np.asarray(b.pools[0][:, pages])   # [L, 3, Hkv, P, D]
+    # a sharing request prefills + decodes well past the prefix
+    r2 = b.admit(SYSTEM + [60, 61, 62, 63], 20)
+    b.run_until_drained()
+    assert b.completed[r2] == _exp(params, cfg, SYSTEM + [60, 61, 62, 63],
+                                   20)
+    kp_after = np.asarray(b.pools[0][:, pages])
+    assert (kp_before == kp_after).all(), "registry pages were mutated"
+
+
+def test_prefix_eviction_under_page_pressure(model):
+    params, cfg = model
+    # pool sized so the long request FITS ONLY if the registry gives
+    # its pages back: 32 usable pages, long needs 31, and 3 are parked
+    # on the cached prefix after the first completion (29 free)
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=P,
+                               n_pages=33, prefix_cache=True)
+    p1 = SYSTEM + [50]
+    b.admit(p1, 4)
+    b.run_until_drained()
+    assert b._prefixes
+    # a full-length UNRELATED request needs every page the pool has
+    long = [99] * 100
+    rid = b.admit(long, 24)
+    assert rid is not None, "eviction should have freed registry pages"
+    b.run_until_drained()
+    assert b.completed[rid] == _exp(params, cfg, long, 24)
+    # the ORIGINAL prefix was evicted to make room (the long request may
+    # have registered its own afterwards — that's the cache working)
+    assert tuple(p1[:12]) not in b._prefixes
+
+
+def test_cancelled_prefill_never_registers(model):
+    params, cfg = model
+    b = _batcher(params, cfg)
+    rid = b.admit_chunked(SYSTEM + [50, 51, 52], 8, chunk=P)
+    b.advance_prefill()
+    assert b.cancel(rid)
+    assert not b._prefixes                 # partial K/V is not donated
+    assert b.free_page_count() == b.n_pages - 1
+
+
+def test_prefix_cache_through_service_mixed_traffic(model):
+    params, cfg = model
+    svc = ContinuousService(params, cfg, n_slots=2, page_size=P,
+                            prefill_chunk=P, prefix_cache=True).start()
+    try:
+        reqs = [(SYSTEM + [50, 51], 8), (SYSTEM + [60], 10),
+                ([7, 7, 7, 7, 7], 6), (SYSTEM + [50, 51], 8)]
+        sinks = [svc.submit(p, n) for p, n in reqs]
+        for (p, n), s in zip(reqs, sinks):
+            assert s.get(timeout=120) == _exp(params, cfg, p, n)
+    finally:
+        svc.stop()
+
+
+def test_prefix_cache_rejects_windowed_and_dense(model):
+    params, cfg = model
+    wcfg = transformer.tiny(max_seq=64, window=16)
+    wparams = transformer.init_params(jax.random.PRNGKey(0), wcfg)
+    with pytest.raises(ValueError, match="full-causal"):
+        PagedContinuousBatcher(wparams, wcfg, n_slots=1, page_size=P,
+                               prefix_cache=True)
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousService(params, cfg, n_slots=1, prefix_cache=True)
+
+
+def test_matched_prefix_never_evicts_itself(model):
+    """A matched (claimed) prefix must survive page-pressure eviction:
+    admission fails with backpressure rather than aliasing its own
+    shared pages; the claim is rolled back."""
+    params, cfg = model
+    # 16 usable pages; after the first completion 3 park on the registry
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=P,
+                               n_pages=17, prefix_cache=True)
+    p1 = SYSTEM + [50]
+    b.admit(p1, 3)
+    b.run_until_drained()
+    (key,) = b._prefixes
+    # an ACTIVE filler pins 10 pages (free drops to 3)...
+    filler = b.admit_chunked([77] * 20, 20, chunk=P)
+    assert filler is not None and b.free_page_count() == 3
+    # ...so the same-prefix request's own remainder (7 ranges - 3
+    # shared = 4) cannot fit, and the ONLY idle registry entry is the
+    # prefix it just matched: must refuse, never self-evict
+    rid = b.admit(SYSTEM + [51], 15)
+    assert rid is None                       # backpressure, not aliasing
+    assert key in b._prefixes
+    assert b._prefixes[key].active == 0      # claim rolled back
+    b.run_until_drained()                    # filler completes
+    rid2 = b.admit(SYSTEM + [52], 4)
+    assert rid2 is not None
+    b.run_until_drained()
+    assert b.completed[rid2] == _exp(params, cfg, SYSTEM + [52], 4)
+
+
+def test_unchunked_admit_streams_past_shared_prefix(model, monkeypatch):
+    """admit() (whole-prompt) must not run the monolithic page walk over
+    a shared prefix — registry pages are read-only; the remainder
+    streams through the chunk body instead."""
+    import tpushare.serving.paged as paged_mod
+
+    params, cfg = model
+    b = _batcher(params, cfg)
+    b.admit(SYSTEM + [50], 3)
+    b.run_until_drained()
+
+    calls = []
+    real = paged_mod._prefill
+    monkeypatch.setattr(paged_mod, "_prefill",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    p2 = SYSTEM + [61, 62]
+    rid = b.admit(p2, 5)
+    assert not calls, "monolithic page walk ran over registry pages"
+    b.run_until_drained()
+    assert b.completed[rid] == _exp(params, cfg, p2, 5)
+
+
+def test_registry_budget_evicts_idle_for_new_prefix(model):
+    params, cfg = model
+    b = _batcher(params, cfg)
+    b.max_cached_pages = 3                   # room for exactly one prefix
+    b.admit(SYSTEM + [50], 3)
+    b.run_until_drained()
+    key_a = tuple(SYSTEM)
+    assert key_a in b._prefixes
+    other = [90 + (j % 7) for j in range(14)]
+    b.admit(other + [50], 3)
+    b.run_until_drained()
+    key_b = tuple(other[:12])
+    assert key_b in b._prefixes, "budget blocked the hot new prefix"
+    assert key_a not in b._prefixes          # idle LRU evicted
+
+
+def test_max_new_one_requests_seed_the_registry(model):
+    """Scoring-style traffic (max_new=1) is exactly shared-prefix
+    traffic; its completions must donate pages too."""
+    params, cfg = model
+    b = _batcher(params, cfg)
+    rid = b.admit(SYSTEM + [50], 1)
+    assert rid in b.completed                # completed at activation
+    assert tuple(SYSTEM) in b._prefixes

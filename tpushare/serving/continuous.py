@@ -294,7 +294,8 @@ class _Slot:
     length: int          # tokens currently in the slot's cache + pending
     remaining: int       # tokens still to generate
     last_token: int
-    output: List[int]
+    output: List[int]    # prompt + generated (completed value)
+    prompt_len: int = 0
     temperature: float = 0.0
     key: Optional[jnp.ndarray] = None
     eos_id: Optional[int] = None
@@ -376,9 +377,18 @@ class ContinuousBatcher:
                 "slots_per_gib": (2 ** 30) // bytes_per_slot,
                 "pool_bytes": int(bytes_per_slot * self.n_slots)}
 
-    def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
-        """Claim per-request storage; False = backpressure (no admit)."""
-        return True                     # dense rows are pre-reserved
+    def _reserve(self, slot: int, prompt_len: int, max_new: int,
+                 prompt: Optional[List[int]] = None) -> bool:
+        """Claim per-request storage; False = backpressure (no admit).
+        ``prompt`` rides along for storages that can share it (the paged
+        prefix cache); dense rows are pre-reserved and ignore it."""
+        return True
+
+    def _prefill_start(self, slot: int) -> int:
+        """First prompt position admission must actually PREFILL —
+        storages serving a cached prefix (paged prefix cache) return
+        its length; everything else starts at 0."""
+        return 0
 
     def _release(self, slot: int) -> None:
         """Return per-request storage on completion."""
@@ -465,7 +475,8 @@ class ContinuousBatcher:
         if not free:
             return None
         slot = free[0]
-        if not self._reserve(slot, len(prompt), max_new_tokens):
+        if not self._reserve(slot, len(prompt), max_new_tokens,
+                             prompt=prompt):
             return None
         rid = self._next_id
         self._next_id += 1
@@ -501,11 +512,21 @@ class ContinuousBatcher:
         output = list(prompt) + [first]
         if remaining == 0 or (eos_id is not None and first == eos_id):
             self.completed[rid] = output
+            # release through a REAL slot record, like every other
+            # completion — storages that inspect the finished slot at
+            # release (the paged prefix cache donates pure-prompt pages)
+            # must see max_new=1 / instant-eos requests too
+            self.slots[slot] = _Slot(
+                request_id=rid, length=len(prompt), remaining=0,
+                last_token=first, output=output,
+                prompt_len=len(prompt), temperature=temperature)
             self._release(slot)
+            del self.slots[slot]
             return
         self.slots[slot] = _Slot(request_id=rid, length=len(prompt),
                                  remaining=remaining, last_token=first,
-                                 output=output, temperature=temperature,
+                                 output=output, prompt_len=len(prompt),
+                                 temperature=temperature,
                                  key=key, eos_id=eos_id,
                                  top_k=top_k, top_p=top_p)
 
@@ -529,12 +550,14 @@ class ContinuousBatcher:
         if not free:
             return None
         slot = free[0]
-        if not self._reserve(slot, len(prompt), max_new_tokens):
+        if not self._reserve(slot, len(prompt), max_new_tokens,
+                             prompt=prompt):
             return None
         rid = self._next_id
         self._next_id += 1
         self.prefilling[slot] = _Prefill(
-            request_id=rid, prompt=list(prompt), pos=0,
+            request_id=rid, prompt=list(prompt),
+            pos=self._prefill_start(slot),
             max_new=max_new_tokens, temperature=temperature, seed=seed,
             chunk=chunk, eos_id=eos_id, top_k=top_k, top_p=top_p)
         return rid
@@ -821,7 +844,8 @@ class ContinuousService:
                  mesh=None,
                  spec_k: int = 0,
                  spec_ngram: int = 2,
-                 spec_rounds: Optional[int] = None):
+                 spec_rounds: Optional[int] = None,
+                 prefix_cache: bool = False):
         import queue as _q
         import threading
 
@@ -870,8 +894,12 @@ class ContinuousService:
             from .paged import PagedContinuousBatcher
             self._batcher = PagedContinuousBatcher(
                 params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
-                mesh=mesh, max_prefill_chunk=self._prefill_chunk)
+                mesh=mesh, max_prefill_chunk=self._prefill_chunk,
+                prefix_cache=prefix_cache)
         else:
+            if prefix_cache:
+                raise ValueError("prefix_cache rides the paged pool; "
+                                 "pass page_size too")
             self._batcher = ContinuousBatcher(params, cfg, n_slots, mesh=mesh)
         if self._spec_k and (page_size is not None
                              or self._batcher.rolling_slots):

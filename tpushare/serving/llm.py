@@ -66,7 +66,8 @@ class LLMServer:
                  page_size: int = 0,
                  n_pages: int = 0,
                  tp: int = 0,
-                 spec_k: int = 0):
+                 spec_k: int = 0,
+                 prefix_cache: bool = False):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
@@ -102,7 +103,8 @@ class LLMServer:
                 page_size=page_size or None,
                 n_pages=n_pages or None,
                 mesh=mesh,
-                spec_k=spec_k).start()
+                spec_k=spec_k,
+                prefix_cache=prefix_cache).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -467,7 +469,13 @@ def main(argv=None) -> int:
                     help="prompt-lookup speculation depth for all-greedy "
                          "batches (0 = off; greedy-exact; requires "
                          "--slots, dense pool)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="reuse completed requests' prompt-prefix KV "
+                         "pages for same-prefix admissions (requires "
+                         "--page-size; full-causal models)")
     args = ap.parse_args(argv)
+    if args.prefix_cache and not args.page_size:
+        ap.error("--prefix-cache requires --page-size")
     if args.spec_k and not args.slots:
         ap.error("--spec-k requires --slots")
     if args.spec_k and args.page_size:
@@ -496,7 +504,7 @@ def main(argv=None) -> int:
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp,
-                    spec_k=args.spec_k)
+                    spec_k=args.spec_k, prefix_cache=args.prefix_cache)
     log.info("llm server: model=%s quant=%s tp=%d on :%d", args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
              args.tp, srv.port)

@@ -33,8 +33,10 @@ chip (pkg/gpu/nvidia/nvidia.go:73-85 fan-out).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -107,12 +109,30 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     return toks.T, keys, pools
 
 
+@dataclasses.dataclass
+class _CachedPrefix:
+    """A registered prompt prefix whose K/V pages live in the pool.
+
+    ``pages`` are REGISTRY-owned (not any slot's): admitted requests
+    map them read-only into their page tables and bump ``active``;
+    nothing ever writes a registered page (decode/prefill writes start
+    past the shared region, garbage writes are aimed at each slot's own
+    positions).  Evictable only at active == 0.
+    """
+
+    tokens: tuple          # the full-page prefix, exactly
+    pages: list            # physical pages, in position order
+    active: int = 0        # slots currently mapping these pages
+    last_used: float = 0.0
+
+
 class PagedContinuousBatcher(ContinuousBatcher):
     """Dense batcher with the storage hooks swapped for a paged pool."""
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  page_size: int = 16, n_pages: Optional[int] = None,
-                 mesh=None, max_prefill_chunk: int = 64):
+                 mesh=None, max_prefill_chunk: int = 64,
+                 prefix_cache: bool = False):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
@@ -123,6 +143,24 @@ class PagedContinuousBatcher(ContinuousBatcher):
         self.max_prefill_chunk = max(
             page_size,
             -(-max_prefill_chunk // page_size) * page_size)
+        # PREFIX CACHE (vLLM-style, full-causal only): completed
+        # requests donate their prompt's full pages to a registry;
+        # later requests whose prompt starts with a registered prefix
+        # map those pages read-only into their table and prefill only
+        # the remainder.  Exact by construction — a position's K/V
+        # depends only on its causal prefix, so same-prefix K/V is the
+        # same K/V.  A windowed page RING recycles pages in place, so
+        # the two features are mutually exclusive.
+        if prefix_cache and transformer.wants_rolling(cfg):
+            raise ValueError("prefix_cache requires a full-causal config "
+                             "(the windowed page ring recycles pages)")
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self._prefixes: Dict[tuple, _CachedPrefix] = {}
+        self._slot_prefix: Dict[int, tuple] = {}   # slot -> registry key
+        self._slot_shared: Dict[int, int] = {}     # slot -> shared tokens
+        #: registry HBM budget: at most this many pages parked on
+        #: cached prefixes (evicted LRU at zero active when needed)
+        self.max_cached_pages = self.pages_per_slot * 2
         # Default pool: every slot can hold a full max_seq sequence (the
         # dense equivalent + 1 trash page). Pass a smaller n_pages to
         # overcommit slots against the real traffic mix — the point.
@@ -192,38 +230,147 @@ class PagedContinuousBatcher(ContinuousBatcher):
             return min(n_ranges, w_pages + c_pages + 1)
         return n_ranges
 
-    def _reserve(self, slot: int, prompt_len: int, max_new: int) -> bool:
+    def _lookup_prefix(self, prompt: List[int]) -> Optional[_CachedPrefix]:
+        """Longest registered prefix usable for this prompt: a full-page
+        token prefix, capped one token short of the prompt (admission
+        must still prefill >= 1 position to produce the first logits)."""
+        if not self.prefix_cache_enabled or prompt is None:
+            return None
+        usable = ((len(prompt) - 1) // self.page_size) * self.page_size
+        best = None
+        for key, entry in self._prefixes.items():
+            n = len(key)
+            if (n <= usable and tuple(prompt[:n]) == key
+                    and (best is None or n > len(best.tokens))):
+                best = entry
+        return best
+
+    def _evict_prefixes(self, need_pages: int,
+                        registry_room: int = 0) -> None:
+        """Free LRU zero-active cached prefixes until ``need_pages``
+        free pages exist AND ``registry_room`` more cached pages would
+        fit the budget (or nothing evictable remains).  Entries with
+        active mappings are never victims — a matched prefix must bump
+        ``active`` BEFORE any eviction runs, or it could evict itself
+        and alias its pages."""
+        def _over():
+            cached = sum(len(e.pages) for e in self._prefixes.values())
+            return (len(self._free_pages) < need_pages
+                    or cached + registry_room > self.max_cached_pages)
+
+        while _over():
+            idle = [e for e in self._prefixes.values() if e.active == 0]
+            if not idle:
+                return
+            victim = min(idle, key=lambda e: e.last_used)
+            del self._prefixes[victim.tokens]
+            self._free_pages.extend(victim.pages)
+
+    def _reserve(self, slot: int, prompt_len: int, max_new: int,
+                 prompt: Optional[List[int]] = None) -> bool:
         n_ranges = -(-(prompt_len + max_new) // self.page_size)
         held = self._held_pages(prompt_len, max_new)
-        if held > len(self._free_pages):
+        shared = self._lookup_prefix(prompt) if held == n_ranges else None
+        n_shared = len(shared.pages) if shared is not None else 0
+        if shared is not None:
+            # claim BEFORE any eviction: an idle matched entry must not
+            # be its own eviction victim (pages would alias)
+            shared.active += 1
+            shared.last_used = time.monotonic()
+        own = held - n_shared
+        if own > len(self._free_pages):
+            self._evict_prefixes(own)
+        if own > len(self._free_pages):
+            if shared is not None:
+                shared.active -= 1      # claim rolled back
             return False                # page backpressure
-        pages = [self._free_pages.pop() for _ in range(held)]
+        pages = [self._free_pages.pop() for _ in range(own)]
         self.page_table[slot, :] = 0
-        # STATIC ring mapping: position range j -> pages[j % held]; for
-        # full-causal requests held == n_ranges so this is the identity
-        # layout.  No mid-decode table updates, ever — the fixed-table
-        # invariant _tick_n depends on holds by construction.
-        for j in range(n_ranges):
-            self.page_table[slot, j] = pages[j % held]
+        if shared is not None:
+            # read-only mapping of the registry's pages over the shared
+            # prefix; this slot's own pages take over from there
+            self.page_table[slot, :n_shared] = shared.pages
+            self._slot_prefix[slot] = shared.tokens
+            self._slot_shared[slot] = n_shared * self.page_size
+            for j in range(n_shared, n_ranges):
+                self.page_table[slot, j] = pages[j - n_shared]
+        else:
+            # STATIC ring mapping: position range j -> pages[j % held];
+            # for full-causal requests held == n_ranges so this is the
+            # identity layout.  No mid-decode table updates, ever — the
+            # fixed-table invariant _tick_n depends on holds by
+            # construction.
+            for j in range(n_ranges):
+                self.page_table[slot, j] = pages[j % held]
         self._slot_pages[slot] = pages
         return True
 
+    def _prefill_start(self, slot: int) -> int:
+        return self._slot_shared.get(slot, 0)
+
     def _release(self, slot: int) -> None:
+        key = self._slot_prefix.pop(slot, None)
+        self._slot_shared.pop(slot, None)
+        if key is not None:
+            entry = self._prefixes.get(key)
+            if entry is not None:
+                entry.active -= 1
+                entry.last_used = time.monotonic()
+        elif self.prefix_cache_enabled:
+            self._maybe_register(slot)
         self.page_table[slot, :] = 0
         self._free_pages.extend(self._slot_pages.pop(slot, []))
 
+    def _maybe_register(self, slot: int) -> None:
+        """Donate a COMPLETED request's pure-prompt full pages to the
+        prefix registry (instead of freeing them), so the next
+        same-prefix request skips their prefill.
+
+        Only decoding slots register (a cancelled mid-prefill slot's
+        pages are part-garbage), only prefixes not already registered,
+        and only pages holding PROMPT positions exclusively — the page
+        containing prompt_len onward has decode writes.  Slots that
+        themselves mapped a cached prefix just decref (the registry
+        keeps the canonical pages); extension registration is future
+        work.
+        """
+        s = self.slots.get(slot)
+        if s is None or s.prompt_len <= 1:
+            return
+        k_pure = s.prompt_len // self.page_size     # whole-prompt pages
+        if k_pure < 1:
+            return
+        key = tuple(s.output[:k_pure * self.page_size])
+        if key in self._prefixes:
+            return
+        self._evict_prefixes(0, registry_room=k_pure)
+        cached_now = sum(len(e.pages) for e in self._prefixes.values())
+        if cached_now + k_pure > self.max_cached_pages:
+            return                      # nothing idle to evict
+        own = self._slot_pages.get(slot, [])
+        # full-causal identity layout: table row j == own[j]
+        donated = [int(p) for p in self.page_table[slot, :k_pure]]
+        if any(p == 0 for p in donated) or len(own) < k_pure:
+            return                      # defensive: never donate trash
+        self._prefixes[key] = _CachedPrefix(
+            tokens=key, pages=donated, active=0,
+            last_used=time.monotonic())
+        self._slot_pages[slot] = [p for p in own if p not in set(donated)]
+
     def _prefill_into(self, slot: int, tokens, prompt_len: int):
         span = len(self._slot_pages.get(slot, ())) * self.page_size
-        if (transformer.wants_rolling(self.cfg) and span
-                and prompt_len > span):
-            # whole-prompt prefill wider than the page ring would alias
-            # ranges inside one static page walk — stream it through
-            # max_prefill_chunk-sized page-aligned chunks (the bound the
-            # ring is sized for), the bit-exact chunk body chunked
-            # admission uses
+        start = self._prefill_start(slot)
+        if start or (transformer.wants_rolling(self.cfg) and span
+                     and prompt_len > span):
+            # Stream through max_prefill_chunk-sized page-aligned
+            # chunks (the bit-exact chunk body chunked admission uses)
+            # when either (a) a cached PREFIX covers the prompt's head —
+            # the whole-prompt page walk would rewrite registry-owned
+            # pages other slots are mapping — or (b) a whole-prompt walk
+            # would alias the windowed page ring.
             row = np.asarray(tokens).reshape(-1)[:prompt_len]
             step = self.max_prefill_chunk
-            pos, logits_v = 0, None
+            pos, logits_v = start, None
             while pos < prompt_len:
                 # FIXED window width (advance_prefill's compile-count
                 # discipline: widths stay in {step, max_seq - pos}, so a
