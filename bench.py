@@ -263,6 +263,43 @@ def main() -> int:
     result.update(value=round(stats["qps"], 2), attention=attn_path,
                   qps_streamed=round(stats["qps"], 2))
 
+    # --- serving latency: TTFT / per-token time from the new engine
+    # histograms.  A short burst of single-row requests through the
+    # submit->deliver path feeds tpushare_engine_ttft_seconds /
+    # _tpot_seconds; p50 of those lands in the record.  Recorded only on
+    # TPU — on the CPU fallback the numbers would describe the fallback
+    # host, not the accelerator this record is about, so they stay null.
+    watch["stage"] = "latency-measure"
+    ttft_s = tpot_s = None
+    if on_tpu:      # CPU fallback records nulls; don't burn degraded-run
+        try:        # wall time measuring numbers the record discards
+            from tpushare.serving import metrics as serving_metrics
+            _log("measuring ttft/tpot through the submit path...")
+            engine.start()
+            try:
+                sinks = [engine.submit(np.random.randint(
+                    1, 100, size=(seq,), dtype=np.int32))
+                    for _ in range(batch * 2)]
+                for s in sinks:
+                    if s.get(timeout=300) is None:
+                        raise RuntimeError("engine shut down mid-measure")
+            finally:
+                engine.stop()
+            ttft_s = serving_metrics.TTFT.quantile(0.5)
+            tpot_s = serving_metrics.TPOT.quantile(0.5)
+            if ttft_s is not None:
+                _log(f"ttft p50 = {ttft_s * 1000:.2f} ms")
+        except Exception as e:
+            # latency fields are OPTIONAL record enrichment; never let
+            # them kill the round's one JSON line
+            _log(f"latency measure failed ({type(e).__name__}: "
+                 f"{str(e)[:200]}); recording nulls")
+    result.update(
+        ttft_ms=(round(ttft_s * 1000.0, 2)
+                 if ttft_s is not None else None),
+        tpot_ms=(round(tpot_s * 1000.0, 3)
+                 if tpot_s is not None else None))
+
     # --- offline (device-resident) throughput: the headline ---------------
     # The tunnel-attached chip pays ~70 ms of RPC overhead PER DISPATCH
     # (measured round 2: a 2 ms grad and a 7 ms forward both take ~76 ms

@@ -97,9 +97,12 @@ def test_usage_report_roundtrip_metrics_and_inspect():
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
             body = r.read().decode()
-        assert ('tpushare_tenant_hbm_grant_bytes{pod="tenant-a",'
-                'over_grant="true"}') in body
-        assert f"{6 * GIB}" in body
+        # per-tenant usage exported as proper gauges on the shared
+        # registry (labels render in sorted key order)
+        assert ('tpushare_hbm_grant_bytes{over_grant="true",'
+                'pod="tenant-a"}') in body
+        assert ('tpushare_hbm_peak_bytes{over_grant="true",'
+                f'pod="tenant-a"}} {6 * GIB}') in body
         # a well-behaved tenant reports ok
         dev2 = FakeDevice({"bytes_limit": 16 * GIB,
                            "peak_bytes_in_use": 2 * GIB})

@@ -1,0 +1,164 @@
+"""``kubectl inspect tpushare --metrics``: per-node serving stats e2e.
+
+Drives the full chain against fakes: serving-plane series in the
+process-global registry -> StatusServer /metrics (Prometheus text) ->
+inspect's fetch + strict parse + bucket-quantile math -> rendered table
+/ json.  ISSUE-1 acceptance: engine qps, TTFT p50/p99, batch occupancy,
+and KV-page utilization all render.
+"""
+
+import json
+
+from tpushare import telemetry
+from tpushare.inspect import metricsview
+from tpushare.inspect.main import main as inspect_main
+from tpushare.plugin.status import StatusServer
+
+from fakes.apiserver import FakeApiServer
+from test_inspect import make_node
+
+
+def _seed_serving_metrics():
+    """Stand in for a serving process: the same get-or-create names the
+    serving plane registers (tpushare/serving/metrics.py)."""
+    telemetry.gauge("tpushare_engine_qps",
+                    "Queries/s from the most recent throughput "
+                    "measurement").set(123.45)
+    ttft = telemetry.histogram(
+        "tpushare_engine_ttft_seconds", "Time to first output per request")
+    ttft.clear()
+    for _ in range(98):
+        ttft.observe(0.004)        # p50 lane: (0.0025, 0.005]
+    ttft.observe(0.4)
+    ttft.observe(0.4)              # p99 lane: (0.25, 0.5]
+    telemetry.gauge("tpushare_batch_occupancy",
+                    "Active decoding slots / slot capacity").set(0.75)
+    telemetry.gauge("tpushare_kv_pages_used",
+                    "KV pool pages currently reserved").set(30)
+    telemetry.gauge("tpushare_kv_pages_free",
+                    "KV pool pages on the free list").set(10)
+
+
+def test_summarize_serving_quantiles():
+    _seed_serving_metrics()
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+    s = metricsview.summarize_serving(parsed)
+    assert s["qps"] == 123.45
+    assert 0.0025 < s["ttft_p50_s"] <= 0.005
+    assert 0.25 < s["ttft_p99_s"] <= 0.5
+    assert s["occupancy"] == 0.75
+    assert s["kv_util"] == 0.75
+
+
+def _run_inspect(monkeypatch, api, argv):
+    from tpushare.k8s.client import KubeClient
+    import tpushare.inspect.main as im
+    monkeypatch.setattr(im.KubeClient, "from_env",
+                        classmethod(lambda cls: KubeClient(api.url)))
+    return inspect_main(argv)
+
+
+def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
+    _seed_serving_metrics()
+    srv = StatusServer(0).start()       # serves the seeded registry
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-a"] = make_node("node-a", ip="127.0.0.1")
+        rc = _run_inspect(monkeypatch, api,
+                          ["--metrics", "--metrics-port", str(srv.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # binpack view still leads; the metrics table rides next to it
+        assert "TPU0(Allocated/Total)" in out
+        assert "Serving metrics:" in out
+        assert "QPS" in out and "123.45" in out
+        assert "TTFT p50(ms)" in out and "TTFT p99(ms)" in out
+        assert "75%" in out                       # occupancy
+        assert "30/10 (75%)" in out               # KV pages used/free (util)
+    finally:
+        api.stop()
+        srv.stop()
+
+
+def test_inspect_metrics_json_and_unreachable(monkeypatch, capsys):
+    _seed_serving_metrics()
+    srv = StatusServer(0).start()
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-a"] = make_node("node-a", ip="127.0.0.1")
+        # node-b's daemon is down: its row must say so, not fail the view
+        api.nodes["node-b"] = make_node("node-b", ip="203.0.113.1")
+        monkeypatch.setattr(metricsview, "fetch_node_metrics",
+                            _fetch_local_only(srv.port))
+        rc = _run_inspect(monkeypatch, api,
+                          ["-o", "json", "--metrics",
+                           "--metrics-port", str(srv.port)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        by_name = {n["name"]: n for n in out["nodes"]}
+        serving = by_name["node-a"]["serving"]
+        assert serving["qps"] == 123.45
+        assert 0.0025 < serving["ttft_p50_s"] <= 0.005
+        assert serving["occupancy"] == 0.75
+        assert "error" in by_name["node-b"]["serving"]
+    finally:
+        api.stop()
+        srv.stop()
+
+
+def _fetch_local_only(port):
+    """Fetch 127.0.0.1 for real; fail fast for any other address (the
+    dead-node case) instead of waiting out a TCP timeout on a
+    TEST-NET address."""
+    real = metricsview.fetch_node_metrics
+
+    def fetch(address, p, timeout=3.0):
+        if address != "127.0.0.1":
+            raise OSError("no route (test)")
+        return real(address, p, timeout=timeout)
+
+    return fetch
+
+
+def test_multi_port_merge_and_parse_ports():
+    """Daemon + workload server each expose part of the namespace; a
+    comma port list merges them into one per-node summary."""
+    assert metricsview.parse_ports(9102) == [9102]
+    assert metricsview.parse_ports("9102,8000") == [9102, 8000]
+
+    daemon = telemetry.parse_text(
+        "# TYPE tpushare_chips gauge\ntpushare_chips 2\n")
+    _seed_serving_metrics()
+    serving = telemetry.parse_text(telemetry.REGISTRY.render())
+    merged = metricsview.merge_parsed([daemon, serving])
+    assert merged["samples"]["tpushare_chips"] == [({}, 2.0)]
+    s = metricsview.summarize_serving(merged)
+    assert s["qps"] == 123.45 and s["occupancy"] == 0.75
+
+
+def test_gather_rows_errors_only_when_all_ports_fail(monkeypatch):
+    _seed_serving_metrics()
+    srv = StatusServer(0).start()
+    try:
+        class Info:
+            name, address, total_mem = "n1", "127.0.0.1", 64
+
+        # dead port + live port -> summary (not unreachable)
+        rows = metricsview.gather_metrics_rows(
+            [Info()], f"1,{srv.port}", timeout=2.0)
+        assert rows[0][2] is not None and rows[0][2]["qps"] == 123.45
+        rows = metricsview.gather_metrics_rows([Info()], "1", timeout=2.0)
+        assert rows[0][2] is None and "unreachable" in rows[0][3]
+    finally:
+        srv.stop()
+
+
+def test_render_metrics_table_handles_missing_series():
+    out = metricsview.render_metrics_table(
+        [("n1", "10.0.0.1", {"qps": None, "ttft_p50_s": None,
+                             "ttft_p99_s": None, "occupancy": None,
+                             "kv_pages_used": None, "kv_pages_free": None,
+                             "kv_util": None}, None),
+         ("n2", "10.0.0.2", None, "unreachable (OSError)")])
+    assert "n1" in out and "-" in out
+    assert "unreachable (OSError)" in out

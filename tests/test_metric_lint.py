@@ -1,0 +1,61 @@
+"""Metric-namespace lint: every registered series stays Prometheus-clean.
+
+Imports every instrumented module (both planes) so their module-level
+registrations land, then checks the whole registry against the naming
+contract:
+
+* every family matches ``^tpushare_[a-z0-9_]+$``;
+* counters end in ``_total`` (and nothing else does);
+* time histograms end in ``_seconds``;
+* byte-valued series end in ``_bytes``; ``_bytes`` implies gauge here
+  (no byte counters exist yet).
+
+This is the test that keeps the namespace coherent as instrumentation
+grows — a new metric that breaks the conventions fails CI, not a
+dashboard review.
+"""
+
+import re
+
+NAME_RE = re.compile(r"^tpushare_[a-z0-9_]+$")
+
+
+def _registered():
+    # the instrumented modules register at import
+    import tpushare.inspect.metricsview  # noqa: F401 (parser side)
+    import tpushare.kubelet.client  # noqa: F401
+    import tpushare.plugin.allocate  # noqa: F401
+    import tpushare.plugin.status  # noqa: F401
+    import tpushare.serving.metrics  # noqa: F401
+    from tpushare import telemetry
+
+    return telemetry.REGISTRY.describe()
+
+
+def test_every_metric_name_is_prometheus_clean():
+    described = _registered()
+    assert described, "no metrics registered?"
+    bad = [n for n, _, _ in described if not NAME_RE.match(n)]
+    assert not bad, f"non-conforming metric names: {bad}"
+
+
+def test_unit_suffix_conventions():
+    for name, kind, _ in _registered():
+        if kind == "counter":
+            assert name.endswith("_total"), \
+                f"counter {name} must end in _total"
+        else:
+            assert not name.endswith("_total"), \
+                f"{kind} {name} must not claim the counter suffix _total"
+        if kind == "histogram":
+            assert name.endswith("_seconds"), \
+                f"time histogram {name} must end in _seconds"
+        if name.endswith("_bytes"):
+            assert kind == "gauge", \
+                f"{name}: _bytes series are gauges in this namespace"
+
+
+def test_every_metric_has_help_text():
+    for name, _, help_text in _registered():
+        assert help_text and help_text != name, \
+            f"{name} needs real HELP text"

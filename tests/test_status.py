@@ -13,6 +13,12 @@ def _get(port, path):
         return r.status, r.read().decode()
 
 
+def _get_with_type(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type")
+
+
 def test_status_endpoints(tmp_path):
     backend = discovery.FakeBackend(n_chips=2, generation="v5e")
     plugin = TpuDevicePlugin(backend,
@@ -24,11 +30,19 @@ def test_status_endpoints(tmp_path):
         assert code == 200 and body == "ok\n"
 
         status.inc("tpushare_allocations_total")
-        code, body = _get(srv.port, "/metrics")
+        code, body, ctype = _get_with_type(srv.port, "/metrics")
         assert code == 200
+        # Prometheus exposition contract: version-negotiated content
+        # type, HELP/TYPE metadata for every family
+        assert ctype.startswith("text/plain; version=0.0.4")
         assert "tpushare_allocations_total" in body
+        assert "# HELP tpushare_allocations_total" in body
+        assert "# TYPE tpushare_allocations_total counter" in body
+        assert "# TYPE tpushare_devices gauge" in body
         assert 'tpushare_devices{state="healthy"} 32' in body
         assert "tpushare_chips 2" in body
+        from tpushare import telemetry
+        telemetry.parse_text(body)   # strict-parses end to end
 
         plugin.apply_health_event(
             discovery.HealthEvent(0, healthy=False, reason="test"))
@@ -38,6 +52,47 @@ def test_status_endpoints(tmp_path):
 
         code, body = _get(srv.port, "/debug/stacks")
         assert code == 200 and "thread" in body
+    finally:
+        srv.stop()
+
+
+def test_scrape_only_metrics_listener_hides_ingest_and_debug():
+    """The public listener must expose ONLY the read-only exposition:
+    /usage (unauthenticated write) and /debug/* (stack/trace leaks)
+    stay on the loopback-bound full surface."""
+    import json
+    import urllib.error
+
+    srv = StatusServer(0, metrics_port=0, metrics_addr="127.0.0.1").start()
+    try:
+        assert srv.metrics_port and srv.metrics_port != srv.port
+        code, body, ctype = _get_with_type(srv.metrics_port, "/metrics")
+        assert code == 200 and ctype.startswith("text/plain; version=0.0.4")
+        assert "tpushare_allocations_total" in body
+        code, body = _get(srv.metrics_port, "/healthz")
+        assert code == 200 and body == "ok\n"
+        for path in ("/debug/stacks", "/debug/trace"):
+            try:
+                _get(srv.metrics_port, path)
+                raise AssertionError(f"{path} exposed on scrape listener")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.metrics_port}/usage",
+            data=json.dumps({"pod": "evil", "peak_bytes": 1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("/usage exposed on scrape listener")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # the full surface still ingests
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/usage",
+            data=json.dumps({"pod": "ok"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
     finally:
         srv.stop()
 

@@ -1,0 +1,262 @@
+"""Telemetry core: registry semantics, exposition format, ring tracer.
+
+The ISSUE-1 acceptance surface: histogram bucket correctness, concurrent
+inc() from threads, trace-buffer wraparound, /debug/trace parsing as
+valid Chrome trace JSON, and /metrics passing a strict Prometheus
+text-format parse.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from tpushare import telemetry
+from tpushare.telemetry.registry import Registry, quantile_from_buckets
+from tpushare.telemetry.trace import Tracer
+
+
+# ---------------------------------------------------------------- registry
+def test_counter_inc_and_labels():
+    reg = Registry()
+    c = reg.counter("tpushare_x_total", "help")
+    c.inc()
+    c.inc(2.5)
+    c.inc(pod="a")
+    assert c.value() == 3.5
+    assert c.value(pod="a") == 1
+    assert c.value(pod="nope") == 0.0
+
+
+def test_get_or_create_shares_instance_and_checks_kind():
+    reg = Registry()
+    a = reg.counter("tpushare_x_total", "h")
+    b = reg.counter("tpushare_x_total", "different help ignored")
+    assert a is b
+    with pytest.raises(TypeError):
+        reg.gauge("tpushare_x_total", "h")
+
+
+def test_histogram_bucket_correctness():
+    reg = Registry()
+    h = reg.histogram("tpushare_lat_seconds", "h",
+                      buckets=(0.1, 1.0, 10.0))
+    # exact-boundary values land in their own bucket (le is inclusive)
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 50.0):
+        h.observe(v)
+    samples = {(name, key): val for name, key, val in h.samples()}
+    assert samples[("tpushare_lat_seconds_bucket", (("le", "0.1"),))] == 2
+    assert samples[("tpushare_lat_seconds_bucket", (("le", "1"),))] == 4
+    assert samples[("tpushare_lat_seconds_bucket", (("le", "10"),))] == 5
+    assert samples[("tpushare_lat_seconds_bucket", (("le", "+Inf"),))] == 6
+    assert samples[("tpushare_lat_seconds_count", ())] == 6
+    assert abs(samples[("tpushare_lat_seconds_sum", ())] - 56.65) < 1e-9
+    assert h.count() == 6
+
+
+def test_histogram_quantile_interpolates():
+    reg = Registry()
+    h = reg.histogram("tpushare_lat_seconds", "h", buckets=(1.0, 2.0, 4.0))
+    for _ in range(100):
+        h.observe(1.5)          # all mass in the (1, 2] bucket
+    q50 = h.quantile(0.5)
+    assert 1.0 < q50 <= 2.0
+    assert h.quantile(0.0) is not None
+    assert Registry().histogram("tpushare_y_seconds", "h").quantile(0.5) \
+        is None                 # no observations -> None
+
+
+def test_quantile_from_buckets_inf_clamps():
+    # everything in +Inf clamps to the largest finite bound
+    assert quantile_from_buckets([0.1, 1.0], [0, 0, 10], 0.5) == 1.0
+    assert quantile_from_buckets([], [], 0.5) is None
+
+
+def test_concurrent_inc_from_threads():
+    reg = Registry()
+    c = reg.counter("tpushare_n_total", "h")
+    h = reg.histogram("tpushare_t_seconds", "h", buckets=(1.0,))
+    n_threads, per_thread = 8, 5000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == n_threads * per_thread
+    assert h.count() == n_threads * per_thread
+
+
+def test_render_parses_and_carries_help_type():
+    reg = Registry()
+    reg.counter("tpushare_a_total", "counts a").inc(3)
+    reg.gauge("tpushare_b_bytes", "bytes of b").set(7, pod='we"ird\\pod')
+    reg.histogram("tpushare_c_seconds", "time of c").observe(0.01)
+    text = reg.render()
+    parsed = telemetry.parse_text(text)
+    assert parsed["meta"]["tpushare_a_total"] == {
+        "help": "counts a", "type": "counter"}
+    assert parsed["meta"]["tpushare_c_seconds"]["type"] == "histogram"
+    # label escaping round-trips
+    labels, val = parsed["samples"]["tpushare_b_bytes"][0]
+    assert labels == {"pod": 'we"ird\\pod'} and val == 7
+    # the order-sensitive case: literal backslash followed by 'n' must
+    # NOT unescape into a newline (single-pass unescaper)
+    reg2 = Registry()
+    reg2.gauge("tpushare_d_bytes", "h").set(1, pod="a\\nb")
+    labels2, _ = telemetry.parse_text(
+        reg2.render())["samples"]["tpushare_d_bytes"][0]
+    assert labels2 == {"pod": "a\\nb"}
+    reg3 = Registry()
+    reg3.gauge("tpushare_e_bytes", "h").set(1, pod="a\nb")
+    labels3, _ = telemetry.parse_text(
+        reg3.render())["samples"]["tpushare_e_bytes"][0]
+    assert labels3 == {"pod": "a\nb"}
+    # histogram series all present
+    assert "tpushare_c_seconds_bucket" in parsed["samples"]
+    assert "tpushare_c_seconds_sum" in parsed["samples"]
+    assert "tpushare_c_seconds_count" in parsed["samples"]
+
+
+def test_parse_text_rejects_malformed():
+    with pytest.raises(ValueError):
+        telemetry.parse_text('tpushare_x{pod=unquoted} 1')
+    with pytest.raises(ValueError):
+        telemetry.parse_text("not a metric line at all")
+    with pytest.raises(ValueError):
+        telemetry.parse_text("# TYPE tpushare_x bogus_kind")
+
+
+def test_disabled_path_is_noop():
+    reg = Registry()
+    c = reg.counter("tpushare_z_total", "h")
+    h = reg.histogram("tpushare_z_seconds", "h")
+    telemetry.set_enabled(False)
+    try:
+        c.inc(100)
+        h.observe(1.0)
+        tr = Tracer(capacity=4)
+        with tr.span("nope"):
+            pass
+        tr.instant("nope")
+        assert c.value() == 0
+        assert h.count() == 0
+        assert tr.events() == []
+    finally:
+        telemetry.set_enabled(True)
+    c.inc()
+    assert c.value() == 1
+
+
+# ------------------------------------------------------------------ tracer
+def test_trace_buffer_wraparound():
+    tr = Tracer(capacity=8)
+    for i in range(20):
+        with tr.span(f"s{i}", cat="t", i=i):
+            pass
+    evs = tr.events()
+    assert len(evs) == 8
+    assert [e["name"] for e in evs] == [f"s{i}" for i in range(12, 20)]
+    # oldest-first ordering, monotonically nondecreasing timestamps
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_span_records_duration_and_chrome_fields():
+    tr = Tracer(capacity=16)
+    with tr.span("work", cat="serving", n=3):
+        pass
+    tr.instant("ping", cat="serving")
+    span, inst = tr.events()
+    assert span["ph"] == "X" and span["dur"] >= 0
+    assert span["args"] == {"n": 3}
+    assert inst["ph"] == "i"
+    for ev in (span, inst):
+        for field in ("name", "cat", "ts", "pid", "tid"):
+            assert field in ev
+    # the dump is JSON-serializable as-is
+    json.dumps(tr.to_chrome())
+
+
+def test_engine_submit_path_records_latency_and_spans():
+    """submit -> batch -> dispatch -> deliver: the span chain and the
+    request-latency/TTFT/per-token histograms all fire."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.serving import InferenceEngine
+    from tpushare.serving import metrics as sm
+
+    before_ttft = sm.TTFT.count()
+    before_lat = sm.REQUEST_LATENCY.count()
+    before_req = sm.REQUESTS.value()
+    eng = InferenceEngine(lambda t: t.astype(jnp.float32) * 2,
+                          batch_size=4, seq_len=8)
+    eng.start()
+    try:
+        sinks = [eng.submit(np.arange(5, dtype=np.int32))
+                 for _ in range(4)]
+        outs = [s.get(timeout=30) for s in sinks]
+    finally:
+        eng.stop()
+    assert all(o is not None for o in outs)
+    assert sm.REQUESTS.value() == before_req + 4
+    assert sm.TTFT.count() >= before_ttft + 4
+    assert sm.REQUEST_LATENCY.count() >= before_lat + 4
+    names = {e["name"] for e in telemetry.tracer.events()}
+    assert {"engine.batch", "engine.dispatch", "engine.deliver"} <= names
+
+
+def test_batcher_records_occupancy_admissions_completions():
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving import metrics as sm
+    from tpushare.serving.continuous import ContinuousBatcher
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    before_admit = sm.ADMISSIONS.value()
+    before_done = sm.COMPLETIONS.value()
+    before_ticks = sm.TICK_DURATION.count()
+    assert b.admit([1, 2, 3], 4) is not None
+    b.run_until_drained()
+    assert sm.ADMISSIONS.value() == before_admit + 1
+    assert sm.COMPLETIONS.value() == before_done + 1
+    assert sm.TICK_DURATION.count() > before_ticks
+    assert sm.OCCUPANCY.value() == 0.0    # drained pool
+
+
+def test_debug_trace_endpoint_is_valid_chrome_trace_json():
+    """Round trip: spans recorded -> GET /debug/trace -> json.loads ->
+    Chrome trace-event structure (the load contract of chrome://tracing
+    and ui.perfetto.dev)."""
+    from tpushare.plugin.status import StatusServer
+
+    with telemetry.span("roundtrip.test", cat="test", k="v"):
+        pass
+    srv = StatusServer(0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/trace", timeout=5) as r:
+            assert r.headers.get("Content-Type") == "application/json"
+            doc = json.loads(r.read().decode())
+    finally:
+        srv.stop()
+    assert isinstance(doc["traceEvents"], list)
+    names = set()
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "B", "E", "M")
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        names.add(ev["name"])
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    assert "roundtrip.test" in names

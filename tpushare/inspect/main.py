@@ -13,6 +13,7 @@ from typing import List, Optional, Tuple
 
 from ..k8s.client import ApiError, KubeClient
 from ..plugin import podutils
+from . import metricsview
 from .display import render_details, render_summary
 from .nodeinfo import build_node_infos, is_tpu_sharing_node
 
@@ -58,6 +59,16 @@ def main(argv=None) -> int:
     ap.add_argument("-o", "--output", choices=["table", "json"],
                     default="table",
                     help="table (default) or machine-readable json")
+    ap.add_argument("-m", "--metrics", action="store_true",
+                    help="also fetch each node's /metrics and render "
+                         "serving stats (qps, TTFT p50/p99, occupancy, "
+                         "KV-page utilization)")
+    ap.add_argument("--metrics-port",
+                    default=str(metricsview.DEFAULT_METRICS_PORT),
+                    help="comma-separated port(s) of per-node /metrics "
+                         "endpoints — the daemon scrape port and/or "
+                         "workload LLM-server ports; expositions merge "
+                         f"(default {metricsview.DEFAULT_METRICS_PORT})")
     ap.add_argument("node", nargs="?", default=None,
                     help="restrict to one node")
     args = ap.parse_args(argv)
@@ -70,6 +81,9 @@ def main(argv=None) -> int:
         return 1
 
     infos = build_node_infos(nodes, pods)
+    metrics_rows = (metricsview.gather_metrics_rows(infos,
+                                                    args.metrics_port)
+                    if args.metrics else None)
     if args.output == "json":
         import json
 
@@ -97,11 +111,21 @@ def main(argv=None) -> int:
                 # face of the -d table's GRANT/PEAK/OVER column
                 "hbm_usage": info.usage_reports(),
             })
+        if metrics_rows is not None:
+            by_name = {name: (summary if summary is not None
+                              else {"error": err})
+                       for name, _, summary, err in metrics_rows}
+            for entry in out["nodes"]:
+                if entry["name"] in by_name:
+                    entry["serving"] = by_name[entry["name"]]
         json.dump(out, sys.stdout, indent=2)
         print()
         return 0
     render = render_details if args.details else render_summary
     sys.stdout.write(render(infos))
+    if metrics_rows is not None:
+        sys.stdout.write("\n")
+        sys.stdout.write(metricsview.render_metrics_table(metrics_rows))
     return 0
 
 

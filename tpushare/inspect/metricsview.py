@@ -1,0 +1,182 @@
+"""``--metrics`` mode: per-node serving telemetry next to the binpack view.
+
+Fetches each TPU-sharing node's Prometheus ``/metrics`` exposition (the
+daemon's ``--status-port`` endpoint, or a workload LLM server's
+``/metrics``), parses it with the strict parser from
+:mod:`tpushare.telemetry`, and distills the serving-plane series into
+one row per node: engine qps, TTFT p50/p99 (interpolated from the
+histogram buckets, PromQL ``histogram_quantile`` style), batch
+occupancy, and KV-page utilization.  Unreachable nodes render as
+``unreachable`` instead of failing the whole view — this is a debugging
+tool, and a dead daemon is exactly the anomaly it should surface.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry import parse_text, quantile_from_buckets
+from .display import _table
+
+#: the daemon's scrape-only metrics listener in the deploy manifest
+#: (device-plugin-ds.yaml --metrics-port); pass workload-server ports
+#: too (comma list) to pick up the serving-plane series they record
+DEFAULT_METRICS_PORT = 9102
+
+
+def fetch_node_metrics(address: str, port: int,
+                       timeout: float = 3.0) -> dict:
+    """GET and parse one node's /metrics; raises on transport/parse
+    errors (caller decides how to render the failure)."""
+    url = f"http://{address}:{port}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return parse_text(r.read().decode())
+
+
+def merge_parsed(parsed_list) -> dict:
+    """Union several parsed expositions into one view.
+
+    The serving-plane series live in the WORKLOAD process (the LLM
+    server's /metrics), the control-plane series in the daemon's — one
+    node therefore exposes several endpoints, and the per-node summary
+    wants all of them.  Sample lists concatenate; a family appearing in
+    several expositions keeps the first metadata seen."""
+    out = {"meta": {}, "samples": {}}
+    for parsed in parsed_list:
+        for name, m in parsed["meta"].items():
+            out["meta"].setdefault(name, m)
+        for series, samples in parsed["samples"].items():
+            out["samples"].setdefault(series, []).extend(samples)
+    return out
+
+
+def _gauge(parsed: dict, name: str) -> Optional[float]:
+    samples = parsed["samples"].get(name)
+    return samples[0][1] if samples else None
+
+
+def _hist_quantile(parsed: dict, base: str, q: float) -> Optional[float]:
+    """Quantile from ``<base>_bucket`` samples, aggregated over every
+    non-``le`` label set (one serving process per node today, but a
+    labeled future stays correct)."""
+    samples = parsed["samples"].get(base + "_bucket")
+    if not samples:
+        return None
+    by_le: Dict[float, float] = {}
+    for labels, value in samples:
+        le = labels.get("le")
+        if le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + value
+    bounds = sorted(b for b in by_le if b != float("inf"))
+    cum = [by_le[b] for b in bounds]
+    if float("inf") in by_le:
+        cum.append(by_le[float("inf")])
+    else:
+        return None
+    return quantile_from_buckets(bounds, cum, q)
+
+
+def summarize_serving(parsed: dict) -> dict:
+    """The serving stats one node's exposition distills to (None for
+    series the node has not recorded)."""
+    used = _gauge(parsed, "tpushare_kv_pages_used")
+    free = _gauge(parsed, "tpushare_kv_pages_free")
+    kv_util = None
+    if used is not None and free is not None and used + free > 0:
+        kv_util = used / (used + free)
+    return {
+        "qps": _gauge(parsed, "tpushare_engine_qps"),
+        "ttft_p50_s": _hist_quantile(
+            parsed, "tpushare_engine_ttft_seconds", 0.5),
+        "ttft_p99_s": _hist_quantile(
+            parsed, "tpushare_engine_ttft_seconds", 0.99),
+        "occupancy": _gauge(parsed, "tpushare_batch_occupancy"),
+        "kv_pages_used": used,
+        "kv_pages_free": free,
+        "kv_util": kv_util,
+    }
+
+
+def _fmt(v, scale: float = 1.0, suffix: str = "",
+         digits: int = 2) -> str:
+    if v is None:
+        return "-"
+    return f"{v * scale:.{digits}f}{suffix}"
+
+
+def render_metrics_table(
+        rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
+    """``rows`` = [(node, address, summary|None, error|None)]."""
+    table = [["NAME", "IPADDRESS", "QPS", "TTFT p50(ms)", "TTFT p99(ms)",
+              "OCCUPANCY", "KV PAGES(used/free)"]]
+    for name, addr, summary, err in rows:
+        if summary is None:
+            table.append([name, addr, err or "unreachable",
+                          "-", "-", "-", "-"])
+            continue
+        kv = "-"
+        if summary["kv_pages_used"] is not None:
+            kv = (f"{int(summary['kv_pages_used'])}/"
+                  f"{int(summary['kv_pages_free'] or 0)}")
+            if summary["kv_util"] is not None:
+                kv += f" ({summary['kv_util'] * 100:.0f}%)"
+        table.append([
+            name, addr,
+            _fmt(summary["qps"]),
+            _fmt(summary["ttft_p50_s"], 1000.0),
+            _fmt(summary["ttft_p99_s"], 1000.0),
+            _fmt(summary["occupancy"], 100.0, "%", 0),
+            kv,
+        ])
+    return "Serving metrics:\n" + _table(table)
+
+
+def parse_ports(spec) -> List[int]:
+    """``9102`` / ``"9102,8000"`` -> [9102, 8000] (daemon scrape port
+    and/or workload-server ports)."""
+    if isinstance(spec, int):
+        return [spec]
+    ports = [int(p) for p in str(spec).split(",") if p.strip()]
+    if not ports:
+        raise ValueError(f"no ports in {spec!r}")
+    return ports
+
+
+def gather_metrics_rows(infos, ports, timeout: float = 3.0
+                        ) -> List[Tuple[str, str, Optional[dict],
+                                        Optional[str]]]:
+    """One (node, address, summary|None, error|None) row per sharing
+    node.  Every (node, port) pair is scraped and a node's expositions
+    are MERGED — the daemon's port carries control-plane series, a
+    workload LLM server's port carries the serving-plane ones, and the
+    summary needs both.  A node errors only when every port fails.
+
+    Scrapes run CONCURRENTLY: dead daemons are exactly the anomaly this
+    view should surface, and a sequential walk would pay the full
+    timeout per dead endpoint (O(nodes x ports x timeout) on a bad day).
+    """
+    ports = parse_ports(ports)
+    sharing = [info for info in infos if info.total_mem > 0]
+    if not sharing:
+        return []
+
+    def one(info):
+        got, last_err = [], None
+        for port in ports:
+            try:
+                got.append(fetch_node_metrics(info.address, port,
+                                              timeout=timeout))
+            except Exception as e:
+                last_err = e
+        if not got:
+            return (info.name, info.address, None,
+                    f"unreachable ({type(last_err).__name__})")
+        return (info.name, info.address,
+                summarize_serving(merge_parsed(got)), None)
+
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(16, len(sharing))) as pool:
+        return list(pool.map(one, sharing))

@@ -17,7 +17,13 @@ import ssl
 import urllib.request
 from typing import List, Optional
 
+from .. import telemetry
+
 log = logging.getLogger("tpushare.kubelet")
+
+_RPC_LAT = telemetry.histogram(
+    "tpushare_kubelet_rpc_latency_seconds",
+    "Wall time of kubelet /pods/ queries (including failures)")
 
 
 class KubeletClient:
@@ -60,7 +66,8 @@ class KubeletClient:
         tok = self._bearer()
         if tok:
             req.add_header("Authorization", f"Bearer {tok}")
-        with urllib.request.urlopen(req, context=self._ctx,
-                                    timeout=self._timeout) as r:
-            podlist = json.loads(r.read())
+        with telemetry.timed(_RPC_LAT, "kubelet.get_pods", cat="control"):
+            with urllib.request.urlopen(req, context=self._ctx,
+                                        timeout=self._timeout) as r:
+                podlist = json.loads(r.read())
         return podlist.get("items", [])

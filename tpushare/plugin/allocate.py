@@ -27,11 +27,17 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from .. import telemetry
 from . import const
 from .api import pb
 from .discovery import Chip, mem_units_per_chip
 
 log = logging.getLogger("tpushare.allocate")
+
+_ALLOC_LAT = telemetry.histogram(
+    "tpushare_allocate_latency_seconds",
+    "Wall time of one kubelet Allocate RPC through the pod-matching "
+    "allocator (includes the node-pod snapshot and the assigned patch)")
 
 # Host paths where a TPU VM exposes libtpu; mounted read-only into the
 # workload container when present (the reference never needed Mounts —
@@ -246,4 +252,9 @@ def make_allocator(pod_manager):
             status.inc("tpushare_allocations_total")
             return resp
 
-    return allocator
+    def timed_allocator(plugin, request: "pb.AllocateRequest"
+                        ) -> "pb.AllocateResponse":
+        with telemetry.timed(_ALLOC_LAT, "plugin.Allocate", cat="control"):
+            return allocator(plugin, request)
+
+    return timed_allocator

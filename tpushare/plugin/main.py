@@ -68,6 +68,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--status-addr", default="127.0.0.1",
                     help="status bind address (loopback by default; the "
                          "endpoint has no auth)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="additionally serve a scrape-only GET /metrics "
+                         "+ /healthz listener on this port (0 = off) — "
+                         "safe to expose node-wide, unlike the full "
+                         "status surface (/usage ingest, /debug/*)")
+    ap.add_argument("--metrics-addr", default="0.0.0.0",
+                    help="bind address for the scrape-only listener")
     ap.add_argument("--dev-glob", default=os.environ.get(
                         "TPUSHARE_DEV_GLOB", "/dev/accel*"),
                     help="device-node glob for metadata discovery (env "
@@ -188,8 +195,12 @@ def main(argv=None) -> int:
         status_srv = StatusServer(args.status_port,
                                   plugin_ref=lambda: mgr.plugin,
                                   addr=args.status_addr,
-                                  on_usage=on_usage).start()
-        log.info("status endpoint on :%d", status_srv.port)
+                                  on_usage=on_usage,
+                                  metrics_port=args.metrics_port or None,
+                                  metrics_addr=args.metrics_addr).start()
+        log.info("status endpoint on :%d%s", status_srv.port,
+                 (f" (scrape-only metrics on :{status_srv.metrics_port})"
+                  if status_srv.metrics_port else ""))
     try:
         mgr.run()
     finally:

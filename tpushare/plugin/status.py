@@ -1,11 +1,14 @@
-"""Optional daemon status endpoint: /healthz, /metrics, /debug/stacks.
+"""Optional daemon status endpoint: /healthz, /metrics, /debug/trace.
 
 The reference's only observability is leveled logging plus the inspect
 CLI (SURVEY.md §5); its one debug affordance is the SIGQUIT stack dump.
 This keeps both and adds an opt-in (``--status-port``) stdlib HTTP
-endpoint: Prometheus-text ``/metrics`` (allocation counters, device
-health) and ``/debug/stacks`` (the SIGQUIT dump, fetchable).  Binds
-loopback by default — /debug/stacks has no auth and the daemon runs
+endpoint: ``/metrics`` renders the process-global telemetry registry
+(:mod:`tpushare.telemetry`) in the Prometheus text format (HELP/TYPE
+per family, content type ``text/plain; version=0.0.4``),
+``/debug/trace`` dumps the ring-buffer tracer as Chrome trace-event
+JSON, and ``/debug/stacks`` serves the SIGQUIT dump.  Binds loopback by
+default — the debug endpoints have no auth and the daemon runs
 hostNetwork, so node-wide exposure must be an explicit choice.
 """
 
@@ -14,33 +17,78 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import telemetry
 from ..utils import stackdump
-from ..utils.httpserver import JsonHTTPServer
+from ..utils.httpserver import JsonHTTPServer, RawBody
 
-_COUNTERS = {
-    "tpushare_allocations_total": 0,
-    "tpushare_allocation_failures_total": 0,
-    "tpushare_restarts_total": 0,
+#: daemon counter families, pre-registered so /metrics always carries
+#: their HELP/TYPE even at zero
+_COUNTER_HELP = {
+    "tpushare_allocations_total":
+        "Successful device-plugin Allocate calls",
+    "tpushare_allocation_failures_total":
+        "Allocate calls answered with the failure env",
+    "tpushare_restarts_total":
+        "Device-plugin serve-loop restarts",
     # tenants whose reported HBM peak exceeded their grant (advisory-
     # isolation visibility; see /usage)
-    "tpushare_hbm_overshoot_total": 0,
+    "tpushare_hbm_overshoot_total":
+        "Usage reports whose observed HBM peak exceeded the grant",
 }
+for _name, _help in _COUNTER_HELP.items():
+    # inc(0) seeds the zero-valued sample line, so a fresh daemon's
+    # /metrics still carries e.g. `tpushare_allocation_failures_total 0`
+    # (rate()/increase() need the series to exist before the first
+    # event, and the pre-registry render always emitted it)
+    telemetry.counter(_name, _help).inc(0)
+
+_DEVICES = telemetry.gauge(
+    "tpushare_devices", "Advertised fake-devices by health state")
+_CHIPS = telemetry.gauge(
+    "tpushare_chips", "Physical TPU chips discovered")
+# grant vs OBSERVED peak per tenant: on advisory-isolation backends this
+# is the only place an operator sees a co-tenant exceeding its grant
+_HBM_GRANT = telemetry.gauge(
+    "tpushare_hbm_grant_bytes",
+    "Per-tenant HBM grant from the allocation contract (reported via "
+    "/usage)")
+_HBM_PEAK = telemetry.gauge(
+    "tpushare_hbm_peak_bytes",
+    "Per-tenant observed HBM peak (reported via /usage)")
+
 _LOCK = threading.Lock()
+#: names ever routed through :func:`inc` (legacy counters() view)
+_KNOWN = set(_COUNTER_HELP)
 
 
 def inc(name: str, by: int = 1) -> None:
+    """Legacy counter API — now a thin shim over the shared registry
+    (metric names unchanged, so dashboards keep working)."""
     with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + by
+        _KNOWN.add(name)
+    telemetry.counter(name, _COUNTER_HELP.get(name, name)).inc(by)
 
 
 def counters() -> dict:
+    """{name: value} for every counter routed through :func:`inc`."""
     with _LOCK:
-        return dict(_COUNTERS)
+        names = sorted(_KNOWN)
+    return {n: telemetry.counter(n, _COUNTER_HELP.get(n, n)).value()
+            for n in names}
 
 
 class StatusServer:
+    """``port``/``addr``: the FULL surface (metrics, debug dumps, /usage
+    ingest) — loopback by default, because /usage is an unauthenticated
+    write and /debug/* leaks stacks and request traces.  ``metrics_port``
+    (optional) starts a second, scrape-only listener serving just
+    GET /metrics + /healthz, safe to bind node-wide for Prometheus and
+    ``inspect --metrics`` — exposing the read-only exposition never has
+    to mean exposing the ingest or the debug surface."""
+
     def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1",
-                 on_usage=None):
+                 on_usage=None, metrics_port: int = None,
+                 metrics_addr: str = "0.0.0.0"):
         self.plugin_ref = plugin_ref   # callable returning current plugin
         # latest usage report per tenant pod: the workload runtime
         # (tpushare.runtime.contract.report_usage) POSTs observed HBM
@@ -56,13 +104,28 @@ class StatusServer:
         # bounded (k8s caps total annotations at 256 KiB).
         self.usage_ttl_s = 900.0
         self.usage_max = 64
+        self._render_lock = threading.Lock()
         self._http = JsonHTTPServer(port, addr, routes={
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
-            ("GET", "/metrics"): lambda _: (200, self.render_metrics()),
+            ("GET", "/metrics"): lambda _: (
+                200, RawBody(self.render_metrics(),
+                             telemetry.PROM_CONTENT_TYPE)),
             ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
+            ("GET", "/debug/trace"): lambda _: (
+                200, telemetry.tracer.to_chrome()),
             ("POST", "/usage"): self._ingest_usage,
         })
         self.port = self._http.port
+        self._public = None
+        self.metrics_port = None
+        if metrics_port is not None:
+            self._public = JsonHTTPServer(metrics_port, metrics_addr, routes={
+                ("GET", "/healthz"): lambda _: (200, "ok\n"),
+                ("GET", "/metrics"): lambda _: (
+                    200, RawBody(self.render_metrics(),
+                                 telemetry.PROM_CONTENT_TYPE)),
+            })
+            self.metrics_port = self._public.port
 
     def _ingest_usage(self, body):
         if not isinstance(body, dict) or not body.get("pod"):
@@ -118,53 +181,57 @@ class StatusServer:
             del self.usage_reports[oldest]
 
     def render_metrics(self) -> str:
+        """Refresh the daemon-state gauges, then render the WHOLE
+        registry — counters, device health, per-tenant HBM gauges, and
+        (in-process) any serving-plane series — in one exposition.
+
+        Serialized end to end: the HTTP server is threaded, and a
+        concurrent scrape racing the clear()-and-rebuild of the mirror
+        gauges could render a snapshot with the per-tenant series
+        missing (exactly the OVER-grant visibility this endpoint
+        exists for).
+        """
+        with self._render_lock:
+            return self._render_metrics_locked()
+
+    def _render_metrics_locked(self) -> str:
         from . import const
-        lines = []
-        for name, val in sorted(counters().items()):
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {val}")
         plugin = self.plugin_ref() if self.plugin_ref else None
         if plugin is not None:
             devs = plugin.device_list()
             healthy = sum(d.health == const.DEVICE_HEALTHY for d in devs)
-            lines.append("# TYPE tpushare_devices gauge")
-            lines.append(f'tpushare_devices{{state="healthy"}} {healthy}')
-            lines.append(
-                f'tpushare_devices{{state="unhealthy"}} {len(devs) - healthy}')
-            lines.append("# TYPE tpushare_chips gauge")
-            lines.append(f"tpushare_chips {len(plugin.chips)}")
+            _DEVICES.set(healthy, state="healthy")
+            _DEVICES.set(len(devs) - healthy, state="unhealthy")
+            _CHIPS.set(len(plugin.chips))
+        else:
+            _DEVICES.clear()
+            _CHIPS.clear()
         with _LOCK:
             self._evict_locked()
             reports = list(self.usage_reports.values())
-        if reports:
-            # grant vs OBSERVED per tenant: on advisory-isolation
-            # backends this is the only place an operator sees a
-            # co-tenant exceeding its HBM grant
-            lines.append("# TYPE tpushare_tenant_hbm_grant_bytes gauge")
-            lines.append("# TYPE tpushare_tenant_hbm_peak_bytes gauge")
-            for r in reports:
-                # exposition-format label escaping — the pod name is
-                # tenant-supplied, so \ , " and newlines must not be
-                # able to break or inject metric lines
-                pod = (str(r.get("pod", "?"))
-                       .replace("\\", r"\\").replace('"', r"\"")
-                       .replace("\n", r"\n").replace("\r", ""))
-                over = (r.get("grant_bytes") and r.get("peak_bytes")
-                        and r["peak_bytes"] > r["grant_bytes"])
-                tag = f'pod="{pod}",over_grant="{"true" if over else "false"}"'
-                if r.get("grant_bytes") is not None:
-                    lines.append(
-                        f'tpushare_tenant_hbm_grant_bytes{{{tag}}} '
-                        f'{r["grant_bytes"]}')
-                if r.get("peak_bytes") is not None:
-                    lines.append(
-                        f'tpushare_tenant_hbm_peak_bytes{{{tag}}} '
-                        f'{r["peak_bytes"]}')
-        return "\n".join(lines) + "\n"
+        # label sets churn with the tenant population: rebuild from the
+        # live reports so an evicted tenant's series disappears instead
+        # of freezing at its last value
+        _HBM_GRANT.clear()
+        _HBM_PEAK.clear()
+        for r in reports:
+            over = (r.get("grant_bytes") and r.get("peak_bytes")
+                    and r["peak_bytes"] > r["grant_bytes"])
+            labels = {"pod": str(r.get("pod", "?")),
+                      "over_grant": "true" if over else "false"}
+            if r.get("grant_bytes") is not None:
+                _HBM_GRANT.set(r["grant_bytes"], **labels)
+            if r.get("peak_bytes") is not None:
+                _HBM_PEAK.set(r["peak_bytes"], **labels)
+        return telemetry.REGISTRY.render()
 
     def start(self) -> "StatusServer":
         self._http.start()
+        if self._public is not None:
+            self._public.start()
         return self
 
     def stop(self) -> None:
         self._http.stop()
+        if self._public is not None:
+            self._public.stop()

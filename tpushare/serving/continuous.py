@@ -21,13 +21,16 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..models import transformer
+from . import metrics
 
 log = logging.getLogger("tpushare.serving")
 
@@ -353,6 +356,19 @@ class ContinuousBatcher:
         self._spec_stats = {"calls": 0, "rounds": 0, "tokens": 0}
         self._init_storage()
 
+    # -- telemetry helpers ---------------------------------------------
+    def _observe_tick(self, t0: float) -> None:
+        """Record one tick's wall time and the post-tick occupancy."""
+        metrics.TICK_DURATION.observe(time.perf_counter() - t0)
+        metrics.OCCUPANCY.set(
+            len(self.slots) / self.n_slots if self.n_slots else 0.0)
+
+    def _complete(self, rid: int, output: List[int]) -> None:
+        """The ONE completion bookkeeping site (every tick flavor and the
+        instant-finish admission path funnel through it)."""
+        self.completed[rid] = output
+        metrics.COMPLETIONS.inc()
+
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
         self.caches = transformer.init_kv_caches(
@@ -480,6 +496,7 @@ class ContinuousBatcher:
             return None
         rid = self._next_id
         self._next_id += 1
+        metrics.ADMISSIONS.inc()
 
         tokens = jnp.asarray([prompt], jnp.int32)
         logits_v = self._prefill_into(slot, tokens, len(prompt))
@@ -511,7 +528,7 @@ class ContinuousBatcher:
         remaining = max_new_tokens - 1
         output = list(prompt) + [first]
         if remaining == 0 or (eos_id is not None and first == eos_id):
-            self.completed[rid] = output
+            self._complete(rid, output)
             # release through a REAL slot record, like every other
             # completion — storages that inspect the finished slot at
             # release (the paged prefix cache donates pure-prompt pages)
@@ -555,6 +572,7 @@ class ContinuousBatcher:
             return None
         rid = self._next_id
         self._next_id += 1
+        metrics.ADMISSIONS.inc()
         self.prefilling[slot] = _Prefill(
             request_id=rid, prompt=list(prompt),
             pos=self._prefill_start(slot),
@@ -624,15 +642,19 @@ class ContinuousBatcher:
         """One decode step for all active slots; returns #active before."""
         if not self.slots:
             return 0
+        t0 = time.perf_counter()
         tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
         for i, s in self.slots.items():
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
-        nxt = np.asarray(self._step(
-            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
-            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
-            jnp.asarray(tks), jnp.asarray(tps), self._rich()))
+        with telemetry.span("batcher.tick", cat="serving",
+                            active=len(self.slots)):
+            nxt = np.asarray(self._step(
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(temps),
+                jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+                jnp.asarray(tks), jnp.asarray(tps), self._rich()))
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
@@ -642,9 +664,10 @@ class ContinuousBatcher:
             s.remaining -= 1
             if s.remaining <= 0 or (s.eos_id is not None
                                     and s.last_token == s.eos_id):
-                self.completed[s.request_id] = s.output
+                self._complete(s.request_id, s.output)
                 self._release(i)
                 del self.slots[i]
+        self._observe_tick(t0)
         return n_active
 
     def tick_fused(self, n_steps: int) -> int:
@@ -662,6 +685,8 @@ class ContinuousBatcher:
         """
         if not self.slots:
             return 0
+        t0 = time.perf_counter()
+        metrics.FUSED_STEPS.inc(n_steps)
         tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
         # rows decoding at chunk start advance one position per step;
         # everything else (empty, mid-prefill) stays FROZEN at its
@@ -670,11 +695,14 @@ class ContinuousBatcher:
         incs = np.zeros((self.n_slots,), np.int32)
         for i in self.slots:
             incs[i] = 1
-        toks, new_keys = self._step_n(
-            jnp.asarray(tokens), jnp.asarray(lengths), jnp.asarray(temps),
-            jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
-            jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
-            self._rich(), n_steps)
+        with telemetry.span("batcher.tick_fused", cat="serving",
+                            active=len(self.slots), steps=n_steps):
+            toks, new_keys = self._step_n(
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(temps),
+                jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+                jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
+                self._rich(), n_steps)
         toks = np.asarray(toks)
         new_keys = np.asarray(jax.random.key_data(new_keys))
         n_active = len(self.slots)
@@ -695,7 +723,7 @@ class ContinuousBatcher:
             s.remaining -= take
             if s.remaining <= 0 or (s.eos_id is not None
                                     and s.last_token == s.eos_id):
-                self.completed[s.request_id] = s.output
+                self._complete(s.request_id, s.output)
                 self._release(i)
                 del self.slots[i]
             elif s.temperature > 0.0:
@@ -703,6 +731,7 @@ class ContinuousBatcher:
                 # times for a continuing slot — same chain the host loop
                 # would have walked
                 s.key = jax.random.wrap_key_data(jnp.asarray(new_keys[i]))
+        self._observe_tick(t0)
         return n_active
 
     def cancel(self, rid: int) -> bool:
@@ -717,12 +746,17 @@ class ContinuousBatcher:
             if s.request_id == rid:
                 self._release(i)
                 del self.slots[i]
+                metrics.CANCELLATIONS.inc()
                 return True
         for i, p in list(self.prefilling.items()):
             if p.request_id == rid:
                 self._release(i)
                 del self.prefilling[i]
+                metrics.CANCELLATIONS.inc()
                 return True
+        # completed-but-undelivered: the request already counted as a
+        # completion, so dropping its result is NOT a cancellation
+        # (admissions == completions + cancellations must reconcile)
         return self.completed.pop(rid, None) is not None
 
     def tick_spec(self, n_rounds: int, k: int = 8, ngram: int = 2) -> int:
@@ -744,6 +778,7 @@ class ContinuousBatcher:
             raise ValueError("tick_spec needs a full-size dense pool")
         if not self.slots:
             return 0
+        t0 = time.perf_counter()
         if any(s.temperature > 0.0 for s in self.slots.values()):
             raise ValueError("tick_spec is greedy-only; route sampling "
                              "batches through tick/tick_fused")
@@ -808,13 +843,16 @@ class ContinuousBatcher:
             # (== the device's final n_ctx for untruncated rows)
             s.length = len(s.output) - 1
             self._spec_stats["tokens"] += take
+            metrics.SPEC_TOKENS.inc(take)
             if s.remaining <= 0 or (s.eos_id is not None
                                     and s.last_token == s.eos_id):
-                self.completed[s.request_id] = s.output
+                self._complete(s.request_id, s.output)
                 self._release(i)
                 del self.slots[i]
         self._spec_stats["rounds"] += n_rounds
         self._spec_stats["calls"] += 1
+        metrics.SPEC_ROUNDS.inc(n_rounds)
+        self._observe_tick(t0)
         return n_active
 
     def run_until_drained(self, max_ticks: int = 10_000) -> None:
@@ -911,7 +949,11 @@ class ContinuousService:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete)
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete, t_submit)
+        # rid -> [t_submit, prompt_len, t_first_token|None]: feeds the
+        # request-latency / TTFT / per-token histograms (loop-owned,
+        # like _sinks)
+        self._req_meta: Dict[int, list] = {}
         # cancel(sink) handoff: the loop drains this each iteration and
         # releases the matching request wherever it is (waiting queue,
         # prefilling, decoding, or completed-but-undelivered)
@@ -1013,10 +1055,12 @@ class ContinuousService:
         # streaming sinks are unbounded (many deltas); final-only sinks
         # hold exactly one item
         sink = self._q.Queue() if stream else self._q.Queue(maxsize=1)
+        metrics.REQUESTS.inc()
         with self._lock:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
-                 top_k, top_p, stream, sink, on_complete))
+                 top_k, top_p, stream, sink, on_complete,
+                 time.perf_counter()))
         self._work.set()
         return sink
 
@@ -1044,13 +1088,38 @@ class ContinuousService:
                 if entry[0] is sink:
                     self._batcher.cancel(rid)
                     del self._stream_sinks[rid]
+                    self._req_meta.pop(rid, None)
                     break
             else:
                 for rid, s in list(self._sinks.items()):
                     if s is sink:
                         self._batcher.cancel(rid)
                         del self._sinks[rid]
+                        self._req_meta.pop(rid, None)
                         break
+
+    def _observe_request(self, rid: int, out_len: int) -> None:
+        """Feed the request-level histograms at completion (loop thread).
+
+        Streaming requests recorded TTFT at their first delta, so their
+        per-token time covers the decode tail; one-shot requests deliver
+        everything at once, so TTFT is the full latency and per-token
+        time spreads it over the generated tokens.
+        """
+        meta = self._req_meta.pop(rid, None)
+        if meta is None:
+            return
+        now = time.perf_counter()
+        t_sub, prompt_len, t_first = meta
+        total = now - t_sub
+        metrics.REQUEST_LATENCY.observe(total)
+        n_gen = max(1, out_len - prompt_len)
+        if t_first is not None:
+            if n_gen > 1:
+                metrics.TPOT.observe((now - t_first) / (n_gen - 1))
+        else:
+            metrics.TTFT.observe(total)
+            metrics.TPOT.observe(total / n_gen)
 
     def snapshot(self) -> dict:
         """Occupancy for observability: {slots, active, prefilling,
@@ -1086,7 +1155,7 @@ class ContinuousService:
                         break
                     item = self._waiting.pop(0)
                 (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
-                 sink, on_cb) = item
+                 sink, on_cb, t_sub) = item
                 rid = self._batcher.admit_chunked(
                     prompt, max_new, temperature=temp, seed=seed,
                     chunk=self._prefill_chunk, eos_id=eos_id,
@@ -1102,6 +1171,7 @@ class ContinuousService:
                 # chunked admission never completes at admit time (even a
                 # 1-token request finishes in advance_prefill); results
                 # are delivered by the post-tick completed drain below
+                self._req_meta[rid] = [t_sub, len(prompt), None]
                 if stream:
                     self._stream_sinks[rid] = [sink, len(prompt), on_cb]
                 else:
@@ -1143,16 +1213,23 @@ class ContinuousService:
                     elif rid in self._batcher.completed:
                         out = self._batcher.completed[rid]
                     if out is not None and len(out) > pushed:
+                        meta = self._req_meta.get(rid)
+                        if meta is not None and meta[2] is None:
+                            meta[2] = time.perf_counter()
+                            metrics.TTFT.observe(meta[2] - meta[0])
                         sink.put(("delta", out[pushed:]))
                         entry[1] = len(out)
             for rid in list(self._batcher.completed):
                 sink = self._sinks.pop(rid, None)
                 if sink is not None:
-                    sink.put(self._batcher.completed.pop(rid))
+                    out = self._batcher.completed.pop(rid)
+                    self._observe_request(rid, len(out))
+                    sink.put(out)
                     continue
                 entry = self._stream_sinks.pop(rid, None)
                 if entry is not None:
                     out = self._batcher.completed.pop(rid)
+                    self._observe_request(rid, len(out))
                     if entry[2] is not None:
                         try:
                             entry[2](out)

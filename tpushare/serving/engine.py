@@ -18,6 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
+from . import metrics
+
 
 class InferenceEngine:
     """Wraps a jitted ``fn(batch_tokens) -> outputs`` with micro-batching.
@@ -45,7 +48,11 @@ class InferenceEngine:
         # chip).  Depth bounds per-request latency at ~depth x batch
         # time; 1 restores strictly serial behavior.
         self.pipeline_depth = max(1, pipeline_depth)
-        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue]]" = queue.Queue()
+        # (tokens, result queue, submit time) — the submit timestamp
+        # rides with the request so deliver can observe the true
+        # submit->deliver latency (TTFT for this one-shot engine)
+        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue, float]]" = \
+            queue.Queue()
         # dispatched-but-undelivered batches; loop-owned in normal
         # operation, but engine-level so stop() can sentinel these
         # clients if the worker wedges in a device fetch (a tunnel
@@ -67,6 +74,7 @@ class InferenceEngine:
         compute time, not a host<->device round trip (the tunnel-attached
         chip has multi-ms dispatch latency that would otherwise dominate
         sub-10ms forwards)."""
+        metrics.BATCHES.inc()
         if self.pass_mask:
             if mask is None:
                 mask = np.ones_like(tokens, dtype=np.int32)
@@ -94,7 +102,7 @@ class InferenceEngine:
             # arrive, and the zombie worker's late put_nowait will just
             # hit a full queue and be dropped.
             for _, b in list(self._inflight):
-                for _, out_q in b:
+                for _, out_q, _ in b:
                     try:
                         out_q.put_nowait(None)
                     except queue.Full:
@@ -103,7 +111,7 @@ class InferenceEngine:
         # forever on its result queue.
         while True:
             try:
-                _, out_q = self._q.get_nowait()
+                _, out_q, _ = self._q.get_nowait()
             except queue.Empty:
                 break
             out_q.put(None)
@@ -111,7 +119,8 @@ class InferenceEngine:
     def submit(self, tokens: np.ndarray) -> queue.Queue:
         """Enqueue one request [S]; returns a queue delivering the result."""
         out: queue.Queue = queue.Queue(maxsize=1)
-        self._q.put((tokens, out))
+        metrics.REQUESTS.inc()
+        self._q.put((tokens, out, time.perf_counter()))
         return out
 
     def _loop(self):
@@ -122,8 +131,19 @@ class InferenceEngine:
             # host fetch, not block_until_ready (unreliable on remote
             # backends): executions are in-order per device, so pulling
             # this batch's outputs drains everything dispatched before
-            host = np.asarray(outputs)
-            for i, (_, out_q) in enumerate(b):
+            with telemetry.span("engine.deliver", cat="serving",
+                                requests=len(b)):
+                host = np.asarray(outputs)
+            now = time.perf_counter()
+            for i, (toks, out_q, t_sub) in enumerate(b):
+                dt = now - t_sub
+                metrics.REQUEST_LATENCY.observe(dt)
+                # one-shot inference: the full result IS the first
+                # output, so TTFT == request latency; per-token time is
+                # the latency spread over the request's real positions
+                metrics.TTFT.observe(dt)
+                metrics.TPOT.observe(
+                    dt / max(1, min(len(toks), self.seq_len)))
                 try:
                     # put_nowait: if stop() already sentineled this
                     # client (hung-fetch recovery), don't wedge the
@@ -133,7 +153,7 @@ class InferenceEngine:
                     pass
 
         while not self._halt.is_set():
-            batch: List[Tuple[np.ndarray, queue.Queue]] = []
+            batch: List[Tuple[np.ndarray, queue.Queue, float]] = []
             try:
                 # stay responsive while results are pending delivery
                 batch.append(self._q.get(timeout=0.002 if inflight
@@ -142,23 +162,28 @@ class InferenceEngine:
                 if inflight:
                     deliver_oldest()   # idle: drain the pipeline
                 continue
-            deadline = time.monotonic() + self.max_wait
-            while len(batch) < self.batch_size:
-                budget = deadline - time.monotonic()
-                if budget <= 0:
-                    break
-                try:
-                    batch.append(self._q.get(timeout=budget))
-                except queue.Empty:
-                    break
-            tokens = np.full((self.batch_size, self.seq_len), self.pad_id,
-                             dtype=np.int32)
-            mask = np.zeros((self.batch_size, self.seq_len), dtype=np.int32)
-            for i, (toks, _) in enumerate(batch):
-                n = min(len(toks), self.seq_len)
-                tokens[i, :n] = toks[:n]
-                mask[i, :n] = 1
-            inflight.append((self.infer_async(tokens, mask), batch))
+            with telemetry.span("engine.batch", cat="serving"):
+                deadline = time.monotonic() + self.max_wait
+                while len(batch) < self.batch_size:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0:
+                        break
+                    try:
+                        batch.append(self._q.get(timeout=budget))
+                    except queue.Empty:
+                        break
+                tokens = np.full((self.batch_size, self.seq_len),
+                                 self.pad_id, dtype=np.int32)
+                mask = np.zeros((self.batch_size, self.seq_len),
+                                dtype=np.int32)
+                for i, (toks, _, _) in enumerate(batch):
+                    n = min(len(toks), self.seq_len)
+                    tokens[i, :n] = toks[:n]
+                    mask[i, :n] = 1
+            metrics.BATCH_FILL.set(len(batch) / self.batch_size)
+            with telemetry.span("engine.dispatch", cat="serving",
+                                requests=len(batch)):
+                inflight.append((self.infer_async(tokens, mask), batch))
             if len(inflight) >= self.pipeline_depth:
                 deliver_oldest()
         while inflight:                # halt: nothing may stay undelivered
@@ -211,6 +236,12 @@ def measure_qps(engine: InferenceEngine, n_batches: int = 20,
     fetch_barrier(last)
     dt = time.perf_counter() - t0
     queries = n_batches * engine.batch_size
+    # telemetry lands AFTER the clock stops: the timed loop itself adds
+    # only the per-dispatch counter inc (the <2% overhead budget)
+    metrics.QPS.set(queries / dt)
+    telemetry.tracer.instant("engine.measure_qps", cat="serving",
+                             qps=round(queries / dt, 2),
+                             batches=n_batches)
     return {
         "qps": queries / dt,
         "latency_ms": dt / n_batches * 1000.0,

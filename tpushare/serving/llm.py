@@ -77,7 +77,8 @@ class LLMServer:
         shard per ``tpushare.parallel.mesh``).  ``spec_k > 0`` turns on
         opportunistic prompt-lookup speculation for all-greedy batches
         (greedy-exact; see ContinuousService)."""
-        from ..utils.httpserver import JsonHTTPServer
+        from .. import telemetry
+        from ..utils.httpserver import JsonHTTPServer, RawBody
 
         self.cfg = cfg
         self.params = params
@@ -115,6 +116,13 @@ class LLMServer:
             ("POST", "/score"): self._score,
             ("GET", "/healthz"): lambda _: (200, "ok\n"),
             ("GET", "/stats"): self._stats,
+            # workload-side telemetry: the serving-plane series this
+            # process recorded (engine/batcher/paged/spec), Prometheus
+            # text format — what `kubectl inspect tpushare --metrics`
+            # scrapes per node
+            ("GET", "/metrics"): self._metrics,
+            ("GET", "/debug/trace"): lambda _: (
+                200, telemetry.tracer.to_chrome()),
         })
         self.port = self._http.port
 
@@ -412,8 +420,25 @@ class LLMServer:
             payload["text"] = [tok.decode(row) for row in rows]
         return payload
 
-    def _stats(self, _):
+    def _refresh_qps(self) -> float:
+        """Mirror the served rate into the registry at read time, so a
+        /metrics-only scraper (inspect --metrics) sees a live value,
+        not whatever the last /stats poll froze in."""
+        from . import metrics
         dt = time.monotonic() - self._t0
+        if dt:
+            metrics.QPS.set(round(self.requests_served / dt, 3))
+        return dt
+
+    def _metrics(self, _):
+        from .. import telemetry
+        from ..utils.httpserver import RawBody
+        self._refresh_qps()
+        return 200, RawBody(telemetry.REGISTRY.render(),
+                            telemetry.PROM_CONTENT_TYPE)
+
+    def _stats(self, _):
+        dt = self._refresh_qps()
         stats = {
             "requests_served": self.requests_served,
             "sequences_served": self.sequences_served,

@@ -1,0 +1,94 @@
+"""Serving-plane metric handles (one definition per series).
+
+Every serving module records into these shared handles, so the engine's
+micro-batcher and the continuous batcher feed the SAME latency
+histograms without importing each other (registration is get-or-create,
+but defining each family exactly once keeps help text and buckets from
+drifting).  Names follow the namespace lint: ``tpushare_`` prefix,
+``_total`` for counters, ``_seconds`` for time histograms, ``_bytes``
+for byte gauges (tests/test_metric_lint.py).
+
+This module itself is stdlib-only (the jax-heavy modules import it, not
+the other way around).
+"""
+
+from __future__ import annotations
+
+from .. import telemetry
+
+# -- request-level latency (engine micro-batcher AND continuous service) --
+REQUEST_LATENCY = telemetry.histogram(
+    "tpushare_engine_request_latency_seconds",
+    "Submit-to-deliver latency per request through the serving plane")
+TTFT = telemetry.histogram(
+    "tpushare_engine_ttft_seconds",
+    "Time to first output per request (first token for streaming decode; "
+    "full result for one-shot batched inference)")
+TPOT = telemetry.histogram(
+    "tpushare_engine_tpot_seconds",
+    "Per-token time per request (decode time per generated token for "
+    "streaming; latency per sequence position for one-shot inference)",
+    buckets=(1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+             5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0))
+REQUESTS = telemetry.counter(
+    "tpushare_engine_requests_total",
+    "Requests submitted to the serving plane")
+BATCHES = telemetry.counter(
+    "tpushare_engine_batches_total",
+    "Batches dispatched to the device (direct and micro-batched)")
+BATCH_FILL = telemetry.gauge(
+    "tpushare_engine_batch_fill",
+    "Fraction of rows holding real requests in the last dispatched batch")
+QPS = telemetry.gauge(
+    "tpushare_engine_qps",
+    "Queries/s: the most recent measure_qps result, or the serving "
+    "process's lifetime served rate (refreshed at scrape time)")
+
+# -- continuous batcher ---------------------------------------------------
+TICK_DURATION = telemetry.histogram(
+    "tpushare_tick_duration_seconds",
+    "Wall time of one batcher tick call (single, fused, or speculative)")
+OCCUPANCY = telemetry.gauge(
+    "tpushare_batch_occupancy",
+    "Active decoding slots / slot capacity after the last tick")
+ADMISSIONS = telemetry.counter(
+    "tpushare_admissions_total",
+    "Requests admitted into a batcher slot")
+COMPLETIONS = telemetry.counter(
+    "tpushare_completions_total",
+    "Requests finished by the batcher (slot released)")
+CANCELLATIONS = telemetry.counter(
+    "tpushare_cancellations_total",
+    "Requests cancelled before completion (slot/storage reclaimed)")
+FUSED_STEPS = telemetry.counter(
+    "tpushare_fused_steps_total",
+    "Decode steps executed inside fused (scan) tick chunks")
+
+# -- speculation ----------------------------------------------------------
+SPEC_PROPOSED = telemetry.counter(
+    "tpushare_spec_proposed_total",
+    "Draft/lookup tokens proposed to the verifier")
+SPEC_ACCEPTED = telemetry.counter(
+    "tpushare_spec_accepted_total",
+    "Proposed tokens accepted by the target (acceptance rate = "
+    "accepted/proposed)")
+SPEC_ROUNDS = telemetry.counter(
+    "tpushare_spec_rounds_total",
+    "Batched speculative verify rounds executed")
+SPEC_TOKENS = telemetry.counter(
+    "tpushare_spec_tokens_total",
+    "Tokens committed by batched speculative rounds")
+
+# -- paged KV storage -----------------------------------------------------
+KV_PAGES_USED = telemetry.gauge(
+    "tpushare_kv_pages_used",
+    "KV pool pages currently reserved (slots + cached prefixes)")
+KV_PAGES_FREE = telemetry.gauge(
+    "tpushare_kv_pages_free",
+    "KV pool pages on the free list")
+PREFIX_HITS = telemetry.counter(
+    "tpushare_prefix_cache_hits_total",
+    "Admissions that mapped a cached prompt prefix")
+PREFIX_MISSES = telemetry.counter(
+    "tpushare_prefix_cache_misses_total",
+    "Prefix-cache-eligible admissions with no registered prefix")

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import transformer
+from . import metrics
 from .continuous import ContinuousBatcher, _sample_next
 
 log = logging.getLogger("tpushare.serving")
@@ -205,6 +206,14 @@ class PagedContinuousBatcher(ContinuousBatcher):
             (self.n_slots, self.pages_per_slot), np.int32)
         self._free_pages: List[int] = list(range(1, self.n_pages))  # 0=trash
         self._slot_pages: Dict[int, List[int]] = {}
+        self._update_page_gauges()
+
+    def _update_page_gauges(self) -> None:
+        """KV-pool utilization for /metrics (page 0 — trash — excluded:
+        it is never allocatable, so used+free == n_pages-1)."""
+        free = len(self._free_pages)
+        metrics.KV_PAGES_FREE.set(free)
+        metrics.KV_PAGES_USED.set(self.n_pages - 1 - free)
 
     def _held_pages(self, prompt_len: int, max_new: int) -> int:
         """Physical pages a request occupies SIMULTANEOUSLY.
@@ -303,6 +312,15 @@ class PagedContinuousBatcher(ContinuousBatcher):
             for j in range(n_ranges):
                 self.page_table[slot, j] = pages[j % held]
         self._slot_pages[slot] = pages
+        self._update_page_gauges()
+        if self.prefix_cache_enabled and prompt is not None \
+                and held == n_ranges:
+            # counted only on SUCCESSFUL reservation: a backpressure
+            # failure gets requeued and retried every loop iteration,
+            # which would inflate the hit-rate counters once per tick
+            # for the whole pressure window
+            (metrics.PREFIX_HITS if shared is not None
+             else metrics.PREFIX_MISSES).inc()
         return True
 
     def _prefill_start(self, slot: int) -> int:
@@ -320,6 +338,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self._maybe_register(slot)
         self.page_table[slot, :] = 0
         self._free_pages.extend(self._slot_pages.pop(slot, []))
+        self._update_page_gauges()
 
     def _maybe_register(self, slot: int) -> None:
         """Donate a COMPLETED request's pure-prompt full pages to the

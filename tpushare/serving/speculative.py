@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import transformer
+from . import metrics
 from .generate import make_decode_fns
 
 
@@ -97,6 +98,7 @@ def speculative_generate(target_params, target_cfg: transformer.ModelConfig,
             d_pos += 1
             proposal.append(tok)
         stats.proposed += kk
+        metrics.SPEC_PROPOSED.inc(kk)
 
         # --- target verifies next_tok + proposal in one forward ----------
         block = jnp.asarray([[next_tok] + proposal], jnp.int32)
@@ -110,6 +112,7 @@ def speculative_generate(target_params, target_cfg: transformer.ModelConfig,
         while n_accept < kk and proposal[n_accept] == greedy[n_accept]:
             n_accept += 1
         stats.accepted += n_accept
+        metrics.SPEC_ACCEPTED.inc(n_accept)
 
         tokens.extend(proposal[:n_accept])
         old_ctx = n_ctx

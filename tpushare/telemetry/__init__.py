@@ -1,0 +1,43 @@
+"""Unified telemetry plane: metrics registry + request tracing.
+
+One process-global :data:`REGISTRY` (counters / gauges / fixed-bucket
+histograms, Prometheus text rendering) and one process-global
+:data:`tracer` (bounded ring buffer of Chrome trace events).  Both
+planes instrument against these; the daemon's ``/metrics`` and
+``/debug/trace`` and the LLM server's same-named endpoints serve them.
+
+``set_enabled(False)`` turns every instrumentation site into a single
+flag check (the near-free disabled path the overhead test pins down).
+Stdlib only — importable from the device-plugin daemon, the inspect
+CLI, and workload containers alike.
+"""
+
+import time as _time
+from contextlib import contextmanager as _contextmanager
+
+from .registry import (DEFAULT_LATENCY_BUCKETS, PROM_CONTENT_TYPE,  # noqa: F401
+                       REGISTRY, Counter, Gauge, Histogram, Registry,
+                       counter, enabled, gauge, histogram, parse_text,
+                       quantile_from_buckets, set_enabled)
+from .trace import TRACER as tracer  # noqa: F401
+from .trace import Tracer  # noqa: F401
+
+
+def span(name: str, cat: str = "tpushare", **args):
+    """Record a span on the global tracer (no-op context when disabled)."""
+    return tracer.span(name, cat=cat, **args)
+
+
+@_contextmanager
+def timed(hist: Histogram, name: str, cat: str = "tpushare", **args):
+    """One span + one histogram observation over the same wall-time
+    window — the RPC instrumentation idiom (Allocate, kubelet queries),
+    defined once so the two readings can never drift apart.  The
+    histogram observes even when the body raises (failures count toward
+    latency; they are the slow calls an operator is hunting)."""
+    t0 = _time.perf_counter()
+    with tracer.span(name, cat=cat, **args):
+        try:
+            yield
+        finally:
+            hist.observe(_time.perf_counter() - t0)

@@ -1,0 +1,380 @@
+"""Dependency-free, thread-safe metrics registry (Prometheus text format).
+
+The telemetry substrate both planes instrument against (DESIGN.md
+"telemetry plane"): counters, gauges, and fixed-bucket histograms in one
+process-global registry, rendered in the Prometheus text exposition
+format (``# HELP``/``# TYPE`` metadata per family, escaped labels,
+cumulative ``_bucket``/``_sum``/``_count`` series for histograms).
+
+Design constraints, in order:
+
+* **stdlib only** — the workload containers and the daemon both import
+  this; neither may grow a dependency;
+* **near-free when disabled** — every mutating op starts with one module
+  -global flag check and returns, so instrumentation can stay inline in
+  the serving hot path permanently (the overhead test pins the enabled
+  path under 2% too, because a mutation is one dict op under a
+  per-metric lock against millisecond-scale device work);
+* **get-or-create registration** — modules declare their own metrics at
+  import; two modules naming the same series share one instance, so the
+  serving engine and the continuous batcher can feed the same latency
+  histogram without importing each other.
+
+``parse_text`` is the matching strict parser (used by the inspect CLI's
+``--metrics`` mode and by tests as the exposition-format oracle).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+#: Prometheus text exposition content type (version is part of the
+#: format contract scrapers negotiate on).
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Default buckets for latency histograms, in seconds: sub-ms lanes for
+#: on-chip ticks through multi-second lanes for tunnel-attached RPCs.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Global telemetry switch (metrics AND tracing).  The disabled path
+    is one flag check per instrumentation site."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+def _escape_label_value(v) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n").replace("\r", ""))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    return ("{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                           for k, v in key) + "}")
+
+
+def _fmt_value(v) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+        self._vals: dict = {}
+
+    def clear(self) -> None:
+        """Drop every labeled series (e.g. before re-mirroring gauges
+        whose label sets churn, like per-tenant usage)."""
+        with self._lock:
+            self._vals.clear()
+
+    def samples(self) -> List[Tuple[str, tuple, float]]:
+        """[(series_name, label_key, value)] — the exposition lines."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, by: float = 1, **labels) -> None:
+        if not _enabled:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0.0) + by
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._vals.get(_labelkey(labels), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in sorted(self._vals.items())]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._vals[_labelkey(labels)] = float(value)
+
+    def add(self, by: float = 1, **labels) -> None:
+        if not _enabled:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0.0) + by
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._vals.get(_labelkey(labels))
+
+    def samples(self):
+        with self._lock:
+            return [(self.name, k, v) for k, v in sorted(self._vals.items())]
+
+
+def quantile_from_buckets(bounds: List[float], cum_counts: List[float],
+                          q: float) -> Optional[float]:
+    """Quantile estimate from cumulative histogram buckets.
+
+    ``bounds`` are the finite upper bounds (ascending); ``cum_counts``
+    the cumulative counts per bucket PLUS the +Inf bucket (so
+    ``len(cum_counts) == len(bounds) + 1``).  Linear interpolation
+    within the winning bucket, like PromQL's ``histogram_quantile``;
+    values in the +Inf bucket clamp to the largest finite bound.
+    """
+    if not cum_counts:
+        return None
+    total = cum_counts[-1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in zip(bounds, cum_counts):
+        if cum >= target:
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return bound
+            frac = (target - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = bound, cum
+    return bounds[-1] if bounds else None
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+
+    def observe(self, value: float, **labels) -> None:
+        if not _enabled:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            st = self._vals.get(key)
+            if st is None:
+                # [per-bucket counts (+Inf last), sum]
+                st = self._vals[key] = [[0] * (len(self.buckets) + 1), 0.0]
+            st[0][bisect_left(self.buckets, value)] += 1
+            st[1] += value
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            st = self._vals.get(_labelkey(labels))
+            return sum(st[0]) if st else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            st = self._vals.get(_labelkey(labels))
+            return st[1] if st else 0.0
+
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        with self._lock:
+            st = self._vals.get(_labelkey(labels))
+            if st is None:
+                return None
+            counts = list(st[0])
+        cum, acc = [], 0.0
+        for c in counts:
+            acc += c
+            cum.append(acc)
+        return quantile_from_buckets(list(self.buckets), cum, q)
+
+    def samples(self):
+        out = []
+        with self._lock:
+            items = sorted(self._vals.items())
+            for key, (counts, total) in items:
+                acc = 0
+                for bound, c in zip(self.buckets, counts):
+                    acc += c
+                    out.append((self.name + "_bucket",
+                                key + (("le", _fmt_value(bound)),), acc))
+                acc += counts[-1]
+                out.append((self.name + "_bucket",
+                            key + (("le", "+Inf"),), acc))
+                out.append((self.name + "_sum", key, total))
+                out.append((self.name + "_count", key, acc))
+        return out
+
+
+class Registry:
+    """Name -> metric; get-or-create with kind checking."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_text: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_text, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{m.kind}, not {cls.kind}")
+            return m
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        return self._get(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        return self._get(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, help_text, buckets=buckets)
+
+    def describe(self) -> List[Tuple[str, str, str]]:
+        """[(name, kind, help)] for every registered family — the lint
+        test's view of the namespace."""
+        with self._lock:
+            return [(m.name, m.kind, m.help)
+                    for m in sorted(self._metrics.values(),
+                                    key=lambda m: m.name)]
+
+    def render(self) -> str:
+        """Prometheus text format: HELP + TYPE + samples per family."""
+        lines = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            help_text = (m.help.replace("\\", r"\\").replace("\n", r"\n"))
+            lines.append(f"# HELP {m.name} {help_text}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for series, key, val in m.samples():
+                lines.append(f"{series}{_fmt_labels(key)} {_fmt_value(val)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every metric (keeps registrations) — test isolation."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
+
+
+#: the process-global registry every instrumentation site feeds
+REGISTRY = Registry()
+
+
+def counter(name: str, help_text: str) -> Counter:
+    return REGISTRY.counter(name, help_text)
+
+
+def gauge(name: str, help_text: str) -> Gauge:
+    return REGISTRY.gauge(name, help_text)
+
+
+def histogram(name: str, help_text: str,
+              buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
+              ) -> Histogram:
+    return REGISTRY.histogram(name, help_text, buckets=buckets)
+
+
+# --------------------------------------------------------------------------
+# Strict exposition-format parser (inspect --metrics + test oracle)
+# --------------------------------------------------------------------------
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # series name
+    r"(?:\{(.*)\})?"                        # optional label block
+    r" (\+?Inf|-Inf|NaN|[0-9eE.+-]+)$")     # value
+_LABEL_RE = re.compile(
+    r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"n": "\n", "\\": "\\", '"': '"'}
+
+
+def _unescape_label_value(raw: str) -> str:
+    # ONE left-to-right pass: sequential str.replace would corrupt a
+    # literal backslash-then-n ('a\\nb' escapes to 'a\\\\nb'; replacing
+    # '\\n' first would misread the second backslash as starting '\\n')
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), "\\" + m.group(1)), raw)
+
+
+def _parse_labels(block: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    rest = block
+    while rest:
+        m = _LABEL_RE.match(rest)
+        if not m:
+            raise ValueError(f"malformed label block: {block!r}")
+        labels[m.group(1)] = _unescape_label_value(m.group(2))
+        rest = rest[m.end():]
+    return labels
+
+
+def parse_text(text: str) -> dict:
+    """Parse Prometheus text exposition into
+    ``{"meta": {family: {"type": t, "help": h}},
+       "samples": {series: [(labels, value), ...]}}``.
+
+    Raises ``ValueError`` on any malformed line — strict on purpose, so
+    tests using it genuinely validate the exposition format.
+    """
+    meta: Dict[str, dict] = {}
+    samples: Dict[str, list] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            meta.setdefault(parts[0], {})["help"] = (
+                parts[1] if len(parts) > 1 else "")
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split(" ", 1)
+            if len(parts) != 2 or parts[1] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE line: {line!r}")
+            meta.setdefault(parts[0], {})["type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue        # comment
+        m = _SERIES_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        labels = _parse_labels(m.group(2)) if m.group(2) else {}
+        samples.setdefault(m.group(1), []).append(
+            (labels, float(m.group(3))))
+    return {"meta": meta, "samples": samples}

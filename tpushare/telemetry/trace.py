@@ -1,0 +1,121 @@
+"""Bounded ring-buffer event tracer emitting Chrome trace-event JSON.
+
+Request-path spans (submit -> batch -> dispatch -> deliver, Allocate
+RPCs, kubelet queries) land in a fixed-capacity deque; ``to_chrome()``
+renders the buffer as a Chrome/Perfetto trace-event object
+(``{"traceEvents": [...]}``) that ``chrome://tracing`` / ui.perfetto.dev
+load directly.  The daemon serves it at ``/debug/trace``.
+
+A RING buffer, not a log: tracing stays permanently on without an
+unbounded-memory or an I/O cost — old events fall off the back, and a
+dump shows the most recent window of activity, which is the window an
+operator debugging "why is serving slow RIGHT NOW" wants.  Span enter/
+exit is two ``perf_counter`` reads and one deque append (lock-held
+nanoseconds); when telemetry is disabled ``span()`` returns a shared
+no-op context, so the disabled path is one flag check.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import List
+
+from . import registry
+
+
+class _NullSpan:
+    """Shared no-op context for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        tr = self._tracer
+        now = time.perf_counter()
+        tr._emit({
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": (self._t0 - tr._epoch) * 1e6,
+            "dur": (now - self._t0) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": self.args,
+        })
+        return False
+
+
+class Tracer:
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._epoch = time.perf_counter()
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen or 0
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._buf = collections.deque(self._buf, maxlen=capacity)
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            self._buf.append(event)
+
+    def span(self, name: str, cat: str = "tpushare", **args):
+        """Context manager recording one complete ("X") event on exit.
+        ``args`` must be JSON-serializable (they ride into the dump)."""
+        if not registry.enabled():
+            return _NULL
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "tpushare", **args) -> None:
+        """One thread-scoped instant ("i") event."""
+        if not registry.enabled():
+            return
+        self._emit({
+            "name": name, "cat": cat, "ph": "i", "s": "t",
+            "ts": (time.perf_counter() - self._epoch) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+            "args": args,
+        })
+
+    def events(self) -> List[dict]:
+        """Snapshot, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def to_chrome(self) -> dict:
+        """The Chrome trace-event JSON object /debug/trace serves."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+
+#: the process-global tracer every span site feeds
+TRACER = Tracer()

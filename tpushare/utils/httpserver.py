@@ -25,10 +25,20 @@ class StreamingBody:
         self.content_type = content_type
 
 
+class RawBody:
+    """A handler payload with an explicit content type — for responses
+    whose media type carries protocol meaning (Prometheus' ``/metrics``
+    negotiates on ``text/plain; version=0.0.4``)."""
+
+    def __init__(self, data, content_type: str = "text/plain; charset=utf-8"):
+        self.data = data.encode() if isinstance(data, str) else data
+        self.content_type = content_type
+
+
 class JsonHTTPServer:
     """Routes: {(method, path): handler}; handler(body_dict|None) ->
-    (code, payload).  Payload str -> text/plain, StreamingBody ->
-    incremental write, else JSON."""
+    (code, payload).  Payload str -> text/plain, RawBody -> explicit
+    content type, StreamingBody -> incremental write, else JSON."""
 
     def __init__(self, port: int, addr: str,
                  routes: dict,
@@ -66,7 +76,10 @@ class JsonHTTPServer:
                             close()
                     self.close_connection = True
                     return
-                if isinstance(payload, str):
+                if isinstance(payload, RawBody):
+                    data = payload.data
+                    ctype = payload.content_type
+                elif isinstance(payload, str):
                     data = payload.encode()
                     ctype = "text/plain; charset=utf-8"
                 else:
