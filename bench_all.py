@@ -17,7 +17,91 @@ def _emit(metric, value, unit, **extra):
                       "unit": unit, **extra}), flush=True)
 
 
+def admit_while_decode_bench(params, cfg, *, slots, n_reqs, prompt_len,
+                             gen, chunk, decode_chunk, budget, reps=2,
+                             mesh=None):
+    """Admit-while-decode, MIXED single-dispatch rounds vs the
+    INTERLEAVED reference (one dispatch per prefilling slot plus one
+    fused decode dispatch per round) — driven at the batcher level so
+    both policies see the identical workload, round for round.  A
+    backlog of multi-chunk prompts streams in as slots free, so rounds
+    constantly carry mid-prefill slots alongside decoding ones — the
+    regime where dispatch count, not FLOPs, is the bottleneck.
+
+    ``mesh`` (CPU runs): a tensor-parallel mesh over the virtual
+    8-device CPU mesh, the off-TPU proxy for per-dispatch cost — SPMD
+    launch overhead stands in for the ~70 ms tunnel RPC every dispatch
+    pays in production, which single-device CPU dispatch (async,
+    pipelined, sub-ms) cannot represent.
+
+    Returns per-policy {tokens/s, rounds, dispatches}; the last of
+    ``reps`` runs is the timed one (earlier runs absorb the compiles).
+    Importable so a test can smoke-run it at tiny sizes (tier-1-safe).
+    """
+    from tpushare.serving.continuous import ContinuousBatcher
+
+    def run(mixed):
+        b = ContinuousBatcher(params, cfg, n_slots=slots, mesh=mesh)
+        dispatches = [0]
+        real_step = b._step_mixed
+        real_chunk = b._prefill_chunk_into
+        real_n = b._step_n
+
+        def count(fn):
+            def wrapped(*a, **k):
+                dispatches[0] += 1
+                return fn(*a, **k)
+            return wrapped
+
+        b._step_mixed = count(real_step)
+        b._prefill_chunk_into = count(real_chunk)
+        b._step_n = count(real_n)
+        pending = [1 + (i % 50) for i in range(n_reqs)]
+
+        def admit():
+            while pending and b.free_slots():
+                if b.admit_chunked([pending[0]] * prompt_len, gen,
+                                   chunk=chunk) is None:
+                    return
+                pending.pop(0)
+
+        admit()
+        rounds = 0
+        t0 = time.perf_counter()
+        while pending or b.prefilling or b.slots:
+            # both arms follow the SERVICE loop's policy for their mode
+            if mixed and b.prefilling:
+                b.tick_mixed(decode_chunk, chunk=chunk, budget=budget)
+            else:
+                if b.prefilling:
+                    b.advance_prefill()
+                b.tick_fused(decode_chunk)
+            admit()
+            rounds += 1
+        dt = time.perf_counter() - t0
+        assert len(b.completed) == n_reqs, "bench did not drain"
+        return {"tokens_per_s": n_reqs * gen / dt, "rounds": rounds,
+                "dispatches": dispatches[0]}
+
+    out = {}
+    for name, mixed in (("interleaved", False), ("mixed", True)):
+        for _ in range(reps):
+            out[name] = run(mixed)
+    return out
+
+
 def main() -> int:
+    import os
+    import sys
+    if "jax" not in sys.modules:
+        # the admit-while-decode scenario needs the virtual 8-device
+        # CPU mesh (its tp arm is the per-dispatch cost proxy); the
+        # flag is harmless on TPU (it only affects the cpu platform)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -126,6 +210,39 @@ def main() -> int:
           prompt_len=prompt_len, gen=svc_gen, decode_chunk=svc_chunk,
           note="admit-while-decode: generated tokens only; prefill work "
                "inside the timed window")
+
+    # 2a-dispatch. admit-while-decode, ONE mixed dispatch per round vs
+    # the interleaved reference (1 + #prefilling dispatches): the
+    # token-budget mixed step's whole point is dispatch count — on the
+    # tunnel every dispatch is ~70 ms, so rounds carrying several
+    # mid-prefill slots pay multiples of it without the coalesced
+    # block.  Off-TPU the scenario runs tensor-parallel over the
+    # virtual 8-device CPU mesh: SPMD launch overhead is the honest
+    # per-dispatch cost proxy (single-device CPU dispatch is async and
+    # sub-ms, hiding exactly the tax being measured).
+    awd_mesh = None
+    if not on_tpu and len(jax.devices()) >= 4:
+        from tpushare.parallel.mesh import make_mesh
+        awd_mesh = make_mesh({"tp": 4})
+    awd_slots = 8   # the win scales with CONCURRENT prefills per round
+    awd = admit_while_decode_bench(
+        lparams, lcfg, slots=awd_slots, n_reqs=2 * awd_slots,
+        prompt_len=(6 * 16) if on_tpu else 40,
+        gen=17 if on_tpu else 5,
+        chunk=16 if on_tpu else 4,
+        decode_chunk=8 if on_tpu else 2,
+        budget=(16 * awd_slots) if on_tpu else (4 * awd_slots),
+        mesh=awd_mesh)
+    _emit("admit_while_decode_tokens_per_s_mixed",
+          awd["mixed"]["tokens_per_s"], "tokens/s", platform=platform,
+          slots=awd_slots, tp=(4 if awd_mesh is not None else 0),
+          rounds=awd["mixed"]["rounds"],
+          dispatches=awd["mixed"]["dispatches"],
+          interleaved_dispatches=awd["interleaved"]["dispatches"],
+          vs_interleaved=round(awd["mixed"]["tokens_per_s"]
+                               / awd["interleaved"]["tokens_per_s"], 3),
+          note="generated tokens only; prompts stream in while earlier "
+               "requests decode (mixed = 1 dispatch/round)")
 
     # 2b. same decode workload through the PAGED batcher: measures the
     # gather/scatter overhead paged storage pays per tick (its win is

@@ -178,19 +178,54 @@ def test_fused_overrun_at_max_seq_boundary(model, paged):
 
 
 @pytest.mark.parametrize("paged", [False, True])
-def test_service_fused_engages_while_prefilling(model, paged):
-    """Under admit-while-decode traffic the loop must interleave FUSED
-    decode chunks with prompt chunks — not fall back to single ticks
-    whenever anything is prefilling (which starved the fused path under
-    exactly the ragged traffic the batcher exists for) — and outputs
-    must still match per-request greedy, on BOTH storages (the paged
-    garbage-write containment is load-bearing here too)."""
+def test_service_mixed_step_engages_while_prefilling(model, paged):
+    """Under admit-while-decode traffic the default loop must serve
+    each round with ONE mixed dispatch (coalesced prompt chunks fused
+    with the decode scan) — and outputs must still match per-request
+    greedy, on BOTH storages (the paged garbage-write containment is
+    load-bearing here too)."""
     params, cfg = model
     # paged admission rounds the prefill chunk UP to a page multiple, so
     # the page must not exceed the chunk or prompts prefill in one piece
     # and the interleave window this test observes never opens
     service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
                                 decode_chunk=4,
+                                page_size=4 if paged else None)
+    mixed_while_prefilling = []
+    b = service._batcher
+    real_mixed = b.tick_mixed
+
+    def spy(n, **kw):
+        if b.prefilling:
+            mixed_while_prefilling.append(n)
+        return real_mixed(n, **kw)
+
+    b.tick_mixed = spy
+    service.start()
+    try:
+        # long prompts (multiple prefill chunks) arriving while earlier
+        # requests decode long generations: prefilling is non-empty for
+        # many loop iterations mid-decode
+        reqs = [([3, 5, 7], 24), ([1] * 14, 20), ([2] * 11, 16),
+                ([6, 6, 6], 12)]
+        sinks = [service.submit(p, n) for p, n in reqs]
+        for sink, (p, n) in zip(sinks, reqs):
+            assert sink.get(timeout=120) == _plain(params, cfg, p, n)
+    finally:
+        service.stop()
+    assert mixed_while_prefilling, \
+        "no mixed round ran while a slot was prefilling"
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_service_fused_engages_while_prefilling_sequential(model, paged):
+    """With mixed_step=False the loop must still interleave FUSED decode
+    chunks with prompt chunks — not fall back to single ticks whenever
+    anything is prefilling (the pre-mixed regression this test
+    originally guarded) — on BOTH storages."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4, mixed_step=False,
                                 page_size=4 if paged else None)
     fused_while_prefilling = []
     b = service._batcher
@@ -204,9 +239,6 @@ def test_service_fused_engages_while_prefilling(model, paged):
     b.tick_fused = spy
     service.start()
     try:
-        # long prompts (multiple prefill chunks) arriving while earlier
-        # requests decode long generations: prefilling is non-empty for
-        # many loop iterations mid-decode
         reqs = [([3, 5, 7], 24), ([1] * 14, 20), ([2] * 11, 16),
                 ([6, 6, 6], 12)]
         sinks = [service.submit(p, n) for p, n in reqs]

@@ -37,6 +37,11 @@ def _seed_serving_metrics():
                     "KV pool pages currently reserved").set(30)
     telemetry.gauge("tpushare_kv_pages_free",
                     "KV pool pages on the free list").set(10)
+    telemetry.gauge("tpushare_prefill_queue_depth",
+                    "Slots currently mid-prefill").set(2)
+    telemetry.gauge("tpushare_mixed_budget_utilization",
+                    "Real prompt tokens / padded prefill-block "
+                    "capacity").set(0.62)
 
 
 def test_summarize_serving_quantiles():
@@ -48,6 +53,8 @@ def test_summarize_serving_quantiles():
     assert 0.25 < s["ttft_p99_s"] <= 0.5
     assert s["occupancy"] == 0.75
     assert s["kv_util"] == 0.75
+    assert s["prefill_queue"] == 2
+    assert s["mixed_budget_util"] == 0.62
 
 
 def _run_inspect(monkeypatch, api, argv):
@@ -75,6 +82,8 @@ def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
         assert "TTFT p50(ms)" in out and "TTFT p99(ms)" in out
         assert "75%" in out                       # occupancy
         assert "30/10 (75%)" in out               # KV pages used/free (util)
+        assert "PREFILL Q" in out and "BUDGET%" in out
+        assert "62%" in out                       # mixed budget utilization
     finally:
         api.stop()
         srv.stop()

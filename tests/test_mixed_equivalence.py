@@ -1,0 +1,196 @@
+"""Mixed-step bit-identity: tick_mixed outputs must equal the
+sequential admit+decode path (and hence per-request generate()) on
+every storage flavor — dense, paged, ROLLING dense, windowed page ring,
+prefix cache — for greedy and sampling, eos, and tight budgets."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.generate import generate
+from tpushare.serving.paged import PagedContinuousBatcher
+
+pytestmark = pytest.mark.slow  # heavy JAX equivalence suite
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def wmodel():
+    cfg = transformer.tiny(max_seq=96, window=16)
+    params = transformer.init_params(jax.random.PRNGKey(4), cfg)
+    return params, cfg
+
+
+def _plain(params, cfg, prompt, n):
+    return [int(t) for t in generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), max_new_tokens=n)[0]]
+
+
+def _drain_mixed(b, n_steps=4, chunk=4, budget=8, max_rounds=500):
+    for _ in range(max_rounds):
+        if not b.prefilling and not b.slots:
+            return
+        b.tick_mixed(n_steps, chunk=chunk, budget=budget)
+    raise RuntimeError("did not drain")
+
+
+REQS = [(list(range(1, 11)), 6), ([3, 5, 7], 8), ([9] * 14, 5)]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_mixed_greedy_matches_generate(model, paged):
+    params, cfg = model
+    if paged:
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4)
+    else:
+        b = ContinuousBatcher(params, cfg, n_slots=3)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in REQS]
+    _drain_mixed(b)
+    for rid, (p, n) in zip(rids, REQS):
+        assert b.completed[rid] == _plain(params, cfg, p, n), rid
+    if paged:
+        assert b.free_page_count() == b.n_pages - 1
+
+
+def test_mixed_sampling_bitidentical_to_sequential(model):
+    """Same seed through the sequential single-tick path and through
+    mixed rounds (with a decoding greedy neighbour) must emit the same
+    stream — the in-program key chain replays the host splits."""
+    params, cfg = model
+    prompt, n = list(range(1, 11)), 7
+
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    rg = b.admit([7, 8, 9], 12)                 # greedy, decoding all along
+    rs = b.admit_chunked(prompt, n, chunk=3, temperature=0.9, seed=17)
+    _drain_mixed(b, n_steps=3, chunk=4, budget=4)
+
+    ref = ContinuousBatcher(params, cfg, n_slots=1)
+    rr = ref.admit(prompt, n, temperature=0.9, seed=17)
+    ref.run_until_drained()
+    assert b.completed[rs] == ref.completed[rr]
+    assert b.completed[rg] == _plain(params, cfg, [7, 8, 9], 12)
+
+
+def test_mixed_rolling_dense_pool(wmodel):
+    """Windowed config -> ROLLING window-sized slots: the coalesced
+    prefill's per-row kv_write_len must keep padded tails out of the
+    ring, and frozen garbage aims must not evict attendable keys."""
+    params, cfg = wmodel
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    assert b.rolling_slots
+    reqs = [(list(range(1, 25)), 8), ([3, 5, 7], 10), ([9] * 30, 6)]
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in reqs]
+    _drain_mixed(b)
+    for rid, (p, n) in zip(rids, reqs):
+        assert b.completed[rid] == _plain(params, cfg, p, n), rid
+
+
+def test_mixed_windowed_page_ring(wmodel):
+    """Windowed config on PAGED storage (page ring): mixed rounds must
+    respect the ring's prefill margin (chunk clamped into
+    max_prefill_chunk) and stay exact."""
+    params, cfg = wmodel
+    b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4,
+                               max_prefill_chunk=8)
+    reqs = [(list(range(1, 25)), 8), ([3, 5, 7], 10), ([9] * 30, 6)]
+    rids = [b.admit_chunked(p, n, chunk=8) for p, n in reqs]
+    _drain_mixed(b, chunk=8, budget=16)
+    for rid, (p, n) in zip(rids, reqs):
+        assert b.completed[rid] == _plain(params, cfg, p, n), rid
+
+
+def test_mixed_prefix_cache_reuse(model):
+    """A second same-prefix request admitted through mixed rounds must
+    map the registry pages (pos starts past the shared head) and decode
+    exactly."""
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                               prefix_cache=True)
+    pref = list(range(1, 13))
+    b.admit_chunked(pref, 4, chunk=4)
+    _drain_mixed(b)
+    r2 = b.admit_chunked(pref + [50, 51], 5, chunk=4)
+    st = list(b.prefilling.values())[0]
+    assert st.pos > 0, "prefix cache did not skip the shared head"
+    _drain_mixed(b)
+    assert b.completed[r2] == _plain(params, cfg, pref + [50, 51], 5)
+
+
+def test_mixed_eos_and_midchunk_completion(model):
+    """eos emitted inside a mixed round's scan finishes the request AT
+    the eos (surplus garbage steps never leak), matching generate()."""
+    params, cfg = model
+    prompt, n = [3, 5, 7], 24
+    full = _plain(params, cfg, prompt, n)
+    gen_part = full[len(prompt):]
+    eos = None
+    for pos in range(1, len(gen_part) - 2):
+        if gen_part[pos] not in gen_part[:pos]:
+            eos, want = gen_part[pos], full[:len(prompt) + pos + 1]
+            break
+    assert eos is not None, "no usable eos case"
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    r1 = b.admit_chunked(prompt, n, chunk=4, eos_id=eos)
+    r2 = b.admit_chunked([6] * 9, 5, chunk=4)   # mid-chunk finisher
+    _drain_mixed(b, n_steps=4)
+    assert b.completed[r1] == want
+    assert b.completed[r2] == _plain(params, cfg, [6] * 9, 5)
+
+
+def test_mixed_budget_tighter_than_queue(model):
+    """More concurrent prompts than budget rows: rotation must still
+    drain everything exactly (fairness is covered in the fast lane;
+    exactness under rotation is covered here)."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=4)
+    reqs = [([1 + i] * 22, 4) for i in range(4)]
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in reqs]
+    _drain_mixed(b, n_steps=2, chunk=4, budget=8)     # R=2 of 4
+    for rid, (p, n) in zip(rids, reqs):
+        assert b.completed[rid] == _plain(params, cfg, p, n), rid
+
+
+def test_mixed_boundary_overflow_falls_back_narrow(model):
+    """A slot whose next window would cross max_seq (possible only
+    after uneven sequential chunking) must advance through the narrow
+    sequential fallback and stay exact."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    prompt = [2] * 95
+    r = b.admit_chunked(prompt, 1, chunk=45)
+    rd = b.admit([4, 5, 6], 8)                  # decoding neighbour
+    b.advance_prefill()
+    b.advance_prefill()                         # pos = 90; 90+8 > 96
+    assert list(b.prefilling.values())[0].pos == 90
+    b.tick_mixed(2, chunk=8, budget=16)
+    _drain_mixed(b, n_steps=2, chunk=8, budget=16)
+    assert b.completed[r] == _plain(params, cfg, prompt, 1)
+    assert b.completed[rd] == _plain(params, cfg, [4, 5, 6], 8)
+
+
+def test_service_mixed_equals_sequential_flag(model):
+    """The service's two policies (mixed default vs mixed_step=False)
+    must deliver identical outputs for the same traffic."""
+    params, cfg = model
+    reqs = [([3, 5, 7], 10), ([1] * 14, 8), ([2] * 11, 6),
+            ([6, 6, 6], 9)]
+    outs = {}
+    for mixed in (True, False):
+        svc = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=4, mixed_step=mixed).start()
+        try:
+            sinks = [svc.submit(p, n) for p, n in reqs]
+            outs[mixed] = [s.get(timeout=120) for s in sinks]
+        finally:
+            svc.stop()
+    assert outs[True] == outs[False]
+    for got, (p, n) in zip(outs[True], reqs):
+        assert got == _plain(params, cfg, p, n)

@@ -1,0 +1,249 @@
+"""Mixed-step scheduler mechanics (fast lane): one dispatch per steady
+round, round-robin chunk fairness under the token budget, cancel in
+every request state, and the mixed-step telemetry series.
+
+Bit-identity of mixed outputs against the sequential path lives in the
+slow suite (tests/test_mixed_equivalence.py); this file covers the
+scheduler's CONTROL behavior at small shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpushare.serving import metrics
+from tpushare.models import transformer
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.generate import generate
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _plain(params, cfg, prompt, n):
+    return [int(t) for t in generate(
+        params, cfg, jnp.asarray([prompt], jnp.int32), max_new_tokens=n)[0]]
+
+
+def _drain_mixed(b, n_steps=2, chunk=4, budget=8, max_rounds=300):
+    for _ in range(max_rounds):
+        if not b.prefilling and not b.slots:
+            return
+        b.tick_mixed(n_steps, chunk=chunk, budget=budget)
+    raise RuntimeError("did not drain")
+
+
+def _count_dispatches(b):
+    """Wrap every device-dispatching batcher hook with a counter —
+    the dispatch-count assertion instrument."""
+    counts = {"mixed": 0, "other": 0}
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    wrap("_step_mixed", "mixed")
+    wrap("_step", "other")
+    wrap("_step_n", "other")
+    wrap("_prefill_chunk_into", "other")
+    wrap("_prefill_into", "other")
+    return counts
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_one_device_dispatch_per_steady_mixed_round(model, paged):
+    """A steady mixed round — mid-prefill slots alongside decoding ones,
+    no max_seq-boundary stragglers — must be exactly ONE device dispatch
+    (the whole point vs the 1 + #prefilling interleave)."""
+    params, cfg = model
+    if paged:
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4)
+    else:
+        b = ContinuousBatcher(params, cfg, n_slots=3)
+    rd = b.admit([1, 2, 3], 12)                # decoding throughout
+    rp1 = b.admit_chunked([5] * 20, 3, chunk=4)
+    rp2 = b.admit_chunked([6] * 20, 3, chunk=4)
+    counts = _count_dispatches(b)
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    assert rounds > 1
+    assert counts["mixed"] == rounds, "not one dispatch per mixed round"
+    assert counts["other"] == 0, \
+        "a mixed round leaked a separate prefill/decode dispatch"
+    _drain_mixed(b)
+    for rid, (p, n) in [(rd, ([1, 2, 3], 12)), (rp1, ([5] * 20, 3)),
+                        (rp2, ([6] * 20, 3))]:
+        assert b.completed[rid] == _plain(params, cfg, p, n)
+
+
+def test_round_robin_no_slot_waits_more_than_one_round(model):
+    """Budget R=2 against 3 concurrent long prompts: the slot skipped in
+    a round must be served in the next one (round-robin cursor), so no
+    mid-prefill slot ever waits more than one round while others
+    advance."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    for i in range(3):
+        b.admit_chunked([1 + i] * 40, 1, chunk=4)
+    slots = sorted(b.prefilling)
+    waited = {s: 0 for s in slots}
+    while b.prefilling:
+        before = {s: b.prefilling[s].pos for s in b.prefilling}
+        b.tick_mixed(1, chunk=4, budget=8)      # R=2 of 3 advance
+        for s, pos0 in before.items():
+            if s not in b.prefilling:           # finished this round
+                continue
+            if b.prefilling[s].pos == pos0:
+                waited[s] += 1
+                assert waited[s] <= 1, \
+                    f"slot {s} starved {waited[s]} consecutive rounds"
+            else:
+                waited[s] = 0
+    assert len(b.completed) == 3
+
+
+def test_advance_prefill_max_slots_rotates(model):
+    """The sequential path's chunk selection shares the same fairness
+    contract: advance_prefill(max_slots=k) must rotate, not re-serve the
+    same k slots every call."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    for i in range(3):
+        b.admit_chunked([1 + i] * 40, 1, chunk=4)
+    served = set()
+    before = {s: b.prefilling[s].pos for s in b.prefilling}
+    b.advance_prefill(max_slots=2)
+    served |= {s for s in before if b.prefilling[s].pos != before[s]}
+    before = {s: b.prefilling[s].pos for s in b.prefilling}
+    b.advance_prefill(max_slots=2)
+    served |= {s for s in before if b.prefilling[s].pos != before[s]}
+    assert served == set(before), "rotation skipped a slot"
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_cancel_every_state_under_mixed_rounds(model, paged):
+    """cancel() of a chunked request in each state — mid-prefill and
+    decoding at the batcher, waiting at the service — frees its slot
+    under the mixed scheduler, and the survivors' outputs stay exact."""
+    params, cfg = model
+    mk = ((lambda n: PagedContinuousBatcher(params, cfg, n_slots=n,
+                                            page_size=4))
+          if paged else (lambda n: ContinuousBatcher(params, cfg,
+                                                     n_slots=n)))
+    # mid-prefill: cancel between mixed rounds
+    b = mk(2)
+    keep = b.admit_chunked([9, 8, 7], 6, chunk=4)
+    dead = b.admit_chunked([5] * 24, 6, chunk=4)
+    b.tick_mixed(2, chunk=4, budget=8)
+    assert any(p.request_id == dead for p in b.prefilling.values())
+    assert b.cancel(dead)
+    assert all(p.request_id != dead for p in b.prefilling.values())
+    _drain_mixed(b)
+    assert b.completed[keep] == _plain(params, cfg, [9, 8, 7], 6)
+    assert dead not in b.completed
+    assert len(b.free_slots()) == 2
+    if paged:
+        assert b.free_page_count() == b.n_pages - 1
+
+    # decoding: cancel after the prompt completed under mixed rounds
+    b2 = mk(2)
+    keep2 = b2.admit_chunked([4, 4, 2], 8, chunk=4)
+    dead2 = b2.admit_chunked([3] * 10, 30, chunk=4)
+    while any(p.request_id == dead2 for p in b2.prefilling.values()):
+        b2.tick_mixed(2, chunk=4, budget=8)
+    assert b2.cancel(dead2)
+    _drain_mixed(b2)
+    assert b2.completed[keep2] == _plain(params, cfg, [4, 4, 2], 8)
+    assert dead2 not in b2.completed
+    if paged:
+        assert b2.free_page_count() == b2.n_pages - 1
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_service_cancel_waiting_request_mixed(model, paged):
+    """A request still in the service's waiting queue cancels cleanly
+    while mixed rounds serve the pool."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=1, prefill_chunk=4,
+                                decode_chunk=2,
+                                page_size=4 if paged else None).start()
+    try:
+        s1 = service.submit([7] * 12, 20)       # occupies the only slot
+        s2 = service.submit([8] * 12, 4)        # waits
+        service.cancel(s2)
+        assert s1.get(timeout=120) == _plain(params, cfg, [7] * 12, 20)
+        snap = service.snapshot()
+        assert snap["queued"] == 0
+    finally:
+        service.stop()
+
+
+def test_mixed_metrics_series_move(model):
+    """tpushare_mixed_steps_total / _prefill_tokens_total advance, the
+    budget-utilization gauge lands in (0, 1], and the prefill-queue
+    gauge tracks mid-prefill slots."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    steps0 = metrics.MIXED_STEPS.value()
+    toks0 = metrics.MIXED_PREFILL_TOKENS.value()
+    b.admit_chunked([5] * 20, 2, chunk=4)
+    assert metrics.PREFILL_QUEUE_DEPTH.value() == 1
+    b.tick_mixed(1, chunk=4, budget=8)
+    assert metrics.MIXED_STEPS.value() == steps0 + 1
+    assert metrics.MIXED_PREFILL_TOKENS.value() == toks0 + 4
+    # one real 4-token chunk in an R=2 x C=4 block
+    assert metrics.MIXED_BUDGET_UTILIZATION.value() == pytest.approx(0.5)
+    _drain_mixed(b)
+    assert metrics.PREFILL_QUEUE_DEPTH.value() == 0
+
+
+def test_service_sequential_prefill_flag(model):
+    """mixed_step=False restores the advance-then-fuse interleave (the
+    reference policy) — asserted by spying the batcher methods."""
+    params, cfg = model
+    service = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                                decode_chunk=2, mixed_step=False)
+    b = service._batcher
+    called = {"mixed": 0, "advance": 0}
+    real_adv = b.advance_prefill
+    b.tick_mixed = lambda *a, **k: called.__setitem__(
+        "mixed", called["mixed"] + 1) or 0
+    def adv(*a, **k):
+        called["advance"] += 1
+        return real_adv(*a, **k)
+    b.advance_prefill = adv
+    service.start()
+    try:
+        sink = service.submit([3] * 12, 4)
+        assert sink.get(timeout=120) == _plain(params, cfg, [3] * 12, 4)
+    finally:
+        service.stop()
+    assert called["advance"] > 0 and called["mixed"] == 0
+
+
+def test_bench_scenario_smoke(model):
+    """The bench_all admit-while-decode scenario runs at tiny sizes and
+    reports both policies (tier-1-safe; the >=1.5x ratio claim is for
+    the committed BENCH run, not a loaded CI box)."""
+    import bench_all
+
+    params, cfg = model
+    out = bench_all.admit_while_decode_bench(
+        params, cfg, slots=2, n_reqs=3, prompt_len=8, gen=3, chunk=4,
+        decode_chunk=2, budget=8, reps=1)
+    for arm in ("mixed", "interleaved"):
+        assert out[arm]["tokens_per_s"] > 0
+        assert out[arm]["rounds"] > 0
+    assert out["mixed"]["dispatches"] < out["interleaved"]["dispatches"]

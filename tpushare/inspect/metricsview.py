@@ -97,6 +97,11 @@ def summarize_serving(parsed: dict) -> dict:
         "kv_pages_used": used,
         "kv_pages_free": free,
         "kv_util": kv_util,
+        # mixed-step scheduler: mid-prefill queue depth and how full the
+        # last round's coalesced prefill block was
+        "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
+        "mixed_budget_util": _gauge(
+            parsed, "tpushare_mixed_budget_utilization"),
     }
 
 
@@ -111,11 +116,12 @@ def render_metrics_table(
         rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
     """``rows`` = [(node, address, summary|None, error|None)]."""
     table = [["NAME", "IPADDRESS", "QPS", "TTFT p50(ms)", "TTFT p99(ms)",
-              "OCCUPANCY", "KV PAGES(used/free)"]]
+              "OCCUPANCY", "KV PAGES(used/free)", "PREFILL Q",
+              "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, err or "unreachable",
-                          "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -130,6 +136,8 @@ def render_metrics_table(
             _fmt(summary["ttft_p99_s"], 1000.0),
             _fmt(summary["occupancy"], 100.0, "%", 0),
             kv,
+            _fmt(summary.get("prefill_queue"), 1.0, "", 0),
+            _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])
     return "Serving metrics:\n" + _table(table)
 

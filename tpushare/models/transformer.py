@@ -314,6 +314,10 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                 new_pos = cache_len[:, None] + jnp.arange(s_new)[None, :]
                 k_pos = jnp.concatenate([ring_pos, new_pos], axis=1)
                 a = (r[None, :] - cache_len[:, None]) % W        # [B, W]
+                if jnp.ndim(nv) == 1:
+                    # per-row real-token counts (the batched multi-prompt
+                    # prefill: each row's chunk has its own padded tail)
+                    nv = nv[:, None]                             # [B, 1]
             o = cached_attention(
                 q, _expand_kv(jnp.concatenate([ck, k], axis=2), h // hkv),
                 _expand_kv(jnp.concatenate([cv, v], axis=2), h // hkv),
@@ -666,6 +670,72 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
     x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x[0, last_idx], params["lm_head"])
+    return logits, (new_kp, new_vp)
+
+
+def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
+                                page_rows, pos, last_idx):
+    """Coalesced MULTI-prompt prefill: one window per row, each into its
+    own slot's reserved pages, in a single forward — the paged half of
+    the mixed-step scheduler (one device dispatch per service round).
+
+    tokens [R, W] with W a page multiple; page_rows [R, max_seq//page]
+    (each row's page-table row); pos [R] page-aligned per-row offsets;
+    last_idx [R] each row's final REAL position within its window.
+    Per-row math is exactly :func:`forward_paged_prefill_chunk`'s — the
+    batch dim only adds rows, it never changes a row's reduction order —
+    so coalesced and per-slot chunked prefill stay bit-identical.
+
+    Scatter safety: live rows target DISTINCT slots (the batcher
+    guarantees it), and distinct slots own distinct pages, so real page
+    writes never collide.  A PADDED row rides an all-zero table: every
+    one of its writes lands on the TRASH page (page 0), where colliding
+    garbage is fine — the position mask keeps that page out of every
+    softmax, exactly like inactive slots in the decode tick.  The caller
+    must keep ``pos + W <= max_seq`` for live rows (the page-walk index
+    clamps at the table edge; a crossing window would rewrite the last
+    real page).  Returns (logits [R, vocab] at each row's ``last_idx``,
+    updated pools).
+    """
+    b, s = tokens.shape
+    kp, vp = pools
+    page = kp.shape[3]
+    if s % page:
+        raise ValueError("prefill window must be page-aligned")
+    n_chunks = s // page                        # static
+    positions = pos[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    pids = jnp.take_along_axis(
+        page_rows, (pos // page)[:, None] + jnp.arange(n_chunks)[None, :],
+        axis=1)                                 # [R, n_chunks]
+    flat_pids = pids.reshape(-1)
+
+    def pieces(t):
+        # [R, Hkv, W, D] -> [R*n_chunks, Hkv, page, D] page-shaped blocks
+        r, hh, _, d = t.shape
+        return (t.reshape(r, hh, n_chunks, page, d)
+                .transpose(0, 2, 1, 3, 4).reshape(r * n_chunks, hh, page, d))
+
+    def body(x, layer_and_pool):
+        layer, kpool, vpool = layer_and_pool
+
+        def attend(lyr, xin):
+            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [R, Hkv, W, D]
+            kp2 = kpool.at[flat_pids].set(pieces(k))
+            vp2 = vpool.at[flat_pids].set(pieces(v))
+            o = cached_attention(
+                q, _expand_kv(_paged_gather(kp2, page_rows), h // hkv),
+                _expand_kv(_paged_gather(vp2, page_rows), h // hkv),
+                positions, window=cfg.window)
+            return o, (kp2, vp2)
+
+        return _attn_ffn(layer, x, cfg, attend)
+
+    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    xl = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    logits = _head_mm(xl, params["lm_head"])
     return logits, (new_kp, new_vp)
 
 

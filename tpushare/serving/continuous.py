@@ -34,6 +34,11 @@ from . import metrics
 
 log = logging.getLogger("tpushare.serving")
 
+#: [B, 2] uint32 key data -> [B] typed PRNG keys, jitted once: the
+#: per-call ``jax.vmap(...)`` retrace cost ~0.6 ms on every tick —
+#: real money against a sub-3 ms CPU round (and pure waste on TPU).
+_wrap_keys = jax.jit(jax.vmap(jax.random.wrap_key_data))
+
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len"),
                    donate_argnums=(2,))
@@ -157,6 +162,26 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
     return nxt, caches
 
 
+def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
+                 incs, cfg, n: int, rich: bool):
+    """The fused decode scan BODY (trace-level, not jitted itself) —
+    the one definition shared by :func:`_tick_n` and the mixed-step
+    program :func:`_tick_mixed`, so the two dispatch flavors cannot
+    drift.  See :func:`_tick_n` for the semantics contract."""
+    def body(carry, _):
+        tok, caches, lengths, keys = carry
+        ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
+        logits, caches = transformer.forward(
+            params, tok, cfg, kv_caches=caches, cache_len=lengths)
+        nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
+                           tks if rich else None, tps if rich else None)
+        return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
+
+    (_, caches, _, keys), toks = jax.lax.scan(
+        body, (tokens, caches, lengths, keys), None, length=n)
+    return toks.T, keys, caches
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
@@ -188,18 +213,68 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     layouts: ring slot pos % W holds position pos - W, attendable only
     by queries < pos, all already computed.)
     """
-    def body(carry, _):
-        tok, caches, lengths, keys = carry
-        ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
-        logits, caches = transformer.forward(
-            params, tok, cfg, kv_caches=caches, cache_len=lengths)
-        nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
-                           tks if rich else None, tps if rich else None)
-        return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
+    return _decode_scan(params, tokens, caches, lengths, temps, keys,
+                        tks, tps, incs, cfg, n, rich)
 
-    (_, caches, _, keys), toks = jax.lax.scan(
-        body, (tokens, caches, lengths, keys), None, length=n)
-    return toks.T, keys, caches
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
+                                             "rich"),
+                   donate_argnums=(7,))
+def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
+                src_mask, caches, tokens, lengths, temps, keys, tks, tps,
+                incs, cfg, chunk_len: int, n: int, rich: bool = False):
+    """ONE device program per mixed service round: (a) the pending
+    chunks of up to R mid-prefill slots coalesced into a single batched,
+    padded prefill forward, then (b) the fused ``n``-step decode scan
+    over the whole slot pool — the token-budget mixed step that replaces
+    the interleave-two-dispatches policy (one ~70 ms tunnel RPC per
+    round instead of 1 + #prefilling).
+
+    Prefill half: ``p_tokens`` [R, C] holds one padded chunk per row,
+    ``p_slots``/``p_pos``/``p_last`` its target slot, cache offset, and
+    final real index.  The target rows are GATHERED out of the pool,
+    prefilled as one [R, C] forward (per-row math identical to the
+    per-slot :func:`_prefill_chunk` — batching adds rows, it never
+    reorders a row's reductions), and written back with a per-slot
+    SELECT: ``src_rows[b]``/``src_mask[b]`` name the prefill row feeding
+    slot b (host-computed; live rows target distinct slots).  A PADDED
+    row's output is dropped by the select, so its garbage never touches
+    the pool — budget-padding buys one compiled program shape for any
+    number of mid-prefill slots.  ``kv_write_len`` bounds ROLLING-ring
+    commits per row (padded tails are never committed; full-size pools
+    ignore it as always).
+
+    Decode half: the identical scan :func:`_tick_n` runs, over the
+    POST-prefill pool.  Rows prefilled this round stay frozen
+    (``incs``=0) at their post-chunk offset — the same garbage aim the
+    sequential advance-then-fuse interleave produces, contained by the
+    same argument (the next chunk or the first real decode write
+    overwrites position p before any query attends it).  Per-request
+    token streams are therefore bit-identical to the sequential path;
+    only the round a finished prefill JOINS the scan shifts (the host
+    activates it after the dispatch), which no request's own stream can
+    observe.
+
+    Returns (chunk-final logits [R, V], decode tokens [B, n], final
+    keys, caches).
+    """
+    rows = jax.tree_util.tree_map(
+        lambda c: jnp.take(c, p_slots, axis=1), caches)
+    p_logits, rows = transformer.forward(
+        params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
+        cache_len=p_pos, kv_write_len=p_last + 1)
+
+    def put(c, r):
+        g = jnp.take(r, src_rows, axis=1)
+        m = src_mask.reshape((1, -1) + (1,) * (c.ndim - 2))
+        return jnp.where(m, g, c)
+
+    caches = jax.tree_util.tree_map(put, caches, rows)
+    sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
+    toks, keys, caches = _decode_scan(
+        params, tokens, caches, lengths, temps, keys, tks, tps, incs,
+        cfg, n, rich)
+    return sel, toks, keys, caches
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
@@ -348,6 +423,12 @@ class ContinuousBatcher:
         self.n_slots = n_slots
         self.slots: Dict[int, _Slot] = {}      # slot index -> live request
         self.prefilling: Dict[int, _Prefill] = {}   # slot -> mid-prefill
+        # round-robin cursor over mid-prefill SLOT ids: when a round's
+        # token budget selects fewer chunks than there are prefilling
+        # slots, selection resumes after the last slot served, so a
+        # long prompt cannot starve later admits (Sarathi-style
+        # fairness; see _select_prefill_slots)
+        self._prefill_cursor = 0
         self._next_id = 0
         self.completed: Dict[int, List[int]] = {}
         # tick_spec accounting: tokens committed per speculative round —
@@ -368,6 +449,11 @@ class ContinuousBatcher:
         instant-finish admission path funnel through it)."""
         self.completed[rid] = output
         metrics.COMPLETIONS.inc()
+
+    def _observe_prefill(self) -> None:
+        """Mirror the mid-prefill queue depth into /metrics (every site
+        that grows or shrinks ``self.prefilling`` calls this)."""
+        metrics.PREFILL_QUEUE_DEPTH.set(len(self.prefilling))
 
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
@@ -578,30 +664,64 @@ class ContinuousBatcher:
             pos=self._prefill_start(slot),
             max_new=max_new_tokens, temperature=temperature, seed=seed,
             chunk=chunk, eos_id=eos_id, top_k=top_k, top_p=top_p)
+        self._observe_prefill()
         return rid
 
-    def advance_prefill(self) -> int:
-        """Process ONE chunk for every mid-prefill slot; returns the
-        number of slots still prefilling afterwards."""
-        for slot, st in list(self.prefilling.items()):
-            n = len(st.prompt)
-            # Clamp the padded window at max_seq: the in-jit scatter
-            # clamps out-of-range starts, so an over-long window would
-            # silently wrap back over real cached positions.  Window
-            # sizes stay static-shaped: {chunk, max_seq mod chunk}.
-            window = min(st.chunk, self.cfg.max_seq - st.pos)
-            end = min(st.pos + window, n)
-            piece = st.prompt[st.pos:end]
-            padded = np.zeros((1, window), np.int32)
-            padded[0, :len(piece)] = piece
-            logits_v = self._prefill_chunk_into(
-                slot, padded, st.pos, len(piece) - 1, window)
-            st.pos = end
-            if end >= n:
-                del self.prefilling[slot]
-                self._activate(slot, st.request_id, st.prompt, logits_v,
-                               st.max_new, st.temperature, st.seed,
-                               st.eos_id, st.top_k, st.top_p)
+    def _select_prefill_slots(self, limit: int,
+                              eligible=None) -> List[int]:
+        """Up to ``limit`` mid-prefill slot ids in ROUND-ROBIN order:
+        circular slot order starting at the cursor, which then moves
+        past the last slot served.  When every pending slot fits the
+        limit this is just a rotation (all advance); when it doesn't,
+        the slots skipped this round are FIRST in line next round — no
+        slot waits more than ceil(pending/limit) - 1 rounds, and with
+        limit >= pending/2 no slot ever waits more than one round."""
+        pending = sorted(self.prefilling if eligible is None else eligible)
+        if not pending or limit <= 0:
+            return []
+        start = 0
+        for idx, s in enumerate(pending):
+            if s >= self._prefill_cursor:
+                start = idx
+                break
+        rotated = pending[start:] + pending[:start]
+        picked = rotated[:limit]
+        self._prefill_cursor = (picked[-1] + 1) % max(1, self.n_slots)
+        return picked
+
+    def _advance_one_prefill(self, slot: int) -> None:
+        """One prompt chunk for ONE mid-prefill slot (its own dispatch)
+        — the sequential chunk body, also the fallback for windows the
+        fixed-width mixed step cannot take (see tick_mixed)."""
+        st = self.prefilling[slot]
+        n = len(st.prompt)
+        # Clamp the padded window at max_seq: the in-jit scatter
+        # clamps out-of-range starts, so an over-long window would
+        # silently wrap back over real cached positions.  Window
+        # sizes stay static-shaped: {chunk, max_seq mod chunk}.
+        window = min(st.chunk, self.cfg.max_seq - st.pos)
+        end = min(st.pos + window, n)
+        piece = st.prompt[st.pos:end]
+        padded = np.zeros((1, window), np.int32)
+        padded[0, :len(piece)] = piece
+        logits_v = self._prefill_chunk_into(
+            slot, padded, st.pos, len(piece) - 1, window)
+        st.pos = end
+        if end >= n:
+            del self.prefilling[slot]
+            self._activate(slot, st.request_id, st.prompt, logits_v,
+                           st.max_new, st.temperature, st.seed,
+                           st.eos_id, st.top_k, st.top_p)
+
+    def advance_prefill(self, max_slots: Optional[int] = None) -> int:
+        """Process one chunk for mid-prefill slots — every slot by
+        default, or at most ``max_slots`` selected round-robin (the
+        fairness contract of :meth:`_select_prefill_slots`).  Returns
+        the number of slots still prefilling afterwards."""
+        limit = len(self.prefilling) if max_slots is None else max_slots
+        for slot in self._select_prefill_slots(limit):
+            self._advance_one_prefill(slot)
+        self._observe_prefill()
         return len(self.prefilling)
 
     def _gather_slot_arrays(self):
@@ -653,7 +773,7 @@ class ContinuousBatcher:
             nxt = np.asarray(self._step(
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
-                jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+                _wrap_keys(jnp.asarray(keys)),
                 jnp.asarray(tks), jnp.asarray(tps), self._rich()))
         n_active = len(self.slots)
         for i in list(self.slots):
@@ -700,12 +820,21 @@ class ContinuousBatcher:
             toks, new_keys = self._step_n(
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
-                jax.vmap(jax.random.wrap_key_data)(jnp.asarray(keys)),
+                _wrap_keys(jnp.asarray(keys)),
                 jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
                 self._rich(), n_steps)
         toks = np.asarray(toks)
         new_keys = np.asarray(jax.random.key_data(new_keys))
         n_active = len(self.slots)
+        self._drain_fused_tokens(toks, new_keys, n_steps)
+        self._observe_tick(t0)
+        return n_active
+
+    def _drain_fused_tokens(self, toks, new_keys, n_steps: int) -> None:
+        """Consume one fused scan's [B, n] token block: extend every
+        decoding slot by its first ``remaining`` tokens, finish at eos,
+        and carry the device-advanced keys — the ONE drain shared by
+        :meth:`tick_fused` and :meth:`tick_mixed`."""
         for i in list(self.slots):
             s = self.slots[i]
             take = min(n_steps, s.remaining)
@@ -731,6 +860,161 @@ class ContinuousBatcher:
                 # times for a continuing slot — same chain the host loop
                 # would have walked
                 s.key = jax.random.wrap_key_data(jnp.asarray(new_keys[i]))
+
+    # -- mixed prefill+decode step -------------------------------------
+    def _mixed_chunk_len(self, chunk: int) -> int:
+        """The mixed round's fixed prefill-window width for this storage
+        (paged storage rounds to a page multiple and clamps into the
+        windowed ring's margin)."""
+        return max(1, chunk)
+
+    def _step_mixed(self, p_tokens, p_slots, p_active, p_pos, p_last,
+                    tokens, lengths, temps, keys, tks, tps, incs, rich,
+                    chunk_len: int, n_steps: int):
+        """THE one device dispatch of a mixed round (storage hook).
+        Returns (chunk-final logits [R, V], decode tokens [B, n], final
+        keys)."""
+        src_rows = np.zeros((self.n_slots,), np.int32)
+        src_mask = np.zeros((self.n_slots,), bool)
+        for r in range(len(p_slots)):
+            if p_active[r]:
+                src_rows[p_slots[r]] = r
+                src_mask[p_slots[r]] = True
+        sel, toks, keys, self.caches = _tick_mixed(
+            self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
+            jnp.asarray(p_pos), jnp.asarray(p_last),
+            jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
+            tokens, lengths, temps, keys, tks, tps, incs,
+            self.cfg, chunk_len, n_steps, rich)
+        return sel, toks, keys
+
+    def tick_mixed(self, n_steps: int, chunk: int = 64,
+                   budget: int = 128) -> int:
+        """One TOKEN-BUDGET mixed prefill+decode round in a single
+        device dispatch: coalesce the pending chunks of up to
+        ``budget // chunk`` mid-prefill slots (round-robin, so a long
+        prompt cannot starve later admits) into one batched prefill
+        forward AND run the ``n_steps`` fused decode scan over all
+        decoding slots — the same work the sequential
+        ``advance_prefill(); tick_fused(n)`` interleave does in
+        ``1 + #prefilling`` dispatches.  Returns #decoding slots before
+        the round.
+
+        Per-request token streams are bit-identical to the sequential
+        path (see :func:`_tick_mixed`); a slot whose prompt completes
+        this round is activated host-side after the dispatch and joins
+        the NEXT round's scan.  ``budget`` is padded capacity: the
+        prefill block is a fixed [R, chunk] shape (R = budget//chunk,
+        clamped to the slot count, min 1) so exactly one program shape
+        ever compiles — unused rows burn chunk-width FLOPs and are
+        discarded.  A slot whose next window would cross ``max_seq``
+        (possible only when earlier sequential chunking left ``pos``
+        within ``chunk`` of the boundary) cannot ride the fixed-width
+        block — it falls back to one narrow sequential chunk after the
+        mixed dispatch, preserving the max_seq clamp invariant.
+        """
+        if not self.prefilling and not self.slots:
+            return 0
+        t0 = time.perf_counter()
+        C = self._mixed_chunk_len(chunk)
+        R = max(1, min(budget // C if budget >= C else 1, self.n_slots))
+        S = self.cfg.max_seq
+        eligible = [i for i, st in self.prefilling.items()
+                    if st.pos + C <= S]
+        overflow = [i for i, st in self.prefilling.items()
+                    if st.pos + C > S]
+        picked = self._select_prefill_slots(R, eligible)
+        if not picked:
+            # Nothing for the fixed-width block to do (no mid-prefill
+            # slots, or every pending window crosses the max_seq
+            # boundary): skip the wholly-padded mixed dispatch — advance
+            # the stragglers sequentially and decode with the plain
+            # fused chunk, exactly the sequential reference composition.
+            for i in list(overflow):
+                if i in self.prefilling:
+                    self._advance_one_prefill(i)
+            self._observe_prefill()
+            if self.slots:
+                return self.tick_fused(n_steps)
+            self._observe_tick(t0)
+            return 0
+        p_tokens = np.zeros((R, C), np.int32)
+        p_slots = np.zeros((R,), np.int32)
+        p_active = np.zeros((R,), bool)
+        p_pos = np.zeros((R,), np.int32)
+        p_last = np.zeros((R,), np.int32)
+        plan = []                      # (row, slot, state, chunk end)
+        n_real = 0
+        for r, i in enumerate(picked):
+            st = self.prefilling[i]
+            end = min(st.pos + C, len(st.prompt))
+            piece = st.prompt[st.pos:end]
+            p_tokens[r, :len(piece)] = piece
+            p_slots[r] = i
+            p_active[r] = True
+            p_pos[r] = st.pos
+            p_last[r] = len(piece) - 1
+            plan.append((r, i, st, end))
+            n_real += len(piece)
+        metrics.MIXED_STEPS.inc()
+        metrics.MIXED_PREFILL_TOKENS.inc(n_real)
+        metrics.MIXED_BUDGET_UTILIZATION.set(n_real / float(R * C))
+        if self.slots:
+            # decoder-empty rounds run the scan for shape only — their
+            # steps produce nothing, so they don't count (tick_fused
+            # returns before counting when no slot decodes)
+            metrics.FUSED_STEPS.inc(n_steps)
+        # Advance host-side offsets BEFORE gathering the decode operands:
+        # the scan's frozen garbage write for a row prefilled this round
+        # must aim at the POST-chunk offset (the next window, overwritten
+        # before attendable) — the same aim the sequential advance-then-
+        # fuse interleave produces.
+        for _, _, st, end in plan:
+            st.pos = end
+        # keys carry each slot's CURRENT (unsplit) data — the scan splits
+        # in-device, the same chain tick_fused walks
+        tokens, lengths, temps, keys, tks, tps = self._gather_slot_arrays()
+        incs = np.zeros((self.n_slots,), np.int32)
+        for i in self.slots:
+            incs[i] = 1
+        with telemetry.span("batcher.tick_mixed", cat="serving",
+                            active=len(self.slots), prefilling=len(plan),
+                            steps=n_steps):
+            sel, toks, new_keys = self._step_mixed(
+                p_tokens, p_slots, p_active, p_pos, p_last,
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(temps),
+                _wrap_keys(jnp.asarray(keys)),
+                jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
+                self._rich(), C, n_steps)
+        # Host fetches are the real sync points (CLAUDE.md): fetch ONLY
+        # what this round consumes, so pure-prefill rounds with no
+        # completions stay fully async and pipeline like sequential
+        # chunk dispatches do.
+        n_active = len(self.slots)
+        if n_active:
+            toks = np.asarray(toks)
+            new_keys = np.asarray(jax.random.key_data(new_keys))
+            self._drain_fused_tokens(toks, new_keys, n_steps)
+        # Activate rows whose chunk completed the prompt — they join the
+        # NEXT round's scan (the host-side half of advance_prefill's
+        # completion, fed by the dispatch's chunk-final logits).
+        done = [(r, i, st) for r, i, st, end in plan
+                if end >= len(st.prompt)]
+        if done:
+            sel = np.asarray(sel)
+            for r, i, st in done:
+                del self.prefilling[i]
+                self._activate(i, st.request_id, st.prompt, sel[r],
+                               st.max_new, st.temperature, st.seed,
+                               st.eos_id, st.top_k, st.top_p)
+        # Boundary stragglers: windows that would cross max_seq take the
+        # narrow sequential chunk (rare — only prompts within one chunk
+        # of the context limit after uneven earlier chunking).
+        for i in overflow:
+            if i in self.prefilling:
+                self._advance_one_prefill(i)
+        self._observe_prefill()
         self._observe_tick(t0)
         return n_active
 
@@ -752,6 +1036,7 @@ class ContinuousBatcher:
             if p.request_id == rid:
                 self._release(i)
                 del self.prefilling[i]
+                self._observe_prefill()
                 metrics.CANCELLATIONS.inc()
                 return True
         # completed-but-undelivered: the request already counted as a
@@ -883,11 +1168,25 @@ class ContinuousService:
                  spec_k: int = 0,
                  spec_ngram: int = 2,
                  spec_rounds: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 mixed_step: bool = True,
+                 prefill_budget: Optional[int] = None):
         import queue as _q
         import threading
 
         self._q = _q
+        # MIXED rounds (default): while anything is mid-prefill, each
+        # loop iteration is ONE device dispatch — the pending chunks of
+        # up to prefill_budget//prefill_chunk slots coalesced into a
+        # batched prefill, fused with the decode scan (tick_mixed) —
+        # instead of the sequential 1 + #prefilling dispatches.
+        # prefill_budget is the per-round prefill TOKEN budget
+        # (Sarathi-style); default two chunks' worth.  It is padded
+        # capacity: one program shape compiles regardless of how many
+        # slots are actually prefilling.  mixed_step=False restores the
+        # sequential advance-then-fuse interleave (the bit-identical
+        # reference path).
+        self._mixed_step = bool(mixed_step)
         # Steady-state decoding runs decode_chunk ticks per host round
         # trip (tick_fused) — the host-RPC amortization that closes most
         # of the per-dispatch vs fused-scan throughput gap.  1 disables
@@ -927,6 +1226,9 @@ class ContinuousService:
         # chunk's forward (paged storage rounds the chunk up to a page
         # multiple, see paged.py).
         self._prefill_chunk = max(1, prefill_chunk)
+        self._prefill_budget = (int(prefill_budget)
+                                if prefill_budget is not None
+                                else 2 * self._prefill_chunk)
         if page_size is not None:
             # paged KV storage: more in-flight sequences per HBM byte
             from .paged import PagedContinuousBatcher
@@ -1177,15 +1479,24 @@ class ContinuousService:
                 else:
                     self._sinks[rid] = sink
             if self._batcher.prefilling:
-                # One prompt chunk, then a fused decode chunk: prompts
-                # keep streaming while decoding slots keep their host-RPC
-                # amortization (see __init__ on _prefill_decode_chunk).
-                self._batcher.advance_prefill()
-                if self._prefill_decode_chunk > 1:
-                    active = self._batcher.tick_fused(
-                        self._prefill_decode_chunk)
+                if self._mixed_step:
+                    # ONE dispatch per round: all pending prompt chunks
+                    # under the token budget, coalesced and fused with
+                    # the decode scan (see tick_mixed).
+                    active = self._batcher.tick_mixed(
+                        self._prefill_decode_chunk,
+                        chunk=self._prefill_chunk,
+                        budget=self._prefill_budget)
                 else:
-                    active = self._batcher.tick()
+                    # Sequential reference policy: one prompt chunk per
+                    # prefilling slot, then a fused decode chunk (see
+                    # __init__ on _prefill_decode_chunk).
+                    self._batcher.advance_prefill()
+                    if self._prefill_decode_chunk > 1:
+                        active = self._batcher.tick_fused(
+                            self._prefill_decode_chunk)
+                    else:
+                        active = self._batcher.tick()
             elif (self._spec_k
                   and all(s.temperature == 0.0
                           for s in self._batcher.slots.values())):

@@ -67,7 +67,9 @@ class LLMServer:
                  n_pages: int = 0,
                  tp: int = 0,
                  spec_k: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 prefill_budget: int = 0,
+                 mixed_step: bool = True):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
@@ -76,7 +78,11 @@ class LLMServer:
         devices and serves SPMD (requires --slots; params and KV storage
         shard per ``tpushare.parallel.mesh``).  ``spec_k > 0`` turns on
         opportunistic prompt-lookup speculation for all-greedy batches
-        (greedy-exact; see ContinuousService)."""
+        (greedy-exact; see ContinuousService).  ``prefill_budget`` caps
+        the prompt tokens one MIXED service round coalesces into its
+        single-dispatch prefill block (0 = two prefill chunks);
+        ``mixed_step=False`` restores the sequential advance-then-fuse
+        interleave."""
         from .. import telemetry
         from ..utils.httpserver import JsonHTTPServer, RawBody
 
@@ -105,7 +111,9 @@ class LLMServer:
                 n_pages=n_pages or None,
                 mesh=mesh,
                 spec_k=spec_k,
-                prefix_cache=prefix_cache).start()
+                prefix_cache=prefix_cache,
+                mixed_step=mixed_step,
+                prefill_budget=prefill_budget or None).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -498,7 +506,21 @@ def main(argv=None) -> int:
                     help="reuse completed requests' prompt-prefix KV "
                          "pages for same-prefix admissions (requires "
                          "--page-size; full-causal models)")
+    ap.add_argument("--prefill-budget", type=int, default=0,
+                    help="prompt tokens one mixed service round may "
+                         "coalesce into its single-dispatch prefill "
+                         "block (0 = two prefill chunks; requires "
+                         "--slots)")
+    ap.add_argument("--sequential-prefill", action="store_true",
+                    help="disable the mixed prefill+decode step: one "
+                         "dispatch per prefilling slot plus one fused "
+                         "decode dispatch per round (the reference "
+                         "interleave)")
     args = ap.parse_args(argv)
+    if args.prefill_budget and not args.slots:
+        ap.error("--prefill-budget requires --slots")
+    if args.sequential_prefill and not args.slots:
+        ap.error("--sequential-prefill requires --slots")
     if args.prefix_cache and not args.page_size:
         ap.error("--prefix-cache requires --page-size")
     if args.spec_k and not args.slots:
@@ -529,7 +551,9 @@ def main(argv=None) -> int:
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp,
-                    spec_k=args.spec_k, prefix_cache=args.prefix_cache)
+                    spec_k=args.spec_k, prefix_cache=args.prefix_cache,
+                    prefill_budget=args.prefill_budget,
+                    mixed_step=not args.sequential_prefill)
     log.info("llm server: model=%s quant=%s tp=%d on :%d", args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
              args.tp, srv.port)

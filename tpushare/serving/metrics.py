@@ -64,6 +64,21 @@ FUSED_STEPS = telemetry.counter(
     "tpushare_fused_steps_total",
     "Decode steps executed inside fused (scan) tick chunks")
 
+# -- mixed prefill+decode step --------------------------------------------
+MIXED_STEPS = telemetry.counter(
+    "tpushare_mixed_steps_total",
+    "Mixed prefill+decode rounds dispatched (one device program each)")
+MIXED_PREFILL_TOKENS = telemetry.counter(
+    "tpushare_mixed_prefill_tokens_total",
+    "Real prompt tokens coalesced into mixed-round prefill blocks")
+MIXED_BUDGET_UTILIZATION = telemetry.gauge(
+    "tpushare_mixed_budget_utilization",
+    "Real prompt tokens / padded prefill-block capacity in the last "
+    "mixed round (low = budget over-provisioned for current traffic)")
+PREFILL_QUEUE_DEPTH = telemetry.gauge(
+    "tpushare_prefill_queue_depth",
+    "Slots currently mid-prefill (admitted, prompt not fully in cache)")
+
 # -- speculation ----------------------------------------------------------
 SPEC_PROPOSED = telemetry.counter(
     "tpushare_spec_proposed_total",

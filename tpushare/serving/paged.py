@@ -96,6 +96,15 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     decode chunk outruns the ring's prefill margin.  Freezing removes
     the coupling between decode_chunk and the ring size entirely.
     """
+    return _decode_scan(params, tokens, pools, page_table, lengths,
+                        temps, keys, tks, tps, incs, cfg, n, rich)
+
+
+def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
+                 tks, tps, incs, cfg, n: int, rich: bool):
+    """The paged fused decode scan BODY (trace-level) shared by
+    :func:`_tick_n` and the mixed-step program :func:`_tick_mixed` —
+    one definition, so the two dispatch flavors cannot drift."""
     def body(carry, _):
         tok, pools, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)
@@ -108,6 +117,28 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     (_, pools, _, keys), toks = jax.lax.scan(
         body, (tokens, pools, lengths, keys), None, length=n)
     return toks.T, keys, pools
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
+                                             "rich"),
+                   donate_argnums=(5,))
+def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
+                page_table, tokens, lengths, temps, keys, tks, tps, incs,
+                cfg, chunk_len: int, n: int, rich: bool = False):
+    """Paged twin of continuous._tick_mixed: the coalesced multi-prompt
+    prefill (:func:`transformer.forward_paged_prefill_batch` — live rows
+    write their own distinct pages, padded rows ride all-zero tables so
+    every write lands on the masked TRASH page) followed by the fused
+    ``n``-step paged decode scan, in ONE dispatch.  The page table is
+    FIXED across the whole round, as _tick_n requires — the prefill
+    writes through each row's own table row, never reshaping it."""
+    sel, pools = transformer.forward_paged_prefill_batch(
+        params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
+        p_last)
+    toks, keys, pools = _decode_scan(
+        params, tokens, pools, page_table, lengths, temps, keys, tks,
+        tps, incs, cfg, n, rich)
+    return sel, toks, keys, pools
 
 
 @dataclasses.dataclass
@@ -427,6 +458,32 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.asarray(self.page_table[slot]), pos, last_idx, self.cfg,
             chunk_len)
         return logits
+
+    def _mixed_chunk_len(self, chunk: int) -> int:
+        """Mixed-round window width on paged storage: rounded UP to a
+        page multiple (writes are whole pages) and clamped into the
+        windowed page ring's prefill margin (see _held_pages) — the same
+        rounding admit_chunked applies to sequential chunks."""
+        c = -(-max(1, chunk) // self.page_size) * self.page_size
+        if transformer.wants_rolling(self.cfg):
+            c = min(c, self.max_prefill_chunk)
+        return max(self.page_size, c)
+
+    def _step_mixed(self, p_tokens, p_slots, p_active, p_pos, p_last,
+                    tokens, lengths, temps, keys, tks, tps, incs, rich,
+                    chunk_len: int, n_steps: int):
+        p_tables = np.zeros((len(p_slots), self.pages_per_slot), np.int32)
+        for r in range(len(p_slots)):
+            if p_active[r]:
+                # the slot's own table row; padded rows keep all-zero
+                # tables, routing every write to the masked trash page
+                p_tables[r] = self.page_table[p_slots[r]]
+        sel, toks, keys, self.pools = _tick_mixed(
+            self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
+            jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
+            jnp.asarray(self.page_table), tokens, lengths, temps, keys,
+            tks, tps, incs, self.cfg, chunk_len, n_steps, rich)
+        return sel, toks, keys
 
     # ------------------------------------------------------------------
     def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,
