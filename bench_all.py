@@ -90,6 +90,56 @@ def admit_while_decode_bench(params, cfg, *, slots, n_reqs, prompt_len,
     return out
 
 
+def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
+                   gen, decode_chunk, throughput_slots, reps=2):
+    """int8 vs bf16 KV cache on the PAGED pool: (a) sequences admitted
+    under one fixed ``pool_bytes`` budget — the capacity win the mode
+    exists for (>= 1.9x by the byte model in ops.quant.kv_cache_bytes)
+    — and (b) fused decode tokens/s at IDENTICAL occupancy, which
+    prices the quantize/dequantize work riding the jitted step.  On CPU
+    the (b) arm is overhead-only (no HBM bandwidth to save); on TPU the
+    halved cache reads push it the other way for memory-bound decode.
+
+    Importable so a test can smoke-run it at tiny sizes (tier-1-safe).
+    Returns {"pool_bytes", per-dtype {admitted, tokens_per_s}}.
+    """
+    import dataclasses
+    import time as _t
+
+    from tpushare.ops.quant import kv_cache_bytes
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    budget = kv_cache_bytes(cfg, cfg.max_seq) * n_budget_slots
+    out = {"pool_bytes": int(budget)}
+    for kv_dtype in ("bf16", "int8"):
+        c = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+        # (a) capacity: admit until the page pool pushes back
+        b = PagedContinuousBatcher(params, c, n_slots=4 * n_budget_slots
+                                   * cfg.max_seq // (prompt_len + gen),
+                                   page_size=page_size, pool_bytes=budget)
+        admitted = 0
+        while b.admit([1 + admitted % 50] * prompt_len, gen) is not None:
+            admitted += 1
+        # (b) throughput at fixed occupancy (dense-equivalent pages)
+        tokens_per_s = None
+        for _ in range(reps):            # first rep absorbs compiles
+            bt = PagedContinuousBatcher(params, c,
+                                        n_slots=throughput_slots,
+                                        page_size=page_size)
+            for i in range(throughput_slots):
+                bt.admit([1 + i] * prompt_len, gen)
+            bt.tick_fused(decode_chunk)            # warm
+            t0 = _t.perf_counter()
+            while bt.slots:
+                bt.tick_fused(decode_chunk)
+            dt = _t.perf_counter() - t0
+            timed = throughput_slots * (gen - 1 - decode_chunk)
+            tokens_per_s = timed / dt
+        out[kv_dtype] = {"admitted": admitted,
+                         "tokens_per_s": tokens_per_s}
+    return out
+
+
 def main() -> int:
     import os
     import sys
@@ -259,6 +309,39 @@ def main() -> int:
     _emit("llm_decode_tokens_per_s_paged", timed_tokens / dt_paged,
           "tokens/s", platform=platform, slots=slots, page_size=16,
           vs_dense=round(dt / dt_paged, 3))
+
+    # 2b-quant. int8 KV cache on the paged pool: sequences admitted
+    # under one fixed pool_bytes budget (the ~2x capacity win) and
+    # fused decode tokens/s at identical occupancy (the quantize/
+    # dequantize price on CPU; on TPU halved cache reads repay it for
+    # memory-bound decode).  Own config: the reference storage must be
+    # REAL bf16 at head_dim 128 (tiny() stores f32, which would flatter
+    # the ratio; thin heads would understate it — the per-token scale
+    # amortizes over head_dim).
+    kcfg = (transformer.ModelConfig(vocab=32000, d_model=512, n_layers=4,
+                                    n_heads=4, n_kv_heads=4, d_ff=1408,
+                                    max_seq=512)
+            if on_tpu else
+            transformer.ModelConfig(vocab=256, d_model=256, n_layers=2,
+                                    n_heads=2, n_kv_heads=2, d_ff=128,
+                                    max_seq=96, dtype=jnp.bfloat16))
+    kparams = transformer.init_params(jax.random.PRNGKey(6), kcfg)
+    kvq = kv_quant_bench(
+        kparams, kcfg, page_size=16, n_budget_slots=4,
+        prompt_len=(3 * 16) if on_tpu else 3,
+        gen=gen, decode_chunk=16 if on_tpu else 4,
+        throughput_slots=slots)
+    _emit("kv_quant_decode_tokens_per_s_int8",
+          kvq["int8"]["tokens_per_s"], "tokens/s", platform=platform,
+          slots=slots, page_size=16, kv_pool_bytes=kvq["pool_bytes"],
+          vs_bf16=round(kvq["int8"]["tokens_per_s"]
+                        / kvq["bf16"]["tokens_per_s"], 3),
+          admitted_bf16=kvq["bf16"]["admitted"],
+          admitted_int8=kvq["int8"]["admitted"],
+          admitted_ratio=round(kvq["int8"]["admitted"]
+                               / max(1, kvq["bf16"]["admitted"]), 3),
+          note="capacity at fixed pool_bytes + fused paged decode at "
+               "identical occupancy")
 
     # 2c. fused greedy decode, bf16 vs int8 vs int4: batch-1 decode is
     # WEIGHT-bound (every token re-reads all weights), so weight-only

@@ -1,0 +1,140 @@
+"""On-chip int8 KV cache: compile-check + decode throughput vs bf16.
+
+The quantized cache's CPU-side contract is pinned in
+tests/test_kv_quant.py; what only the real chip can answer is
+
+* does the int8 store COMPILE AND LOWER on Mosaic/XLA-TPU at a serving
+  shape (the int8 scatter/gather and the trailing-singleton f32 scale
+  layout must both legalize — the Pallas interpreter would not catch a
+  refusal, CLAUDE.md block-layout hazard);
+* does decode get FASTER — decode is memory-bandwidth-bound, so halving
+  the bytes read per step should show up in tokens/s, net of the
+  quantize/dequantize VPU work.
+
+Method (CLAUDE.md tunnel rules): prefill once, then time a
+device-resident ``lax.scan`` decode (ONE dispatch, host-fetch barrier)
+identically for bf16 and int8 stores, plus one paged-pool decode tick
+per flavor as the paged compile-check.  Greedy agreement between the
+two streams is reported (int8 is accuracy-bounded, not bit-identical).
+
+    python drives/drive_kv_quant.py        # real chip; ~4 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.models import transformer
+    from tpushare.ops.quant import kv_cache_bytes
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=4096)
+        batch, prompt_len, n_dec, page = 8, 1024, 128, 64
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96, dtype=jnp.bfloat16)
+        batch, prompt_len, n_dec, page = 2, 24, 16, 16
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab)
+
+    out = {"metric": "kv_quant_decode", "platform": dev.platform,
+           "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
+           "flavors": {}}
+    streams = {}
+    for kv_dtype in ("bf16", "int8"):
+        c = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+
+        @functools.partial(jax.jit, static_argnames=("n",),
+                           donate_argnums=(1,))
+        def decode_n(tok0, caches, pos0, n: int, c=c):
+            def body(carry, _):
+                tok, caches, pos = carry
+                logits, caches = transformer.forward(
+                    params, tok[:, None], c, kv_caches=caches,
+                    cache_len=pos)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+                return (nxt, caches, pos + 1), nxt
+
+            (_, caches, _), toks = jax.lax.scan(
+                body, (tok0, caches, jnp.asarray(pos0, jnp.int32)), None,
+                length=n)
+            return toks.T, caches
+
+        # jitted ONCE per flavor: a fresh jit(lambda) per call would key
+        # on function identity and re-issue the 20-140 s tunnel compile
+        # for the warm AND timed prefill
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill_jit(p, caches, c=c):
+            return transformer.forward(params, p, c, kv_caches=caches,
+                                       cache_len=0)
+
+        def prefill():
+            caches = transformer.init_kv_caches(c, batch=batch)
+            logits, caches = prefill_jit(prompt, caches)
+            return (jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
+                    caches)
+
+        t0 = time.perf_counter()
+        tok0, caches = prefill()
+        toks, caches = decode_n(tok0, caches, prompt_len, n_dec)
+        first = [int(t) for t in toks[0]]        # compile + barrier
+        compile_s = time.perf_counter() - t0
+        tok0, caches = prefill()                 # fresh timed pass
+        t0 = time.perf_counter()
+        toks, caches = decode_n(tok0, caches, prompt_len, n_dec)
+        int(toks[0, -1])                         # host fetch = barrier
+        dt = time.perf_counter() - t0
+
+        # paged-pool compile-check: one decode tick through the int8
+        # page scatter/gather (the second lowering surface)
+        pools = transformer.init_paged_kv(c, n_pages=batch + 1,
+                                          page_size=page)
+        table = np.zeros((batch, cfg.max_seq // page), np.int32)
+        table[:, 0] = np.arange(1, batch + 1)
+        lg, pools = transformer.forward_paged_decode(
+            params, jnp.asarray([[3]] * batch, jnp.int32), c, pools,
+            jnp.asarray(table), jnp.zeros((batch,), jnp.int32))
+        paged_ok = bool(np.isfinite(np.asarray(lg, np.float32)).all())
+
+        streams[kv_dtype] = first
+        out["flavors"][kv_dtype] = {
+            "kv_cache_bytes": kv_cache_bytes(c, cfg.max_seq) * batch,
+            "compile_s": round(compile_s, 1),
+            "tokens_per_s": round(batch * n_dec / dt, 1),
+            "paged_tick_ok": paged_ok,
+        }
+    b, q = out["flavors"]["bf16"], out["flavors"]["int8"]
+    out["speedup_int8_vs_bf16"] = round(
+        q["tokens_per_s"] / b["tokens_per_s"], 3)
+    out["hbm_ratio_bf16_vs_int8"] = round(
+        b["kv_cache_bytes"] / q["kv_cache_bytes"], 3)
+    agree = sum(a == b_ for a, b_ in zip(streams["bf16"], streams["int8"]))
+    out["stream_agreement"] = f"{agree}/{n_dec}"
+    out["compile_ok"] = bool(b["paged_tick_ok"] and q["paged_tick_ok"])
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

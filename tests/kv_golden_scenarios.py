@@ -1,0 +1,148 @@
+"""Shared scenario definitions for the KV-cache golden / agreement suites.
+
+One place defines the serving workloads that exercise EVERY storage
+flavor (dense ticked/fused/mixed, rolling window pool, paged, windowed
+page ring, prefix cache, plus the single-request fused path), so the
+bf16 bit-identity regression (tests/test_kv_quant.py) and the int8
+agreement suite replay the *same* traffic.  The goldens committed in
+``tests/golden_kv_bf16.json`` were produced by running
+:func:`compute_streams` with ``kv_dtype=None`` on the pre-int8 tree;
+bf16 mode must keep reproducing them byte for byte.
+
+Regenerate (only when an INTENTIONAL bf16-stream change lands):
+
+    env -u PALLAS_AXON_POOL_IPS python -c \
+      "import json, sys; sys.path.insert(0, 'tests'); \
+       from kv_golden_scenarios import compute_streams; \
+       json.dump(compute_streams(), open('tests/golden_kv_bf16.json','w'), \
+                 indent=1)"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def _cfg(window=None, kv_dtype=None):
+    from tpushare.models import transformer
+    cfg = transformer.tiny(max_seq=96, window=window)
+    if kv_dtype is not None:
+        cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+    return cfg
+
+
+#: (prompt, max_new) per request; chosen to cover multi-chunk prompts,
+#: padded final chunks, and instant-ish finishes
+FULL_REQS = [(list(range(1, 11)), 6), ([3, 5, 7], 8), ([9] * 14, 5)]
+#: windowed traffic: prompts longer than the 16-token window and decode
+#: past one ring revolution
+WIN_REQS = [(list(range(1, 40)), 20), ([5, 6, 7], 30), ([8] * 20, 12)]
+#: prefix-cache traffic: a shared 8-token (two-page) prompt head
+PREFIX_HEAD = [11, 12, 13, 14, 15, 16, 17, 18]
+PREFIX_REQS = [(PREFIX_HEAD + [21, 22], 5), (PREFIX_HEAD + [31], 6),
+               (PREFIX_HEAD + [41, 42, 43], 4)]
+
+
+def _drain_mixed(b, n_steps=3, chunk=4, budget=8, max_rounds=600):
+    for _ in range(max_rounds):
+        if not b.prefilling and not b.slots:
+            return
+        b.tick_mixed(n_steps, chunk=chunk, budget=budget)
+    raise RuntimeError("mixed drain did not finish")
+
+
+def _drain_fused(b, n_steps=4, max_rounds=600):
+    for _ in range(max_rounds):
+        if b.prefilling:
+            b.advance_prefill()
+        if not b.tick_fused(n_steps) and not b.prefilling:
+            return
+    raise RuntimeError("fused drain did not finish")
+
+
+def _streams(b, rids):
+    return [[int(t) for t in b.completed[r]] for r in rids]
+
+
+def compute_streams(kv_dtype=None):
+    """flavor -> list of completed token streams, over every storage
+    flavor.  ``kv_dtype=None`` leaves the config untouched (the bf16
+    golden arm works on trees predating the ``kv_dtype`` field)."""
+    from tpushare.models import transformer
+    from tpushare.serving.continuous import ContinuousBatcher
+    from tpushare.serving.generate import generate_fused
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    out = {}
+    cfg = _cfg(kv_dtype=kv_dtype)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    wcfg = _cfg(window=16, kv_dtype=kv_dtype)
+    wparams = transformer.init_params(jax.random.PRNGKey(4), wcfg)
+
+    # dense pool, single ticks
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    rids = [b.admit(p, n) for p, n in FULL_REQS]
+    b.run_until_drained()
+    out["dense_ticked"] = _streams(b, rids)
+
+    # dense pool, chunked admission + fused decode
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+    _drain_fused(b)
+    out["dense_fused"] = _streams(b, rids)
+
+    # dense pool, mixed single-dispatch rounds
+    b = ContinuousBatcher(params, cfg, n_slots=3)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+    _drain_mixed(b)
+    out["dense_mixed"] = _streams(b, rids)
+
+    # dense pool, one sampled request alongside greedy traffic
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    r0 = b.admit([7, 8, 9], 10)
+    r1 = b.admit(list(range(1, 9)), 10, temperature=0.9, seed=17)
+    b.run_until_drained()
+    out["dense_sampled"] = _streams(b, [r0, r1])
+
+    # ROLLING window-sized dense pool (auto for windowed cfgs)
+    b = ContinuousBatcher(wparams, wcfg, n_slots=3)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
+    _drain_mixed(b)
+    out["rolling"] = _streams(b, rids)
+
+    # paged pool
+    b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+    _drain_mixed(b)
+    out["paged"] = _streams(b, rids)
+
+    # windowed page RING
+    b = PagedContinuousBatcher(wparams, wcfg, n_slots=3, page_size=4,
+                               max_prefill_chunk=4)
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
+    _drain_mixed(b)
+    out["page_ring"] = _streams(b, rids)
+
+    # prefix cache: sequential same-prefix admissions (later ones map
+    # the registered head pages)
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                               prefix_cache=True)
+    rids = []
+    for p, n in PREFIX_REQS:
+        rids.append(b.admit_chunked(p, n, chunk=4))
+        _drain_mixed(b)
+    out["prefix_cache"] = _streams(b, rids)
+
+    # single-request fused decode (the non-batcher path)
+    out["generate_fused"] = [
+        [int(t) for t in generate_fused(
+            params, cfg, jnp.asarray([FULL_REQS[0][0]], jnp.int32),
+            max_new_tokens=8)[0]],
+        [int(t) for t in generate_fused(
+            wparams, wcfg, jnp.asarray([WIN_REQS[0][0]], jnp.int32),
+            max_new_tokens=8)[0]],
+    ]
+    return out

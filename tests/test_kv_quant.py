@@ -1,0 +1,239 @@
+"""Quantized (int8) KV cache: capacity, accuracy bounds, and the bf16
+bit-identity regression guard.
+
+The int8 mode is NOT bit-identical to bf16 (it quantizes cache writes),
+so its contract is accuracy-BOUNDED: pinned max logit error and pinned
+greedy-token agreement against the bf16 reference, on every storage
+flavor (dense ticked/fused/mixed, rolling window pool, paged, windowed
+page ring, prefix cache, single-request fused).  Within int8 mode the
+scheduler equivalences still hold exactly (mixed == sequential ==
+ticked), because quantization happens once at write time regardless of
+which dispatch wrote the position.  bf16 mode must keep producing the
+byte-identical streams committed in ``golden_kv_bf16.json`` (generated
+on the pre-int8 tree — the regression guard for the storage refactor).
+
+Capacity is the point: the same ``pool_bytes`` budget must admit >= 1.9x
+the sequences under int8 (asserted through ``storage_info()`` and the
+paged batcher's free-page accounting, per the one byte model in
+``tpushare.ops.quant.kv_cache_bytes``).
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.models import transformer
+from tpushare.ops.quant import (dequantize_kv, kv_bytes_per_elem,
+                                kv_cache_bytes, quantize_kv)
+from tpushare.serving import metrics
+from tpushare.serving.continuous import ContinuousBatcher
+from tpushare.serving.paged import PagedContinuousBatcher
+
+from kv_golden_scenarios import compute_streams
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden_kv_bf16.json")
+
+#: minimum per-flavor greedy-token agreement, int8 stream vs the bf16
+#: golden (measured 1.000 on every flavor at the committed seeds; the
+#: pin leaves room for backend-kernel drift without letting a broken
+#: quantizer pass)
+AGREEMENT_PIN = 0.90
+#: pinned relative logit error of a decode step served from an int8
+#: cache vs the bf16 cache (measured ~0.007 across seeds)
+LOGIT_REL_PIN = 0.05
+
+#: head_dim=128 config in REAL bf16 storage — the capacity claim's
+#: honest baseline (tiny() stores f32, which would flatter the ratio)
+BCFG = transformer.ModelConfig(vocab=256, d_model=256, n_layers=2,
+                               n_heads=2, n_kv_heads=2, d_ff=128,
+                               max_seq=64, dtype=jnp.bfloat16)
+QCFG = dataclasses.replace(BCFG, kv_dtype="int8")
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+def test_kv_dtype_validates():
+    with pytest.raises(ValueError):
+        dataclasses.replace(transformer.tiny(max_seq=64), kv_dtype="fp8")
+    assert transformer.tiny(max_seq=64).kv_dtype == "bf16"
+
+
+def test_quantize_roundtrip_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 5, 64),
+                          jnp.float32)
+    st = quantize_kv(x)
+    assert st["q"].dtype == jnp.int8
+    assert st["s"].shape == (2, 3, 5, 1)
+    err = np.abs(np.asarray(dequantize_kv(st, jnp.float32) - x))
+    # per-vector symmetric int8: error <= amax/127 per element (half a
+    # quantization step would be amax/254; rounding gives amax/127 worst
+    # case with the clip)
+    amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+    assert (err <= amax / 127.0 + 1e-7).all()
+
+
+def test_build_model_threads_kv_dtype():
+    from tpushare.serving.llm import build_model
+    cfg, _ = build_model("tiny", False, kv_dtype="int8")
+    assert cfg.kv_dtype == "int8"
+    cfg2, _ = build_model("tiny", False)
+    assert cfg2.kv_dtype == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# capacity: >= 1.9x sequences per HBM grant
+# ---------------------------------------------------------------------------
+def test_bytes_per_elem_model():
+    # bf16 value: 2 bytes/elem; int8: 1 byte + f32 scale / head_dim
+    assert kv_bytes_per_elem(BCFG) == 2.0
+    assert kv_bytes_per_elem(QCFG) == 1.0 + 4.0 / BCFG.head_dim
+    ratio = kv_bytes_per_elem(BCFG) / kv_bytes_per_elem(QCFG)
+    assert ratio >= 1.9
+    # kv_cache_bytes matches the actual device buffers
+    caches = transformer.init_kv_caches(QCFG, batch=3)
+    nbytes = sum(leaf.size * leaf.dtype.itemsize
+                 for leaf in jax.tree_util.tree_leaves(caches))
+    assert nbytes == kv_cache_bytes(QCFG, QCFG.max_seq) * 3
+
+
+@pytest.fixture(scope="module")
+def bparams():
+    return transformer.init_params(jax.random.PRNGKey(0), BCFG)
+
+
+def test_dense_storage_info_ratio(bparams):
+    info = ContinuousBatcher(bparams, BCFG, n_slots=2).storage_info()
+    qinfo = ContinuousBatcher(bparams, QCFG, n_slots=2).storage_info()
+    assert info["kv_dtype"] == "bf16" and qinfo["kv_dtype"] == "int8"
+    assert info["bytes_per_slot"] / qinfo["bytes_per_slot"] >= 1.9
+    assert qinfo["slots_per_gib"] >= 1.9 * info["slots_per_gib"]
+
+
+def test_paged_pool_bytes_admits_2x_sequences(bparams):
+    """THE acceptance check: identical pool_bytes, int8 admits >= 1.9x
+    the concurrent sequences (free-page accounting; every admission
+    holds one page here)."""
+    budget = kv_cache_bytes(BCFG, BCFG.max_seq) * 4   # 4 bf16 slots
+    admitted = {}
+    for cfg in (BCFG, QCFG):
+        b = PagedContinuousBatcher(bparams, cfg, n_slots=32, page_size=16,
+                                   pool_bytes=budget)
+        assert b.storage_info()["pool_bytes"] <= budget
+        n = 0
+        while b.admit([1, 2, 3], 13) is not None:   # 16 tokens = 1 page
+            n += 1
+        assert b.free_page_count() == 0       # budget genuinely exhausted
+        admitted[cfg.kv_dtype] = n
+    assert admitted["int8"] >= 1.9 * admitted["bf16"], admitted
+    with pytest.raises(ValueError):
+        PagedContinuousBatcher(bparams, BCFG, n_slots=2, page_size=16,
+                               n_pages=8, pool_bytes=budget)
+
+
+def test_kv_storage_telemetry(bparams):
+    b = ContinuousBatcher(bparams, QCFG, n_slots=3)
+    assert metrics.KV_CACHE_BYTES.value() == b.storage_info()["pool_bytes"]
+    assert metrics.KV_DTYPE_INFO.value(kv_dtype="int8") == 1
+    # a bf16 batcher re-points the info gauge (clear + set)
+    ContinuousBatcher(bparams, BCFG, n_slots=1)
+    assert metrics.KV_DTYPE_INFO.value(kv_dtype="bf16") == 1
+    assert metrics.KV_DTYPE_INFO.value(kv_dtype="int8") is None
+
+
+# ---------------------------------------------------------------------------
+# accuracy bounds
+# ---------------------------------------------------------------------------
+def test_int8_decode_logit_error_bounded():
+    cfg = transformer.tiny(max_seq=64)
+    qcfg = dataclasses.replace(cfg, kv_dtype="int8")
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([list(range(1, 13))], jnp.int32)
+    logits = {}
+    for c in (cfg, qcfg):
+        caches = transformer.init_kv_caches(c, batch=1)
+        _, caches = transformer.forward(params, prompt, c,
+                                        kv_caches=caches, cache_len=0)
+        step, _ = transformer.forward(params, jnp.asarray([[7]], jnp.int32),
+                                      c, kv_caches=caches, cache_len=12)
+        logits[c.kv_dtype] = np.asarray(step[0, 0], np.float32)
+    diff = np.abs(logits["bf16"] - logits["int8"]).max()
+    assert diff <= LOGIT_REL_PIN * np.abs(logits["bf16"]).max(), diff
+
+
+def test_spec_ticks_exact_on_int8_pool(bparams):
+    """Speculation's greedy-exact contract holds WITHIN int8 mode: the
+    verify forward reads the same dequantized cache a plain tick
+    would."""
+    b = ContinuousBatcher(bparams, QCFG, n_slots=2)
+    r = b.admit([5, 6, 5, 6, 5], 10)
+    while b.slots:
+        b.tick_spec(2, k=4, ngram=2)
+    ref = ContinuousBatcher(bparams, QCFG, n_slots=2)
+    rr = ref.admit([5, 6, 5, 6, 5], 10)
+    ref.run_until_drained()
+    assert b.completed[r] == ref.completed[rr]
+
+
+# ---------------------------------------------------------------------------
+# golden regression + per-flavor agreement (the heavy arm)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_bf16_streams_bit_identical_to_committed_goldens():
+    """bf16 mode is the pre-PR behavior, byte for byte: the goldens were
+    generated from the tree BEFORE the store refactor landed, so any
+    numeric drift the refactor introduced in bf16 mode fails here."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    got = compute_streams()
+    assert set(got) == set(golden)
+    for flavor in golden:
+        assert got[flavor] == golden[flavor], flavor
+
+
+@pytest.mark.slow
+def test_int8_agreement_every_flavor():
+    """Greedy (and fixed-seed sampled) streams under int8 agree with
+    the bf16 goldens above the pin on EVERY storage flavor — mixed-step
+    rounds included (dense_mixed / paged / page_ring / prefix_cache all
+    drain through tick_mixed)."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    got = compute_streams(kv_dtype="int8")
+    for flavor, streams in golden.items():
+        agree = total = 0
+        for ref, q in zip(streams, got[flavor]):
+            assert len(q) == len(ref), flavor    # same request lengths
+            total += len(ref)
+            agree += sum(1 for a, b in zip(ref, q) if a == b)
+        assert agree / total >= AGREEMENT_PIN, (flavor, agree / total)
+    # within int8 mode the dispatch flavors stay EXACTLY equivalent:
+    # quantization is per-write, independent of which program wrote it
+    assert got["dense_mixed"] == got["dense_fused"] == got["dense_ticked"]
+
+
+@pytest.mark.slow
+def test_tp_int8_matches_single_device():
+    """Sharding the int8 store (values + scales on the kv-head dim)
+    reproduces single-device int8 streams on the f32 reference config
+    (bf16-activation models can tie-flip under the partitioner's
+    reassociated reductions — quantization's rounding cliff amplifies
+    ulp-level drift; see DESIGN.md)."""
+    from tpushare.parallel.mesh import make_mesh
+    cfg = dataclasses.replace(transformer.tiny(max_seq=96),
+                              kv_dtype="int8")
+    params = transformer.init_params(jax.random.PRNGKey(7), cfg)
+    mesh = make_mesh({"tp": 2})
+
+    def run(m):
+        b = ContinuousBatcher(params, cfg, n_slots=2, mesh=m)
+        rid = b.admit([5, 9, 2], 8)
+        b.run_until_drained()
+        return b.completed[rid]
+
+    assert run(mesh) == run(None)

@@ -8,13 +8,20 @@ contract:
 * counters end in ``_total`` (and nothing else does);
 * time histograms end in ``_seconds``;
 * byte-valued series end in ``_bytes``; ``_bytes`` implies gauge here
-  (no byte counters exist yet).
+  (no byte counters exist yet);
+* ``_info`` series are constant-1 gauges whose payload rides the labels
+  (the Prometheus info idiom, e.g. ``tpushare_kv_dtype_info``).
 
 This is the test that keeps the namespace coherent as instrumentation
 grows — a new metric that breaks the conventions fails CI, not a
-dashboard review.
+dashboard review.  A second lint below guards the KV BYTE MODEL the
+same way: ad-hoc ``2 * ... n_kv_heads ...`` cache-size math outside
+``tpushare.ops.quant`` silently assumes an element size, which the
+int8 KV cache made wrong — new byte math must go through
+``kv_bytes_per_elem`` / ``kv_cache_bytes``.
 """
 
+import os
 import re
 
 NAME_RE = re.compile(r"^tpushare_[a-z0-9_]+$")
@@ -53,6 +60,56 @@ def test_unit_suffix_conventions():
         if name.endswith("_bytes"):
             assert kind == "gauge", \
                 f"{name}: _bytes series are gauges in this namespace"
+        if name.endswith("_info"):
+            assert kind == "gauge", \
+                f"{name}: _info series are constant-1 gauges (info idiom)"
+
+
+def test_kv_byte_series_registered():
+    """The quantized-KV visibility series exist with their contracted
+    names (what inspect --metrics and the capacity dashboards key on)."""
+    names = {n for n, _, _ in _registered()}
+    assert "tpushare_kv_cache_bytes" in names
+    assert "tpushare_kv_dtype_info" in names
+
+
+def test_kv_dtype_info_renders_as_info_series():
+    """Set + render + strict-parse round trip: the info gauge exposes
+    its payload as a label with value 1."""
+    from tpushare import telemetry
+    from tpushare.serving import metrics
+
+    metrics.KV_DTYPE_INFO.clear()
+    metrics.KV_DTYPE_INFO.set(1, kv_dtype="int8")
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+    samples = parsed["samples"]["tpushare_kv_dtype_info"]
+    assert ({"kv_dtype": "int8"}, 1.0) in samples
+
+
+def test_no_literal_kv_byte_math_outside_quant_helper():
+    """Grep-lint: a line multiplying ``2 *`` into ``n_kv_heads`` is the
+    K+V-pair byte formula being re-derived by hand — it hard-codes an
+    element size the kv_dtype makes variable.  The ONE definition lives
+    in tpushare/ops/quant.py (kv_bytes_per_elem / kv_cache_bytes);
+    everything else must call it."""
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tpushare")
+    pat = re.compile(r"2\s*\*")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.endswith(os.path.join("ops", "quant.py")):
+                continue        # the helper itself
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if "n_kv_heads" in line and pat.search(line):
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "KV byte math outside ops/quant.py (use kv_cache_bytes):\n"
+        + "\n".join(offenders))
 
 
 def test_every_metric_has_help_text():

@@ -216,6 +216,26 @@ def test_prefix_cache_on_chip():
 
 
 @_skip
+def test_kv_quant_on_chip():
+    """int8 KV cache on the real chip: the store must COMPILE AND LOWER
+    (dense decode scan + paged tick — the interpreter can't catch a
+    Mosaic layout refusal), halve cache bytes, and not lose decode
+    throughput; tokens/s guards the committed record once one lands."""
+    rec = _run("drive_kv_quant.py", timeout=3600)
+    assert rec["compile_ok"], rec
+    assert rec["hbm_ratio_bf16_vs_int8"] >= 1.9, rec
+    committed = _committed("KV_QUANT_TPU.json", "speedup_int8_vs_bf16",
+                           default=None)
+    got = rec["speedup_int8_vs_bf16"]
+    if committed:
+        assert got >= _GUARD * committed, (rec, committed)
+    else:
+        # first record: memory-bound decode reading half the cache
+        # bytes must at least not LOSE to bf16
+        assert got >= 0.9, rec
+
+
+@_skip
 def test_int4_capacity_demo_on_chip():
     rec = _run("drive_int4_capacity.py", timeout=3600)
     assert rec["only_int4_fits_grant"], rec

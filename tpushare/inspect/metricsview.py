@@ -56,6 +56,16 @@ def _gauge(parsed: dict, name: str) -> Optional[float]:
     return samples[0][1] if samples else None
 
 
+def _info_label(parsed: dict, name: str, label: str) -> Optional[str]:
+    """The ``label`` value of a Prometheus info-style gauge (constant-1
+    series whose payload rides its labels, e.g.
+    ``tpushare_kv_dtype_info{kv_dtype="int8"} 1``)."""
+    for labels, value in parsed["samples"].get(name, ()):
+        if value and label in labels:
+            return labels[label]
+    return None
+
+
 def _hist_quantile(parsed: dict, base: str, q: float) -> Optional[float]:
     """Quantile from ``<base>_bucket`` samples, aggregated over every
     non-``le`` label set (one serving process per node today, but a
@@ -97,6 +107,12 @@ def summarize_serving(parsed: dict) -> dict:
         "kv_pages_used": used,
         "kv_pages_free": free,
         "kv_util": kv_util,
+        # quantized-KV visibility: the pool's persistent footprint and
+        # its storage dtype (int8 halves the bytes the same traffic
+        # holds — the saving this view exists to make visible)
+        "kv_cache_bytes": _gauge(parsed, "tpushare_kv_cache_bytes"),
+        "kv_dtype": _info_label(parsed, "tpushare_kv_dtype_info",
+                                "kv_dtype"),
         # mixed-step scheduler: mid-prefill queue depth and how full the
         # last round's coalesced prefill block was
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
@@ -112,16 +128,27 @@ def _fmt(v, scale: float = 1.0, suffix: str = "",
     return f"{v * scale:.{digits}f}{suffix}"
 
 
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if v < 1024 or unit == "GiB":
+            return (f"{v:.0f}{unit}" if unit == "B"
+                    else f"{v:.1f}{unit}")
+        v /= 1024.0
+    return "-"              # unreachable
+
+
 def render_metrics_table(
         rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
     """``rows`` = [(node, address, summary|None, error|None)]."""
     table = [["NAME", "IPADDRESS", "QPS", "TTFT p50(ms)", "TTFT p99(ms)",
-              "OCCUPANCY", "KV PAGES(used/free)", "PREFILL Q",
-              "BUDGET%"]]
+              "OCCUPANCY", "KV PAGES(used/free)", "KV BYTES(dtype)",
+              "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, err or "unreachable",
-                          "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -129,6 +156,9 @@ def render_metrics_table(
                   f"{int(summary['kv_pages_free'] or 0)}")
             if summary["kv_util"] is not None:
                 kv += f" ({summary['kv_util'] * 100:.0f}%)"
+        kv_bytes = _fmt_bytes(summary.get("kv_cache_bytes"))
+        if summary.get("kv_dtype"):
+            kv_bytes += f" ({summary['kv_dtype']})"
         table.append([
             name, addr,
             _fmt(summary["qps"]),
@@ -136,6 +166,7 @@ def render_metrics_table(
             _fmt(summary["ttft_p99_s"], 1000.0),
             _fmt(summary["occupancy"], 100.0, "%", 0),
             kv,
+            kv_bytes,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])

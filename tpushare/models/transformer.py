@@ -49,6 +49,16 @@ class ModelConfig:
     #: (auto; see ContinuousBatcher rolling_slots): window-sized slots,
     #: so HBM buys max_seq/window× more concurrent sequences.
     window: Optional[int] = None
+    #: KV-cache storage dtype: "bf16" (cfg.dtype storage, the
+    #: bit-identity reference) or "int8" — cache writes quantize
+    #: per-(token, kv-head) inside the same jitted programs and
+    #: attention reads dequantize to cfg.dtype just before the QK^T
+    #: matmul, so every storage pool holds ~2x the sequences per HBM
+    #: byte (``ops.quant.kv_bytes_per_elem``).  Decode is NOT
+    #: bit-identical to bf16 (accuracy-bounded instead, see
+    #: tests/test_kv_quant.py); params/activations are untouched —
+    #: weight quantization composes independently (ops.quant).
+    kv_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.window is not None and self.window < 1:
@@ -56,6 +66,9 @@ class ModelConfig:
             # path but "mask everything" to the position-masked decode
             # path — normalize to None instead of diverging silently
             raise ValueError("window must be None or >= 1")
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
+                             f"got {self.kv_dtype!r}")
 
     @property
     def head_dim(self) -> int:
@@ -180,6 +193,52 @@ def _expand_kv(k, n_rep: int):
     return jnp.repeat(k, n_rep, axis=1)  # [B, Hkv, S, D] -> [B, H, S, D]
 
 
+# ---------------------------------------------------------------------------
+# KV-cache storage stores (bf16 array, or int8 {"q","s"} pytree)
+# ---------------------------------------------------------------------------
+# A cache "store" is what one of K or V persists as: a plain cfg.dtype
+# array (kv_dtype="bf16", byte-identical to the pre-quantization
+# layout), or an int8 {"q": [..., D] int8, "s": [..., 1] f32} pytree
+# (kv_dtype="int8").  The scale rides the SAME rank with a singleton
+# trailing dim, so every index op the serving plane applies to caches
+# (token-axis slices/scatters, batch-axis gathers, ring selects, mixed-
+# step row writebacks) maps over both leaves unchanged — _smap below is
+# that one tree_map spelling, and a bf16 store degenerates to the exact
+# single-array op the pre-int8 code performed (bit-identity preserved).
+
+def kv_quantized(cfg: ModelConfig) -> bool:
+    return cfg.kv_dtype == "int8"
+
+
+def _smap(f, *stores):
+    """Apply one index/update op to every leaf of K or V store(s)."""
+    return jax.tree_util.tree_map(f, *stores)
+
+
+def _kv_leaf(store):
+    """The VALUES array of a store (for shape queries only)."""
+    return store["q"] if isinstance(store, dict) else store
+
+
+def _kv_pack(x, cfg: ModelConfig):
+    """Fresh K or V block [B, Hkv, S, D] -> its storage form.  int8
+    quantizes per (token, kv-head) HERE — once, at write time — so a
+    position's cached value is identical no matter which dispatch
+    flavor (whole/chunked/mixed prefill, decode) wrote it."""
+    if kv_quantized(cfg):
+        from ..ops.quant import quantize_kv
+        return quantize_kv(x)
+    return x
+
+
+def _kv_unpack(store, cfg: ModelConfig):
+    """Storage form -> dense cfg.dtype block for the attention read."""
+    if isinstance(store, dict):
+        from ..ops.quant import dequantize_kv
+        return dequantize_kv(store, cfg.dtype)
+    return store
+
+
 def _qkv(p, x, cfg: ModelConfig, positions):
     """Project + RoPE: x [B,S,d] -> q [B,H,S,D], k/v [B,Hkv,S,D]."""
     b, s, _ = x.shape
@@ -248,8 +307,14 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
     q, k, v = _qkv(p, xin, cfg, positions)
 
     if kv_cache is not None:
-        ck, cv = kv_cache                       # [B, Hkv, max_seq|W, D]
-        W = ck.shape[2]
+        ck, cv = kv_cache          # stores: [B, Hkv, max_seq|W, D] (+s)
+        W = _kv_leaf(ck).shape[2]
+        # Storage form of this step's fresh K/V — int8 quantizes ONCE
+        # here.  Where a query attends its own chunk's keys outside the
+        # cache (the rolling multi-token path below), it reads the
+        # UNPACKED storage form, so a position's key is the same number
+        # whether read in-dispatch or from the cache next round.
+        k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
         if W < cfg.max_seq:
             # ROLLING window cache (init_kv_caches(..., rolling=True)):
             # position p lives in ring slot p % W, so persistent HBM and
@@ -281,10 +346,10 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                     # dynamic-update-slice lowers much better on TPU
                     # than a 1-element scatter
                     slot = cache_len % W
-                    ck = jax.lax.dynamic_update_slice(
-                        ck, k, (0, 0, slot, 0))
-                    cv = jax.lax.dynamic_update_slice(
-                        cv, v, (0, 0, slot, 0))
+                    ck = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                        c, n, (0, 0, slot, 0)), ck, k_st)
+                    cv = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                        c, n, (0, 0, slot, 0)), cv, v_st)
                     l_end = cache_len + 1
                     k_pos = r + W * ((l_end - 1 - r) // W)       # [W]
                 else:
@@ -292,13 +357,15 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                     upd = jax.vmap(lambda c, blk, p:
                                    jax.lax.dynamic_update_slice(
                                        c, blk, (0, p, 0)))
-                    ck = upd(ck, k, slots)
-                    cv = upd(cv, v, slots)
+                    ck = _smap(lambda c, n: upd(c, n, slots), ck, k_st)
+                    cv = _smap(lambda c, n: upd(c, n, slots), cv, v_st)
                     l_end = cache_len + 1                        # [B]
                     k_pos = (r[None, :]
                              + W * ((l_end[:, None] - 1 - r[None, :]) // W))
-                o = cached_attention(q, _expand_kv(ck, h // hkv),
-                                     _expand_kv(cv, h // hkv), positions,
+                o = cached_attention(q, _expand_kv(_kv_unpack(ck, cfg),
+                                                   h // hkv),
+                                     _expand_kv(_kv_unpack(cv, cfg),
+                                                h // hkv), positions,
                                      window=cfg.window, k_positions=k_pos)
                 return o, (ck, cv)
             nv = s_new if kv_write_len is None else kv_write_len
@@ -319,8 +386,12 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                     # prefill: each row's chunk has its own padded tail)
                     nv = nv[:, None]                             # [B, 1]
             o = cached_attention(
-                q, _expand_kv(jnp.concatenate([ck, k], axis=2), h // hkv),
-                _expand_kv(jnp.concatenate([cv, v], axis=2), h // hkv),
+                q, _expand_kv(jnp.concatenate(
+                    [_kv_unpack(ck, cfg), _kv_unpack(k_st, cfg)],
+                    axis=2), h // hkv),
+                _expand_kv(jnp.concatenate(
+                    [_kv_unpack(cv, cfg), _kv_unpack(v_st, cfg)],
+                    axis=2), h // hkv),
                 positions, window=cfg.window, k_positions=k_pos)
             # commit: per ring slot, the LATEST real chunk offset that
             # maps to it (a + W*floor((nv-1-a)/W)); slots no real offset
@@ -328,30 +399,34 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
             j_r = jnp.clip(a + W * ((nv - 1 - a) // W), 0, s_new - 1)
             write = a < nv                        # [W] or [B, W]
             if jnp.ndim(cache_len) == 0:
-                sel_k, sel_v = k[:, :, j_r, :], v[:, :, j_r, :]
+                sel_k = _smap(lambda n: n[:, :, j_r, :], k_st)
+                sel_v = _smap(lambda n: n[:, :, j_r, :], v_st)
                 wmask = write[None, None, :, None]
             else:
                 take = jax.vmap(lambda blk, ix: blk[:, ix, :])
-                sel_k, sel_v = take(k, j_r), take(v, j_r)
+                sel_k = _smap(lambda n: take(n, j_r), k_st)
+                sel_v = _smap(lambda n: take(n, j_r), v_st)
                 wmask = write[:, None, :, None]
-            ck = jnp.where(wmask, sel_k, ck)
-            cv = jnp.where(wmask, sel_v, cv)
+            ck = _smap(lambda c, s: jnp.where(wmask, s, c), ck, sel_k)
+            cv = _smap(lambda c, s: jnp.where(wmask, s, c), cv, sel_v)
             return o, (ck, cv)
         if jnp.ndim(cache_len) == 0:
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, cache_len, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, cache_len, 0))
+            ck = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                c, n, (0, 0, cache_len, 0)), ck, k_st)
+            cv = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                c, n, (0, 0, cache_len, 0)), cv, v_st)
         else:
             # per-sample positions (continuous batching): vmap the update
             # over the batch with each slot's own offset
             upd = jax.vmap(
                 lambda c, blk, p: jax.lax.dynamic_update_slice(
                     c, blk, (0, p, 0)))
-            ck = upd(ck, k, cache_len)
-            cv = upd(cv, v, cache_len)
+            ck = _smap(lambda c, n: upd(c, n, cache_len), ck, k_st)
+            cv = _smap(lambda c, n: upd(c, n, cache_len), cv, v_st)
         # decode: attend over the filled prefix; positions mask the rest
-        o = cached_attention(q, _expand_kv(ck, h // hkv),
-                             _expand_kv(cv, h // hkv), positions,
-                             window=cfg.window)
+        o = cached_attention(q, _expand_kv(_kv_unpack(ck, cfg), h // hkv),
+                             _expand_kv(_kv_unpack(cv, cfg), h // hkv),
+                             positions, window=cfg.window)
         return o, (ck, cv)
     if attention_fn is not None:
         if cfg.window is not None:
@@ -526,7 +601,12 @@ def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False):
     window configs only): position p lives in slot p % window, so cache
     HBM is O(window) instead of O(max_seq) — for mistral_7b that is a
     4096-entry cache against a 32768 context, 8x less KV memory and 8x
-    fewer attended keys per decode step."""
+    fewer attended keys per decode step.
+
+    ``cfg.kv_dtype="int8"`` swaps each buffer for an int8 {"q","s"}
+    store (per-(position, kv-head) scales riding a trailing singleton)
+    — same shapes and index semantics, ~half the HBM
+    (``ops.quant.kv_bytes_per_elem``)."""
     if rolling:
         if cfg.window is None:
             raise ValueError("rolling caches need a sliding-window cfg")
@@ -534,7 +614,20 @@ def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False):
     else:
         t = cfg.max_seq
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, t, cfg.head_dim)
-    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    return (_kv_store_zeros(shape, cfg), _kv_store_zeros(shape, cfg))
+
+
+def _kv_store_zeros(shape, cfg: ModelConfig):
+    """Zeroed persistent storage for one of K/V: a cfg.dtype array, or
+    the int8 {"q","s"} pair with a per-(position, kv-head) scale buffer
+    riding the values' rank (trailing singleton).  Zero scales
+    dequantize to exact zeros, so unwritten/trash positions read the
+    same 0.0 the bf16 layout holds."""
+    if kv_quantized(cfg):
+        from ..ops.quant import KV_SCALE_DTYPE
+        return {"q": jnp.zeros(shape, jnp.int8),
+                "s": jnp.zeros(shape[:-1] + (1,), KV_SCALE_DTYPE)}
+    return jnp.zeros(shape, cfg.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -550,9 +643,11 @@ def init_paged_kv(cfg: ModelConfig, n_pages: int, page_size: int):
     unowned table entries and inactive slots point at it, their writes
     land there, and the position mask keeps its garbage out of every
     softmax — so the math is bit-identical to the dense cache path.
+    ``cfg.kv_dtype="int8"`` stores pages as int8 {"q","s"} pairs (same
+    page geometry, ~2x the pages per HBM byte).
     """
     shape = (cfg.n_layers, n_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
-    return (jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+    return (_kv_store_zeros(shape, cfg), _kv_store_zeros(shape, cfg))
 
 
 def _paged_gather(pool, page_table):
@@ -566,6 +661,15 @@ def _paged_gather(pool, page_table):
     g = pool[page_table]                        # [B, pages, Hkv, P, D]
     b, npg, hkv, p, d = g.shape
     return g.transpose(0, 2, 1, 3, 4).reshape(b, hkv, npg * p, d)
+
+
+def _paged_gather_deq(store, page_table, cfg: ModelConfig):
+    """Gather a pool STORE through a page table and unpack to the dense
+    cfg.dtype attention view (scales gather alongside their values —
+    the trailing-singleton layout makes :func:`_paged_gather` generic
+    in the last dim)."""
+    return _kv_unpack(
+        _smap(lambda p: _paged_gather(p, page_table), store), cfg)
 
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
@@ -583,7 +687,7 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     positions = lengths[:, None] + jnp.arange(s)[None, :]
     x = params["embed"][tokens].astype(cfg.dtype)
     kp, vp = pools
-    page = kp.shape[3]
+    page = _kv_leaf(kp).shape[3]
     h, hkv = cfg.n_heads, cfg.n_kv_heads
     # Each slot appends at logical position `length`: page length//P,
     # lane length%P.  Distinct active slots own distinct pages, so the
@@ -597,11 +701,16 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
 
         def attend(lyr, xin):
             q, k, v = _qkv(lyr, xin, cfg, positions)
-            kp2 = kpool.at[page_ids, :, offsets, :].set(k[:, :, 0, :])
-            vp2 = vpool.at[page_ids, :, offsets, :].set(v[:, :, 0, :])
+            k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
+            kp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
+                        .set(n[:, :, 0, :]), kpool, k_st)
+            vp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
+                        .set(n[:, :, 0, :]), vpool, v_st)
             o = cached_attention(
-                q, _expand_kv(_paged_gather(kp2, page_table), h // hkv),
-                _expand_kv(_paged_gather(vp2, page_table), h // hkv),
+                q, _expand_kv(_paged_gather_deq(kp2, page_table, cfg),
+                              h // hkv),
+                _expand_kv(_paged_gather_deq(vp2, page_table, cfg),
+                           h // hkv),
                 positions, window=cfg.window)
             return o, (kp2, vp2)
 
@@ -635,7 +744,7 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
     if b != 1:
         raise ValueError("paged prefill is per-request (batch 1)")
     kp, vp = pools
-    page = kp.shape[3]
+    page = _kv_leaf(kp).shape[3]
     if s % page:
         raise ValueError("prefill window must be page-aligned")
     positions = pos + jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
@@ -649,19 +758,22 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
 
         def attend(lyr, xin):
             q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [1, Hkv, W, D]
+            k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
             kp2, vp2 = kpool, vpool
             for j in range(n_chunks):           # static page walk
                 pid = page_rows[first_page + j]
                 # piece [1, Hkv, page, D] already matches pool layout
-                kp2 = jax.lax.dynamic_update_slice(
-                    kp2, k[:, :, j * page:(j + 1) * page, :],
-                    (pid, 0, 0, 0))
-                vp2 = jax.lax.dynamic_update_slice(
-                    vp2, v[:, :, j * page:(j + 1) * page, :],
-                    (pid, 0, 0, 0))
+                kp2 = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                    c, n[:, :, j * page:(j + 1) * page, :],
+                    (pid, 0, 0, 0)), kp2, k_st)
+                vp2 = _smap(lambda c, n: jax.lax.dynamic_update_slice(
+                    c, n[:, :, j * page:(j + 1) * page, :],
+                    (pid, 0, 0, 0)), vp2, v_st)
             o = cached_attention(
-                q, _expand_kv(_paged_gather(kp2, page_rows[None]), h // hkv),
-                _expand_kv(_paged_gather(vp2, page_rows[None]), h // hkv),
+                q, _expand_kv(_paged_gather_deq(kp2, page_rows[None], cfg),
+                              h // hkv),
+                _expand_kv(_paged_gather_deq(vp2, page_rows[None], cfg),
+                           h // hkv),
                 positions, window=cfg.window)
             return o, (kp2, vp2)
 
@@ -699,7 +811,7 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
     """
     b, s = tokens.shape
     kp, vp = pools
-    page = kp.shape[3]
+    page = _kv_leaf(kp).shape[3]
     if s % page:
         raise ValueError("prefill window must be page-aligned")
     n_chunks = s // page                        # static
@@ -722,11 +834,16 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
 
         def attend(lyr, xin):
             q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [R, Hkv, W, D]
-            kp2 = kpool.at[flat_pids].set(pieces(k))
-            vp2 = vpool.at[flat_pids].set(pieces(v))
+            k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
+            kp2 = _smap(lambda c, n: c.at[flat_pids].set(pieces(n)),
+                        kpool, k_st)
+            vp2 = _smap(lambda c, n: c.at[flat_pids].set(pieces(n)),
+                        vpool, v_st)
             o = cached_attention(
-                q, _expand_kv(_paged_gather(kp2, page_rows), h // hkv),
-                _expand_kv(_paged_gather(vp2, page_rows), h // hkv),
+                q, _expand_kv(_paged_gather_deq(kp2, page_rows, cfg),
+                              h // hkv),
+                _expand_kv(_paged_gather_deq(vp2, page_rows, cfg),
+                           h // hkv),
                 positions, window=cfg.window)
             return o, (kp2, vp2)
 
@@ -747,7 +864,7 @@ def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
     logits [1, vocab], updated pools)."""
     b, s = tokens.shape
     kp, _ = pools
-    page = kp.shape[3]
+    page = _kv_leaf(kp).shape[3]
     w = -(-s // page) * page
     if w != s:
         tokens = jnp.pad(tokens[:, :s], ((0, 0), (0, w - s)))

@@ -54,6 +54,63 @@ def qmatmul(x: jnp.ndarray, qw: Dict, dtype=None) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# KV-cache quantization (int8, per-token-per-head)
+# ---------------------------------------------------------------------------
+#: dtype of the per-(token, head) KV scales.  f32: the scale multiplies
+#: every dequantized element, so its own rounding error would stack on
+#: the int8 grid's; at head_dim >= 64 the 4 bytes amortize to < 7% of
+#: the cache anyway.
+KV_SCALE_DTYPE = jnp.float32
+
+
+def quantize_kv(x: jnp.ndarray) -> Dict:
+    """K or V block [..., D] -> {"q": int8 [..., D], "s": f32 [..., 1]}.
+
+    Per-VECTOR symmetric (one scale per token per kv-head, reduced over
+    head_dim only): the finest granularity that still writes
+    append-only — a new token's scale never re-quantizes already-cached
+    neighbours, so decode/prefill/mixed paths all see identical cached
+    values no matter which dispatch wrote them.  The trailing singleton
+    keeps the scale the same RANK as the values: every cache index op
+    (slice/scatter on the token axis, batch gathers, ring selects)
+    applies to both leaves unchanged via ``tree_map``.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(KV_SCALE_DTYPE)}
+
+
+def dequantize_kv(store: Dict, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """{"q","s"} -> dense [..., D] block in ``dtype`` (reads dequantize
+    to the compute dtype just before the QK^T / PV matmuls)."""
+    return (store["q"].astype(jnp.float32) * store["s"]).astype(dtype)
+
+
+def kv_bytes_per_elem(cfg) -> float:
+    """Persistent bytes per stored KV ELEMENT for this config's
+    ``kv_dtype`` — value byte(s) plus the per-(token, head) scale
+    amortized over head_dim.  THE one definition of KV element cost;
+    byte-size math everywhere else goes through here or
+    :func:`kv_cache_bytes` (lint-enforced)."""
+    if getattr(cfg, "kv_dtype", "bf16") == "int8":
+        return 1.0 + jnp.dtype(KV_SCALE_DTYPE).itemsize / cfg.head_dim
+    return float(jnp.dtype(cfg.dtype).itemsize)
+
+
+def kv_cache_bytes(cfg, tokens: int) -> int:
+    """Persistent KV-cache bytes for ``tokens`` cache positions: K and V
+    across all layers and kv-heads (+ int8 scale buffers).  Used by
+    every storage_info() / gauge / capacity computation so the byte
+    model cannot drift between reservation, eviction, and reporting."""
+    kv_pair = 2            # one K and one V entry per position
+    elems = (kv_pair * cfg.n_layers * cfg.n_kv_heads * tokens
+             * cfg.head_dim)
+    return int(round(elems * kv_bytes_per_elem(cfg)))
+
+
+# ---------------------------------------------------------------------------
 # int4 (grouped, packed two-per-byte)
 # ---------------------------------------------------------------------------
 def quantize4(w: jnp.ndarray, group: int = 512):

@@ -44,7 +44,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .shardmap_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -41,7 +41,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .shardmap_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import (NEG_INF, flash_attention_lse,

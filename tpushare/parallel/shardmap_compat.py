@@ -1,0 +1,26 @@
+"""``shard_map`` across jax versions — the ONE import site.
+
+Newer jax promotes ``shard_map`` to the top level and renames its
+replication-check kwarg ``check_rep`` -> ``check_vma``; older releases
+(this container pins 0.4.x) keep it in ``jax.experimental.shard_map``
+with the old kwarg.  The wrapper keeps every call site on the new
+spelling so the parallel plane imports (and runs) on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:                  # jax < 0.5: pre-promotion location
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})

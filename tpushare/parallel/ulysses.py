@@ -15,7 +15,7 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax import shard_map
+from .shardmap_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import attention

@@ -436,8 +436,20 @@ class ContinuousBatcher:
         # verify forward, like one plain tick)
         self._spec_stats = {"calls": 0, "rounds": 0, "tokens": 0}
         self._init_storage()
+        self._observe_storage()
 
     # -- telemetry helpers ---------------------------------------------
+    def _observe_storage(self) -> None:
+        """Mirror the KV pool's persistent footprint into /metrics: the
+        byte gauge is what ``kubectl inspect tpushare --metrics`` and
+        the daemon's grant-vs-usage view read, and the ``_info`` gauge
+        names the storage dtype (constant 1, Prometheus info idiom) —
+        together they make the int8 saving visible off-process."""
+        info = self.storage_info()
+        metrics.KV_CACHE_BYTES.set(info["pool_bytes"])
+        metrics.KV_DTYPE_INFO.clear()
+        metrics.KV_DTYPE_INFO.set(1, kv_dtype=info["kv_dtype"])
+
     def _observe_tick(self, t0: float) -> None:
         """Record one tick's wall time and the post-tick occupancy."""
         metrics.TICK_DURATION.observe(time.perf_counter() - t0)
@@ -467,13 +479,16 @@ class ContinuousBatcher:
         """HBM accounting for the slot pool: what one slot costs and how
         many slots a GiB of KV budget buys — the economics the rolling
         pool changes (window-sized slots: max_seq/window× more slots
-        per byte for sliding-window models)."""
+        per byte for sliding-window models) and the int8 KV cache
+        changes again (~2x slots per byte at any slot size; all byte
+        math through :func:`tpushare.ops.quant.kv_cache_bytes`, so
+        reservation/gauges/reporting share one dtype-aware model)."""
+        from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
         slot_tokens = (cfg.window if self.rolling_slots else cfg.max_seq)
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        bytes_per_slot = (2 * cfg.n_layers * cfg.n_kv_heads * slot_tokens
-                          * cfg.head_dim * itemsize)
+        bytes_per_slot = kv_cache_bytes(cfg, slot_tokens)
         return {"kind": "rolling" if self.rolling_slots else "dense",
+                "kv_dtype": cfg.kv_dtype,
                 "slot_tokens": int(slot_tokens),
                 "bytes_per_slot": int(bytes_per_slot),
                 "slots_per_gib": (2 ** 30) // bytes_per_slot,

@@ -26,7 +26,13 @@ log = logging.getLogger("tpushare.llm")
 
 
 def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
-                quantize_int4: bool = False):
+                quantize_int4: bool = False, kv_dtype: str = "bf16"):
+    """``kv_dtype="int8"`` stores the serving KV cache quantized
+    (per-token scales, ~2x sequences per HBM byte; decode is accuracy-
+    bounded, not bit-identical — see DESIGN.md "Quantized KV").
+    Orthogonal to the weight-only ``--int8``/``--int4`` flags."""
+    import dataclasses
+
     import jax
 
     from ..models import transformer
@@ -50,6 +56,8 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
     if quantize_int8 and quantize_int4:
         raise ValueError("pick one of int8 / int4")
     cfg = cfgs[model_name]()
+    if kv_dtype != "bf16":
+        cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
     params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
     if quantize_int4:
         params = quant.quantize_params(params, bits=4)
@@ -484,6 +492,12 @@ def main(argv=None) -> int:
     ap.add_argument("--int4", action="store_true",
                     help="weight-only grouped int4, packed two-per-byte "
                          "(a 7B model in a ~7GiB grant)")
+    ap.add_argument("--kv-dtype", choices=("bf16", "int8"), default="bf16",
+                    help="KV-cache storage dtype: int8 admits ~2x the "
+                         "concurrent sequences per HBM byte (accuracy-"
+                         "bounded decode, not bit-identical); works with "
+                         "every storage flavor and composes with "
+                         "--int8/--int4 weights")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--addr", default="0.0.0.0")
     ap.add_argument("--slots", type=int, default=0,
@@ -547,16 +561,17 @@ def main(argv=None) -> int:
         log.info("running unallocated (dev mode)")
 
     cfg, params = build_model(args.model, args.int8,
-                              quantize_int4=args.int4)
+                              quantize_int4=args.int4,
+                              kv_dtype=args.kv_dtype)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill)
-    log.info("llm server: model=%s quant=%s tp=%d on :%d", args.model,
+    log.info("llm server: model=%s quant=%s kv=%s tp=%d on :%d", args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
-             args.tp, srv.port)
+             args.kv_dtype, args.tp, srv.port)
     srv.serve_forever()
     return 0
 

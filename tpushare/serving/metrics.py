@@ -94,6 +94,16 @@ SPEC_TOKENS = telemetry.counter(
     "tpushare_spec_tokens_total",
     "Tokens committed by batched speculative rounds")
 
+# -- KV storage (all pool flavors) ----------------------------------------
+KV_CACHE_BYTES = telemetry.gauge(
+    "tpushare_kv_cache_bytes",
+    "Persistent KV-cache pool HBM footprint of the live batcher (values "
+    "plus int8 scale buffers; the bytes an int8 cache halves)")
+KV_DTYPE_INFO = telemetry.gauge(
+    "tpushare_kv_dtype_info",
+    "KV-cache storage dtype of the live batcher (constant 1; the dtype "
+    "rides the kv_dtype label, Prometheus info idiom)")
+
 # -- paged KV storage -----------------------------------------------------
 KV_PAGES_USED = telemetry.gauge(
     "tpushare_kv_pages_used",

@@ -164,11 +164,20 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  page_size: int = 16, n_pages: Optional[int] = None,
                  mesh=None, max_prefill_chunk: int = 64,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 pool_bytes: Optional[int] = None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq // page_size
+        if pool_bytes is not None:
+            # size the pool by an HBM BUDGET instead of a page count:
+            # the same byte grant buys ~2x the pages under kv_dtype=int8
+            # (one dtype-aware byte model — ops.quant.kv_cache_bytes)
+            if n_pages is not None:
+                raise ValueError("pass n_pages or pool_bytes, not both")
+            from ..ops.quant import kv_cache_bytes
+            n_pages = int(pool_bytes) // kv_cache_bytes(cfg, page_size)
         # Upper bound on any prefill chunk through this batcher —
         # admission clamps to it.  Sized into the windowed page ring
         # (see _held_pages); irrelevant for full-causal requests.
@@ -216,12 +225,16 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def storage_info(self) -> dict:
         """HBM accounting for the page pool (vs the base class's
-        per-slot rows): persistent KV cost is pages, not slots."""
+        per-slot rows): persistent KV cost is pages, not slots.  Byte
+        math goes through :func:`tpushare.ops.quant.kv_cache_bytes`, so
+        an int8 pool prices its pages (and the ``pool_bytes`` sizing
+        knob admits ~2x of them) with the same model the gauges and
+        ``/usage`` reporting use."""
+        from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        bytes_per_page = (2 * cfg.n_layers * cfg.n_kv_heads
-                          * self.page_size * cfg.head_dim * itemsize)
-        return {"kind": "paged", "page_tokens": self.page_size,
+        bytes_per_page = kv_cache_bytes(cfg, self.page_size)
+        return {"kind": "paged", "kv_dtype": cfg.kv_dtype,
+                "page_tokens": self.page_size,
                 "bytes_per_page": int(bytes_per_page),
                 "n_pages": self.n_pages,
                 "pool_bytes": int(bytes_per_page * self.n_pages)}
