@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -76,89 +75,20 @@ def bert_fwd_flops_per_batch(cfg, batch: int, seq: int) -> float:
 #: why the last probe failed (rides into the record's "note")
 _PROBE_FAIL = {"reason": None}
 
+# The ONE probe/watchdog implementation lives in the shared health
+# plane (tpushare/telemetry/health.py — stdlib-only, safe to import
+# before jax); this bench consumes it instead of carrying a private
+# copy.  Behavior is unchanged: probe deadline -> abandon (never kill
+# mid-dial) -> cpu fallback; stall -> degraded JSON line (and now the
+# health state machine goes WEDGED, snapshotting the flight recorder).
+from tpushare.telemetry import health as _health
+
 
 def _probe_platform(deadline_s: float):
-    """Ask a subprocess what platform jax lands on, with a deadline.
-
-    Only runs when the tunnel hook env is present — that is the one case
-    where backend init can stall for ~25 minutes. The subprocess inherits
-    the env, so it reproduces exactly the dial the bench process would
-    make. Returns the platform string, or None when the probe timed out
-    or failed (caller should pin cpu). On timeout the probe is abandoned
-    to exit on its own — never killed mid-dial.
-    """
-    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
-        return os.environ.get("JAX_PLATFORMS") or "local"  # nothing dials
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return "cpu"  # pinned; nothing to probe
-    _log(f"probing accelerator (deadline {deadline_s:.0f}s)...")
-    proc = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
-    try:
-        out, _ = proc.communicate(timeout=deadline_s)
-        lines = (out or "").strip().splitlines()
-        if lines:
-            return lines[-1]
-        _log("probe subprocess exited without a platform (backend init "
-             "crashed); falling back to cpu")
-        _PROBE_FAIL["reason"] = ("accelerator probe subprocess died "
-                                 "without initializing a backend; cpu "
-                                 "fallback")
-        return None
-    except subprocess.TimeoutExpired:
-        _log("probe deadline hit; abandoning probe (not killing mid-dial) "
-             "and falling back to cpu")
-        _PROBE_FAIL["reason"] = ("accelerator probe hit its deadline "
-                                 "(tunnel outage signature); cpu fallback "
-                                 "- see CLAUDE.md 'Environment hazards'")
-        return None
-
-
-def _start_watchdog(budget_s: float, state: dict) -> None:
-    """Emit a degraded-but-valid JSON record and exit if the bench stalls.
-
-    A tunnel fetch can hang FOREVER mid-measure (observed round 4: the
-    streamed measurement blocked >25 min after a chip-stress run), and a
-    blocked gRPC recv cannot be interrupted from Python.  The driver
-    would eventually kill the process anyway — this watchdog beats it to
-    the punch with whatever numbers exist so far, so the round records a
-    degraded measurement instead of nothing.  ``state['best']`` is the
-    best record assembled so far; stage 'done' disarms.
-    """
-    import threading
-
-    def run():
-        t0 = time.monotonic()
-        while time.monotonic() - t0 < budget_s:
-            time.sleep(5)
-            if state.get("stage") == "done":
-                return
-        stage = state.get("stage")
-        if stage == "done":
-            return
-        rec = dict(state.get("best") or {})
-        rec.setdefault("metric", "bert_base_infer_qps")
-        rec.setdefault("value", None)
-        rec.setdefault("unit", "qps")
-        rec.setdefault("vs_baseline", None)
-        rec["degraded"] = (f"watchdog fired at stage {stage!r} after "
-                           f"{budget_s:.0f}s (hung tunnel fetch?)")
-        _log(f"WATCHDOG: stalled at {stage!r}; emitting degraded record")
-        print(json.dumps(rec), flush=True)
-        if stage in ("probe", "import-jax"):
-            # Mid-DIAL: exiting here is exactly the kill CLAUDE.md bans
-            # (it wedges the tunnel for a long time).  The record is out
-            # on stdout; leave the process to finish or to the caller's
-            # own policy.
-            _log("WATCHDOG: stage is mid-dial; NOT exiting (record "
-                 "emitted; kill policy is the caller's)")
-            return
-        os._exit(2)
-
-    threading.Thread(target=run, daemon=True,
-                     name="tpushare-bench-watchdog").start()
+    platform, reason = _health.probe_platform(deadline_s, log=_log)
+    if reason is not None:
+        _PROBE_FAIL["reason"] = reason
+    return platform
 
 
 def main() -> int:
@@ -167,9 +97,13 @@ def main() -> int:
     # the watchdog must outlast the naive-baseline budget, or raising
     # TPUSHARE_BENCH_BUDGET_S would get a healthy bench killed mid-naive
     budget_s = float(os.environ.get("TPUSHARE_BENCH_BUDGET_S", "900"))
-    _start_watchdog(
+    _health.start_stall_watchdog(
         float(os.environ.get("TPUSHARE_BENCH_WATCHDOG_S",
-                             str(max(1500.0, budget_s + 600.0)))), watch)
+                             str(max(1500.0, budget_s + 600.0)))),
+        watch,
+        defaults={"metric": "bert_base_infer_qps", "value": None,
+                  "unit": "qps", "vs_baseline": None},
+        log=_log)
     probed = _probe_platform(deadline)
     if probed is None:
         # Probe stalled or died: pin cpu BEFORE the first backend touch
@@ -177,6 +111,8 @@ def main() -> int:
         # but set them anyway.
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        _health.MONITOR.mark_cpu_fallback(
+            _PROBE_FAIL["reason"] or "probe failed; cpu fallback")
 
     watch["stage"] = "import-jax"
     _log("importing jax...")
@@ -199,6 +135,7 @@ def main() -> int:
         _PROBE_FAIL["reason"] = (
             f"probe saw a healthy backend but this process's init "
             f"failed ({str(e)[:120]}); cpu fallback")
+        _health.MONITOR.mark_cpu_fallback(_PROBE_FAIL["reason"])
         jax.config.update("jax_platforms", "cpu")
         platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -217,6 +154,9 @@ def main() -> int:
         "attention": None, "mfu": None,
         "device_kind": getattr(jax.devices()[0], "device_kind", None),
         "batch_size": batch, "seq_len": seq,
+        # the shared state machine's verdict (ok/degraded/wedged/
+        # cpu_fallback) — refreshed again just before the final print
+        "health_state": _health.MONITOR.state,
     }
     if _PROBE_FAIL["reason"]:
         # a fallback fired: say WHICH in the record, so a degraded
@@ -464,6 +404,7 @@ def main() -> int:
         naive_flavor=naive_flavor,
         naive_qps_source=naive_src,
     )
+    result["health_state"] = _health.MONITOR.state
     watch["stage"] = "done"
     print(json.dumps(result))
     return 0

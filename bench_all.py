@@ -13,8 +13,16 @@ import time
 
 
 def _emit(metric, value, unit, **extra):
-    print(json.dumps({"metric": metric, "value": round(value, 2),
-                      "unit": unit, **extra}), flush=True)
+    from tpushare.telemetry import health
+
+    rec = {"metric": metric, "value": round(value, 2), "unit": unit,
+           **extra}
+    if health.MONITOR.state != health.OK:
+        # a fallback/wedge fired somewhere this run: every record says
+        # so, so a degraded sweep artifact explains itself
+        rec["health_state"] = health.MONITOR.state
+        rec["health_reason"] = health.MONITOR.reason
+    print(json.dumps(rec), flush=True)
 
 
 def admit_while_decode_bench(params, cfg, *, slots, n_reqs, prompt_len,
@@ -156,11 +164,11 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    try:
-        platform = jax.devices()[0].platform
-    except RuntimeError:
-        jax.config.update("jax_platforms", "cpu")
-        platform = jax.devices()[0].platform
+    # shared CPU-fallback policy (telemetry/health.py): a failed backend
+    # init pins cpu and marks the health machine CPU_FALLBACK instead of
+    # this file carrying its own try/except copy
+    from tpushare.telemetry import health
+    platform = health.resolve_platform()
     on_tpu = platform == "tpu"
 
     from tpushare.models import bert, transformer

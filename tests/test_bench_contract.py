@@ -24,7 +24,10 @@ def test_bench_emits_single_json_line(tmp_path):
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
-    for key in ("metric", "value", "unit", "vs_baseline"):
+    for key in ("metric", "value", "unit", "vs_baseline", "health_state"):
         assert key in rec, rec
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
+    # pinned-cpu run (no tunnel dial attempted): the shared health
+    # machine reports ok, not cpu_fallback — nothing failed over
+    assert rec["health_state"] == "ok"

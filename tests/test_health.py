@@ -1,0 +1,390 @@
+"""Backend health plane + flight recorder (ISSUE-4 acceptance).
+
+The wedge drill: a fake backend hangs one dispatch past the deadline —
+the state machine transitions OK -> WEDGED, the stall counter
+increments, /healthz flips non-200, a flight-recorder snapshot lands on
+disk containing the stalled dispatch's begin event, and the hung worker
+is never killed (it completes once the fake releases).  Plus: per-phase
+device-time attribution through the real batcher tick flavors, the
+probe loop's abandon-never-kill deadline policy, and the flight
+recorder's ring/snapshot/disabled contracts.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpushare import telemetry
+from tpushare.telemetry import health
+from tpushare.telemetry.events import RECORDER, FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _isolate_monitor():
+    """The monitor and recorder are process-global on purpose; tests
+    must not leak WEDGED state (or a tiny stall deadline) into the rest
+    of the suite."""
+    prior_deadline = health.MONITOR.dispatch_deadline_s
+    yield
+    health.MONITOR.stop_probe_loop()
+    health.MONITOR.dispatch_deadline_s = prior_deadline
+    health.MONITOR.reset()
+    RECORDER.clear()
+    telemetry.set_enabled(True)
+
+
+def _wait_for(cond, timeout=10.0, interval=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ------------------------------------------------------------ state machine
+def test_state_machine_transitions_and_one_hot_gauge():
+    m = health.MONITOR
+    assert m.state == health.OK
+    m.set_state(health.DEGRADED, "probe flaky")
+    assert m.state == health.DEGRADED
+    # one-hot: exactly the current state's series is 1
+    for s in health.STATES:
+        expect = 1.0 if s == health.DEGRADED else 0.0
+        assert health.HEALTH_STATE.value(state=s) == expect
+    assert health.BACKEND_UP.value() == 1.0      # degraded still serves
+    m.set_state(health.WEDGED, "hung")
+    assert health.BACKEND_UP.value() == 0.0
+    # transitions land in the flight recorder
+    kinds = [e["kind"] for e in RECORDER.events()]
+    assert kinds.count("health_transition") >= 2
+
+
+def test_healthz_codes_per_state():
+    m = health.MONITOR
+    assert m.healthz() == (200, "ok\n")
+    m.set_state(health.DEGRADED, "slow probe")
+    code, body = m.healthz()
+    assert code == 200 and body["state"] == "degraded"
+    m.set_state(health.WEDGED, "stalled")
+    code, body = m.healthz()
+    assert code == 503 and body["state"] == "wedged"
+    assert "stalled" in body["reason"]
+    m.reset()
+    assert m.healthz() == (200, "ok\n")
+
+
+def test_cpu_fallback_is_sticky_across_probe_success():
+    m = health.MONITOR
+    m.mark_cpu_fallback("probe deadline; pinned cpu")
+    m.record_probe(True, 0.01)
+    # the ACCELERATOR recovered, but this process still runs on CPU
+    assert m.state == health.CPU_FALLBACK
+    assert health.BACKEND_UP.value() == 0.0
+
+
+def test_probe_results_drive_states():
+    m = health.MONITOR
+    before = health.PROBE_LATENCY.count()
+    m.record_probe(False, 0.5, "transient")
+    assert m.state == health.DEGRADED
+    m.record_probe(False, 10.0, "deadline", timed_out=True)
+    assert m.state == health.WEDGED          # outage signature
+    m.record_probe(True, 0.02)
+    assert m.state == health.OK              # late success recovers
+    assert health.PROBE_LATENCY.count() == before + 3
+
+
+# ---------------------------------------------------------------- probe loop
+def test_probe_loop_deadline_abandons_worker_never_kills():
+    hang = threading.Event()
+    entered = threading.Event()
+
+    def slow_probe():
+        entered.set()
+        hang.wait()          # a hung tunnel fetch
+
+    m = health.MONITOR
+    m.start_probe_loop(probe_fn=slow_probe, interval_s=0.02,
+                       deadline_s=0.15)
+    try:
+        assert _wait_for(lambda: m.state == health.WEDGED)
+        assert entered.is_set()
+        # the worker is still parked in the fake fetch — not killed
+        workers = [t for t in threading.enumerate()
+                   if t.name == "tpushare-health-probe-worker"]
+        assert workers and all(t.is_alive() for t in workers)
+    finally:
+        m.stop_probe_loop()
+        hang.set()           # release; the LATE success must recover
+    assert _wait_for(lambda: m.state == health.OK)
+
+
+def test_default_probe_is_scalar_fetch():
+    # the default probe body runs a real tiny dispatch and scalar-fetch
+    health.jax_scalar_probe()
+
+
+def test_probe_success_cannot_clear_wedge_while_stall_in_flight(
+        tmp_path, monkeypatch):
+    """The tunnel's half-dead mode: small probe RPCs answer while a
+    real dispatch stays hung — a probe success must NOT paint the
+    machine green (the stall record never re-fires)."""
+    monkeypatch.setenv("TPUSHARE_FLIGHT_DIR", str(tmp_path))
+    m = health.MONITOR
+    m.dispatch_deadline_s = 0.2
+    release = threading.Event()
+
+    def hung_dispatch():
+        with m.dispatch_guard("decode"):
+            release.wait()
+
+    t = threading.Thread(target=hung_dispatch, daemon=True)
+    t.start()
+    try:
+        assert _wait_for(lambda: m.state == health.WEDGED)
+        m.record_probe(True, 0.01)
+        assert m.state == health.WEDGED
+        assert "stalled dispatch" in m.reason
+    finally:
+        release.set()
+        t.join(5)
+    assert _wait_for(lambda: m.state != health.WEDGED)
+    m.record_probe(True, 0.01)     # stall gone: now a probe recovers
+    assert m.state == health.OK
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_and_seq():
+    r = FlightRecorder(capacity=4)
+    seqs = [r.record("tick", i=i) for i in range(10)]
+    assert seqs == list(range(1, 11))
+    evs = r.events()
+    assert len(evs) == 4 and [e["i"] for e in evs] == [6, 7, 8, 9]
+    # JSONL round-trips
+    lines = r.to_jsonl().strip().splitlines()
+    assert [json.loads(l)["seq"] for l in lines] == [7, 8, 9, 10]
+
+
+def test_flight_recorder_disabled_is_noop():
+    r = FlightRecorder(capacity=4)
+    telemetry.set_enabled(False)
+    try:
+        assert r.record("nope") == 0
+        assert r.events() == []
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_flight_recorder_snapshot_to_disk(tmp_path):
+    r = FlightRecorder(capacity=8)
+    r.record("admit", rid=1)
+    path = r.snapshot_to(str(tmp_path / "snap.jsonl"), reason="drill")
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["kind"] == "snapshot_header"
+    assert lines[0]["reason"] == "drill"
+    assert any(e["kind"] == "admit" and e.get("rid") == 1 for e in lines)
+
+
+def test_flight_recorder_set_capacity_atomic_with_concurrent_record():
+    """Shrinking/growing the ring while writers hammer it must never
+    lose the deque or raise (lock held around the swap)."""
+    r = FlightRecorder(capacity=256)
+    halt = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not halt.is_set():
+            try:
+                r.record("w", i=i)
+            except Exception as e:       # pragma: no cover
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for cap in (8, 512, 2, 128) * 25:
+            r.set_capacity(cap)
+    finally:
+        halt.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    assert len(r.events()) <= r.capacity
+    r.record("last")
+    assert r.events()[-1]["kind"] == "last"
+
+
+# ----------------------------------------------------------- the wedge drill
+def test_wedge_drill_engine_stall_marks_never_kills(tmp_path, monkeypatch):
+    """ISSUE-4 acceptance: a fake backend hangs one dispatch past the
+    deadline -> OK->WEDGED, stall counter, non-200 /healthz, snapshot on
+    disk with the stalled dispatch's begin event, worker never killed."""
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from tpushare.plugin.status import StatusServer
+    from tpushare.serving import InferenceEngine
+
+    monkeypatch.setenv("TPUSHARE_FLIGHT_DIR", str(tmp_path))
+    m = health.MONITOR
+    m.reset()
+    RECORDER.clear()
+    m.dispatch_deadline_s = 0.3
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hung_backend(tokens):
+        # the FAKE: first trace blocks like a dead-tunnel dispatch,
+        # until the test releases it — a kill would strand `release`
+        entered.set()
+        release.wait()
+        return tokens.astype("float32")
+
+    eng = InferenceEngine(hung_backend, batch_size=2, seq_len=4,
+                          max_wait_ms=1.0)
+    srv = StatusServer(0).start()
+    stalls_before = health.DISPATCH_STALLS.value()
+    eng.start()
+    try:
+        sink = eng.submit(np.arange(4, dtype=np.int32))
+        assert _wait_for(entered.is_set, timeout=10)
+        assert m.state == health.OK        # in flight, not yet late
+        assert _wait_for(lambda: m.state == health.WEDGED, timeout=10)
+
+        # counter + /healthz flip
+        assert health.DISPATCH_STALLS.value() == stalls_before + 1
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5)
+            raise AssertionError("/healthz stayed 200 while WEDGED")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read().decode())["state"] == "wedged"
+
+        # snapshot landed on disk, containing the stalled dispatch's
+        # begin event (the stall event points back at it by seq)
+        snap = m.last_snapshot_path
+        assert snap is not None and snap.startswith(str(tmp_path))
+        lines = [json.loads(l) for l in open(snap)]
+        stall = next(e for e in lines if e["kind"] == "dispatch_stall")
+        begin = next(e for e in lines if e["kind"] == "dispatch_begin"
+                     and e["seq"] == stall["begin_seq"])
+        assert begin["phase"] == stall["phase"]
+
+        # the hung worker was marked, never killed
+        assert eng._worker.is_alive()
+        release.set()
+        out = sink.get(timeout=30)
+        assert out is not None             # the dispatch COMPLETED
+        # recovery: the returned stall downgrades WEDGED -> DEGRADED
+        assert _wait_for(lambda: m.state != health.WEDGED, timeout=10)
+        assert m.state in (health.DEGRADED, health.OK)
+    finally:
+        release.set()
+        eng.stop()
+        srv.stop()
+
+
+def test_debug_events_endpoint_serves_jsonl():
+    import urllib.request
+
+    from tpushare.plugin.status import StatusServer
+
+    RECORDER.record("admit", rid=42, prompt_len=3)
+    srv = StatusServer(0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/events",
+                timeout=5) as r:
+            assert r.headers.get("Content-Type").startswith(
+                "application/x-ndjson")
+            events = [json.loads(l) for l in r.read().decode().splitlines()]
+    finally:
+        srv.stop()
+    assert any(e["kind"] == "admit" and e.get("rid") == 42 for e in events)
+
+
+# ------------------------------------------------- device-time attribution
+def test_device_time_attribution_per_phase_and_goodput_gauge():
+    """prefill/decode/mixed all populate tpushare_device_time_seconds,
+    and the goodput gauge derives from exactly those sums."""
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving.continuous import ContinuousBatcher
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    before = {p: health.DEVICE_TIME.count(phase=p)
+              for p in health.PHASES}
+
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    assert b.admit([1, 2, 3], 3) is not None            # prefill
+    b.tick()                                            # decode (single)
+    assert b.admit_chunked([4, 5, 6, 7], 3, chunk=2) is not None
+    while b.prefilling or b.slots:
+        b.tick_mixed(2, chunk=2, budget=4)              # mixed rounds
+
+    assert health.DEVICE_TIME.count(phase="prefill") > before["prefill"]
+    assert health.DEVICE_TIME.count(phase="decode") > before["decode"]
+    assert health.DEVICE_TIME.count(phase="mixed") > before["mixed"]
+
+    util = health.refresh_device_utilization()
+    assert util is not None and 0.0 < util <= 1.0
+    assert health.DEVICE_UTILIZATION.value() == util
+    # strictly derived: the gauge equals the histogram-sum derivation
+    busy = sum(health.DEVICE_TIME.sum(phase=p) for p in health.PHASES)
+    now = time.monotonic()
+    rederived = min(1.0, busy / (now - health._UTIL_T0))
+    assert abs(util - rederived) < 0.05
+
+    # flight recorder saw the admissions (forensics trail)
+    kinds = [e["kind"] for e in RECORDER.events()]
+    assert "admit" in kinds
+
+
+def test_dispatch_guard_disabled_is_single_flag_check():
+    before_count = health.DEVICE_TIME.count(phase="decode")
+    RECORDER.clear()
+    telemetry.set_enabled(False)
+    try:
+        g1 = health.MONITOR.dispatch_guard("decode")
+        g2 = health.MONITOR.dispatch_guard("mixed", steps=4)
+        assert g1 is g2                     # the shared no-op context
+        with g1:
+            pass
+        assert RECORDER.events() == []
+        assert health.DEVICE_TIME.count(phase="decode") == before_count
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_rpc_overhead_subtraction(monkeypatch):
+    monkeypatch.setenv(health.RPC_OVERHEAD_ENV, "70")
+    health.reset_rpc_overhead_cache()   # memoized (hot-path cost)
+    try:
+        assert health.rpc_overhead_s() == pytest.approx(0.070)
+        before_sum = health.DEVICE_TIME.sum(phase="decode")
+        with health.MONITOR.dispatch_guard("decode"):
+            time.sleep(0.01)  # wall ~10ms < 70ms overhead -> clamps to 0
+        assert health.DEVICE_TIME.sum(phase="decode") == \
+            pytest.approx(before_sum, abs=1e-6)
+        monkeypatch.setenv(health.RPC_OVERHEAD_ENV, "0")
+        health.reset_rpc_overhead_cache()
+        with health.MONITOR.dispatch_guard("decode"):
+            time.sleep(0.01)
+        assert health.DEVICE_TIME.sum(phase="decode") >= \
+            before_sum + 0.009
+    finally:
+        monkeypatch.delenv(health.RPC_OVERHEAD_ENV)
+        health.reset_rpc_overhead_cache()

@@ -66,7 +66,10 @@ def _run_inspect(monkeypatch, api, argv):
 
 
 def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
+    from tpushare.telemetry import health
+
     _seed_serving_metrics()
+    health.MONITOR.reset()              # deterministic one-hot: OK
     srv = StatusServer(0).start()       # serves the seeded registry
     api = FakeApiServer().start()
     try:
@@ -78,6 +81,7 @@ def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
         # binpack view still leads; the metrics table rides next to it
         assert "TPU0(Allocated/Total)" in out
         assert "Serving metrics:" in out
+        assert "HEALTH" in out and "OK" in out    # health plane column
         assert "QPS" in out and "123.45" in out
         assert "TTFT p50(ms)" in out and "TTFT p99(ms)" in out
         assert "75%" in out                       # occupancy
@@ -85,6 +89,52 @@ def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
         assert "PREFILL Q" in out and "BUDGET%" in out
         assert "62%" in out                       # mixed budget utilization
     finally:
+        api.stop()
+        srv.stop()
+
+
+def test_inspect_metrics_dead_port_renders_down_row(monkeypatch, capsys):
+    """ISSUE-4 satellite e2e: one node with a LIVE endpoint, one whose
+    port refuses the connection — the dead node renders a DOWN row
+    instead of raising, and the live node still summarizes."""
+    from tpushare.telemetry import health
+
+    _seed_serving_metrics()
+    health.MONITOR.set_state(health.WEDGED, "drill")
+    srv = StatusServer(0).start()
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-live"] = make_node("node-live", ip="127.0.0.1")
+        api.nodes["node-dead"] = make_node("node-dead", ip="203.0.113.9")
+        # live node fetches for real; the dead node's address fails
+        # fast with a refused-style OSError (no TEST-NET timeout wait)
+        monkeypatch.setattr(metricsview, "fetch_node_metrics",
+                            _fetch_local_only(srv.port))
+        rc = _run_inspect(monkeypatch, api,
+                          ["--metrics", "--metrics-port", str(srv.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        serving = out.split("Serving metrics:", 1)[1]
+        live_row = next(l for l in serving.splitlines()
+                        if "node-live" in l)
+        dead_row = next(l for l in serving.splitlines()
+                        if "node-dead" in l)
+        # the live node's health state rides the exposition end to end
+        assert "WEDGED" in live_row
+        assert "DOWN" in dead_row and "123.45" not in dead_row
+
+        # json mode: the health key is uniform across live and dead
+        rc = _run_inspect(monkeypatch, api,
+                          ["-o", "json", "--metrics",
+                           "--metrics-port", str(srv.port)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        by_name = {n["name"]: n for n in doc["nodes"]}
+        assert by_name["node-live"]["serving"]["health"] == "wedged"
+        dead = by_name["node-dead"]["serving"]
+        assert dead["health"] == "down" and "error" in dead
+    finally:
+        health.MONITOR.reset()
         api.stop()
         srv.stop()
 

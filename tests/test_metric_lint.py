@@ -34,6 +34,7 @@ def _registered():
     import tpushare.plugin.allocate  # noqa: F401
     import tpushare.plugin.status  # noqa: F401
     import tpushare.serving.metrics  # noqa: F401
+    import tpushare.telemetry.health  # noqa: F401
     from tpushare import telemetry
 
     return telemetry.REGISTRY.describe()
@@ -116,3 +117,31 @@ def test_every_metric_has_help_text():
     for name, _, help_text in _registered():
         assert help_text and help_text != name, \
             f"{name} needs real HELP text"
+
+
+def test_health_plane_series_registered_with_contracted_names():
+    """The backend health plane's series exist under their contracted
+    names and kinds (what /healthz dashboards, the kubelet probe
+    runbook, and inspect --metrics key on)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_backend_up") == "gauge"
+    assert by_name.get("tpushare_backend_health_state") == "gauge"
+    assert by_name.get("tpushare_probe_latency_seconds") == "histogram"
+    assert by_name.get("tpushare_dispatch_stalls_total") == "counter"
+    assert by_name.get("tpushare_device_time_seconds") == "histogram"
+    assert by_name.get("tpushare_device_utilization") == "gauge"
+
+
+def test_health_state_renders_one_hot():
+    """Set + render + strict-parse round trip: exactly one state series
+    carries 1 at any time (the state-machine exposition idiom)."""
+    from tpushare import telemetry
+    from tpushare.telemetry import health
+
+    health.MONITOR.reset()
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+    samples = parsed["samples"]["tpushare_backend_health_state"]
+    states = {l["state"]: v for l, v in samples}
+    assert set(states) == set(health.STATES)
+    assert sum(states.values()) == 1.0
+    assert states["ok"] == 1.0

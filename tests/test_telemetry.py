@@ -155,6 +155,45 @@ def test_disabled_path_is_noop():
 
 
 # ------------------------------------------------------------------ tracer
+def test_tracer_set_capacity_atomic_with_concurrent_emit():
+    """Regression (ISSUE-4 satellite): shrinking the ring while spans
+    emit from other threads must lose neither the deque nor events
+    recorded after the swap — the lock is held around the swap, so
+    every _emit lands in exactly one of old/new."""
+    tr = Tracer(capacity=512)
+    halt = threading.Event()
+    errors = []
+
+    def emitter():
+        i = 0
+        while not halt.is_set():
+            try:
+                with tr.span("w", cat="t", i=i):
+                    pass
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=emitter) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for cap in (8, 1024, 2, 256) * 25:
+            tr.set_capacity(cap)
+            assert len(tr.events()) <= cap
+    finally:
+        halt.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    # still functional after the churn: new spans land and the bound holds
+    tr.clear()
+    with tr.span("after"):
+        pass
+    assert [e["name"] for e in tr.events()] == ["after"]
+
+
 def test_trace_buffer_wraparound():
     tr = Tracer(capacity=8)
     for i in range(20):

@@ -112,8 +112,10 @@ def main(argv=None) -> int:
                 "hbm_usage": info.usage_reports(),
             })
         if metrics_rows is not None:
+            # dead endpoints carry an explicit health key so json
+            # consumers read node["serving"]["health"] uniformly
             by_name = {name: (summary if summary is not None
-                              else {"error": err})
+                              else {"error": err, "health": "down"})
                        for name, _, summary, err in metrics_rows}
             for entry in out["nodes"]:
                 if entry["name"] in by_name:

@@ -89,6 +89,17 @@ def _hist_quantile(parsed: dict, base: str, q: float) -> Optional[float]:
     return quantile_from_buckets(bounds, cum, q)
 
 
+def _health_state(parsed: dict) -> Optional[str]:
+    """Current backend health state from the one-hot
+    ``tpushare_backend_health_state{state=...}`` family (None when the
+    node exposes no health plane — e.g. an older daemon)."""
+    for labels, value in parsed["samples"].get(
+            "tpushare_backend_health_state", ()):
+        if value and "state" in labels:
+            return labels["state"]
+    return None
+
+
 def summarize_serving(parsed: dict) -> dict:
     """The serving stats one node's exposition distills to (None for
     series the node has not recorded)."""
@@ -98,6 +109,12 @@ def summarize_serving(parsed: dict) -> dict:
     if used is not None and free is not None and used + free > 0:
         kv_util = used / (used + free)
     return {
+        # backend health plane: the state machine plus the live
+        # goodput gauge derived from the device-time histograms
+        "health": _health_state(parsed),
+        "backend_up": _gauge(parsed, "tpushare_backend_up"),
+        "device_utilization": _gauge(parsed,
+                                     "tpushare_device_utilization"),
         "qps": _gauge(parsed, "tpushare_engine_qps"),
         "ttft_p50_s": _hist_quantile(
             parsed, "tpushare_engine_ttft_seconds", 0.5),
@@ -141,13 +158,15 @@ def _fmt_bytes(v: Optional[float]) -> str:
 
 def render_metrics_table(
         rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
-    """``rows`` = [(node, address, summary|None, error|None)]."""
-    table = [["NAME", "IPADDRESS", "QPS", "TTFT p50(ms)", "TTFT p99(ms)",
-              "OCCUPANCY", "KV PAGES(used/free)", "KV BYTES(dtype)",
-              "PREFILL Q", "BUDGET%"]]
+    """``rows`` = [(node, address, summary|None, error|None)].  A node
+    whose every endpoint refused/failed renders a ``DOWN`` row (the
+    anomaly this view exists to surface) instead of raising."""
+    table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
+              "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
+              "KV BYTES(dtype)", "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
-            table.append([name, addr, err or "unreachable",
+            table.append([name, addr, "DOWN", err or "unreachable",
                           "-", "-", "-", "-", "-", "-", "-"])
             continue
         kv = "-"
@@ -159,8 +178,9 @@ def render_metrics_table(
         kv_bytes = _fmt_bytes(summary.get("kv_cache_bytes"))
         if summary.get("kv_dtype"):
             kv_bytes += f" ({summary['kv_dtype']})"
+        health = (summary.get("health") or "-").upper()
         table.append([
-            name, addr,
+            name, addr, health,
             _fmt(summary["qps"]),
             _fmt(summary["ttft_p50_s"], 1000.0),
             _fmt(summary["ttft_p99_s"], 1000.0),

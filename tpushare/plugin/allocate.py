@@ -211,6 +211,9 @@ def make_allocator(pod_manager):
                 log.warning("no assumed pod matches request of %d %s "
                             "(candidates: %d)", pod_req, plugin.memory_unit,
                             len(candidates))
+                telemetry.recorder.record(
+                    "hbm_refusal", units=pod_req,
+                    unit=plugin.memory_unit, candidates=len(candidates))
                 return failure_response(request, pod_req, plugin.memory_unit)
 
             isolation_off = pod_manager.isolation_disabled()
@@ -250,6 +253,9 @@ def make_allocator(pod_manager):
                     core_exclusive=exclusive))
             from . import status
             status.inc("tpushare_allocations_total")
+            telemetry.recorder.record(
+                "hbm_grant", units=pod_req, unit=plugin.memory_unit,
+                chip=chip.index, core=core, cotenants=cotenants)
             return resp
 
     def timed_allocator(plugin, request: "pb.AllocateRequest"

@@ -7,9 +7,13 @@ endpoint: ``/metrics`` renders the process-global telemetry registry
 (:mod:`tpushare.telemetry`) in the Prometheus text format (HELP/TYPE
 per family, content type ``text/plain; version=0.0.4``),
 ``/debug/trace`` dumps the ring-buffer tracer as Chrome trace-event
-JSON, and ``/debug/stacks`` serves the SIGQUIT dump.  Binds loopback by
-default — the debug endpoints have no auth and the daemon runs
-hostNetwork, so node-wide exposure must be an explicit choice.
+JSON, ``/debug/events`` dumps the structured flight recorder as JSONL,
+and ``/debug/stacks`` serves the SIGQUIT dump.  ``/healthz`` answers
+from the shared backend health monitor (non-200 exactly when WEDGED) —
+on BOTH listeners, so the deploy manifest's kubelet liveness probe can
+hit the node-wide scrape port.  Binds loopback by default — the debug
+endpoints have no auth and the daemon runs hostNetwork, so node-wide
+exposure must be an explicit choice.
 """
 
 from __future__ import annotations
@@ -18,6 +22,8 @@ import threading
 import time
 
 from .. import telemetry
+from ..telemetry.events import RECORDER
+from ..telemetry.health import healthz_route
 from ..utils import stackdump
 from ..utils.httpserver import JsonHTTPServer, RawBody
 
@@ -106,13 +112,16 @@ class StatusServer:
         self.usage_max = 64
         self._render_lock = threading.Lock()
         self._http = JsonHTTPServer(port, addr, routes={
-            ("GET", "/healthz"): lambda _: (200, "ok\n"),
+            ("GET", "/healthz"): healthz_route,
             ("GET", "/metrics"): lambda _: (
                 200, RawBody(self.render_metrics(),
                              telemetry.PROM_CONTENT_TYPE)),
             ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
             ("GET", "/debug/trace"): lambda _: (
                 200, telemetry.tracer.to_chrome()),
+            ("GET", "/debug/events"): lambda _: (
+                200, RawBody(RECORDER.to_jsonl(),
+                             "application/x-ndjson")),
             ("POST", "/usage"): self._ingest_usage,
         })
         self.port = self._http.port
@@ -120,7 +129,9 @@ class StatusServer:
         self.metrics_port = None
         if metrics_port is not None:
             self._public = JsonHTTPServer(metrics_port, metrics_addr, routes={
-                ("GET", "/healthz"): lambda _: (200, "ok\n"),
+                # /healthz here too: this is the only listener a
+                # kubelet probe can reach (the full surface is loopback)
+                ("GET", "/healthz"): healthz_route,
                 ("GET", "/metrics"): lambda _: (
                     200, RawBody(self.render_metrics(),
                                  telemetry.PROM_CONTENT_TYPE)),
@@ -159,6 +170,10 @@ class StatusServer:
         grant, peak = rec.get("grant_bytes"), rec.get("peak_bytes")
         if grant and peak and peak > grant:
             inc("tpushare_hbm_overshoot_total")
+            # advisory-isolation forensics: a tenant exceeding its HBM
+            # grant is front-page material for a WEDGED post-mortem
+            RECORDER.record("hbm_overshoot", pod=rec["pod"],
+                            grant_bytes=grant, peak_bytes=peak)
         if self.on_usage is not None:
             try:
                 self.on_usage(reports)

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import health
+from ..telemetry.events import RECORDER
 from ..models import transformer
 from . import metrics
 
@@ -455,6 +457,11 @@ class ContinuousBatcher:
         metrics.TICK_DURATION.observe(time.perf_counter() - t0)
         metrics.OCCUPANCY.set(
             len(self.slots) / self.n_slots if self.n_slots else 0.0)
+        # per-tick, not per-guard: re-deriving the goodput gauge costs a
+        # few histogram-sum locks, too much for the per-dispatch hot
+        # path but free at tick granularity (and /metrics re-derives at
+        # scrape time anyway)
+        health.refresh_device_utilization()
 
     def _complete(self, rid: int, output: List[int]) -> None:
         """The ONE completion bookkeeping site (every tick flavor and the
@@ -590,19 +597,30 @@ class ContinuousBatcher:
         self.validate_sampling(top_k, top_p)
         free = self.free_slots()
         if not free:
+            RECORDER.record("admit_refused", reason="no_free_slot",
+                            prompt_len=len(prompt))
             return None
         slot = free[0]
         if not self._reserve(slot, len(prompt), max_new_tokens,
                              prompt=prompt):
+            # storage backpressure: the pool's HBM budget said no — the
+            # refusal event is the serving-plane grant/refusal record
+            RECORDER.record("admit_refused", reason="storage",
+                            prompt_len=len(prompt))
             return None
         rid = self._next_id
         self._next_id += 1
         metrics.ADMISSIONS.inc()
+        RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
+                        max_new=max_new_tokens)
 
         tokens = jnp.asarray([prompt], jnp.int32)
-        logits_v = self._prefill_into(slot, tokens, len(prompt))
-        self._activate(slot, rid, list(prompt), logits_v, max_new_tokens,
-                       temperature, seed, eos_id, top_k, top_p)
+        with health.MONITOR.dispatch_guard("prefill",
+                                           tokens=len(prompt)):
+            logits_v = self._prefill_into(slot, tokens, len(prompt))
+            self._activate(slot, rid, list(prompt), logits_v,
+                           max_new_tokens, temperature, seed, eos_id,
+                           top_k, top_p)
         return rid
 
     def _activate(self, slot: int, rid: int, prompt: List[int], logits_v,
@@ -666,14 +684,20 @@ class ContinuousBatcher:
             raise ValueError("chunk must be >= 1")
         free = self.free_slots()
         if not free:
+            RECORDER.record("admit_refused", reason="no_free_slot",
+                            prompt_len=len(prompt))
             return None
         slot = free[0]
         if not self._reserve(slot, len(prompt), max_new_tokens,
                              prompt=prompt):
+            RECORDER.record("admit_refused", reason="storage",
+                            prompt_len=len(prompt))
             return None
         rid = self._next_id
         self._next_id += 1
         metrics.ADMISSIONS.inc()
+        RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
+                        max_new=max_new_tokens, chunked=True)
         self.prefilling[slot] = _Prefill(
             request_id=rid, prompt=list(prompt),
             pos=self._prefill_start(slot),
@@ -719,14 +743,22 @@ class ContinuousBatcher:
         piece = st.prompt[st.pos:end]
         padded = np.zeros((1, window), np.int32)
         padded[0, :len(piece)] = piece
-        logits_v = self._prefill_chunk_into(
-            slot, padded, st.pos, len(piece) - 1, window)
-        st.pos = end
-        if end >= n:
-            del self.prefilling[slot]
-            self._activate(slot, st.request_id, st.prompt, logits_v,
-                           st.max_new, st.temperature, st.seed,
-                           st.eos_id, st.top_k, st.top_p)
+        # one guarded window per chunk, but only the FINAL chunk's
+        # _activate fetch is a sync point — mid-prompt chunks dispatch
+        # async (near-zero wall, intentionally pipelined), so they
+        # stall-watch without observing, or the prefill device-time
+        # histogram would fill with ~0 samples
+        final = end >= n
+        with health.MONITOR.dispatch_guard("prefill", observe=final,
+                                           tokens=len(piece)):
+            logits_v = self._prefill_chunk_into(
+                slot, padded, st.pos, len(piece) - 1, window)
+            st.pos = end
+            if end >= n:
+                del self.prefilling[slot]
+                self._activate(slot, st.request_id, st.prompt, logits_v,
+                               st.max_new, st.temperature, st.seed,
+                               st.eos_id, st.top_k, st.top_p)
 
     def advance_prefill(self, max_slots: Optional[int] = None) -> int:
         """Process one chunk for mid-prefill slots — every slot by
@@ -783,8 +815,10 @@ class ContinuousBatcher:
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
-        with telemetry.span("batcher.tick", cat="serving",
-                            active=len(self.slots)):
+        with health.MONITOR.dispatch_guard("decode",
+                                           active=len(self.slots)), \
+                telemetry.span("batcher.tick", cat="serving",
+                               active=len(self.slots)):
             nxt = np.asarray(self._step(
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
@@ -830,16 +864,22 @@ class ContinuousBatcher:
         incs = np.zeros((self.n_slots,), np.int32)
         for i in self.slots:
             incs[i] = 1
-        with telemetry.span("batcher.tick_fused", cat="serving",
-                            active=len(self.slots), steps=n_steps):
-            toks, new_keys = self._step_n(
-                jnp.asarray(tokens), jnp.asarray(lengths),
-                jnp.asarray(temps),
-                _wrap_keys(jnp.asarray(keys)),
-                jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
-                self._rich(), n_steps)
-        toks = np.asarray(toks)
-        new_keys = np.asarray(jax.random.key_data(new_keys))
+        # the guard spans dispatch AND the host fetches below — the
+        # fetch is the true barrier, so this is the window that hangs
+        # on a dead tunnel and the window device time is measured over
+        with health.MONITOR.dispatch_guard("decode",
+                                           active=len(self.slots),
+                                           steps=n_steps):
+            with telemetry.span("batcher.tick_fused", cat="serving",
+                                active=len(self.slots), steps=n_steps):
+                toks, new_keys = self._step_n(
+                    jnp.asarray(tokens), jnp.asarray(lengths),
+                    jnp.asarray(temps),
+                    _wrap_keys(jnp.asarray(keys)),
+                    jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
+                    self._rich(), n_steps)
+            toks = np.asarray(toks)
+            new_keys = np.asarray(jax.random.key_data(new_keys))
         n_active = len(self.slots)
         self._drain_fused_tokens(toks, new_keys, n_steps)
         self._observe_tick(t0)
@@ -992,24 +1032,31 @@ class ContinuousBatcher:
         incs = np.zeros((self.n_slots,), np.int32)
         for i in self.slots:
             incs[i] = 1
-        with telemetry.span("batcher.tick_mixed", cat="serving",
-                            active=len(self.slots), prefilling=len(plan),
-                            steps=n_steps):
-            sel, toks, new_keys = self._step_mixed(
-                p_tokens, p_slots, p_active, p_pos, p_last,
-                jnp.asarray(tokens), jnp.asarray(lengths),
-                jnp.asarray(temps),
-                _wrap_keys(jnp.asarray(keys)),
-                jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
-                self._rich(), C, n_steps)
-        # Host fetches are the real sync points (CLAUDE.md): fetch ONLY
-        # what this round consumes, so pure-prefill rounds with no
-        # completions stay fully async and pipeline like sequential
-        # chunk dispatches do.
-        n_active = len(self.slots)
+        # guard spans the one dispatch plus this round's lazy fetches —
+        # the measured wall of the mixed round, phase-labeled "mixed"
+        with health.MONITOR.dispatch_guard("mixed",
+                                           active=len(self.slots),
+                                           prefilling=len(plan),
+                                           steps=n_steps):
+            with telemetry.span("batcher.tick_mixed", cat="serving",
+                                active=len(self.slots),
+                                prefilling=len(plan), steps=n_steps):
+                sel, toks, new_keys = self._step_mixed(
+                    p_tokens, p_slots, p_active, p_pos, p_last,
+                    jnp.asarray(tokens), jnp.asarray(lengths),
+                    jnp.asarray(temps),
+                    _wrap_keys(jnp.asarray(keys)),
+                    jnp.asarray(tks), jnp.asarray(tps),
+                    jnp.asarray(incs), self._rich(), C, n_steps)
+            # Host fetches are the real sync points (CLAUDE.md): fetch
+            # ONLY what this round consumes, so pure-prefill rounds
+            # with no completions stay fully async and pipeline like
+            # sequential chunk dispatches do.
+            n_active = len(self.slots)
+            if n_active:
+                toks = np.asarray(toks)
+                new_keys = np.asarray(jax.random.key_data(new_keys))
         if n_active:
-            toks = np.asarray(toks)
-            new_keys = np.asarray(jax.random.key_data(new_keys))
             self._drain_fused_tokens(toks, new_keys, n_steps)
         # Activate rows whose chunk completed the prompt — they join the
         # NEXT round's scan (the host-side half of advance_prefill's
@@ -1110,16 +1157,21 @@ class ContinuousBatcher:
             next_toks[i] = s.last_token
             remainings[i] = s.remaining
             actives[i] = 1
-        bufs_j, buf_lens_j, n_ctxs_j, next_toks_j, produced, self.caches = \
-            _tick_spec(self.params, jnp.asarray(bufs), self.caches,
-                       jnp.asarray(buf_lens), jnp.asarray(n_ctxs),
-                       jnp.asarray(next_toks), jnp.asarray(remainings),
-                       jnp.asarray(actives).astype(bool), self.cfg,
-                       k, ngram, n_rounds)
-        bufs_h = np.asarray(bufs_j)
-        produced = np.asarray(produced)
-        n_ctxs_h = np.asarray(n_ctxs_j)
-        next_h = np.asarray(next_toks_j)
+        with health.MONITOR.dispatch_guard("decode",
+                                           active=len(self.slots),
+                                           spec_rounds=n_rounds):
+            bufs_j, buf_lens_j, n_ctxs_j, next_toks_j, produced, \
+                self.caches = \
+                _tick_spec(self.params, jnp.asarray(bufs), self.caches,
+                           jnp.asarray(buf_lens), jnp.asarray(n_ctxs),
+                           jnp.asarray(next_toks),
+                           jnp.asarray(remainings),
+                           jnp.asarray(actives).astype(bool), self.cfg,
+                           k, ngram, n_rounds)
+            bufs_h = np.asarray(bufs_j)
+            produced = np.asarray(produced)
+            n_ctxs_h = np.asarray(n_ctxs_j)
+            next_h = np.asarray(next_toks_j)
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import health
 from . import metrics
 
 
@@ -75,11 +76,16 @@ class InferenceEngine:
         chip has multi-ms dispatch latency that would otherwise dominate
         sub-10ms forwards)."""
         metrics.BATCHES.inc()
-        if self.pass_mask:
-            if mask is None:
-                mask = np.ones_like(tokens, dtype=np.int32)
-            return self.fn(jnp.asarray(tokens), jnp.asarray(mask))
-        return self.fn(jnp.asarray(tokens))
+        # observe=False: dispatch is async (near-zero wall) — device
+        # time is attributed at the fetch; the guard exists because a
+        # dispatch that BLOCKS (tracing/compiling against a dead
+        # backend) must still trip the stall watchdog
+        with health.MONITOR.dispatch_guard("prefill", observe=False):
+            if self.pass_mask:
+                if mask is None:
+                    mask = np.ones_like(tokens, dtype=np.int32)
+                return self.fn(jnp.asarray(tokens), jnp.asarray(mask))
+            return self.fn(jnp.asarray(tokens))
 
     def warmup(self):
         dummy = np.zeros((self.batch_size, self.seq_len), dtype=np.int32)
@@ -131,8 +137,13 @@ class InferenceEngine:
             # host fetch, not block_until_ready (unreliable on remote
             # backends): executions are in-order per device, so pulling
             # this batch's outputs drains everything dispatched before
-            with telemetry.span("engine.deliver", cat="serving",
-                                requests=len(b)):
+            # the stall-watchdog guard brackets the fetch (the one call
+            # that hangs on a dead tunnel) and attributes device time:
+            # an encoder forward is a full-context pass, phase=prefill
+            with health.MONITOR.dispatch_guard("prefill",
+                                               requests=len(b)), \
+                    telemetry.span("engine.deliver", cat="serving",
+                                   requests=len(b)):
                 host = np.asarray(outputs)
             now = time.perf_counter()
             for i, (toks, out_q, t_sub) in enumerate(b):
@@ -183,6 +194,7 @@ class InferenceEngine:
             metrics.BATCH_FILL.set(len(batch) / self.batch_size)
             with telemetry.span("engine.dispatch", cat="serving",
                                 requests=len(batch)):
+                # infer_async carries its own stall guard
                 inflight.append((self.infer_async(tokens, mask), batch))
             if len(inflight) >= self.pipeline_depth:
                 deliver_oldest()
@@ -209,9 +221,12 @@ def measure_qps(engine: InferenceEngine, n_batches: int = 20,
         # in-order per device, so host-fetching ONE element of a result
         # forces completion of everything dispatched before it (the
         # [0,...] index is computed on device; only a scalar crosses
-        # the wire).
-        leaf = jax.tree_util.tree_leaves(result)[0]
-        float(leaf[(0,) * leaf.ndim])
+        # the wire).  The stall guard brackets the fetch — the call
+        # that hangs on a dead tunnel — and attributes the drained
+        # pipeline's device time (phase=prefill: encoder forwards).
+        with health.MONITOR.dispatch_guard("prefill"):
+            leaf = jax.tree_util.tree_leaves(result)[0]
+            float(leaf[(0,) * leaf.ndim])
 
     tokens = np.random.randint(
         1, 100, size=(engine.batch_size, engine.seq_len), dtype=np.int32)
@@ -239,6 +254,7 @@ def measure_qps(engine: InferenceEngine, n_batches: int = 20,
     # telemetry lands AFTER the clock stops: the timed loop itself adds
     # only the per-dispatch counter inc (the <2% overhead budget)
     metrics.QPS.set(queries / dt)
+    health.refresh_device_utilization()
     telemetry.tracer.instant("engine.measure_qps", cat="serving",
                              qps=round(queries / dt, 2),
                              batches=n_batches)

@@ -92,6 +92,7 @@ class LLMServer:
         ``mixed_step=False`` restores the sequential advance-then-fuse
         interleave."""
         from .. import telemetry
+        from ..telemetry.health import healthz_route
         from ..utils.httpserver import JsonHTTPServer, RawBody
 
         self.cfg = cfg
@@ -130,7 +131,9 @@ class LLMServer:
             ("POST", "/generate"): self._generate,
             ("POST", "/generate_stream"): self._generate_stream,
             ("POST", "/score"): self._score,
-            ("GET", "/healthz"): lambda _: (200, "ok\n"),
+            # health-plane view: non-200 exactly when the backend is
+            # WEDGED (a stalled dispatch past deadline / failed probe)
+            ("GET", "/healthz"): healthz_route,
             ("GET", "/stats"): self._stats,
             # workload-side telemetry: the serving-plane series this
             # process recorded (engine/batcher/paged/spec), Prometheus
@@ -139,6 +142,9 @@ class LLMServer:
             ("GET", "/metrics"): self._metrics,
             ("GET", "/debug/trace"): lambda _: (
                 200, telemetry.tracer.to_chrome()),
+            ("GET", "/debug/events"): lambda _: (
+                200, RawBody(telemetry.recorder.to_jsonl(),
+                             "application/x-ndjson")),
         })
         self.port = self._http.port
 
@@ -448,8 +454,12 @@ class LLMServer:
 
     def _metrics(self, _):
         from .. import telemetry
+        from ..telemetry import health
         from ..utils.httpserver import RawBody
         self._refresh_qps()
+        # scrape-time derivation: the goodput gauge always reflects the
+        # device-time histograms as of THIS exposition
+        health.refresh_device_utilization()
         return 200, RawBody(telemetry.REGISTRY.render(),
                             telemetry.PROM_CONTENT_TYPE)
 
@@ -563,6 +573,23 @@ def main(argv=None) -> int:
     cfg, params = build_model(args.model, args.int8,
                               quantize_int4=args.int4,
                               kv_dtype=args.kv_dtype)
+    # Health plane: on a tunnel-attached backend, run the low-frequency
+    # probe loop (tiny dispatch + scalar fetch with a deadline — the
+    # true barrier) so /healthz reflects the tunnel, not hope.  A
+    # local backend cannot wedge this way; the dispatch watchdog alone
+    # covers it without burning probe dispatches.
+    import os as _os
+
+    from ..telemetry import health as _health
+    if _os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # deadline covers the FIRST probe's remote_compile (~20-140 s
+        # for bf16 through the tunnel, CLAUDE.md) — a tighter deadline
+        # would mark a healthy warming server WEDGED on its first probe
+        _health.MONITOR.start_probe_loop(
+            interval_s=float(_os.environ.get(
+                "TPUSHARE_PROBE_INTERVAL_S", "60")),
+            deadline_s=float(_os.environ.get(
+                "TPUSHARE_PROBE_DEADLINE_S", "180")))
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp,

@@ -15,6 +15,10 @@ the other way around).
 from __future__ import annotations
 
 from .. import telemetry
+# Backend-attribution series live with the health plane (stdlib layer,
+# shared with bench and the daemon); re-exported here so serving code
+# keeps one metrics namespace to import from.
+from ..telemetry.health import DEVICE_TIME, DEVICE_UTILIZATION  # noqa: F401
 
 # -- request-level latency (engine micro-batcher AND continuous service) --
 REQUEST_LATENCY = telemetry.histogram(

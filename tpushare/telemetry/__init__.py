@@ -1,10 +1,14 @@
-"""Unified telemetry plane: metrics registry + request tracing.
+"""Unified telemetry plane: metrics, tracing, health, flight recorder.
 
 One process-global :data:`REGISTRY` (counters / gauges / fixed-bucket
-histograms, Prometheus text rendering) and one process-global
-:data:`tracer` (bounded ring buffer of Chrome trace events).  Both
-planes instrument against these; the daemon's ``/metrics`` and
-``/debug/trace`` and the LLM server's same-named endpoints serve them.
+histograms, Prometheus text rendering), one process-global
+:data:`tracer` (bounded ring buffer of Chrome trace events), one
+process-global flight :data:`recorder` (bounded ring of structured
+forensics events, dumped at ``/debug/events`` and snapshotted to disk
+on a WEDGED transition), and one backend health :data:`monitor`
+(OK/DEGRADED/WEDGED/CPU_FALLBACK state machine + probe loop + dispatch
+stall watchdog, served at ``/healthz``).  Both planes instrument
+against these; the daemon's and the LLM server's endpoints serve them.
 
 ``set_enabled(False)`` turns every instrumentation site into a single
 flag check (the near-free disabled path the overhead test pins down).
@@ -21,6 +25,10 @@ from .registry import (DEFAULT_LATENCY_BUCKETS, PROM_CONTENT_TYPE,  # noqa: F401
                        quantile_from_buckets, set_enabled)
 from .trace import TRACER as tracer  # noqa: F401
 from .trace import Tracer  # noqa: F401
+from .events import RECORDER as recorder  # noqa: F401
+from .events import FlightRecorder  # noqa: F401
+from . import health  # noqa: F401
+from .health import MONITOR as monitor  # noqa: F401
 
 
 def span(name: str, cat: str = "tpushare", **args):

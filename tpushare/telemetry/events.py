@@ -1,0 +1,125 @@
+"""Bounded structured flight recorder (JSONL ring) for outage forensics.
+
+The round-4 tunnel outage (CLAUDE.md "Environment hazards") was
+reconstructed from scattered stderr lines; this module is the organized
+replacement: every plane records small structured events — admissions,
+dispatch begin/end with measured device residency, health transitions,
+HBM grant/refusal, errors — into one fixed-capacity ring.  Like the
+trace ring it is a RING, not a log: recording stays permanently on with
+no I/O and bounded memory, and a dump shows the most recent window,
+which is the window a post-mortem wants.
+
+Two dump paths:
+
+* on demand at ``/debug/events`` (daemon and ``tpushare-llm-server``),
+  newline-delimited JSON, newest last;
+* automatically to disk when the health monitor transitions to WEDGED
+  (:mod:`tpushare.telemetry.health`) — by the time an operator notices a
+  wedge the interesting events are minutes old, and a hung process may
+  never answer an HTTP dump again.  The snapshot must therefore happen
+  at the TRANSITION, from the watchdog thread, not from a handler.
+
+Disabled-path contract: ``record()`` starts with the same single
+module-global flag check every registry mutation starts with
+(``telemetry.set_enabled(False)`` turns recording off).  Stdlib only.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+from . import registry
+
+#: env override for where WEDGED snapshots land (default: the system
+#: temp dir — a workload container may have no writable cwd)
+SNAPSHOT_DIR_ENV = "TPUSHARE_FLIGHT_DIR"
+
+
+def snapshot_dir() -> str:
+    return os.environ.get(SNAPSHOT_DIR_ENV) or tempfile.gettempdir()
+
+
+class FlightRecorder:
+    """Fixed-capacity deque of event dicts; thread-safe; JSONL dumps."""
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            return self._buf.maxlen or 0
+
+    def set_capacity(self, capacity: int) -> None:
+        # lock held around the swap: a concurrent record() must land in
+        # either the old or the new deque, never in a dropped one
+        with self._lock:
+            self._buf = collections.deque(self._buf, maxlen=capacity)
+
+    def record(self, kind: str, _ts: Optional[float] = None,
+               **fields) -> int:
+        """Append one event; returns its monotonically increasing ``seq``
+        (0 when disabled — the caller's handle for correlating begin/end
+        pairs, e.g. a dispatch stall pointing back at its begin event).
+        ``fields`` must be JSON-serializable (they ride into dumps).
+        ``_ts`` backdates the event (retroactive dispatch_begin records:
+        the health plane emits a dispatch's begin lazily — at stall
+        detection or slow-dispatch exit — stamped with the dispatch's
+        TRUE start time, so the boring fast path records nothing)."""
+        if not registry.enabled():
+            return 0
+        with self._lock:
+            self._seq += 1
+            event = {"seq": self._seq,
+                     "ts": round(_ts if _ts is not None else time.time(),
+                                 6),
+                     "kind": kind}
+            event.update(fields)
+            self._buf.append(event)
+            return self._seq
+
+    def events(self) -> List[dict]:
+        """Snapshot, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def to_jsonl(self) -> str:
+        return "".join(json.dumps(e, sort_keys=True) + "\n"
+                       for e in self.events())
+
+    def snapshot_to(self, path: Optional[str] = None,
+                    reason: str = "") -> Optional[str]:
+        """Write the ring to ``path`` (default: a timestamped file in
+        :func:`snapshot_dir`) as JSONL, preceded by one header line.
+        Returns the path, or None when the write failed — forensics
+        must never take down the process it is documenting."""
+        if path is None:
+            path = os.path.join(
+                snapshot_dir(),
+                f"tpushare_flight_{os.getpid()}_{int(time.time())}.jsonl")
+        header = json.dumps({"kind": "snapshot_header", "pid": os.getpid(),
+                             "ts": round(time.time(), 6),
+                             "reason": reason}, sort_keys=True)
+        try:
+            with open(path, "w") as f:
+                f.write(header + "\n")
+                f.write(self.to_jsonl())
+            return path
+        except OSError:
+            return None
+
+
+#: the process-global flight recorder every plane records into
+RECORDER = FlightRecorder()

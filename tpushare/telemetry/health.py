@@ -1,0 +1,654 @@
+"""Backend health plane: state machine, probe loop, dispatch watchdog.
+
+The axon backend is the least-observable component in the stack: a dead
+tunnel stalls backend init for ~25 minutes, ``block_until_ready`` is a
+false barrier, and a hung fetch blocks a worker thread forever
+(CLAUDE.md "Environment hazards").  This module gives both planes ONE
+shared answer to "is the backend OK, slow, or wedged":
+
+* a four-state machine — ``OK / DEGRADED / WEDGED / CPU_FALLBACK`` —
+  exported one-hot as ``tpushare_backend_health_state{state=...}`` plus
+  a scalar ``tpushare_backend_up``, and served at ``/healthz`` (non-200
+  exactly when WEDGED, so it can wire straight into a kubelet
+  liveness/readiness probe);
+* a low-frequency probe loop: a tiny compile+dispatch+SCALAR-FETCH with
+  a deadline — the host fetch is the only reliable barrier on remote
+  backends (never ``block_until_ready``); a probe that misses its
+  deadline is ABANDONED to finish on its own, never killed (killing a
+  process/thread mid-TPU-dial wedges the tunnel);
+* a per-dispatch stall watchdog: serving wraps every device
+  dispatch+fetch in :meth:`HealthMonitor.dispatch_guard`; a guard open
+  past its deadline increments ``tpushare_dispatch_stalls_total``,
+  transitions the machine to WEDGED, and snapshots the flight recorder
+  to disk — while the hung worker keeps waiting untouched (the
+  CLAUDE.md survival rule: marking, never killing);
+* per-phase device-time attribution: guard exit observes
+  ``tpushare_device_time_seconds{phase=prefill|decode|mixed}`` with the
+  known constant tunnel-RPC overhead subtracted — the measured usage
+  feedback SGDRC-style co-location decisions need.
+
+``bench.py``'s probe-deadline / CPU-fallback / stall-watchdog logic
+lives here too (:func:`probe_platform`, :func:`start_stall_watchdog`)
+so there is exactly one probe implementation in the tree.
+
+Stdlib only at import; jax is imported lazily inside the default probe.
+The disabled path (``telemetry.set_enabled(False)``) reduces every
+entry point to one flag check.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from . import registry
+from .events import RECORDER
+
+# -- states ----------------------------------------------------------------
+OK = "ok"
+DEGRADED = "degraded"
+WEDGED = "wedged"
+CPU_FALLBACK = "cpu_fallback"
+STATES = (OK, DEGRADED, WEDGED, CPU_FALLBACK)
+
+#: dispatch phases with their own device-time series (the label values
+#: tpushare_device_time_seconds carries; lint pins the histogram name)
+PHASES = ("prefill", "decode", "mixed")
+
+# -- metrics ---------------------------------------------------------------
+BACKEND_UP = registry.gauge(
+    "tpushare_backend_up",
+    "1 when the accelerator backend is believed usable (OK/DEGRADED), "
+    "0 when WEDGED or running on the CPU fallback")
+HEALTH_STATE = registry.gauge(
+    "tpushare_backend_health_state",
+    "Backend health state machine, one-hot by the state label "
+    "(ok/degraded/wedged/cpu_fallback; exactly one series is 1)")
+PROBE_LATENCY = registry.histogram(
+    "tpushare_probe_latency_seconds",
+    "Wall latency of backend health probes (tiny dispatch + scalar "
+    "fetch, the true completion barrier); deadline misses observe the "
+    "deadline")
+DISPATCH_STALLS = registry.counter(
+    "tpushare_dispatch_stalls_total",
+    "Device dispatches still in flight past the stall deadline (the "
+    "hung worker is marked, never killed)")
+DEVICE_TIME = registry.histogram(
+    "tpushare_device_time_seconds",
+    "Measured per-dispatch device residency by phase (prefill/decode/"
+    "mixed): wall time of dispatch+host-fetch minus the constant "
+    "tunnel-RPC overhead (TPUSHARE_RPC_OVERHEAD_MS)")
+DEVICE_UTILIZATION = registry.gauge(
+    "tpushare_device_utilization",
+    "Fraction of wall-clock time attributed to device compute across "
+    "all phases (sum of tpushare_device_time_seconds over process "
+    "uptime) — the live goodput gauge; multiply by the workload's "
+    "FLOP/s-at-full-utilization to read MFU")
+
+#: process epoch for the utilization denominator
+_UTIL_T0 = time.monotonic()
+
+
+def refresh_device_utilization(now: Optional[float] = None) -> Optional[float]:
+    """Re-derive :data:`DEVICE_UTILIZATION` from the per-phase device-
+    time histogram sums (called after ticks and at scrape time).  The
+    gauge is strictly DERIVED — no second accounting to drift."""
+    if not registry.enabled():
+        return None
+    busy = sum(DEVICE_TIME.sum(phase=p) for p in PHASES)
+    elapsed = (now if now is not None else time.monotonic()) - _UTIL_T0
+    if elapsed <= 0:
+        return None
+    util = min(1.0, busy / elapsed)
+    DEVICE_UTILIZATION.set(util)
+    return util
+
+#: the known constant per-dispatch RPC overhead of the tunnel-attached
+#: chip, subtracted from wall time to attribute DEVICE residency
+#: (CLAUDE.md: ~70 ms per dispatch through the tunnel; 0 when no tunnel)
+RPC_OVERHEAD_ENV = "TPUSHARE_RPC_OVERHEAD_MS"
+
+#: memoized rpc_overhead_s result — an os.environ read is ~1.5 µs,
+#: real money on the per-dispatch guard-exit path (None = recompute)
+_RPC_OVERHEAD_CACHE: Optional[float] = None
+
+
+def rpc_overhead_s() -> float:
+    global _RPC_OVERHEAD_CACHE
+    if _RPC_OVERHEAD_CACHE is not None:
+        return _RPC_OVERHEAD_CACHE
+    ms = os.environ.get(RPC_OVERHEAD_ENV)
+    if ms is not None:
+        try:
+            val = max(0.0, float(ms) / 1000.0)
+        except ValueError:
+            val = 0.0
+    else:
+        val = 0.070 if os.environ.get("PALLAS_AXON_POOL_IPS") else 0.0
+    _RPC_OVERHEAD_CACHE = val
+    return val
+
+
+def reset_rpc_overhead_cache() -> None:
+    """Re-read the env on next use (tests changing the override)."""
+    global _RPC_OVERHEAD_CACHE
+    _RPC_OVERHEAD_CACHE = None
+
+
+class _NullGuard:
+    """Shared no-op context for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_GUARD = _NullGuard()
+
+
+class _DispatchGuard:
+    __slots__ = ("_mon", "phase", "deadline_s", "observe", "info", "_t0")
+
+    def __init__(self, mon: "HealthMonitor", phase: str,
+                 deadline_s: Optional[float], observe: bool, info: dict):
+        self._mon = mon
+        self.phase = phase
+        self.deadline_s = deadline_s
+        self.observe = observe
+        self.info = info
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        self._mon._guard_enter(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._mon._guard_exit(self, time.monotonic() - self._t0,
+                              error=exc is not None)
+        return False
+
+
+class HealthMonitor:
+    """The process-global backend health state machine.
+
+    Thread-safe; every mutating entry point is gated on the telemetry
+    flag.  One instance (:data:`MONITOR`) is shared by the serving
+    plane, the daemon status endpoint, the LLM server, and the bench
+    harnesses — health is a property of the PROCESS's backend, so there
+    is nothing per-component about it.
+    """
+
+    def __init__(self, dispatch_deadline_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        if dispatch_deadline_s is None:
+            dispatch_deadline_s = float(
+                os.environ.get("TPUSHARE_DISPATCH_DEADLINE_S", "600"))
+        #: in-flight dispatch deadline; guards may override per call.
+        #: <= 0 disables stall watching entirely.
+        self.dispatch_deadline_s = dispatch_deadline_s
+        #: a clean dispatch slower than this still earns a dispatch_end
+        #: flight event (slow-but-not-stalled is forensics too)
+        self.slow_record_s = float(
+            os.environ.get("TPUSHARE_SLOW_DISPATCH_RECORD_S", "1.0"))
+        self.state = OK
+        self.reason = "no probe yet"
+        self.last_snapshot_path: Optional[str] = None
+        self._transitions = 0
+        self._inflight: Dict[int, dict] = {}   # seq -> guard record
+        self._next_token = 0
+        self._scanner: Optional[threading.Thread] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_halt = threading.Event()
+        self._mirror_state()
+
+    # -- state machine -------------------------------------------------
+    def _mirror_state(self) -> None:
+        for s in STATES:
+            HEALTH_STATE.set(1.0 if s == self.state else 0.0, state=s)
+        BACKEND_UP.set(1.0 if self.state in (OK, DEGRADED) else 0.0)
+
+    def set_state(self, state: str, reason: str = "") -> None:
+        """Transition (no-op when already there); WEDGED entry snapshots
+        the flight recorder to disk — a hung process may never answer an
+        HTTP dump, so forensics are written at the transition."""
+        if state not in STATES:
+            raise ValueError(f"unknown health state {state!r}")
+        with self._lock:
+            if state == self.state:
+                self.reason = reason or self.reason
+                return
+            prev, self.state = self.state, state
+            self.reason = reason
+            self._transitions += 1
+            self._mirror_state()
+        RECORDER.record("health_transition", frm=prev, to=state,
+                        reason=reason)
+        if state == WEDGED:
+            self.last_snapshot_path = RECORDER.snapshot_to(
+                reason=f"WEDGED: {reason}")
+
+    def mark_cpu_fallback(self, reason: str) -> None:
+        """This process pinned the CPU backend (probe failure, backend
+        init error).  STICKY: later probe successes describe the
+        accelerator, not this process, which stays on CPU."""
+        self.set_state(CPU_FALLBACK, reason)
+
+    def healthz(self) -> Tuple[int, object]:
+        """(status code, body) for a /healthz route: non-200 exactly
+        when WEDGED, so the route can back a kubelet liveness probe
+        (DEGRADED and CPU_FALLBACK still serve — restarting them fixes
+        nothing and loses the flight recorder)."""
+        with self._lock:
+            state, reason = self.state, self.reason
+            stalled = sum(1 for g in self._inflight.values()
+                          if g.get("stalled"))
+        if state == OK:
+            return 200, "ok\n"
+        body = {"state": state, "reason": reason,
+                "stalled_dispatches": stalled}
+        return (503, body) if state == WEDGED else (200, body)
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for /healthz bodies, inspect, and tests."""
+        with self._lock:
+            return {"state": self.state, "reason": self.reason,
+                    "inflight_dispatches": len(self._inflight),
+                    "transitions": self._transitions,
+                    "last_snapshot_path": self.last_snapshot_path}
+
+    def reset(self) -> None:
+        """Back to OK and forget in-flight guards — TEST isolation only
+        (a live process has no legitimate amnesia)."""
+        with self._lock:
+            self.state, self.reason = OK, "reset"
+            self._inflight.clear()
+            self._transitions = 0
+            self.last_snapshot_path = None
+            self._mirror_state()
+
+    # -- probes --------------------------------------------------------
+    def record_probe(self, ok: bool, latency_s: float,
+                     reason: str = "", timed_out: bool = False) -> None:
+        """Feed one probe result into the machine.  Timeout failures go
+        straight to WEDGED (the round-4 outage signature: init/dispatch
+        hanging ~1500 s); other failures mark DEGRADED.  Success
+        recovers WEDGED/DEGRADED to OK but never un-pins CPU_FALLBACK."""
+        if not registry.enabled():
+            return
+        PROBE_LATENCY.observe(latency_s)
+        RECORDER.record("probe", ok=ok, latency_s=round(latency_s, 6),
+                        reason=reason or None)
+        if ok:
+            with self._lock:
+                any_stalled = any(r["stalled"]
+                                  for r in self._inflight.values())
+            if any_stalled:
+                # Small RPCs answering while a real dispatch is still
+                # hung is the tunnel's classic half-dead mode: the
+                # probe must not paint a wedged machine green (the
+                # stall record never re-fires — see _scan_loop's
+                # not-stalled filter).
+                self.reason = ("probe ok but a stalled dispatch is "
+                               "still in flight")
+            elif self.state in (DEGRADED, WEDGED):
+                self.set_state(OK, "probe recovered")
+            elif self.state == OK:
+                self.reason = "probe ok"
+        elif timed_out:
+            self.set_state(WEDGED, reason or "probe deadline exceeded")
+        else:
+            self.set_state(DEGRADED, reason or "probe failed")
+
+    def start_probe_loop(self, probe_fn: Optional[Callable[[], None]] = None,
+                         interval_s: float = 30.0,
+                         deadline_s: float = 10.0) -> None:
+        """Low-frequency background probing.  ``probe_fn`` performs one
+        tiny dispatch and SCALAR-FETCHES the result (the true barrier);
+        default :func:`jax_scalar_probe`.  Each probe runs in its own
+        worker thread with ``deadline_s`` to finish; a late worker is
+        abandoned (never killed) and its eventual result still lands —
+        that late success is exactly how a recovered tunnel flips the
+        machine back to OK without anyone re-arming anything."""
+        if probe_fn is None:
+            probe_fn = jax_scalar_probe
+        self.stop_probe_loop()
+        self._probe_halt = threading.Event()
+        halt = self._probe_halt
+
+        def probe_once():
+            done = threading.Event()
+
+            def worker():
+                t0 = time.monotonic()
+                try:
+                    probe_fn()
+                except Exception as e:
+                    done.set()
+                    self.record_probe(False, time.monotonic() - t0,
+                                      f"probe raised {type(e).__name__}: "
+                                      f"{str(e)[:200]}")
+                    return
+                done.set()
+                self.record_probe(True, time.monotonic() - t0)
+
+            t = threading.Thread(target=worker, daemon=True,
+                                 name="tpushare-health-probe-worker")
+            t.start()
+            if not done.wait(deadline_s):
+                # Mark now; the worker stays untouched and reports late.
+                self.record_probe(False, deadline_s,
+                                  "probe deadline exceeded (worker "
+                                  "abandoned, not killed)",
+                                  timed_out=True)
+
+        def loop():
+            while not halt.wait(interval_s):
+                if registry.enabled():
+                    probe_once()
+
+        self._probe_thread = threading.Thread(
+            target=loop, daemon=True, name="tpushare-health-probe")
+        self._probe_thread.start()
+
+    def stop_probe_loop(self) -> None:
+        self._probe_halt.set()
+        self._probe_thread = None
+
+    # -- per-dispatch stall watchdog ----------------------------------
+    def dispatch_guard(self, phase: str,
+                       deadline_s: Optional[float] = None,
+                       observe: bool = True, **info):
+        """Context manager around ONE device dispatch (+ its host
+        fetch).  On exit, observes per-phase device time (wall minus
+        the constant tunnel-RPC overhead) into
+        ``tpushare_device_time_seconds`` when ``observe`` (dispatch-only
+        sites that fetch later pass ``observe=False`` so the near-zero
+        async-dispatch wall time does not pollute the attribution).
+        While open past the deadline, the watchdog marks a stall —
+        counter + WEDGED + flight snapshot — without touching the
+        blocked thread."""
+        if not registry.enabled():
+            return _NULL_GUARD
+        return _DispatchGuard(self, phase, deadline_s, observe, info)
+
+    def _guard_enter(self, g: _DispatchGuard) -> None:
+        # HOT PATH: no recorder write here — the begin event is emitted
+        # LAZILY (by the scanner at stall detection, or at exit for
+        # slow/errored dispatches) backdated to rec["ts"], so a fast
+        # clean dispatch costs one lock'd dict insert and the ring
+        # keeps minutes of interesting history instead of seconds of
+        # boring begin/end pairs.
+        deadline = (g.deadline_s if g.deadline_s is not None
+                    else self.dispatch_deadline_s)
+        rec = {"begin_seq": 0, "phase": g.phase,
+               "t0": time.monotonic(), "ts": time.time(),
+               "deadline_s": deadline, "stalled": False,
+               "info": g.info}
+        with self._lock:
+            self._next_token += 1
+            token = self._next_token
+            self._inflight[token] = rec
+            g.info["_token"] = token
+            if deadline and deadline > 0 and self._scanner is None:
+                self._scanner = threading.Thread(
+                    target=self._scan_loop, daemon=True,
+                    name="tpushare-dispatch-watchdog")
+                self._scanner.start()
+
+    @staticmethod
+    def _emit_begin(rec: dict) -> int:
+        """Emit ``rec``'s retroactive dispatch_begin (idempotent)."""
+        if not rec["begin_seq"]:
+            info = {k: v for k, v in rec["info"].items()
+                    if k != "_token"}
+            rec["begin_seq"] = RECORDER.record(
+                "dispatch_begin", _ts=rec["ts"], phase=rec["phase"],
+                **info)
+        return rec["begin_seq"]
+
+    def _guard_exit(self, g: _DispatchGuard, wall_s: float,
+                    error: bool) -> None:
+        # HOT PATH: one guard per serving dispatch, against ms-scale
+        # device work — stays a few µs.  The boring case (fast, clean,
+        # machine OK) does: lock'd pop, one histogram observe, return.
+        # dispatch_end flight events are recorded only when INTERESTING
+        # (stalled / errored / slow): normal traffic would both cost
+        # time and evict the events a post-mortem actually wants from
+        # the bounded ring; the begin event (always recorded) plus the
+        # per-phase histograms carry the steady-state story.
+        token = g.info.pop("_token", None)
+        with self._lock:
+            rec = self._inflight.pop(token, None)
+        stalled = bool(rec and rec["stalled"])
+        if g.observe and not stalled:
+            # a stalled dispatch's wall is tunnel hang, not device
+            # compute — attributing it would pin the goodput gauge at
+            # "fully busy" during exactly the hours it was zero
+            DEVICE_TIME.observe(max(0.0, wall_s - rpc_overhead_s()),
+                                phase=g.phase)
+        if not (stalled or error or wall_s >= self.slow_record_s
+                or self.state in (WEDGED, DEGRADED)):
+            # WEDGED/DEGRADED traffic is forensics; sticky CPU_FALLBACK
+            # is not — recording every CPU dispatch forever would flood
+            # the ring and evict the history a post-mortem wants
+            return
+        begin_seq = self._emit_begin(rec) if rec else 0
+        RECORDER.record("dispatch_end", phase=g.phase,
+                        begin_seq=begin_seq, wall_s=round(wall_s, 6),
+                        stalled=stalled, error=error, **g.info)
+        if error:
+            RECORDER.record("error", phase=g.phase,
+                            wall_s=round(wall_s, 6))
+        with self._lock:
+            any_stalled = any(r["stalled"]
+                              for r in self._inflight.values())
+        if stalled and not any_stalled and self.state == WEDGED:
+            # The hung dispatch came back (tunnel recovered on its own):
+            # not OK yet — DEGRADED until a probe or further clean
+            # traffic says otherwise — but no longer wedged.
+            self.set_state(
+                DEGRADED,
+                f"stalled {g.phase} dispatch returned after "
+                f"{wall_s:.1f}s")
+        elif (not error and not stalled and self.state == DEGRADED
+                and not any_stalled):
+            self.set_state(OK, "clean dispatch after degradation")
+
+    def _scan_loop(self) -> None:
+        while True:
+            with self._lock:
+                deadlines = [r["deadline_s"]
+                             for r in self._inflight.values()
+                             if r["deadline_s"] and r["deadline_s"] > 0]
+                floor = min(deadlines) if deadlines \
+                    else (self.dispatch_deadline_s or 1.0)
+            time.sleep(min(max(floor / 4.0, 0.02), 2.0))
+            now = time.monotonic()
+            newly = []
+            with self._lock:
+                for rec in self._inflight.values():
+                    if (not rec["stalled"] and rec["deadline_s"]
+                            and rec["deadline_s"] > 0
+                            and now - rec["t0"] > rec["deadline_s"]):
+                        rec["stalled"] = True
+                        # the stalled dispatch's begin event (backdated
+                        # to its true start) lands BEFORE the stall
+                        # event — and, transitively, before the WEDGED
+                        # snapshot; emitted under the lock so the exit
+                        # path cannot double-emit it
+                        self._emit_begin(rec)
+                        newly.append(rec)
+            for rec in newly:
+                DISPATCH_STALLS.inc()
+                RECORDER.record(
+                    "dispatch_stall", phase=rec["phase"],
+                    begin_seq=rec["begin_seq"],
+                    waited_s=round(now - rec["t0"], 3),
+                    deadline_s=rec["deadline_s"])
+                self.set_state(
+                    WEDGED,
+                    f"{rec['phase']} dispatch in flight "
+                    f"{now - rec['t0']:.1f}s > deadline "
+                    f"{rec['deadline_s']:.1f}s (worker left running)")
+
+
+#: the process-global monitor every plane consults
+MONITOR = HealthMonitor()
+
+
+def healthz_route(_body=None) -> Tuple[int, object]:
+    """Drop-in JsonHTTPServer handler: GET /healthz off :data:`MONITOR`."""
+    return MONITOR.healthz()
+
+
+#: the probe's jitted program, built ONCE per process: a fresh lambda
+#: per probe would re-jit (and re-remote_compile) every interval
+_PROBE_FN = None
+
+
+def jax_scalar_probe() -> None:
+    """The default probe body: one tiny jitted dispatch whose result is
+    host-fetched as a scalar — the only reliable completion barrier on
+    the axon backend (``block_until_ready`` has returned early there).
+    bf16 on purpose: f32 compiles through the tunnel are banned
+    (CLAUDE.md — an f32 program hung remote_compile ~50 min), and the
+    probe must never itself be the outage."""
+    global _PROBE_FN
+    import jax
+    import jax.numpy as jnp
+
+    if _PROBE_FN is None:
+        _PROBE_FN = jax.jit(lambda x: x * 2 + 1)
+    y = _PROBE_FN(jnp.bfloat16(1.0))
+    assert float(y) == 3.0
+
+
+# -------------------------------------------------------------------------
+# Bench-side helpers (the ONE probe/watchdog implementation; bench.py and
+# bench_all.py call these instead of carrying private copies)
+# -------------------------------------------------------------------------
+
+#: watchdog stages during which the process must NOT exit: the worker is
+#: mid-TPU-dial, and exiting is exactly the kill CLAUDE.md bans
+DIAL_STAGES = ("probe", "import-jax")
+
+
+def probe_platform(deadline_s: float, log=lambda msg: None
+                   ) -> Tuple[Optional[str], Optional[str]]:
+    """Ask a SUBPROCESS what platform jax lands on, with a deadline.
+
+    Only dials when the tunnel hook env (``PALLAS_AXON_POOL_IPS``) is
+    present — that is the one case where backend init can stall ~25
+    minutes.  The subprocess inherits the env, reproducing exactly the
+    dial the caller would make.  Returns ``(platform, None)`` on
+    success and ``(None, reason)`` on timeout/death (caller should pin
+    cpu and :meth:`HealthMonitor.mark_cpu_fallback` with the reason).
+    On timeout the subprocess is ABANDONED to exit on its own — never
+    killed mid-dial.  Results feed :data:`MONITOR`.
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return os.environ.get("JAX_PLATFORMS") or "local", None  # no dial
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu", None  # pinned; nothing to probe
+    log(f"probing accelerator (deadline {deadline_s:.0f}s)...")
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=deadline_s)
+        lines = (out or "").strip().splitlines()
+        if lines:
+            MONITOR.record_probe(True, time.monotonic() - t0)
+            return lines[-1], None
+        log("probe subprocess exited without a platform (backend init "
+            "crashed); falling back to cpu")
+        reason = ("accelerator probe subprocess died without "
+                  "initializing a backend; cpu fallback")
+        MONITOR.record_probe(False, time.monotonic() - t0, reason)
+        return None, reason
+    except subprocess.TimeoutExpired:
+        log("probe deadline hit; abandoning probe (not killing mid-dial) "
+            "and falling back to cpu")
+        reason = ("accelerator probe hit its deadline (tunnel outage "
+                  "signature); cpu fallback - see CLAUDE.md "
+                  "'Environment hazards'")
+        MONITOR.record_probe(False, deadline_s, reason, timed_out=True)
+        return None, reason
+
+
+def resolve_platform():
+    """jax.devices() with the standard CPU-fallback-on-init-failure
+    policy (bench_all, probes): a failed backend init pins cpu and marks
+    :data:`MONITOR` CPU_FALLBACK instead of raising."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError as e:
+        MONITOR.mark_cpu_fallback(
+            f"backend init failed ({str(e)[:120]}); cpu fallback")
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+
+
+def start_stall_watchdog(budget_s: float, state: dict, defaults: dict,
+                         log=lambda msg: None,
+                         emit=None, _exit=os._exit) -> threading.Thread:
+    """Emit a degraded-but-valid record and exit if a bench run stalls.
+
+    A tunnel fetch can hang FOREVER mid-measure (round 4: a streamed
+    measurement blocked >25 min), and a blocked gRPC recv cannot be
+    interrupted from Python.  The driver would eventually kill the
+    process anyway — this watchdog beats it to the punch with whatever
+    numbers exist so far.  ``state['best']`` is the best record
+    assembled so far; ``state['stage'] == 'done'`` disarms.  The record
+    gains ``degraded`` + ``health_state`` (the machine goes WEDGED,
+    which also snapshots the flight recorder).  When the stall happens
+    in a :data:`DIAL_STAGES` stage, the process is left alive — exiting
+    mid-dial is exactly the kill that wedges the tunnel.
+    """
+    import json
+
+    if emit is None:
+        emit = lambda rec: print(json.dumps(rec), flush=True)
+
+    def run():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < budget_s:
+            time.sleep(5)
+            if state.get("stage") == "done":
+                return
+        stage = state.get("stage")
+        if stage == "done":
+            return
+        reason = (f"watchdog fired at stage {stage!r} after "
+                  f"{budget_s:.0f}s (hung tunnel fetch?)")
+        MONITOR.set_state(WEDGED, reason)
+        rec = dict(state.get("best") or {})
+        for k, v in defaults.items():
+            rec.setdefault(k, v)
+        rec["degraded"] = reason
+        rec["health_state"] = MONITOR.state
+        rec["health_reason"] = MONITOR.reason
+        log(f"WATCHDOG: stalled at {stage!r}; emitting degraded record")
+        emit(rec)
+        if stage in DIAL_STAGES:
+            log("WATCHDOG: stage is mid-dial; NOT exiting (record "
+                "emitted; kill policy is the caller's)")
+            return
+        _exit(2)
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="tpushare-bench-watchdog")
+    t.start()
+    return t

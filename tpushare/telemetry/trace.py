@@ -75,9 +75,14 @@ class Tracer:
 
     @property
     def capacity(self) -> int:
-        return self._buf.maxlen or 0
+        with self._lock:
+            return self._buf.maxlen or 0
 
     def set_capacity(self, capacity: int) -> None:
+        # lock held around the swap: a concurrent _emit must append to
+        # either the old or the new deque, never to a dropped one (the
+        # shrink-while-emitting race; threaded regression in
+        # tests/test_telemetry.py)
         with self._lock:
             self._buf = collections.deque(self._buf, maxlen=capacity)
 
