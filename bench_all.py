@@ -98,6 +98,36 @@ def admit_while_decode_bench(params, cfg, *, slots, n_reqs, prompt_len,
     return out
 
 
+def _fused_paged_decode_tokens_per_s(params, cfg, *, page_size, slots,
+                                     prompt_len, gen, decode_chunk,
+                                     reps):
+    """THE fused-decode drain both paged-storage scenarios time (the
+    int8-capacity and the attn-kernel comparisons must measure the
+    same thing): admit ``slots`` identical requests, one warm fused
+    chunk (absorbs nothing timed), drain, and count only the tokens
+    decoded inside the clock — admit's first token and the warm chunk
+    are excluded.  The last of ``reps`` runs is the timed one (earlier
+    runs absorb the compiles)."""
+    import time as _t
+
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    tokens_per_s = None
+    for _ in range(reps):
+        b = PagedContinuousBatcher(params, cfg, n_slots=slots,
+                                   page_size=page_size)
+        for i in range(slots):
+            b.admit([1 + i] * prompt_len, gen)
+        b.tick_fused(decode_chunk)               # warm
+        t0 = _t.perf_counter()
+        while b.slots:
+            b.tick_fused(decode_chunk)
+        dt = _t.perf_counter() - t0
+        timed = slots * (gen - 1 - decode_chunk)
+        tokens_per_s = timed / dt
+    return tokens_per_s
+
+
 def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
                    gen, decode_chunk, throughput_slots, reps=2):
     """int8 vs bf16 KV cache on the PAGED pool: (a) sequences admitted
@@ -112,7 +142,6 @@ def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
     Returns {"pool_bytes", per-dtype {admitted, tokens_per_s}}.
     """
     import dataclasses
-    import time as _t
 
     from tpushare.ops.quant import kv_cache_bytes
     from tpushare.serving.paged import PagedContinuousBatcher
@@ -129,22 +158,44 @@ def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
         while b.admit([1 + admitted % 50] * prompt_len, gen) is not None:
             admitted += 1
         # (b) throughput at fixed occupancy (dense-equivalent pages)
-        tokens_per_s = None
-        for _ in range(reps):            # first rep absorbs compiles
-            bt = PagedContinuousBatcher(params, c,
-                                        n_slots=throughput_slots,
-                                        page_size=page_size)
-            for i in range(throughput_slots):
-                bt.admit([1 + i] * prompt_len, gen)
-            bt.tick_fused(decode_chunk)            # warm
-            t0 = _t.perf_counter()
-            while bt.slots:
-                bt.tick_fused(decode_chunk)
-            dt = _t.perf_counter() - t0
-            timed = throughput_slots * (gen - 1 - decode_chunk)
-            tokens_per_s = timed / dt
+        tokens_per_s = _fused_paged_decode_tokens_per_s(
+            params, c, page_size=page_size, slots=throughput_slots,
+            prompt_len=prompt_len, gen=gen, decode_chunk=decode_chunk,
+            reps=reps)
         out[kv_dtype] = {"admitted": admitted,
                          "tokens_per_s": tokens_per_s}
+    return out
+
+
+def paged_attn_bench(params, cfg, *, page_size, slots, prompt_len, gen,
+                     decode_chunk, reps=2):
+    """Pallas paged-decode kernel vs the XLA gather at IDENTICAL
+    occupancy, bf16 AND int8 pools: the same fused-decode drain per
+    (kv_dtype, attn_kernel) cell, so the only variable is the paged
+    READ path.  On CPU the kernel runs through the Pallas interpreter —
+    an overhead-only arm (no HBM to save; the number prices the
+    dispatcher plumbing, not the kernel) — while on TPU the kernel
+    reads the pool once where the gather materializes + re-reads a
+    dense cfg.dtype view, so memory-bound decode should flip toward it,
+    most of all on int8 pools (the gather path dequantizes the WHOLE
+    view to bf16 first).
+
+    Importable so a test can smoke-run it at tiny sizes (tier-1-safe).
+    Returns {kv_dtype: {attn_kernel: tokens_per_s}}.
+    """
+    import dataclasses
+
+    out = {}
+    for kv_dtype in ("bf16", "int8"):
+        arm = {}
+        for kernel in ("xla", "pallas"):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel=kernel)
+            arm[kernel] = _fused_paged_decode_tokens_per_s(
+                params, c, page_size=page_size, slots=slots,
+                prompt_len=prompt_len, gen=gen,
+                decode_chunk=decode_chunk, reps=reps)
+        out[kv_dtype] = arm
     return out
 
 
@@ -350,6 +401,26 @@ def main() -> int:
                                / max(1, kvq["bf16"]["admitted"]), 3),
           note="capacity at fixed pool_bytes + fused paged decode at "
                "identical occupancy")
+
+    # 2b-kernel. the Pallas paged-decode read path vs the XLA gather at
+    # identical occupancy, bf16 and int8 pools (same config as 2b-quant:
+    # REAL bf16 storage at head_dim 128 — the kernel's lane tile).
+    # page_size 32 keeps the int8 pool Mosaic-viable on TPU (int8 tiles
+    # are 32 sublanes; a 16-token page would silently fall back to the
+    # gather and benchmark nothing).
+    pa = paged_attn_bench(kparams, kcfg, page_size=32, slots=slots,
+                          prompt_len=(3 * 16) if on_tpu else 3,
+                          gen=gen, decode_chunk=16 if on_tpu else 4)
+    _emit("paged_attn_decode_tokens_per_s", pa["int8"]["pallas"],
+          "tokens/s", platform=platform, slots=slots, page_size=32,
+          attn_kernel="pallas", kv_dtype="int8",
+          vs_xla_int8=round(pa["int8"]["pallas"] / pa["int8"]["xla"], 3),
+          vs_xla_bf16=round(pa["bf16"]["pallas"] / pa["bf16"]["xla"], 3),
+          bf16_pallas=round(pa["bf16"]["pallas"], 2),
+          bf16_xla=round(pa["bf16"]["xla"], 2),
+          int8_xla=round(pa["int8"]["xla"], 2),
+          note="fused paged decode, kernel vs gather at identical "
+               "occupancy; CPU arm is interpret-mode (overhead-only)")
 
     # 2c. fused greedy decode, bf16 vs int8 vs int4: batch-1 decode is
     # WEIGHT-bound (every token re-reads all weights), so weight-only

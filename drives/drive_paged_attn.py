@@ -1,0 +1,159 @@
+"""On-chip Pallas paged-attention kernel: compile-check + decode vs XLA.
+
+The kernel's CPU-side contract is pinned in tests/test_paged_attn.py
+(interpret mode).  What only the real chip can answer is
+
+* does the kernel COMPILE AND LOWER on Mosaic at a serving shape — the
+  page-gather BlockSpec index maps (scalar-prefetched table), the int8
+  page tiles (32-sublane), and above all the trailing-singleton f32
+  scale blocks ([page, 1]: Mosaic must lane-pad the singleton to the
+  128-lane tile) are exactly the layout decisions the interpreter does
+  not check (CLAUDE.md block-layout hazard);
+* does decode get FASTER — the XLA gather path materializes + re-reads
+  a dense cfg.dtype view of the whole cache per layer (bf16-sized even
+  for int8 pools), so the one-pass kernel should win on memory-bound
+  decode, most of all with kv_dtype="int8".
+
+Method (CLAUDE.md tunnel rules): per (kv_dtype, attn_kernel) cell,
+prefill once through the coalesced batch path — which itself exercises
+the MULTI-token kernel (prefill windows attending history) — then time
+a device-resident ``lax.scan`` decode (ONE dispatch, host-fetch
+barrier).  Greedy stream agreement pallas-vs-xla is reported per dtype
+(the kernel is accuracy-bounded, not bit-identical).
+
+    python drives/drive_paged_attn.py        # real chip; ~6 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.models import transformer
+    from tpushare.ops.attention import paged_kernel_viable
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=4096)
+        batch, prompt_len, n_dec, page = 8, 1024, 64, 64
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96, dtype=jnp.bfloat16)
+        batch, prompt_len, n_dec, page = 2, 24, 8, 16
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab)
+    pages_per_slot = cfg.max_seq // page
+    w = -(-prompt_len // page) * page           # page-aligned prefill
+    padded = jnp.pad(prompt, ((0, 0), (0, w - prompt_len)))
+    table = np.zeros((batch, pages_per_slot), np.int32)
+    for b in range(batch):
+        table[b, :] = 1 + b * pages_per_slot + np.arange(pages_per_slot)
+    table = jnp.asarray(table)
+
+    out = {"metric": "paged_attn_decode", "platform": dev.platform,
+           "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
+           "page_size": page, "flavors": {}}
+    streams = {}
+    for kv_dtype in ("bf16", "int8"):
+        streams[kv_dtype] = {}
+        out["flavors"][kv_dtype] = {}
+        for kernel in ("xla", "pallas"):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel=kernel)
+            if kernel == "pallas" and on_tpu:
+                # a non-viable shape would silently fall back to the
+                # gather and compile-check NOTHING — fail loudly
+                # instead (rows: the coalesced prefill is the widest
+                # q-row block this drive dispatches)
+                rows = (cfg.n_heads // cfg.n_kv_heads) * w
+                assert paged_kernel_viable(page, cfg.head_dim,
+                                           kv_dtype == "int8",
+                                           cfg.dtype, rows=rows), \
+                    (page, kv_dtype, rows)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def prefill_jit(pools, c=c):
+                # coalesced batch prefill: the MULTI-token kernel arm
+                return transformer.forward_paged_prefill_batch(
+                    params, padded, c, pools, table,
+                    jnp.zeros((batch,), jnp.int32),
+                    jnp.full((batch,), prompt_len - 1, jnp.int32))
+
+            @functools.partial(jax.jit, static_argnames=("n",),
+                               donate_argnums=(1,))
+            def decode_n(tok0, pools, n: int, c=c):
+                def body(carry, _):
+                    tok, pools, lengths = carry
+                    logits, pools = transformer.forward_paged_decode(
+                        params, tok[:, None], c, pools, table, lengths)
+                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                        tok.dtype)
+                    return (nxt, pools, lengths + 1), nxt
+
+                lengths = jnp.full((batch,), prompt_len, jnp.int32)
+                (_, pools, _), toks = jax.lax.scan(
+                    body, (tok0, pools, lengths), None, length=n)
+                return toks.T, pools
+
+            def run():
+                pools = transformer.init_paged_kv(
+                    c, n_pages=batch * pages_per_slot + 1, page_size=page)
+                sel, pools = prefill_jit(pools)
+                tok0 = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                toks, pools = decode_n(tok0, pools, n_dec)
+                return sel, toks
+
+            t0 = time.perf_counter()
+            sel, toks = run()
+            first = [int(t) for t in toks[0]]        # compile + barrier
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            sel, toks = run()                        # warm timed pass
+            int(toks[0, -1])                         # host fetch barrier
+            dt = time.perf_counter() - t0
+
+            streams[kv_dtype][kernel] = first
+            out["flavors"][kv_dtype][kernel] = {
+                "compile_s": round(compile_s, 1),
+                "tokens_per_s": round(batch * n_dec / dt, 1),
+                # finiteness of the f32 LOGITS (argmax'd int tokens are
+                # trivially finite and would make compile_ok vacuous)
+                "finite": bool(np.isfinite(
+                    np.asarray(sel, np.float32)).all()),
+            }
+
+    for kv_dtype in ("bf16", "int8"):
+        f = out["flavors"][kv_dtype]
+        out[f"speedup_pallas_vs_xla_{kv_dtype}"] = round(
+            f["pallas"]["tokens_per_s"] / f["xla"]["tokens_per_s"], 3)
+        agree = sum(a == b for a, b in zip(streams[kv_dtype]["xla"],
+                                           streams[kv_dtype]["pallas"]))
+        out[f"stream_agreement_{kv_dtype}"] = f"{agree}/{n_dec}"
+    out["compile_ok"] = all(
+        cell["finite"] for f in out["flavors"].values()
+        for cell in f.values())
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,13 @@ import jax
 import jax.numpy as jnp
 
 
-def _cfg(window=None, kv_dtype=None):
+def _cfg(window=None, kv_dtype=None, attn_kernel=None):
     from tpushare.models import transformer
     cfg = transformer.tiny(max_seq=96, window=window)
     if kv_dtype is not None:
         cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+    if attn_kernel is not None:
+        cfg = dataclasses.replace(cfg, attn_kernel=attn_kernel)
     return cfg
 
 
@@ -67,82 +69,105 @@ def _streams(b, rids):
     return [[int(t) for t in b.completed[r]] for r in rids]
 
 
-def compute_streams(kv_dtype=None):
+def compute_streams(kv_dtype=None, attn_kernel=None, flavors=None):
     """flavor -> list of completed token streams, over every storage
     flavor.  ``kv_dtype=None`` leaves the config untouched (the bf16
-    golden arm works on trees predating the ``kv_dtype`` field)."""
+    golden arm works on trees predating the ``kv_dtype`` field);
+    ``attn_kernel=None`` likewise (explicit "xla" must reproduce the
+    None streams byte for byte — the knob-plumbing guard; "pallas"
+    swaps the paged read path and is agreement-pinned instead).
+    ``flavors`` (a collection of flavor names) restricts the run to a
+    subset — the per-knob guards replay only the storage flavors the
+    knob can touch instead of paying the whole sweep again."""
     from tpushare.models import transformer
     from tpushare.serving.continuous import ContinuousBatcher
     from tpushare.serving.generate import generate_fused
     from tpushare.serving.paged import PagedContinuousBatcher
 
+    def want(name):
+        return flavors is None or name in flavors
+
     out = {}
-    cfg = _cfg(kv_dtype=kv_dtype)
+    cfg = _cfg(kv_dtype=kv_dtype, attn_kernel=attn_kernel)
     params = transformer.init_params(jax.random.PRNGKey(0), cfg)
-    wcfg = _cfg(window=16, kv_dtype=kv_dtype)
+    wcfg = _cfg(window=16, kv_dtype=kv_dtype, attn_kernel=attn_kernel)
     wparams = transformer.init_params(jax.random.PRNGKey(4), wcfg)
 
     # dense pool, single ticks
-    b = ContinuousBatcher(params, cfg, n_slots=3)
-    rids = [b.admit(p, n) for p, n in FULL_REQS]
-    b.run_until_drained()
-    out["dense_ticked"] = _streams(b, rids)
+    if want("dense_ticked"):
+        b = ContinuousBatcher(params, cfg, n_slots=3)
+        rids = [b.admit(p, n) for p, n in FULL_REQS]
+        b.run_until_drained()
+        out["dense_ticked"] = _streams(b, rids)
 
     # dense pool, chunked admission + fused decode
-    b = ContinuousBatcher(params, cfg, n_slots=3)
-    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
-    _drain_fused(b)
-    out["dense_fused"] = _streams(b, rids)
+    if want("dense_fused"):
+        b = ContinuousBatcher(params, cfg, n_slots=3)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+        _drain_fused(b)
+        out["dense_fused"] = _streams(b, rids)
 
     # dense pool, mixed single-dispatch rounds
-    b = ContinuousBatcher(params, cfg, n_slots=3)
-    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
-    _drain_mixed(b)
-    out["dense_mixed"] = _streams(b, rids)
+    if want("dense_mixed"):
+        b = ContinuousBatcher(params, cfg, n_slots=3)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+        _drain_mixed(b)
+        out["dense_mixed"] = _streams(b, rids)
 
     # dense pool, one sampled request alongside greedy traffic
-    b = ContinuousBatcher(params, cfg, n_slots=2)
-    r0 = b.admit([7, 8, 9], 10)
-    r1 = b.admit(list(range(1, 9)), 10, temperature=0.9, seed=17)
-    b.run_until_drained()
-    out["dense_sampled"] = _streams(b, [r0, r1])
+    if want("dense_sampled"):
+        b = ContinuousBatcher(params, cfg, n_slots=2)
+        r0 = b.admit([7, 8, 9], 10)
+        r1 = b.admit(list(range(1, 9)), 10, temperature=0.9, seed=17)
+        b.run_until_drained()
+        out["dense_sampled"] = _streams(b, [r0, r1])
 
     # ROLLING window-sized dense pool (auto for windowed cfgs)
-    b = ContinuousBatcher(wparams, wcfg, n_slots=3)
-    rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
-    _drain_mixed(b)
-    out["rolling"] = _streams(b, rids)
+    if want("rolling"):
+        b = ContinuousBatcher(wparams, wcfg, n_slots=3)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
+        _drain_mixed(b)
+        out["rolling"] = _streams(b, rids)
 
     # paged pool
-    b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4)
-    rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
-    _drain_mixed(b)
-    out["paged"] = _streams(b, rids)
+    if want("paged"):
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in FULL_REQS]
+        _drain_mixed(b)
+        out["paged"] = _streams(b, rids)
 
     # windowed page RING
-    b = PagedContinuousBatcher(wparams, wcfg, n_slots=3, page_size=4,
-                               max_prefill_chunk=4)
-    rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
-    _drain_mixed(b)
-    out["page_ring"] = _streams(b, rids)
+    if want("page_ring"):
+        b = PagedContinuousBatcher(wparams, wcfg, n_slots=3, page_size=4,
+                                   max_prefill_chunk=4)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
+        _drain_mixed(b)
+        out["page_ring"] = _streams(b, rids)
 
     # prefix cache: sequential same-prefix admissions (later ones map
     # the registered head pages)
-    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
-                               prefix_cache=True)
-    rids = []
-    for p, n in PREFIX_REQS:
-        rids.append(b.admit_chunked(p, n, chunk=4))
-        _drain_mixed(b)
-    out["prefix_cache"] = _streams(b, rids)
+    if want("prefix_cache"):
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                                   prefix_cache=True)
+        rids = []
+        for p, n in PREFIX_REQS:
+            rids.append(b.admit_chunked(p, n, chunk=4))
+            _drain_mixed(b)
+        out["prefix_cache"] = _streams(b, rids)
 
     # single-request fused decode (the non-batcher path)
-    out["generate_fused"] = [
-        [int(t) for t in generate_fused(
-            params, cfg, jnp.asarray([FULL_REQS[0][0]], jnp.int32),
-            max_new_tokens=8)[0]],
-        [int(t) for t in generate_fused(
-            wparams, wcfg, jnp.asarray([WIN_REQS[0][0]], jnp.int32),
-            max_new_tokens=8)[0]],
-    ]
+    if want("generate_fused"):
+        out["generate_fused"] = [
+            [int(t) for t in generate_fused(
+                params, cfg, jnp.asarray([FULL_REQS[0][0]], jnp.int32),
+                max_new_tokens=8)[0]],
+            [int(t) for t in generate_fused(
+                wparams, wcfg, jnp.asarray([WIN_REQS[0][0]], jnp.int32),
+                max_new_tokens=8)[0]],
+        ]
     return out
+
+
+#: the storage flavors whose reads route through the paged-attention
+#: dispatcher (the only ones ``ModelConfig.attn_kernel`` can perturb)
+PAGED_FLAVORS = ("paged", "page_ring", "prefix_cache")

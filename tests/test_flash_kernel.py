@@ -1,4 +1,11 @@
-"""Flash-attention kernel math, via the Pallas interpreter on CPU."""
+"""Flash-attention kernel math, via the Pallas interpreter on CPU.
+
+No call here passes ``interpret=`` — the kernels resolve it through
+``ops.attention.default_interpret()`` (interpret exactly when the
+backend is not a real TPU), so this file tests the INTERPRETER on
+CPU and the real Mosaic lowering if ever run on a TPU host, instead
+of silently interpreting everywhere.
+"""
 
 import numpy as np
 import pytest
@@ -14,7 +21,7 @@ def test_flash_kernel_matches_reference(causal):
     key = jax.random.PRNGKey(0)
     q, k, v = (jax.random.normal(kk, (2, 3, 256, 128), jnp.float32)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    out = flash_attention(q, k, v, causal=causal)
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -24,8 +31,8 @@ def test_flash_kernel_blocking_invariance():
     key = jax.random.PRNGKey(1)
     q, k, v = (jax.random.normal(kk, (1, 2, 256, 128), jnp.float32)
                for kk in jax.random.split(key, 3))
-    a = flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
-    b = flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
+    a = flash_attention(q, k, v, block_q=128, block_k=128)
+    b = flash_attention(q, k, v, block_q=64, block_k=64)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
@@ -34,7 +41,7 @@ def test_flash_kernel_causal_first_row_is_v0():
     key = jax.random.PRNGKey(2)
     q, k, v = (jax.random.normal(kk, (1, 1, 128, 128), jnp.float32)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out[0, 0, 0]),
                                np.asarray(v[0, 0, 0]), atol=1e-5)
 
@@ -48,7 +55,7 @@ def test_flash_kernel_gqa_native(hkv):
     q = jax.random.normal(kq, (2, 4, 128, 128), jnp.float32)
     k = jax.random.normal(kk, (2, hkv, 128, 128), jnp.float32)
     v = jax.random.normal(kv, (2, hkv, 128, 128), jnp.float32)
-    out = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(q, k, v, causal=True)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -61,7 +68,7 @@ def test_flash_kernel_headdim_padding(causal, d):
     key = jax.random.PRNGKey(5)
     q, k, v = (jax.random.normal(kk, (2, 3, 128, d), jnp.float32)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    out = flash_attention(q, k, v, causal=causal)
     assert out.shape == q.shape
     ref = reference_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
@@ -74,7 +81,7 @@ def test_flash_kernel_headdim64_gqa():
     q = jax.random.normal(kq, (1, 4, 128, 64), jnp.float32)
     k = jax.random.normal(kk, (1, 2, 128, 64), jnp.float32)
     v = jax.random.normal(kv, (1, 2, 128, 64), jnp.float32)
-    out = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(q, k, v, causal=True)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -91,8 +98,7 @@ def test_flash_kernel_grad_matches_reference(d):
     v = jax.random.normal(kv, (1, 1, 128, d), jnp.float32)
 
     def flash_loss(q, k, v):
-        return (flash_attention(q, k, v, causal=True,
-                                interpret=True) ** 2).sum()
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
 
     def ref_loss(q, k, v):
         return (reference_attention(q, k, v, causal=True) ** 2).sum()
@@ -113,7 +119,7 @@ def test_flash_bwd_blocking_invariance_and_noncausal():
 
     def loss(q, k, v, blk):
         return (flash_attention(q, k, v, causal=False, block_q=blk,
-                                block_k=blk, interpret=True) ** 2).sum()
+                                block_k=blk) ** 2).sum()
 
     g128 = jax.grad(lambda *a: loss(*a, 128), argnums=(0, 1, 2))(q, k, v)
     g64 = jax.grad(lambda *a: loss(*a, 64), argnums=(0, 1, 2))(q, k, v)
@@ -132,7 +138,7 @@ def test_flash_bwd_bf16_grad_dtypes():
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 64), jnp.bfloat16)
                for kk in jax.random.split(key, 3))
     g = jax.grad(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, interpret=True).astype(jnp.float32).sum(),
+        q, k, v, causal=True).astype(jnp.float32).sum(),
         argnums=(0, 1, 2))(q, k, v)
     for t, p in zip(g, (q, k, v)):
         assert t.dtype == p.dtype == jnp.bfloat16
@@ -143,7 +149,7 @@ def test_flash_kernel_bf16_io():
     key = jax.random.PRNGKey(3)
     q, k, v = (jax.random.normal(kk, (1, 2, 128, 128), jnp.bfloat16)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(q, k, v, causal=True)
     assert out.dtype == jnp.bfloat16
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
@@ -158,12 +164,12 @@ def test_flash_kernel_block_fits_nondivisible_seq():
     key = jax.random.PRNGKey(9)
     q, k, v = (jax.random.normal(kk, (1, 2, 384, 64), jnp.float32)
                for kk in jax.random.split(key, 3))
-    out = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(q, k, v, causal=True)
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     # and through the fused backward
     g = jax.grad(lambda q_: (flash_attention(
-        q_, k, v, causal=True, interpret=True) ** 2).sum())(q)
+        q_, k, v, causal=True) ** 2).sum())(q)
     gr = jax.grad(lambda q_: (reference_attention(
         q_, k, v, causal=True) ** 2).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=3e-4)
@@ -182,7 +188,7 @@ def test_flash_bf16_grads_match_f32_reference_values():
         return (fn(q_, k_, v_).astype(jnp.float32) ** 2).sum()
 
     gb = jax.grad(lambda *a: loss(lambda q_, k_, v_: flash_attention(
-        q_, k_, v_, causal=True, interpret=True), *a), argnums=(0, 1, 2))(
+        q_, k_, v_, causal=True), *a), argnums=(0, 1, 2))(
             q, k, v)
     gr = jax.grad(lambda *a: loss(lambda q_, k_, v_: reference_attention(
         q_, k_, v_, causal=True), *a), argnums=(0, 1, 2))(qf, kf, vf)
@@ -199,7 +205,7 @@ def test_flash_attention_lse_matches_reference():
     key = jax.random.PRNGKey(14)
     q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
                for kk in jax.random.split(key, 3))
-    out, lse = flash_attention_lse(q, k, v, causal=True, interpret=True)
+    out, lse = flash_attention_lse(q, k, v, causal=True)
     ro, rl = reference_attention_lse(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ro), atol=2e-5)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(rl), atol=2e-5)
@@ -221,7 +227,7 @@ def test_flash_attention_lse_grad_includes_lse_cotangent():
         return (out ** 2).sum() + (lse * w).sum()
 
     gf = jax.grad(lambda *a: loss(lambda q_, k_, v_: flash_attention_lse(
-        q_, k_, v_, causal=True, interpret=True), *a),
+        q_, k_, v_, causal=True), *a),
         argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(lambda *a: loss(lambda q_, k_, v_: reference_attention_lse(
         q_, k_, v_, causal=True), *a), argnums=(0, 1, 2))(q, k, v)
@@ -255,10 +261,10 @@ def test_flash_kernel_sliding_window_matches_reference(w):
     q, k, v = (jax.random.normal(kk, (1, 2, 256, 64), jnp.float32)
                for kk in jax.random.split(key, 3))
     ref = reference_attention(q, k, v, causal=True, window=w)
-    fl = flash_attention(q, k, v, causal=True, interpret=True, window=w)
+    fl = flash_attention(q, k, v, causal=True, window=w)
     np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), atol=2e-5)
     g1 = jax.grad(lambda q_: (flash_attention(
-        q_, k, v, causal=True, interpret=True, window=w) ** 2).sum())(q)
+        q_, k, v, causal=True, window=w) ** 2).sum())(q)
     g2 = jax.grad(lambda q_: (reference_attention(
         q_, k, v, causal=True, window=w) ** 2).sum())(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=3e-4)
@@ -279,15 +285,15 @@ def test_flash_window_block_skip_bounds_multiblock():
                for kk in jax.random.split(key, 3))
     for w in (64, 130, 200):
         ref = reference_attention(q, k, v, causal=True, window=w)
-        fl = flash_attention(q, k, v, causal=True, interpret=True,
+        fl = flash_attention(q, k, v, causal=True,
                              window=w, block_q=128, block_k=128)
         np.testing.assert_allclose(np.asarray(fl), np.asarray(ref),
                                    atol=2e-5, err_msg=f"w={w}")
         g1 = jax.grad(lambda q_: (flash_attention(
-            q_, k, v, causal=True, interpret=True, window=w,
+            q_, k, v, causal=True, window=w,
             block_q=128, block_k=128) ** 2).sum())(q)
         gk = jax.grad(lambda k_: (flash_attention(
-            q, k_, v, causal=True, interpret=True, window=w,
+            q, k_, v, causal=True, window=w,
             block_q=128, block_k=128) ** 2).sum())(k)
         g2 = jax.grad(lambda q_: (reference_attention(
             q_, k, v, causal=True, window=w) ** 2).sum())(q)
@@ -312,6 +318,6 @@ def test_window_validation():
         transformer.tiny(window=-4)
     q = jnp.ones((1, 2, 128, 64), jnp.float32)
     with pytest.raises(ValueError, match="causal"):
-        flash_attention(q, q, q, causal=False, interpret=True, window=8)
+        flash_attention(q, q, q, causal=False, window=8)
     with pytest.raises(ValueError, match="causal"):
         reference_attention(q, q, q, causal=False, window=8)

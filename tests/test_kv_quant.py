@@ -34,7 +34,7 @@ from tpushare.serving import metrics
 from tpushare.serving.continuous import ContinuousBatcher
 from tpushare.serving.paged import PagedContinuousBatcher
 
-from kv_golden_scenarios import compute_streams
+from kv_golden_scenarios import PAGED_FLAVORS, compute_streams
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden_kv_bf16.json")
 
@@ -193,6 +193,22 @@ def test_bf16_streams_bit_identical_to_committed_goldens():
     got = compute_streams()
     assert set(got) == set(golden)
     for flavor in golden:
+        assert got[flavor] == golden[flavor], flavor
+
+
+@pytest.mark.slow
+def test_attn_kernel_xla_explicit_is_byte_identical():
+    """attn_kernel="xla" set EXPLICITLY reproduces the committed bf16
+    goldens byte for byte on every paged flavor: the round-10 knob
+    plumbing (dispatcher, config field) must not perturb the default
+    read path at all — only attn_kernel="pallas" is allowed to change
+    numbers (and that arm is agreement-pinned in
+    tests/test_paged_attn.py, not bit-pinned)."""
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    got = compute_streams(attn_kernel="xla", flavors=PAGED_FLAVORS)
+    assert set(got) == set(PAGED_FLAVORS)
+    for flavor in PAGED_FLAVORS:
         assert got[flavor] == golden[flavor], flavor
 
 

@@ -72,6 +72,7 @@ def test_kv_byte_series_registered():
     names = {n for n, _, _ in _registered()}
     assert "tpushare_kv_cache_bytes" in names
     assert "tpushare_kv_dtype_info" in names
+    assert "tpushare_attn_kernel_info" in names
 
 
 def test_kv_dtype_info_renders_as_info_series():
@@ -111,6 +112,45 @@ def test_no_literal_kv_byte_math_outside_quant_helper():
     assert not offenders, (
         "KV byte math outside ops/quant.py (use kv_cache_bytes):\n"
         + "\n".join(offenders))
+
+
+def test_no_direct_page_gather_outside_dispatcher():
+    """Grep-lint: subscripting a pool with a whole page table
+    (``pool[page_table]``-style gather) anywhere but
+    ``transformer._paged_gather`` bypasses the ``attn_kernel``
+    dispatcher (``transformer.paged_attention``) — the new read site
+    would silently stay on the XLA gather path under
+    ``attn_kernel="pallas"``, and its dense transient would be
+    invisible to ``storage_info()``'s accounting.  All paged reads
+    must route through the dispatcher; the ONE sanctioned gather lives
+    in ``_paged_gather``."""
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tpushare")
+    pat = re.compile(r"\w+\s*\[\s*(page_table|page_rows|tables?)\s*\]")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                lines = f.readlines()
+            allowed = set()
+            if path.endswith(os.path.join("models", "transformer.py")):
+                # the sanctioned gather: the _paged_gather body only
+                start = next(i for i, ln in enumerate(lines)
+                             if ln.startswith("def _paged_gather("))
+                end = next((i for i in range(start + 1, len(lines))
+                            if lines[i].startswith("def ")), len(lines))
+                allowed = set(range(start, end))
+            for lineno, line in enumerate(lines):
+                if pat.search(line) and lineno not in allowed:
+                    offenders.append(
+                        f"{path}:{lineno + 1}: {line.strip()}")
+    assert not offenders, (
+        "direct pool[page_table] gather outside transformer."
+        "_paged_gather (route paged reads through "
+        "transformer.paged_attention):\n" + "\n".join(offenders))
 
 
 def test_every_metric_has_help_text():

@@ -1,0 +1,404 @@
+"""Pallas paged-attention decode kernel (attn_kernel="pallas").
+
+The kernel's contract mirrors the int8 KV cache's (round 8): NOT
+bit-identical to the XLA gather path — the online softmax reassociates
+reductions block-by-block — so equivalence is pinned as bounded logit
+error + greedy agreement per paged storage flavor, while dispatch
+flavors WITHIN the kernel path (ticked / fused / mixed) must stay
+EXACTLY self-consistent (same program, same reduction order, every
+dispatch).  The knob itself must be inert: attn_kernel="xla" explicit
+is byte-identical to the default (the golden guard lives in
+tests/test_kv_quant.py).
+
+On CPU everything here runs the REAL kernel through the Pallas
+interpreter (ops.attention.default_interpret()); what the interpreter
+cannot prove — Mosaic lowering of the page-gather index maps, the int8
+page tiles, and the trailing-singleton f32 scale blocks — is
+drive_paged_attn.py's job in the ``-m tpu`` lane.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.models import transformer
+from tpushare.ops.quant import quantize_kv
+from tpushare.serving import metrics
+from tpushare.serving.continuous import ContinuousBatcher
+from tpushare.serving.paged import PagedContinuousBatcher
+
+from kv_golden_scenarios import _drain_fused as _golden_drain_fused
+from kv_golden_scenarios import _drain_mixed as _golden_drain_mixed
+
+#: pallas-vs-xla pins, same shape as the int8 cache's (kernel output is
+#: reassociated, not wrong: measured exact agreement and ~1e-7 relative
+#: error on the f32 config, ~1e-2 on bf16 at head_dim 128)
+AGREEMENT_PIN = 0.90
+LOGIT_REL_PIN = 0.05
+
+#: bf16 config at head_dim 128 — realistic tiles for the int8 arm
+BCFG = transformer.ModelConfig(vocab=256, d_model=256, n_layers=2,
+                               n_heads=2, n_kv_heads=2, d_ff=128,
+                               max_seq=64, dtype=jnp.bfloat16)
+
+
+def _pallas(cfg):
+    return dataclasses.replace(cfg, attn_kernel="pallas")
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+def test_attn_kernel_validates():
+    with pytest.raises(ValueError, match="attn_kernel"):
+        dataclasses.replace(transformer.tiny(max_seq=64),
+                            attn_kernel="cuda")
+    assert transformer.tiny(max_seq=64).attn_kernel == "xla"
+
+
+def test_build_model_threads_attn_kernel():
+    from tpushare.serving.llm import build_model
+    cfg, _ = build_model("tiny", False, attn_kernel="pallas")
+    assert cfg.attn_kernel == "pallas"
+    cfg2, _ = build_model("tiny", False)
+    assert cfg2.attn_kernel == "xla"
+
+
+def test_default_interpret_is_platform_derived():
+    """On the CPU suite the shared helper must say 'interpret' — the
+    one platform check flash and the paged kernel both resolve
+    ``interpret=None`` through."""
+    from tpushare.ops.attention import _on_tpu, default_interpret
+    assert default_interpret() is True              # conftest pins cpu
+    assert default_interpret() == (not _on_tpu())
+
+
+# ---------------------------------------------------------------------------
+# kernel math vs the XLA gather reference (direct, no serving plane)
+# ---------------------------------------------------------------------------
+def _rand_pool(key, npool, hkv, page, d, dtype, quantized):
+    dense = jax.random.normal(key, (npool, hkv, page, d),
+                              jnp.float32).astype(dtype)
+    if quantized:
+        return quantize_kv(dense)
+    return dense
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("s,window", [(1, None), (4, None), (4, 16)])
+def test_kernel_matches_gather_reference(quantized, s, window):
+    """paged_decode_attention == gather + cached_attention on random
+    pools: GQA (n_rep=2), single- and multi-token queries, sliding
+    window, bf16/int8 stores.  f32 compute makes the reassociation
+    drift negligible, so the comparison is tight."""
+    from tpushare.models.transformer import (_expand_kv,
+                                             _paged_gather_deq,
+                                             cached_attention)
+    from tpushare.ops.attention import paged_decode_attention
+
+    b, h, hkv, d, page, npg, npool = 2, 4, 2, 32, 8, 4, 12
+    cfg = transformer.tiny()            # f32 compute dtype carrier
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    k_store = _rand_pool(ks[0], npool, hkv, page, d, cfg.dtype, quantized)
+    v_store = _rand_pool(ks[1], npool, hkv, page, d, cfg.dtype, quantized)
+    q = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+    table = jax.random.permutation(
+        ks[3], jnp.arange(1, 1 + b * npg)).reshape(b, npg)
+    positions = jnp.asarray([[9 + i for i in range(s)],
+                             [21 + i for i in range(s)]], jnp.int32)
+
+    out = paged_decode_attention(q, k_store, v_store, table, positions,
+                                 window=window)
+    ref = cached_attention(
+        q, _expand_kv(_paged_gather_deq(k_store, table, cfg), h // hkv),
+        _expand_kv(_paged_gather_deq(v_store, table, cfg), h // hkv),
+        positions, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_kernel_survives_fully_masked_pages_under_window():
+    """A sliding window far past page 0 leaves EARLY pages fully masked
+    while the running max is still -inf — the exp(0)=1 poisoning case
+    the keep-multiply exists for.  Output must match the reference and
+    stay finite."""
+    from tpushare.models.transformer import (_expand_kv,
+                                             _paged_gather_deq,
+                                             cached_attention)
+    from tpushare.ops.attention import paged_decode_attention
+
+    cfg = transformer.tiny()
+    hkv, d, page, npg = 2, 32, 8, 6
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    k_store = _rand_pool(ks[0], npg + 1, hkv, page, d, cfg.dtype, False)
+    v_store = _rand_pool(ks[1], npg + 1, hkv, page, d, cfg.dtype, False)
+    q = jax.random.normal(ks[2], (1, 4, 1, d), jnp.float32)
+    table = jnp.arange(1, npg + 1)[None, :]
+    positions = jnp.asarray([[40]], jnp.int32)   # window 8: pages 0-3 dead
+    out = paged_decode_attention(q, k_store, v_store, table, positions,
+                                 window=8)
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+    ref = cached_attention(
+        q, _expand_kv(_paged_gather_deq(k_store, table, cfg), 2),
+        _expand_kv(_paged_gather_deq(v_store, table, cfg), 2),
+        positions, window=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# storage_info accounting + telemetry
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bparams():
+    return transformer.init_params(jax.random.PRNGKey(0), BCFG)
+
+
+def test_storage_info_reports_read_path_and_transient(bparams):
+    """The XLA gather's per-layer dense transient is REAL memory the
+    docstring used to wave away — storage_info must price it (K+V dense
+    views in cfg.dtype over all slots, at FULL q-head width: the gather
+    path _expand_kv's GQA K/V before attention; int8 pools included:
+    the dequantized copy is what the kernel deletes) and report which
+    read path the pool runs."""
+    n_slots = 3
+    for cfg in (BCFG, dataclasses.replace(BCFG, kv_dtype="int8")):
+        info = PagedContinuousBatcher(bparams, cfg, n_slots=n_slots,
+                                      page_size=16).storage_info()
+        assert info["attn_kernel"] == "xla"
+        kv_pair = 2
+        expect = (kv_pair * n_slots * cfg.n_heads * cfg.max_seq
+                  * cfg.head_dim) * jnp.dtype(cfg.dtype).itemsize
+        assert info["attn_read_transient_bytes"] == expect
+        # the transient dwarfs nothing: it is a full dense K+V view,
+        # bf16-sized even for the int8 pool
+        assert info["attn_read_transient_bytes"] > 0
+
+        pinfo = PagedContinuousBatcher(bparams, _pallas(cfg),
+                                       n_slots=n_slots,
+                                       page_size=16).storage_info()
+        assert pinfo["attn_kernel"] == "pallas"
+        assert pinfo["attn_read_transient_bytes"] == 0
+    # GQA: the estimate prices the EXPANDED view (H, not Hkv) — the
+    # gather path repeats K/V to full head width before the softmax
+    gqa = transformer.tiny(max_seq=96)          # 4 heads over 2 kv heads
+    assert gqa.n_heads == 2 * gqa.n_kv_heads
+    est = transformer.paged_read_transient_bytes(gqa, 1)
+    kv_pair = 2
+    assert est == (kv_pair * gqa.n_heads * gqa.max_seq * gqa.head_dim
+                   * jnp.dtype(gqa.dtype).itemsize)
+
+
+def test_storage_info_reports_effective_kernel_on_fallback(bparams,
+                                                           monkeypatch):
+    """When a pallas config actually FALLS BACK to the gather (here via
+    the forced-reference escape hatch; on real TPU also via non-viable
+    tiles), storage_info and the info gauge must report what runs —
+    'pallas, transient 0' while every tick pays the dense gather would
+    actively mislead an operator debugging HBM pressure."""
+    import sys
+    import tpushare.ops.attention  # noqa: F401 (ops.__init__ shadows it)
+    attn_impl = sys.modules["tpushare.ops.attention"]
+    monkeypatch.setattr(attn_impl, "FORCE_REFERENCE", True)
+    info = PagedContinuousBatcher(bparams, _pallas(BCFG), n_slots=2,
+                                  page_size=16).storage_info()
+    assert info["attn_kernel"] == "xla"
+    assert info["attn_read_transient_bytes"] > 0
+    assert metrics.ATTN_KERNEL_INFO.value(attn_kernel="xla") == 1
+
+
+def test_llm_server_refuses_pallas_with_tp(bparams):
+    """The pallas+tp refusal must hold for PROGRAMMATIC construction
+    too, not just the argparse layer — otherwise a direct LLMServer
+    build dies in an opaque SPMD lowering error at the first tick."""
+    from tpushare.serving.llm import LLMServer
+    with pytest.raises(ValueError, match="single-device"):
+        LLMServer(_pallas(BCFG), bparams, n_slots=2, tp=2)
+
+
+def test_paged_batcher_refuses_pallas_with_mesh(bparams):
+    """...and at the batcher itself, where the mesh parameter actually
+    lives — direct PagedContinuousBatcher(mesh=...) construction must
+    fail fast too (pallas_call is not SPMD-partitionable)."""
+    from tpushare.parallel.mesh import make_mesh
+    mesh = make_mesh({"tp": 1})
+    with pytest.raises(ValueError, match="single-device"):
+        PagedContinuousBatcher(bparams, _pallas(BCFG), n_slots=2,
+                               page_size=16, mesh=mesh)
+
+
+def test_viability_gate_bounds_query_rows():
+    """The rows bound exists for VMEM (the whole q-row dim rides one
+    block + three [rows, 128] scratches): on CPU the gate is open (the
+    interpreter has no VMEM), and the bound constant is what the
+    committed drive proves on chip."""
+    from tpushare.ops.attention import (PAGED_KERNEL_MAX_ROWS,
+                                        paged_kernel_viable)
+    # off-TPU: interpret mode, any rows
+    assert paged_kernel_viable(16, 128, False, jnp.bfloat16,
+                               rows=10 * PAGED_KERNEL_MAX_ROWS)
+    assert PAGED_KERNEL_MAX_ROWS >= 2048   # drive shape: 1024 * n_rep 2
+
+
+def test_dense_storage_info_reports_xla_read_path(bparams):
+    """Dense slot reads never route through the paged dispatcher: the
+    read path reported is what actually runs, not the config knob."""
+    info = ContinuousBatcher(bparams, _pallas(BCFG),
+                             n_slots=2).storage_info()
+    assert info["attn_kernel"] == "xla"
+
+
+def test_attn_kernel_telemetry(bparams):
+    b = PagedContinuousBatcher(bparams, _pallas(BCFG), n_slots=2,
+                               page_size=16)
+    assert b.storage_info()["attn_kernel"] == "pallas"
+    assert metrics.ATTN_KERNEL_INFO.value(attn_kernel="pallas") == 1
+    # a default batcher re-points the info gauge (clear + set)
+    PagedContinuousBatcher(bparams, BCFG, n_slots=1, page_size=16)
+    assert metrics.ATTN_KERNEL_INFO.value(attn_kernel="xla") == 1
+    assert metrics.ATTN_KERNEL_INFO.value(attn_kernel="pallas") is None
+
+
+# ---------------------------------------------------------------------------
+# serving equivalence per paged flavor
+# ---------------------------------------------------------------------------
+# the ONE drain-loop implementation (kv_golden_scenarios), re-defaulted
+# for this file's page_size-16 traffic — a drift in drain semantics must
+# not fork between the golden suite and this one
+def _drain_mixed(b):
+    _golden_drain_mixed(b, n_steps=3, chunk=16, budget=32)
+
+
+def _drain_fused(b):
+    _golden_drain_fused(b, n_steps=3)
+
+
+_FULL_REQS = [(list(range(1, 11)), 6), ([3, 5, 7], 8)]
+_WIN_REQS = [(list(range(1, 40)), 12), ([5, 6, 7], 10)]
+_PREFIX_HEAD = [11, 12, 13, 14, 15, 16, 17, 18]
+
+
+def _paged_streams(params, cfg, batcher_kw, reqs, drain):
+    b = PagedContinuousBatcher(params, cfg, **batcher_kw)
+    rids = []
+    for p, n in reqs:
+        rids.append(b.admit_chunked(p, n, chunk=16))
+        if batcher_kw.get("prefix_cache"):
+            drain(b)        # sequential: later admits map the registry
+    drain(b)
+    return [b.completed[r] for r in rids]
+
+
+def _flavor_runs(params, cfg, wparams, wcfg):
+    """flavor -> streams for one attn_kernel setting, mixed-dispatch
+    drained (every paged flavor exercises the dispatcher)."""
+    return {
+        "paged": _paged_streams(
+            params, cfg, dict(n_slots=2, page_size=16), _FULL_REQS,
+            _drain_mixed),
+        "page_ring": _paged_streams(
+            wparams, wcfg, dict(n_slots=2, page_size=16,
+                                max_prefill_chunk=16), _WIN_REQS,
+            _drain_mixed),
+        "prefix_cache": _paged_streams(
+            params, cfg, dict(n_slots=2, page_size=4, prefix_cache=True),
+            [(_PREFIX_HEAD + [21, 22], 5), (_PREFIX_HEAD + [31], 6)],
+            _drain_mixed),
+    }
+
+
+def test_pallas_agreement_every_paged_flavor():
+    """THE acceptance check: per-flavor greedy agreement (kernel vs the
+    XLA gather path) above the pin on paged, page-ring, and
+    prefix-cache storage — f32 tiny config, where reassociation drift
+    is tiny, so disagreement means a real kernel bug."""
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    wcfg = transformer.tiny(max_seq=96, window=16)
+    wparams = transformer.init_params(jax.random.PRNGKey(4), wcfg)
+    ref = _flavor_runs(params, cfg, wparams, wcfg)
+    got = _flavor_runs(params, _pallas(cfg), wparams, _pallas(wcfg))
+    for flavor, streams in ref.items():
+        agree = total = 0
+        for r, g in zip(streams, got[flavor]):
+            assert len(r) == len(g), flavor
+            total += len(r)
+            agree += sum(1 for a, b in zip(r, g) if a == b)
+        assert agree / total >= AGREEMENT_PIN, (flavor, agree / total)
+
+
+def test_pallas_dispatch_flavors_exactly_self_consistent():
+    """Within attn_kernel="pallas" the scheduler equivalences hold
+    EXACTLY: ticked == fused == mixed (one kernel, one reduction order,
+    regardless of which dispatch program ran the read)."""
+    cfg = _pallas(transformer.tiny(max_seq=96))
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+
+    def ticked():
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+        rids = [b.admit(p, n) for p, n in _FULL_REQS]
+        b.run_until_drained()
+        return [b.completed[r] for r in rids]
+
+    def fused():
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+        rids = [b.admit_chunked(p, n, chunk=16) for p, n in _FULL_REQS]
+        _drain_fused(b)
+        return [b.completed[r] for r in rids]
+
+    def mixed():
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16)
+        rids = [b.admit_chunked(p, n, chunk=16) for p, n in _FULL_REQS]
+        _drain_mixed(b)
+        return [b.completed[r] for r in rids]
+
+    t, f, m = ticked(), fused(), mixed()
+    assert t == f == m
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_pallas_decode_logit_error_bounded(kv_dtype, bparams):
+    """Decode-step logits through the kernel vs the XLA gather, on the
+    REAL bf16 config at head_dim 128 (both kv dtypes): bounded relative
+    error, the same pin shape the int8 cache carries."""
+    base = dataclasses.replace(BCFG, kv_dtype=kv_dtype)
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+                          [5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]],
+                         jnp.int32)
+    logits = {}
+    for cfg in (base, _pallas(base)):
+        pools = transformer.init_paged_kv(cfg, n_pages=2 * 4 + 1,
+                                          page_size=16)
+        table = np.zeros((2, cfg.max_seq // 16), np.int32)
+        table[0, :4] = [1, 2, 3, 4]
+        table[1, :4] = [5, 6, 7, 8]
+        toks = jnp.pad(prompt, ((0, 0), (0, 4)))     # one-page align
+        _, pools = transformer.forward_paged_prefill_batch(
+            bparams, toks, cfg, pools, jnp.asarray(table),
+            jnp.zeros((2,), jnp.int32), jnp.asarray([11, 11], jnp.int32))
+        step, _ = transformer.forward_paged_decode(
+            bparams, jnp.asarray([[7], [9]], jnp.int32), cfg, pools,
+            jnp.asarray(table), jnp.asarray([12, 12], jnp.int32))
+        logits[cfg.attn_kernel] = np.asarray(step[:, 0], np.float32)
+    diff = np.abs(logits["xla"] - logits["pallas"]).max()
+    assert diff <= LOGIT_REL_PIN * np.abs(logits["xla"]).max(), diff
+    assert (logits["xla"].argmax(-1) == logits["pallas"].argmax(-1)).all()
+
+
+def test_bench_scenario_smoke(bparams):
+    """The bench_all kernel-vs-gather scenario runs at tiny sizes and
+    reports all four (kv_dtype, attn_kernel) cells (tier-1-safe; the
+    speedup claim is for the committed TPU run — the CPU arm is
+    interpret-mode, overhead-only)."""
+    import bench_all
+
+    out = bench_all.paged_attn_bench(
+        bparams, BCFG, page_size=16, slots=2, prompt_len=3, gen=5,
+        decode_chunk=2, reps=1)
+    for kv_dtype in ("bf16", "int8"):
+        for kernel in ("xla", "pallas"):
+            assert out[kv_dtype][kernel] > 0, (kv_dtype, kernel)

@@ -130,6 +130,10 @@ def summarize_serving(parsed: dict) -> dict:
         "kv_cache_bytes": _gauge(parsed, "tpushare_kv_cache_bytes"),
         "kv_dtype": _info_label(parsed, "tpushare_kv_dtype_info",
                                 "kv_dtype"),
+        # which attention READ path the tenant's storage runs ("xla"
+        # dense gather vs the "pallas" fused paged-decode kernel)
+        "attn_kernel": _info_label(parsed, "tpushare_attn_kernel_info",
+                                   "attn_kernel"),
         # mixed-step scheduler: mid-prefill queue depth and how full the
         # last round's coalesced prefill block was
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
@@ -163,11 +167,11 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "PREFILL Q", "BUDGET%"]]
+              "KV BYTES(dtype)", "ATTN", "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -187,6 +191,7 @@ def render_metrics_table(
             _fmt(summary["occupancy"], 100.0, "%", 0),
             kv,
             kv_bytes,
+            summary.get("attn_kernel") or "-",
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])

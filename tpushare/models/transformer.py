@@ -59,6 +59,17 @@ class ModelConfig:
     #: tests/test_kv_quant.py); params/activations are untouched —
     #: weight quantization composes independently (ops.quant).
     kv_dtype: str = "bf16"
+    #: paged-pool attention READ path: "xla" (gather the dense view
+    #: transiently, then ``cached_attention`` — bit-identical to the
+    #: dense cache path) or "pallas" (the fused page-walk kernel,
+    #: ``ops.attention.paged_decode_attention``: int8 dequant in
+    #: register + online softmax, no dense transient).  "pallas" is
+    #: accuracy-bounded vs "xla", not bit-identical (reassociated
+    #: reductions — the same contract as kv_dtype="int8"); dispatch
+    #: flavors WITHIN each path stay exactly self-consistent.  Dense
+    #: (non-paged) storage ignores the knob.  Default stays "xla"
+    #: until the chip record lands (drives/drive_paged_attn.py).
+    attn_kernel: str = "xla"
 
     def __post_init__(self):
         if self.window is not None and self.window < 1:
@@ -69,6 +80,9 @@ class ModelConfig:
         if self.kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
                              f"got {self.kv_dtype!r}")
+        if self.attn_kernel not in ("xla", "pallas"):
+            raise ValueError(f"attn_kernel must be 'xla' or 'pallas', "
+                             f"got {self.attn_kernel!r}")
 
     @property
     def head_dim(self) -> int:
@@ -653,10 +667,20 @@ def init_paged_kv(cfg: ModelConfig, n_pages: int, page_size: int):
 def _paged_gather(pool, page_table):
     """pool [n_pages, Hkv, P, D] + table [B, pages] -> [B, Hkv, pages*P, D].
 
-    The gather materializes a dense per-layer view TRANSIENTLY (inside the
-    layer scan, freed after the layer) — attention reads the whole KV
-    anyway, so HBM traffic matches the dense path; only the persistent
-    pool shrinks.
+    The gather materializes a dense per-layer view TRANSIENTLY (inside
+    the layer scan, freed after the layer), so only the persistent pool
+    shrinks — but "transient" is not free: the peak-live cost per layer
+    is the full K+V dense view in cfg.dtype (write + re-read it, on top
+    of the pool read; see :func:`paged_read_transient_bytes`, surfaced
+    in ``storage_info()["attn_read_transient_bytes"]``), and with an
+    int8 pool the dequantized copy is BF16-sized — the chip moves
+    int8-read + bf16-write + bf16-read where one int8 read would do,
+    surrendering most of the quantized cache's bandwidth win.  The
+    ``attn_kernel="pallas"`` read path deletes this transient entirely
+    (:func:`paged_attention`).  This function is the ONE sanctioned
+    pool-through-table gather (lint-enforced in
+    tests/test_metric_lint.py); every paged read must route through
+    :func:`paged_attention` so the knob actually governs the path.
     """
     g = pool[page_table]                        # [B, pages, Hkv, P, D]
     b, npg, hkv, p, d = g.shape
@@ -670,6 +694,64 @@ def _paged_gather_deq(store, page_table, cfg: ModelConfig):
     in the last dim)."""
     return _kv_unpack(
         _smap(lambda p: _paged_gather(p, page_table), store), cfg)
+
+
+def paged_read_transient_bytes(cfg: ModelConfig, rows: int,
+                               attn_kernel: Optional[str] = None) -> int:
+    """Peak-live bytes the XLA gather path materializes PER LAYER for
+    one paged attention read over ``rows`` table rows: the K and V
+    dense views the softmax actually consumes, [rows, H, max_seq, D]
+    in cfg.dtype — FULL q-head width, because the gather path expands
+    GQA K/V via ``_expand_kv`` before ``cached_attention`` (another
+    H/Hkv× the kernel path never pays), and always the COMPUTE dtype,
+    because :func:`_paged_gather_deq` dequantizes the whole view
+    before attention, which is exactly why an int8 pool's transient is
+    as big as a bf16 pool's.  0 under the Pallas kernel path (pages
+    stream through VMEM).  ``attn_kernel`` overrides the config's knob
+    with the EFFECTIVE read path (callers that know a pallas config
+    fell back to the gather — see
+    ``PagedContinuousBatcher.storage_info``).  This is
+    transient-activation accounting in cfg.dtype, NOT persistent-pool
+    byte math — the persistent model stays
+    ``ops.quant.kv_cache_bytes``."""
+    if (attn_kernel or cfg.attn_kernel) == "pallas":
+        return 0
+    kv_pair = 2
+    elems = (kv_pair * rows * cfg.n_heads * cfg.max_seq
+             * cfg.head_dim)
+    return int(elems * jnp.dtype(cfg.dtype).itemsize)
+
+
+def paged_attention(q, k_store, v_store, page_table, positions,
+                    cfg: ModelConfig):
+    """THE paged-pool attention read dispatcher — every paged forward
+    flavor (decode tick, prefill chunk, coalesced prefill batch, page
+    ring, prefix cache) routes here, so ``cfg.attn_kernel`` governs one
+    site (lint-enforced: direct pool-through-table gathers outside
+    :func:`_paged_gather` fail tests/test_metric_lint.py).
+
+    "pallas" additionally falls back to the XLA gather on real TPU
+    when the pool's tiles cannot lower on Mosaic
+    (:func:`tpushare.ops.attention.paged_kernel_viable`: head_dim must
+    fill 128-lane tiles, the page the value dtype's sublane tile) or
+    when the reference escape hatch is forced."""
+    if cfg.attn_kernel == "pallas":
+        from ..ops.attention import (paged_decode_attention,
+                                     paged_kernel_viable)
+        leaf = _kv_leaf(k_store)
+        rows = (q.shape[1] // cfg.n_kv_heads) * q.shape[2]
+        if paged_kernel_viable(leaf.shape[2], leaf.shape[3],
+                               kv_quantized(cfg), cfg.dtype, rows=rows):
+            return paged_decode_attention(
+                q, k_store, v_store, page_table, positions,
+                window=cfg.window)
+    h, hkv = cfg.n_heads, cfg.n_kv_heads
+    return cached_attention(
+        q, _expand_kv(_paged_gather_deq(k_store, page_table, cfg),
+                      h // hkv),
+        _expand_kv(_paged_gather_deq(v_store, page_table, cfg),
+                   h // hkv),
+        positions, window=cfg.window)
 
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
@@ -688,7 +770,6 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     x = params["embed"][tokens].astype(cfg.dtype)
     kp, vp = pools
     page = _kv_leaf(kp).shape[3]
-    h, hkv = cfg.n_heads, cfg.n_kv_heads
     # Each slot appends at logical position `length`: page length//P,
     # lane length%P.  Distinct active slots own distinct pages, so the
     # scatter never collides (inactive slots all hit the trash page).
@@ -706,12 +787,7 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                         .set(n[:, :, 0, :]), kpool, k_st)
             vp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
                         .set(n[:, :, 0, :]), vpool, v_st)
-            o = cached_attention(
-                q, _expand_kv(_paged_gather_deq(kp2, page_table, cfg),
-                              h // hkv),
-                _expand_kv(_paged_gather_deq(vp2, page_table, cfg),
-                           h // hkv),
-                positions, window=cfg.window)
+            o = paged_attention(q, kp2, vp2, page_table, positions, cfg)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -749,7 +825,6 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
         raise ValueError("prefill window must be page-aligned")
     positions = pos + jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     x = params["embed"][tokens].astype(cfg.dtype)
-    h, hkv = cfg.n_heads, cfg.n_kv_heads
     n_chunks = s // page                        # static
     first_page = pos // page                    # traced
 
@@ -769,12 +844,8 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
                 vp2 = _smap(lambda c, n: jax.lax.dynamic_update_slice(
                     c, n[:, :, j * page:(j + 1) * page, :],
                     (pid, 0, 0, 0)), vp2, v_st)
-            o = cached_attention(
-                q, _expand_kv(_paged_gather_deq(kp2, page_rows[None], cfg),
-                              h // hkv),
-                _expand_kv(_paged_gather_deq(vp2, page_rows[None], cfg),
-                           h // hkv),
-                positions, window=cfg.window)
+            o = paged_attention(q, kp2, vp2, page_rows[None], positions,
+                                cfg)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -817,7 +888,6 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
     n_chunks = s // page                        # static
     positions = pos[:, None] + jnp.arange(s)[None, :]
     x = params["embed"][tokens].astype(cfg.dtype)
-    h, hkv = cfg.n_heads, cfg.n_kv_heads
     pids = jnp.take_along_axis(
         page_rows, (pos // page)[:, None] + jnp.arange(n_chunks)[None, :],
         axis=1)                                 # [R, n_chunks]
@@ -839,12 +909,7 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
                         kpool, k_st)
             vp2 = _smap(lambda c, n: c.at[flat_pids].set(pieces(n)),
                         vpool, v_st)
-            o = cached_attention(
-                q, _expand_kv(_paged_gather_deq(kp2, page_rows, cfg),
-                              h // hkv),
-                _expand_kv(_paged_gather_deq(vp2, page_rows, cfg),
-                           h // hkv),
-                positions, window=cfg.window)
+            o = paged_attention(q, kp2, vp2, page_rows, positions, cfg)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)

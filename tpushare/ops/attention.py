@@ -377,9 +377,13 @@ _flash_core_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
                                              "interpret", "window"))
 def flash_attention_lse(q, k, v, causal: bool = True,
                         block_q: int = 512, block_k: int = 512,
-                        interpret: bool = False, window: int = 0):
+                        interpret: Optional[bool] = None, window: int = 0):
     """Differentiable flash attention returning (out [B,H,S,D],
-    lse [B,H,S] of the scaled scores); see :func:`_flash_core_lse`."""
+    lse [B,H,S] of the scaled scores); see :func:`_flash_core_lse`.
+    ``interpret=None`` resolves via :func:`default_interpret` (compile
+    on TPU, interpret elsewhere)."""
+    if interpret is None:
+        interpret = default_interpret()
     return _flash_core_lse(q, k, v, causal, block_q, block_k, interpret,
                            window)
 
@@ -388,7 +392,7 @@ def flash_attention_lse(q, k, v, causal: bool = True,
                                              "interpret", "window"))
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 512, block_k: int = 512,
-                    interpret: bool = False, window: int = 0):
+                    interpret: Optional[bool] = None, window: int = 0):
     """Differentiable Pallas flash attention (see :func:`_flash_core`).
 
     Default 512x512 blocks: measured on a v5e at s=2048/d=128, the
@@ -403,7 +407,12 @@ def flash_attention(q, k, v, causal: bool = True,
     :func:`_fit_block`; such shapes would only lower on the interpreter,
     never on real TPU).  ``window`` > 0 adds Mistral-style sliding-window
     masking (each query sees its last ``window`` keys), with whole
-    K-blocks outside the window skipped in forward AND backward."""
+    K-blocks outside the window skipped in forward AND backward.
+    ``interpret=None`` resolves via :func:`default_interpret` (compile
+    on TPU, interpret elsewhere — hard-coding True would silently test
+    the interpreter on a TPU host)."""
+    if interpret is None:
+        interpret = default_interpret()
     return _flash_core(q, k, v, causal, block_q, block_k, interpret,
                        window)
 
@@ -598,11 +607,243 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
             dv.astype(orig_v.dtype))
 
 
+# ---------------------------------------------------------------------------
+# Pallas paged-attention decode kernel
+# ---------------------------------------------------------------------------
+def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
+                       scale: float, window: int, quantized: bool):
+    """One (batch, kv-head, table-entry) program of the paged decode
+    read: the grid's LAST dim walks the row's page table in logical
+    order (TPU grids run sequentially, so the online-softmax carry
+    lives in scratch across the walk), the page-table entry picked the
+    page block via the BlockSpec index map (scalar-prefetch), and int8
+    pages dequantize IN REGISTER — the dense gathered view and its
+    bf16 copy of the cache never exist.
+
+    Layouts (Mosaic wants (8k, 128) tiles in every block's last two
+    dims; the interpreter does not enforce this — drive_paged_attn.py
+    is the proof):
+
+    * q rides [rows, D] with rows = n_rep * S padded to the 8-row
+      sublane tile (GQA q-heads sharing this kv head, per query
+      position) and D a 128-lane multiple on real TPU;
+    * per-row query positions ride a lane-broadcast [rows, 128] int32
+      tile, exactly like the flash kernel's stats;
+    * the int8 scale leaf enters as its natural trailing-singleton
+      [page, 1] f32 block — the page dim on sublanes, the singleton
+      lane Mosaic pads to the 128-lane tile (~page * 512 B of VMEM,
+      negligible; a lane-broadcast [page, 128] copy would be a
+      pool-sized transient, the exact thing this kernel deletes).
+
+    Masking is positional, identical in structure to
+    ``cached_attention``: key position = table_index * page + lane,
+    keep = causal (and window).  A page with NO kept lanes must not
+    poison the carry: while every page so far is masked, m stays
+    NEG_INF and exp(s - m) would be exp(0) = 1 lane-wide, so p is
+    multiplied by the keep mask (the flash kernel avoids this case by
+    loop bounds instead; a page walk under a sliding window can hit
+    fully-masked pages BEFORE the first live one).
+    """
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc = refs
+
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, NEG_INF, jnp.float32)
+        l_sc[...] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    q = q_ref[...]                                    # [rows, D]
+    rows = q.shape[0]
+    if quantized:
+        # in-register dequant: int8 page * [page, 1] f32 scale, cast to
+        # the compute dtype so the QK^T/PV matmuls ride the MXU's
+        # native mode (bf16 x bf16 -> f32) like every other path
+        kk = (k_ref[...].astype(jnp.float32) * ks_ref[...]).astype(q.dtype)
+        vv = (v_ref[...].astype(jnp.float32) * vs_ref[...]).astype(q.dtype)
+    else:
+        kk = k_ref[...]                               # [page, D]
+        vv = v_ref[...]
+
+    s = _dotf32(q, kk, transpose_b=True) * scale      # [rows, page] f32
+    q_pos = qpos_ref[...][:, :1]                      # [rows, 1] (lane 0)
+    k_pos = j * page + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, page), 1)
+    keep = k_pos <= q_pos
+    if window:
+        keep &= k_pos > q_pos - window
+    s = jnp.where(keep, s, NEG_INF)
+
+    m, l, acc = m_sc[...], l_sc[...], acc_sc[...]     # m/l [rows, 128]
+    m_new = jnp.maximum(m, jnp.broadcast_to(
+        s.max(axis=-1, keepdims=True), m.shape))
+    # keep-multiply: see docstring (fully-masked pages at m == NEG_INF)
+    p = jnp.exp(s - m_new[:, :1]) * keep.astype(jnp.float32)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.broadcast_to(
+        p.sum(axis=-1, keepdims=True), l.shape)
+    acc_new = acc * alpha[:, :1] + _dotf32(p.astype(vv.dtype), vv)
+    m_sc[...], l_sc[...], acc_sc[...] = m_new, l_new, acc_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[...] = (acc_new
+                      / jnp.maximum(l_new[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+#: Max query ROWS (n_rep * S, pre-padding) one kernel program holds on
+#: real TPU: the whole row dim rides a single block plus three
+#: [rows, 128] f32 scratches, so VMEM (~16 MiB) bounds it.  2048 rows
+#: ≈ 5.5 MiB of blocks+scratch at D=128 — the shape the committed
+#: drive proves (prompt 1024 × n_rep 2); past it the dispatcher falls
+#: back to the gather (long whole-prompt prefills) rather than letting
+#: Mosaic die at the first long admit.  Decode (S=1) never comes close.
+PAGED_KERNEL_MAX_ROWS = 2048
+
+
+def paged_kernel_viable(page: int, head_dim: int, quantized: bool,
+                        dtype, rows: int = 1) -> bool:
+    """THE Mosaic-viability gate for :func:`paged_decode_attention` on a
+    REAL TPU (interpret mode enforces no tiling, so off-TPU callers run
+    the kernel at any shape): the pool's last two dims (page, head_dim)
+    are the kernel's K/V block, so head_dim must fill 128-lane tiles —
+    padding it would materialize a padded copy of the POOL, the exact
+    transient the kernel deletes — the page must fill the value dtype's
+    sublane tile (int8 tiles are 32 rows, bf16 16, f32 8), and the
+    query-row block (``rows`` = n_rep * S) must fit VMEM
+    (:data:`PAGED_KERNEL_MAX_ROWS`).  Callers fall back to the XLA
+    gather when this returns False."""
+    if FORCE_REFERENCE:
+        return False
+    if not _on_tpu():
+        return True
+    if head_dim % 128:
+        return False
+    if rows > PAGED_KERNEL_MAX_ROWS:
+        return False
+    sublane = 32 if quantized else (8 if jnp.dtype(dtype).itemsize == 4
+                                    else 16)
+    return page % sublane == 0
+
+
+def paged_decode_attention(q, k_store, v_store, page_table, positions,
+                           window: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Paged-pool attention read as ONE memory-bound Pallas pass.
+
+    q: [B, H, S, D] (S = 1 decode, or a prefill window attending its
+    own freshly-written pages plus history); k_store / v_store: a pool
+    [n_pages, Hkv, page, D] in the compute dtype, or the round-8 int8
+    store {"q": int8 [n_pages, Hkv, page, D], "s": f32 [..., 1]};
+    page_table: [B, max_seq // page] int32 logical page order (0-padded
+    — page 0 is the trash page, masked positionally like every other
+    out-of-range key); positions: [B, S] query positions.  Returns
+    [B, H, S, D].
+
+    vs the XLA gather path (``transformer._paged_gather``): no dense
+    [B, pages, Hkv, page, D] transient, no bf16 copy of an int8 cache —
+    the chip reads int8 + scales once, dequantizes in register, and
+    accumulates with an online softmax.  NOT bit-identical to the
+    gather path (block-wise reassociated reductions); equivalence is
+    accuracy-bounded + greedy-agreement-pinned (tests/test_paged_attn
+    .py), while dispatch flavors WITHIN this path stay exactly
+    self-consistent.  GQA is native: K/V pages are read once per
+    kv-head, never expanded.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = default_interpret()
+    quantized = isinstance(k_store, dict)
+    kq = k_store["q"] if quantized else k_store
+    vq = v_store["q"] if quantized else v_store
+    b, h, s, d = q.shape
+    hkv, page = kq.shape[1], kq.shape[2]
+    if h % hkv:
+        raise ValueError(f"GQA needs n_heads % n_kv_heads == 0, "
+                         f"got {h} % {hkv}")
+    n_rep = h // hkv
+    rows = n_rep * s
+    rows_p = max(8, -(-rows // 8) * 8)
+    scale = 1.0 / np.sqrt(d)
+    win = int(window or 0)
+
+    # rows = the q heads sharing one kv head, per query position:
+    # head kh*n_rep + r lands on row r*S + s_i of kv-head kh's block
+    qr = q.reshape(b, hkv, n_rep, s, d).reshape(b, hkv, rows, d)
+    qpos = jnp.tile(jnp.asarray(positions, jnp.int32), (1, n_rep))
+    if rows_p != rows:
+        # padded rows attend position 0 of the trash/first page with a
+        # zero query — finite softmax, sliced away below
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, rows_p - rows), (0, 0)))
+        qpos = jnp.pad(qpos, ((0, 0), (0, rows_p - rows)))
+    qpos = jnp.broadcast_to(qpos[:, :, None], (b, rows_p, 128))
+
+    n_pg = page_table.shape[1]
+    pool_spec = pl.BlockSpec(
+        (None, None, page, d), lambda bb, hh, j, tbl: (tbl[bb, j], hh, 0, 0))
+    scale_spec = pl.BlockSpec(
+        (None, None, page, 1), lambda bb, hh, j, tbl: (tbl[bb, j], hh, 0, 0))
+    in_specs = [
+        pl.BlockSpec((None, rows_p, 128), lambda bb, hh, j, tbl: (bb, 0, 0)),
+        pl.BlockSpec((None, None, rows_p, d),
+                     lambda bb, hh, j, tbl: (bb, hh, 0, 0)),
+        pool_spec,
+    ]
+    args = [qpos, qr, kq]
+    if quantized:
+        in_specs.append(scale_spec)
+        args.append(k_store["s"])
+    in_specs.append(pool_spec)
+    args.append(vq)
+    if quantized:
+        in_specs.append(scale_spec)
+        args.append(v_store["s"])
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, n_pg),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, rows_p, d),
+                               lambda bb, hh, j, tbl: (bb, hh, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((rows_p, 128), jnp.float32),
+                        pltpu.VMEM((rows_p, 128), jnp.float32),
+                        pltpu.VMEM((rows_p, d), jnp.float32)],
+    )
+    kernel = functools.partial(_paged_attn_kernel, page=page, scale=scale,
+                               window=win, quantized=quantized)
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows_p, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(page_table, jnp.int32), *args)
+    out = out[:, :, :rows, :].reshape(b, hkv, n_rep, s, d)
+    return out.reshape(b, h, s, d)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
+
+
+def default_interpret() -> bool:
+    """THE interpret-mode default for every Pallas kernel in this
+    module (flash and paged): interpret exactly when the backend is not
+    a real TPU.  Call sites that hard-code ``interpret=True`` would
+    silently test the INTERPRETER on a TPU host — which does not
+    enforce Mosaic's block-layout rules (CLAUDE.md hazard) — so kernels
+    take ``interpret=None`` and resolve it here; pass an explicit bool
+    only to force one mode deliberately."""
+    return not _on_tpu()
 
 
 #: Escape hatch: force the jnp reference path even on TPU.  Flipped by

@@ -451,6 +451,9 @@ class ContinuousBatcher:
         metrics.KV_CACHE_BYTES.set(info["pool_bytes"])
         metrics.KV_DTYPE_INFO.clear()
         metrics.KV_DTYPE_INFO.set(1, kv_dtype=info["kv_dtype"])
+        metrics.ATTN_KERNEL_INFO.clear()
+        metrics.ATTN_KERNEL_INFO.set(
+            1, attn_kernel=info.get("attn_kernel", "xla"))
 
     def _observe_tick(self, t0: float) -> None:
         """Record one tick's wall time and the post-tick occupancy."""
@@ -494,7 +497,11 @@ class ContinuousBatcher:
         cfg = self.cfg
         slot_tokens = (cfg.window if self.rolling_slots else cfg.max_seq)
         bytes_per_slot = kv_cache_bytes(cfg, slot_tokens)
+        # dense slot reads never route through the paged dispatcher, so
+        # the read path is the XLA dense cached_attention regardless of
+        # cfg.attn_kernel — report what actually runs
         return {"kind": "rolling" if self.rolling_slots else "dense",
+                "attn_kernel": "xla",
                 "kv_dtype": cfg.kv_dtype,
                 "slot_tokens": int(slot_tokens),
                 "bytes_per_slot": int(bytes_per_slot),

@@ -26,11 +26,15 @@ log = logging.getLogger("tpushare.llm")
 
 
 def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
-                quantize_int4: bool = False, kv_dtype: str = "bf16"):
+                quantize_int4: bool = False, kv_dtype: str = "bf16",
+                attn_kernel: str = "xla"):
     """``kv_dtype="int8"`` stores the serving KV cache quantized
     (per-token scales, ~2x sequences per HBM byte; decode is accuracy-
     bounded, not bit-identical — see DESIGN.md "Quantized KV").
-    Orthogonal to the weight-only ``--int8``/``--int4`` flags."""
+    Orthogonal to the weight-only ``--int8``/``--int4`` flags.
+    ``attn_kernel="pallas"`` reads paged KV pools through the fused
+    Pallas decode kernel instead of the XLA gather (DESIGN.md "The
+    paged decode kernel"); dense storage ignores it."""
     import dataclasses
 
     import jax
@@ -58,6 +62,8 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
     cfg = cfgs[model_name]()
     if kv_dtype != "bf16":
         cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+    if attn_kernel != "xla":
+        cfg = dataclasses.replace(cfg, attn_kernel=attn_kernel)
     params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
     if quantize_int4:
         params = quant.quantize_params(params, bits=4)
@@ -107,6 +113,14 @@ class LLMServer:
             raise ValueError("tp > 1 requires n_slots > 0 "
                              "(tensor-parallel serving rides the "
                              "continuous batcher)")
+        if tp > 1 and getattr(cfg, "attn_kernel", "xla") == "pallas":
+            # pallas_call is not SPMD-partitionable under the tp mesh;
+            # enforced here (not just argparse) so programmatic
+            # construction fails fast too instead of dying in an
+            # opaque Mosaic/SPMD lowering error at the first tick
+            raise ValueError("attn_kernel='pallas' is single-device "
+                             "for now — use tp <= 1 or the xla read "
+                             "path (DESIGN.md fallback matrix)")
         if n_slots > 0:
             from .continuous import ContinuousService
 
@@ -508,6 +522,14 @@ def main(argv=None) -> int:
                          "bounded decode, not bit-identical); works with "
                          "every storage flavor and composes with "
                          "--int8/--int4 weights")
+    ap.add_argument("--attn-kernel", choices=("xla", "pallas"),
+                    default="xla",
+                    help="paged-pool attention read path: 'pallas' fuses "
+                         "the page gather, int8 dequant, and online "
+                         "softmax into one Pallas pass (no dense "
+                         "transient; accuracy-bounded vs 'xla', not "
+                         "bit-identical); needs --page-size to matter "
+                         "(dense storage ignores it)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--addr", default="0.0.0.0")
     ap.add_argument("--slots", type=int, default=0,
@@ -557,6 +579,11 @@ def main(argv=None) -> int:
         ap.error("--kv-pages requires --page-size")
     if args.tp > 1 and not args.slots:
         ap.error("--tp requires --slots")
+    if args.attn_kernel == "pallas" and args.tp > 1:
+        # pallas_call is not SPMD-partitionable under the tp mesh; the
+        # sharded-pool kernel is future work (DESIGN.md fallback matrix)
+        ap.error("--attn-kernel pallas is single-device for now "
+                 "(use --tp 1 or the xla read path)")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -572,7 +599,8 @@ def main(argv=None) -> int:
 
     cfg, params = build_model(args.model, args.int8,
                               quantize_int4=args.int4,
-                              kv_dtype=args.kv_dtype)
+                              kv_dtype=args.kv_dtype,
+                              attn_kernel=args.attn_kernel)
     # Health plane: on a tunnel-attached backend, run the low-frequency
     # probe loop (tiny dispatch + scalar fetch with a deadline — the
     # true barrier) so /healthz reflects the tunnel, not hope.  A

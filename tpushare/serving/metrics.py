@@ -107,6 +107,11 @@ KV_DTYPE_INFO = telemetry.gauge(
     "tpushare_kv_dtype_info",
     "KV-cache storage dtype of the live batcher (constant 1; the dtype "
     "rides the kv_dtype label, Prometheus info idiom)")
+ATTN_KERNEL_INFO = telemetry.gauge(
+    "tpushare_attn_kernel_info",
+    "Attention read path of the live batcher's KV storage (constant 1; "
+    "the path rides the attn_kernel label: 'xla' = dense gather, "
+    "'pallas' = fused paged-decode kernel, Prometheus info idiom)")
 
 # -- paged KV storage -----------------------------------------------------
 KV_PAGES_USED = telemetry.gauge(

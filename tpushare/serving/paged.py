@@ -168,6 +168,14 @@ class PagedContinuousBatcher(ContinuousBatcher):
                  pool_bytes: Optional[int] = None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
+        if mesh is not None and cfg.attn_kernel == "pallas":
+            # pallas_call is not SPMD-partitionable under the tp mesh —
+            # refuse HERE (where the mesh is known), not just in the
+            # CLI, so direct construction fails fast instead of dying
+            # in an opaque Mosaic/SPMD lowering error at the first tick
+            raise ValueError("attn_kernel='pallas' is single-device "
+                             "for now (no mesh); use the xla read "
+                             "path for tensor-parallel paged serving")
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq // page_size
         if pool_bytes is not None:
@@ -230,10 +238,31 @@ class PagedContinuousBatcher(ContinuousBatcher):
         an int8 pool prices its pages (and the ``pool_bytes`` sizing
         knob admits ~2x of them) with the same model the gauges and
         ``/usage`` reporting use."""
+        from ..ops.attention import paged_kernel_viable
         from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
         bytes_per_page = kv_cache_bytes(cfg, self.page_size)
+        # the EFFECTIVE read path, not the configured one: a pallas
+        # config whose pool cannot lower on Mosaic (page below the
+        # dtype's sublane tile, lane-unaligned head_dim) or a forced
+        # reference escape hatch runs the XLA gather — telemetry must
+        # say so, or an operator debugging HBM pressure / a flat
+        # speedup reads "pallas, transient 0" while every tick pays
+        # the dense gather
+        kernel = cfg.attn_kernel
+        if kernel == "pallas" and not paged_kernel_viable(
+                self.page_size, cfg.head_dim,
+                transformer.kv_quantized(cfg), cfg.dtype):
+            kernel = "xla"
         return {"kind": "paged", "kv_dtype": cfg.kv_dtype,
+                # the attention READ path + what the XLA gather's dense
+                # per-layer transient peaks at (0 under the Pallas
+                # kernel — the saving the kernel exists for; see
+                # transformer.paged_read_transient_bytes)
+                "attn_kernel": kernel,
+                "attn_read_transient_bytes":
+                    transformer.paged_read_transient_bytes(
+                        cfg, self.n_slots, attn_kernel=kernel),
                 "page_tokens": self.page_size,
                 "bytes_per_page": int(bytes_per_page),
                 "n_pages": self.n_pages,
