@@ -210,7 +210,7 @@ def main() -> int:
     # TPU — on the CPU fallback the numbers would describe the fallback
     # host, not the accelerator this record is about, so they stay null.
     watch["stage"] = "latency-measure"
-    ttft_s = tpot_s = None
+    ttft_s = tpot_s = queue_s = None
     if on_tpu:      # CPU fallback records nulls; don't burn degraded-run
         try:        # wall time measuring numbers the record discards
             from tpushare.serving import metrics as serving_metrics
@@ -227,6 +227,9 @@ def main() -> int:
                 engine.stop()
             ttft_s = serving_metrics.TTFT.quantile(0.5)
             tpot_s = serving_metrics.TPOT.quantile(0.5)
+            # queue-wait p50 from the request-lifecycle attribution:
+            # the submit->batch-admission half of the TTFT above
+            queue_s = serving_metrics.REQUEST_QUEUE.quantile(0.5)
             if ttft_s is not None:
                 _log(f"ttft p50 = {ttft_s * 1000:.2f} ms")
         except Exception as e:
@@ -238,7 +241,9 @@ def main() -> int:
         ttft_ms=(round(ttft_s * 1000.0, 2)
                  if ttft_s is not None else None),
         tpot_ms=(round(tpot_s * 1000.0, 3)
-                 if tpot_s is not None else None))
+                 if tpot_s is not None else None),
+        queue_wait_ms=(round(queue_s * 1000.0, 3)
+                       if queue_s is not None else None))
 
     # --- offline (device-resident) throughput: the headline ---------------
     # The tunnel-attached chip pays ~70 ms of RPC overhead PER DISPATCH
@@ -405,6 +410,11 @@ def main() -> int:
         naive_qps_source=naive_src,
     )
     result["health_state"] = _health.MONITOR.state
+    # goodput from the device-time attribution: fraction of the run's
+    # wall spent in measured device compute (null on CPU FALLBACK; a
+    # deliberately pinned cpu run still records it — the measurement is
+    # honest about its platform)
+    result["device_utilization"] = _health.recordable_device_utilization()
     watch["stage"] = "done"
     print(json.dumps(result))
     return 0

@@ -13,10 +13,27 @@ import time
 
 
 def _emit(metric, value, unit, **extra):
+    from tpushare.serving import metrics as serving_metrics
     from tpushare.telemetry import health
 
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
            **extra}
+    # request-lifecycle attribution enrichment on every record: the
+    # goodput gauge as of this record, and queue-wait p50 when the
+    # scenario drove the submit path (null otherwise / on CPU fallback)
+    rec.setdefault("device_utilization",
+                   health.recordable_device_utilization())
+    queue_s = (serving_metrics.REQUEST_QUEUE.quantile(0.5)
+               if serving_metrics.REQUEST_QUEUE.count() else None)
+    # this sweep process owns its registry: clearing after the read
+    # makes each record's p50 cover exactly ITS scenario's admissions,
+    # not a cumulative mix of every earlier scenario's
+    serving_metrics.REQUEST_QUEUE.clear()
+    rec.setdefault("queue_wait_ms",
+                   round(queue_s * 1000.0, 3)
+                   if queue_s is not None
+                   and health.MONITOR.state != health.CPU_FALLBACK
+                   else None)
     if health.MONITOR.state != health.OK:
         # a fallback/wedge fired somewhere this run: every record says
         # so, so a degraded sweep artifact explains itself

@@ -24,10 +24,18 @@ def test_bench_emits_single_json_line(tmp_path):
     lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
-    for key in ("metric", "value", "unit", "vs_baseline", "health_state"):
+    for key in ("metric", "value", "unit", "vs_baseline", "health_state",
+                "device_utilization", "queue_wait_ms"):
         assert key in rec, rec
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
     # pinned-cpu run (no tunnel dial attempted): the shared health
     # machine reports ok, not cpu_fallback — nothing failed over
     assert rec["health_state"] == "ok"
+    # request-lifecycle attribution enrichment: goodput recorded on a
+    # deliberately-pinned cpu run (only CPU_FALLBACK nulls it); the
+    # queue-wait p50 comes from the TPU-only submit-path measure, so
+    # it is null here
+    assert rec["device_utilization"] is not None
+    assert 0 < rec["device_utilization"] <= 1
+    assert rec["queue_wait_ms"] is None

@@ -159,6 +159,132 @@ def test_every_metric_has_help_text():
             f"{name} needs real HELP text"
 
 
+def test_tenant_accounting_series_registered_with_contracted_names():
+    """The per-tenant accounting plane's series exist under their
+    contracted names and kinds (what inspect --tenants and the
+    ROADMAP-3 policy loop key on)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_tenant_device_time_seconds") == "gauge"
+    assert by_name.get("tpushare_tenant_device_share") == "gauge"
+    assert by_name.get("tpushare_tenant_entitlement_share") == "gauge"
+    assert by_name.get("tpushare_tenant_fairness_index") == "gauge"
+    assert by_name.get(
+        "tpushare_tenant_share_overshoot_total") == "counter"
+    assert by_name.get("tpushare_request_queue_seconds") == "histogram"
+    assert by_name.get("tpushare_request_device_seconds") == "histogram"
+    assert by_name.get("tpushare_generated_tokens_total") == "counter"
+    assert by_name.get("tpushare_jit_retraces_total") == "counter"
+
+
+# -- label hygiene (ISSUE-6 satellite) --------------------------------------
+#: every label NAME any family may declare or observe.  Request IDs,
+#: seqs, and other per-request values are BANNED as labels (unbounded
+#: cardinality kills Prometheus); they ride flight-recorder events.
+ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
+                       "kv_dtype", "attn_kernel"}
+FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
+                         "id"}
+#: label names whose VALUES are enumerated per family (one-hot states,
+#: phase attributions) — an observation outside the enum is a typo'd
+#: series that dashboards silently miss
+ENUMERATED_VALUES = {
+    ("tpushare_backend_health_state", "state"):
+        {"ok", "degraded", "wedged", "cpu_fallback"},
+    ("tpushare_devices", "state"): {"healthy", "unhealthy"},
+    ("tpushare_device_time_seconds", "phase"):
+        {"prefill", "decode", "mixed"},
+    ("tpushare_request_device_seconds", "phase"): {"prefill", "decode"},
+    ("tpushare_hbm_grant_bytes", "over_grant"): {"true", "false"},
+    ("tpushare_hbm_peak_bytes", "over_grant"): {"true", "false"},
+}
+
+
+def _observed_label_sets():
+    """{family: [sample label dicts]} from a full registry render."""
+    from tpushare import telemetry
+
+    _registered()
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+    out = {}
+    for series, samples in parsed["samples"].items():
+        base = series
+        for suffix in ("_bucket", "_sum", "_count"):
+            if series.endswith(suffix) and series[:-len(suffix)] in {
+                    n for n, _, _ in telemetry.REGISTRY.describe()}:
+                base = series[:-len(suffix)]
+        out.setdefault(base, []).extend(labels for labels, _ in samples)
+    return out
+
+
+def test_declared_label_names_enumerated():
+    """Every family's DECLARED labels come from the allowlist — a new
+    label name is a namespace decision, made here, not ad hoc."""
+    _registered()
+    from tpushare import telemetry
+
+    for name, _, _, labels in telemetry.REGISTRY.families():
+        bad = set(labels) - ALLOWED_LABEL_NAMES
+        assert not bad, (f"{name} declares non-allowlisted label(s) "
+                        f"{sorted(bad)}; extend ALLOWED_LABEL_NAMES "
+                        f"deliberately or rename")
+
+
+def test_observed_labels_match_declaration_and_enums():
+    """Observations stay inside each family's declared label schema,
+    enumerated label values stay inside their enums, and no sample
+    anywhere carries a request-id-shaped label."""
+    from tpushare import telemetry
+
+    declared = {name: set(labels)
+                for name, _, _, labels in telemetry.REGISTRY.families()
+                if labels}
+    for family, label_sets in _observed_label_sets().items():
+        for labels in label_sets:
+            names = set(labels) - {"le"}
+            forbidden = names & FORBIDDEN_LABEL_NAMES
+            assert not forbidden, (
+                f"{family} carries unbounded-cardinality label(s) "
+                f"{sorted(forbidden)} — request-scoped values belong "
+                f"in flight-recorder events, never labels")
+            assert names <= ALLOWED_LABEL_NAMES, (
+                f"{family} sample carries non-allowlisted label(s) "
+                f"{sorted(names - ALLOWED_LABEL_NAMES)}")
+            if family in declared:
+                # a family WITH a declared schema must observe inside
+                # it, or docs/METRICS.md publishes the wrong labels
+                assert names <= declared[family], (
+                    f"{family} observes label(s) "
+                    f"{sorted(names - declared[family])} outside its "
+                    f"declared schema {sorted(declared[family])}")
+            for lname, val in labels.items():
+                enum = ENUMERATED_VALUES.get((family, lname))
+                assert enum is None or val in enum, (
+                    f"{family}{{{lname}={val!r}}} outside the "
+                    f"enumerated values {sorted(enum)}")
+
+
+def test_metrics_catalog_in_sync_with_registry():
+    """docs/METRICS.md matches the registry render byte for byte.
+    Generated in a clean subprocess: the pytest process's registry
+    accumulates test-seeded families that must not leak into (or fail)
+    the comparison."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "tpushare.telemetry.catalog"],
+        capture_output=True, text=True, timeout=300, cwd=repo, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(os.path.join(repo, "docs", "METRICS.md")) as f:
+        committed = f.read()
+    assert out.stdout == committed, (
+        "docs/METRICS.md is stale — regenerate with "
+        "`python -m tpushare.telemetry.catalog > docs/METRICS.md`")
+
+
 def test_health_plane_series_registered_with_contracted_names():
     """The backend health plane's series exist under their contracted
     names and kinds (what /healthz dashboards, the kubelet probe

@@ -1,0 +1,453 @@
+"""Per-tenant accounting plane + request-lifecycle attribution (ISSUE-6).
+
+Tentpole acceptance: request IDs thread submit -> tick -> delivery (a
+stalled dispatch's flight-recorder event NAMES the requests it wedged);
+queue-wait / per-request device-time / token histograms fill through
+the real batcher; ``contract.report_usage`` carries device-time,
+goodput, qps, and stall fields; the daemon aggregates per-tenant
+device-time share vs HBM-fraction entitlement with a Jain fairness
+index and a share-overshoot counter; ``kubectl inspect tpushare
+--tenants`` renders the table for two fake tenants with the
+overshooting one flagged.  Satellites covered here: the
+``/debug/events?since=`` cursor and the ``tpushare_jit_retraces_total``
+counter.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare import telemetry
+from tpushare.plugin import const, status
+from tpushare.plugin.status import StatusServer, aggregate_tenants
+from tpushare.runtime import contract
+from tpushare.telemetry import health
+from tpushare.telemetry.events import RECORDER
+
+GIB = 2 ** 30
+
+
+@pytest.fixture(autouse=True)
+def _isolate_monitor():
+    """Monitor/recorder are process-global; stall drills here must not
+    leak WEDGED state or tiny deadlines into the rest of the suite —
+    and these tests must not inherit whatever state the previous
+    module left, so reset on the way in too."""
+    prior_deadline = health.MONITOR.dispatch_deadline_s
+    health.MONITOR.reset()
+    yield
+    health.MONITOR.dispatch_deadline_s = prior_deadline
+    health.MONITOR.reset()
+    RECORDER.clear()
+    telemetry.set_enabled(True)
+
+
+def _wait_for(cond, timeout=10.0, interval=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _tenant_env(port, pod, fraction="0.500000"):
+    return {
+        "TPU_VISIBLE_CHIPS": "0",
+        "XLA_PYTHON_CLIENT_MEM_FRACTION": fraction,
+        "ALIYUN_COM_TPU_MEM_IDX": "0",
+        "ALIYUN_COM_TPU_MEM_POD": "8",
+        "ALIYUN_COM_TPU_MEM_CONTAINER": "8",
+        "ALIYUN_COM_TPU_MEM_DEV": "16",
+        "HOSTNAME": pod,
+        "TPUSHARE_STATUS_PORT": str(port),
+    }
+
+
+class FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def _post_usage(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/usage",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status
+
+
+def _report(pod, fraction, device_time_s, qps=1.0, stalls=0,
+            peak_gib=1, grant_gib=8):
+    """A /usage body shaped like contract.report_usage's."""
+    return {"pod": pod, "chip": 0,
+            "grant_bytes": grant_gib * GIB, "peak_bytes": peak_gib * GIB,
+            "limit_bytes": 16 * GIB, "enforced": False,
+            "hbm_fraction": fraction, "device_time_s": device_time_s,
+            "device_utilization": 0.5, "qps": qps,
+            "generated_tokens": 100, "stalls": stalls,
+            "health_state": "ok"}
+
+
+# ------------------------------------------------------ share aggregation
+def test_aggregate_tenants_fair_pair_scores_one():
+    agg = aggregate_tenants([_report("a", 0.5, 60.0),
+                             _report("b", 0.5, 60.0)])
+    assert agg["fairness_index"] == pytest.approx(1.0)
+    for t in agg["tenants"].values():
+        assert t["share"] == pytest.approx(0.5)
+        assert t["entitlement"] == pytest.approx(0.5)
+        assert not t["over_share"]
+
+
+def test_aggregate_tenants_hog_flagged_and_fairness_drops():
+    # entitlements 0.5/0.5 but tenant-a takes 90% of device time
+    agg = aggregate_tenants([_report("a", 0.5, 90.0),
+                             _report("b", 0.5, 10.0)])
+    a, b = agg["tenants"]["a"], agg["tenants"]["b"]
+    assert a["share"] == pytest.approx(0.9)
+    assert a["over_share"] and not b["over_share"]
+    # Jain over normalized shares (1.8, 0.2): (2.0)^2 / (2 * 3.28)
+    assert agg["fairness_index"] == pytest.approx(4.0 / 6.56)
+
+
+def test_aggregate_tenants_unequal_entitlements_respected():
+    # a bought 3x the chip b did and uses exactly 3x the time: fair
+    agg = aggregate_tenants([_report("a", 0.75, 90.0),
+                             _report("b", 0.25, 30.0)])
+    assert agg["fairness_index"] == pytest.approx(1.0)
+    assert not any(t["over_share"] for t in agg["tenants"].values())
+
+
+def test_aggregate_tenants_tolerates_missing_fields():
+    # legacy HBM-only report (no device_time_s): excluded from shares
+    agg = aggregate_tenants([
+        {"pod": "old", "grant_bytes": GIB, "peak_bytes": GIB},
+        _report("new", 0.5, 10.0)])
+    assert set(agg["tenants"]) == {"new"}
+    # single tenant: trivially fair
+    assert agg["fairness_index"] == pytest.approx(1.0)
+    # nobody reporting device time at all -> no index
+    assert aggregate_tenants([])["fairness_index"] is None
+
+
+# ------------------------------------------------ report_usage new fields
+def test_report_usage_carries_serving_accounting():
+    seen = {}
+    srv = StatusServer(0, on_usage=lambda reports: seen.update(reports))
+    srv.start()
+    try:
+        # put some real device time on the books for this process
+        with health.MONITOR.dispatch_guard("decode"):
+            time.sleep(0.01)
+        env = _tenant_env(srv.port, "tenant-a")
+        dev = FakeDevice({"bytes_limit": 16 * GIB,
+                          "peak_bytes_in_use": 2 * GIB})
+        assert contract.report_usage(device=dev, env=env)
+        rep = seen["tenant-a"]
+        assert rep["hbm_fraction"] == pytest.approx(0.5)
+        assert rep["device_time_s"] > 0
+        assert rep["device_utilization"] is not None
+        # the stall counter is process-global and cumulative — earlier
+        # wedge drills in a full-suite run legitimately incremented it;
+        # the report must MIRROR it, whatever it is
+        assert rep["stalls"] == int(health.DISPATCH_STALLS.value())
+        assert rep["health_state"] == "ok"
+        # generated_tokens/qps are zero/None in a process that never
+        # served, but the KEYS ride the report (the daemon's columns)
+        assert "generated_tokens" in rep and "qps" in rep
+    finally:
+        srv.stop()
+
+
+def test_share_overshoot_counter_and_flight_event():
+    srv = StatusServer(0).start()
+    RECORDER.clear()
+    try:
+        before = status.counters()[
+            "tpushare_tenant_share_overshoot_total"]
+        assert _post_usage(srv.port, _report("fair", 0.5, 10.0)) == 200
+        assert _post_usage(srv.port, _report("hog", 0.5, 90.0)) == 200
+        assert status.counters()[
+            "tpushare_tenant_share_overshoot_total"] == before + 1
+        ev = next(e for e in RECORDER.events()
+                  if e["kind"] == "share_overshoot")
+        assert ev["pod"] == "hog" and ev["share"] > ev["entitlement"]
+    finally:
+        srv.stop()
+
+
+def test_daemon_metrics_export_tenant_series():
+    srv = StatusServer(0).start()
+    try:
+        _post_usage(srv.port, _report("a", 0.5, 30.0))
+        _post_usage(srv.port, _report("b", 0.5, 10.0))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        parsed = telemetry.parse_text(body)
+        time_samples = dict(
+            (labels["tenant"], v) for labels, v in
+            parsed["samples"]["tpushare_tenant_device_time_seconds"])
+        assert time_samples == {"a": 30.0, "b": 10.0}
+        shares = dict(
+            (labels["tenant"], v) for labels, v in
+            parsed["samples"]["tpushare_tenant_device_share"])
+        assert shares["a"] == pytest.approx(0.75)
+        fairness = parsed["samples"][
+            "tpushare_tenant_fairness_index"][0][1]
+        assert 0 < fairness < 1.0
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- inspect --tenants e2e
+def test_inspect_tenants_end_to_end(monkeypatch, capsys):
+    """ISSUE-6 acceptance: two fake tenants' share vs entitlement and
+    the Jain index render per node, with the overshooting tenant
+    flagged — table and json."""
+    from fakes.apiserver import FakeApiServer
+    from test_inspect import make_node
+    from tpushare.inspect import metricsview
+    from tpushare.inspect.main import main as inspect_main
+    from tpushare.k8s.client import KubeClient
+    import tpushare.inspect.main as im
+
+    srv = StatusServer(0).start()
+    api = FakeApiServer().start()
+    try:
+        # two fake tenants sharing one chip 50/50; "hog" takes 90% of
+        # the measured device time — the advisory-caps scenario
+        _post_usage(srv.port, _report("fair", 0.5, 10.0))
+        _post_usage(srv.port, _report("hog", 0.5, 90.0, peak_gib=9))
+        api.nodes["node-a"] = make_node("node-a", ip="127.0.0.1")
+        monkeypatch.setattr(im.KubeClient, "from_env",
+                            classmethod(lambda cls: KubeClient(api.url)))
+        rc = inspect_main(["--tenants", "--metrics-port", str(srv.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Tenant accounting:" in out
+        hog = next(l for l in out.splitlines() if "hog" in l)
+        fair = next(l for l in out.splitlines() if "fair" in l)
+        assert "OVER" in hog and "HBM-OVER" in hog   # 9GiB peak > 8 grant
+        assert "OVER" not in fair and "ok" in fair
+        assert "90%" in hog and "50%" in hog         # share vs entitlement
+
+        rc = inspect_main(["-o", "json", "--tenants",
+                           "--metrics-port", str(srv.port)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        tenants = doc["nodes"][0]["tenants"]
+        assert tenants["tenants"]["hog"]["over_share"] is True
+        assert tenants["tenants"]["fair"]["over_share"] is False
+        assert 0 < tenants["fairness_index"] < 1.0
+    finally:
+        api.stop()
+        srv.stop()
+
+
+# ----------------------------------------------- /debug/events?since= tail
+def test_debug_events_since_cursor():
+    RECORDER.clear()
+    seqs = [RECORDER.record("tick", i=i) for i in range(5)]
+    srv = StatusServer(0).start()
+    try:
+        def fetch(since=None):
+            url = f"http://127.0.0.1:{srv.port}/debug/events"
+            if since is not None:
+                url += f"?since={since}"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return [json.loads(l)
+                        for l in r.read().decode().splitlines()]
+
+        full = fetch()
+        assert [e["i"] for e in full if e["kind"] == "tick"] == list(range(5))
+        tail = fetch(since=seqs[2])
+        assert [e["i"] for e in tail] == [3, 4]
+        assert fetch(since=seqs[-1]) == []
+        # malformed cursor is a 400, not a 500
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/events?since=x",
+                timeout=5)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()
+
+
+def test_events_since_survives_ring_wrap():
+    from tpushare.telemetry.events import FlightRecorder
+
+    r = FlightRecorder(capacity=4)
+    seqs = [r.record("e", i=i) for i in range(10)]
+    # cursor fell off the back: the whole ring comes back (the seq gap
+    # tells the scraper how much it lost)
+    assert [e["i"] for e in r.events_since(seqs[0])] == [6, 7, 8, 9]
+    assert [e["i"] for e in r.events_since(seqs[7])] == [8, 9]
+
+
+# -------------------------------------- request-lifecycle attribution
+def _tiny_batcher(n_slots=2):
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving.continuous import ContinuousBatcher
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return ContinuousBatcher(params, cfg, n_slots=n_slots)
+
+
+def test_request_attribution_through_batcher():
+    from tpushare.serving import metrics
+
+    b = _tiny_batcher()
+    before = {
+        "prefill": metrics.REQUEST_DEVICE_TIME.count(phase="prefill"),
+        "decode": metrics.REQUEST_DEVICE_TIME.count(phase="decode"),
+        "tokens": metrics.GENERATED_TOKENS.value(),
+    }
+    assert b.admit([1, 2, 3], 4) is not None
+    assert b.admit_chunked([4, 5, 6, 7], 3, chunk=2) is not None
+    while b.prefilling or b.slots:
+        b.tick_mixed(2, chunk=2, budget=4)
+    assert len(b.completed) == 2
+    # both requests observed per phase at completion...
+    assert metrics.REQUEST_DEVICE_TIME.count(phase="prefill") \
+        == before["prefill"] + 2
+    assert metrics.REQUEST_DEVICE_TIME.count(phase="decode") \
+        == before["decode"] + 2
+    assert metrics.REQUEST_DEVICE_TIME.sum(phase="decode") > 0
+    # ...tokens counted prompt-excluded (4 + 3), and nothing leaks
+    assert metrics.GENERATED_TOKENS.value() == before["tokens"] + 7
+    assert b._req_acct == {}
+
+
+def test_request_attribution_dropped_on_cancel():
+    from tpushare.serving import metrics
+
+    b = _tiny_batcher()
+    before = metrics.REQUEST_DEVICE_TIME.count(phase="decode")
+    rid = b.admit([1, 2, 3], 8)
+    b.tick()
+    assert b.cancel(rid)
+    b._acct_flush()
+    assert rid not in b._req_acct
+    assert metrics.REQUEST_DEVICE_TIME.count(phase="decode") == before
+
+
+def test_service_observes_queue_wait():
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving import metrics
+    from tpushare.serving.continuous import ContinuousService
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    before = metrics.REQUEST_QUEUE.count()
+    svc = ContinuousService(params, cfg, n_slots=2).start()
+    try:
+        sinks = [svc.submit([1, 2, 3], 3) for _ in range(3)]
+        outs = [s.get(timeout=60) for s in sinks]
+        assert all(o is not None for o in outs)
+    finally:
+        svc.stop()
+    assert metrics.REQUEST_QUEUE.count() == before + 3
+
+
+def test_stalled_dispatch_names_request_ids(monkeypatch, tmp_path):
+    """The flight-recorder story the tentpole promises: a wedged
+    dispatch's events carry the rids it stranded."""
+    monkeypatch.setenv("TPUSHARE_FLIGHT_DIR", str(tmp_path))
+    health.MONITOR.reset()
+    RECORDER.clear()
+    health.MONITOR.dispatch_deadline_s = 0.3
+
+    b = _tiny_batcher()
+    rid = b.admit([1, 2, 3], 8)
+    assert rid is not None
+    release = threading.Event()
+    real_step = b._step
+
+    def hung_step(*a, **k):
+        release.wait()            # a dead-tunnel fetch
+        return real_step(*a, **k)
+
+    b._step = hung_step
+    t = threading.Thread(target=b.tick, daemon=True)
+    t.start()
+    try:
+        assert _wait_for(lambda: health.MONITOR.state == health.WEDGED)
+        stall = next(e for e in RECORDER.events()
+                     if e["kind"] == "dispatch_stall")
+        begin = next(e for e in RECORDER.events()
+                     if e["kind"] == "dispatch_begin"
+                     and e["seq"] == stall["begin_seq"])
+        assert begin["rids"] == [rid]
+        # the on-disk WEDGED snapshot names them too
+        lines = [json.loads(l)
+                 for l in open(health.MONITOR.last_snapshot_path)]
+        assert any(e.get("rids") == [rid] for e in lines)
+    finally:
+        release.set()
+        t.join(30)
+
+
+def test_engine_requests_ride_rids_and_queue_wait():
+    import numpy as np
+
+    from tpushare.models import bert
+    from tpushare.serving import InferenceEngine, metrics
+
+    import jax
+
+    cfg = bert.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(lambda t: bert.forward(params, t, cfg),
+                          batch_size=2, seq_len=8)
+    q_before = metrics.REQUEST_QUEUE.count()
+    d_before = metrics.REQUEST_DEVICE_TIME.count(phase="prefill")
+    eng.start()
+    try:
+        sinks = [eng.submit(np.arange(8, dtype=np.int32))
+                 for _ in range(4)]
+        assert all(s.get(timeout=60) is not None for s in sinks)
+    finally:
+        eng.stop()
+    assert metrics.REQUEST_QUEUE.count() == q_before + 4
+    assert metrics.REQUEST_DEVICE_TIME.count(phase="prefill") \
+        == d_before + 4
+
+
+# --------------------------------------------------------- retrace counter
+def test_jit_retrace_counter_sees_new_program():
+    from tpushare.serving import continuous, metrics
+
+    b = _tiny_batcher()
+    b.admit([1, 2, 3], 12)
+    b.tick()
+    # the scan runs on a tick throttle in production
+    # (DERIVED_OBSERVE_EVERY); drive it directly at each checkpoint
+    continuous._observe_retraces()      # baseline at first observation
+    base = metrics.JIT_RETRACES.value()
+    b.tick()                            # same program: no growth
+    continuous._observe_retraces()
+    assert metrics.JIT_RETRACES.value() == base
+    # a NEW static arg (a fused n_steps no other test uses) compiles a
+    # new program — the cache growth the counter exists to surface
+    odd_steps = 11
+    while b.slots:
+        b.tick_fused(odd_steps)
+    continuous._observe_retraces()
+    assert metrics.JIT_RETRACES.value() > base

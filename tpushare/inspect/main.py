@@ -63,6 +63,11 @@ def main(argv=None) -> int:
                     help="also fetch each node's /metrics and render "
                          "serving stats (qps, TTFT p50/p99, occupancy, "
                          "KV-page utilization)")
+    ap.add_argument("-t", "--tenants", action="store_true",
+                    help="also fetch each node's /metrics and render the "
+                         "per-tenant accounting table (device-time share "
+                         "vs HBM-fraction entitlement, Jain fairness "
+                         "index, overshoot flags)")
     ap.add_argument("--metrics-port",
                     default=str(metricsview.DEFAULT_METRICS_PORT),
                     help="comma-separated port(s) of per-node /metrics "
@@ -84,6 +89,9 @@ def main(argv=None) -> int:
     metrics_rows = (metricsview.gather_metrics_rows(infos,
                                                     args.metrics_port)
                     if args.metrics else None)
+    tenant_rows = (metricsview.gather_tenant_rows(infos,
+                                                  args.metrics_port)
+                   if args.tenants else None)
     if args.output == "json":
         import json
 
@@ -120,6 +128,15 @@ def main(argv=None) -> int:
             for entry in out["nodes"]:
                 if entry["name"] in by_name:
                     entry["serving"] = by_name[entry["name"]]
+        if tenant_rows is not None:
+            # the per-tenant accounting view: share vs entitlement +
+            # fairness per node; dead nodes carry the uniform error key
+            by_name = {name: (summary if summary is not None
+                              else {"error": err, "tenants": {}})
+                       for name, _, summary, err in tenant_rows}
+            for entry in out["nodes"]:
+                if entry["name"] in by_name:
+                    entry["tenants"] = by_name[entry["name"]]
         json.dump(out, sys.stdout, indent=2)
         print()
         return 0
@@ -128,6 +145,9 @@ def main(argv=None) -> int:
     if metrics_rows is not None:
         sys.stdout.write("\n")
         sys.stdout.write(metricsview.render_metrics_table(metrics_rows))
+    if tenant_rows is not None:
+        sys.stdout.write("\n")
+        sys.stdout.write(metricsview.render_tenants_table(tenant_rows))
     return 0
 
 

@@ -142,6 +142,44 @@ def summarize_serving(parsed: dict) -> dict:
     }
 
 
+def summarize_tenants(parsed: dict) -> dict:
+    """The per-tenant accounting one node's exposition distills to —
+    the scrape-side mirror of the daemon's ``aggregate_tenants``
+    (tpushare/plugin/status.py): device-time share vs HBM-fraction
+    entitlement per tenant, the node's Jain fairness index, and the
+    HBM grant/peak columns keyed by the same pod name.  ``{}``-tenant
+    result means the node's daemon has no usage reports (no tenant ran
+    ``contract.report_usage``)."""
+    tenants: Dict[str, dict] = {}
+
+    def fold(series: str, key: str, label: str = "tenant"):
+        for labels, value in parsed["samples"].get(series, ()):
+            name = labels.get(label)
+            if name is not None:
+                tenants.setdefault(name, {})[key] = value
+
+    fold("tpushare_tenant_device_time_seconds", "device_time_s")
+    fold("tpushare_tenant_device_share", "share")
+    fold("tpushare_tenant_entitlement_share", "entitlement")
+    fold("tpushare_hbm_grant_bytes", "hbm_grant_bytes", label="pod")
+    fold("tpushare_hbm_peak_bytes", "hbm_peak_bytes", label="pod")
+    for labels, _ in parsed["samples"].get("tpushare_hbm_grant_bytes", ()):
+        pod = labels.get("pod")
+        if pod in tenants:
+            tenants[pod]["hbm_over"] = labels.get("over_grant") == "true"
+    from ..plugin.status import SHARE_OVERSHOOT_SLACK
+    for t in tenants.values():
+        share, ent = t.get("share"), t.get("entitlement")
+        # the daemon's verdict re-derived from the exported shares with
+        # the ONE slack constant, so the CLI needs no extra series
+        t["over_share"] = bool(share is not None and ent
+                               and share > ent * SHARE_OVERSHOOT_SLACK)
+    return {
+        "fairness_index": _gauge(parsed, "tpushare_tenant_fairness_index"),
+        "tenants": tenants,
+    }
+
+
 def _fmt(v, scale: float = 1.0, suffix: str = "",
          digits: int = 2) -> str:
     if v is None:
@@ -198,6 +236,61 @@ def render_metrics_table(
     return "Serving metrics:\n" + _table(table)
 
 
+def render_tenants_table(
+        rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
+    """``rows`` = [(node, address, tenants_summary|None, error|None)] —
+    one line per (node, tenant) with device-time share vs entitlement
+    and the flag column (``OVER`` = share past entitlement+slack: the
+    measured form of the round-4 "HBM caps are advisory" finding), plus
+    the node's Jain fairness index.  Nodes without reports render a
+    placeholder row (the daemon is up but no tenant reported), dead
+    nodes a DOWN row."""
+    table = [["NAME", "TENANT", "DEVICE TIME(s)", "SHARE", "ENTITLEMENT",
+              "HBM PEAK/GRANT", "FAIRNESS", "FLAG"]]
+    for name, addr, summary, err in rows:
+        if summary is None:
+            table.append([name, "-", "DOWN", err or "unreachable",
+                          "-", "-", "-", "-"])
+            continue
+        fairness = _fmt(summary.get("fairness_index"), digits=3)
+        tenants = summary["tenants"]
+        if not tenants:
+            table.append([name, "-", "-", "-", "-", "-", fairness,
+                          "no reports"])
+            continue
+        for tenant in sorted(tenants):
+            t = tenants[tenant]
+            hbm = "-"
+            if t.get("hbm_peak_bytes") is not None:
+                hbm = (f"{_fmt_bytes(t['hbm_peak_bytes'])}/"
+                       f"{_fmt_bytes(t.get('hbm_grant_bytes'))}")
+            flags = []
+            if t.get("over_share"):
+                flags.append("OVER")
+            if t.get("hbm_over"):
+                flags.append("HBM-OVER")
+            table.append([
+                name, tenant,
+                _fmt(t.get("device_time_s")),
+                _fmt(t.get("share"), 100.0, "%", 0),
+                _fmt(t.get("entitlement"), 100.0, "%", 0),
+                hbm, fairness,
+                "+".join(flags) if flags else "ok",
+            ])
+    return "Tenant accounting:\n" + _table(table)
+
+
+def gather_tenant_rows(infos, ports, timeout: float = 3.0
+                       ) -> List[Tuple[str, str, Optional[dict],
+                                       Optional[str]]]:
+    """One (node, address, tenants_summary|None, error|None) row per
+    sharing node — same concurrent multi-port scrape-and-merge as
+    :func:`gather_metrics_rows`, distilled through
+    :func:`summarize_tenants` (the daemon port carries the tenant
+    series; workload ports merge in harmlessly)."""
+    return _gather_rows(infos, ports, summarize_tenants, timeout)
+
+
 def parse_ports(spec) -> List[int]:
     """``9102`` / ``"9102,8000"`` -> [9102, 8000] (daemon scrape port
     and/or workload-server ports)."""
@@ -222,6 +315,14 @@ def gather_metrics_rows(infos, ports, timeout: float = 3.0
     view should surface, and a sequential walk would pay the full
     timeout per dead endpoint (O(nodes x ports x timeout) on a bad day).
     """
+    return _gather_rows(infos, ports, summarize_serving, timeout)
+
+
+def _gather_rows(infos, ports, summarize, timeout: float
+                 ) -> List[Tuple[str, str, Optional[dict],
+                                 Optional[str]]]:
+    """The one scrape-merge-summarize walk behind ``--metrics`` and
+    ``--tenants`` (only the distiller differs)."""
     ports = parse_ports(ports)
     sharing = [info for info in infos if info.total_mem > 0]
     if not sharing:
@@ -239,7 +340,7 @@ def gather_metrics_rows(infos, ports, timeout: float = 3.0
             return (info.name, info.address, None,
                     f"unreachable ({type(last_err).__name__})")
         return (info.name, info.address,
-                summarize_serving(merge_parsed(got)), None)
+                summarize(merge_parsed(got)), None)
 
     from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=min(16, len(sharing))) as pool:

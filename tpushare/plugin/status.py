@@ -22,7 +22,7 @@ import threading
 import time
 
 from .. import telemetry
-from ..telemetry.events import RECORDER
+from ..telemetry.events import RECORDER, debug_events_route
 from ..telemetry.health import healthz_route
 from ..utils import stackdump
 from ..utils.httpserver import JsonHTTPServer, RawBody
@@ -40,6 +40,13 @@ _COUNTER_HELP = {
     # isolation visibility; see /usage)
     "tpushare_hbm_overshoot_total":
         "Usage reports whose observed HBM peak exceeded the grant",
+    # tenants whose device-time SHARE exceeded their HBM-fraction
+    # entitlement share (plus slack) at ingest time — the round-4
+    # "caps are advisory" finding as a measured counter, and the
+    # trigger signal for the ROADMAP-3 throttling policy
+    "tpushare_tenant_share_overshoot_total":
+        "Usage reports whose device-time share exceeded the tenant's "
+        "entitlement share by more than the slack factor",
 }
 for _name, _help in _COUNTER_HELP.items():
     # inc(0) seeds the zero-valued sample line, so a fresh daemon's
@@ -49,7 +56,8 @@ for _name, _help in _COUNTER_HELP.items():
     telemetry.counter(_name, _help).inc(0)
 
 _DEVICES = telemetry.gauge(
-    "tpushare_devices", "Advertised fake-devices by health state")
+    "tpushare_devices", "Advertised fake-devices by health state",
+    labels=("state",))
 _CHIPS = telemetry.gauge(
     "tpushare_chips", "Physical TPU chips discovered")
 # grant vs OBSERVED peak per tenant: on advisory-isolation backends this
@@ -57,10 +65,85 @@ _CHIPS = telemetry.gauge(
 _HBM_GRANT = telemetry.gauge(
     "tpushare_hbm_grant_bytes",
     "Per-tenant HBM grant from the allocation contract (reported via "
-    "/usage)")
+    "/usage)", labels=("over_grant", "pod"))
 _HBM_PEAK = telemetry.gauge(
     "tpushare_hbm_peak_bytes",
-    "Per-tenant observed HBM peak (reported via /usage)")
+    "Per-tenant observed HBM peak (reported via /usage)",
+    labels=("over_grant", "pod"))
+
+# -- per-tenant accounting plane (round 11) --------------------------------
+# The /usage ingest now carries each tenant's cumulative device time,
+# goodput, qps, and stalls alongside the HBM peak; the daemon aggregates
+# ACTUAL device-time share against the HBM-fraction ENTITLEMENT and
+# exports both, plus a Jain fairness index over the normalized shares —
+# the substrate the ROADMAP-3 enforcement loop throttles against.
+_TENANT_DEVICE_TIME = telemetry.gauge(
+    "tpushare_tenant_device_time_seconds",
+    "Per-tenant cumulative device time (dispatch residency summed over "
+    "phases) as last reported via /usage", labels=("tenant",))
+_TENANT_SHARE = telemetry.gauge(
+    "tpushare_tenant_device_share",
+    "Per-tenant fraction of ALL reporting tenants' device time (actual "
+    "use of the shared chip)", labels=("tenant",))
+_TENANT_ENTITLEMENT = telemetry.gauge(
+    "tpushare_tenant_entitlement_share",
+    "Per-tenant entitlement: the tenant's HBM fraction normalized over "
+    "all reporting tenants' fractions (what its grant says it should "
+    "consume of the shared chip)", labels=("tenant",))
+_TENANT_FAIRNESS = telemetry.gauge(
+    "tpushare_tenant_fairness_index",
+    "Jain fairness index over tenants' entitlement-normalized device-"
+    "time shares (1.0 = every tenant consumes exactly in proportion to "
+    "its entitlement; 1/n = one tenant has the whole chip)")
+
+#: a tenant is flagged over-share when actual share > entitlement share
+#: times this slack (10% grace keeps jitter from counting as overshoot)
+SHARE_OVERSHOOT_SLACK = 1.1
+
+
+def aggregate_tenants(reports) -> dict:
+    """Fold the live usage reports into the per-tenant accounting view.
+
+    ``reports``: iterables of /usage report dicts.  Share is each
+    tenant's ``device_time_s`` over the sum of all reporting tenants'
+    (cumulative residency — rate-of-change is the scraper's derivative);
+    entitlement is its ``hbm_fraction`` normalized the same way (the
+    fractions of co-tenants on one chip need not sum to 1).  The Jain
+    index is computed over ``x_i = share_i / entitlement_i``: 1.0 means
+    everyone consumes exactly in proportion to what they were granted,
+    regardless of absolute load.  Pure function (unit-tested directly);
+    returns ``{"tenants": {pod: {...}}, "fairness_index": float|None}``.
+    """
+    rs = [r for r in reports if r.get("device_time_s") is not None]
+    total_time = sum(r["device_time_s"] for r in rs)
+    total_frac = sum(r["hbm_fraction"] for r in rs
+                     if r.get("hbm_fraction"))
+    tenants = {}
+    xs = []
+    for r in rs:
+        share = (r["device_time_s"] / total_time if total_time > 0
+                 else None)
+        frac = r.get("hbm_fraction")
+        ent = (frac / total_frac if frac and total_frac else None)
+        over = bool(share is not None and ent is not None
+                    and share > ent * SHARE_OVERSHOOT_SLACK)
+        tenants[r["pod"]] = {
+            "device_time_s": r["device_time_s"],
+            "share": share,
+            "entitlement": ent,
+            "over_share": over,
+            "device_utilization": r.get("device_utilization"),
+            "qps": r.get("qps"),
+            "generated_tokens": r.get("generated_tokens"),
+            "stalls": r.get("stalls"),
+            "health_state": r.get("health_state"),
+        }
+        if share is not None and ent:
+            xs.append(share / ent)
+    fairness = None
+    if xs:
+        fairness = (sum(xs) ** 2) / (len(xs) * sum(x * x for x in xs))
+    return {"tenants": tenants, "fairness_index": fairness}
 
 _LOCK = threading.Lock()
 #: names ever routed through :func:`inc` (legacy counters() view)
@@ -119,9 +202,7 @@ class StatusServer:
             ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
             ("GET", "/debug/trace"): lambda _: (
                 200, telemetry.tracer.to_chrome()),
-            ("GET", "/debug/events"): lambda _: (
-                200, RawBody(RECORDER.to_jsonl(),
-                             "application/x-ndjson")),
+            ("GET", "/debug/events"): debug_events_route,
             ("POST", "/usage"): self._ingest_usage,
         })
         self.port = self._http.port
@@ -153,6 +234,13 @@ class StatusServer:
             except (TypeError, ValueError):
                 return None
 
+        def _flt(key):
+            v = body.get(key)
+            try:
+                return float(v) if v is not None else None
+            except (TypeError, ValueError):
+                return None
+
         rec = {"pod": str(body["pod"])[:253],      # k8s name length cap
                "chip": _num("chip"),
                "grant_bytes": _num("grant_bytes"),
@@ -161,6 +249,17 @@ class StatusServer:
                "enforced": (bool(body["enforced"])
                             if isinstance(body.get("enforced"), bool)
                             else None),
+               # serving-plane accounting (contract.serving_snapshot):
+               # same coerce-or-drop posture — tenant-supplied floats
+               "hbm_fraction": _flt("hbm_fraction"),
+               "device_time_s": _flt("device_time_s"),
+               "device_utilization": _flt("device_utilization"),
+               "qps": _flt("qps"),
+               "generated_tokens": _num("generated_tokens"),
+               "stalls": _num("stalls"),
+               "health_state": (str(body["health_state"])[:32]
+                                if body.get("health_state") is not None
+                                else None),
                "ts": time.time()}
         with _LOCK:
             self.usage_reports[rec["pod"]] = rec
@@ -174,6 +273,15 @@ class StatusServer:
             # grant is front-page material for a WEDGED post-mortem
             RECORDER.record("hbm_overshoot", pod=rec["pod"],
                             grant_bytes=grant, peak_bytes=peak)
+        agg = aggregate_tenants(reports.values())
+        me = agg["tenants"].get(rec["pod"])
+        if me is not None and me["over_share"]:
+            # the reporting tenant's device-time share exceeds its
+            # entitlement: the measured form of "caps are advisory"
+            inc("tpushare_tenant_share_overshoot_total")
+            RECORDER.record("share_overshoot", pod=rec["pod"],
+                            share=round(me["share"], 4),
+                            entitlement=round(me["entitlement"], 4))
         if self.on_usage is not None:
             try:
                 self.on_usage(reports)
@@ -238,6 +346,21 @@ class StatusServer:
                 _HBM_GRANT.set(r["grant_bytes"], **labels)
             if r.get("peak_bytes") is not None:
                 _HBM_PEAK.set(r["peak_bytes"], **labels)
+        # per-tenant accounting view: same rebuild-from-live-reports
+        # discipline (evicted tenants' series disappear)
+        _TENANT_DEVICE_TIME.clear()
+        _TENANT_SHARE.clear()
+        _TENANT_ENTITLEMENT.clear()
+        _TENANT_FAIRNESS.clear()
+        agg = aggregate_tenants(reports)
+        for pod, t in agg["tenants"].items():
+            _TENANT_DEVICE_TIME.set(t["device_time_s"], tenant=pod)
+            if t["share"] is not None:
+                _TENANT_SHARE.set(t["share"], tenant=pod)
+            if t["entitlement"] is not None:
+                _TENANT_ENTITLEMENT.set(t["entitlement"], tenant=pod)
+        if agg["fairness_index"] is not None:
+            _TENANT_FAIRNESS.set(agg["fairness_index"])
         return telemetry.REGISTRY.render()
 
     def start(self) -> "StatusServer":

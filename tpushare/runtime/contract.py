@@ -194,16 +194,52 @@ def verify_budget(device=None, env: Optional[dict] = None,
             "limit_bytes": int(limit)}
 
 
+def serving_snapshot() -> dict:
+    """This process's serving-plane accounting as the usage report
+    carries it: cumulative per-phase device time, the derived goodput
+    gauge, the engine qps gauge, generated tokens, dispatch stalls, and
+    the health state.  Read from the process-global telemetry registry
+    (stdlib — safe before jax); zeros/None when this process never
+    served anything.
+    """
+    from ..telemetry import health as _health
+
+    busy = sum(_health.DEVICE_TIME.sum(phase=p) for p in _health.PHASES)
+    util = _health.refresh_device_utilization()
+    # read-only lookups (find, not get-or-create): the serving modules
+    # may not be imported in a pure-training tenant, and peeking must
+    # not register their families with placeholder metadata
+    from ..telemetry import registry as _registry
+    qps_g = _registry.REGISTRY.find("tpushare_engine_qps")
+    tok_c = _registry.REGISTRY.find("tpushare_generated_tokens_total")
+    qps = qps_g.value() if qps_g is not None else None
+    tokens = tok_c.value() if tok_c is not None else 0
+    return {
+        "device_time_s": round(busy, 6),
+        "device_utilization": (round(util, 6)
+                               if util is not None else None),
+        "qps": qps,
+        "generated_tokens": int(tokens),
+        "stalls": int(_health.DISPATCH_STALLS.value()),
+        "health_state": _health.MONITOR.state,
+    }
+
+
 def report_usage(device=None, env: Optional[dict] = None,
                  peak_bytes: Optional[int] = None,
                  pod: Optional[str] = None,
                  timeout: float = 2.0) -> bool:
-    """POST this tenant's observed HBM peak to the node daemon's
-    ``/usage`` endpoint (the other half of :func:`verify_budget`: on an
-    advisory backend only the tenant can see its own usage, so it
-    reports — the daemon exports grant-vs-peak per pod in /metrics and
-    annotates the node for the inspect CLI).  Address comes from the
-    injected ``TPUSHARE_STATUS_PORT`` (+ optional ``_HOST``, default
+    """POST this tenant's observed usage to the node daemon's ``/usage``
+    endpoint (the other half of :func:`verify_budget`: on an advisory
+    backend only the tenant can see its own usage, so it reports — the
+    daemon exports grant-vs-peak per pod in /metrics and annotates the
+    node for the inspect CLI).  Beyond the HBM peak, the report carries
+    the serving-plane accounting (:func:`serving_snapshot`: cumulative
+    device time, goodput, qps, stalls, health state) and the tenant's
+    HBM-fraction entitlement — what the daemon aggregates into
+    per-tenant device-time SHARE vs entitlement and the Jain fairness
+    index (``kubectl inspect tpushare --tenants``).  Address comes from
+    the injected ``TPUSHARE_STATUS_PORT`` (+ optional ``_HOST``, default
     loopback — the daemon runs hostNetwork).  Best-effort: returns
     False, never raises, when unallocated or the daemon is unreachable.
     """
@@ -220,7 +256,8 @@ def report_usage(device=None, env: Optional[dict] = None,
             import jax
             device = jax.local_devices()[0]
         except Exception:
-            return False
+            device = None   # jax-less/broken-backend tenants still
+            # report: the serving accounting below is jax-free
     stats = {}
     if device is not None:
         try:
@@ -230,8 +267,10 @@ def report_usage(device=None, env: Optional[dict] = None,
     if peak_bytes is None:
         peak_bytes = stats.get("peak_bytes_in_use",
                                stats.get("bytes_in_use"))
-    if peak_bytes is None:
-        return False
+    # no peak is NOT a reason to stay silent anymore: the report is
+    # also the device-time/goodput accounting channel, and a backend
+    # without memory stats (CPU fallback: memory_stats() is None) still
+    # has device time to account for — send the report with a null peak
     # one enforcement definition: reuse verify_budget (quietly — the
     # caller already got its warning) rather than re-deriving the
     # grant/limit comparison here
@@ -248,9 +287,16 @@ def report_usage(device=None, env: Optional[dict] = None,
     body = {"pod": pod or e.get("HOSTNAME", "unknown"),
             "chip": view.chip_index,
             "grant_bytes": grant,
-            "peak_bytes": int(peak_bytes),
+            "peak_bytes": (int(peak_bytes)
+                           if peak_bytes is not None else None),
             "limit_bytes": limit,
-            "enforced": enforced}
+            "enforced": enforced,
+            # the entitlement the daemon normalizes device-time share
+            # against (the HBM fraction is THE share contract a tenant
+            # bought; SGDRC-style observe-then-control reads actual
+            # share against it)
+            "hbm_fraction": view.hbm_fraction}
+    body.update(serving_snapshot())
     host = e.get(_STATUS_HOST, "127.0.0.1")
     try:
         req = urllib.request.Request(

@@ -368,6 +368,50 @@ def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
     return bufs, buf_lens, n_ctxs, next_toks, produced, caches
 
 
+#: the jitted serving entry points the retrace counter watches — every
+#: device program a service round can dispatch
+_JIT_ENTRIES = (_wrap_keys, _prefill_chunk, _tick, _tick_n, _tick_mixed,
+                _tick_spec)
+
+#: every Nth tick runs the derived observations (goodput re-derivation,
+#: retrace scan) — cheap enough to stay inline at that cadence, >1% of
+#: a tiny-config tick if run per tick
+DERIVED_OBSERVE_EVERY = 16
+
+#: per-entry program-cache size at last observation (None until the
+#: first _observe_retraces call per process)
+_TRACE_BASELINE: Optional[Dict[int, int]] = None
+
+
+def _observe_retraces() -> None:
+    """Mirror jit program-cache GROWTH on the serving entry points into
+    ``tpushare_jit_retraces_total``.  The first observation (normally
+    right after the first tick) is the baseline — expected first
+    compiles never count; every cache entry added after that does.  A
+    new static-arg combination (a different fused ``n_steps``, the rich
+    sampler flipping on) legitimately adds ONE entry; steady growth
+    under stable traffic is the round-7 hazard this counter exists to
+    surface (a per-call wrapper re-tracing every tick, invisible at
+    ~0.6 ms without it)."""
+    global _TRACE_BASELINE
+    if not telemetry.enabled():
+        return
+    sizes = {}
+    for fn in _JIT_ENTRIES:
+        size_of = getattr(fn, "_cache_size", None)
+        if size_of is None:        # jax without the introspection API
+            return
+        sizes[id(fn)] = size_of()
+    if _TRACE_BASELINE is None:
+        _TRACE_BASELINE = sizes
+        return
+    grew = sum(max(0, n - _TRACE_BASELINE.get(k, 0))
+               for k, n in sizes.items())
+    if grew:
+        metrics.JIT_RETRACES.inc(grew)
+        _TRACE_BASELINE = sizes
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -437,6 +481,11 @@ class ContinuousBatcher:
         # tokens/rounds > 1 is the acceptance win (each round costs one
         # verify forward, like one plain tick)
         self._spec_stats = {"calls": 0, "rounds": 0, "tokens": 0}
+        # per-request lifecycle attribution: rid -> accumulated device-
+        # time shares by phase + token count, observed into the request
+        # histograms at completion (see _acct_credit/_acct_flush)
+        self._req_acct: Dict[int, dict] = {}
+        self._tick_count = 0
         self._init_storage()
         self._observe_storage()
 
@@ -460,17 +509,85 @@ class ContinuousBatcher:
         metrics.TICK_DURATION.observe(time.perf_counter() - t0)
         metrics.OCCUPANCY.set(
             len(self.slots) / self.n_slots if self.n_slots else 0.0)
-        # per-tick, not per-guard: re-deriving the goodput gauge costs a
-        # few histogram-sum locks, too much for the per-dispatch hot
-        # path but free at tick granularity (and /metrics re-derives at
-        # scrape time anyway)
-        health.refresh_device_utilization()
+        self._acct_flush()
+        self._tick_count += 1
+        if self._tick_count % DERIVED_OBSERVE_EVERY == 0:
+            # derived/diagnostic observations on a throttle, not per
+            # tick: the goodput gauge re-derives from histogram sums
+            # (three locks) and the retrace scan walks six program
+            # caches — ~40us together, which is >1% of a SMALL model's
+            # tick and pure waste at that cadence (/metrics re-derives
+            # utilization at scrape time anyway, and retrace growth is
+            # a trend, not a per-tick event)
+            health.refresh_device_utilization()
+            _observe_retraces()
 
     def _complete(self, rid: int, output: List[int]) -> None:
         """The ONE completion bookkeeping site (every tick flavor and the
         instant-finish admission path funnel through it)."""
         self.completed[rid] = output
         metrics.COMPLETIONS.inc()
+        acct = self._req_acct.get(rid)
+        if acct is not None:
+            # observed at the next _acct_flush, not here: the dispatch
+            # that finished this request is still inside its guard, so
+            # its device-time share has not been credited yet
+            acct["done_tokens"] = max(0, len(output) - acct["prompt_len"])
+
+    # -- per-request device-time attribution ---------------------------
+    def _rids(self, prefilling: bool = False) -> List[int]:
+        """Request IDs riding the next dispatch (decoding slots, plus
+        mid-prefill ones when asked) — what dispatch-guard flight events
+        and trace spans carry, so a stall names its victims."""
+        rids = [s.request_id for s in self.slots.values()]
+        if prefilling:
+            rids += [p.request_id for p in self.prefilling.values()]
+        return rids
+
+    def _acct_open(self, rid: int, prompt_len: int) -> None:
+        if telemetry.enabled():
+            self._req_acct[rid] = {"prefill_s": 0.0, "decode_s": 0.0,
+                                   "prompt_len": prompt_len,
+                                   "done_tokens": None}
+
+    def _acct_credit(self, device_s: Optional[float],
+                     decode_rids: List[int],
+                     prefill_rids: List[int] = ()) -> None:
+        """Split one guard's measured device residency equally across
+        the requests that rode the dispatch (decoding participants book
+        it as decode, prefilling ones as prefill — the mixed round's
+        one program serves both halves, so an exact per-phase split
+        does not exist; the equal split is documented in DESIGN.md)."""
+        if device_s is None:
+            return
+        n = len(decode_rids) + len(prefill_rids)
+        if not n:
+            return
+        share = device_s / n
+        for rid in decode_rids:
+            acct = self._req_acct.get(rid)
+            if acct is not None:
+                acct["decode_s"] += share
+        for rid in prefill_rids:
+            acct = self._req_acct.get(rid)
+            if acct is not None:
+                acct["prefill_s"] += share
+
+    def _acct_flush(self) -> None:
+        """Observe and drop completed requests' accumulated attribution
+        (runs at tick granularity; completion marks, flush observes —
+        so the completing dispatch's own share is included)."""
+        if not self._req_acct:
+            return
+        done = [rid for rid, a in self._req_acct.items()
+                if a["done_tokens"] is not None]
+        for rid in done:
+            a = self._req_acct.pop(rid)
+            metrics.REQUEST_DEVICE_TIME.observe(a["prefill_s"],
+                                                phase="prefill")
+            metrics.REQUEST_DEVICE_TIME.observe(a["decode_s"],
+                                                phase="decode")
+            metrics.GENERATED_TOKENS.inc(a["done_tokens"])
 
     def _observe_prefill(self) -> None:
         """Mirror the mid-prefill queue depth into /metrics (every site
@@ -620,14 +737,18 @@ class ContinuousBatcher:
         metrics.ADMISSIONS.inc()
         RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
                         max_new=max_new_tokens)
+        self._acct_open(rid, len(prompt))
 
         tokens = jnp.asarray([prompt], jnp.int32)
         with health.MONITOR.dispatch_guard("prefill",
-                                           tokens=len(prompt)):
+                                           tokens=len(prompt),
+                                           rids=[rid]) as g:
             logits_v = self._prefill_into(slot, tokens, len(prompt))
             self._activate(slot, rid, list(prompt), logits_v,
                            max_new_tokens, temperature, seed, eos_id,
                            top_k, top_p)
+        self._acct_credit(g.device_s, [], [rid])
+        self._acct_flush()
         return rid
 
     def _activate(self, slot: int, rid: int, prompt: List[int], logits_v,
@@ -705,6 +826,7 @@ class ContinuousBatcher:
         metrics.ADMISSIONS.inc()
         RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
                         max_new=max_new_tokens, chunked=True)
+        self._acct_open(rid, len(prompt))
         self.prefilling[slot] = _Prefill(
             request_id=rid, prompt=list(prompt),
             pos=self._prefill_start(slot),
@@ -757,7 +879,8 @@ class ContinuousBatcher:
         # histogram would fill with ~0 samples
         final = end >= n
         with health.MONITOR.dispatch_guard("prefill", observe=final,
-                                           tokens=len(piece)):
+                                           tokens=len(piece),
+                                           rids=[st.request_id]) as g:
             logits_v = self._prefill_chunk_into(
                 slot, padded, st.pos, len(piece) - 1, window)
             st.pos = end
@@ -766,6 +889,11 @@ class ContinuousBatcher:
                 self._activate(slot, st.request_id, st.prompt, logits_v,
                                st.max_new, st.temperature, st.seed,
                                st.eos_id, st.top_k, st.top_p)
+        # mid-prompt chunks dispatch async (device_s is None there, like
+        # the phase histogram); only the final chunk's sync point credits
+        self._acct_credit(g.device_s, [], [st.request_id])
+        if final:
+            self._acct_flush()
 
     def advance_prefill(self, max_slots: Optional[int] = None) -> int:
         """Process one chunk for mid-prefill slots — every slot by
@@ -822,15 +950,18 @@ class ContinuousBatcher:
             if s.temperature > 0.0:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
+        rids = self._rids() if telemetry.enabled() else []
         with health.MONITOR.dispatch_guard("decode",
-                                           active=len(self.slots)), \
+                                           active=len(self.slots),
+                                           rids=rids) as g, \
                 telemetry.span("batcher.tick", cat="serving",
-                               active=len(self.slots)):
+                               active=len(self.slots), rids=rids):
             nxt = np.asarray(self._step(
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
                 _wrap_keys(jnp.asarray(keys)),
                 jnp.asarray(tks), jnp.asarray(tps), self._rich()))
+        self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
@@ -874,11 +1005,14 @@ class ContinuousBatcher:
         # the guard spans dispatch AND the host fetches below — the
         # fetch is the true barrier, so this is the window that hangs
         # on a dead tunnel and the window device time is measured over
+        rids = self._rids() if telemetry.enabled() else []
         with health.MONITOR.dispatch_guard("decode",
                                            active=len(self.slots),
-                                           steps=n_steps):
+                                           steps=n_steps,
+                                           rids=rids) as g:
             with telemetry.span("batcher.tick_fused", cat="serving",
-                                active=len(self.slots), steps=n_steps):
+                                active=len(self.slots), steps=n_steps,
+                                rids=rids):
                 toks, new_keys = self._step_n(
                     jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(temps),
@@ -887,6 +1021,7 @@ class ContinuousBatcher:
                     self._rich(), n_steps)
             toks = np.asarray(toks)
             new_keys = np.asarray(jax.random.key_data(new_keys))
+        self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         self._drain_fused_tokens(toks, new_keys, n_steps)
         self._observe_tick(t0)
@@ -1041,13 +1176,21 @@ class ContinuousBatcher:
             incs[i] = 1
         # guard spans the one dispatch plus this round's lazy fetches —
         # the measured wall of the mixed round, phase-labeled "mixed"
+        if telemetry.enabled():
+            decode_rids = self._rids()
+            prefill_rids = [st.request_id for _, _, st, _ in plan]
+        else:
+            decode_rids, prefill_rids = [], []
         with health.MONITOR.dispatch_guard("mixed",
                                            active=len(self.slots),
                                            prefilling=len(plan),
-                                           steps=n_steps):
+                                           steps=n_steps,
+                                           rids=decode_rids
+                                           + prefill_rids) as g:
             with telemetry.span("batcher.tick_mixed", cat="serving",
                                 active=len(self.slots),
-                                prefilling=len(plan), steps=n_steps):
+                                prefilling=len(plan), steps=n_steps,
+                                rids=decode_rids + prefill_rids):
                 sel, toks, new_keys = self._step_mixed(
                     p_tokens, p_slots, p_active, p_pos, p_last,
                     jnp.asarray(tokens), jnp.asarray(lengths),
@@ -1063,6 +1206,7 @@ class ContinuousBatcher:
             if n_active:
                 toks = np.asarray(toks)
                 new_keys = np.asarray(jax.random.key_data(new_keys))
+        self._acct_credit(g.device_s, decode_rids, prefill_rids)
         if n_active:
             self._drain_fused_tokens(toks, new_keys, n_steps)
         # Activate rows whose chunk completed the prompt — they join the
@@ -1095,6 +1239,9 @@ class ContinuousBatcher:
         the service loop calls this for abandoned streams so a client
         that disconnected mid-stream does not keep decoding to
         completion in a slot someone else could use."""
+        # a cancelled request's partial attribution is dropped, not
+        # observed — the request histograms describe COMPLETED lifecycles
+        self._req_acct.pop(rid, None)
         for i, s in list(self.slots.items()):
             if s.request_id == rid:
                 self._release(i)
@@ -1164,9 +1311,11 @@ class ContinuousBatcher:
             next_toks[i] = s.last_token
             remainings[i] = s.remaining
             actives[i] = 1
+        rids = self._rids() if telemetry.enabled() else []
         with health.MONITOR.dispatch_guard("decode",
                                            active=len(self.slots),
-                                           spec_rounds=n_rounds):
+                                           spec_rounds=n_rounds,
+                                           rids=rids) as g:
             bufs_j, buf_lens_j, n_ctxs_j, next_toks_j, produced, \
                 self.caches = \
                 _tick_spec(self.params, jnp.asarray(bufs), self.caches,
@@ -1179,6 +1328,7 @@ class ContinuousBatcher:
             produced = np.asarray(produced)
             n_ctxs_h = np.asarray(n_ctxs_j)
             next_h = np.asarray(next_toks_j)
+        self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         for i in list(self.slots):
             s = self.slots[i]
@@ -1544,6 +1694,10 @@ class ContinuousService:
                     with self._lock:
                         self._waiting.insert(0, item)
                     break
+                # queue wait ends at ADMISSION (a slot + storage granted;
+                # prefill compute starts next round) — the backpressure
+                # half of TTFT, separated from prompt compute
+                metrics.REQUEST_QUEUE.observe(time.perf_counter() - t_sub)
                 # chunked admission never completes at admit time (even a
                 # 1-token request finishes in advance_prefill); results
                 # are delivered by the post-tick completed drain below

@@ -9,6 +9,7 @@ fixed batch so the jit cache stays warm), and a throughput probe.
 from __future__ import annotations
 
 import collections
+import itertools
 import queue
 import threading
 import time
@@ -49,11 +50,18 @@ class InferenceEngine:
         # chip).  Depth bounds per-request latency at ~depth x batch
         # time; 1 restores strictly serial behavior.
         self.pipeline_depth = max(1, pipeline_depth)
-        # (tokens, result queue, submit time) — the submit timestamp
-        # rides with the request so deliver can observe the true
-        # submit->deliver latency (TTFT for this one-shot engine)
-        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue, float]]" = \
-            queue.Queue()
+        # (tokens, result queue, submit time, request id) — the submit
+        # timestamp rides with the request so deliver can observe the
+        # true submit->deliver latency (TTFT for this one-shot engine);
+        # the request id threads submit -> batch -> dispatch -> deliver
+        # so dispatch-guard flight events and trace spans can name the
+        # requests in flight (a stalled dispatch is traceable to them)
+        self._q: "queue.Queue[Tuple[np.ndarray, queue.Queue, float, int]]" \
+            = queue.Queue()
+        # itertools.count: submit() is multi-producer (HTTP handler
+        # threads), and a duplicated rid would make the flight
+        # recorder's stall forensics name the wrong request
+        self._next_rid = itertools.count(1)
         # dispatched-but-undelivered batches; loop-owned in normal
         # operation, but engine-level so stop() can sentinel these
         # clients if the worker wedges in a device fetch (a tunnel
@@ -107,8 +115,8 @@ class InferenceEngine:
             # the DISPATCHED clients too — their results may never
             # arrive, and the zombie worker's late put_nowait will just
             # hit a full queue and be dropped.
-            for _, b in list(self._inflight):
-                for _, out_q, _ in b:
+            for _, b, _ in list(self._inflight):
+                for _, out_q, _, _ in b:
                     try:
                         out_q.put_nowait(None)
                     except queue.Full:
@@ -117,7 +125,7 @@ class InferenceEngine:
         # forever on its result queue.
         while True:
             try:
-                _, out_q, _ = self._q.get_nowait()
+                _, out_q, _, _ = self._q.get_nowait()
             except queue.Empty:
                 break
             out_q.put(None)
@@ -126,14 +134,15 @@ class InferenceEngine:
         """Enqueue one request [S]; returns a queue delivering the result."""
         out: queue.Queue = queue.Queue(maxsize=1)
         metrics.REQUESTS.inc()
-        self._q.put((tokens, out, time.perf_counter()))
+        self._q.put((tokens, out, time.perf_counter(),
+                     next(self._next_rid)))
         return out
 
     def _loop(self):
         inflight = self._inflight
 
         def deliver_oldest():
-            outputs, b = inflight.popleft()
+            outputs, b, rids = inflight.popleft()
             # host fetch, not block_until_ready (unreliable on remote
             # backends): executions are in-order per device, so pulling
             # this batch's outputs drains everything dispatched before
@@ -141,20 +150,24 @@ class InferenceEngine:
             # that hangs on a dead tunnel) and attributes device time:
             # an encoder forward is a full-context pass, phase=prefill
             with health.MONITOR.dispatch_guard("prefill",
-                                               requests=len(b)), \
+                                               requests=len(b),
+                                               rids=rids) as g, \
                     telemetry.span("engine.deliver", cat="serving",
-                                   requests=len(b)):
+                                   requests=len(b), rids=rids):
                 host = np.asarray(outputs)
             now = time.perf_counter()
-            for i, (toks, out_q, t_sub) in enumerate(b):
+            if g.device_s is not None and b:
+                # per-request attribution: this dispatch's measured
+                # device residency split equally over the requests that
+                # rode it (one-shot inference is all prefill); one
+                # batched observe — single lock on the hot path
+                metrics.REQUEST_DEVICE_TIME.observe_n(
+                    g.device_s / len(b), len(b), phase="prefill")
+            lats, tpots = [], []
+            for i, (toks, out_q, t_sub, _) in enumerate(b):
                 dt = now - t_sub
-                metrics.REQUEST_LATENCY.observe(dt)
-                # one-shot inference: the full result IS the first
-                # output, so TTFT == request latency; per-token time is
-                # the latency spread over the request's real positions
-                metrics.TTFT.observe(dt)
-                metrics.TPOT.observe(
-                    dt / max(1, min(len(toks), self.seq_len)))
+                lats.append(dt)
+                tpots.append(dt / max(1, min(len(toks), self.seq_len)))
                 try:
                     # put_nowait: if stop() already sentineled this
                     # client (hung-fetch recovery), don't wedge the
@@ -162,9 +175,16 @@ class InferenceEngine:
                     out_q.put_nowait(host[i])
                 except queue.Full:
                     pass
+            # batched observes (one lock per family, not per request):
+            # one-shot inference delivers the full result at once, so
+            # TTFT == request latency and per-token time is the latency
+            # spread over each request's real positions
+            metrics.REQUEST_LATENCY.observe_many(lats)
+            metrics.TTFT.observe_many(lats)
+            metrics.TPOT.observe_many(tpots)
 
         while not self._halt.is_set():
-            batch: List[Tuple[np.ndarray, queue.Queue, float]] = []
+            batch: List[Tuple[np.ndarray, queue.Queue, float, int]] = []
             try:
                 # stay responsive while results are pending delivery
                 batch.append(self._q.get(timeout=0.002 if inflight
@@ -187,15 +207,23 @@ class InferenceEngine:
                                  self.pad_id, dtype=np.int32)
                 mask = np.zeros((self.batch_size, self.seq_len),
                                 dtype=np.int32)
-                for i, (toks, _, _) in enumerate(batch):
+                for i, (toks, _, _, _) in enumerate(batch):
                     n = min(len(toks), self.seq_len)
                     tokens[i, :n] = toks[:n]
                     mask[i, :n] = 1
             metrics.BATCH_FILL.set(len(batch) / self.batch_size)
+            rids = [rid for _, _, _, rid in batch]
+            # queue wait ends when the request joins a dispatched batch
+            # (the engine's admission point); the remaining latency is
+            # device + delivery.  Batched observe: one lock per batch.
+            t_dispatch = time.perf_counter()
+            metrics.REQUEST_QUEUE.observe_many(
+                [t_dispatch - t_sub for _, _, t_sub, _ in batch])
             with telemetry.span("engine.dispatch", cat="serving",
-                                requests=len(batch)):
+                                requests=len(batch), rids=rids):
                 # infer_async carries its own stall guard
-                inflight.append((self.infer_async(tokens, mask), batch))
+                inflight.append((self.infer_async(tokens, mask), batch,
+                                 rids))
             if len(inflight) >= self.pipeline_depth:
                 deliver_oldest()
         while inflight:                # halt: nothing may stay undelivered

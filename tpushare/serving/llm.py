@@ -98,6 +98,7 @@ class LLMServer:
         ``mixed_step=False`` restores the sequential advance-then-fuse
         interleave."""
         from .. import telemetry
+        from ..telemetry.events import debug_events_route
         from ..telemetry.health import healthz_route
         from ..utils.httpserver import JsonHTTPServer, RawBody
 
@@ -156,9 +157,9 @@ class LLMServer:
             ("GET", "/metrics"): self._metrics,
             ("GET", "/debug/trace"): lambda _: (
                 200, telemetry.tracer.to_chrome()),
-            ("GET", "/debug/events"): lambda _: (
-                200, RawBody(telemetry.recorder.to_jsonl(),
-                             "application/x-ndjson")),
+            # ?since=<seq> tails the flight recorder incrementally
+            # (one shared route implementation with the daemon)
+            ("GET", "/debug/events"): debug_events_route,
         })
         self.port = self._http.port
 
@@ -624,6 +625,23 @@ def main(argv=None) -> int:
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill)
+    # Tenant accounting: when the allocation injected a daemon status
+    # port, report this tenant's usage (HBM peak + device-time/goodput/
+    # qps/stalls, contract.report_usage) on a low-frequency loop — the
+    # feed behind the daemon's per-tenant share-vs-entitlement view and
+    # `kubectl inspect tpushare --tenants`.  Best-effort by contract
+    # (report_usage never raises); daemon thread dies with the server.
+    if view.allocated and _os.environ.get("TPUSHARE_STATUS_PORT"):
+        interval = float(_os.environ.get("TPUSHARE_USAGE_REPORT_S", "30"))
+
+        def _report_loop():
+            while True:
+                time.sleep(interval)
+                contract.report_usage()
+
+        threading.Thread(target=_report_loop, daemon=True,
+                         name="tpushare-usage-report").start()
+        log.info("usage reporting to daemon every %.0fs", interval)
     log.info("llm server: model=%s quant=%s kv=%s tp=%d on :%d", args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
              args.kv_dtype, args.tp, srv.port)

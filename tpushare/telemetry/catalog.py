@@ -1,0 +1,69 @@
+"""Metric catalog generator: the registry rendered as docs/METRICS.md.
+
+Imports every instrumented module (the same set the metric-name lint
+imports, so the two views cannot diverge), then renders one markdown
+table row per registered family — name, type, declared labels, help.
+``tests/test_metric_lint.py`` asserts the committed docs/METRICS.md
+matches this render byte for byte (generated in a clean subprocess, so
+test-registered families cannot leak in): a new metric without a
+regenerated catalog fails CI, not a dashboard review.
+
+Regenerate with::
+
+    python -m tpushare.telemetry.catalog > docs/METRICS.md
+"""
+
+from __future__ import annotations
+
+HEADER = """\
+# tpushare metric catalog
+
+Every metric family the instrumented modules register, as rendered by
+`/metrics` on the daemon (control plane + per-tenant accounting) and
+`tpushare-llm-server` (serving plane).  GENERATED — do not edit by
+hand; regenerate with `python -m tpushare.telemetry.catalog >
+docs/METRICS.md` (a test asserts this file matches the registry).
+
+Conventions (enforced by tests/test_metric_lint.py): `tpushare_`
+prefix; counters end `_total`; time histograms end `_seconds`; byte
+gauges end `_bytes`; `_info` families are constant-1 gauges whose
+payload rides the labels; label names come from the enumerated
+allowlist and never carry request IDs or other unbounded values
+(request IDs ride flight-recorder events instead).
+
+| Metric | Type | Labels | Help |
+|---|---|---|---|
+"""
+
+
+def _import_instrumented() -> None:
+    """The modules whose import registers the full namespace (keep in
+    sync with tests/test_metric_lint.py::_registered)."""
+    import tpushare.inspect.metricsview  # noqa: F401
+    import tpushare.kubelet.client  # noqa: F401
+    import tpushare.plugin.allocate  # noqa: F401
+    import tpushare.plugin.status  # noqa: F401
+    import tpushare.serving.metrics  # noqa: F401
+    import tpushare.telemetry.health  # noqa: F401
+
+
+def render_catalog() -> str:
+    _import_instrumented()
+    from . import registry
+
+    lines = [HEADER]
+    for name, kind, help_text, labels in registry.REGISTRY.families():
+        label_cell = ", ".join(f"`{l}`" for l in labels) if labels else "—"
+        help_cell = " ".join(help_text.split()).replace("|", r"\|")
+        lines.append(f"| `{name}` | {kind} | {label_cell} "
+                     f"| {help_cell} |\n")
+    return "".join(lines)
+
+
+def main() -> int:
+    print(render_catalog(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -91,13 +91,25 @@ class FlightRecorder:
         with self._lock:
             return list(self._buf)
 
+    def events_since(self, seq: int) -> List[dict]:
+        """Events with ``seq`` strictly greater than the cursor, oldest
+        first — the incremental-tail read behind ``/debug/events?since=``
+        (a scraper remembers the last seq it saw and re-fetches only the
+        delta instead of re-downloading the whole ring).  A cursor that
+        has fallen off the back of the ring simply returns the whole
+        ring: the scraper lost events either way, and the seq gap tells
+        it how many."""
+        with self._lock:
+            return [e for e in self._buf if e["seq"] > seq]
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
 
-    def to_jsonl(self) -> str:
+    def to_jsonl(self, since: int = 0) -> str:
+        events = self.events_since(since) if since else self.events()
         return "".join(json.dumps(e, sort_keys=True) + "\n"
-                       for e in self.events())
+                       for e in events)
 
     def snapshot_to(self, path: Optional[str] = None,
                     reason: str = "") -> Optional[str]:
@@ -123,3 +135,25 @@ class FlightRecorder:
 
 #: the process-global flight recorder every plane records into
 RECORDER = FlightRecorder()
+
+
+from ..utils.httpserver import with_query  # noqa: E402 (stdlib-only)
+
+
+@with_query
+def debug_events_route(_body=None, query=None):
+    """Drop-in JsonHTTPServer handler: GET /debug/events[?since=<seq>]
+    off :data:`RECORDER` — whole ring by default, or only events with
+    ``seq`` strictly greater than the cursor, so a scraper can TAIL the
+    ring incrementally (remember the last seq seen, fetch the delta)
+    instead of re-downloading 2048 events per poll.  One shared
+    implementation for the daemon's status listener and the LLM server
+    (the ``healthz_route`` pattern)."""
+    from ..utils.httpserver import RawBody
+
+    try:
+        since = int((query or {}).get("since", 0))
+    except (TypeError, ValueError):
+        return 400, {"Error": "since must be an integer seq cursor"}
+    return 200, RawBody(RECORDER.to_jsonl(since=since),
+                        "application/x-ndjson")

@@ -67,7 +67,8 @@ BACKEND_UP = registry.gauge(
 HEALTH_STATE = registry.gauge(
     "tpushare_backend_health_state",
     "Backend health state machine, one-hot by the state label "
-    "(ok/degraded/wedged/cpu_fallback; exactly one series is 1)")
+    "(ok/degraded/wedged/cpu_fallback; exactly one series is 1)",
+    labels=("state",))
 PROBE_LATENCY = registry.histogram(
     "tpushare_probe_latency_seconds",
     "Wall latency of backend health probes (tiny dispatch + scalar "
@@ -81,7 +82,8 @@ DEVICE_TIME = registry.histogram(
     "tpushare_device_time_seconds",
     "Measured per-dispatch device residency by phase (prefill/decode/"
     "mixed): wall time of dispatch+host-fetch minus the constant "
-    "tunnel-RPC overhead (TPUSHARE_RPC_OVERHEAD_MS)")
+    "tunnel-RPC overhead (TPUSHARE_RPC_OVERHEAD_MS)",
+    labels=("phase",))
 DEVICE_UTILIZATION = registry.gauge(
     "tpushare_device_utilization",
     "Fraction of wall-clock time attributed to device compute across "
@@ -106,6 +108,18 @@ def refresh_device_utilization(now: Optional[float] = None) -> Optional[float]:
     util = min(1.0, busy / elapsed)
     DEVICE_UTILIZATION.set(util)
     return util
+
+def recordable_device_utilization() -> Optional[float]:
+    """The goodput value a bench/sweep RECORD should carry: the freshly
+    re-derived utilization, rounded, or None on the sticky CPU fallback
+    (there the number would describe the fallback host, not the
+    accelerator the record is about).  One definition for bench.py and
+    bench_all.py — the round-9 no-private-copies rule."""
+    util = refresh_device_utilization()
+    if util is None or MONITOR.state == CPU_FALLBACK:
+        return None
+    return round(util, 4)
+
 
 #: the known constant per-dispatch RPC overhead of the tunnel-attached
 #: chip, subtracted from wall time to attribute DEVICE residency
@@ -144,6 +158,10 @@ class _NullGuard:
 
     __slots__ = ()
 
+    #: disabled guards measured nothing (class attr: slots instances
+    #: share it, callers read it uniformly after the with-block)
+    device_s = None
+
     def __enter__(self):
         return self
 
@@ -155,7 +173,8 @@ _NULL_GUARD = _NullGuard()
 
 
 class _DispatchGuard:
-    __slots__ = ("_mon", "phase", "deadline_s", "observe", "info", "_t0")
+    __slots__ = ("_mon", "phase", "deadline_s", "observe", "info", "_t0",
+                 "device_s")
 
     def __init__(self, mon: "HealthMonitor", phase: str,
                  deadline_s: Optional[float], observe: bool, info: dict):
@@ -164,6 +183,12 @@ class _DispatchGuard:
         self.deadline_s = deadline_s
         self.observe = observe
         self.info = info
+        #: measured device residency of this dispatch, set at exit when
+        #: the guard observed (None for async-dispatch-only guards and
+        #: stalled dispatches) — the per-request attribution reads this
+        #: after the with-block to split device time across the request
+        #: IDs that rode the dispatch
+        self.device_s: Optional[float] = None
 
     def __enter__(self):
         self._t0 = time.monotonic()
@@ -432,8 +457,8 @@ class HealthMonitor:
             # a stalled dispatch's wall is tunnel hang, not device
             # compute — attributing it would pin the goodput gauge at
             # "fully busy" during exactly the hours it was zero
-            DEVICE_TIME.observe(max(0.0, wall_s - rpc_overhead_s()),
-                                phase=g.phase)
+            g.device_s = max(0.0, wall_s - rpc_overhead_s())
+            DEVICE_TIME.observe(g.device_s, phase=g.phase)
         if not (stalled or error or wall_s >= self.slow_record_s
                 or self.state in (WEDGED, DEGRADED)):
             # WEDGED/DEGRADED traffic is forensics; sticky CPU_FALLBACK

@@ -88,6 +88,10 @@ class _Metric:
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
+        #: declared label names (the family's schema: what docs/METRICS.md
+        #: catalogs and the label-hygiene lint checks observations
+        #: against); () = unlabeled family
+        self.labelnames: Tuple[str, ...] = ()
         self._lock = threading.Lock()
         self._vals: dict = {}
 
@@ -184,17 +188,54 @@ class Histogram(_Metric):
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket bound")
 
+    def _state_locked(self, key: tuple) -> list:
+        """Get-or-init one label set's ``[bucket counts (+Inf last),
+        sum]`` state — callers hold ``self._lock`` (the ONE copy of the
+        state-shape invariant, shared by the three observe flavors)."""
+        st = self._vals.get(key)
+        if st is None:
+            st = self._vals[key] = [[0] * (len(self.buckets) + 1), 0.0]
+        return st
+
     def observe(self, value: float, **labels) -> None:
         if not _enabled:
             return
         key = _labelkey(labels)
         with self._lock:
-            st = self._vals.get(key)
-            if st is None:
-                # [per-bucket counts (+Inf last), sum]
-                st = self._vals[key] = [[0] * (len(self.buckets) + 1), 0.0]
+            st = self._state_locked(key)
             st[0][bisect_left(self.buckets, value)] += 1
             st[1] += value
+
+    def observe_n(self, value: float, n: int, **labels) -> None:
+        """``n`` observations of the same ``value`` under ONE lock —
+        the fan-out fast path for per-request attribution, where a
+        batch dispatch credits an identical share to every request it
+        carried (one lock instead of batch-size locks on the serving
+        hot path)."""
+        if not _enabled or n <= 0:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            st = self._state_locked(key)
+            st[0][bisect_left(self.buckets, value)] += n
+            st[1] += value * n
+
+    def observe_many(self, values, **labels) -> None:
+        """A batch of distinct observations under ONE lock — the other
+        per-request fast path (a delivered batch observes batch-size
+        latencies at once; per-value ``observe`` calls would pay a lock
+        round trip each inside the serving loop)."""
+        if not _enabled or not values:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            st = self._state_locked(key)
+            counts, buckets = st[0], self.buckets
+            total = 0.0
+            for v in values:
+                counts[bisect_left(buckets, v)] += 1
+                total += v
+            st[1] += total
 
     def count(self, **labels) -> int:
         with self._lock:
@@ -243,32 +284,63 @@ class Registry:
         self._metrics: Dict[str, _Metric] = {}
         self._lock = threading.Lock()
 
-    def _get(self, cls, name: str, help_text: str, **kw) -> _Metric:
+    def _get(self, cls, name: str, help_text: str,
+             labels: Tuple[str, ...] = (), **kw) -> _Metric:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help_text, **kw)
+                m.labelnames = tuple(labels)
             elif not isinstance(m, cls):
                 raise TypeError(f"metric {name!r} already registered as "
                                 f"{m.kind}, not {cls.kind}")
+            elif labels and not m.labelnames:
+                # get-or-create: a later registration may carry the
+                # declaration an earlier anonymous one omitted
+                m.labelnames = tuple(labels)
+            elif labels and tuple(labels) != m.labelnames:
+                # a CONFLICTING declaration is a schema bug, loud like
+                # the kind mismatch above — silently keeping the first
+                # would publish a wrong catalog/lint schema
+                raise ValueError(
+                    f"metric {name!r} already declared with labels "
+                    f"{m.labelnames}, not {tuple(labels)}")
             return m
 
-    def counter(self, name: str, help_text: str) -> Counter:
-        return self._get(Counter, name, help_text)
+    def counter(self, name: str, help_text: str,
+                labels: Tuple[str, ...] = ()) -> Counter:
+        return self._get(Counter, name, help_text, labels=labels)
 
-    def gauge(self, name: str, help_text: str) -> Gauge:
-        return self._get(Gauge, name, help_text)
+    def gauge(self, name: str, help_text: str,
+              labels: Tuple[str, ...] = ()) -> Gauge:
+        return self._get(Gauge, name, help_text, labels=labels)
 
     def histogram(self, name: str, help_text: str,
-                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
-                  ) -> Histogram:
-        return self._get(Histogram, name, help_text, buckets=buckets)
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+                  labels: Tuple[str, ...] = ()) -> Histogram:
+        return self._get(Histogram, name, help_text, buckets=buckets,
+                         labels=labels)
 
     def describe(self) -> List[Tuple[str, str, str]]:
         """[(name, kind, help)] for every registered family — the lint
         test's view of the namespace."""
         with self._lock:
             return [(m.name, m.kind, m.help)
+                    for m in sorted(self._metrics.values(),
+                                    key=lambda m: m.name)]
+
+    def find(self, name: str) -> Optional[_Metric]:
+        """Read-only lookup: the registered family, or None — for
+        readers (usage reporting) that must not get-or-create a family
+        with placeholder metadata just to peek at its value."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def families(self) -> List[Tuple[str, str, str, Tuple[str, ...]]]:
+        """[(name, kind, help, labelnames)] — the metric catalog's view
+        (docs/METRICS.md) and the label-hygiene lint's schema source."""
+        with self._lock:
+            return [(m.name, m.kind, m.help, m.labelnames)
                     for m in sorted(self._metrics.values(),
                                     key=lambda m: m.name)]
 
@@ -297,18 +369,21 @@ class Registry:
 REGISTRY = Registry()
 
 
-def counter(name: str, help_text: str) -> Counter:
-    return REGISTRY.counter(name, help_text)
+def counter(name: str, help_text: str,
+            labels: Tuple[str, ...] = ()) -> Counter:
+    return REGISTRY.counter(name, help_text, labels=labels)
 
 
-def gauge(name: str, help_text: str) -> Gauge:
-    return REGISTRY.gauge(name, help_text)
+def gauge(name: str, help_text: str,
+          labels: Tuple[str, ...] = ()) -> Gauge:
+    return REGISTRY.gauge(name, help_text, labels=labels)
 
 
 def histogram(name: str, help_text: str,
-              buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS
-              ) -> Histogram:
-    return REGISTRY.histogram(name, help_text, buckets=buckets)
+              buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+              labels: Tuple[str, ...] = ()) -> Histogram:
+    return REGISTRY.histogram(name, help_text, buckets=buckets,
+                              labels=labels)
 
 
 # --------------------------------------------------------------------------

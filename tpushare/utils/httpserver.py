@@ -11,6 +11,17 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qsl
+
+
+def with_query(handler: Callable) -> Callable:
+    """Mark a route handler as query-aware: it is called
+    ``handler(body, query)`` with ``query`` a flat {name: last value}
+    dict parsed from the URL's query string (``/debug/events?since=42``
+    -> ``{"since": "42"}``).  Un-marked handlers keep the one-argument
+    ``handler(body)`` contract, so existing routes need no change."""
+    handler.wants_query = True
+    return handler
 
 
 class StreamingBody:
@@ -101,7 +112,10 @@ class JsonHTTPServer:
                 if not self._authorized():
                     self._send(401, {"Error": "unauthorized"})
                     return
-                handler = outer.routes.get((method, self.path))
+                # route on the bare path: the query string is handler
+                # input (?since= cursors), not part of the route key
+                path, _, rawq = self.path.partition("?")
+                handler = outer.routes.get((method, path))
                 if handler is None:
                     self._send(404, {"Error": "not found"})
                     return
@@ -114,7 +128,11 @@ class JsonHTTPServer:
                         self._send(400, {"Error": "bad json"})
                         return
                 try:
-                    code, payload = handler(body)
+                    if getattr(handler, "wants_query", False):
+                        code, payload = handler(
+                            body, dict(parse_qsl(rawq)))
+                    else:
+                        code, payload = handler(body)
                 except Exception as e:  # keep serving either way
                     code = 200 if outer.inband_errors else 500
                     payload = {"Error": str(e)}
