@@ -117,32 +117,49 @@ def admit_while_decode_bench(params, cfg, *, slots, n_reqs, prompt_len,
 
 def _fused_paged_decode_tokens_per_s(params, cfg, *, page_size, slots,
                                      prompt_len, gen, decode_chunk,
-                                     reps):
+                                     reps, mesh=None):
     """THE fused-decode drain both paged-storage scenarios time (the
     int8-capacity and the attn-kernel comparisons must measure the
     same thing): admit ``slots`` identical requests, one warm fused
     chunk (absorbs nothing timed), drain, and count only the tokens
     decoded inside the clock — admit's first token and the warm chunk
     are excluded.  The last of ``reps`` runs is the timed one (earlier
-    runs absorb the compiles)."""
+    runs absorb the compiles).
+
+    ``mesh`` runs the drain tensor-parallel (round 12: the Pallas read
+    shard_mapped per device) — off-TPU that makes SPMD launch overhead
+    the honest per-dispatch cost proxy, exactly like the mixed-step
+    arm.  Returns (tokens_per_s, dispatches): the dispatch count keeps
+    the CPU arm readable as overhead-only (same dispatches, different
+    per-dispatch plumbing)."""
     import time as _t
 
     from tpushare.serving.paged import PagedContinuousBatcher
 
-    tokens_per_s = None
+    tokens_per_s = dispatches = None
     for _ in range(reps):
         b = PagedContinuousBatcher(params, cfg, n_slots=slots,
-                                   page_size=page_size)
+                                   page_size=page_size, mesh=mesh)
+        n_disp = [0]
+        real_step_n = b._step_n
+
+        def counted(*a, **k):
+            n_disp[0] += 1
+            return real_step_n(*a, **k)
+
+        b._step_n = counted
         for i in range(slots):
             b.admit([1 + i] * prompt_len, gen)
         b.tick_fused(decode_chunk)               # warm
+        n_disp[0] = 0                            # timed window only
         t0 = _t.perf_counter()
         while b.slots:
             b.tick_fused(decode_chunk)
         dt = _t.perf_counter() - t0
         timed = slots * (gen - 1 - decode_chunk)
         tokens_per_s = timed / dt
-    return tokens_per_s
+        dispatches = n_disp[0]
+    return tokens_per_s, dispatches
 
 
 def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
@@ -175,7 +192,7 @@ def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
         while b.admit([1 + admitted % 50] * prompt_len, gen) is not None:
             admitted += 1
         # (b) throughput at fixed occupancy (dense-equivalent pages)
-        tokens_per_s = _fused_paged_decode_tokens_per_s(
+        tokens_per_s, _ = _fused_paged_decode_tokens_per_s(
             params, c, page_size=page_size, slots=throughput_slots,
             prompt_len=prompt_len, gen=gen, decode_chunk=decode_chunk,
             reps=reps)
@@ -185,7 +202,7 @@ def kv_quant_bench(params, cfg, *, page_size, n_budget_slots, prompt_len,
 
 
 def paged_attn_bench(params, cfg, *, page_size, slots, prompt_len, gen,
-                     decode_chunk, reps=2):
+                     decode_chunk, reps=2, mesh=None):
     """Pallas paged-decode kernel vs the XLA gather at IDENTICAL
     occupancy, bf16 AND int8 pools: the same fused-decode drain per
     (kv_dtype, attn_kernel) cell, so the only variable is the paged
@@ -197,8 +214,14 @@ def paged_attn_bench(params, cfg, *, page_size, slots, prompt_len, gen,
     most of all on int8 pools (the gather path dequantizes the WHOLE
     view to bf16 first).
 
+    ``mesh`` runs both cells tensor-parallel (round 12): the kernel
+    arm shard_maps the Pallas read per device, the gather arm rides
+    the partitioner — kernel-sharded vs gather at identical occupancy
+    AND identical dispatch counts (recorded per cell, so the CPU arm
+    stays an overhead-only proxy like the mixed-step arm).
+
     Importable so a test can smoke-run it at tiny sizes (tier-1-safe).
-    Returns {kv_dtype: {attn_kernel: tokens_per_s}}.
+    Returns {kv_dtype: {attn_kernel: {tokens_per_s, dispatches}}}.
     """
     import dataclasses
 
@@ -208,10 +231,11 @@ def paged_attn_bench(params, cfg, *, page_size, slots, prompt_len, gen,
         for kernel in ("xla", "pallas"):
             c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
                                     attn_kernel=kernel)
-            arm[kernel] = _fused_paged_decode_tokens_per_s(
+            tps, n_disp = _fused_paged_decode_tokens_per_s(
                 params, c, page_size=page_size, slots=slots,
                 prompt_len=prompt_len, gen=gen,
-                decode_chunk=decode_chunk, reps=reps)
+                decode_chunk=decode_chunk, reps=reps, mesh=mesh)
+            arm[kernel] = {"tokens_per_s": tps, "dispatches": n_disp}
         out[kv_dtype] = arm
     return out
 
@@ -428,16 +452,71 @@ def main() -> int:
     pa = paged_attn_bench(kparams, kcfg, page_size=32, slots=slots,
                           prompt_len=(3 * 16) if on_tpu else 3,
                           gen=gen, decode_chunk=16 if on_tpu else 4)
-    _emit("paged_attn_decode_tokens_per_s", pa["int8"]["pallas"],
+    _emit("paged_attn_decode_tokens_per_s",
+          pa["int8"]["pallas"]["tokens_per_s"],
           "tokens/s", platform=platform, slots=slots, page_size=32,
           attn_kernel="pallas", kv_dtype="int8",
-          vs_xla_int8=round(pa["int8"]["pallas"] / pa["int8"]["xla"], 3),
-          vs_xla_bf16=round(pa["bf16"]["pallas"] / pa["bf16"]["xla"], 3),
-          bf16_pallas=round(pa["bf16"]["pallas"], 2),
-          bf16_xla=round(pa["bf16"]["xla"], 2),
-          int8_xla=round(pa["int8"]["xla"], 2),
+          dispatches=pa["int8"]["pallas"]["dispatches"],
+          vs_xla_int8=round(pa["int8"]["pallas"]["tokens_per_s"]
+                            / pa["int8"]["xla"]["tokens_per_s"], 3),
+          vs_xla_bf16=round(pa["bf16"]["pallas"]["tokens_per_s"]
+                            / pa["bf16"]["xla"]["tokens_per_s"], 3),
+          bf16_pallas=round(pa["bf16"]["pallas"]["tokens_per_s"], 2),
+          bf16_xla=round(pa["bf16"]["xla"]["tokens_per_s"], 2),
+          int8_xla=round(pa["int8"]["xla"]["tokens_per_s"], 2),
           note="fused paged decode, kernel vs gather at identical "
                "occupancy; CPU arm is interpret-mode (overhead-only)")
+
+    # 2b-kernel-tp. the same kernel-vs-gather cells TENSOR-PARALLEL
+    # (round 12: the Pallas read runs per shard through shard_map; the
+    # gather rides the partitioner).  Head counts divisible by tp=4 so
+    # each shard owns whole GQA groups — the config the sharded path
+    # exists for.  Off-TPU this is the per-dispatch cost proxy again
+    # (SPMD launch overhead; dispatch counts recorded per cell prove
+    # both arms paid the identical dispatch schedule), so the CPU
+    # record prices tp plumbing, not chip bandwidth — the chip claim
+    # stays with drives/drive_paged_attn.py's tp arm.
+    tp_mesh = None
+    if len(jax.devices()) >= 4:
+        from tpushare.parallel.mesh import make_mesh
+        tp_mesh = make_mesh({"tp": 4})
+    if tp_mesh is not None:
+        tpcfg = (transformer.ModelConfig(
+                     vocab=32000, d_model=1024, n_layers=4, n_heads=8,
+                     n_kv_heads=4, d_ff=2816, max_seq=512)
+                 if on_tpu else
+                 transformer.ModelConfig(
+                     vocab=256, d_model=256, n_layers=2, n_heads=4,
+                     n_kv_heads=4, d_ff=128, max_seq=96,
+                     dtype=jnp.bfloat16))
+        tpparams = transformer.init_params(jax.random.PRNGKey(8), tpcfg)
+        patp = paged_attn_bench(tpparams, tpcfg, page_size=32,
+                                slots=slots,
+                                prompt_len=(3 * 16) if on_tpu else 3,
+                                gen=gen,
+                                decode_chunk=16 if on_tpu else 4,
+                                mesh=tp_mesh)
+        _emit("paged_attn_decode_tokens_per_s_tp",
+              patp["int8"]["pallas"]["tokens_per_s"],
+              "tokens/s", platform=platform, slots=slots, page_size=32,
+              tp=4, attn_kernel="pallas", kv_dtype="int8",
+              dispatches=patp["int8"]["pallas"]["dispatches"],
+              xla_dispatches=patp["int8"]["xla"]["dispatches"],
+              vs_xla_int8=round(
+                  patp["int8"]["pallas"]["tokens_per_s"]
+                  / patp["int8"]["xla"]["tokens_per_s"], 3),
+              vs_xla_bf16=round(
+                  patp["bf16"]["pallas"]["tokens_per_s"]
+                  / patp["bf16"]["xla"]["tokens_per_s"], 3),
+              bf16_pallas=round(
+                  patp["bf16"]["pallas"]["tokens_per_s"], 2),
+              bf16_xla=round(patp["bf16"]["xla"]["tokens_per_s"], 2),
+              int8_xla=round(patp["int8"]["xla"]["tokens_per_s"], 2),
+              note="kernel shard_mapped over tp=4 vs partitioned "
+                   "gather, identical occupancy and dispatch schedule; "
+                   "CPU arm is interpret-mode over the virtual mesh "
+                   "(overhead-only proxy — chip claim lives in the "
+                   "-m tpu lane)")
 
     # 2c. fused greedy decode, bf16 vs int8 vs int4: batch-1 decode is
     # WEIGHT-bound (every token re-reads all weights), so weight-only

@@ -12,7 +12,11 @@ The kernel's CPU-side contract is pinned in tests/test_paged_attn.py
 * does decode get FASTER — the XLA gather path materializes + re-reads
   a dense cfg.dtype view of the whole cache per layer (bf16-sized even
   for int8 pools), so the one-pass kernel should win on memory-bound
-  decode, most of all with kv_dtype="int8".
+  decode, most of all with kv_dtype="int8";
+* does the kernel lower PER SHARD under shard_map (round 12, tp=2 arm:
+  the per-shard Hkv/2 pool tiles and the [page, 1] scale blocks must
+  lower inside the shard_map body — interpret mode cannot prove this
+  either).
 
 Method (CLAUDE.md tunnel rules): per (kv_dtype, attn_kernel) cell,
 prefill once through the coalesced batch path — which itself exercises
@@ -72,6 +76,61 @@ def main() -> int:
     out = {"metric": "paged_attn_decode", "platform": dev.platform,
            "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
            "page_size": page, "flavors": {}}
+
+    def run_cell(c, run_params, mesh=None):
+        """One (cfg, mesh) cell: coalesced batch prefill (the
+        MULTI-token kernel arm) + a device-resident decode scan; the
+        host fetch is the barrier.  Returns (compile_s, tokens/s,
+        first-run greedy stream, logits finite)."""
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_jit(pools):
+            return transformer.forward_paged_prefill_batch(
+                run_params, padded, c, pools, table,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.full((batch,), prompt_len - 1, jnp.int32),
+                mesh=mesh)
+
+        @functools.partial(jax.jit, static_argnames=("n",),
+                           donate_argnums=(1,))
+        def decode_n(tok0, pools, n: int):
+            def body(carry, _):
+                tok, pools, lengths = carry
+                logits, pools = transformer.forward_paged_decode(
+                    run_params, tok[:, None], c, pools, table, lengths,
+                    mesh=mesh)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                    tok.dtype)
+                return (nxt, pools, lengths + 1), nxt
+
+            lengths = jnp.full((batch,), prompt_len, jnp.int32)
+            (_, pools, _), toks = jax.lax.scan(
+                body, (tok0, pools, lengths), None, length=n)
+            return toks.T, pools
+
+        def run():
+            pools = transformer.init_paged_kv(
+                c, n_pages=batch * pages_per_slot + 1, page_size=page)
+            if mesh is not None:
+                from tpushare.parallel.mesh import shard_kv_storage
+                pools = shard_kv_storage(pools, mesh)
+            sel, pools = prefill_jit(pools)
+            tok0 = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+            toks, pools = decode_n(tok0, pools, n_dec)
+            return sel, toks
+
+        t0 = time.perf_counter()
+        sel, toks = run()
+        first = [int(t) for t in toks[0]]            # compile + barrier
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sel, toks = run()                            # warm timed pass
+        int(toks[0, -1])                             # host fetch barrier
+        dt = time.perf_counter() - t0
+        # finiteness of the f32 LOGITS (argmax'd int tokens are
+        # trivially finite and would make compile_ok vacuous)
+        finite = bool(np.isfinite(np.asarray(sel, np.float32)).all())
+        return compile_s, batch * n_dec / dt, first, finite
+
     streams = {}
     for kv_dtype in ("bf16", "int8"):
         streams[kv_dtype] = {}
@@ -89,56 +148,12 @@ def main() -> int:
                                            kv_dtype == "int8",
                                            cfg.dtype, rows=rows), \
                     (page, kv_dtype, rows)
-
-            @functools.partial(jax.jit, donate_argnums=(0,))
-            def prefill_jit(pools, c=c):
-                # coalesced batch prefill: the MULTI-token kernel arm
-                return transformer.forward_paged_prefill_batch(
-                    params, padded, c, pools, table,
-                    jnp.zeros((batch,), jnp.int32),
-                    jnp.full((batch,), prompt_len - 1, jnp.int32))
-
-            @functools.partial(jax.jit, static_argnames=("n",),
-                               donate_argnums=(1,))
-            def decode_n(tok0, pools, n: int, c=c):
-                def body(carry, _):
-                    tok, pools, lengths = carry
-                    logits, pools = transformer.forward_paged_decode(
-                        params, tok[:, None], c, pools, table, lengths)
-                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
-                        tok.dtype)
-                    return (nxt, pools, lengths + 1), nxt
-
-                lengths = jnp.full((batch,), prompt_len, jnp.int32)
-                (_, pools, _), toks = jax.lax.scan(
-                    body, (tok0, pools, lengths), None, length=n)
-                return toks.T, pools
-
-            def run():
-                pools = transformer.init_paged_kv(
-                    c, n_pages=batch * pages_per_slot + 1, page_size=page)
-                sel, pools = prefill_jit(pools)
-                tok0 = jnp.argmax(sel, axis=-1).astype(jnp.int32)
-                toks, pools = decode_n(tok0, pools, n_dec)
-                return sel, toks
-
-            t0 = time.perf_counter()
-            sel, toks = run()
-            first = [int(t) for t in toks[0]]        # compile + barrier
-            compile_s = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            sel, toks = run()                        # warm timed pass
-            int(toks[0, -1])                         # host fetch barrier
-            dt = time.perf_counter() - t0
-
+            compile_s, tps, first, finite = run_cell(c, params)
             streams[kv_dtype][kernel] = first
             out["flavors"][kv_dtype][kernel] = {
                 "compile_s": round(compile_s, 1),
-                "tokens_per_s": round(batch * n_dec / dt, 1),
-                # finiteness of the f32 LOGITS (argmax'd int tokens are
-                # trivially finite and would make compile_ok vacuous)
-                "finite": bool(np.isfinite(
-                    np.asarray(sel, np.float32)).all()),
+                "tokens_per_s": round(tps, 1),
+                "finite": finite,
             }
 
     for kv_dtype in ("bf16", "int8"):
@@ -151,6 +166,42 @@ def main() -> int:
     out["compile_ok"] = all(
         cell["finite"] for f in out["flavors"].values()
         for cell in f.values())
+
+    # -- tp=2 shard_map arm (round 12) ----------------------------------
+    # What ONLY this arm can prove: Mosaic lowering of the per-shard
+    # kernel UNDER shard_map — above all the trailing-singleton
+    # [page, 1] f32 scale tiles at the per-shard Hkv/2 pool shape —
+    # which neither interpret mode nor the single-device compile checks
+    # (the shard_map body lowers per device with its own layouts).
+    # Both head counts divide 2 in both configs (16/8 on chip, 2/2 in
+    # the CPU shape), so the gate must route the KERNEL, not fall back.
+    if len(jax.devices()) >= 2:
+        from tpushare.parallel.mesh import make_mesh, shard_params
+        mesh = make_mesh({"tp": 2})
+        sh_params = shard_params(params, mesh)
+        out["tp2"] = {"flavors": {}}
+        for kv_dtype in ("bf16", "int8"):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel="pallas")
+            compile_s, tps, first, finite = run_cell(c, sh_params,
+                                                     mesh=mesh)
+            agree = sum(a == b for a, b in zip(
+                streams[kv_dtype]["pallas"], first))
+            out["tp2"]["flavors"][kv_dtype] = {
+                "compile_s": round(compile_s, 1),
+                "tokens_per_s": round(tps, 1),
+                "finite": finite,
+                # vs the SINGLE-DEVICE kernel stream: sharding splits
+                # whole GQA groups, so disagreement here is partitioner
+                # matmul reassociation (bf16), never the kernel
+                "agreement_vs_single": f"{agree}/{n_dec}",
+            }
+        out["tp2"]["compile_ok"] = all(
+            cell["finite"] for cell in out["tp2"]["flavors"].values())
+        out["compile_ok"] = out["compile_ok"] and out["tp2"]["compile_ok"]
+    else:
+        out["tp2"] = {"skipped": "single device"}
+
     print(json.dumps(out))
     return 0
 

@@ -153,6 +153,35 @@ def test_no_direct_page_gather_outside_dispatcher():
         "transformer.paged_attention):\n" + "\n".join(offenders))
 
 
+def test_no_direct_pallas_call_outside_ops_attention():
+    """Grep-lint: a ``pallas_call(`` invocation anywhere but
+    ``tpushare/ops/attention.py`` would hand the repo a kernel without
+    the shard_map wrapper / viability-gate / interpret-default
+    machinery that module centralizes — re-introducing exactly the
+    "pallas_call is not SPMD-partitionable, so refuse tp" ceiling this
+    round removed.  New kernels go in ops/attention.py (or route their
+    dispatch through it) so they inherit sharded serving for free."""
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tpushare")
+    pat = re.compile(r"\bpallas_call\s*\(")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if path.endswith(os.path.join("ops", "attention.py")):
+                continue        # the one sanctioned kernel module
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if pat.search(line):
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "direct pallas_call outside ops/attention.py (new kernels must "
+        "live behind its shard_map/viability dispatch):\n"
+        + "\n".join(offenders))
+
+
 def test_every_metric_has_help_text():
     for name, _, help_text in _registered():
         assert help_text and help_text != name, \
@@ -181,7 +210,7 @@ def test_tenant_accounting_series_registered_with_contracted_names():
 #: seqs, and other per-request values are BANNED as labels (unbounded
 #: cardinality kills Prometheus); they ride flight-recorder events.
 ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
-                       "kv_dtype", "attn_kernel"}
+                       "kv_dtype", "attn_kernel", "reason"}
 FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
                          "id"}
 #: label names whose VALUES are enumerated per family (one-hot states,
@@ -196,7 +225,19 @@ ENUMERATED_VALUES = {
     ("tpushare_request_device_seconds", "phase"): {"prefill", "decode"},
     ("tpushare_hbm_grant_bytes", "over_grant"): {"true", "false"},
     ("tpushare_hbm_peak_bytes", "over_grant"): {"true", "false"},
+    # keep in sync with ops.attention.FALLBACK_REASONS (asserted below)
+    ("tpushare_attn_kernel_fallback_total", "reason"):
+        {"head_dim", "page_tile", "max_rows", "tp_heads", "forced"},
 }
+
+
+def test_fallback_reason_enum_matches_gate():
+    """The lint's enumerated reasons and the gate's FALLBACK_REASONS
+    are the same set — a new gate reason without a deliberate enum
+    entry here would otherwise observe an un-enumerated label value."""
+    from tpushare.ops.attention import FALLBACK_REASONS
+    assert set(FALLBACK_REASONS) == ENUMERATED_VALUES[
+        ("tpushare_attn_kernel_fallback_total", "reason")]
 
 
 def _observed_label_sets():

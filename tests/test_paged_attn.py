@@ -211,24 +211,67 @@ def test_storage_info_reports_effective_kernel_on_fallback(bparams,
     assert metrics.ATTN_KERNEL_INFO.value(attn_kernel="xla") == 1
 
 
-def test_llm_server_refuses_pallas_with_tp(bparams):
-    """The pallas+tp refusal must hold for PROGRAMMATIC construction
-    too, not just the argparse layer — otherwise a direct LLMServer
-    build dies in an opaque SPMD lowering error at the first tick."""
+def test_llm_server_accepts_pallas_with_tp(bparams):
+    """The round-10 pallas+tp refusal is GONE: a tensor-parallel
+    LLMServer with the Pallas read path constructs, serves, and
+    answers — the kernel runs per shard through shard_map
+    (ops.attention.sharded_paged_decode_attention)."""
     from tpushare.serving.llm import LLMServer
-    with pytest.raises(ValueError, match="single-device"):
-        LLMServer(_pallas(BCFG), bparams, n_slots=2, tp=2)
+    srv = LLMServer(_pallas(BCFG), bparams, port=0, addr="127.0.0.1",
+                    n_slots=2, page_size=16, tp=2).start()
+    try:
+        sink = srv._service.submit([1, 2, 3], 4)
+        out = sink.get(timeout=600)
+        assert out is not None and len(out) == 7
+    finally:
+        srv.stop()
 
 
-def test_paged_batcher_refuses_pallas_with_mesh(bparams):
-    """...and at the batcher itself, where the mesh parameter actually
-    lives — direct PagedContinuousBatcher(mesh=...) construction must
-    fail fast too (pallas_call is not SPMD-partitionable)."""
+def test_llm_server_cli_accepts_pallas_with_tp(monkeypatch):
+    """...and the argparse layer no longer ap.errors the combination:
+    `--attn-kernel pallas --tp 4` parses and threads both knobs into
+    the server build (the server itself is stubbed — this pins the CLI
+    contract, not the serving stack)."""
+    from tpushare.serving import llm
+
+    seen = {}
+
+    class _Stub:
+        def __init__(self, cfg, params, **kw):
+            seen["attn_kernel"] = cfg.attn_kernel
+            seen["tp"] = kw.get("tp")
+            self.port = 0
+
+        def serve_forever(self):
+            return None
+
+    monkeypatch.setattr(llm, "LLMServer", _Stub)
+    rc = llm.main(["--model", "tiny", "--slots", "2", "--page-size",
+                   "16", "--attn-kernel", "pallas", "--tp", "4"])
+    assert rc == 0
+    assert seen == {"attn_kernel": "pallas", "tp": 4}
+
+
+def test_paged_batcher_accepts_pallas_with_mesh():
+    """Direct PagedContinuousBatcher(mesh=...) construction with the
+    kernel path serves, and — on the f32 reference config, where the
+    partitioner's matmul reassociation cannot tie-flip — its greedy
+    streams equal the single-device kernel's exactly: each shard's
+    softmax closes over whole GQA head groups, so sharding never
+    splits a head's reductions (bf16-activation models keep the
+    agreement-pinned contract instead, like every tp path)."""
     from tpushare.parallel.mesh import make_mesh
-    mesh = make_mesh({"tp": 1})
-    with pytest.raises(ValueError, match="single-device"):
-        PagedContinuousBatcher(bparams, _pallas(BCFG), n_slots=2,
-                               page_size=16, mesh=mesh)
+    cfg = _pallas(transformer.tiny(max_seq=96))
+    params = transformer.init_params(jax.random.PRNGKey(2), cfg)
+
+    def run(mesh):
+        b = PagedContinuousBatcher(params, cfg, n_slots=2,
+                                   page_size=16, mesh=mesh)
+        rids = [b.admit([1, 2, 3], 4), b.admit([7, 5], 5)]
+        b.run_until_drained()
+        return [b.completed[r] for r in rids]
+
+    assert run(make_mesh({"tp": 2})) == run(None)
 
 
 def test_viability_gate_bounds_query_rows():
@@ -293,19 +336,21 @@ def _paged_streams(params, cfg, batcher_kw, reqs, drain):
     return [b.completed[r] for r in rids]
 
 
-def _flavor_runs(params, cfg, wparams, wcfg):
+def _flavor_runs(params, cfg, wparams, wcfg, mesh=None):
     """flavor -> streams for one attn_kernel setting, mixed-dispatch
-    drained (every paged flavor exercises the dispatcher)."""
+    drained (every paged flavor exercises the dispatcher).  ``mesh``
+    runs every flavor tensor-parallel (the round-12 sharded path)."""
     return {
         "paged": _paged_streams(
-            params, cfg, dict(n_slots=2, page_size=16), _FULL_REQS,
-            _drain_mixed),
+            params, cfg, dict(n_slots=2, page_size=16, mesh=mesh),
+            _FULL_REQS, _drain_mixed),
         "page_ring": _paged_streams(
             wparams, wcfg, dict(n_slots=2, page_size=16,
-                                max_prefill_chunk=16), _WIN_REQS,
-            _drain_mixed),
+                                max_prefill_chunk=16, mesh=mesh),
+            _WIN_REQS, _drain_mixed),
         "prefix_cache": _paged_streams(
-            params, cfg, dict(n_slots=2, page_size=4, prefix_cache=True),
+            params, cfg, dict(n_slots=2, page_size=4, prefix_cache=True,
+                              mesh=mesh),
             [(_PREFIX_HEAD + [21, 22], 5), (_PREFIX_HEAD + [31], 6)],
             _drain_mixed),
     }
@@ -389,16 +434,212 @@ def test_pallas_decode_logit_error_bounded(kv_dtype, bparams):
     assert (logits["xla"].argmax(-1) == logits["pallas"].argmax(-1)).all()
 
 
+# ---------------------------------------------------------------------------
+# tensor-parallel kernel serving (round 12: shard_map'd Pallas reads)
+# ---------------------------------------------------------------------------
+def _tp_cfg(**kw):
+    """tiny() with hkv == h == 4 so a tp=4 mesh gets one whole GQA
+    group per shard (f32 compute: the partitioner cannot tie-flip)."""
+    return transformer.tiny(n_kv_heads=4, max_seq=96, **kw)
+
+
+def test_sharded_kernel_matches_unsharded():
+    """ops.attention.sharded_paged_decode_attention == the unsharded
+    kernel on random pools (bf16 and int8 stores, GQA n_rep=2, tp=2):
+    the shard decomposition adds no reduction across shards, so the
+    only drift allowed is float noise."""
+    from tpushare.ops.attention import (paged_decode_attention,
+                                        sharded_paged_decode_attention)
+    from tpushare.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"tp": 2})
+    b, h, hkv, d, page, npg, npool = 2, 4, 2, 32, 8, 4, 12
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    for quantized in (False, True):
+        k_store = _rand_pool(ks[0], npool, hkv, page, d, jnp.float32,
+                             quantized)
+        v_store = _rand_pool(ks[1], npool, hkv, page, d, jnp.float32,
+                             quantized)
+        q = jax.random.normal(ks[2], (b, h, 1, d), jnp.float32)
+        table = jax.random.permutation(
+            ks[3], jnp.arange(1, 1 + b * npg)).reshape(b, npg)
+        positions = jnp.asarray([[9], [21]], jnp.int32)
+        ref = paged_decode_attention(q, k_store, v_store, table,
+                                     positions)
+        got = sharded_paged_decode_attention(q, k_store, v_store, table,
+                                             positions, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_sharded_flash_attention_matches_reference():
+    """The dense/flash twin: ops.attention.attention under a tp mesh
+    (per-shard dispatch through sharded_attention; the reference body
+    off-TPU, the flash kernel on chip) == the unsharded reference, and
+    an indivisible head count falls back to the single-program path
+    with the tp_heads counter bumped instead of crashing."""
+    from tpushare.ops.attention import attention, reference_attention
+    from tpushare.parallel.mesh import make_mesh
+    from tpushare.serving.metrics import ATTN_FALLBACK
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (2, 4, 16, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (2, 2, 16, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (2, 2, 16, 32), jnp.float32)
+    ref = reference_attention(q, k, v, causal=True)
+    got = attention(q, k, v, causal=True, mesh=make_mesh({"tp": 2}))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5)
+    # hkv=2 % tp=4 != 0: single-program fallback, counter bumped
+    before = ATTN_FALLBACK.value(reason="tp_heads") or 0
+    got4 = attention(q, k, v, causal=True, mesh=make_mesh({"tp": 4}))
+    np.testing.assert_allclose(np.asarray(got4), np.asarray(ref),
+                               atol=2e-5)
+    assert (ATTN_FALLBACK.value(reason="tp_heads") or 0) == before + 1
+
+
+def test_tp4_pallas_agreement_every_paged_flavor():
+    """THE tp acceptance check: attn_kernel="pallas" + tp=4 over the
+    virtual 8-device mesh is agreement-pinned vs the tp XLA gather on
+    every paged flavor (paged / page ring / prefix cache), mixed-
+    dispatch drained — the same contract the single-device kernel
+    carries, now with each shard reading its own head group's pages."""
+    from tpushare.parallel.mesh import make_mesh
+    mesh = make_mesh({"tp": 4})
+    cfg = _tp_cfg()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    wcfg = _tp_cfg(window=16)
+    wparams = transformer.init_params(jax.random.PRNGKey(4), wcfg)
+    ref = _flavor_runs(params, cfg, wparams, wcfg, mesh=mesh)
+    got = _flavor_runs(params, _pallas(cfg), wparams, _pallas(wcfg),
+                       mesh=mesh)
+    for flavor, streams in ref.items():
+        agree = total = 0
+        for r, g in zip(streams, got[flavor]):
+            assert len(r) == len(g), flavor
+            total += len(r)
+            agree += sum(1 for a, b in zip(r, g) if a == b)
+        assert agree / total >= AGREEMENT_PIN, (flavor, agree / total)
+
+
+def test_tp_pallas_dispatch_flavors_exactly_self_consistent():
+    """Within the sharded kernel path the scheduler equivalences hold
+    EXACTLY, like single-device: ticked == fused == mixed under tp=4
+    (one kernel per shard, one reduction order, every dispatch
+    program)."""
+    from tpushare.parallel.mesh import make_mesh
+    mesh = make_mesh({"tp": 4})
+    cfg = _pallas(_tp_cfg())
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+
+    def run(drain, chunked):
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16,
+                                   mesh=mesh)
+        admit = b.admit_chunked if chunked else b.admit
+        kw = {"chunk": 16} if chunked else {}
+        rids = [admit(p, n, **kw) for p, n in _FULL_REQS]
+        drain(b)
+        return [b.completed[r] for r in rids]
+
+    t = run(lambda b: b.run_until_drained(), False)
+    f = run(_drain_fused, True)
+    m = run(_drain_mixed, True)
+    assert t == f == m
+
+
+def test_per_dispatch_fallback_mixes_paths_agreement_pinned(monkeypatch):
+    """On a real chip the gates evaluate PER DISPATCH: a whole-prompt
+    prefill whose query-row block exceeds PAGED_KERNEL_MAX_ROWS takes
+    the gather while the decode ticks keep the kernel.  Simulate that
+    split on CPU by tightening the rows bound through the dispatcher's
+    gate: one request's stream then mixes both read paths (gather-
+    written prefill + kernel decode — cache WRITES are identical in
+    both, only the read rounds differently) and must stay agreement-
+    pinned vs the pure-xla run, with the max_rows fallback counted."""
+    import sys
+
+    import tpushare.ops.attention  # noqa: F401 (ops.__init__ shadows it)
+    from tpushare.serving.metrics import ATTN_FALLBACK
+    attn_impl = sys.modules["tpushare.ops.attention"]
+    real = attn_impl.paged_kernel_fallback_reason
+
+    def gated(page, head_dim, quantized, dtype, rows=1, **kw):
+        if rows > 2:            # decode rows = n_rep*1 = 2 stay viable
+            return "max_rows"
+        return real(page, head_dim, quantized, dtype, rows=rows, **kw)
+
+    monkeypatch.setattr(attn_impl, "paged_kernel_fallback_reason", gated)
+    # max_seq=80: a cfg no other test traced, so the patched gate is
+    # consulted at trace time instead of a cached program winning
+    cfg = transformer.tiny(max_seq=80)
+    params = transformer.init_params(jax.random.PRNGKey(6), cfg)
+
+    def run(c):
+        b = PagedContinuousBatcher(params, c, n_slots=2, page_size=16)
+        rids = [b.admit(list(range(1, 11)), 6), b.admit([3, 5, 7], 8)]
+        b.run_until_drained()
+        return [b.completed[r] for r in rids]
+
+    before = ATTN_FALLBACK.value(reason="max_rows") or 0
+    got = run(_pallas(cfg))
+    assert (ATTN_FALLBACK.value(reason="max_rows") or 0) > before
+    ref = run(cfg)
+    agree = sum(1 for r, g in zip(ref, got)
+                for a, b in zip(r, g) if a == b)
+    total = sum(len(r) for r in ref)
+    assert all(len(r) == len(g) for r, g in zip(ref, got))
+    assert agree / total >= AGREEMENT_PIN, agree / total
+
+
+def test_tp_indivisible_kv_heads_degrade_to_gather():
+    """n_kv_heads % tp != 0 must not crash: the dispatcher falls back
+    to the sharded XLA gather (which legalizes storage to replication),
+    bumps the fallback counter with reason="tp_heads", storage_info
+    reports the effective path, and the streams equal the explicit-xla
+    run EXACTLY (it IS the same program)."""
+    from tpushare.parallel.mesh import make_mesh
+    from tpushare.serving.metrics import ATTN_FALLBACK
+    mesh = make_mesh({"tp": 4})
+    cfg = transformer.tiny(max_seq=96)          # hkv=2: 2 % 4 != 0
+    params = transformer.init_params(jax.random.PRNGKey(5), cfg)
+
+    def run(c, count=False):
+        before = ATTN_FALLBACK.value(reason="tp_heads") or 0
+        b = PagedContinuousBatcher(params, c, n_slots=2, page_size=16,
+                                   mesh=mesh)
+        assert b.storage_info()["attn_kernel"] == "xla"
+        rids = [b.admit(p, n) for p, n in _FULL_REQS]
+        b.run_until_drained()
+        if count:
+            assert (ATTN_FALLBACK.value(reason="tp_heads") or 0) > before
+        return [b.completed[r] for r in rids]
+
+    assert run(_pallas(cfg), count=True) == run(cfg)
+
+
 def test_bench_scenario_smoke(bparams):
     """The bench_all kernel-vs-gather scenario runs at tiny sizes and
-    reports all four (kv_dtype, attn_kernel) cells (tier-1-safe; the
-    speedup claim is for the committed TPU run — the CPU arm is
-    interpret-mode, overhead-only)."""
+    reports all four (kv_dtype, attn_kernel) cells with their dispatch
+    counts (tier-1-safe; the speedup claim is for the committed TPU
+    run — the CPU arm is interpret-mode, overhead-only), and the tp
+    arm drives the same timer over a mesh."""
     import bench_all
+    from tpushare.parallel.mesh import make_mesh
 
     out = bench_all.paged_attn_bench(
         bparams, BCFG, page_size=16, slots=2, prompt_len=3, gen=5,
         decode_chunk=2, reps=1)
     for kv_dtype in ("bf16", "int8"):
         for kernel in ("xla", "pallas"):
-            assert out[kv_dtype][kernel] > 0, (kv_dtype, kernel)
+            cell = out[kv_dtype][kernel]
+            assert cell["tokens_per_s"] > 0, (kv_dtype, kernel)
+            assert cell["dispatches"] > 0, (kv_dtype, kernel)
+    # identical dispatch schedule across cells — the invariant that
+    # keeps the CPU number readable as overhead-only
+    disp = {out[d][k]["dispatches"] for d in out for k in out[d]}
+    assert len(disp) == 1, out
+    # tp arm: same timer under a tp=2 mesh (BCFG heads divide by 2)
+    tp = bench_all.paged_attn_bench(
+        bparams, BCFG, page_size=16, slots=2, prompt_len=3, gen=5,
+        decode_chunk=2, reps=1, mesh=make_mesh({"tp": 2}))
+    assert tp["int8"]["pallas"]["tokens_per_s"] > 0

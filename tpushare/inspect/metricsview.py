@@ -131,9 +131,15 @@ def summarize_serving(parsed: dict) -> dict:
         "kv_dtype": _info_label(parsed, "tpushare_kv_dtype_info",
                                 "kv_dtype"),
         # which attention READ path the tenant's storage runs ("xla"
-        # dense gather vs the "pallas" fused paged-decode kernel)
+        # dense gather vs the "pallas" fused paged-decode kernel), and
+        # how many compiled programs fell back from a requested kernel
+        # to the gather (summed over reasons; nonzero = some live
+        # program is NOT on the kernel the config asked for)
         "attn_kernel": _info_label(parsed, "tpushare_attn_kernel_info",
                                    "attn_kernel"),
+        "attn_fallbacks": sum(
+            v for _, v in parsed["samples"].get(
+                "tpushare_attn_kernel_fallback_total", ())) or None,
         # mixed-step scheduler: mid-prefill queue depth and how full the
         # last round's coalesced prefill block was
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
@@ -220,6 +226,11 @@ def render_metrics_table(
         kv_bytes = _fmt_bytes(summary.get("kv_cache_bytes"))
         if summary.get("kv_dtype"):
             kv_bytes += f" ({summary['kv_dtype']})"
+        attn = summary.get("attn_kernel") or "-"
+        if summary.get("attn_fallbacks"):
+            # the viability gates demoted some compiled program(s) to
+            # the gather — the ATTN column must not read "pallas" clean
+            attn += f" (fb {int(summary['attn_fallbacks'])})"
         health = (summary.get("health") or "-").upper()
         table.append([
             name, addr, health,
@@ -229,7 +240,7 @@ def render_metrics_table(
             _fmt(summary["occupancy"], 100.0, "%", 0),
             kv,
             kv_bytes,
-            summary.get("attn_kernel") or "-",
+            attn,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])

@@ -303,7 +303,8 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                   kv_cache: Optional[Tuple] = None,
                   cache_len: Optional[jnp.ndarray] = None,
                   attention_fn=None,
-                  kv_write_len=None):
+                  kv_write_len=None,
+                  mesh=None):
     """Dense attention step: (o [B,H,S,D] pre-projection, new_cache).
 
     ``kv_write_len`` (traced scalar, ROLLING caches only): number of
@@ -449,8 +450,11 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
         # custom impls (ring/ulysses) expect equal head counts
         return attention_fn(q, _expand_kv(k, h // hkv),
                             _expand_kv(v, h // hkv), causal=True), None
-    # default path is GQA-aware: K/V stay at Hkv heads end-to-end
-    return attention(q, k, v, causal=True, window=cfg.window), None
+    # default path is GQA-aware: K/V stay at Hkv heads end-to-end;
+    # a tensor-parallel mesh routes the flash kernel per shard over its
+    # local GQA head groups (ops.attention.sharded_attention)
+    return attention(q, k, v, causal=True, window=cfg.window,
+                     mesh=mesh), None
 
 
 def _attn_ffn(layer, x, cfg: ModelConfig, attend):
@@ -482,7 +486,8 @@ def forward(params, tokens, cfg: ModelConfig,
             attention_fn=None,
             remat_policy=None,
             kv_write_len=None,
-            return_hidden: bool = False):
+            return_hidden: bool = False,
+            mesh=None):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -502,6 +507,12 @@ def forward(params, tokens, cfg: ModelConfig,
     (see the commit discussion in :func:`_attend_dense`).
     ``kv_write_len`` (rolling only) marks how many of the S tokens are
     REAL — a padded tail is attendable-masked and never committed.
+
+    ``mesh`` (no-cache path only) routes attention through the
+    shard_map'd flash kernel under a >1 ``tp`` axis — each shard runs
+    the Pallas kernel on its local GQA head groups instead of falling
+    back to the XLA reference (``pallas_call`` is not
+    SPMD-partitionable without it).
 
     ``remat_policy`` (no-cache path only) wraps the scanned layer body
     in per-layer ``jax.checkpoint``: the backward holds one layer's
@@ -529,7 +540,8 @@ def forward(params, tokens, cfg: ModelConfig,
             return _attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
-                    lyr, xin, cfg, positions, attention_fn=attention_fn))
+                    lyr, xin, cfg, positions, attention_fn=attention_fn,
+                    mesh=mesh))
 
         if remat_policy is not None:
             body = jax.checkpoint(
@@ -723,28 +735,48 @@ def paged_read_transient_bytes(cfg: ModelConfig, rows: int,
 
 
 def paged_attention(q, k_store, v_store, page_table, positions,
-                    cfg: ModelConfig):
+                    cfg: ModelConfig, mesh=None, tp_axis: str = "tp"):
     """THE paged-pool attention read dispatcher — every paged forward
     flavor (decode tick, prefill chunk, coalesced prefill batch, page
     ring, prefix cache) routes here, so ``cfg.attn_kernel`` governs one
     site (lint-enforced: direct pool-through-table gathers outside
     :func:`_paged_gather` fail tests/test_metric_lint.py).
 
-    "pallas" additionally falls back to the XLA gather on real TPU
-    when the pool's tiles cannot lower on Mosaic
-    (:func:`tpushare.ops.attention.paged_kernel_viable`: head_dim must
-    fill 128-lane tiles, the page the value dtype's sublane tile) or
-    when the reference escape hatch is forced."""
+    "pallas" falls back to the XLA gather — bumping
+    ``tpushare_attn_kernel_fallback_total{reason=}`` — on real TPU when
+    the pool's tiles cannot lower on Mosaic
+    (:func:`tpushare.ops.attention.paged_kernel_fallback_reason`:
+    head_dim must fill 128-lane tiles, the page the value dtype's
+    sublane tile, the query-row block the VMEM bound), when the
+    reference escape hatch is forced, or — on any platform — when a
+    tensor-parallel ``mesh`` cannot split whole GQA head groups per
+    shard (``tp_heads``).  A viable kernel under ``mesh`` with tp > 1
+    runs per-shard through
+    :func:`tpushare.ops.attention.sharded_paged_decode_attention`
+    (pallas_call is not SPMD-partitionable; the gather path needs no
+    wrapper — XLA's partitioner shards it)."""
     if cfg.attn_kernel == "pallas":
-        from ..ops.attention import (paged_decode_attention,
-                                     paged_kernel_viable)
+        from ..ops.attention import (count_attn_fallback,
+                                     paged_decode_attention,
+                                     paged_kernel_fallback_reason,
+                                     sharded_paged_decode_attention,
+                                     tp_degree)
         leaf = _kv_leaf(k_store)
         rows = (q.shape[1] // cfg.n_kv_heads) * q.shape[2]
-        if paged_kernel_viable(leaf.shape[2], leaf.shape[3],
-                               kv_quantized(cfg), cfg.dtype, rows=rows):
+        tp = tp_degree(mesh, tp_axis)
+        reason = paged_kernel_fallback_reason(
+            leaf.shape[2], leaf.shape[3], kv_quantized(cfg), cfg.dtype,
+            rows=rows, tp=tp, n_kv_heads=leaf.shape[1],
+            n_heads=q.shape[1])
+        if reason is None:
+            if tp > 1:
+                return sharded_paged_decode_attention(
+                    q, k_store, v_store, page_table, positions, mesh,
+                    axis=tp_axis, window=cfg.window)
             return paged_decode_attention(
                 q, k_store, v_store, page_table, positions,
                 window=cfg.window)
+        count_attn_fallback(reason)
     h, hkv = cfg.n_heads, cfg.n_kv_heads
     return cached_attention(
         q, _expand_kv(_paged_gather_deq(k_store, page_table, cfg),
@@ -755,7 +787,7 @@ def paged_attention(q, k_store, v_store, page_table, positions,
 
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
-                         page_table, lengths):
+                         page_table, lengths, mesh=None):
     """One decode step for every slot against the paged pool.
 
     tokens [B, 1]; pools from :func:`init_paged_kv`; page_table
@@ -763,7 +795,8 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     Returns (logits [B, 1, vocab], updated pools).  Same math as the
     dense ``forward(..., cache_len=lengths)`` tick — garbage positions
     (trash page, beyond-length lanes) are masked exactly like the dense
-    cache's unwritten tail.
+    cache's unwritten tail.  ``mesh`` (tensor-parallel serving) reaches
+    :func:`paged_attention`, which runs the Pallas read per shard.
     """
     b, s = tokens.shape
     positions = lengths[:, None] + jnp.arange(s)[None, :]
@@ -787,7 +820,8 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                         .set(n[:, :, 0, :]), kpool, k_st)
             vp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
                         .set(n[:, :, 0, :]), vpool, v_st)
-            o = paged_attention(q, kp2, vp2, page_table, positions, cfg)
+            o = paged_attention(q, kp2, vp2, page_table, positions, cfg,
+                                mesh=mesh)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -799,7 +833,7 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
 
 
 def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
-                                page_rows, pos, last_idx):
+                                page_rows, pos, last_idx, mesh=None):
     """One prompt WINDOW into a slot's reserved pages at offset ``pos``.
 
     tokens [1, W] with W a multiple of the page size and ``pos``
@@ -845,7 +879,7 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
                     c, n[:, :, j * page:(j + 1) * page, :],
                     (pid, 0, 0, 0)), vp2, v_st)
             o = paged_attention(q, kp2, vp2, page_rows[None], positions,
-                                cfg)
+                                cfg, mesh=mesh)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -857,7 +891,7 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
 
 
 def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
-                                page_rows, pos, last_idx):
+                                page_rows, pos, last_idx, mesh=None):
     """Coalesced MULTI-prompt prefill: one window per row, each into its
     own slot's reserved pages, in a single forward — the paged half of
     the mixed-step scheduler (one device dispatch per service round).
@@ -909,7 +943,8 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
                         kpool, k_st)
             vp2 = _smap(lambda c, n: c.at[flat_pids].set(pieces(n)),
                         vpool, v_st)
-            o = paged_attention(q, kp2, vp2, page_rows, positions, cfg)
+            o = paged_attention(q, kp2, vp2, page_rows, positions, cfg,
+                                mesh=mesh)
             return o, (kp2, vp2)
 
         return _attn_ffn(layer, x, cfg, attend)
@@ -922,7 +957,7 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
 
 
 def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
-                          page_rows, prompt_len: int):
+                          page_rows, prompt_len: int, mesh=None):
     """Prefill ONE whole request into its reserved pages: the page-
     aligned chunk body (:func:`forward_paged_prefill_chunk`) at pos 0,
     with the prompt padded to a page multiple.  Returns (last-position
@@ -934,5 +969,6 @@ def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
     if w != s:
         tokens = jnp.pad(tokens[:, :s], ((0, 0), (0, w - s)))
     logits, pools = forward_paged_prefill_chunk(
-        params, tokens, cfg, pools, page_rows, 0, prompt_len - 1)
+        params, tokens, cfg, pools, page_rows, 0, prompt_len - 1,
+        mesh=mesh)
     return logits[None], pools

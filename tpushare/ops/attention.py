@@ -706,30 +706,77 @@ def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
 #: Mosaic die at the first long admit.  Decode (S=1) never comes close.
 PAGED_KERNEL_MAX_ROWS = 2048
 
+#: every reason :func:`paged_kernel_fallback_reason` can return — the
+#: enumerated values of the ``reason`` label on
+#: ``tpushare_attn_kernel_fallback_total`` (tests/test_metric_lint.py
+#: pins observations to this set)
+FALLBACK_REASONS = ("head_dim", "page_tile", "max_rows", "tp_heads",
+                    "forced")
 
-def paged_kernel_viable(page: int, head_dim: int, quantized: bool,
-                        dtype, rows: int = 1) -> bool:
-    """THE Mosaic-viability gate for :func:`paged_decode_attention` on a
-    REAL TPU (interpret mode enforces no tiling, so off-TPU callers run
-    the kernel at any shape): the pool's last two dims (page, head_dim)
-    are the kernel's K/V block, so head_dim must fill 128-lane tiles —
-    padding it would materialize a padded copy of the POOL, the exact
-    transient the kernel deletes — the page must fill the value dtype's
-    sublane tile (int8 tiles are 32 rows, bf16 16, f32 8), and the
-    query-row block (``rows`` = n_rep * S) must fit VMEM
-    (:data:`PAGED_KERNEL_MAX_ROWS`).  Callers fall back to the XLA
-    gather when this returns False."""
+
+def tp_degree(mesh, axis: str = "tp") -> int:
+    """Size of ``axis`` in ``mesh`` (1 when mesh is None or lacks the
+    axis) — the ONE way kernel dispatch sites ask "how many tensor-
+    parallel shards am I running under?"."""
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[axis])
+
+
+def paged_kernel_fallback_reason(page: int, head_dim: int,
+                                 quantized: bool, dtype, rows: int = 1,
+                                 tp: int = 1, n_kv_heads: int = 0,
+                                 n_heads: int = 0) -> Optional[str]:
+    """THE viability gate for :func:`paged_decode_attention`, returning
+    WHY the kernel cannot run (None = viable) so fallback sites can
+    label ``tpushare_attn_kernel_fallback_total``.
+
+    Mosaic tile gates apply on a REAL TPU only (interpret mode enforces
+    no tiling, so off-TPU callers run the kernel at any shape): the
+    pool's last two dims (page, head_dim) are the kernel's K/V block,
+    so head_dim must fill 128-lane tiles — padding it would
+    materialize a padded copy of the POOL, the exact transient the
+    kernel deletes — the page must fill the value dtype's sublane tile
+    (int8 tiles are 32 rows, bf16 16, f32 8), and the query-row block
+    (``rows`` = n_rep * S) must fit VMEM
+    (:data:`PAGED_KERNEL_MAX_ROWS`).
+
+    The ``tp_heads`` gate is STRUCTURAL, not Mosaic, so it applies on
+    every platform: ``tp`` > 1 runs the kernel under ``shard_map`` with
+    whole GQA head groups per shard (no cross-shard softmax), which
+    needs both head counts divisible by the tp degree.  Gates evaluate
+    against the PER-SHARD shapes — head counts divide by ``tp``, while
+    page, head_dim, and rows (= n_rep * S, with n_rep shard-invariant)
+    are identical on every shard, so the fallback decision is uniform
+    across shards by construction.
+    """
     if FORCE_REFERENCE:
-        return False
+        return "forced"
+    if tp > 1 and ((n_kv_heads and n_kv_heads % tp)
+                   or (n_heads and n_heads % tp)):
+        return "tp_heads"
     if not _on_tpu():
-        return True
+        return None
     if head_dim % 128:
-        return False
+        return "head_dim"
     if rows > PAGED_KERNEL_MAX_ROWS:
-        return False
+        return "max_rows"
     sublane = 32 if quantized else (8 if jnp.dtype(dtype).itemsize == 4
                                     else 16)
-    return page % sublane == 0
+    if page % sublane:
+        return "page_tile"
+    return None
+
+
+def paged_kernel_viable(page: int, head_dim: int, quantized: bool,
+                        dtype, rows: int = 1, tp: int = 1,
+                        n_kv_heads: int = 0, n_heads: int = 0) -> bool:
+    """Boolean view of :func:`paged_kernel_fallback_reason` (True =
+    the kernel runs).  Callers fall back to the XLA gather when this
+    returns False."""
+    return paged_kernel_fallback_reason(
+        page, head_dim, quantized, dtype, rows=rows, tp=tp,
+        n_kv_heads=n_kv_heads, n_heads=n_heads) is None
 
 
 def paged_decode_attention(q, k_store, v_store, page_table, positions,
@@ -828,6 +875,90 @@ def paged_decode_attention(q, k_store, v_store, page_table, positions,
     return out.reshape(b, h, s, d)
 
 
+def sharded_paged_decode_attention(q, k_store, v_store, page_table,
+                                   positions, mesh, axis: str = "tp",
+                                   window: Optional[int] = None,
+                                   interpret: Optional[bool] = None):
+    """:func:`paged_decode_attention` under ``shard_map`` over the tp
+    axis: each mesh shard runs the Pallas kernel on its LOCAL q-heads
+    and KV pages — ``pallas_call`` is not SPMD-partitionable, so this
+    wrapper is what lets the paged read path serve tensor-parallel
+    models at all.
+
+    Sharding layout (Megatron head order): q [B, H, S, D] and the pool
+    leaves [n_pages, Hkv, page, D] (int8 scales [n_pages, Hkv, page, 1])
+    shard their HEAD dim; the page table and query positions replicate.
+    Heads are ordered kv-group-major (head h = kh * n_rep + r), so a
+    contiguous block of H/tp q-heads covers exactly Hkv/tp whole GQA
+    groups — each shard's softmax closes over its own heads and NO
+    cross-shard collective is needed.  Callers must have checked
+    divisibility (``paged_kernel_fallback_reason`` reason "tp_heads")
+    before routing here.  ``check_vma=False``: pallas_call carries no
+    replication rule, which is the point of the wrapper.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.shardmap_compat import shard_map
+
+    head = P(None, axis, None, None)
+    rep = P()
+
+    def store_specs(store):
+        return jax.tree_util.tree_map(lambda _: head, store)
+
+    def body(q, ks, vs, tbl, pos):
+        return paged_decode_attention(q, ks, vs, tbl, pos,
+                                      window=window, interpret=interpret)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(head, store_specs(k_store), store_specs(v_store),
+                  rep, rep),
+        out_specs=head, check_vma=False,
+    )(q, k_store, v_store, page_table, positions)
+
+
+def sharded_attention(q, k, v, mesh, axis: str = "tp",
+                      causal: bool = True,
+                      window: Optional[int] = None):
+    """:func:`attention` under ``shard_map`` over the tp axis: each
+    shard dispatches on its LOCAL heads (the flash kernel on TPU, the
+    jnp reference elsewhere) — the wrapper that lets the no-cache
+    forward keep the flash kernel under tensor parallelism instead of
+    refusing it (``pallas_call`` is not SPMD-partitionable).
+
+    q [B, H, S, D] and k/v [B, Hkv, S, D] shard their head dims; GQA
+    groups stay shard-local (kv-group-major head order, see
+    :func:`sharded_paged_decode_attention`), so per-shard softmaxes are
+    complete and no collective is needed.  Callers gate on
+    divisibility of BOTH head counts by the tp degree.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.shardmap_compat import shard_map
+
+    head = P(None, axis, None, None)
+
+    def body(q, k, v):
+        return attention(q, k, v, causal=causal, window=window)
+
+    return shard_map(body, mesh=mesh, in_specs=(head, head, head),
+                     out_specs=head, check_vma=False)(q, k, v)
+
+
+def count_attn_fallback(reason: str) -> None:
+    """Bump ``tpushare_attn_kernel_fallback_total{reason=}`` — called
+    at every viability-gate fallback site (the paged dispatcher and the
+    sharded-flash gate).  Dispatch sites run at TRACE time inside jit,
+    so the counter advances once per compiled program that fell back,
+    not once per device dispatch — a nonzero value means "some live
+    program runs the gather although the kernel was asked for", which
+    is the operator-facing fact.  Lazy import: ops must stay importable
+    without the serving plane."""
+    from ..serving.metrics import ATTN_FALLBACK
+    ATTN_FALLBACK.inc(reason=reason)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
@@ -869,7 +1000,8 @@ def use_flash(q, k) -> bool:
 
 
 def attention(q, k, v, causal: bool = True,
-              window: Optional[int] = None):
+              window: Optional[int] = None, mesh=None,
+              tp_axis: str = "tp"):
     """Dispatch: Pallas flash on TPU (shape permitting), reference else.
 
     k/v may carry fewer (GQA) heads; both paths handle it — the flash
@@ -880,7 +1012,27 @@ def attention(q, k, v, causal: bool = True,
     not lane-aligned (64 for BERT-base/DistilBERT — the bench models) are
     zero-padded to 128 inside ``flash_attention``; only tiny head dims
     (< 32), where padding overhead dominates, fall back to the reference.
+
+    ``mesh`` with a >1 ``tp_axis`` routes through
+    :func:`sharded_attention` (the flash kernel per shard on its local
+    GQA head groups) when both head counts divide the tp degree;
+    otherwise it bumps the fallback counter with reason "tp_heads" and
+    returns the reference directly — plain jnp the partitioner CAN
+    shard, never the single-program flash ``pallas_call`` (which would
+    die in SPMD lowering inside a tp-sharded program).
     """
+    tp = tp_degree(mesh, tp_axis)
+    if tp > 1:
+        if q.shape[1] % tp == 0 and k.shape[1] % tp == 0:
+            return sharded_attention(q, k, v, mesh, axis=tp_axis,
+                                     causal=causal, window=window)
+        count_attn_fallback("tp_heads")
+        # The reference DIRECTLY: use_flash knows nothing about tp, and
+        # tracing the single-program flash pallas_call into a program
+        # whose operands are sharded over the mesh dies in the SPMD
+        # partitioner — the exact crash "tp_heads degrades, never
+        # crashes" promises away.
+        return reference_attention(q, k, v, causal=causal, window=window)
     if use_flash(q, k):
         return flash_attention(q, k, v, causal=causal,
                                window=int(window or 0))

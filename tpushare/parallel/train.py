@@ -20,7 +20,7 @@ from ..models import transformer
 
 
 def lm_loss(params, tokens, cfg: transformer.ModelConfig,
-            remat_policy=None, head_chunk: int = 0):
+            remat_policy=None, head_chunk: int = 0, mesh=None):
     """Next-token cross-entropy; tokens [B, S+1] split into input/target.
 
     ``head_chunk`` > 0 computes the head+softmax one sequence chunk at
@@ -32,13 +32,19 @@ def lm_loss(params, tokens, cfg: transformer.ModelConfig,
     the HBM-traffic saving is what matters on long sequences, where the
     monolithic loss tail was eating the train step's MFU.  Falls back
     to the monolithic path when the chunk does not divide S.
+
+    ``mesh`` (tensor-parallel training on real TPU) keeps the forward
+    on the flash kernel: attention runs per shard through
+    ``ops.attention.sharded_attention`` instead of degrading to the
+    XLA reference under the partitioner.
     """
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     S = inputs.shape[1]
     if head_chunk and S % head_chunk == 0 and S > head_chunk:
         hidden = transformer.forward(params, inputs, cfg,
                                      remat_policy=remat_policy,
-                                     return_hidden=True)   # [B, S, D]
+                                     return_hidden=True,
+                                     mesh=mesh)   # [B, S, D]
         B, _, D = hidden.shape
         n = S // head_chunk
         hs = hidden.reshape(B, n, head_chunk, D).transpose(1, 0, 2, 3)
@@ -61,7 +67,8 @@ def lm_loss(params, tokens, cfg: transformer.ModelConfig,
         total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, ts))
         return total / (B * S)
     logits = transformer.forward(params, inputs, cfg,
-                                 remat_policy=remat_policy)  # [B,S,V] f32
+                                 remat_policy=remat_policy,
+                                 mesh=mesh)  # [B,S,V] f32
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
@@ -128,12 +135,18 @@ ATTN_SAVING_POLICY = jax.checkpoint_policies.save_only_these_names(
 
 
 def make_train_step(cfg: transformer.ModelConfig, optimizer,
-                    remat: str = "none", head_chunk: int = 0):
+                    remat: str = "none", head_chunk: int = 0,
+                    mesh=None):
     """Returns jitted (params, opt_state, tokens) -> (params, opt_state, loss).
 
     ``head_chunk`` > 0 turns on the chunked loss (see :func:`lm_loss`):
     [B, S, vocab] logits never materialize whole — the monolithic loss
     tail's HBM traffic was a measurable MFU drag at long sequences.
+
+    ``mesh`` (a tensor-parallel mesh the params are sharded over) keeps
+    attention on the Pallas flash kernel per shard (see
+    :func:`lm_loss`); without it a tp train step on real TPU silently
+    degrades to the XLA reference attention.
 
     ``remat`` picks the recompute/HBM trade for the backward:
 
@@ -151,14 +164,14 @@ def make_train_step(cfg: transformer.ModelConfig, optimizer,
     """
     if remat == "full":
         loss_fn = jax.checkpoint(functools.partial(
-            lm_loss, cfg=cfg, head_chunk=head_chunk))
+            lm_loss, cfg=cfg, head_chunk=head_chunk, mesh=mesh))
     elif remat == "layer":
         loss_fn = functools.partial(lm_loss, cfg=cfg,
                                     remat_policy=ATTN_SAVING_POLICY,
-                                    head_chunk=head_chunk)
+                                    head_chunk=head_chunk, mesh=mesh)
     elif remat == "none":
         loss_fn = functools.partial(lm_loss, cfg=cfg,
-                                    head_chunk=head_chunk)
+                                    head_chunk=head_chunk, mesh=mesh)
     else:
         raise ValueError(f"remat must be none|layer|full, got {remat!r}")
 

@@ -114,14 +114,12 @@ class LLMServer:
             raise ValueError("tp > 1 requires n_slots > 0 "
                              "(tensor-parallel serving rides the "
                              "continuous batcher)")
-        if tp > 1 and getattr(cfg, "attn_kernel", "xla") == "pallas":
-            # pallas_call is not SPMD-partitionable under the tp mesh;
-            # enforced here (not just argparse) so programmatic
-            # construction fails fast too instead of dying in an
-            # opaque Mosaic/SPMD lowering error at the first tick
-            raise ValueError("attn_kernel='pallas' is single-device "
-                             "for now — use tp <= 1 or the xla read "
-                             "path (DESIGN.md fallback matrix)")
+        # attn_kernel="pallas" + tp > 1 is served: the paged dispatcher
+        # shard_maps the kernel over the tp axis (whole GQA head groups
+        # per shard; ops.attention.sharded_paged_decode_attention) and
+        # falls back to the sharded XLA gather — with the fallback
+        # counter bumped — when the per-shard shapes fail the viability
+        # gates (including indivisible head counts).
         if n_slots > 0:
             from .continuous import ContinuousService
 
@@ -530,7 +528,10 @@ def main(argv=None) -> int:
                          "softmax into one Pallas pass (no dense "
                          "transient; accuracy-bounded vs 'xla', not "
                          "bit-identical); needs --page-size to matter "
-                         "(dense storage ignores it)")
+                         "(dense storage ignores it); composes with "
+                         "--tp (the kernel runs per shard via "
+                         "shard_map; indivisible head counts fall back "
+                         "to the sharded gather)")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--addr", default="0.0.0.0")
     ap.add_argument("--slots", type=int, default=0,
@@ -580,11 +581,6 @@ def main(argv=None) -> int:
         ap.error("--kv-pages requires --page-size")
     if args.tp > 1 and not args.slots:
         ap.error("--tp requires --slots")
-    if args.attn_kernel == "pallas" and args.tp > 1:
-        # pallas_call is not SPMD-partitionable under the tp mesh; the
-        # sharded-pool kernel is future work (DESIGN.md fallback matrix)
-        ap.error("--attn-kernel pallas is single-device for now "
-                 "(use --tp 1 or the xla read path)")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set

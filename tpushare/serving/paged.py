@@ -50,37 +50,43 @@ from .continuous import ContinuousBatcher, _sample_next
 log = logging.getLogger("tpushare.serving")
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len"),
+@functools.partial(jax.jit, static_argnames=("cfg", "prompt_len",
+                                             "mesh"),
                    donate_argnums=(2,))
-def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int):
+def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int,
+             mesh=None):
     return transformer.forward_paged_prefill(
-        params, tokens, cfg, pools, page_rows, prompt_len)
+        params, tokens, cfg, pools, page_rows, prompt_len, mesh=mesh)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "window"),
+@functools.partial(jax.jit, static_argnames=("cfg", "window", "mesh"),
                    donate_argnums=(2,))
 def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
-                   window: int):
+                   window: int, mesh=None):
     return transformer.forward_paged_prefill_chunk(
-        params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx)
+        params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx,
+        mesh=mesh)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "rich"),
+@functools.partial(jax.jit, static_argnames=("cfg", "rich", "mesh"),
                    donate_argnums=(2,))
 def _tick(params, tokens, pools, page_table, lengths, temps, keys,
-          tks, tps, cfg, rich: bool = False):
-    """Paged twin of continuous._tick (same sampling helper)."""
+          tks, tps, cfg, rich: bool = False, mesh=None):
+    """Paged twin of continuous._tick (same sampling helper).  ``mesh``
+    is STATIC (jax.sharding.Mesh hashes by devices+axes): under tp it
+    reaches the paged-attention dispatcher, which shard_maps the Pallas
+    read per device."""
     logits, pools = transformer.forward_paged_decode(
-        params, tokens, cfg, pools, page_table, lengths)
+        params, tokens, cfg, pools, page_table, lengths, mesh=mesh)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
     return nxt, pools
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "mesh"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
-            tks, tps, incs, cfg, n: int, rich: bool = False):
+            tks, tps, incs, cfg, n: int, rich: bool = False, mesh=None):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
@@ -97,11 +103,11 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     the coupling between decode_chunk and the ring size entirely.
     """
     return _decode_scan(params, tokens, pools, page_table, lengths,
-                        temps, keys, tks, tps, incs, cfg, n, rich)
+                        temps, keys, tks, tps, incs, cfg, n, rich, mesh)
 
 
 def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
-                 tks, tps, incs, cfg, n: int, rich: bool):
+                 tks, tps, incs, cfg, n: int, rich: bool, mesh=None):
     """The paged fused decode scan BODY (trace-level) shared by
     :func:`_tick_n` and the mixed-step program :func:`_tick_mixed` —
     one definition, so the two dispatch flavors cannot drift."""
@@ -109,7 +115,7 @@ def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
         tok, pools, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)
         logits, pools = transformer.forward_paged_decode(
-            params, tok, cfg, pools, page_table, lengths)
+            params, tok, cfg, pools, page_table, lengths, mesh=mesh)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
         return (nxt[:, None], pools, lengths + incs, ks[:, 0]), nxt
@@ -120,11 +126,12 @@ def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
-                                             "rich"),
+                                             "rich", "mesh"),
                    donate_argnums=(5,))
 def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
                 page_table, tokens, lengths, temps, keys, tks, tps, incs,
-                cfg, chunk_len: int, n: int, rich: bool = False):
+                cfg, chunk_len: int, n: int, rich: bool = False,
+                mesh=None):
     """Paged twin of continuous._tick_mixed: the coalesced multi-prompt
     prefill (:func:`transformer.forward_paged_prefill_batch` — live rows
     write their own distinct pages, padded rows ride all-zero tables so
@@ -134,10 +141,10 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
     writes through each row's own table row, never reshaping it."""
     sel, pools = transformer.forward_paged_prefill_batch(
         params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
-        p_last)
+        p_last, mesh=mesh)
     toks, keys, pools = _decode_scan(
         params, tokens, pools, page_table, lengths, temps, keys, tks,
-        tps, incs, cfg, n, rich)
+        tps, incs, cfg, n, rich, mesh)
     return sel, toks, keys, pools
 
 
@@ -168,14 +175,6 @@ class PagedContinuousBatcher(ContinuousBatcher):
                  pool_bytes: Optional[int] = None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
-        if mesh is not None and cfg.attn_kernel == "pallas":
-            # pallas_call is not SPMD-partitionable under the tp mesh —
-            # refuse HERE (where the mesh is known), not just in the
-            # CLI, so direct construction fails fast instead of dying
-            # in an opaque Mosaic/SPMD lowering error at the first tick
-            raise ValueError("attn_kernel='pallas' is single-device "
-                             "for now (no mesh); use the xla read "
-                             "path for tensor-parallel paged serving")
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq // page_size
         if pool_bytes is not None:
@@ -238,21 +237,24 @@ class PagedContinuousBatcher(ContinuousBatcher):
         an int8 pool prices its pages (and the ``pool_bytes`` sizing
         knob admits ~2x of them) with the same model the gauges and
         ``/usage`` reporting use."""
-        from ..ops.attention import paged_kernel_viable
+        from ..ops.attention import paged_kernel_viable, tp_degree
         from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
         bytes_per_page = kv_cache_bytes(cfg, self.page_size)
         # the EFFECTIVE read path, not the configured one: a pallas
         # config whose pool cannot lower on Mosaic (page below the
-        # dtype's sublane tile, lane-unaligned head_dim) or a forced
-        # reference escape hatch runs the XLA gather — telemetry must
-        # say so, or an operator debugging HBM pressure / a flat
-        # speedup reads "pallas, transient 0" while every tick pays
-        # the dense gather
+        # dtype's sublane tile, lane-unaligned head_dim), whose head
+        # counts a tp mesh cannot split into whole GQA groups per
+        # shard, or a forced reference escape hatch runs the XLA
+        # gather — telemetry must say so, or an operator debugging HBM
+        # pressure / a flat speedup reads "pallas, transient 0" while
+        # every tick pays the dense gather
         kernel = cfg.attn_kernel
         if kernel == "pallas" and not paged_kernel_viable(
                 self.page_size, cfg.head_dim,
-                transformer.kv_quantized(cfg), cfg.dtype):
+                transformer.kv_quantized(cfg), cfg.dtype,
+                tp=tp_degree(self.mesh), n_kv_heads=cfg.n_kv_heads,
+                n_heads=cfg.n_heads):
             kernel = "xla"
         return {"kind": "paged", "kv_dtype": cfg.kv_dtype,
                 # the attention READ path + what the XLA gather's dense
@@ -477,20 +479,23 @@ class PagedContinuousBatcher(ContinuousBatcher):
             return logits_v
         logits, self.pools = _prefill(
             self.params, tokens, self.pools,
-            jnp.asarray(self.page_table[slot]), self.cfg, prompt_len)
+            jnp.asarray(self.page_table[slot]), self.cfg, prompt_len,
+            mesh=self.mesh)
         return logits[0]      # [V]: the prompt's last-position logits
 
     def _step(self, tokens, lengths, temps, keys, tks, tps, rich):
         nxt, self.pools = _tick(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
-            lengths, temps, keys, tks, tps, self.cfg, rich)
+            lengths, temps, keys, tks, tps, self.cfg, rich,
+            mesh=self.mesh)
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int):
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
-            lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich)
+            lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich,
+            mesh=self.mesh)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -498,7 +503,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         logits, self.pools = _prefill_chunk(
             self.params, jnp.asarray(padded_tokens), self.pools,
             jnp.asarray(self.page_table[slot]), pos, last_idx, self.cfg,
-            chunk_len)
+            chunk_len, mesh=self.mesh)
         return logits
 
     def _mixed_chunk_len(self, chunk: int) -> int:
@@ -524,7 +529,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
             jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
             jnp.asarray(self.page_table), tokens, lengths, temps, keys,
-            tks, tps, incs, self.cfg, chunk_len, n_steps, rich)
+            tks, tps, incs, self.cfg, chunk_len, n_steps, rich,
+            mesh=self.mesh)
         return sel, toks, keys
 
     # ------------------------------------------------------------------
