@@ -2,12 +2,23 @@
 # vet+test+build; here make wraps the same).
 PY ?= python3
 
-.PHONY: all native proto test bench clean
+.PHONY: all native proto test bench lint asan clean
 
 all: native
 
 native:
 	$(MAKE) -C native
+
+# Static analysis, both layers (tpulint AST rules + the Mosaic
+# gate-agreement sweep); env -u: a sitecustomize hook dials the remote
+# TPU tunnel from any python process when PALLAS_AXON_POOL_IPS is set,
+# and the sweep's gate cross-check imports jax.
+lint:
+	env -u PALLAS_AXON_POOL_IPS $(PY) -m tpushare.analysis
+
+# Sanitizer self-check for the native shim (see native/Makefile).
+asan:
+	$(MAKE) -C native asan
 
 proto:
 	protoc --python_out=tpushare/plugin/api \

@@ -24,7 +24,31 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def precheck() -> dict:
+    """Chip-free Mosaic verdicts for the shapes this drive dispatches
+    (fwd+bwd at s=1024, the s=2048 timing shape, and the tp=2 arm's
+    per-shard head split), BEFORE any jax import — a statically-refused
+    layout must never cost a tunnel dial (CLAUDE.md hazards)."""
+    from tpushare.analysis import mosaic
+
+    cells = {}
+    for name, seq in (("bwd_s1024", 1024), ("fwd_s2048", 2048)):
+        cells[name] = mosaic.precheck_flash(
+            seq_q=seq, seq_k=seq, head_dim=128, dtype="bf16").summary()
+    cells["tp2"] = mosaic.precheck_flash(
+        seq_q=1024, seq_k=1024, head_dim=128, dtype="bf16",
+        n_heads=8, n_kv_heads=8, tp=2).summary()
+    return cells
+
+
 def main() -> int:
+    pre = precheck()
+    precheck_ok = all(c["ok"] for c in pre.values())
+    if not precheck_ok:
+        print(json.dumps({"metric": "flash_kernel_drive",
+                          "precheck_ok": False, "precheck": pre}))
+        return 1
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -34,7 +58,8 @@ def main() -> int:
 
     dev = jax.devices()[0]
     out = {"metric": "flash_kernel_drive", "platform": dev.platform,
-           "device_kind": getattr(dev, "device_kind", "?")}
+           "device_kind": getattr(dev, "device_kind", "?"),
+           "precheck_ok": precheck_ok, "precheck": pre}
     on_tpu = dev.platform == "tpu"
     if not on_tpu:
         # still useful off-chip: interpret-mode correctness

@@ -48,7 +48,8 @@ def main() -> int:
 
     def timed(fn):
         r = fn()
-        jax.block_until_ready(r[0]) if isinstance(r, tuple) else None
+        # the host fetch below is the barrier (scalar-fetch; CLAUDE.md:
+        # block_until_ready returns early on the axon backend)
         int(np.asarray(r[0] if isinstance(r, tuple) else r)[0, -1])
         t0 = time.perf_counter()
         for _ in range(2):

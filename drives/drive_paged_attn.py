@@ -41,8 +41,43 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+#: the on-chip serving shape this drive dispatches (must stay in sync
+#: with the TPU branch of main()): n_heads 16 / n_kv_heads 8 on
+#: d_model 2048 -> head_dim 128, page 64, and the coalesced prefill is
+#: the widest q-row block (n_rep 2 x prompt 1024 = 2048 rows)
+_TPU_SHAPE = dict(page=64, head_dim=128, rows=2048, n_kv_heads=8,
+                  n_heads=16)
+
+
+def precheck() -> dict:
+    """Chip-free Mosaic verdicts for every cell this drive would
+    dispatch, BEFORE any jax import (importing jax dials the tunnel
+    when PALLAS_AXON_POOL_IPS is set) — a statically-refused layout
+    must never cost a chip dial.  ``cross_check=False`` for the same
+    reason; the gate-agreement guarantee lives in tier-1
+    (tests/test_analysis.py)."""
+    from tpushare.analysis import mosaic
+
+    cells = {}
+    for kv_dtype in ("bf16", "int8"):
+        for tp in (1, 2):
+            v = mosaic.precheck_paged(
+                quantized=kv_dtype == "int8", dtype="bf16", tp=tp,
+                assume_tpu=True, cross_check=False, **_TPU_SHAPE)
+            cells[f"{kv_dtype}_tp{tp}"] = v.summary()
+    return cells
+
 
 def main() -> int:
+    pre = precheck()
+    precheck_ok = all(c["ok"] for c in pre.values())
+    if not precheck_ok:
+        # refuse to dial: print the verdict as the drive's one JSON
+        # line so the -m tpu lane reports WHY without a tunnel round
+        print(json.dumps({"metric": "paged_attn_decode",
+                          "precheck_ok": False, "precheck": pre}))
+        return 1
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -75,7 +110,8 @@ def main() -> int:
 
     out = {"metric": "paged_attn_decode", "platform": dev.platform,
            "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
-           "page_size": page, "flavors": {}}
+           "page_size": page, "precheck_ok": precheck_ok,
+           "precheck": pre, "flavors": {}}
 
     def run_cell(c, run_params, mesh=None):
         """One (cfg, mesh) cell: coalesced batch prefill (the

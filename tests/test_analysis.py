@@ -1,0 +1,450 @@
+"""The static-analysis plane: Mosaic prechecker + tpulint engine.
+
+Three contracts:
+
+* AGREEMENT — the symbolic prechecker's verdict equals the live
+  dispatch gate's (``ops.attention.paged_kernel_fallback_reason``) on
+  every config in the sweep, including per-shard tp shapes, with each
+  known Mosaic hazard from CLAUDE.md rounds 10/12 reproduced as a
+  named finding.  The cross-check is BUILT IN (``cross_check=True``
+  raises ``GateDriftError``), so a gate edit without a prechecker edit
+  fails here, not on the chip.
+* RULES — each tpulint rule flags its target construct and, unlike the
+  regex lints it replaced, ignores the same text in comments and
+  strings (the false-positive class the AST kills).
+* REPO CLEAN — ``python -m tpushare.analysis`` exits 0 on this repo in
+  a clean subprocess, and docs/LINTS.md matches ``--catalog`` byte for
+  byte (the docs/METRICS.md pattern).
+"""
+
+import importlib
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tpushare.analysis import mosaic, tpulint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: Mosaic prechecker vs the live gate
+# ---------------------------------------------------------------------------
+def test_sweep_agrees_with_gate_and_expectations():
+    """Every sweep case: prechecker == gate (cross-checked inside
+    precheck_paged) AND the hazard expectations hold — any drift
+    surfaces as findings here."""
+    assert mosaic.sweep_findings(cross_check=True) == []
+
+
+def test_sweep_covers_the_known_hazards():
+    """The CLAUDE.md round-10/12 hazards each appear in the sweep as a
+    named refusal (the acceptance list: page-16 int8, non-128 head_dim,
+    indivisible tp heads, VMEM row bound)."""
+    expects = {c["expect"] for c in mosaic.default_sweep()}
+    assert {"page_tile", "head_dim", "tp_heads", "max_rows",
+            None} <= expects
+
+
+@pytest.mark.parametrize("kwargs, reason", [
+    # round-10: page 16 pools fall back on int8 (32-row sublane tile)
+    (dict(page=16, head_dim=128, quantized=True, dtype="bf16"),
+     "page_tile"),
+    # ...while bf16 fills its 16-row tile at the same page size
+    (dict(page=16, head_dim=128, quantized=False, dtype="bf16"), None),
+    (dict(page=8, head_dim=128, quantized=False, dtype="f32"), None),
+    # head_dim must fill the 128-lane tile (pool padding is pool-sized)
+    (dict(page=64, head_dim=64, quantized=False, dtype="bf16"),
+     "head_dim"),
+    # VMEM row bound: long whole-prompt prefills
+    (dict(page=64, head_dim=128, quantized=False, dtype="bf16",
+          rows=4096), "max_rows"),
+    # round-12 structural: heads must divide the tp degree
+    (dict(page=64, head_dim=128, quantized=False, dtype="bf16", tp=2,
+          n_kv_heads=3, n_heads=6), "tp_heads"),
+    (dict(page=64, head_dim=128, quantized=True, dtype="bf16", tp=2,
+          n_kv_heads=8, n_heads=16), None),
+])
+def test_paged_verdicts(kwargs, reason):
+    v = mosaic.precheck_paged(assume_tpu=True, cross_check=True,
+                              **kwargs)
+    assert v.reason == reason, (v.reason, v.findings)
+    assert v.ok == (reason is None)
+    if reason is not None:
+        # refusals come with at least one explanatory finding
+        assert v.findings, v
+
+
+def test_structural_gates_apply_off_tpu_too():
+    """tp_heads refuses on EVERY platform (the gate's round-12
+    promise); Mosaic tile hazards are vacuous off-TPU but still appear
+    as (tpu-only) context findings."""
+    v = mosaic.precheck_paged(page=16, head_dim=64, quantized=True,
+                              dtype="bf16", tp=2, n_kv_heads=3,
+                              n_heads=6, assume_tpu=False,
+                              cross_check=True)
+    assert v.reason == "tp_heads"
+    v2 = mosaic.precheck_paged(page=16, head_dim=64, quantized=True,
+                               dtype="bf16", assume_tpu=False,
+                               cross_check=True)
+    assert v2.ok and v2.reason is None
+    assert any(f.startswith("(tpu-only)") for f in v2.findings), v2
+
+
+def test_forced_escape_hatch_agrees(monkeypatch):
+    """TPUSHARE_FORCE_REFERENCE_ATTN pins reason 'forced' in both the
+    gate (module global, read at import) and the prechecker (env, read
+    per call) — patch both sides the way a forced process would see
+    them and assert they still agree."""
+    attention = importlib.import_module("tpushare.ops.attention")
+
+    monkeypatch.setenv("TPUSHARE_FORCE_REFERENCE_ATTN", "1")
+    monkeypatch.setattr(attention, "FORCE_REFERENCE", True)
+    v = mosaic.precheck_paged(page=64, head_dim=128, quantized=False,
+                              dtype="bf16", cross_check=True)
+    assert v.reason == "forced"
+
+
+def test_max_rows_constant_cannot_drift():
+    """mosaic duplicates PAGED_KERNEL_MAX_ROWS to stay importable
+    without jax; this is the pin (cross_check re-asserts it per call)."""
+    attention = importlib.import_module("tpushare.ops.attention")
+
+    assert mosaic.PAGED_KERNEL_MAX_ROWS == \
+        attention.PAGED_KERNEL_MAX_ROWS
+
+
+def test_gate_drift_raises(monkeypatch):
+    """An edited gate without a prechecker edit is a loud
+    GateDriftError, not a silently stale verdict."""
+    attention = importlib.import_module("tpushare.ops.attention")
+
+    real = attention.paged_kernel_fallback_reason
+    monkeypatch.setattr(
+        attention, "paged_kernel_fallback_reason",
+        lambda *a, **k: "head_dim" if real(*a, **k) is None
+        else real(*a, **k))
+    with pytest.raises(mosaic.GateDriftError):
+        mosaic.precheck_paged(page=64, head_dim=128, quantized=False,
+                              dtype="bf16", cross_check=True)
+
+
+def test_check_block_names_the_layout_rules():
+    """The block-level rules the interpreter cannot prove, unit by
+    unit: 1-D vector blocks refuse; trailing singletons are the ONE
+    lane exception; pool blocks need the full per-dtype sublane tile."""
+    # the round-10 scale-block hazard: [page] 1-D refuses, [page, 1]
+    # (lane-padded trailing singleton) lowers
+    assert mosaic.check_block(mosaic.Block("scale", (64,), "f32"))
+    assert not mosaic.check_block(mosaic.Block("scale", (64, 1), "f32"))
+    # non-128 lane dim refuses
+    assert mosaic.check_block(mosaic.Block("q", (8, 64), "bf16"))
+    # strict pool sublane: int8 page 16 refuses, 32 lowers
+    assert mosaic.check_block(
+        mosaic.Block("k", (16, 128), "int8", strict_sublane=True))
+    assert not mosaic.check_block(
+        mosaic.Block("k", (32, 128), "int8", strict_sublane=True))
+    # row blocks the kernel pads itself: the 8-row multiple suffices
+    assert not mosaic.check_block(mosaic.Block("q", (8, 128), "bf16"))
+
+
+def test_paged_blocks_carry_the_scale_layout():
+    """int8 stores add trailing-singleton [page, 1] f32 scale blocks
+    alongside the int8 pool blocks — the exact layout the committed
+    drive proves on chip."""
+    blocks = {b.name: b for b in mosaic.paged_blocks(
+        64, 128, quantized=True, dtype="bf16", rows=8)}
+    assert blocks["k_scale"].shape == (64, 1)
+    assert blocks["k_scale"].dtype == "f32"
+    assert blocks["k_page"].dtype == "int8"
+    assert blocks["k_page"].strict_sublane
+    # unquantized stores have no scale leaves
+    names = {b.name for b in mosaic.paged_blocks(
+        64, 128, quantized=False, dtype="bf16", rows=8)}
+    assert "k_scale" not in names
+
+
+def test_flash_precheck_matches_fit_block():
+    """precheck_flash refuses exactly where ops.attention._fit_block
+    raises (the seq-tiling rule), and passes the committed drive
+    shapes."""
+    from tpushare.ops.attention import _fit_block
+
+    ok = mosaic.precheck_flash(seq_q=1024, seq_k=1024, head_dim=128,
+                               dtype="bf16")
+    assert ok.ok and ok.reason is None
+    # head_dim 64 pads (BERT-base) — no refusal, unlike the paged pool
+    assert mosaic.precheck_flash(seq_q=256, seq_k=256, head_dim=64,
+                                 dtype="bf16").ok
+    # a seq whose largest block divisor is not an 8-row multiple:
+    # runtime raises, the prechecker refuses with the same rule
+    bad_seq = 12
+    refused = mosaic.precheck_flash(seq_q=bad_seq, seq_k=bad_seq,
+                                    head_dim=128, dtype="bf16")
+    assert not refused.ok and refused.reason == "seq_tile", refused
+    with pytest.raises(ValueError):
+        _fit_block(512, bad_seq)
+    # tp divisibility mirrors the sharded-attention gate
+    assert mosaic.precheck_flash(
+        seq_q=1024, seq_k=1024, head_dim=128, dtype="bf16",
+        n_heads=6, n_kv_heads=3, tp=4).reason == "tp_heads"
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: tpulint rules
+# ---------------------------------------------------------------------------
+def _lint(path, code, rule):
+    return tpulint.lint_source(path, code, rules=[rule])
+
+
+def test_rule_block_until_ready():
+    bad = "import jax\njax.block_until_ready(x)\ny.block_until_ready()\n"
+    fs = _lint("tpushare/serving/new.py", bad, "no-block-until-ready")
+    assert [f.line for f in fs] == [2, 3]
+    # the false-positive class the regexes suffered: comments/strings
+    clean = ('# block_until_ready is unreliable\n'
+             's = "never call block_until_ready"\n')
+    assert not _lint("tpushare/serving/new.py", clean,
+                     "no-block-until-ready")
+    # the graft harness entry is the documented exception
+    assert not _lint("__graft_entry__.py", bad, "no-block-until-ready")
+    # the from-import evasion: both the import and the bare-name call
+    # are findings (an attribute-only match would miss them)
+    evade = ("from jax import block_until_ready\n"
+             "block_until_ready(x)\n")
+    assert len(_lint("tpushare/serving/new.py", evade,
+                     "no-block-until-ready")) == 2
+
+
+def test_rule_hardcoded_interpret():
+    bad = "o = flash_attention(q, q, q, interpret=True)\n"
+    assert _lint("tests/test_new.py", bad, "no-hardcoded-interpret")
+    # explicit False (forcing a real compile) and None both stay legal,
+    # and the rule only patrols tests/
+    assert not _lint("tests/test_new.py",
+                     "o = f(interpret=False)\np = g(interpret=None)\n",
+                     "no-hardcoded-interpret")
+    assert not _lint("drives/drive_new.py", bad,
+                     "no-hardcoded-interpret")
+
+
+def test_rule_pallas_call_confined():
+    bad = "from jax.experimental import pallas as pl\npl.pallas_call(k)\n"
+    assert _lint("tpushare/ops/newkernel.py", bad,
+                 "pallas-call-confined")
+    assert not _lint("tpushare/ops/attention.py", bad,
+                     "pallas-call-confined")
+    # string probes (jaxpr.count("pallas_call")) no longer trip it
+    assert not _lint("tpushare/ops/newkernel.py",
+                     'n = jaxpr.count("pallas_call")\n',
+                     "pallas-call-confined")
+
+
+def test_rule_paged_gather_confined():
+    bad = "g = pool[page_table]\n"
+    assert _lint("tpushare/serving/new.py", bad,
+                 "paged-gather-confined")
+    # the sanctioned body: the real _paged_gather function range
+    ok = "def _paged_gather(pool, page_table):\n    return pool[page_table]\n"
+    assert not _lint("tpushare/models/transformer.py", ok,
+                     "paged-gather-confined")
+    # ...but only in transformer.py
+    assert _lint("tpushare/serving/new.py", ok, "paged-gather-confined")
+
+
+def test_rule_kv_byte_math():
+    bad = "b = 2 * n_kv_heads * head_dim * 2\n"
+    assert _lint("tpushare/serving/new.py", bad, "kv-byte-math")
+    bad_attr = "b = 2 * seq * cfg.n_kv_heads\n"
+    assert _lint("tpushare/serving/new.py", bad_attr, "kv-byte-math")
+    assert not _lint("tpushare/ops/quant.py", bad, "kv-byte-math")
+    # a comment mentioning the formula is not a finding (regex era was)
+    assert not _lint("tpushare/serving/new.py",
+                     "# bytes = 2 * n_kv_heads * hd\nx = 1\n",
+                     "kv-byte-math")
+    # 2 * without n_kv_heads in the statement is unrelated math
+    assert not _lint("tpushare/serving/new.py", "pad = 2 * page\n",
+                     "kv-byte-math")
+
+
+def test_rule_subprocess_env_scrub():
+    spawn = ("import subprocess, os\n"
+             "subprocess.run(['python', '-c', 'pass'])\n")
+    fs = _lint("tests/test_new.py", spawn, "subprocess-env-scrub")
+    assert fs and "PALLAS_AXON_POOL_IPS" in fs[0].message
+    scrubbed = ("import subprocess, os\n"
+                "env = dict(os.environ, JAX_PLATFORMS='cpu')\n"
+                "env.pop('PALLAS_AXON_POOL_IPS', None)\n"
+                "subprocess.run(['python'], env=env)\n")
+    assert not _lint("tests/test_new.py", scrubbed,
+                     "subprocess-env-scrub")
+    # subscript spelling of the pin counts too
+    scrubbed2 = ("import subprocess, os\n"
+                 "env = dict(os.environ)\n"
+                 "env['JAX_PLATFORMS'] = 'cpu'\n"
+                 "env.pop('PALLAS_AXON_POOL_IPS', None)\n"
+                 "subprocess.Popen(['python'], env=env)\n")
+    assert not _lint("tests/test_new.py", scrubbed2,
+                     "subprocess-env-scrub")
+    # a READ of the key is not a pin: the child still inherits an
+    # unpinned JAX_PLATFORMS (the exact hazard the rule blocks)
+    read_only = ("import subprocess, os\n"
+                 "env = dict(os.environ)\n"
+                 "env.pop('PALLAS_AXON_POOL_IPS', None)\n"
+                 "plat = env.get('JAX_PLATFORMS')\n"
+                 "subprocess.run(['python'], env=env)\n")
+    assert _lint("tests/test_new.py", read_only, "subprocess-env-scrub")
+    # ...while a setdefault write counts
+    setdef = ("import subprocess, os\n"
+              "env = dict(os.environ)\n"
+              "env.pop('PALLAS_AXON_POOL_IPS', None)\n"
+              "env.setdefault('JAX_PLATFORMS', 'cpu')\n"
+              "subprocess.run(['python'], env=env)\n")
+    assert not _lint("tests/test_new.py", setdef, "subprocess-env-scrub")
+    # the real-chip lane re-injects deliberately: allowlisted
+    assert not _lint("tests/test_tpu_lane.py", spawn,
+                     "subprocess-env-scrub")
+
+
+def test_rule_telemetry_lock():
+    bad = ("from tpushare.telemetry import health\n"
+           "health.MONITOR._inflight = {}\n"
+           "health.MONITOR.state = 'ok'\n")
+    fs = _lint("tests/test_new.py", bad, "telemetry-lock")
+    assert [f.line for f in fs] == [2, 3]
+    # the public float knobs stay assignable (guards sample them once)
+    ok = ("from tpushare.telemetry import health\n"
+          "health.MONITOR.dispatch_deadline_s = 30.0\n"
+          "health.MONITOR.slow_record_s = 0.0\n"
+          "MONITOR.reset()\n"
+          "RECORDER.clear()\n")
+    assert not _lint("tests/test_new.py", ok, "telemetry-lock")
+    # inside the telemetry package the lock-holding code mutates freely
+    assert not _lint("tpushare/telemetry/health.py", bad,
+                     "telemetry-lock")
+
+
+def test_run_rule_rejects_unknown_names():
+    """A renamed rule cannot silently hollow out its pytest wrapper."""
+    with pytest.raises(KeyError):
+        tpulint.run_rule("no-such-rule")
+
+
+def test_lint_source_reports_syntax_errors():
+    fs = tpulint.lint_source("tpushare/broken.py", "def f(:\n")
+    assert fs and fs[0].rule == "parse"
+
+
+def test_repo_file_walk_covers_all_planes():
+    files = tpulint.repo_python_files(REPO)
+    assert "tpushare/ops/attention.py" in files
+    assert "tests/test_metric_lint.py" in files
+    assert "drives/drive_paged_attn.py" in files
+    assert "bench.py" in files
+
+
+# ---------------------------------------------------------------------------
+# Repo-clean + catalog sync (the docs/METRICS.md pattern)
+# ---------------------------------------------------------------------------
+def _clean_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def test_cli_exits_zero_on_this_repo():
+    """The acceptance criterion: `python -m tpushare.analysis` is clean
+    on the repo (both layers, live gate cross-check included)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpushare.analysis"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=_clean_env())
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert "0 finding(s)" in out.stderr
+
+
+def test_cli_flags_a_seeded_offender(tmp_path):
+    """End-to-end negative control: a file with a banned construct
+    makes the CLI exit non-zero and name the rule."""
+    bad = tmp_path / "tpushare" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text("import jax\njax.block_until_ready(x)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpushare.analysis", "--root",
+         str(tmp_path), "tpushare/serving/bad.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=_clean_env())
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    assert "no-block-until-ready" in out.stdout
+
+
+def test_lints_catalog_in_sync():
+    """docs/LINTS.md matches `--catalog` byte for byte (clean
+    subprocess, mirroring the docs/METRICS.md sync test)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tpushare.analysis", "--catalog"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=_clean_env())
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(os.path.join(REPO, "docs", "LINTS.md")) as f:
+        committed = f.read()
+    assert out.stdout == committed, (
+        "docs/LINTS.md is stale — regenerate with "
+        "`python -m tpushare.analysis --catalog > docs/LINTS.md`")
+
+
+def test_catalog_names_every_rule():
+    cat = tpulint.render_catalog()
+    for name in tpulint.RULES:
+        assert f"`{name}`" in cat
+
+
+# ---------------------------------------------------------------------------
+# The telemetry-lock rule's TARGET invariant: a threaded race smoke
+# ---------------------------------------------------------------------------
+def test_locked_telemetry_mutation_survives_threads():
+    """What the telemetry-lock rule protects: mutations through the
+    locked API stay consistent under thread hammering — the one-hot
+    health render keeps exactly one live state, counters lose no
+    increments.  (Direct attribute writes — the thing the rule bans —
+    have no such guarantee.)"""
+    from tpushare import telemetry
+    from tpushare.telemetry import health
+    from tpushare.telemetry.registry import Counter
+
+    c = Counter("tpushare_race_smoke_total", "standalone race probe")
+    n_threads, n_iter = 8, 400
+    errors = []
+
+    def worker(i):
+        try:
+            for k in range(n_iter):
+                c.inc()
+                health.MONITOR.set_state(
+                    health.DEGRADED if (i + k) % 2 else health.OK,
+                    reason=f"race-smoke-{i}")
+                snap = health.MONITOR.snapshot()
+                assert snap["state"] in health.STATES
+        except Exception as e:          # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert c.value() == n_threads * n_iter
+        # one-hot invariant holds after the storm
+        parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+        states = {l["state"]: v for l, v in
+                  parsed["samples"]["tpushare_backend_health_state"]}
+        assert sum(states.values()) == 1.0
+    finally:
+        # MONITOR is process-global; leave it as the next test expects
+        health.MONITOR.reset()

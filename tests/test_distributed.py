@@ -51,7 +51,9 @@ def test_device_trace_writes_profile(tmp_path):
     from tpushare.utils.profiler import device_trace
 
     with device_trace(str(tmp_path)) as logdir:
-        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+        # scalar-fetch barrier (lint no-block-until-ready): one element
+        # fetch drains the in-order stream
+        float((jnp.ones((64, 64)) @ jnp.ones((64, 64)))[0, 0])
     import os
     found = []
     for root, _, files in os.walk(tmp_path):

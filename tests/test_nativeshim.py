@@ -198,6 +198,30 @@ def test_shim_event_channel_node_lifecycle(tmp_path):
     assert {(e["chip"], e["healthy"]) for e in p4} == {(1, True), (7, True)}
 
 
+@pytest.mark.skipif(
+    os.environ.get("TPUSHARE_RUN_ASAN") != "1",
+    reason="opt-in sanitizer lane: set TPUSHARE_RUN_ASAN=1 "
+           "(needs gcc with libasan)")
+def test_shim_asan_clean(tmp_path):
+    """Sanitizer build mode (`make -C native asan`): the shim plus a
+    self-check main as one ASan+UBSan executable, walked over a fake
+    device tree — heap/stack/global violations and UB abort with a
+    sanitizer report instead of corrupting the daemon at 3am.  Opt-in
+    (env above) because it recompiles the shim; a clean run prints
+    asan-ok and takes well under a second."""
+    subprocess.run(["make", "-C", os.path.join(REPO, "native"), "asan"],
+                   check=True, capture_output=True)
+    for i in range(3):
+        (tmp_path / f"accel{i}").touch()
+    out = subprocess.run(
+        [os.path.join(REPO, "native", "tpushim_asan_check")],
+        env=_cpu_env(TPUSHIM_DEV_GLOB=str(tmp_path / "accel*"),
+                     TPUSHIM_ACCELERATOR_TYPE="v5e-4"),
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert "asan-ok" in out.stdout
+
+
 def test_libtpu_backend_translates_native_events():
     """LibtpuBackend.poll_health maps the shim's JSON transitions onto
     HealthEvents (chip -1 = unattributable passes through)."""

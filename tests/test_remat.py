@@ -69,7 +69,9 @@ def test_attn_saving_policy_drops_forward_kernel_recompute():
         b, s, d = x.shape
         h = 2
         q = (x @ w).reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
-        o = flash_attention(q, q, q, causal=True, interpret=True)
+        # interpret resolves via default_interpret() (True off-TPU) —
+        # hard-coding it is lint-banned (no-hardcoded-interpret)
+        o = flash_attention(q, q, q, causal=True)
         return o.transpose(0, 2, 1, 3).reshape(b, s, d) @ w.T
 
     def make_loss(policy_kind):

@@ -101,6 +101,9 @@ def _run(script, timeout=2400, at=("drives",), all_lines=False,
 @_skip
 def test_flash_kernel_on_chip():
     rec = _run("drive_flash_kernel.py")
+    # the drive prechecks its layouts statically BEFORE dialing (a
+    # refused layout prints the verdict and exits without a dial)
+    assert rec.get("precheck_ok", True), rec
     assert rec["bwd_ok"], rec
     assert rec["platform"] == "tpu", rec
     # round 12: the kernel must also lower PER SHARD under shard_map
@@ -247,6 +250,8 @@ def test_paged_attn_kernel_on_chip():
     (CLAUDE.md hazard) — and must not LOSE to the XLA gather it
     replaces at identical occupancy on memory-bound decode."""
     rec = _run("drive_paged_attn.py", timeout=3600)
+    # static Mosaic precheck ran pre-dial and agreed the layout lowers
+    assert rec.get("precheck_ok", True), rec
     assert rec["compile_ok"], rec
     # round 12 shard_map arm: the per-shard [page, 1] scale tiles must
     # lower under shard_map too (skipped on single-device hosts)

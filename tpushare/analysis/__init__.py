@@ -1,0 +1,32 @@
+"""Static-analysis plane: chip-free Mosaic prechecks + AST invariant lints.
+
+Two layers, one CLI (``python -m tpushare.analysis``, non-zero exit on
+findings — wired as ``make lint`` and run in tier-1):
+
+* :mod:`tpushare.analysis.mosaic` — a SYMBOLIC Mosaic layout prechecker:
+  given the kernel-call parameters a config would produce, it derives
+  every block the flash and paged Pallas kernels would hand
+  ``pallas_call`` and validates them against the tiling rules the Pallas
+  INTERPRETER does not enforce (CLAUDE.md hazard: a kernel can pass
+  every interpret-mode test and still refuse to lower on real TPU).
+  Stdlib-only on purpose: drives consult it BEFORE importing jax, so a
+  statically-refused layout never costs a tunnel dial.  Its verdict is
+  cross-checked against the live dispatch gate
+  (``ops.attention.paged_kernel_fallback_reason``) so the gate and the
+  checker can never drift.
+
+* :mod:`tpushare.analysis.tpulint` — an AST-based rule engine holding
+  the repo's hard-won invariants (no ``block_until_ready`` barriers,
+  ``pallas_call`` confined to ops/attention.py, no raw KV byte math,
+  env scrubbing in subprocess tests, ...), replacing the brittle
+  regex grep-lints: matching on the AST kills the comment/string
+  false-positive class and lets rules see scope (the one sanctioned
+  ``_paged_gather`` body, keyword arguments, assignment targets).
+
+``python -m tpushare.analysis --catalog`` renders docs/LINTS.md (the
+rule catalog; sync-tested like docs/METRICS.md).
+"""
+
+from . import mosaic, tpulint  # noqa: F401
+
+__all__ = ["mosaic", "tpulint"]

@@ -1,0 +1,64 @@
+"""``python -m tpushare.analysis`` — run both analysis layers, exit
+non-zero on findings (wired as ``make lint``; tier-1 runs it via
+tests/test_analysis.py in a clean subprocess).
+
+Layer 2 (tpulint) needs only the stdlib; Layer 1's gate cross-check
+imports jax (ops.attention), so run the CLI with the tunnel scrubbed
+(``env -u PALLAS_AXON_POOL_IPS``, as the Makefile target does) — the
+gate itself never initializes a backend, but a sitecustomize hook dials
+on ANY jax import when the variable is set.
+
+``--catalog`` renders docs/LINTS.md (stdlib-only, no jax) and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import mosaic, tpulint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpushare.analysis",
+        description="tpushare static analysis: Mosaic layout precheck "
+                    "+ AST invariant lints")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files to lint (default: the "
+                         "whole repo tree + the Mosaic drift sweep)")
+    ap.add_argument("--catalog", action="store_true",
+                    help="print the docs/LINTS.md rule catalog and exit")
+    ap.add_argument("--root", default=None,
+                    help="checkout root (default: derived from the "
+                         "package location)")
+    ap.add_argument("--no-mosaic", action="store_true",
+                    help="skip the Mosaic gate-agreement sweep (it "
+                         "imports jax for the live cross-check)")
+    args = ap.parse_args(argv)
+
+    if args.catalog:
+        print(tpulint.render_catalog(), end="")
+        return 0
+
+    root = args.root or tpulint.repo_root()
+    if args.paths:
+        findings = [str(f) for f in tpulint.lint_paths(args.paths,
+                                                       root=root)]
+        n_files = len(args.paths)
+    else:
+        files = tpulint.repo_python_files(root)
+        findings = [str(f) for f in tpulint.lint_paths(files, root=root)]
+        n_files = len(files)
+        if not args.no_mosaic:
+            findings.extend(mosaic.sweep_findings(cross_check=True))
+
+    for f in findings:
+        print(f)
+    print(f"tpushare.analysis: {n_files} files, {len(tpulint.RULES)} "
+          f"rules, {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

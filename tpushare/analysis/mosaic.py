@@ -1,0 +1,536 @@
+"""Symbolic Mosaic layout prechecker for the repo's Pallas kernels.
+
+The Pallas interpreter enforces NONE of Mosaic's block-layout rules, so
+a kernel can pass every interpret-mode test and still refuse to lower on
+real TPU — rounds 10 and 12 each burned scarce tunnel time discovering
+exactly that (CLAUDE.md "Environment hazards").  This module answers the
+lowering question WITHOUT a chip: given the parameters a kernel call
+would receive, it derives every block the call would hand
+``pallas_call`` (mirroring ``ops.attention._flash_pallas`` /
+``paged_decode_attention`` shape for shape) and validates them against
+the rules that only the real Mosaic compiler checks:
+
+* the last two dims of every block must be (8k, 128) tiles — a squeezed
+  1-D vector block refuses to lower (per-row stats must ride a
+  lane-broadcast ``[rows, 128]`` tile, like jax's own flash kernel);
+* the ONE sanctioned exception: a trailing-singleton last dim
+  (``[page, 1]`` int8 scale blocks) — Mosaic lane-pads the singleton;
+* K/V POOL blocks must fill the store dtype's sublane tile
+  (int8 32 / bf16 16 / f32 8 rows — page_size 16 pools fall back on
+  int8!), while row-dim blocks the kernels pad themselves need the
+  8-row multiple the padding guarantees;
+* the paged kernel's whole q-row block plus its three f32 scratches
+  must fit VMEM (:data:`PAGED_KERNEL_MAX_ROWS`, with the byte estimate
+  made explicit here);
+* under tensor parallelism the kernels run per shard through
+  ``shard_map``, so both head counts must divide the tp degree
+  (round 12's structural ``tp_heads`` gate) — all other paged-block
+  shapes are shard-invariant, so the verdict is uniform across shards.
+
+STDLIB-ONLY by design: drives consult the prechecker BEFORE importing
+jax (importing jax dials the tunnel when ``PALLAS_AXON_POOL_IPS`` is
+set), so a statically-refused layout never costs a chip dial.  The
+jax-importing part — :func:`cross_check`, which asserts the verdict
+agrees with the live dispatch gate
+(``ops.attention.paged_kernel_fallback_reason``) so gate and checker
+can never drift — is opt-in per call (``cross_check=True``, the default
+for the CLI and tests; drives pass ``False`` pre-dial).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import List, Optional, Sequence, Tuple
+
+#: Mosaic's lane tile: the last dim of every block is laid out over 128
+#: vector lanes.
+LANE = 128
+
+#: Minimum sublane rows per dtype itemsize (the second-to-last block
+#: dim): f32 8, bf16 16, int8 32 — smaller blocks refuse to lower.
+SUBLANE_BY_ITEMSIZE = {4: 8, 2: 16, 1: 32}
+
+#: Mirror of ``ops.attention.PAGED_KERNEL_MAX_ROWS`` — duplicated so
+#: this module stays importable without jax; :func:`cross_check` (and
+#: tests/test_analysis.py) assert the two never drift.
+PAGED_KERNEL_MAX_ROWS = 2048
+
+#: VMEM budget per TensorCore the q-row bound protects (~16 MiB on the
+#: deployed generations); the estimate below is advisory context for
+#: findings, the BINDING rule is the row bound the gate enforces.
+VMEM_BYTES = 16 * 1024 * 1024
+
+#: dtype-name canonicalization: the prechecker speaks short names, the
+#: live gate speaks numpy/jnp dtypes.
+_DTYPES = {
+    "f32": ("float32", 4), "float32": ("float32", 4),
+    "bf16": ("bfloat16", 2), "bfloat16": ("bfloat16", 2),
+    "f16": ("float16", 2), "float16": ("float16", 2),
+    "int8": ("int8", 1), "i8": ("int8", 1),
+    "int32": ("int32", 4), "i32": ("int32", 4),
+}
+
+
+def canon_dtype(dtype) -> Tuple[str, int]:
+    """(numpy-spelled name, itemsize) for a short name, numpy-spelled
+    name, or anything with an ``itemsize``/``name`` (np/jnp dtypes)."""
+    if isinstance(dtype, str):
+        try:
+            return _DTYPES[dtype]
+        except KeyError:
+            raise ValueError(f"unknown dtype name {dtype!r}") from None
+    name = getattr(dtype, "__name__", None) or str(dtype)
+    if name in _DTYPES:
+        return _DTYPES[name]
+    itemsize = getattr(dtype, "itemsize", None)
+    if itemsize is None:
+        raise ValueError(f"cannot canonicalize dtype {dtype!r}")
+    return name, int(itemsize)
+
+
+def sublane_tile(dtype) -> int:
+    """Minimum sublane rows for ``dtype`` (int8 32 / bf16 16 / f32 8)."""
+    return SUBLANE_BY_ITEMSIZE[canon_dtype(dtype)[1]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    """One block a kernel hands ``pallas_call`` (a BlockSpec's block
+    shape, or a VMEM scratch shape — Mosaic tiles both the same way).
+
+    ``strict_sublane``: pool blocks carry the store dtype's full
+    sublane-tile requirement (the round-10 ``page_tile`` hazard); row
+    blocks the kernels pad themselves only need the 8-row multiple the
+    padding guarantees (512-wide flash blocks and the drives' committed
+    shapes prove 8k rows lower for bf16).
+    """
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+    strict_sublane: bool = False
+    note: str = ""
+
+    @property
+    def nbytes(self) -> int:
+        return math.prod(self.shape) * canon_dtype(self.dtype)[1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """A prechecker answer: ``ok`` mirrors the dispatch gate
+    (``reason`` uses the gate's enum — see
+    ``ops.attention.FALLBACK_REASONS``); ``findings`` name every
+    violated layout rule (strictly more detail than the one-reason
+    gate); ``blocks``/``vmem_bytes`` are the derived evidence."""
+
+    ok: bool
+    reason: Optional[str]
+    findings: Tuple[str, ...]
+    blocks: Tuple[Block, ...]
+    vmem_bytes: int = 0
+
+    def summary(self) -> dict:
+        """JSON-friendly form for drive records (``precheck`` key)."""
+        return {"ok": self.ok, "reason": self.reason,
+                "findings": list(self.findings),
+                "vmem_bytes": self.vmem_bytes}
+
+
+class GateDriftError(AssertionError):
+    """The symbolic verdict disagrees with the live dispatch gate —
+    one of the two changed without the other; fix the drift before
+    trusting either."""
+
+
+def check_block(block: Block) -> List[str]:
+    """Mosaic tile findings for one block (empty = lowers).
+
+    Rules (the ones the interpreter cannot prove): rank >= 2 — a 1-D
+    vector block refuses to lower; last dim a 128-lane multiple OR the
+    sanctioned trailing singleton (lane-padded by Mosaic); second-to-
+    last dim a sublane-tile multiple (full per-dtype tile for
+    ``strict_sublane`` pool blocks, the guaranteed 8-row multiple
+    otherwise)."""
+    out = []
+    if len(block.shape) < 2:
+        out.append(
+            f"{block.name}: 1-D vector block {block.shape} refuses to "
+            f"lower on Mosaic — per-row values must ride a "
+            f"lane-broadcast [rows, {LANE}] tile (or a trailing-"
+            f"singleton [rows, 1] block)")
+        return out
+    rows, lanes = block.shape[-2], block.shape[-1]
+    if lanes != 1 and lanes % LANE:
+        out.append(
+            f"{block.name}: last block dim {lanes} is not a "
+            f"{LANE}-lane multiple (and not the sanctioned trailing "
+            f"singleton)")
+    sublane = sublane_tile(block.dtype) if block.strict_sublane else 8
+    if rows % sublane:
+        what = (f"the {block.dtype} sublane tile ({sublane} rows)"
+                if block.strict_sublane else
+                f"the 8-row sublane multiple")
+        out.append(
+            f"{block.name}: second-to-last block dim {rows} does not "
+            f"fill {what}")
+    return out
+
+
+def _forced() -> bool:
+    """Mirror of ``ops.attention.FORCE_REFERENCE``'s import-time env
+    read (kept env-based here so the prechecker needs no jax import);
+    :func:`cross_check` catches any runtime divergence."""
+    return os.environ.get("TPUSHARE_FORCE_REFERENCE_ATTN") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Paged decode kernel (ops.attention.paged_decode_attention)
+# ---------------------------------------------------------------------------
+def paged_blocks(page: int, head_dim: int, quantized: bool, dtype,
+                 rows: int = 1) -> List[Block]:
+    """Every block ``paged_decode_attention`` would hand
+    ``pallas_call`` (inputs, output, VMEM scratch), mirrored shape for
+    shape from the kernel body — change the kernel, change this list,
+    and the agreement sweep in tests/test_analysis.py will tell you if
+    you forgot."""
+    compute = canon_dtype(dtype)[0]
+    store = "int8" if quantized else compute
+    rows_p = max(8, -(-rows // 8) * 8)
+    blocks = [
+        Block("qpos", (rows_p, LANE), "int32",
+              note="lane-broadcast query positions"),
+        Block("q", (rows_p, head_dim), compute),
+        Block("k_page", (page, head_dim), store, strict_sublane=True,
+              note="pool block: last two pool dims"),
+        Block("v_page", (page, head_dim), store, strict_sublane=True),
+        Block("out", (rows_p, head_dim), compute),
+        Block("m_scratch", (rows_p, LANE), "f32"),
+        Block("l_scratch", (rows_p, LANE), "f32"),
+        Block("acc_scratch", (rows_p, head_dim), "f32"),
+    ]
+    if quantized:
+        blocks[3:3] = [
+            Block("k_scale", (page, 1), "f32", strict_sublane=False,
+                  note="trailing-singleton [page, 1]: Mosaic lane-pads "
+                       "the singleton; a 1-D [page] block would refuse "
+                       "to lower"),
+            Block("v_scale", (page, 1), "f32"),
+        ]
+    return blocks
+
+
+def paged_vmem_bytes(page: int, head_dim: int, quantized: bool, dtype,
+                     rows: int = 1) -> int:
+    """VMEM the paged kernel holds live per program (blocks + scratch)."""
+    return sum(b.nbytes for b in paged_blocks(page, head_dim, quantized,
+                                              dtype, rows))
+
+
+def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
+                   rows: int = 1, tp: int = 1, n_kv_heads: int = 0,
+                   n_heads: int = 0, assume_tpu: bool = True,
+                   cross_check: bool = False) -> Verdict:
+    """Would ``paged_decode_attention`` LOWER at these parameters on a
+    real chip?  The chip-free twin of the dispatch gate
+    (``ops.attention.paged_kernel_fallback_reason``): same parameters,
+    same reason enum, same precedence — but derived from the block
+    layout rules, with every violation named in ``findings``.
+
+    ``assume_tpu=False`` answers for an interpret-mode host (Mosaic
+    gates vacuous — only the structural ``tp_heads``/``forced`` gates
+    apply), exactly like the live gate off-TPU.  ``cross_check=True``
+    imports the live gate and raises :class:`GateDriftError` on any
+    disagreement — NEVER pass it from a pre-dial drive (it imports
+    jax)."""
+    findings: List[str] = []
+    reason: Optional[str] = None
+
+    if _forced():
+        reason = "forced"
+        findings.append(
+            "TPUSHARE_FORCE_REFERENCE_ATTN=1: the reference escape "
+            "hatch is open — every kernel dispatch falls back")
+    if tp > 1 and ((n_kv_heads and n_kv_heads % tp)
+                   or (n_heads and n_heads % tp)):
+        reason = reason or "tp_heads"
+        findings.append(
+            f"tp={tp} cannot split whole GQA head groups: n_kv_heads="
+            f"{n_kv_heads} / n_heads={n_heads} must both divide the tp "
+            f"degree (shard_map runs the kernel per shard with no "
+            f"cross-shard softmax) — structural, refuses on EVERY "
+            f"platform, degrades to the sharded XLA gather")
+
+    # per-shard shapes: head counts divide by tp, everything else is
+    # shard-invariant (rows = n_rep * S with n_rep = n_heads/n_kv_heads
+    # unchanged by a division of both counts)
+    blocks = tuple(paged_blocks(page, head_dim, quantized, dtype, rows))
+    vmem = sum(b.nbytes for b in blocks)
+
+    mosaic_findings: List[str] = []
+    for b in blocks:
+        mosaic_findings.extend(check_block(b))
+    if rows > PAGED_KERNEL_MAX_ROWS:
+        mosaic_findings.append(
+            f"q-row block rows={rows} exceeds PAGED_KERNEL_MAX_ROWS="
+            f"{PAGED_KERNEL_MAX_ROWS}: the whole row dim rides one "
+            f"block plus three f32 scratches (~{vmem // 1024} KiB here "
+            f"of ~{VMEM_BYTES // (1024 * 1024)} MiB VMEM) — long "
+            f"whole-prompt prefills fall back per dispatch")
+
+    if assume_tpu:
+        findings.extend(mosaic_findings)
+        if reason is None:
+            # the gate's precedence: head_dim, then max_rows, then
+            # page_tile (tests/test_analysis.py sweeps agreement)
+            if head_dim % LANE:
+                reason = "head_dim"
+            elif rows > PAGED_KERNEL_MAX_ROWS:
+                reason = "max_rows"
+            elif page % sublane_tile("int8" if quantized else dtype):
+                reason = "page_tile"
+    elif mosaic_findings:
+        # interpret mode enforces no tiling: record what WOULD refuse
+        # on a real chip as context, but don't let it flip the verdict
+        findings.extend(f"(tpu-only) {f}" for f in mosaic_findings)
+
+    v = Verdict(ok=reason is None, reason=reason,
+                findings=tuple(findings), blocks=blocks, vmem_bytes=vmem)
+    if cross_check:
+        _cross_check_paged(v, page, head_dim, quantized, dtype, rows,
+                           tp, n_kv_heads, n_heads, assume_tpu)
+    return v
+
+
+def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
+                       rows, tp, n_kv_heads, n_heads, assume_tpu):
+    """Assert the symbolic verdict equals the LIVE gate's (imports jax;
+    also pins the duplicated max-rows constant)."""
+    # NOT ``from ..ops import attention`` — the ops __init__ re-exports
+    # the attention FUNCTION under that name
+    from ..ops.attention import PAGED_KERNEL_MAX_ROWS as gate_max_rows
+    from ..ops.attention import paged_kernel_fallback_reason
+
+    if gate_max_rows != PAGED_KERNEL_MAX_ROWS:
+        raise GateDriftError(
+            f"PAGED_KERNEL_MAX_ROWS drift: ops.attention says "
+            f"{gate_max_rows}, analysis.mosaic says "
+            f"{PAGED_KERNEL_MAX_ROWS}")
+    gate = paged_kernel_fallback_reason(
+        page, head_dim, quantized, canon_dtype(dtype)[0], rows=rows,
+        tp=tp, n_kv_heads=n_kv_heads, n_heads=n_heads,
+        assume_tpu=assume_tpu)
+    if gate != v.reason:
+        raise GateDriftError(
+            f"verdict drift at page={page} head_dim={head_dim} "
+            f"quantized={quantized} dtype={dtype} rows={rows} tp={tp} "
+            f"heads={n_heads}/{n_kv_heads} assume_tpu={assume_tpu}: "
+            f"gate says {gate!r}, prechecker says {v.reason!r} "
+            f"(findings: {list(v.findings)})")
+
+
+# ---------------------------------------------------------------------------
+# Flash kernel (ops.attention._flash_pallas + the fused backward)
+# ---------------------------------------------------------------------------
+def _fit_block(block: int, seq: int) -> Optional[int]:
+    """Mirror of ``ops.attention._fit_block``: largest divisor of
+    ``seq`` <= the requested block that is an 8-row multiple; None
+    where the runtime raises (the shape would only lower on the
+    interpreter, never on real TPU)."""
+    block = min(block, seq)
+    while seq % block:
+        block //= 2
+    return None if block % 8 else block
+
+
+def flash_blocks(seq_q: int, seq_k: int, head_dim: int, dtype,
+                 block_q: int = 512, block_k: int = 512,
+                 backward: bool = True) -> List[Block]:
+    """Every block the flash forward (and, with ``backward``, the fused
+    backward pair) would hand ``pallas_call``, after the kernel's own
+    legalizations: blocks shrink to 8-row divisors via
+    :func:`_fit_block` (None -> modelled as the raw remainder so
+    :func:`check_block` names the violation) and head dims zero-pad to
+    the next 128-lane multiple (the kernel pads activations — cheap —
+    unlike the paged kernel, whose pool padding would be pool-sized)."""
+    compute = canon_dtype(dtype)[0]
+    bq = _fit_block(block_q, seq_q)
+    bk = _fit_block(block_k, seq_k)
+    d = -(-head_dim // LANE) * LANE
+    if bq is None:
+        bq = min(block_q, seq_q)
+        while seq_q % bq:
+            bq //= 2
+    if bk is None:
+        bk = min(block_k, seq_k)
+        while seq_k % bk:
+            bk //= 2
+    blocks = [
+        Block("fwd.q", (bq, d), compute),
+        Block("fwd.k", (seq_k, d), compute, note="full-seq K rows"),
+        Block("fwd.v", (seq_k, d), compute),
+        Block("fwd.out", (bq, d), compute),
+        Block("fwd.lse", (bq, LANE), "f32",
+              note="per-row stats ride a lane-broadcast [rows, 128] "
+                   "tile — a squeezed [rows] vector cannot lower"),
+    ]
+    if backward:
+        blocks += [
+            Block("bwd_dkv.q", (seq_q, d), compute),
+            Block("bwd_dkv.k", (bk, d), compute),
+            Block("bwd_dkv.v", (bk, d), compute),
+            Block("bwd_dkv.do", (seq_q, d), compute),
+            Block("bwd_dkv.lse", (seq_q, LANE), "f32"),
+            Block("bwd_dkv.dvec", (seq_q, LANE), "f32"),
+            Block("bwd_dkv.dk", (bk, d), "f32"),
+            Block("bwd_dkv.dv", (bk, d), "f32"),
+            Block("bwd_dq.q", (bq, d), compute),
+            Block("bwd_dq.k", (seq_k, d), compute),
+            Block("bwd_dq.v", (seq_k, d), compute),
+            Block("bwd_dq.do", (bq, d), compute),
+            Block("bwd_dq.lse", (bq, LANE), "f32"),
+            Block("bwd_dq.dvec", (bq, LANE), "f32"),
+            Block("bwd_dq.dq", (bq, d), "f32"),
+        ]
+    return blocks
+
+
+def precheck_flash(seq_q: int, seq_k: int, head_dim: int, dtype,
+                   block_q: int = 512, block_k: int = 512,
+                   n_heads: int = 0, n_kv_heads: int = 0, tp: int = 1,
+                   backward: bool = True) -> Verdict:
+    """Would the flash kernel (fwd + fused bwd) LOWER at this shape?
+
+    Refusals (``reason``): ``seq_tile`` — no 8-row-multiple divisor of
+    the sequence fits the requested block, the exact shape where
+    ``ops.attention._fit_block`` raises at trace time; ``tp_heads`` —
+    under tensor parallelism (``sharded_attention`` runs the kernel per
+    shard) both head counts must divide the tp degree, same structural
+    rule as the paged kernel.  ``head_dim`` never refuses here: the
+    flash kernel zero-pads activations to the 128-lane tile itself
+    (2x HBM traffic at D=64, amortized by the S^2 regime)."""
+    findings: List[str] = []
+    reason: Optional[str] = None
+
+    if _forced():
+        reason = "forced"
+        findings.append("TPUSHARE_FORCE_REFERENCE_ATTN=1: escape hatch "
+                        "open, dispatch takes the reference path")
+    if tp > 1 and ((n_kv_heads and n_kv_heads % tp)
+                   or (n_heads and n_heads % tp)):
+        reason = reason or "tp_heads"
+        findings.append(
+            f"tp={tp} cannot split whole GQA head groups "
+            f"(n_heads={n_heads}, n_kv_heads={n_kv_heads})")
+    for name, seq, block in (("q", seq_q, block_q), ("k", seq_k, block_k)):
+        if _fit_block(block, seq) is None:
+            reason = reason or "seq_tile"
+            findings.append(
+                f"seq_{name}={seq}: largest divisor <= block {block} is "
+                f"not an 8-row sublane multiple — _fit_block raises at "
+                f"trace time (pad the sequence or take the reference "
+                f"path)")
+    blocks = tuple(flash_blocks(seq_q, seq_k, head_dim, dtype,
+                                block_q, block_k, backward=backward))
+    n_clean = len(findings)
+    for b in blocks:
+        findings.extend(check_block(b))
+    # any surviving block violation is a sequence-tiling residue: head
+    # dims are pre-padded to 128 lanes and stats ride [rows, 128]
+    if reason is None and len(findings) > n_clean:
+        reason = "seq_tile"
+    vmem = sum(b.nbytes for b in blocks[:5])   # fwd working set
+    return Verdict(ok=reason is None, reason=reason,
+                   findings=tuple(findings), blocks=blocks,
+                   vmem_bytes=vmem)
+
+
+# ---------------------------------------------------------------------------
+# Config sweep (the CLI's drift check; tests assert the named hazards)
+# ---------------------------------------------------------------------------
+def default_sweep() -> List[dict]:
+    """The canonical paged-kernel parameter sweep: every committed
+    serving/drive shape plus each known round-10/12 hazard.  Entries
+    are ``precheck_paged`` kwargs; ``expect`` pins the verdict the
+    hazard list predicts (tests assert it, the CLI only cross-checks
+    gate agreement)."""
+    cases = []
+    # happy paths: the drive shapes (page 64, head_dim 128) both dtypes
+    for quantized in (False, True):
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=2048, tp=1, n_kv_heads=8,
+                          n_heads=16, expect=None))
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=2048, tp=2, n_kv_heads=8,
+                          n_heads=16, expect=None))
+    # round-10 hazards, each as a named refusal
+    cases.append(dict(page=16, head_dim=128, quantized=True,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="page_tile",
+                      note="page 16 pools fall back on int8 (32-row "
+                           "sublane tile)"))
+    cases.append(dict(page=16, head_dim=128, quantized=False,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect=None,
+                      note="...but page 16 bf16 fills its 16-row tile"))
+    cases.append(dict(page=8, head_dim=128, quantized=False,
+                      dtype="f32", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect=None))
+    cases.append(dict(page=8, head_dim=128, quantized=False,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="page_tile"))
+    cases.append(dict(page=16, head_dim=128, quantized=False,
+                      dtype="int8", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="page_tile",
+                      note="an int8 STORE needs the 32-row tile even "
+                           "unquantized — sublane is keyed on the "
+                           "store itemsize, not the quantized flag"))
+    cases.append(dict(page=32, head_dim=128, quantized=False,
+                      dtype="int8", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect=None))
+    cases.append(dict(page=64, head_dim=64, quantized=False,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="head_dim",
+                      note="padding the POOL to 128 lanes would be a "
+                           "pool-sized transient — refuse instead"))
+    cases.append(dict(page=64, head_dim=128, quantized=True,
+                      dtype="bf16", rows=4096, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="max_rows",
+                      note="long whole-prompt prefill: q rows exceed "
+                           "the VMEM-bounded block"))
+    # round-12 structural gate: indivisible heads refuse on EVERY
+    # platform (checked under assume_tpu=False too by the sweep test)
+    cases.append(dict(page=64, head_dim=128, quantized=False,
+                      dtype="bf16", rows=8, tp=2, n_kv_heads=3,
+                      n_heads=6, expect="tp_heads"))
+    cases.append(dict(page=64, head_dim=128, quantized=True,
+                      dtype="bf16", rows=8, tp=4, n_kv_heads=8,
+                      n_heads=16, expect=None))
+    # precedence: head_dim wins over page_tile (mirrors the gate order)
+    cases.append(dict(page=16, head_dim=64, quantized=True,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=8, expect="head_dim"))
+    return cases
+
+
+def sweep_findings(cross_check: bool = True) -> List[str]:
+    """Run the default sweep; returns human-readable findings for any
+    gate drift or expectation mismatch (empty = the gate and the
+    prechecker agree on every case).  The CLI's Layer-1 entry point."""
+    out = []
+    for case in default_sweep():
+        case = dict(case)
+        expect = case.pop("expect")
+        case.pop("note", None)
+        try:
+            v = precheck_paged(cross_check=cross_check, **case)
+        except GateDriftError as e:
+            out.append(f"mosaic: {e}")
+            continue
+        if v.reason != expect:
+            out.append(
+                f"mosaic: sweep expectation drift at {case}: expected "
+                f"{expect!r}, prechecker says {v.reason!r}")
+    return out

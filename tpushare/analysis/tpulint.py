@@ -1,0 +1,556 @@
+"""tpulint: the repo's invariants as AST rules.
+
+The hard-won conventions this codebase runs on — scalar-fetch barriers,
+kernel/byte-math confinement, env scrubbing in subprocess tests — used
+to live as brittle regexes in tests/test_metric_lint.py: a mention in a
+comment or docstring tripped them, and anything needing scope (a
+keyword argument, an assignment target, the one sanctioned function
+body) was inexpressible.  This module is the same invariants on the
+AST: each rule walks a parsed module, so strings and comments are
+invisible by construction and rules can see call keywords, assignment
+targets, and enclosing function ranges.
+
+Anatomy: a :class:`Rule` couples a checker (``(ctx) -> findings``) with
+a SCOPE (which repo-relative paths it patrols) and an ALLOWLIST (the
+deliberate, documented exceptions — extending one is a reviewed
+decision, exactly like the metric-label allowlist).  The engine parses
+each file once and runs every in-scope rule over the shared tree.
+
+Entry points: :func:`lint_repo` (everything the repo tree owns),
+:func:`run_rule` (one rule repo-wide — what the thin pytest wrappers in
+tests/test_metric_lint.py call), :func:`lint_source` (a snippet under a
+virtual path — how tests/test_analysis.py unit-tests rules), and
+:func:`render_catalog` (docs/LINTS.md).  Stdlib-only; nothing here
+imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+#: the repo sub-trees the engine patrols (plus top-level ``*.py``)
+WALK_DIRS = ("tpushare", "tests", "drives")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Context:
+    """Per-file state shared by every rule: the parsed tree, a lazy
+    child->parent map (for statement-level rules), and the source lines
+    (findings quote the offending line)."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=relpath)
+        self.lines = source.splitlines()
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def parent_map(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {
+                child: node
+                for node in ast.walk(self.tree)
+                for child in ast.iter_child_nodes(node)}
+        return self._parents
+
+    def stmt_of(self, node: ast.AST) -> ast.AST:
+        """The nearest enclosing statement (the unit the old line-based
+        greps approximated)."""
+        parents = self.parent_map()
+        while not isinstance(node, ast.stmt) and node in parents:
+            node = parents[node]
+        return node
+
+    def quote(self, lineno: int) -> str:
+        try:
+            return self.lines[lineno - 1].strip()
+        except IndexError:
+            return ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    help: str
+    scope: Callable[[str], bool]
+    scope_doc: str
+    check: Callable[[Context], Iterable[Tuple[int, str]]]
+    allow: Tuple[str, ...] = ()          # path suffixes, with reasons
+    allow_doc: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        return self.scope(relpath) and not any(
+            relpath.endswith(sfx) for sfx in self.allow)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, help: str, scope: Callable[[str], bool],
+         scope_doc: str, allow: Tuple[str, ...] = (),
+         allow_doc: str = ""):
+    def deco(fn):
+        RULES[name] = Rule(name=name, help=help, scope=scope,
+                           scope_doc=scope_doc, check=fn, allow=allow,
+                           allow_doc=allow_doc)
+        return fn
+    return deco
+
+
+def _in_package(relpath: str) -> bool:
+    return relpath.startswith("tpushare/")
+
+
+def _in_tests(relpath: str) -> bool:
+    return relpath.startswith("tests/")
+
+
+def _everywhere(relpath: str) -> bool:
+    return True
+
+
+def _outside_telemetry(relpath: str) -> bool:
+    return not relpath.startswith("tpushare/telemetry/")
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+@rule(
+    "no-block-until-ready",
+    "``block_until_ready`` is NOT a reliable barrier on the remote axon "
+    "backend (it has returned with a 715-GFLOP batch 'done' in 0.02 ms "
+    "— CLAUDE.md).  Synchronize by host-fetching a scalar derived from "
+    "the result (``float(x[0, 0])``): executions are in-order per "
+    "device, so one fetch drains the stream.",
+    _everywhere, "whole repo",
+    allow=("__graft_entry__.py",),
+    allow_doc="the graft harness entry runs local-mesh dryruns the "
+              "harness itself synchronizes; it never rides the tunnel")
+def _no_block_until_ready(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        hit = (
+            (isinstance(node, ast.Attribute)
+             and node.attr == "block_until_ready")
+            # from-import (and aliasing) evasion: `from jax import
+            # block_until_ready [as x]` binds the free function
+            or (isinstance(node, ast.ImportFrom)
+                and any(a.name == "block_until_ready"
+                        for a in node.names or []))
+            # ...and the bare-name call the from-import enables
+            or (isinstance(node, ast.Name)
+                and node.id == "block_until_ready"))
+        if hit:
+            yield node.lineno, (
+                "block_until_ready is not a barrier on remote backends "
+                "— host-fetch a scalar from the result instead "
+                f"(`{ctx.quote(node.lineno)}`)")
+
+
+@rule(
+    "no-hardcoded-interpret",
+    "Tests must not pass ``interpret=True`` to Pallas kernel wrappers: "
+    "``ops.attention.default_interpret()`` is THE interpret-mode "
+    "default (interpret exactly off-TPU) — hard-coding True would "
+    "silently test the INTERPRETER on a TPU host, which does not "
+    "enforce Mosaic's block-layout rules.  Omit the kwarg (None "
+    "resolves via default_interpret) or pass it explicitly only to "
+    "force one mode deliberately outside tests.",
+    _in_tests, "tests/")
+def _no_hardcoded_interpret(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "interpret" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True:
+                yield kw.value.lineno, (
+                    "hard-coded interpret=True — omit the kwarg and "
+                    "let ops.attention.default_interpret() resolve it")
+
+
+@rule(
+    "pallas-call-confined",
+    "A ``pallas_call`` outside tpushare/ops/attention.py hands the "
+    "repo a kernel without the shard_map wrapper / viability-gate / "
+    "interpret-default machinery that module centralizes — "
+    "re-introducing the 'not SPMD-partitionable, so refuse tp' "
+    "ceiling round 12 removed.  New kernels go in ops/attention.py "
+    "(or route their dispatch through it).",
+    _in_package, "tpushare/",
+    allow=("tpushare/ops/attention.py",),
+    allow_doc="the one sanctioned kernel module")
+def _pallas_call_confined(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name == "pallas_call":
+            yield node.lineno, (
+                "pallas_call outside ops/attention.py — new kernels "
+                "must live behind its shard_map/viability dispatch")
+
+
+#: the page-table spellings the paged-read confinement patrols (same
+#: set the retired grep used)
+_TABLE_NAMES = frozenset({"page_table", "page_rows", "table", "tables"})
+
+
+@rule(
+    "paged-gather-confined",
+    "Subscripting a pool with a whole page table "
+    "(``pool[page_table]``) anywhere but "
+    "``transformer._paged_gather`` bypasses the ``attn_kernel`` "
+    "dispatcher (``transformer.paged_attention``): the new read site "
+    "would silently stay on the XLA gather under "
+    "``attn_kernel='pallas'`` and its dense transient would be "
+    "invisible to ``storage_info()``.",
+    _in_package, "tpushare/")
+def _paged_gather_confined(ctx: Context):
+    allowed: List[range] = []
+    if ctx.relpath.endswith("models/transformer.py"):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == "_paged_gather":
+                allowed.append(range(node.lineno, node.end_lineno + 1))
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.slice, ast.Name) and \
+                node.slice.id in _TABLE_NAMES:
+            if any(node.lineno in r for r in allowed):
+                continue
+            yield node.lineno, (
+                f"pool-through-table gather "
+                f"(`{ctx.quote(node.lineno)}`) outside "
+                f"transformer._paged_gather — route paged reads "
+                f"through transformer.paged_attention")
+
+
+@rule(
+    "kv-byte-math",
+    "A ``2 *`` multiply in an expression touching ``n_kv_heads`` is "
+    "the K+V-pair byte formula being re-derived by hand — it "
+    "hard-codes an element size the kv_dtype made variable.  The ONE "
+    "definition lives in tpushare/ops/quant.py "
+    "(``kv_bytes_per_elem`` / ``kv_cache_bytes``); everything else "
+    "must call it.",
+    _in_package, "tpushare/",
+    allow=("tpushare/ops/quant.py",),
+    allow_doc="the byte-model helper itself")
+def _kv_byte_math(ctx: Context):
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Mult)):
+            continue
+        if not any(isinstance(side, ast.Constant) and side.value == 2
+                   for side in (node.left, node.right)):
+            continue
+        stmt = ctx.stmt_of(node)
+        if stmt.lineno in seen:
+            continue
+        touches_kv = any(
+            (isinstance(n, ast.Name) and n.id == "n_kv_heads")
+            or (isinstance(n, ast.Attribute) and n.attr == "n_kv_heads")
+            for n in ast.walk(stmt))
+        if touches_kv:
+            seen.add(stmt.lineno)
+            yield node.lineno, (
+                "literal `2 *` KV byte math next to n_kv_heads — use "
+                "ops.quant.kv_cache_bytes / kv_bytes_per_elem")
+
+
+#: subprocess entry points that spawn (``subprocess.<attr>(...)``)
+_SPAWN_ATTRS = frozenset({"run", "Popen", "check_output", "check_call",
+                          "call"})
+
+
+@rule(
+    "subprocess-env-scrub",
+    "A test that spawns a python subprocess must scrub "
+    "``PALLAS_AXON_POOL_IPS`` (a sitecustomize hook dials the remote "
+    "TPU tunnel from EVERY python process when it is set) and pin "
+    "``JAX_PLATFORMS`` — the module must contain an "
+    "``env.pop('PALLAS_AXON_POOL_IPS', ...)`` and a "
+    "``'JAX_PLATFORMS'`` env write for its spawns to inherit.",
+    _in_tests, "tests/",
+    allow=("tests/test_tpu_lane.py",),
+    allow_doc="the opt-in real-chip lane: it deliberately RE-INJECTS "
+              "the stashed POOL_IPS so its drive subprocess is the one "
+              "dialing process (conftest popped it from the parent)")
+def _subprocess_env_scrub(ctx: Context):
+    spawns = [
+        node for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _SPAWN_ATTRS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "subprocess"]
+    if not spawns:
+        return
+    pops = any(
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "pop"
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and node.args[0].value == "PALLAS_AXON_POOL_IPS"
+        for node in ast.walk(ctx.tree))
+    def pins_platforms(node: ast.AST) -> bool:
+        # only WRITES count — a read (env.get("JAX_PLATFORMS"),
+        # membership test) leaves the child unpinned.  Spellings:
+        # env["JAX_PLATFORMS"] = ... (subscript store),
+        # {"JAX_PLATFORMS": ...} (dict-literal key, covers update()),
+        # dict(os.environ, JAX_PLATFORMS="cpu") (keyword arg), and
+        # env.setdefault("JAX_PLATFORMS", ...)
+        if isinstance(node, ast.Assign):
+            return any(
+                isinstance(t, ast.Subscript)
+                and isinstance(t.slice, ast.Constant)
+                and t.slice.value == "JAX_PLATFORMS"
+                for t in node.targets)
+        if isinstance(node, ast.Dict):
+            return any(
+                isinstance(k, ast.Constant) and k.value == "JAX_PLATFORMS"
+                for k in node.keys)
+        if isinstance(node, ast.keyword):
+            return node.arg == "JAX_PLATFORMS"
+        if isinstance(node, ast.Call):
+            return (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "setdefault"
+                    and bool(node.args)
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "JAX_PLATFORMS")
+        return False
+
+    pins = any(pins_platforms(node) for node in ast.walk(ctx.tree))
+    if pops and pins:
+        return
+    missing = []
+    if not pops:
+        missing.append("env.pop('PALLAS_AXON_POOL_IPS', None)")
+    if not pins:
+        missing.append("a 'JAX_PLATFORMS' pin")
+    for node in spawns:
+        yield node.lineno, (
+            f"subprocess spawn in a test module without "
+            f"{' or '.join(missing)} — the child would dial the TPU "
+            f"tunnel when PALLAS_AXON_POOL_IPS is set")
+
+
+#: the process-global telemetry singletons whose internals are
+#: lock-guarded
+_TELEMETRY_GLOBALS = frozenset({"MONITOR", "RECORDER", "REGISTRY"})
+#: public attributes mutations must route through methods: direct
+#: writes bypass the lock AND the metric mirroring (_mirror_state,
+#: transition events)
+_GUARDED_PUBLIC_ATTRS = frozenset({"state", "reason"})
+
+
+@rule(
+    "telemetry-lock",
+    "MONITOR / RECORDER / REGISTRY are process-global and "
+    "thread-shared; their internals mutate only under their own lock, "
+    "inside tpushare/telemetry/.  Assigning a private attribute (or "
+    "``.state``/``.reason``) from outside bypasses the lock and the "
+    "metric mirroring — use the methods (``set_state``, ``reset``, "
+    "``clear``, ``set_capacity``).  Public float knobs "
+    "(``dispatch_deadline_s``, ``slow_record_s``) stay assignable: "
+    "they are single-word reads the guards sample once.",
+    _outside_telemetry, "whole repo except tpushare/telemetry/")
+def _telemetry_lock(ctx: Context):
+    def base_is_global(value: ast.AST) -> bool:
+        return ((isinstance(value, ast.Name)
+                 and value.id in _TELEMETRY_GLOBALS)
+                or (isinstance(value, ast.Attribute)
+                    and value.attr in _TELEMETRY_GLOBALS))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        base_is_global(t.value) and \
+                        (t.attr.startswith("_")
+                         or t.attr in _GUARDED_PUBLIC_ATTRS):
+                    yield t.lineno, (
+                        f"direct write to {t.attr!r} on a process-"
+                        f"global telemetry object bypasses its lock — "
+                        f"use the mutation methods (set_state / reset "
+                        f"/ clear)")
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+def lint_source(relpath: str, source: str,
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint one module body under a virtual repo-relative path (rules
+    scope on the path, so tests pick the scope by spelling it)."""
+    relpath = relpath.replace(os.sep, "/")
+    try:
+        ctx = Context(relpath, source)
+    except SyntaxError as e:
+        return [Finding("parse", relpath, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    todo = [RULES[n] for n in rules] if rules else list(RULES.values())
+    out: List[Finding] = []
+    for r in todo:
+        if not r.applies(relpath):
+            continue
+        for line, message in r.check(ctx):
+            out.append(Finding(r.name, relpath, line, message))
+    return out
+
+
+def repo_root() -> str:
+    """The checkout root (this file lives at
+    <root>/tpushare/analysis/tpulint.py)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def repo_python_files(root: Optional[str] = None) -> List[str]:
+    """Every ``*.py`` the engine patrols, repo-relative: the walked
+    sub-trees plus the top-level scripts (bench, probes, graft entry)."""
+    root = root or repo_root()
+    out = []
+    for d in WALK_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, dirnames, files in os.walk(base):
+            dirnames[:] = [n for n in dirnames if n != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            out.append(fn)
+    return [p.replace(os.sep, "/") for p in out]
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    root = root or repo_root()
+    out: List[Finding] = []
+    for rel in paths:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            out.extend(lint_source(rel, f.read(), rules=rules))
+    return out
+
+
+def lint_repo(root: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    return lint_paths(repo_python_files(root), root=root, rules=rules)
+
+
+def run_rule(name: str, root: Optional[str] = None) -> List[Finding]:
+    """One rule repo-wide — the entry the thin pytest wrappers in
+    tests/test_metric_lint.py call (unknown names raise KeyError so a
+    renamed rule cannot silently hollow out its test)."""
+    return lint_repo(root=root, rules=[RULES[name].name])
+
+
+def format_findings(findings: Iterable[Finding]) -> str:
+    return "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Catalog (docs/LINTS.md)
+# ---------------------------------------------------------------------------
+_CATALOG_HEADER = """\
+# tpushare lint catalog
+
+Every invariant `python -m tpushare.analysis` enforces (wired as
+`make lint`; tier-1 runs it in tests/test_analysis.py).  GENERATED — do
+not edit by hand; regenerate with `python -m tpushare.analysis
+--catalog > docs/LINTS.md` (a test asserts this file matches the
+engine).
+
+## Layer 1 — Mosaic layout prechecker (`tpushare.analysis.mosaic`)
+
+Chip-free lowering verdicts for the Pallas kernels: the interpreter
+enforces none of Mosaic's block-layout rules, so these checks are what
+stands between an interpret-green kernel and a burned tunnel dial.
+Verdicts are cross-checked against the live dispatch gate
+(`ops.attention.paged_kernel_fallback_reason`) on every run, so the
+gate and the checker cannot drift.
+
+| Check | Rule |
+|---|---|
+"""
+
+_CATALOG_RULES_HEADER = """\
+
+## Layer 2 — tpulint AST rules (`tpushare.analysis.tpulint`)
+
+| Rule | Scope | Allowlisted | Invariant |
+|---|---|---|---|
+"""
+
+
+def render_catalog() -> str:
+    from . import mosaic
+
+    sub = ", ".join(
+        f"{name} {rows}" for name, rows in
+        (("int8", mosaic.SUBLANE_BY_ITEMSIZE[1]),
+         ("bf16", mosaic.SUBLANE_BY_ITEMSIZE[2]),
+         ("f32", mosaic.SUBLANE_BY_ITEMSIZE[4])))
+    mosaic_rows = [
+        ("block rank", "every block is rank >= 2 — a squeezed 1-D "
+         "vector block refuses to lower (per-row stats ride a "
+         f"lane-broadcast `[rows, {mosaic.LANE}]` tile)"),
+        ("lane tile", f"the last block dim is a {mosaic.LANE}-lane "
+         "multiple, or the ONE sanctioned trailing singleton "
+         "(`[page, 1]` scale blocks — Mosaic lane-pads the singleton)"),
+        ("sublane tile", f"K/V POOL blocks fill the store dtype's "
+         f"sublane tile ({sub} rows); row blocks the kernels pad "
+         f"themselves need the 8-row multiple the padding guarantees"),
+        ("head_dim", "the paged kernel's pool lanes must fill the "
+         "128-lane tile — padding the POOL would materialize the "
+         "pool-sized transient the kernel exists to delete (the flash "
+         "kernel pads activations instead, which is cheap)"),
+        ("q-row bound", "the paged kernel's whole q-row block plus "
+         "three f32 scratches live in VMEM: rows <= "
+         f"{mosaic.PAGED_KERNEL_MAX_ROWS} "
+         "(`PAGED_KERNEL_MAX_ROWS`; long whole-prompt prefills fall "
+         "back per dispatch)"),
+        ("tp divisibility", "under tensor parallelism both head "
+         "counts must divide the tp degree (kernels run per shard "
+         "through `shard_map`, whole GQA groups per shard, no "
+         "cross-shard softmax) — structural, every platform"),
+        ("seq tiling", "flash blocks must shrink to an 8-row-multiple "
+         "divisor of the sequence (`_fit_block` raises at trace time "
+         "otherwise)"),
+    ]
+    lines = [_CATALOG_HEADER]
+    for name, text in mosaic_rows:
+        lines.append(f"| {name} | {text} |\n")
+    lines.append(_CATALOG_RULES_HEADER)
+    for r in RULES.values():
+        allow = ", ".join(f"`{a}`" for a in r.allow) if r.allow else "—"
+        if r.allow_doc:
+            allow += f" ({r.allow_doc})"
+        help_cell = " ".join(r.help.split()).replace("|", r"\|")
+        allow_cell = " ".join(allow.split()).replace("|", r"\|")
+        lines.append(f"| `{r.name}` | {r.scope_doc} | {allow_cell} "
+                     f"| {help_cell} |\n")
+    return "".join(lines)

@@ -726,7 +726,9 @@ def tp_degree(mesh, axis: str = "tp") -> int:
 def paged_kernel_fallback_reason(page: int, head_dim: int,
                                  quantized: bool, dtype, rows: int = 1,
                                  tp: int = 1, n_kv_heads: int = 0,
-                                 n_heads: int = 0) -> Optional[str]:
+                                 n_heads: int = 0,
+                                 assume_tpu: Optional[bool] = None
+                                 ) -> Optional[str]:
     """THE viability gate for :func:`paged_decode_attention`, returning
     WHY the kernel cannot run (None = viable) so fallback sites can
     label ``tpushare_attn_kernel_fallback_total``.
@@ -749,20 +751,30 @@ def paged_kernel_fallback_reason(page: int, head_dim: int,
     page, head_dim, and rows (= n_rep * S, with n_rep shard-invariant)
     are identical on every shard, so the fallback decision is uniform
     across shards by construction.
+
+    ``assume_tpu`` overrides platform detection (None = detect): the
+    chip-free Mosaic prechecker (``analysis.mosaic``) passes True to
+    ask "would this lower on a REAL chip?" from a CPU host and
+    cross-checks its own symbolic verdict against this gate so the two
+    can never drift.
     """
     if FORCE_REFERENCE:
         return "forced"
     if tp > 1 and ((n_kv_heads and n_kv_heads % tp)
                    or (n_heads and n_heads % tp)):
         return "tp_heads"
-    if not _on_tpu():
+    if not (_on_tpu() if assume_tpu is None else assume_tpu):
         return None
     if head_dim % 128:
         return "head_dim"
     if rows > PAGED_KERNEL_MAX_ROWS:
         return "max_rows"
-    sublane = 32 if quantized else (8 if jnp.dtype(dtype).itemsize == 4
-                                    else 16)
+    # sublane tile of the STORE dtype (int8 when quantized): Mosaic
+    # wants f32 8 / bf16 16 / int8 32 rows regardless of WHY the pool
+    # is 1-byte — keyed on itemsize so an unquantized int8 store gets
+    # the same 32-row verdict the prechecker derives
+    store_itemsize = 1 if quantized else jnp.dtype(dtype).itemsize
+    sublane = {4: 8, 2: 16, 1: 32}[store_itemsize]
     if page % sublane:
         return "page_tile"
     return None

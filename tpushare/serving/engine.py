@@ -72,8 +72,18 @@ class InferenceEngine:
 
     # -- sync one-shot ------------------------------------------------------
     def infer(self, tokens: np.ndarray, mask: Optional[np.ndarray] = None):
-        """tokens [B, S] -> outputs, blocking."""
-        return jax.block_until_ready(self.infer_async(tokens, mask))
+        """tokens [B, S] -> outputs, blocking.
+
+        The barrier is a host fetch of one scalar from the result, not
+        ``block_until_ready`` — which has returned early on the remote
+        axon backend (CLAUDE.md); executions are in-order per device,
+        so one fetch drains the stream (lint: no-block-until-ready)."""
+        out = self.infer_async(tokens, mask)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        # first-element index, not reshape(-1): reshape would be a
+        # second device dispatch (~70ms RPC on the tunnel) per infer
+        float(leaf[(0,) * leaf.ndim])
+        return out
 
     def infer_async(self, tokens: np.ndarray,
                     mask: Optional[np.ndarray] = None):

@@ -25,6 +25,23 @@ def device_trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+def _sync(result) -> None:
+    """Drain the device stream by host-fetching ONE scalar from the
+    first array leaf of ``result`` — the sanctioned barrier:
+    ``block_until_ready`` has returned early on the remote axon backend
+    (CLAUDE.md), while executions are in-order per device, so a single
+    element fetch waits for everything queued before it."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(result):
+        if hasattr(leaf, "ndim"):
+            # first-element index, no reshape: reshape is its own
+            # device dispatch (~70ms RPC each on the tunnel), which
+            # would inflate every sample by a second round trip
+            float(leaf[(0,) * leaf.ndim])
+            return
+
+
 def time_fn(fn: Callable, *args, iters: int = 10,
             warmup: int = 1) -> Dict[str, float]:
     """{'compile_s', 'mean_s', 'p50_s', 'best_s'} for a jitted callable.
@@ -32,17 +49,15 @@ def time_fn(fn: Callable, *args, iters: int = 10,
     The first call is measured separately: under jit it includes trace +
     XLA compile, which steady-state numbers must exclude.
     """
-    import jax
-
     t0 = time.perf_counter()
-    jax.block_until_ready(fn(*args))
+    _sync(fn(*args))
     compile_s = time.perf_counter() - t0
     for _ in range(max(0, warmup - 1)):
-        jax.block_until_ready(fn(*args))
+        _sync(fn(*args))
     samples = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _sync(fn(*args))
         samples.append(time.perf_counter() - t0)
     samples.sort()
     return {
