@@ -1,0 +1,206 @@
+"""On-chip speculation on PAGED int8 pools: compile-check + spec vs
+plain fused decode through the continuous batcher.
+
+The CPU-side contract is pinned in tests/test_spec_storage.py (greedy
+exactness per storage flavor, int8 self-consistency, mixed fusion).
+What only the real chip can answer:
+
+* does the k-row VERIFY READ lower on Mosaic — the paged kernel at
+  rows = n_rep * (1+k) (the spec row multiplier), walking the
+  scalar-prefetched page table with int8 32-sublane page tiles and the
+  trailing-singleton [page, 1] f32 scale blocks — and does the k-token
+  PAGE SCATTER (the per-row multi-position `.at[pids, :, offs, :]`
+  write forward_paged_verify performs) compile inside the spec scan;
+  the interpreter proves neither (CLAUDE.md block-layout hazard);
+* does it lower PER SHARD under shard_map (tp=2 arm) — the per-shard
+  Hkv/2 pool tiles and scale blocks inside the shard_map body, which
+  neither interpret mode nor the single-device compile checks;
+* does speculation actually WIN on paged int8 pools at repetitive
+  traffic, where every verify dispatch replaces up to 1+k fused steps
+  — the measured form of the BENCH_EXTENDED ~4x ceiling in the
+  configuration production runs (ROADMAP item 5).
+
+Method (CLAUDE.md tunnel rules): per (kv_dtype, attn_kernel) cell,
+admit repetitive prompts into a PagedContinuousBatcher and drain once
+with fused decode chunks and once with tick_spec rounds — identical
+occupancy, host fetches as barriers.  Exactness (spec == fused within
+one cell) is asserted per cell; pallas-vs-xla stream agreement is
+reported (that pair is accuracy-bounded, not bit-identical).  The
+static mosaic precheck runs BEFORE the jax import, so a refused layout
+never costs a chip dial.
+
+    python drives/drive_spec_paged.py        # real chip; ~8 min
+
+Prints ONE JSON line (SPEC_PAGED_TPU.json when committed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the on-chip shape this drive dispatches (must stay in sync with the
+#: TPU branch of main()): head_dim 128, page 64 (int8's 32-sublane tile
+#: filled), spec depth 8 -> verify rows n_rep * 9 = 18
+_TPU_SHAPE = dict(page=64, head_dim=128, n_kv_heads=8, n_heads=16,
+                  spec_k=8)
+
+
+def precheck() -> dict:
+    """Chip-free Mosaic verdicts for the spec VERIFY read of every cell
+    this drive would dispatch, BEFORE any jax import (importing jax
+    dials the tunnel when PALLAS_AXON_POOL_IPS is set).
+    ``cross_check=False`` pre-dial; the gate-agreement guarantee lives
+    in tier-1 (tests/test_analysis.py)."""
+    from tpushare.analysis import mosaic
+
+    cells = {}
+    for kv_dtype in ("bf16", "int8"):
+        for tp in (1, 2):
+            v = mosaic.precheck_spec_paged(
+                quantized=kv_dtype == "int8", dtype="bf16", tp=tp,
+                assume_tpu=True, cross_check=False, **_TPU_SHAPE)
+            cells[f"{kv_dtype}_tp{tp}"] = v.summary()
+    return cells
+
+
+def main() -> int:
+    pre = precheck()
+    precheck_ok = all(c["ok"] for c in pre.values())
+    if not precheck_ok:
+        print(json.dumps({"metric": "spec_paged",
+                          "precheck_ok": False, "precheck": pre}))
+        return 1
+
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=1024)
+        slots, prompt_len, gen, page, k, n_rounds = 8, 128, 64, 64, 8, 8
+        decode_chunk = 16
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96)
+        slots, prompt_len, gen, page, k, n_rounds = 2, 16, 17, 16, 4, 4
+        decode_chunk = 4
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    # repetitive prompts (lookup's home turf), distinct per slot
+    prompts = [[1 + ((3 * i + j) % 13) for j in range(4)]
+               * (prompt_len // 4) for i in range(slots)]
+
+    out = {"metric": "spec_paged", "platform": dev.platform,
+           "slots": slots, "prompt_len": prompt_len, "gen": gen,
+           "page_size": page, "spec_k": k, "n_rounds": n_rounds,
+           "precheck_ok": precheck_ok, "precheck": pre, "flavors": {}}
+
+    def run_cell(c, run_params, arm, mesh=None):
+        """One (cfg, arm, mesh) drain: admit all prompts, drain with
+        the arm's dispatch flavor; returns (compile_s, tokens/s,
+        streams).  First drain absorbs compiles, second is timed; the
+        final completed fetch is the barrier."""
+        def drain():
+            b = PagedContinuousBatcher(run_params, c, n_slots=slots,
+                                       page_size=page, mesh=mesh,
+                                       spec_k=k if arm == "spec" else 0)
+            rids = [b.admit(p, gen) for p in prompts]
+            it = 0
+            while b.slots and it < 10_000:
+                if arm == "spec":
+                    b.tick_spec(n_rounds, k=k)
+                else:
+                    b.tick_fused(decode_chunk)
+                it += 1
+            return [[int(t) for t in b.completed[r]] for r in rids]
+
+        t0 = time.perf_counter()
+        streams = drain()
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        streams = drain()
+        dt = time.perf_counter() - t0
+        return compile_s, slots * gen / dt, streams
+
+    streams = {}
+    for kv_dtype in ("bf16", "int8"):
+        streams[kv_dtype] = {}
+        out["flavors"][kv_dtype] = {}
+        for kernel in ("xla", "pallas"):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel=kernel)
+            cell = {}
+            for arm in ("fused", "spec"):
+                compile_s, tps, st = run_cell(c, params, arm)
+                cell[arm] = {"compile_s": round(compile_s, 1),
+                             "tokens_per_s": round(tps, 1)}
+                streams[kv_dtype][(kernel, arm)] = st
+            # the speculative contract: spec == plain WITHIN one read
+            # path (pallas-vs-xla stays agreement-bounded)
+            cell["exact"] = (streams[kv_dtype][(kernel, "spec")]
+                             == streams[kv_dtype][(kernel, "fused")])
+            cell["speedup_spec_vs_fused"] = round(
+                cell["spec"]["tokens_per_s"]
+                / cell["fused"]["tokens_per_s"], 3)
+            out["flavors"][kv_dtype][kernel] = cell
+
+    for kv_dtype in ("bf16", "int8"):
+        a = streams[kv_dtype][("xla", "spec")]
+        b = streams[kv_dtype][("pallas", "spec")]
+        agree = sum(x == y for sa, sb in zip(a, b)
+                    for x, y in zip(sa[prompt_len:], sb[prompt_len:]))
+        out[f"stream_agreement_{kv_dtype}"] = f"{agree}/{slots * gen}"
+    out["exact"] = all(cell["exact"]
+                       for f in out["flavors"].values()
+                       for cell in f.values())
+    out["speedup_spec_vs_fused_int8"] = \
+        out["flavors"]["int8"]["pallas"]["speedup_spec_vs_fused"]
+
+    # -- tp=2 shard_map arm ---------------------------------------------
+    # What ONLY this arm proves: the k-row verify read's per-shard
+    # blocks (Hkv/2 pool tiles, [page, 1] scale singletons) lowering
+    # UNDER shard_map, with the verify's page scatter partitioned over
+    # the kv-head axis.
+    if len(jax.devices()) >= 2:
+        from tpushare.parallel.mesh import make_mesh, shard_params
+        mesh = make_mesh({"tp": 2})
+        sh_params = shard_params(params, mesh)
+        out["tp2"] = {"flavors": {}}
+        for kv_dtype in ("bf16", "int8"):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel="pallas")
+            compile_s, tps, st = run_cell(c, sh_params, "spec",
+                                          mesh=mesh)
+            agree = sum(
+                x == y for sa, sb in zip(
+                    streams[kv_dtype][("pallas", "spec")], st)
+                for x, y in zip(sa[prompt_len:], sb[prompt_len:]))
+            out["tp2"]["flavors"][kv_dtype] = {
+                "compile_s": round(compile_s, 1),
+                "tokens_per_s": round(tps, 1),
+                # vs the single-device pallas spec stream: bf16
+                # disagreement is partitioner matmul reassociation,
+                # never the kernel
+                "agreement_vs_single": f"{agree}/{slots * gen}",
+            }
+        out["tp2"]["compile_ok"] = True
+    else:
+        out["tp2"] = {"skipped": "single device"}
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,38 @@ def test_max_rows_constant_cannot_drift():
         attention.PAGED_KERNEL_MAX_ROWS
 
 
+def test_spec_verify_rows_mirror_cannot_drift():
+    """mosaic duplicates the spec row multiplier (rows = n_rep*(k+1))
+    the same way — the prechecker must price the exact q-row block
+    ``forward_paged_verify`` hands the dispatcher."""
+    attention = importlib.import_module("tpushare.ops.attention")
+
+    for n_heads, n_kv, k in [(16, 8, 8), (8, 8, 4), (32, 4, 1),
+                             (4, 4, 0)]:
+        assert (mosaic.spec_verify_rows(n_heads, n_kv, k)
+                == attention.spec_verify_rows(n_heads, n_kv, k)), \
+            (n_heads, n_kv, k)
+
+
+def test_precheck_spec_paged_is_the_rows_shorthand():
+    """precheck_spec_paged == precheck_paged at the derived row count
+    (same verdict object fields), including a max_rows refusal at an
+    absurd depth."""
+    a = mosaic.precheck_spec_paged(page=64, head_dim=128,
+                                   quantized=True, dtype="bf16",
+                                   spec_k=8, n_kv_heads=8, n_heads=16)
+    b = mosaic.precheck_paged(page=64, head_dim=128, quantized=True,
+                              dtype="bf16",
+                              rows=mosaic.spec_verify_rows(16, 8, 8),
+                              n_kv_heads=8, n_heads=16)
+    assert (a.ok, a.reason, a.blocks) == (b.ok, b.reason, b.blocks)
+    deep = mosaic.precheck_spec_paged(page=64, head_dim=128,
+                                      quantized=True, dtype="bf16",
+                                      spec_k=2048, n_kv_heads=8,
+                                      n_heads=16)
+    assert deep.reason == "max_rows"
+
+
 def test_gate_drift_raises(monkeypatch):
     """An edited gate without a prechecker edit is a loud
     GateDriftError, not a silently stale verdict."""

@@ -26,6 +26,14 @@ import re
 
 NAME_RE = re.compile(r"^tpushare_[a-z0-9_]+$")
 
+#: histograms that measure something other than time — declared HERE
+#: deliberately (the namespace decision), so the `_seconds` suffix rule
+#: keeps catching accidentally-unsuffixed latency histograms
+DIMENSIONLESS_HISTOGRAMS = {
+    # accepted proposal tokens per speculative verify round per slot
+    "tpushare_spec_accept_depth",
+}
+
 
 def _registered():
     # the instrumented modules register at import
@@ -56,8 +64,15 @@ def test_unit_suffix_conventions():
             assert not name.endswith("_total"), \
                 f"{kind} {name} must not claim the counter suffix _total"
         if kind == "histogram":
-            assert name.endswith("_seconds"), \
-                f"time histogram {name} must end in _seconds"
+            if name in DIMENSIONLESS_HISTOGRAMS:
+                assert not name.endswith("_seconds"), \
+                    f"{name} is declared dimensionless yet claims the " \
+                    f"_seconds suffix"
+            else:
+                assert name.endswith("_seconds"), \
+                    f"time histogram {name} must end in _seconds " \
+                    f"(dimensionless histograms join " \
+                    f"DIMENSIONLESS_HISTOGRAMS deliberately)"
         if name.endswith("_bytes"):
             assert kind == "gauge", \
                 f"{name}: _bytes series are gauges in this namespace"
@@ -178,6 +193,10 @@ ENUMERATED_VALUES = {
     # keep in sync with ops.attention.FALLBACK_REASONS (asserted below)
     ("tpushare_attn_kernel_fallback_total", "reason"):
         {"head_dim", "page_tile", "max_rows", "tp_heads", "forced"},
+    # keep in sync with continuous.SPEC_FALLBACK_REASONS (asserted
+    # below)
+    ("tpushare_spec_fallback_total", "reason"):
+        {"ring_margin", "sampling_only"},
 }
 
 
@@ -188,6 +207,14 @@ def test_fallback_reason_enum_matches_gate():
     from tpushare.ops.attention import FALLBACK_REASONS
     assert set(FALLBACK_REASONS) == ENUMERATED_VALUES[
         ("tpushare_attn_kernel_fallback_total", "reason")]
+
+
+def test_spec_fallback_reason_enum_matches_constant():
+    """Same discipline for the speculation capability/routing reasons:
+    the serving constant and the lint enum must be one set."""
+    from tpushare.serving.continuous import SPEC_FALLBACK_REASONS
+    assert set(SPEC_FALLBACK_REASONS) == ENUMERATED_VALUES[
+        ("tpushare_spec_fallback_total", "reason")]
 
 
 def _observed_label_sets():

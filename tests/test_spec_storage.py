@@ -1,0 +1,507 @@
+"""Speculation that survives paging (round 14): prompt-lookup spec on
+EVERY storage flavor — dense full-size, rolling ring, paged, windowed
+page ring, prefix cache — with kv_dtype int8 supported throughout, and
+fused into the mixed step (tick_mixed_spec).
+
+Contracts under test:
+
+* greedy-exactness per flavor: spec streams == the non-spec reference
+  (``generate``) on the f32 tiny configs, whatever drain flavor ran;
+* int8 exact self-consistency EXTENDS to speculation: spec == mixed ==
+  sequential == ticked within int8 mode (append-only verify writes —
+  a committed position is quantized once, by whichever program wrote
+  it);
+* ONE device dispatch per steady mixed round with speculation (the
+  round-7 invariant carried into the spec-fused program);
+* round-robin prefill fairness with spec slots present;
+* cancel in every slot state under spec rounds;
+* the capability checks that replaced the round-5 refusals
+  (ring-margin gate, sampling_only routing, storage-aware headroom).
+
+The bf16 golden streams are untouched by construction (goldens replay
+non-spec paths only — tests/test_kv_quant.py guards them byte for
+byte).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpushare.models import transformer
+from tpushare.serving import metrics
+from tpushare.serving.continuous import (SPEC_FALLBACK_REASONS,
+                                         ContinuousBatcher,
+                                         ContinuousService)
+from tpushare.serving.generate import generate
+from tpushare.serving.paged import PagedContinuousBatcher
+
+REPETITIVE = [1, 2, 3, 4] * 4
+PLAIN = [9, 8, 7]
+#: windowed traffic: prompts past the 16-token window, decode past one
+#: ring revolution
+WIN_REQS = [(list(range(1, 30)), 20), ([5, 6, 5, 6, 5, 6], 16)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def wmodel():
+    wcfg = transformer.tiny(max_seq=96, window=16)
+    wparams = transformer.init_params(jax.random.PRNGKey(4), wcfg)
+    return wparams, wcfg
+
+
+def _exp(params, cfg, p, n):
+    return [int(t) for t in generate(
+        params, cfg, jnp.asarray([p], jnp.int32), max_new_tokens=n)[0]]
+
+
+def _drain_spec(b, k=4, n_rounds=2, chunk=4, budget=8, max_rounds=400):
+    """The service composition at batcher level: mixed-spec rounds
+    while anything prefills, plain spec rounds after."""
+    for _ in range(max_rounds):
+        if not b.prefilling and not b.slots:
+            return
+        if b.prefilling:
+            b.tick_mixed_spec(n_rounds, chunk=chunk, budget=budget,
+                              k=k, ngram=2)
+        else:
+            b.tick_spec(n_rounds, k=k, ngram=2)
+    raise RuntimeError("spec drain did not finish")
+
+
+# ---------------------------------------------------------------------------
+# greedy-exactness per storage flavor
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", ["dense_full", "rolling", "paged",
+                                    "page_ring", "prefix_cache"])
+def test_spec_streams_exact_per_flavor(model, wmodel, flavor):
+    """spec (and spec-in-mixed, via chunked admission) reproduces the
+    per-request ``generate`` reference on every storage flavor."""
+    if flavor in ("rolling", "page_ring"):
+        params, cfg = wmodel
+        reqs = WIN_REQS
+    else:
+        params, cfg = model
+        reqs = [(REPETITIVE, 12), (PLAIN, 8), ([5] * 6, 6)]
+    if flavor == "dense_full":
+        b = ContinuousBatcher(params, cfg, n_slots=3, spec_k=4)
+        assert not b.rolling_slots
+    elif flavor == "rolling":
+        b = ContinuousBatcher(params, cfg, n_slots=2, spec_k=4)
+        assert b.rolling_slots
+    elif flavor == "paged":
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4,
+                                   spec_k=4)
+    elif flavor == "page_ring":
+        b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                                   max_prefill_chunk=4, spec_k=4)
+        assert b.spec_fallback_reason(4) is None
+    else:
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4,
+                                   prefix_cache=True, spec_k=4)
+        head = [11, 12, 13, 14, 15, 16, 17, 18]
+        reqs = [(head + [21, 22], 6), (head + [31], 7),
+                (head + [41, 42], 5)]
+    rids = [b.admit_chunked(p, n, chunk=4) for p, n in reqs]
+    _drain_spec(b)
+    for rid, (p, n) in zip(rids, reqs):
+        assert b.completed[rid] == _exp(params, cfg, p, n), (flavor, p)
+    if flavor in ("paged", "page_ring", "prefix_cache"):
+        # every page back on the free list (or parked in the registry)
+        held = sum(len(e.pages) for e in b._prefixes.values())
+        assert b.free_page_count() + held == b.n_pages - 1
+
+
+@pytest.mark.slow
+def test_int8_spec_self_consistency_paged_and_dense(model):
+    """Within int8 mode the dispatch equivalences EXTEND to spec:
+    spec == mixed == sequential ticked, paged and dense — a committed
+    position's int8 value is write-once regardless of which program
+    wrote it (the append-only argument, DESIGN.md)."""
+    params, cfg = model
+    qcfg = dataclasses.replace(cfg, kv_dtype="int8")
+    reqs = [(REPETITIVE, 10), (PLAIN, 8), ([5] * 6, 6)]
+
+    def run(paged, flavor):
+        if paged:
+            b = PagedContinuousBatcher(params, qcfg, n_slots=3,
+                                       page_size=4, spec_k=4)
+        else:
+            b = ContinuousBatcher(params, qcfg, n_slots=3, spec_k=4)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in reqs]
+        it = 0
+        while (b.slots or b.prefilling) and it < 400:
+            if flavor == "spec":
+                if b.prefilling:
+                    b.tick_mixed_spec(2, chunk=4, budget=8, k=4)
+                else:
+                    b.tick_spec(2, k=4)
+            elif flavor == "mixed":
+                if b.prefilling:
+                    b.tick_mixed(2, chunk=4, budget=8)
+                else:
+                    b.tick_fused(2)
+            else:
+                if b.prefilling:
+                    b.advance_prefill()
+                b.tick()
+            it += 1
+        return [b.completed[r] for r in rids]
+
+    for paged in (True, False):
+        spec = run(paged, "spec")
+        assert spec == run(paged, "mixed") == run(paged, "ticked"), \
+            ("paged" if paged else "dense")
+
+
+@pytest.mark.slow
+def test_int8_rolling_and_ring_spec_match_nonspec(wmodel):
+    """int8 on the windowed storages: spec streams equal the non-spec
+    int8 streams (self-consistency on the ring flavors too)."""
+    wparams, wcfg = wmodel
+    qcfg = dataclasses.replace(wcfg, kv_dtype="int8")
+
+    def run(paged, spec):
+        if paged:
+            b = PagedContinuousBatcher(wparams, qcfg, n_slots=2,
+                                       page_size=4, max_prefill_chunk=4,
+                                       spec_k=4 if spec else 0)
+        else:
+            b = ContinuousBatcher(wparams, qcfg, n_slots=2,
+                                  spec_k=4 if spec else 0)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in WIN_REQS]
+        it = 0
+        while (b.slots or b.prefilling) and it < 400:
+            if spec:
+                if b.prefilling:
+                    b.tick_mixed_spec(2, chunk=4, budget=8, k=4)
+                else:
+                    b.tick_spec(2, k=4)
+            else:
+                if b.prefilling:
+                    b.advance_prefill()
+                b.tick()
+            it += 1
+        return [b.completed[r] for r in rids]
+
+    for paged in (True, False):
+        assert run(paged, True) == run(paged, False), \
+            ("page_ring" if paged else "rolling")
+
+
+# ---------------------------------------------------------------------------
+# the single-dispatch invariant with speculation fused in
+# ---------------------------------------------------------------------------
+def _count_dispatches(b):
+    counts = {"mixed_spec": 0, "other": 0}
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    wrap("_step_mixed_spec", "mixed_spec")
+    wrap("_step_mixed", "other")
+    wrap("_step_spec", "other")
+    wrap("_step", "other")
+    wrap("_step_n", "other")
+    wrap("_prefill_chunk_into", "other")
+    wrap("_prefill_into", "other")
+    return counts
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_one_dispatch_per_steady_spec_mixed_round(model, paged):
+    """A steady mixed round WITH speculation — mid-prefill slots
+    alongside a greedy decoding slot — stays exactly ONE device
+    dispatch (the round-7 invariant, now carrying spec verify rows)."""
+    params, cfg = model
+    if paged:
+        b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4,
+                                   spec_k=4)
+    else:
+        b = ContinuousBatcher(params, cfg, n_slots=3, spec_k=4)
+    rd = b.admit(REPETITIVE, 12)               # greedy, decoding
+    rp1 = b.admit_chunked([5] * 20, 3, chunk=4)
+    rp2 = b.admit_chunked([6] * 20, 3, chunk=4)
+    counts = _count_dispatches(b)
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed_spec(2, chunk=4, budget=8, k=4)
+        rounds += 1
+    assert rounds > 1
+    assert counts["mixed_spec"] == rounds, \
+        "not one dispatch per spec-mixed round"
+    assert counts["other"] == 0, \
+        "a spec-mixed round leaked a separate prefill/decode dispatch"
+    _drain_spec(b)
+    for rid, (p, n) in [(rd, (REPETITIVE, 12)), (rp1, ([5] * 20, 3)),
+                        (rp2, ([6] * 20, 3))]:
+        assert b.completed[rid] == _exp(params, cfg, p, n)
+
+
+def test_round_robin_fairness_with_spec_slots(model):
+    """Budget R=2 against 3 concurrent long prompts while a spec slot
+    decodes: the slot skipped in a round is served next round — no
+    mid-prefill slot waits more than one round under spec-mixed."""
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=4, spec_k=4)
+    b.admit(REPETITIVE, 30)                    # greedy spec rider
+    for i in range(3):
+        b.admit_chunked([1 + i] * 40, 1, chunk=4)
+    waited = {s: 0 for s in b.prefilling}
+    while b.prefilling:
+        before = {s: b.prefilling[s].pos for s in b.prefilling}
+        b.tick_mixed_spec(1, chunk=4, budget=8, k=4)   # R=2 of 3
+        for s, pos0 in before.items():
+            if s not in b.prefilling:
+                continue
+            if b.prefilling[s].pos == pos0:
+                waited[s] += 1
+                assert waited[s] <= 1, \
+                    f"slot {s} starved {waited[s]} consecutive rounds"
+            else:
+                waited[s] = 0
+    _drain_spec(b)
+    assert len(b.completed) == 4
+
+
+# ---------------------------------------------------------------------------
+# cancel in every slot state under spec rounds
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("paged", [False, True])
+def test_cancel_every_state_under_spec_rounds(model, paged):
+    """cancel() of a request in each state — mid-prefill between
+    spec-mixed rounds, decoding between spec rounds, waiting at the
+    service — frees its slot/storage, survivors stay exact."""
+    params, cfg = model
+    mk = ((lambda n: PagedContinuousBatcher(params, cfg, n_slots=n,
+                                            page_size=4, spec_k=4))
+          if paged else
+          (lambda n: ContinuousBatcher(params, cfg, n_slots=n,
+                                       spec_k=4)))
+    # mid-prefill: cancel between spec-mixed rounds
+    b = mk(2)
+    keep = b.admit_chunked(PLAIN, 6, chunk=4)
+    dead = b.admit_chunked([5] * 24, 6, chunk=4)
+    b.tick_mixed_spec(2, chunk=4, budget=8, k=4)
+    assert any(p.request_id == dead for p in b.prefilling.values())
+    assert b.cancel(dead)
+    _drain_spec(b)
+    assert b.completed[keep] == _exp(params, cfg, PLAIN, 6)
+    assert dead not in b.completed
+    assert len(b.free_slots()) == 2
+    if paged:
+        assert b.free_page_count() == b.n_pages - 1
+
+    # decoding: cancel between spec rounds
+    b2 = mk(2)
+    keep2 = b2.admit(REPETITIVE, 10)
+    dead2 = b2.admit([3] * 6, 30)
+    b2.tick_spec(2, k=4)
+    assert b2.cancel(dead2)
+    _drain_spec(b2)
+    assert b2.completed[keep2] == _exp(params, cfg, REPETITIVE, 10)
+    assert dead2 not in b2.completed
+    if paged:
+        assert b2.free_page_count() == b2.n_pages - 1
+
+    # waiting at the service, while spec rounds serve the pool
+    svc = ContinuousService(params, cfg, n_slots=1, spec_k=4,
+                            prefill_chunk=4, decode_chunk=2,
+                            page_size=4 if paged else None).start()
+    try:
+        s1 = svc.submit(REPETITIVE, 16)
+        s2 = svc.submit([8] * 12, 4)           # waits: one slot
+        svc.cancel(s2)
+        assert s1.get(timeout=120) == _exp(params, cfg, REPETITIVE, 16)
+        assert svc.snapshot()["queued"] == 0
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# sampling rides / capability checks / telemetry
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("paged", [False, True])
+def test_sampling_rides_spec_rows_exactly(model, paged):
+    """A sampling slot alongside greedy slots rides the spec program as
+    a plain decode row: its stream is bit-identical to the ticked
+    reference (same per-round key chain as the fused scan)."""
+    params, cfg = model
+    mk = ((lambda spec: PagedContinuousBatcher(
+              params, cfg, n_slots=2, page_size=4, spec_k=spec))
+          if paged else
+          (lambda spec: ContinuousBatcher(params, cfg, n_slots=2,
+                                          spec_k=spec)))
+    b = mk(4)
+    rg = b.admit(REPETITIVE, 10)
+    rs = b.admit([5, 6, 5, 6], 8, temperature=0.9, seed=7)
+    for _ in range(40):
+        if not b.tick_spec(2, k=4):
+            break
+    ref = mk(0)
+    rg2 = ref.admit(REPETITIVE, 10)
+    rs2 = ref.admit([5, 6, 5, 6], 8, temperature=0.9, seed=7)
+    ref.run_until_drained()
+    assert b.completed[rg] == ref.completed[rg2]
+    assert b.completed[rs] == ref.completed[rs2]
+
+
+def test_ring_margin_capability_gate(wmodel):
+    """A windowed page ring whose margin cannot contain the k-token
+    rejected tail refuses speculation STRUCTURALLY (ring_margin), and
+    the service degrades to plain decode — counted, logged, served —
+    instead of raising."""
+    wparams, wcfg = wmodel
+    b = PagedContinuousBatcher(wparams, wcfg, n_slots=2, page_size=4,
+                               max_prefill_chunk=4)
+    # margin = (w_pages + c_pages + 1) * page - window = 24 - 16 = 8
+    assert b.spec_fallback_reason(8) is None
+    assert b.spec_fallback_reason(9) == "ring_margin"
+    assert "ring_margin" in SPEC_FALLBACK_REASONS
+
+    before = metrics.SPEC_FALLBACK.value(reason="ring_margin") or 0
+    svc = ContinuousService(wparams, wcfg, n_slots=2, page_size=4,
+                            prefill_chunk=4, spec_k=12).start()
+    try:
+        assert svc._spec_k == 0            # disabled, not refused
+        assert metrics.SPEC_FALLBACK.value(
+            reason="ring_margin") == before + 1
+        p, n = WIN_REQS[1]
+        assert svc.submit(p, n).get(timeout=120) \
+            == _exp(wparams, wcfg, p, n)
+    finally:
+        svc.stop()
+
+
+def test_unprovisioned_storage_refuses_spec_loudly(wmodel):
+    """A storage that cannot CONTAIN a k-token verify block raises from
+    tick_spec instead of silently corrupting streams: a rolling ring
+    without spec slack (spec_k=0 default, or k past the provisioned
+    slack) and a margin-short page ring — the direct-batcher-API twin
+    of the service's counted ring_margin fallback."""
+    wparams, wcfg = wmodel
+    b = ContinuousBatcher(wparams, wcfg, n_slots=1)     # slack-less ring
+    assert b.rolling_slots
+    assert b.spec_fallback_reason(4) == "ring_margin"
+    b.admit([5, 6, 5, 6, 5], 10)
+    with pytest.raises(ValueError, match="ring_margin"):
+        b.tick_spec(2, k=4)
+    b2 = ContinuousBatcher(wparams, wcfg, n_slots=1, spec_k=2)
+    b2.admit([5, 6, 5, 6, 5], 10)
+    with pytest.raises(ValueError, match="ring_margin"):
+        b2.tick_spec(2, k=4)                  # deeper than provisioned
+    pr = PagedContinuousBatcher(wparams, wcfg, n_slots=1, page_size=4,
+                                max_prefill_chunk=4)
+    pr.admit([5, 6, 5, 6, 5], 10)
+    with pytest.raises(ValueError, match="ring_margin"):
+        pr.tick_spec(2, k=12)                 # margin is 8
+    # full-size dense and full-causal paged stay capable at any k the
+    # headroom admits, provisioned or not (no slack to outrun)
+
+
+def test_sampling_only_rounds_fall_back_counted(model):
+    """With spec configured but only sampling slots active, rounds
+    route through the plain fused path and count the skipped
+    opportunity (sampling_only)."""
+    params, cfg = model
+    before = metrics.SPEC_FALLBACK.value(reason="sampling_only") or 0
+    svc = ContinuousService(params, cfg, n_slots=2, spec_k=4,
+                            prefill_chunk=4, decode_chunk=2).start()
+    try:
+        got = svc.submit([5, 6, 7], 6, temperature=0.9,
+                         seed=11).get(timeout=120)
+        assert (metrics.SPEC_FALLBACK.value(reason="sampling_only")
+                or 0) > before
+        assert svc.snapshot()["speculation"]["rounds"] == 0
+    finally:
+        svc.stop()
+    ref = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                            decode_chunk=2).start()
+    try:
+        assert got == ref.submit([5, 6, 7], 6, temperature=0.9,
+                                 seed=11).get(timeout=120)
+    finally:
+        ref.stop()
+
+
+def test_headroom_is_storage_aware(model):
+    """The +k headroom requirement is a FULL-SIZE-DENSE property, not a
+    speculation property: paged storage routes past-the-end writes to
+    the trash page and accepts boundary requests."""
+    params, cfg = model
+    dense = ContinuousBatcher(params, cfg, n_slots=1, spec_k=8)
+    with pytest.raises(ValueError, match="headroom"):
+        dense.validate_spec_request(40, cfg.max_seq - 40, 8)
+    paged = PagedContinuousBatcher(params, cfg, n_slots=1, page_size=4,
+                                   spec_k=8)
+    paged.validate_spec_request(40, cfg.max_seq - 40, 8)   # no raise
+    # and the boundary request actually SERVES exactly on pages
+    rid = paged.admit([7] * 40, cfg.max_seq - 40)
+    it = 0
+    while paged.slots and it < 200:
+        paged.tick_spec(2, k=8)
+        it += 1
+    assert paged.completed[rid] == _exp(params, cfg, [7] * 40,
+                                        cfg.max_seq - 40)
+
+
+def test_accept_depth_histogram_moves(model):
+    """tpushare_spec_accept_depth observes per-round per-slot accepted
+    counts during spec drains (the distribution behind
+    tokens-per-round)."""
+    params, cfg = model
+    before = metrics.SPEC_ACCEPT_DEPTH.count()
+    b = ContinuousBatcher(params, cfg, n_slots=1, spec_k=4)
+    b.admit(REPETITIVE, 10)
+    _drain_spec(b)
+    after = metrics.SPEC_ACCEPT_DEPTH.count()
+    assert after > before
+    # committed tokens reconcile: sum(depth) + rounds-with-live-slot
+    # >= produced is the loose sanity bound; the exact accounting is
+    # tokens == accepts + live commits, already covered by exactness
+
+
+def test_storage_info_prices_spec_rows(model):
+    """A spec-provisioned paged pool reports the verify read's
+    effective kernel viability (rows = n_rep * (1+k)) — the spec row
+    multiplier reaches storage_info's ATTN telemetry."""
+    params, cfg = model
+    pcfg = dataclasses.replace(cfg, attn_kernel="pallas")
+    b = PagedContinuousBatcher(params, pcfg, n_slots=1, page_size=4,
+                               spec_k=4)
+    # off-TPU the Mosaic gates are vacuous: the kernel reports viable
+    # at the spec row count too — the assertion is that the call path
+    # prices spec rows without error (the TPU-side refusals are swept
+    # in tests/test_analysis.py and the committed drive)
+    assert b.storage_info()["attn_kernel"] == "pallas"
+
+
+def test_bench_spec_scenario_smoke(model):
+    """The bench_all spec-on-paged scenario runs at tiny sizes, keeps
+    greedy exactness, and the spec arm dispatches less (tier-1-safe;
+    the >= 1.5x ratio claim is for the committed BENCH run)."""
+    import bench_all
+
+    params, cfg = model
+    out = bench_all.spec_paged_bench(params, cfg, page_size=4, slots=2,
+                                     prompt_len=8, gen=9, k=3,
+                                     n_rounds=4, reps=1)
+    for kv_dtype in ("bf16", "int8"):
+        assert out[kv_dtype]["spec"]["tokens_per_s"] > 0
+        assert (out[kv_dtype]["spec"]["dispatches"]
+                < out[kv_dtype]["ticked"]["dispatches"])

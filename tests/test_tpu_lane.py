@@ -269,6 +269,31 @@ def test_paged_attn_kernel_on_chip():
 
 
 @_skip
+def test_spec_paged_on_chip():
+    """Speculation on paged int8 pools (round 14): the k-row verify
+    read (rows = n_rep * (1+k)) and the per-row page scatter must
+    COMPILE AND LOWER on Mosaic — single-device and per shard under
+    the tp=2 shard_map arm, neither of which interpret mode can prove
+    — with spec == fused exactness per read path, and speculation must
+    WIN over plain fused decode at repetitive traffic on the chip."""
+    rec = _run("drive_spec_paged.py", timeout=3600)
+    # static Mosaic precheck ran pre-dial and agreed the layout lowers
+    assert rec.get("precheck_ok", True), rec
+    assert rec["exact"], rec
+    assert rec["tp2"].get("compile_ok", True), rec
+    committed = _committed("SPEC_PAGED_TPU.json",
+                           "speedup_spec_vs_fused_int8", default=None)
+    got = rec["speedup_spec_vs_fused_int8"]
+    if committed:
+        assert got >= _GUARD * committed, (rec, committed)
+    else:
+        # first record: a verify dispatch replaces up to 1+k fused
+        # steps at high acceptance — repetitive traffic must not LOSE;
+        # the committed record then sets the real bar
+        assert got >= 1.0, rec
+
+
+@_skip
 def test_int4_capacity_demo_on_chip():
     rec = _run("drive_int4_capacity.py", timeout=3600)
     assert rec["only_int4_fits_grant"], rec

@@ -304,6 +304,33 @@ def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
     return v
 
 
+def spec_verify_rows(n_heads: int, n_kv_heads: int, spec_k: int) -> int:
+    """Query rows a speculative VERIFY read hands the paged kernel:
+    ``n_rep * (spec_k + 1)`` — the spec row multiplier (round 14).
+    Mirror of ``ops.attention.spec_verify_rows`` (duplicated so this
+    module stays importable without jax; tests/test_analysis.py pins
+    the two, the same discipline as PAGED_KERNEL_MAX_ROWS)."""
+    n_rep = max(1, n_heads // max(1, n_kv_heads))
+    return n_rep * (int(spec_k) + 1)
+
+
+def precheck_spec_paged(page: int, head_dim: int, quantized: bool, dtype,
+                        spec_k: int, n_kv_heads: int, n_heads: int,
+                        tp: int = 1, assume_tpu: bool = True,
+                        cross_check: bool = False) -> Verdict:
+    """Would the paged kernel lower for a SPECULATIVE verify read at
+    these parameters?  Exactly :func:`precheck_paged` with the q-row
+    block derived from the spec depth (``rows = n_rep * (spec_k + 1)``
+    — the multiplier ``transformer.forward_paged_verify`` hands the
+    dispatcher per call): the drive's pre-dial check and the
+    spec-provisioned ``storage_info`` both price this shape."""
+    return precheck_paged(
+        page, head_dim, quantized, dtype,
+        rows=spec_verify_rows(n_heads, n_kv_heads, spec_k), tp=tp,
+        n_kv_heads=n_kv_heads, n_heads=n_heads, assume_tpu=assume_tpu,
+        cross_check=cross_check)
+
+
 def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
                        rows, tp, n_kv_heads, n_heads, assume_tpu):
     """Assert the symbolic verdict equals the LIVE gate's (imports jax;
@@ -512,6 +539,27 @@ def default_sweep() -> List[dict]:
     cases.append(dict(page=16, head_dim=64, quantized=True,
                       dtype="bf16", rows=8, tp=1, n_kv_heads=8,
                       n_heads=8, expect="head_dim"))
+    # round-14 spec verify reads: the q-row block is the spec row
+    # multiplier rows = n_rep * (k+1) (ceil-padded to the 8-row tile by
+    # the kernel) — the committed drive shape, both dtypes, tp 1 and 2
+    for quantized in (False, True):
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=spec_verify_rows(16, 8, 8),
+                          tp=1, n_kv_heads=8, n_heads=16, expect=None,
+                          note="k=8 verify: 18 q rows, kernel pads to "
+                               "24 (sublane-clean)"))
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=spec_verify_rows(16, 8, 8),
+                          tp=2, n_kv_heads=8, n_heads=16, expect=None))
+    # an absurd spec depth crosses the VMEM row bound like any long
+    # prefill — the gate must refuse, not let Mosaic die
+    cases.append(dict(page=64, head_dim=128, quantized=True,
+                      dtype="bf16",
+                      rows=spec_verify_rows(16, 8, 1024), tp=1,
+                      n_kv_heads=8, n_heads=16, expect="max_rows",
+                      note="spec row multiplier past "
+                           "PAGED_KERNEL_MAX_ROWS falls back per "
+                           "dispatch"))
     return cases
 
 

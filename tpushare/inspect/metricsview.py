@@ -145,6 +145,16 @@ def summarize_serving(parsed: dict) -> dict:
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
         "mixed_budget_util": _gauge(
             parsed, "tpushare_mixed_budget_utilization"),
+        # speculation: committed tokens per verify round (> 1 is the
+        # acceptance win; each round costs about one decode forward)
+        # and how often a configured spec_k fell back to plain decode
+        # (summed over reasons — nonzero means some rounds/configs did
+        # not speculate although speculation was asked for)
+        "spec_rounds": _gauge(parsed, "tpushare_spec_rounds_total"),
+        "spec_tokens": _gauge(parsed, "tpushare_spec_tokens_total"),
+        "spec_fallbacks": sum(
+            v for _, v in parsed["samples"].get(
+                "tpushare_spec_fallback_total", ())) or None,
     }
 
 
@@ -211,11 +221,12 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "ATTN", "PREFILL Q", "BUDGET%"]]
+              "KV BYTES(dtype)", "ATTN", "SPEC", "PREFILL Q",
+              "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -231,6 +242,17 @@ def render_metrics_table(
             # the viability gates demoted some compiled program(s) to
             # the gather — the ATTN column must not read "pallas" clean
             attn += f" (fb {int(summary['attn_fallbacks'])})"
+        # SPEC: tokens committed per verify round (the acceptance win),
+        # with the skipped/disabled fallback count alongside so a
+        # "spec on, nothing speculating" node explains itself
+        spec = "-"
+        if summary.get("spec_rounds"):
+            tpr = ((summary.get("spec_tokens") or 0.0)
+                   / summary["spec_rounds"])
+            spec = f"{tpr:.2f}t/r"
+        if summary.get("spec_fallbacks"):
+            spec = (("" if spec == "-" else spec + " ")
+                    + f"(fb {int(summary['spec_fallbacks'])})")
         health = (summary.get("health") or "-").upper()
         table.append([
             name, addr, health,
@@ -241,6 +263,7 @@ def render_metrics_table(
             kv,
             kv_bytes,
             attn,
+            spec,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])

@@ -350,9 +350,10 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
             # gather+select (deterministic; no duplicate-index scatter).
             # ``kv_write_len`` bounds the commit so a padded tail is
             # never written (it would wrap onto still-attendable keys).
-            if cfg.window != W:
+            if cfg.window > W:
                 raise ValueError(
-                    f"rolling cache of {W} requires cfg.window == {W}")
+                    f"rolling cache of {W} slots cannot hold a "
+                    f"window of {cfg.window}")
             s_new = k.shape[2]
             r = jnp.arange(W)
             if s_new == 1:
@@ -621,13 +622,23 @@ def wants_rolling(cfg: ModelConfig) -> bool:
     return cfg.window is not None and cfg.window < cfg.max_seq
 
 
-def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False):
+def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False,
+                   ring_slack: int = 0):
     """Stacked KV cache: a (k, v) pair of [L, B, Hkv, T, D] buffers with
     T = max_seq, or T = cfg.window for a ROLLING ring cache (sliding-
-    window configs only): position p lives in slot p % window, so cache
+    window configs only): position p lives in slot p % T, so cache
     HBM is O(window) instead of O(max_seq) — for mistral_7b that is a
     4096-entry cache against a 32768 context, 8x less KV memory and 8x
     fewer attended keys per decode step.
+
+    ``ring_slack`` (rolling only) adds that many ring slots beyond the
+    window — the speculative-decode headroom: a verify block's REJECTED
+    k-token tail is committed, never rewound, and with T = window + k
+    every such write evicts only positions already outside any future
+    query's window while the slack slots' stale claims stay position-
+    masked (see DESIGN.md "Speculation on paged pools").  T clamps at
+    max_seq (callers degenerate to full-size rows there); slack 0 is
+    byte-identical to the pre-slack layout.
 
     ``cfg.kv_dtype="int8"`` swaps each buffer for an int8 {"q","s"}
     store (per-(position, kv-head) scales riding a trailing singleton)
@@ -636,7 +647,7 @@ def init_kv_caches(cfg: ModelConfig, batch: int, rolling: bool = False):
     if rolling:
         if cfg.window is None:
             raise ValueError("rolling caches need a sliding-window cfg")
-        t = cfg.window
+        t = min(cfg.window + max(0, int(ring_slack)), cfg.max_seq)
     else:
         t = cfg.max_seq
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, t, cfg.head_dim)
@@ -820,6 +831,76 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                         .set(n[:, :, 0, :]), kpool, k_st)
             vp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
                         .set(n[:, :, 0, :]), vpool, v_st)
+            o = paged_attention(q, kp2, vp2, page_table, positions, cfg,
+                                mesh=mesh)
+            return o, (kp2, vp2)
+
+        return _attn_ffn(layer, x, cfg, attend)
+
+    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    logits = _head_mm(x, params["lm_head"])
+    return logits, (new_kp, new_vp)
+
+
+def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
+                         page_table, lengths, mesh=None):
+    """Speculative VERIFY step against the paged pool: every slot's
+    pending token plus its k proposal tokens scored in one forward.
+
+    tokens [B, 1+k]; lengths [B] — row b's block occupies positions
+    ``lengths[b] .. lengths[b]+k``, starting exactly at the committed
+    context, so no committed position is ever rewritten (append-only:
+    what keeps int8 pools exactly self-consistent across dispatch
+    flavors).  The k+1 fresh K/V entries scatter through each row's
+    OWN page-table walk — up to ``ceil(k/page)+1`` pages per row, all
+    reserved to that slot, so real writes never collide (inactive and
+    padded rows ride 0 tables onto the masked trash page, like every
+    other paged flavor).  A position past the table's reach (possible
+    only for the rejected/garbage tail near max_seq) is routed to the
+    TRASH page explicitly — never clamped onto a real page.
+
+    Rejected tails are masked, not rewound (commit-length clamp): a
+    garbage position q > the post-round committed length stays
+    position-masked for every consumed query until a later block
+    rewrites it with the real token at q, and on a windowed page RING
+    its eviction target q - held*page is already outside every future
+    query's window provided the ring's margin covers k
+    (``PagedContinuousBatcher.spec_fallback_reason`` gates that).  The
+    read routes through :func:`paged_attention` like every paged
+    flavor, so ``attn_kernel="pallas"`` runs the k-row verify through
+    the kernel (rows = n_rep * (1+k), the spec row multiplier the
+    viability gate prices per call) and tp meshes shard it per device.
+    Returns (logits [B, 1+k, vocab], updated pools).
+    """
+    b, s = tokens.shape
+    positions = lengths[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    kp, vp = pools
+    page = _kv_leaf(kp).shape[3]
+    n_tbl = page_table.shape[1]
+    ranges = positions // page                             # [B, S]
+    pids = jnp.where(
+        ranges < n_tbl,
+        jnp.take_along_axis(page_table, jnp.clip(ranges, 0, n_tbl - 1),
+                            axis=1),
+        0)
+    offs = positions % page
+
+    def body(x, layer_and_pool):
+        layer, kpool, vpool = layer_and_pool
+
+        def attend(lyr, xin):
+            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [B,Hkv,S,D]
+
+            def put(c, n):
+                # [B, Hkv, S, D] -> [B, S, Hkv, D] rides the advanced-
+                # index dims of the (page, lane) scatter; the int8
+                # scale leaf's trailing singleton maps unchanged
+                return c.at[pids, :, offs, :].set(n.transpose(0, 2, 1, 3))
+
+            kp2 = _smap(put, kpool, _kv_pack(k, cfg))
+            vp2 = _smap(put, vpool, _kv_pack(v, cfg))
             o = paged_attention(q, kp2, vp2, page_table, positions, cfg,
                                 mesh=mesh)
             return o, (kp2, vp2)

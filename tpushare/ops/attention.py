@@ -714,6 +714,20 @@ FALLBACK_REASONS = ("head_dim", "page_tile", "max_rows", "tp_heads",
                     "forced")
 
 
+def spec_verify_rows(n_heads: int, n_kv_heads: int, spec_k: int) -> int:
+    """Query ROWS a speculative verify read hands the paged kernel per
+    kv head: the pending token plus ``spec_k`` proposal positions,
+    times the GQA repeat — exactly the ``rows = n_rep * S`` the
+    dispatcher derives from q.shape at trace time.  THE one way
+    spec-aware callers (``storage_info``, the mosaic prechecker,
+    drives) price the spec row multiplier against
+    :data:`PAGED_KERNEL_MAX_ROWS` without building a q tensor first
+    (``analysis.mosaic.spec_verify_rows`` mirrors this; the agreement
+    test pins the two)."""
+    n_rep = max(1, n_heads // max(1, n_kv_heads))
+    return n_rep * (int(spec_k) + 1)
+
+
 def tp_degree(mesh, axis: str = "tp") -> int:
     """Size of ``axis`` in ``mesh`` (1 when mesh is None or lacks the
     axis) — the ONE way kernel dispatch sites ask "how many tensor-

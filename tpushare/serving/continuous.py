@@ -279,99 +279,134 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     return sel, toks, keys, caches
 
 
+def _dense_spec_verify(params, cfg):
+    """The dense slot pool's ``verify`` closure for
+    :func:`tpushare.serving.speculative.spec_scan`: one cached forward
+    over the ``[B, 1+k]`` blocks at each row's own depth.
+
+    ``kv_write_len``: a ROLLING ring commits the WHOLE 1+k block for
+    live rows — rejected tails are masked by the slack ring's position
+    reconstruction (``init_kv_caches(ring_slack=k)``), never retracted
+    — and commits NOTHING for frozen rows (their garbage verify never
+    touches the ring).  Full-size caches ignore the arg as ever: their
+    rejected tails sit past the committed length, position-masked until
+    the next block rewrites them.
+    """
+    def verify(blocks, n_ctxs, live, caches):
+        logits, caches = transformer.forward(
+            params, blocks, cfg, kv_caches=caches, cache_len=n_ctxs,
+            kv_write_len=jnp.where(live, blocks.shape[1], 0))
+        return logits, caches
+
+    return verify
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
-                                             "n_rounds"),
+                                             "n_rounds", "rich"),
                    donate_argnums=(2,))
 def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
-               remainings, actives, cfg, k: int, ngram: int,
-               n_rounds: int):
+               remainings, actives, temps, keys, tks, tps, cfg, k: int,
+               ngram: int, n_rounds: int, rich: bool = False):
     """``n_rounds`` of batched PROMPT-LOOKUP speculative decoding in one
     dispatch — the continuous batcher's speculation path (the serving
-    integration of :mod:`.speculative`'s single-request while_loop).
+    integration of :mod:`.speculative`'s single-request while_loop; the
+    round body is :func:`tpushare.serving.speculative.spec_scan`,
+    shared with the paged twin and the mixed-spec programs).
 
-    Per round, per slot: commit the pending known-correct token, propose
-    the ``k`` tokens that followed the most recent earlier occurrence of
-    the trailing ``ngram`` in that slot's OWN token buffer, verify
-    pending+proposal in ONE ``[B, 1+k]`` forward (batch-1 decode is
-    weight-bound, so the k extra positions are nearly free), and accept
-    the longest agreeing prefix — greedy-exact per slot, like the
-    single-request path.
+    Per round, per GREEDY slot: commit the pending known-correct token,
+    propose the ``k`` tokens that followed the most recent earlier
+    occurrence of the trailing ``ngram`` in that slot's OWN token
+    buffer, verify pending+proposal in ONE ``[B, 1+k]`` forward
+    (batch-1 decode is weight-bound, so the k extra positions are
+    nearly free), and accept the longest agreeing prefix — greedy-exact
+    per slot, like the single-request path.  SAMPLING slots ride the
+    same forward as plain decode rows (position-0 logits, one key
+    split per round — the fused scan's chain), so a mixed greedy/
+    sampling pool still takes one dispatch per round.
 
-    ``bufs`` [B, S] is each slot's token history (prompt + committed
-    output, device-resident so the n-gram scan never leaves the chip);
-    ``next_toks`` holds each slot's pending token (generated, not yet in
-    cache).  ``actives``/``remainings`` freeze exhausted or inactive
-    rows: a frozen row re-verifies at a fixed position every round
-    (writes beyond its committed length are never attended — the same
-    containment as a finished slot in ``_tick_n``).  DENSE full-size
-    pools only: a rejected proposal must be retractable by position
-    masking alone, which a rolling ring cannot do (its writes evict).
+    ``bufs`` [B, max_seq + k] is each slot's token history (prompt +
+    committed output, device-resident so the n-gram scan never leaves
+    the chip; the +k tail keeps a near-max_seq row's proposal append
+    from clamping into committed history); ``next_toks`` holds each
+    slot's pending token (generated, not yet in cache).  ``actives``/
+    ``remainings`` freeze exhausted or inactive rows: a frozen row
+    re-verifies at a fixed position every round (writes beyond its
+    committed length are never attended — the same containment as a
+    finished slot in ``_tick_n``).  Works on EVERY dense pool flavor:
+    full-size rows mask rejected writes positionally, rolling rings
+    carry ``spec_k`` slots of slack (see :func:`_dense_spec_verify`).
 
-    Returns (bufs, buf_lens, n_ctxs, next_toks, produced, caches):
-    ``produced[i]`` counts tokens committed into row i's buf this call;
-    the caller drains ``bufs[i, old_len : old_len + produced[i]]``.
+    Returns (bufs, buf_lens, n_ctxs, next_toks, produced, keys,
+    accepts, spec_lives, caches): ``produced[i]`` counts tokens
+    committed into row i's buf this call; the caller drains
+    ``bufs[i, old_len : old_len + produced[i]]``.
     """
-    S = cfg.max_seq
-    B = bufs.shape[0]
-    rows = jnp.arange(B)
+    from .speculative import spec_scan
+    return spec_scan(_dense_spec_verify(params, cfg), _sample_next,
+                     bufs, buf_lens, n_ctxs, next_toks, remainings,
+                     actives, temps, keys, tks, tps, caches, k, ngram,
+                     n_rounds, rich)
 
-    def round_(st, _):
-        bufs, buf_lens, n_ctxs, next_toks, produced, caches = st
-        live = actives & (produced < remainings)             # [B] bool
-        # -- commit the pending token ------------------------------
-        upd = jax.vmap(lambda b, t, p: jax.lax.dynamic_update_slice(
-            b, t[None], (p,)))
-        bufs = jnp.where(live[:, None],
-                         upd(bufs, next_toks, buf_lens), bufs)
-        buf_lens = buf_lens + live
-        produced = produced + live
-        rem_after = remainings - produced                    # [B]
 
-        # -- propose from each row's own history (the ONE lookup
-        # definition, vmapped — see speculative.propose_lookup) -----
-        from .speculative import propose_lookup
-        proposals, prop_lens = jax.vmap(
-            propose_lookup, in_axes=(0, 0, None, None))(
-                bufs, buf_lens, k, ngram)                    # [B,k],[B]
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "k",
+                                             "ngram", "n_rounds",
+                                             "rich"),
+                   donate_argnums=(7,))
+def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
+                     src_mask, caches, bufs, buf_lens, n_ctxs,
+                     next_toks, remainings, actives, temps, keys, tks,
+                     tps, cfg, chunk_len: int, k: int, ngram: int,
+                     n_rounds: int, rich: bool = False):
+    """ONE device program per mixed service round WITH speculation: the
+    coalesced budget-bounded prefill block (identical to
+    :func:`_tick_mixed`'s prefill half), then ``n_rounds`` speculative
+    verify rounds over the whole slot pool — spec rows for greedy
+    slots, plain decode rows for sampling slots, frozen garbage rows
+    for mid-prefill slots (aimed at their POST-chunk offset, exactly
+    like the mixed decode scan's ``incs``-frozen rows).  Speculation
+    thereby becomes a third co-resident phase of the single-dispatch
+    round instead of a mode switch — the admit-while-decode regime
+    keeps the round-7 one-dispatch invariant AND the spec multiplier.
 
-        # -- verify pending + proposal in one forward --------------
-        blocks = jnp.concatenate([next_toks[:, None], proposals], axis=1)
-        logits, caches = transformer.forward(
-            params, blocks, cfg, kv_caches=caches, cache_len=n_ctxs)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,1+k]
+    Returns (chunk-final logits [R, V],) + the :func:`_tick_spec`
+    outputs.
+    """
+    rows = jax.tree_util.tree_map(
+        lambda c: jnp.take(c, p_slots, axis=1), caches)
+    p_logits, rows = transformer.forward(
+        params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
+        cache_len=p_pos, kv_write_len=p_last + 1)
 
-        # -- longest agreeing prefix, bounded per row --------------
-        agree = ((proposals == greedy[:, :k])
-                 & (jnp.arange(k)[None, :] < prop_lens[:, None]))
-        n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
-                        axis=1)
-        n_acc = jnp.clip(n_acc, 0, jnp.maximum(rem_after, 0))
-        n_acc = jnp.where(live, n_acc, 0)
-        # append accepted proposals (the garbage tail beyond n_acc sits
-        # past buf_len and is overwritten before it is ever read)
-        bufs = jnp.where(live[:, None],
-                         jax.vmap(lambda b, pr, p:
-                                  jax.lax.dynamic_update_slice(
-                                      b, pr, (p,)))(bufs, proposals,
-                                                    buf_lens),
-                         bufs)
-        buf_lens = buf_lens + n_acc
-        produced = produced + n_acc
-        n_ctxs = n_ctxs + (1 + n_acc) * live
-        next_toks = jnp.where(live, greedy[rows, n_acc], next_toks)
-        return (bufs, buf_lens, n_ctxs, next_toks, produced, caches), None
+    def put(c, r):
+        g = jnp.take(r, src_rows, axis=1)
+        m = src_mask.reshape((1, -1) + (1,) * (c.ndim - 2))
+        return jnp.where(m, g, c)
 
-    produced0 = jnp.zeros((B,), jnp.int32)
-    (bufs, buf_lens, n_ctxs, next_toks, produced, caches), _ = \
-        jax.lax.scan(round_, (bufs, buf_lens, n_ctxs, next_toks,
-                              produced0, caches), None, length=n_rounds)
-    return bufs, buf_lens, n_ctxs, next_toks, produced, caches
+    caches = jax.tree_util.tree_map(put, caches, rows)
+    sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
 
+    from .speculative import spec_scan
+    out = spec_scan(_dense_spec_verify(params, cfg), _sample_next,
+                    bufs, buf_lens, n_ctxs, next_toks, remainings,
+                    actives, temps, keys, tks, tps, caches, k, ngram,
+                    n_rounds, rich)
+    return (sel,) + out
+
+
+#: every reason a CONFIGURED spec_k can fall back to plain decode — the
+#: enumerated values of ``tpushare_spec_fallback_total{reason=}``
+#: (enum-linted in tests/test_metric_lint.py, the FALLBACK_REASONS
+#: pattern): ``ring_margin`` = the windowed page ring lacks the k-token
+#: eviction margin a verify block needs (structural, disables spec at
+#: service start); ``sampling_only`` = no greedy slot active this round
+#: (spec rows exist only for greedy slots, so the round routes through
+#: the plain fused path instead of burning k dead lanes per row)
+SPEC_FALLBACK_REASONS = ("ring_margin", "sampling_only")
 
 #: the jitted serving entry points the retrace counter watches — every
 #: device program a service round can dispatch
 _JIT_ENTRIES = (_wrap_keys, _prefill_chunk, _tick, _tick_n, _tick_mixed,
-                _tick_spec)
+                _tick_spec, _tick_mixed_spec)
 
 #: every Nth tick runs the derived observations (goodput re-derivation,
 #: retrace scan) — cheap enough to stay inline at that cadence, >1% of
@@ -438,7 +473,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
-                 mesh=None, rolling_slots: Optional[bool] = None):
+                 mesh=None, rolling_slots: Optional[bool] = None,
+                 spec_k: int = 0):
         """``mesh``: optional ``jax.sharding.Mesh`` for tensor-parallel
         serving — params take the Megatron tp layout
         (:func:`tpushare.parallel.mesh.shard_params`) and KV storage
@@ -453,13 +489,28 @@ class ContinuousBatcher:
         is ``cfg.window`` entries instead of ``cfg.max_seq``:
         max_seq/window× more slots per HBM byte, same outputs); full-
         causal configs get max_seq rows.  Pass False to force max_seq
-        rows for a windowed config (the bit-identity reference)."""
+        rows for a windowed config (the bit-identity reference).
+
+        ``spec_k``: the speculation depth this pool must be able to
+        VERIFY (0 = no provisioning).  A rolling pool adds ``spec_k``
+        ring slots of slack so a verify block's rejected k-token tail
+        evicts only keys already outside every future query's window
+        (``init_kv_caches(ring_slack=)``); other storages need no
+        provisioning.  ``tick_spec`` itself takes ``k`` per call —
+        ``spec_k`` is the capacity bound the storage was built for."""
         self.mesh = mesh
+        self.spec_k = max(0, int(spec_k))
         if rolling_slots is None:
             rolling_slots = (cfg.window is not None
                              and cfg.window < cfg.max_seq)
         if rolling_slots and cfg.window is None:
             raise ValueError("rolling_slots needs a sliding-window cfg")
+        if (rolling_slots and self.spec_k
+                and cfg.window + self.spec_k >= cfg.max_seq):
+            # the spec-slack ring would cover the whole context —
+            # full-size rows ARE that storage, with the simpler
+            # positional-masking containment story
+            rolling_slots = False
         self.rolling_slots = bool(rolling_slots)
         if mesh is not None:
             from ..parallel.mesh import shard_params
@@ -597,7 +648,8 @@ class ContinuousBatcher:
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
         self.caches = transformer.init_kv_caches(
-            self.cfg, batch=self.n_slots, rolling=self.rolling_slots)
+            self.cfg, batch=self.n_slots, rolling=self.rolling_slots,
+            ring_slack=self.spec_k)
         if self.mesh is not None:
             from ..parallel.mesh import shard_kv_storage
             self.caches = shard_kv_storage(self.caches, self.mesh)
@@ -612,7 +664,10 @@ class ContinuousBatcher:
         reservation/gauges/reporting share one dtype-aware model)."""
         from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
-        slot_tokens = (cfg.window if self.rolling_slots else cfg.max_seq)
+        # a rolling pool provisioned for speculation carries spec_k ring
+        # slots of slack (see __init__) — price what was allocated
+        slot_tokens = (min(cfg.window + self.spec_k, cfg.max_seq)
+                       if self.rolling_slots else cfg.max_seq)
         bytes_per_slot = kv_cache_bytes(cfg, slot_tokens)
         # dense slot reads never route through the paged dispatcher, so
         # the read path is the XLA dense cached_attention regardless of
@@ -704,6 +759,44 @@ class ContinuousBatcher:
             raise ValueError("top_k must be >= 0 (0 = off)")
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1] (1 = off)")
+
+    # -- speculation capability ----------------------------------------
+    def spec_fallback_reason(self, k: int) -> Optional[str]:
+        """Why ``spec_k=k`` speculation cannot run on THIS storage
+        (None = capable) — the REAL capability check that replaced the
+        round-5 dense-pool refusals; reasons enumerate
+        :data:`SPEC_FALLBACK_REASONS`.  Full-size dense pools are
+        always capable (rejected verify writes are masked
+        positionally); a ROLLING ring is capable up to the slack it
+        was ALLOCATED with (``spec_k`` extra slots, see ``__init__``)
+        — a deeper ``k`` would evict still-in-window keys, the same
+        eviction-margin hazard as the windowed page ring."""
+        if self.rolling_slots and k > self.spec_k:
+            return "ring_margin"
+        return None
+
+    def _spec_needs_headroom(self) -> bool:
+        """Whether a verify block's garbage tail can CLAMP onto real
+        cache positions, so requests need ``prompt + max_new + k <=
+        max_seq``.  Only the full-size dense pool: its in-jit block
+        write is one ``dynamic_update_slice`` whose clamped start would
+        overwrite committed, still-attendable keys.  Rolling rings
+        commit through the gather-select (never clamps; slack contains
+        rejects) and paged tables route past-the-end writes to the
+        trash page."""
+        return not self.rolling_slots
+
+    def validate_spec_request(self, prompt_len: int, max_new: int,
+                              k: int) -> None:
+        """Raise for a request THIS storage could never speculate for
+        (the submit-side twin of the per-slot checks in
+        :meth:`tick_spec`)."""
+        if self._spec_needs_headroom() \
+                and prompt_len + max_new + k > self.cfg.max_seq:
+            raise ValueError(
+                f"speculation needs {k} tokens of cache headroom: "
+                f"prompt+max_new_tokens+spec_k exceeds "
+                f"max_seq={self.cfg.max_seq}")
 
     def admit(self, prompt: List[int], max_new_tokens: int,
               temperature: float = 0.0,
@@ -1071,12 +1164,7 @@ class ContinuousBatcher:
         """THE one device dispatch of a mixed round (storage hook).
         Returns (chunk-final logits [R, V], decode tokens [B, n], final
         keys)."""
-        src_rows = np.zeros((self.n_slots,), np.int32)
-        src_mask = np.zeros((self.n_slots,), bool)
-        for r in range(len(p_slots)):
-            if p_active[r]:
-                src_rows[p_slots[r]] = r
-                src_mask[p_slots[r]] = True
+        src_rows, src_mask = self._mixed_src(p_slots, p_active)
         sel, toks, keys, self.caches = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
             jnp.asarray(p_pos), jnp.asarray(p_last),
@@ -1084,6 +1172,128 @@ class ContinuousBatcher:
             tokens, lengths, temps, keys, tks, tps, incs,
             self.cfg, chunk_len, n_steps, rich)
         return sel, toks, keys
+
+    def _mixed_src(self, p_slots, p_active):
+        """The per-slot prefill-row SELECT operands (``src_rows``/
+        ``src_mask``) both dense mixed programs share."""
+        src_rows = np.zeros((self.n_slots,), np.int32)
+        src_mask = np.zeros((self.n_slots,), bool)
+        for r in range(len(p_slots)):
+            if p_active[r]:
+                src_rows[p_slots[r]] = r
+                src_mask[p_slots[r]] = True
+        return src_rows, src_mask
+
+    # -- speculative step hooks ----------------------------------------
+    def _step_spec(self, bufs, buf_lens, n_ctxs, next_toks, remainings,
+                   actives, temps, keys, tks, tps, rich, k: int,
+                   ngram: int, n_rounds: int):
+        """THE one device dispatch of a speculative round batch
+        (storage hook).  Returns (bufs, produced, next_toks, keys,
+        accepts, spec_lives)."""
+        (bufs, _, _, next_toks, produced, keys, accepts, lives,
+         self.caches) = _tick_spec(
+            self.params, bufs, self.caches, buf_lens, n_ctxs, next_toks,
+            remainings, actives, temps, keys, tks, tps, self.cfg, k,
+            ngram, n_rounds, rich)
+        return bufs, produced, next_toks, keys, accepts, lives
+
+    def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
+                         p_last, bufs, buf_lens, n_ctxs, next_toks,
+                         remainings, actives, temps, keys, tks, tps,
+                         rich, chunk_len: int, k: int, ngram: int,
+                         n_rounds: int):
+        """THE one device dispatch of a mixed round with speculation
+        (storage hook).  Returns (chunk-final logits [R, V],) + the
+        :meth:`_step_spec` outputs."""
+        src_rows, src_mask = self._mixed_src(p_slots, p_active)
+        (sel, bufs, _, _, next_toks, produced, keys, accepts, lives,
+         self.caches) = _tick_mixed_spec(
+            self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
+            jnp.asarray(p_pos), jnp.asarray(p_last),
+            jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
+            bufs, buf_lens, n_ctxs, next_toks, remainings, actives,
+            temps, keys, tks, tps, self.cfg, chunk_len, k, ngram,
+            n_rounds, rich)
+        return sel, bufs, produced, next_toks, keys, accepts, lives
+
+    def _plan_mixed_round(self, chunk: int, budget: int):
+        """Pack this round's coalesced prefill block under the token
+        budget (round-robin selection, fixed [R, C] shape) — the
+        planning half shared by :meth:`tick_mixed` and
+        :meth:`tick_mixed_spec`.  Returns (block | None, overflow):
+        ``block`` is None when no eligible window exists (nothing
+        prefilling, or every pending window crosses max_seq) and the
+        caller falls back to the sequential composition."""
+        C = self._mixed_chunk_len(chunk)
+        R = max(1, min(budget // C if budget >= C else 1, self.n_slots))
+        S = self.cfg.max_seq
+        eligible = [i for i, st in self.prefilling.items()
+                    if st.pos + C <= S]
+        overflow = [i for i, st in self.prefilling.items()
+                    if st.pos + C > S]
+        picked = self._select_prefill_slots(R, eligible)
+        if not picked:
+            return None, overflow
+        p_tokens = np.zeros((R, C), np.int32)
+        p_slots = np.zeros((R,), np.int32)
+        p_active = np.zeros((R,), bool)
+        p_pos = np.zeros((R,), np.int32)
+        p_last = np.zeros((R,), np.int32)
+        plan = []                      # (row, slot, state, chunk end)
+        n_real = 0
+        for r, i in enumerate(picked):
+            st = self.prefilling[i]
+            end = min(st.pos + C, len(st.prompt))
+            piece = st.prompt[st.pos:end]
+            p_tokens[r, :len(piece)] = piece
+            p_slots[r] = i
+            p_active[r] = True
+            p_pos[r] = st.pos
+            p_last[r] = len(piece) - 1
+            plan.append((r, i, st, end))
+            n_real += len(piece)
+        metrics.MIXED_STEPS.inc()
+        metrics.MIXED_PREFILL_TOKENS.inc(n_real)
+        metrics.MIXED_BUDGET_UTILIZATION.set(n_real / float(R * C))
+        return {"C": C, "p_tokens": p_tokens, "p_slots": p_slots,
+                "p_active": p_active, "p_pos": p_pos, "p_last": p_last,
+                "plan": plan}, overflow
+
+    def _mixed_fallback(self, overflow, t0, decode) -> int:
+        """Nothing for the fixed-width block to do this round: advance
+        the max_seq-boundary stragglers sequentially and decode with
+        ``decode()`` — exactly the sequential reference composition
+        (shared by both mixed flavors)."""
+        for i in list(overflow):
+            if i in self.prefilling:
+                self._advance_one_prefill(i)
+        self._observe_prefill()
+        if self.slots:
+            return decode()
+        self._observe_tick(t0)
+        return 0
+
+    def _finish_mixed_round(self, plan, sel, overflow) -> None:
+        """Post-dispatch host half shared by both mixed flavors:
+        activate rows whose chunk completed the prompt (fed by the
+        dispatch's chunk-final logits; they join the NEXT round), then
+        advance boundary stragglers with the narrow sequential chunk
+        (rare — only prompts within one chunk of the context limit
+        after uneven earlier chunking)."""
+        done = [(r, i, st) for r, i, st, end in plan
+                if end >= len(st.prompt)]
+        if done:
+            sel = np.asarray(sel)
+            for r, i, st in done:
+                del self.prefilling[i]
+                self._activate(i, st.request_id, st.prompt, sel[r],
+                               st.max_new, st.temperature, st.seed,
+                               st.eos_id, st.top_k, st.top_p)
+        for i in overflow:
+            if i in self.prefilling:
+                self._advance_one_prefill(i)
+        self._observe_prefill()
 
     def tick_mixed(self, n_steps: int, chunk: int = 64,
                    budget: int = 128) -> int:
@@ -1113,49 +1323,11 @@ class ContinuousBatcher:
         if not self.prefilling and not self.slots:
             return 0
         t0 = time.perf_counter()
-        C = self._mixed_chunk_len(chunk)
-        R = max(1, min(budget // C if budget >= C else 1, self.n_slots))
-        S = self.cfg.max_seq
-        eligible = [i for i, st in self.prefilling.items()
-                    if st.pos + C <= S]
-        overflow = [i for i, st in self.prefilling.items()
-                    if st.pos + C > S]
-        picked = self._select_prefill_slots(R, eligible)
-        if not picked:
-            # Nothing for the fixed-width block to do (no mid-prefill
-            # slots, or every pending window crosses the max_seq
-            # boundary): skip the wholly-padded mixed dispatch — advance
-            # the stragglers sequentially and decode with the plain
-            # fused chunk, exactly the sequential reference composition.
-            for i in list(overflow):
-                if i in self.prefilling:
-                    self._advance_one_prefill(i)
-            self._observe_prefill()
-            if self.slots:
-                return self.tick_fused(n_steps)
-            self._observe_tick(t0)
-            return 0
-        p_tokens = np.zeros((R, C), np.int32)
-        p_slots = np.zeros((R,), np.int32)
-        p_active = np.zeros((R,), bool)
-        p_pos = np.zeros((R,), np.int32)
-        p_last = np.zeros((R,), np.int32)
-        plan = []                      # (row, slot, state, chunk end)
-        n_real = 0
-        for r, i in enumerate(picked):
-            st = self.prefilling[i]
-            end = min(st.pos + C, len(st.prompt))
-            piece = st.prompt[st.pos:end]
-            p_tokens[r, :len(piece)] = piece
-            p_slots[r] = i
-            p_active[r] = True
-            p_pos[r] = st.pos
-            p_last[r] = len(piece) - 1
-            plan.append((r, i, st, end))
-            n_real += len(piece)
-        metrics.MIXED_STEPS.inc()
-        metrics.MIXED_PREFILL_TOKENS.inc(n_real)
-        metrics.MIXED_BUDGET_UTILIZATION.set(n_real / float(R * C))
+        block, overflow = self._plan_mixed_round(chunk, budget)
+        if block is None:
+            return self._mixed_fallback(
+                overflow, t0, lambda: self.tick_fused(n_steps))
+        plan = block["plan"]
         if self.slots:
             # decoder-empty rounds run the scan for shape only — their
             # steps produce nothing, so they don't count (tick_fused
@@ -1192,12 +1364,14 @@ class ContinuousBatcher:
                                 prefilling=len(plan), steps=n_steps,
                                 rids=decode_rids + prefill_rids):
                 sel, toks, new_keys = self._step_mixed(
-                    p_tokens, p_slots, p_active, p_pos, p_last,
+                    block["p_tokens"], block["p_slots"],
+                    block["p_active"], block["p_pos"], block["p_last"],
                     jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(temps),
                     _wrap_keys(jnp.asarray(keys)),
                     jnp.asarray(tks), jnp.asarray(tps),
-                    jnp.asarray(incs), self._rich(), C, n_steps)
+                    jnp.asarray(incs), self._rich(), block["C"],
+                    n_steps)
             # Host fetches are the real sync points (CLAUDE.md): fetch
             # ONLY what this round consumes, so pure-prefill rounds
             # with no completions stay fully async and pipeline like
@@ -1209,25 +1383,7 @@ class ContinuousBatcher:
         self._acct_credit(g.device_s, decode_rids, prefill_rids)
         if n_active:
             self._drain_fused_tokens(toks, new_keys, n_steps)
-        # Activate rows whose chunk completed the prompt — they join the
-        # NEXT round's scan (the host-side half of advance_prefill's
-        # completion, fed by the dispatch's chunk-final logits).
-        done = [(r, i, st) for r, i, st, end in plan
-                if end >= len(st.prompt)]
-        if done:
-            sel = np.asarray(sel)
-            for r, i, st in done:
-                del self.prefilling[i]
-                self._activate(i, st.request_id, st.prompt, sel[r],
-                               st.max_new, st.temperature, st.seed,
-                               st.eos_id, st.top_k, st.top_p)
-        # Boundary stragglers: windows that would cross max_seq take the
-        # narrow sequential chunk (rare — only prompts within one chunk
-        # of the context limit after uneven earlier chunking).
-        for i in overflow:
-            if i in self.prefilling:
-                self._advance_one_prefill(i)
-        self._observe_prefill()
+        self._finish_mixed_round(plan, sel, overflow)
         self._observe_tick(t0)
         return n_active
 
@@ -1260,76 +1416,94 @@ class ContinuousBatcher:
         # (admissions == completions + cancellations must reconcile)
         return self.completed.pop(rid, None) is not None
 
-    def tick_spec(self, n_rounds: int, k: int = 8, ngram: int = 2) -> int:
-        """``n_rounds`` of batched prompt-lookup SPECULATIVE decoding in
-        one dispatch (see :func:`_tick_spec`); returns #active slots
-        before the call.  Greedy-exact: token streams are identical to
-        :meth:`tick`/:meth:`tick_fused` and the two may be interleaved
-        freely, so the service can speculate opportunistically.
-
-        Constraints (the caller routes around them):
-        * every ACTIVE slot must be greedy (temperature == 0) — the
-          speculative contract is argmax equality;
-        * dense full-size storage only (a rolling ring cannot retract a
-          rejected proposal's write; pages would need +k headroom);
-        * each request needs ``prompt + max_new + k <= max_seq`` of
-          cache headroom (rejected tails write up to k past the end).
-        """
-        if self.rolling_slots:
-            raise ValueError("tick_spec needs a full-size dense pool")
-        if not self.slots:
-            return 0
-        t0 = time.perf_counter()
-        if any(s.temperature > 0.0 for s in self.slots.values()):
-            raise ValueError("tick_spec is greedy-only; route sampling "
-                             "batches through tick/tick_fused")
-        S, B = self.cfg.max_seq, self.n_slots
-        bufs = np.zeros((B, S), np.int32)
-        buf_lens = np.zeros((B,), np.int32)
-        n_ctxs = np.zeros((B,), np.int32)
-        next_toks = np.zeros((B,), np.int32)
-        remainings = np.zeros((B,), np.int32)
-        actives = np.zeros((B,), np.int32)
+    def _validate_spec_call(self, k: int) -> None:
+        """The loud half of a spec call, BEFORE any state mutates: a
+        storage that cannot CONTAIN a k-token verify block at all
+        (:meth:`spec_fallback_reason` — a slack-less rolling ring, a
+        margin-short page ring) raises, and on the full-size dense pool
+        every live/mid-prefill request must carry ``k`` tokens of cache
+        headroom (see :meth:`_spec_needs_headroom`: the verify-block
+        write is one clamping dynamic_update_slice, and the frozen
+        garbage write is (1+k) wide too).  The silent alternative is
+        corrupted streams — direct batcher-API callers get the loud
+        error the service-level fallback replaced.  Both spec entry
+        points call this before touching prefill offsets or dispatch
+        state, so a raise leaves the batcher exactly as it was."""
+        reason = self.spec_fallback_reason(k)
+        if reason is not None:
+            raise ValueError(
+                f"this {self.storage_info()['kind']} storage cannot "
+                f"verify k={k} speculative blocks ({reason}); "
+                f"provision the batcher with spec_k >= {k} or lower k")
+        if not self._spec_needs_headroom():
+            return
+        S = self.cfg.max_seq
         for i, st in self.prefilling.items():
-            # frozen garbage aim (see _gather) — and the (1+k)-wide
-            # garbage verify-write needs headroom too: a clamped write
-            # would land on committed, still-attendable prompt keys
             if len(st.prompt) + st.max_new + k > S:
                 raise ValueError(
                     f"prefilling slot {i}: speculation needs {k} tokens "
                     f"of cache headroom past prompt+max_new (max_seq {S})")
-            n_ctxs[i] = st.pos
         for i, s in self.slots.items():
             if len(s.output) + s.remaining + k > S:
                 raise ValueError(
                     f"slot {i}: speculation needs {k} tokens of cache "
                     f"headroom past prompt+max_new (max_seq {S})")
+
+    def _gather_spec_arrays(self, k: int):
+        """Assemble the per-slot operands of a speculative round batch —
+        shared by :meth:`tick_spec` and :meth:`tick_mixed_spec` (which
+        must gather AFTER advancing prefill offsets, so frozen rows aim
+        at their post-chunk position; validation runs separately and
+        FIRST, see :meth:`_validate_spec_call`).  ``bufs`` carries a
+        ``+k`` tail past max_seq so a near-full row's proposal append
+        can never clamp back into committed history."""
+        S, B = self.cfg.max_seq, self.n_slots
+        bufs = np.zeros((B, S + k), np.int32)
+        buf_lens = np.zeros((B,), np.int32)
+        n_ctxs = np.zeros((B,), np.int32)
+        next_toks = np.zeros((B,), np.int32)
+        remainings = np.zeros((B,), np.int32)
+        actives = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        tks = np.zeros((B,), np.int32)
+        tps = np.ones((B,), np.float32)
+        for i, st in self.prefilling.items():
+            n_ctxs[i] = st.pos           # frozen garbage aim
+        for i, s in self.slots.items():
             hist = s.output
             bufs[i, :len(hist) - 1] = hist[:-1]
             buf_lens[i] = len(hist) - 1
             n_ctxs[i] = s.length
             next_toks[i] = s.last_token
             remainings[i] = s.remaining
-            actives[i] = 1
-        rids = self._rids() if telemetry.enabled() else []
-        with health.MONITOR.dispatch_guard("decode",
-                                           active=len(self.slots),
-                                           spec_rounds=n_rounds,
-                                           rids=rids) as g:
-            bufs_j, buf_lens_j, n_ctxs_j, next_toks_j, produced, \
-                self.caches = \
-                _tick_spec(self.params, jnp.asarray(bufs), self.caches,
-                           jnp.asarray(buf_lens), jnp.asarray(n_ctxs),
-                           jnp.asarray(next_toks),
-                           jnp.asarray(remainings),
-                           jnp.asarray(actives).astype(bool), self.cfg,
-                           k, ngram, n_rounds)
-            bufs_h = np.asarray(bufs_j)
-            produced = np.asarray(produced)
-            n_ctxs_h = np.asarray(n_ctxs_j)
-            next_h = np.asarray(next_toks_j)
-        self._acct_credit(g.device_s, rids)
-        n_active = len(self.slots)
+            actives[i] = True
+            temps[i] = s.temperature
+            tks[i] = s.top_k
+            tps[i] = s.top_p
+            if s.temperature > 0.0:
+                keys[i] = np.asarray(jax.random.key_data(s.key))
+        return (bufs, buf_lens, n_ctxs, next_toks, remainings, actives,
+                temps, keys, tks, tps)
+
+    def _spec_operands(self, arrays):
+        """Host arrays -> the device operands `_step_spec` /
+        `_step_mixed_spec` take (keys wrapped once, jitted)."""
+        (bufs, buf_lens, n_ctxs, next_toks, remainings, actives, temps,
+         keys, tks, tps) = arrays
+        return (jnp.asarray(bufs), jnp.asarray(buf_lens),
+                jnp.asarray(n_ctxs), jnp.asarray(next_toks),
+                jnp.asarray(remainings), jnp.asarray(actives),
+                jnp.asarray(temps), _wrap_keys(jnp.asarray(keys)),
+                jnp.asarray(tks), jnp.asarray(tps))
+
+    def _drain_spec(self, bufs_h, produced, next_h, new_keys, accepts,
+                    lives, n_rounds: int) -> None:
+        """Consume one spec batch's outputs: extend every slot by its
+        committed tokens, finish at eos/exhaustion, carry the
+        device-advanced sampling keys, and feed the accept-depth
+        histogram — the ONE drain shared by :meth:`tick_spec` and
+        :meth:`tick_mixed_spec`."""
         for i in list(self.slots):
             s = self.slots[i]
             got = int(produced[i])
@@ -1345,6 +1519,23 @@ class ContinuousBatcher:
             if s.eos_id is not None and s.eos_id in new_toks:
                 take = new_toks.index(s.eos_id) + 1
                 new_toks = new_toks[:take]
+            if telemetry.enabled():
+                # accept-depth: this slot's live greedy rounds, but
+                # ONLY up to the delivered tokens — the device cannot
+                # see eos, so its post-eos rounds keep accepting
+                # lookup tokens the host discards; counting them would
+                # inflate the acceptance distribution on eos-heavy
+                # traffic (each live round delivers its pending commit
+                # plus its accepts, so the cumulative walk stops where
+                # truncation did)
+                depths, delivered = [], 0
+                for r in range(n_rounds):
+                    if not lives[r, i] or delivered >= take:
+                        continue
+                    depths.append(float(accepts[r, i]))
+                    delivered += 1 + int(accepts[r, i])
+                if depths:
+                    metrics.SPEC_ACCEPT_DEPTH.observe_many(depths)
             s.output.extend(new_toks)
             s.remaining -= take
             s.last_token = s.output[-1]
@@ -1358,9 +1549,128 @@ class ContinuousBatcher:
                 self._complete(s.request_id, s.output)
                 self._release(i)
                 del self.slots[i]
+            elif s.temperature > 0.0:
+                # the device split this slot's key once per round — the
+                # same chain the host/fused paths walk per token
+                s.key = jax.random.wrap_key_data(jnp.asarray(new_keys[i]))
         self._spec_stats["rounds"] += n_rounds
         self._spec_stats["calls"] += 1
         metrics.SPEC_ROUNDS.inc(n_rounds)
+
+    def tick_spec(self, n_rounds: int, k: int = 8, ngram: int = 2) -> int:
+        """``n_rounds`` of batched prompt-lookup SPECULATIVE decoding in
+        one dispatch (see :func:`_tick_spec`); returns #active slots
+        before the call.  Greedy-exact: greedy token streams are
+        identical to :meth:`tick`/:meth:`tick_fused` and the flavors
+        may be interleaved freely, so the service can speculate
+        opportunistically.  Runs on EVERY storage flavor — full-size
+        dense, rolling ring (spec-slack provisioned, see ``spec_k``),
+        and the paged pools via the subclass hook — with sampling slots
+        riding the verify forward as plain decode rows (their streams
+        stay bit-identical to the fused path's; only GREEDY slots
+        speculate).
+
+        Remaining constraint: on the full-size dense pool each request
+        needs ``prompt + max_new + k <= max_seq`` of cache headroom
+        (rejected tails write up to k past the end and the block write
+        clamps); rolling rings and paged tables contain the tail
+        without headroom (see DESIGN.md "Speculation on paged pools").
+        """
+        if not self.slots:
+            return 0
+        t0 = time.perf_counter()
+        self._validate_spec_call(k)
+        arrays = self._gather_spec_arrays(k)
+        rids = self._rids() if telemetry.enabled() else []
+        with health.MONITOR.dispatch_guard("decode",
+                                           active=len(self.slots),
+                                           spec_rounds=n_rounds,
+                                           rids=rids) as g:
+            out = self._step_spec(*self._spec_operands(arrays),
+                                  self._rich(), k, ngram, n_rounds)
+            bufs_h = np.asarray(out[0])
+            produced = np.asarray(out[1])
+            next_h = np.asarray(out[2])
+            new_keys = np.asarray(jax.random.key_data(out[3]))
+            accepts = np.asarray(out[4])
+            lives = np.asarray(out[5])
+        self._acct_credit(g.device_s, rids)
+        n_active = len(self.slots)
+        self._drain_spec(bufs_h, produced, next_h, new_keys, accepts,
+                         lives, n_rounds)
+        self._observe_tick(t0)
+        return n_active
+
+    def tick_mixed_spec(self, n_rounds: int, chunk: int = 64,
+                        budget: int = 128, k: int = 8,
+                        ngram: int = 2) -> int:
+        """One mixed service round with SPECULATION as the decode half:
+        the coalesced budget-bounded prefill block plus ``n_rounds``
+        speculative verify rounds (spec rows for greedy slots, plain
+        decode rows for sampling slots) in ONE device dispatch — the
+        round-7 single-dispatch invariant with the speculation
+        multiplier riding along (see :func:`_tick_mixed_spec`).  Same
+        fairness (round-robin chunk selection), same boundary-straggler
+        fallback (which then decodes through :meth:`tick_spec`), same
+        activation protocol as :meth:`tick_mixed`; returns #decoding
+        slots before the round.
+        """
+        if not self.prefilling and not self.slots:
+            return 0
+        t0 = time.perf_counter()
+        # validate BEFORE any mutation: a raise here (incapable
+        # storage, missing headroom) must leave prefill offsets and the
+        # round-robin cursor untouched
+        self._validate_spec_call(k)
+        block, overflow = self._plan_mixed_round(chunk, budget)
+        if block is None:
+            return self._mixed_fallback(
+                overflow, t0,
+                lambda: self.tick_spec(n_rounds, k=k, ngram=ngram))
+        plan = block["plan"]
+        # advance offsets BEFORE gathering: frozen rows aim their
+        # (1+k)-wide garbage verify at the POST-chunk offset, the same
+        # aim tick_mixed gives the frozen decode scan
+        for _, _, st, end in plan:
+            st.pos = end
+        arrays = self._gather_spec_arrays(k)
+        if telemetry.enabled():
+            decode_rids = self._rids()
+            prefill_rids = [st.request_id for _, _, st, _ in plan]
+        else:
+            decode_rids, prefill_rids = [], []
+        with health.MONITOR.dispatch_guard("mixed",
+                                           active=len(self.slots),
+                                           prefilling=len(plan),
+                                           spec_rounds=n_rounds,
+                                           rids=decode_rids
+                                           + prefill_rids) as g:
+            with telemetry.span("batcher.tick_mixed_spec", cat="serving",
+                                active=len(self.slots),
+                                prefilling=len(plan),
+                                spec_rounds=n_rounds,
+                                rids=decode_rids + prefill_rids):
+                out = self._step_mixed_spec(
+                    block["p_tokens"], block["p_slots"],
+                    block["p_active"], block["p_pos"], block["p_last"],
+                    *self._spec_operands(arrays), self._rich(),
+                    block["C"], k, ngram, n_rounds)
+            sel = out[0]
+            # host fetches only what this round consumes (lazy, like
+            # tick_mixed): pure-prefill rounds stay fully async
+            n_active = len(self.slots)
+            if n_active:
+                bufs_h = np.asarray(out[1])
+                produced = np.asarray(out[2])
+                next_h = np.asarray(out[3])
+                new_keys = np.asarray(jax.random.key_data(out[4]))
+                accepts = np.asarray(out[5])
+                lives = np.asarray(out[6])
+        self._acct_credit(g.device_s, decode_rids, prefill_rids)
+        if n_active:
+            self._drain_spec(bufs_h, produced, next_h, new_keys,
+                             accepts, lives, n_rounds)
+        self._finish_mixed_round(plan, sel, overflow)
         self._observe_tick(t0)
         return n_active
 
@@ -1417,15 +1727,21 @@ class ContinuousService:
         # fusion.  The trade is ≤ decode_chunk-1 ticks of completion/
         # admission latency per chunk.
         self._decode_chunk = max(1, decode_chunk)
-        # spec_k > 0 enables OPPORTUNISTIC prompt-lookup speculation:
-        # steady-state rounds with an all-greedy active set route
-        # through tick_spec (greedy-exact, so mixing with fused ticks is
-        # safe); any sampling slot falls back to the plain fused path.
-        # Dense full-size pools only (tick_spec's constraint); requests
-        # then need prompt + max_new + spec_k <= max_seq (checked at
-        # submit).  spec_rounds defaults to half the decode chunk: at
-        # acceptance ~1 token/round speculation matches the fused path's
-        # per-dispatch token yield, and beats it as acceptance grows.
+        # spec_k > 0 enables OPPORTUNISTIC prompt-lookup speculation on
+        # EVERY storage flavor (dense, rolling ring, paged, page ring,
+        # prefix cache; kv_dtype="int8" included): rounds with any
+        # greedy slot active route through tick_spec — or, while
+        # anything is mid-prefill, through tick_mixed_spec, which fuses
+        # the coalesced prefill block WITH the spec rounds into one
+        # dispatch — and sampling slots ride those programs as plain
+        # decode rows (greedy-only routing: only greedy slots
+        # speculate).  A pool that structurally cannot verify k tokens
+        # (a windowed page ring without the eviction margin) DISABLES
+        # speculation at start with a counted fallback instead of
+        # refusing to serve.  spec_rounds defaults to half the decode
+        # chunk: at acceptance ~1 token/round speculation matches the
+        # fused path's per-dispatch token yield, and beats it as
+        # acceptance grows.
         self._spec_k = int(spec_k)
         self._spec_ngram = int(spec_ngram)
         self._spec_rounds = (int(spec_rounds) if spec_rounds is not None
@@ -1459,16 +1775,25 @@ class ContinuousService:
             self._batcher = PagedContinuousBatcher(
                 params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
                 mesh=mesh, max_prefill_chunk=self._prefill_chunk,
-                prefix_cache=prefix_cache)
+                prefix_cache=prefix_cache, spec_k=self._spec_k)
         else:
             if prefix_cache:
                 raise ValueError("prefix_cache rides the paged pool; "
                                  "pass page_size too")
-            self._batcher = ContinuousBatcher(params, cfg, n_slots, mesh=mesh)
-        if self._spec_k and (page_size is not None
-                             or self._batcher.rolling_slots):
-            raise ValueError("speculation (spec_k) requires the dense "
-                             "full-size slot pool")
+            self._batcher = ContinuousBatcher(params, cfg, n_slots,
+                                              mesh=mesh,
+                                              spec_k=self._spec_k)
+        if self._spec_k:
+            # the REAL capability check (replaced the round-5 dense-only
+            # refusal): a storage that cannot contain a k-token rejected
+            # tail degrades to plain decode — counted, logged, served
+            reason = self._batcher.spec_fallback_reason(self._spec_k)
+            if reason is not None:
+                log.warning("speculation disabled (%s): spec_k=%d on %s "
+                            "storage", reason, self._spec_k,
+                            self._batcher.storage_info()["kind"])
+                metrics.SPEC_FALLBACK.inc(reason=reason)
+                self._spec_k = 0
         # _lock guards ONLY the _waiting handoff; the batcher and _sinks
         # are owned by the loop thread, so decode ticks run without the
         # lock and submit() never waits on a model forward.
@@ -1572,12 +1897,11 @@ class ContinuousService:
                 top_k, top_p, stream: bool, on_complete=None):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
-        if self._spec_k and (len(prompt) + max_new_tokens + self._spec_k
-                             > self._batcher.cfg.max_seq):
-            raise ValueError(
-                f"speculation needs {self._spec_k} tokens of cache "
-                f"headroom: prompt+max_new_tokens+spec_k exceeds "
-                f"max_seq={self._batcher.cfg.max_seq}")
+        if self._spec_k:
+            # storage-aware: only the full-size dense pool still needs
+            # the +k cache headroom (see validate_spec_request)
+            self._batcher.validate_spec_request(
+                len(prompt), max_new_tokens, self._spec_k)
         # streaming sinks are unbounded (many deltas); final-only sinks
         # hold exactly one item
         sink = self._q.Queue() if stream else self._q.Queue(maxsize=1)
@@ -1667,6 +1991,23 @@ class ContinuousService:
             snap["speculation"] = st
         return snap
 
+    def _spec_route(self) -> bool:
+        """Speculate this round?  Greedy-only routing stays: spec rows
+        exist only for greedy slots — with none active, a spec round
+        would be a fused decode chunk dragging k dead lanes per row, so
+        the loop falls back to the plain path and counts the skipped
+        opportunity (``tpushare_spec_fallback_total{reason=
+        sampling_only}``).  Sampling slots alongside at least one
+        greedy slot RIDE the spec program as decode rows instead of
+        blocking it (the round-5 all-greedy gate is gone)."""
+        slots = self._batcher.slots
+        if not slots:
+            return False
+        if any(s.temperature == 0.0 for s in slots.values()):
+            return True
+        metrics.SPEC_FALLBACK.inc(reason="sampling_only")
+        return False
+
     # ------------------------------------------------------------------
     def _loop(self) -> None:
         while not self._halt.is_set():
@@ -1706,8 +2047,19 @@ class ContinuousService:
                     self._stream_sinks[rid] = [sink, len(prompt), on_cb]
                 else:
                     self._sinks[rid] = sink
+            spec = bool(self._spec_k) and self._spec_route()
             if self._batcher.prefilling:
-                if self._mixed_step:
+                if self._mixed_step and spec:
+                    # ONE dispatch per round, speculation co-resident:
+                    # the coalesced prefill block fused with the spec
+                    # verify rounds (greedy slots speculate, sampling
+                    # slots ride as decode rows — see tick_mixed_spec).
+                    active = self._batcher.tick_mixed_spec(
+                        self._spec_rounds,
+                        chunk=self._prefill_chunk,
+                        budget=self._prefill_budget,
+                        k=self._spec_k, ngram=self._spec_ngram)
+                elif self._mixed_step:
                     # ONE dispatch per round: all pending prompt chunks
                     # under the token budget, coalesced and fused with
                     # the decode scan (see tick_mixed).
@@ -1725,12 +2077,10 @@ class ContinuousService:
                             self._prefill_decode_chunk)
                     else:
                         active = self._batcher.tick()
-            elif (self._spec_k
-                  and all(s.temperature == 0.0
-                          for s in self._batcher.slots.values())):
-                # all-greedy steady state: speculative rounds (exact,
-                # so interleaving with the fused path below is safe
-                # when a sampling request joins later)
+            elif spec:
+                # steady state with greedy slots active: speculative
+                # rounds (greedy-exact, so interleaving with the fused
+                # path below stays safe as traffic mixes shift)
                 active = self._batcher.tick_spec(
                     self._spec_rounds, k=self._spec_k,
                     ngram=self._spec_ngram)

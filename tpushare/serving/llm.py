@@ -91,8 +91,9 @@ class LLMServer:
         ``tp > 1`` builds a tensor-parallel mesh over the pod's visible
         devices and serves SPMD (requires --slots; params and KV storage
         shard per ``tpushare.parallel.mesh``).  ``spec_k > 0`` turns on
-        opportunistic prompt-lookup speculation for all-greedy batches
-        (greedy-exact; see ContinuousService).  ``prefill_budget`` caps
+        opportunistic prompt-lookup speculation on every storage flavor
+        (greedy-exact; greedy slots speculate, sampling slots ride the
+        same dispatch; see ContinuousService).  ``prefill_budget`` caps
         the prompt tokens one MIXED service round coalesces into its
         single-dispatch prefill block (0 = two prefill chunks);
         ``mixed_step=False`` restores the sequential advance-then-fuse
@@ -547,9 +548,20 @@ def main(argv=None) -> int:
                     help="tensor-parallel degree over the pod's visible "
                          "devices (0/1 = single device); requires --slots")
     ap.add_argument("--spec-k", type=int, default=0,
-                    help="prompt-lookup speculation depth for all-greedy "
-                         "batches (0 = off; greedy-exact; requires "
-                         "--slots, dense pool)")
+                    help="prompt-lookup speculation depth (0 = off; "
+                         "greedy-exact; requires --slots).  Works on "
+                         "EVERY storage flavor — dense, rolling ring, "
+                         "--page-size pools incl. the windowed page "
+                         "ring and --prefix-cache — and composes with "
+                         "--kv-dtype int8, --attn-kernel pallas, and "
+                         "--tp; greedy slots speculate while sampling "
+                         "requests ride the same dispatch as plain "
+                         "decode rows, and mixed admit-while-decode "
+                         "rounds fuse prefill + speculation into one "
+                         "dispatch.  A storage that cannot verify k "
+                         "tokens (page ring without the eviction "
+                         "margin) disables speculation with a counted "
+                         "fallback instead of refusing to serve")
     ap.add_argument("--prefix-cache", action="store_true",
                     help="reuse completed requests' prompt-prefix KV "
                          "pages for same-prefix admissions (requires "
@@ -573,8 +585,6 @@ def main(argv=None) -> int:
         ap.error("--prefix-cache requires --page-size")
     if args.spec_k and not args.slots:
         ap.error("--spec-k requires --slots")
-    if args.spec_k and args.page_size:
-        ap.error("--spec-k requires the dense pool (no --page-size)")
     if args.page_size and not args.slots:
         ap.error("--page-size requires --slots")
     if args.kv_pages and not args.page_size:

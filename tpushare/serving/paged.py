@@ -148,6 +148,69 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
     return sel, toks, keys, pools
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
+                                             "n_rounds", "rich", "mesh"),
+                   donate_argnums=(2,))
+def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
+               next_toks, remainings, actives, temps, keys, tks, tps,
+               cfg, k: int, ngram: int, n_rounds: int,
+               rich: bool = False, mesh=None):
+    """Paged twin of continuous._tick_spec: ``n_rounds`` of batched
+    prompt-lookup speculation against the page pool in one dispatch
+    (the shared round body, :func:`tpushare.serving.speculative
+    .spec_scan`, with the verify forward swapped for
+    :func:`transformer.forward_paged_verify`).  The page table is
+    FIXED across the whole batch, as every paged scan requires: the
+    verify scatter walks each row's OWN reserved pages (up to
+    ``ceil(k/page)+1`` per round), overflow/rejected tails land on the
+    masked trash page or on positions a later block rewrites — see
+    forward_paged_verify on the containment, and
+    ``PagedContinuousBatcher.spec_fallback_reason`` for the one
+    structural gate (a windowed page ring's eviction margin must cover
+    ``k``)."""
+    from .speculative import spec_scan
+
+    def verify(blocks, n_ctxs, live, pools):
+        return transformer.forward_paged_verify(
+            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh)
+
+    return spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
+                     next_toks, remainings, actives, temps, keys, tks,
+                     tps, pools, k, ngram, n_rounds, rich)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "k",
+                                             "ngram", "n_rounds", "rich",
+                                             "mesh"),
+                   donate_argnums=(5,))
+def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
+                     page_table, bufs, buf_lens, n_ctxs, next_toks,
+                     remainings, actives, temps, keys, tks, tps, cfg,
+                     chunk_len: int, k: int, ngram: int, n_rounds: int,
+                     rich: bool = False, mesh=None):
+    """Paged twin of continuous._tick_mixed_spec: the coalesced
+    multi-prompt prefill (:func:`transformer.forward_paged_prefill_
+    batch`) followed by the speculative verify rounds, in ONE dispatch
+    — the mixed step with speculation as its third co-resident phase.
+    Mid-prefill rows ride the spec scan frozen (inactive), their
+    (1+k)-wide garbage verify aimed at the post-chunk offset exactly
+    like the plain mixed scan's ``incs``-frozen rows."""
+    sel, pools = transformer.forward_paged_prefill_batch(
+        params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
+        p_last, mesh=mesh)
+
+    from .speculative import spec_scan
+
+    def verify(blocks, n_ctxs, live, pools):
+        return transformer.forward_paged_verify(
+            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh)
+
+    out = spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
+                    next_toks, remainings, actives, temps, keys, tks,
+                    tps, pools, k, ngram, n_rounds, rich)
+    return (sel,) + out
+
+
 @dataclasses.dataclass
 class _CachedPrefix:
     """A registered prompt prefix whose K/V pages live in the pool.
@@ -172,7 +235,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
                  page_size: int = 16, n_pages: Optional[int] = None,
                  mesh=None, max_prefill_chunk: int = 64,
                  prefix_cache: bool = False,
-                 pool_bytes: Optional[int] = None):
+                 pool_bytes: Optional[int] = None,
+                 spec_k: int = 0):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
@@ -219,7 +283,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # paged storage is position-indexed (no ring wraparound); the
         # rolling-slot layout is a dense-pool concern
         super().__init__(params, cfg, n_slots, mesh=mesh,
-                         rolling_slots=False)
+                         rolling_slots=False, spec_k=spec_k)
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -230,6 +294,31 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 f"request needs {need} pages but the pool holds only "
                 f"{self.n_pages - 1} usable pages")
 
+    # -- speculation capability ----------------------------------------
+    def spec_fallback_reason(self, k: int) -> Optional[str]:
+        """Paged pools verify k-token blocks without extra reservation
+        (rejected tails land past the committed length on the slot's
+        own pages — position-masked until rewritten — or past the
+        reservation on the trash page), EXCEPT the windowed page RING:
+        its verify writes recycle pages in place, so the ring's margin
+        beyond the window (the SAME held-page count the allocation
+        uses, :meth:`_ring_held_pages`) must also cover ``k`` — an
+        eviction at written position q must only reach positions
+        <= q - window.  Shorter margins refuse speculation structurally
+        ("ring_margin"); everything else is capable."""
+        if transformer.wants_rolling(self.cfg):
+            margin = (self._ring_held_pages() * self.page_size
+                      - self.cfg.window)
+            if k > margin:
+                return "ring_margin"
+        return None
+
+    def _spec_needs_headroom(self) -> bool:
+        """Never: the page-table walk routes past-the-end writes to the
+        trash page instead of clamping onto real positions (see
+        transformer.forward_paged_verify)."""
+        return False
+
     def storage_info(self) -> dict:
         """HBM accounting for the page pool (vs the base class's
         per-slot rows): persistent KV cost is pages, not slots.  Byte
@@ -237,7 +326,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         an int8 pool prices its pages (and the ``pool_bytes`` sizing
         knob admits ~2x of them) with the same model the gauges and
         ``/usage`` reporting use."""
-        from ..ops.attention import paged_kernel_viable, tp_degree
+        from ..ops.attention import (paged_kernel_viable,
+                                     spec_verify_rows, tp_degree)
         from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
         bytes_per_page = kv_cache_bytes(cfg, self.page_size)
@@ -248,11 +338,16 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # shard, or a forced reference escape hatch runs the XLA
         # gather — telemetry must say so, or an operator debugging HBM
         # pressure / a flat speedup reads "pallas, transient 0" while
-        # every tick pays the dense gather
+        # every tick pays the dense gather.  A spec-provisioned pool
+        # prices the VERIFY read's q-row block (rows = n_rep * (1+k),
+        # the spec row multiplier) — its steady-state reads are k+1
+        # rows wide, not 1
+        rows = (spec_verify_rows(cfg.n_heads, cfg.n_kv_heads,
+                                 self.spec_k) if self.spec_k else 1)
         kernel = cfg.attn_kernel
         if kernel == "pallas" and not paged_kernel_viable(
                 self.page_size, cfg.head_dim,
-                transformer.kv_quantized(cfg), cfg.dtype,
+                transformer.kv_quantized(cfg), cfg.dtype, rows=rows,
                 tp=tp_degree(self.mesh), n_kv_heads=cfg.n_kv_heads,
                 n_heads=cfg.n_heads):
             kernel = "xla"
@@ -309,10 +404,19 @@ class PagedContinuousBatcher(ContinuousBatcher):
         """
         n_ranges = -(-(prompt_len + max_new) // self.page_size)
         if transformer.wants_rolling(self.cfg):
-            w_pages = -(-self.cfg.window // self.page_size)
-            c_pages = -(-self.max_prefill_chunk // self.page_size)
-            return min(n_ranges, w_pages + c_pages + 1)
+            return min(n_ranges, self._ring_held_pages())
         return n_ranges
+
+    def _ring_held_pages(self) -> int:
+        """THE windowed page ring's size in pages (window + one whole
+        prefill chunk + 1; see :meth:`_held_pages` on why the chunk
+        margin exists) — one definition shared by the allocation
+        (:meth:`_held_pages`) and the speculation eviction-margin gate
+        (:meth:`spec_fallback_reason`), so the safety check can never
+        drift from what was actually allocated."""
+        w_pages = -(-self.cfg.window // self.page_size)
+        c_pages = -(-self.max_prefill_chunk // self.page_size)
+        return w_pages + c_pages + 1
 
     def _lookup_prefix(self, prompt: List[int]) -> Optional[_CachedPrefix]:
         """Longest registered prefix usable for this prompt: a full-page
@@ -519,12 +623,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
     def _step_mixed(self, p_tokens, p_slots, p_active, p_pos, p_last,
                     tokens, lengths, temps, keys, tks, tps, incs, rich,
                     chunk_len: int, n_steps: int):
-        p_tables = np.zeros((len(p_slots), self.pages_per_slot), np.int32)
-        for r in range(len(p_slots)):
-            if p_active[r]:
-                # the slot's own table row; padded rows keep all-zero
-                # tables, routing every write to the masked trash page
-                p_tables[r] = self.page_table[p_slots[r]]
+        p_tables = self._prefill_tables(p_slots, p_active)
         sel, toks, keys, self.pools = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
             jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
@@ -532,6 +631,43 @@ class PagedContinuousBatcher(ContinuousBatcher):
             tks, tps, incs, self.cfg, chunk_len, n_steps, rich,
             mesh=self.mesh)
         return sel, toks, keys
+
+    def _prefill_tables(self, p_slots, p_active):
+        """Per-row page-table rows for a coalesced prefill block (live
+        rows get their slot's table; padded rows all-zero tables onto
+        the masked trash page) — shared by both paged mixed hooks."""
+        p_tables = np.zeros((len(p_slots), self.pages_per_slot), np.int32)
+        for r in range(len(p_slots)):
+            if p_active[r]:
+                p_tables[r] = self.page_table[p_slots[r]]
+        return p_tables
+
+    def _step_spec(self, bufs, buf_lens, n_ctxs, next_toks, remainings,
+                   actives, temps, keys, tks, tps, rich, k: int,
+                   ngram: int, n_rounds: int):
+        (bufs, _, _, next_toks, produced, keys, accepts, lives,
+         self.pools) = _tick_spec(
+            self.params, bufs, self.pools, jnp.asarray(self.page_table),
+            buf_lens, n_ctxs, next_toks, remainings, actives, temps,
+            keys, tks, tps, self.cfg, k, ngram, n_rounds, rich,
+            mesh=self.mesh)
+        return bufs, produced, next_toks, keys, accepts, lives
+
+    def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
+                         p_last, bufs, buf_lens, n_ctxs, next_toks,
+                         remainings, actives, temps, keys, tks, tps,
+                         rich, chunk_len: int, k: int, ngram: int,
+                         n_rounds: int):
+        p_tables = self._prefill_tables(p_slots, p_active)
+        (sel, bufs, _, _, next_toks, produced, keys, accepts, lives,
+         self.pools) = _tick_mixed_spec(
+            self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
+            jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
+            jnp.asarray(self.page_table), bufs, buf_lens, n_ctxs,
+            next_toks, remainings, actives, temps, keys, tks, tps,
+            self.cfg, chunk_len, k, ngram, n_rounds, rich,
+            mesh=self.mesh)
+        return sel, bufs, produced, next_toks, keys, accepts, lives
 
     # ------------------------------------------------------------------
     def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,

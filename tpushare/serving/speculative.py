@@ -140,8 +140,8 @@ def propose_lookup(buf, buf_len, k: int, ngram: int):
 
     Returns ``(proposal [k], prop_len)`` — ``prop_len`` = how many
     proposal entries are real (0 when no earlier match).  One
-    definition shared by the single-request while_loop and the
-    continuous batcher's ``_tick_spec`` (which vmaps it), so a fix to
+    definition shared by the single-request while_loop and the batched
+    serving :func:`spec_scan` (which vmaps it per slot), so a fix to
     the lookup reaches both paths.
     """
     S = buf.shape[0]
@@ -160,6 +160,118 @@ def propose_lookup(buf, buf_len, k: int, ngram: int):
         has, jnp.clip(buf_len - (i_best + ngram), 0, k), 0)
     return proposal, prop_len
 
+
+
+# ---------------------------------------------------------------------------
+# The batched serving spec round (shared by every storage flavor)
+# ---------------------------------------------------------------------------
+def spec_scan(verify, sample, bufs, buf_lens, n_ctxs, next_toks,
+              remainings, actives, temps, keys, tks, tps, storage,
+              k: int, ngram: int, n_rounds: int, rich: bool):
+    """``n_rounds`` of batched prompt-lookup speculation as ONE traced
+    ``lax.scan`` — the round body shared by every storage flavor's
+    jitted spec program (``continuous._tick_spec`` /
+    ``_tick_mixed_spec`` and their paged twins), so the propose/verify/
+    accept/commit logic cannot drift between pools.
+
+    Per round, per slot: commit the pending known-correct token into
+    the slot's token buffer, propose the ``k`` tokens that followed the
+    most recent earlier occurrence of the trailing ``ngram``
+    (:func:`propose_lookup` over the slot's OWN history — GREEDY slots
+    only), verify pending+proposal in one ``[B, 1+k]`` forward via
+    ``verify(blocks, n_ctxs, live, storage) -> (logits, storage)``,
+    and accept the longest agreeing prefix.  SAMPLING slots ride the
+    same forward as plain decode rows: their proposal lanes are dead
+    weight the weight-bound forward absorbs (``prop_len`` forced 0, so
+    they never accept), their next token samples from the block's
+    position-0 logits — identical math to a fused decode step — and
+    their PRNG keys walk the same one-split-per-round chain the fused
+    scan performs, so interleaving spec rounds with plain ticks keeps
+    sampled streams bit-identical too.
+
+    Rejected proposal tokens are MASKED, never rewound: their K/V
+    writes stay in storage past the committed length (each ``verify``
+    is responsible for containing them — position masking on full-size
+    pools and page tables, eviction slack on rings; see DESIGN.md
+    "Speculation on paged pools") until the next round's block, which
+    starts at the committed length, rewrites them with real tokens —
+    append-only per committed position, which is what carries the int8
+    exact-self-consistency contract over to speculation.
+
+    Returns (bufs, buf_lens, n_ctxs, next_toks, produced, keys,
+    accepts [n_rounds, B], spec_lives [n_rounds, B], storage):
+    ``produced[i]`` counts tokens committed into row i's buf;
+    ``accepts``/``spec_lives`` feed the per-round accept-depth
+    histogram (a live greedy row's accepted count per round).
+    """
+    B = bufs.shape[0]
+    rows = jnp.arange(B)
+    greedy_rows = temps <= 0.0
+
+    def round_(st, _):
+        bufs, buf_lens, n_ctxs, next_toks, produced, keys, storage = st
+        live = actives & (produced < remainings)             # [B] bool
+        # -- commit the pending token ------------------------------
+        upd = jax.vmap(lambda b, t, p: jax.lax.dynamic_update_slice(
+            b, t[None], (p,)))
+        bufs = jnp.where(live[:, None],
+                         upd(bufs, next_toks, buf_lens), bufs)
+        buf_lens = buf_lens + live
+        produced = produced + live
+        rem_after = remainings - produced                    # [B]
+
+        # -- propose from each row's own history (the ONE lookup
+        # definition, vmapped) -------------------------------------
+        proposals, prop_lens = jax.vmap(
+            propose_lookup, in_axes=(0, 0, None, None))(
+                bufs, buf_lens, k, ngram)                    # [B,k],[B]
+        # sampling rows never accept: zero proposal length keeps their
+        # round a plain decode step riding the same dispatch
+        prop_lens = jnp.where(greedy_rows, prop_lens, 0)
+
+        # -- verify pending + proposal in one forward --------------
+        blocks = jnp.concatenate([next_toks[:, None], proposals], axis=1)
+        logits, storage = verify(blocks, n_ctxs, live, storage)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,1+k]
+
+        # -- sampling rows: the block's position-0 logits ARE the
+        # decode step; one key split per round, the same deterministic
+        # chain the fused decode scan walks -------------------------
+        ks = jax.vmap(jax.random.split)(keys)            # [B,2]: next,sub
+        sampled = sample(logits[:, 0], temps, ks[:, 1],
+                         tks if rich else None, tps if rich else None)
+
+        # -- longest agreeing prefix, bounded per row --------------
+        agree = ((proposals == greedy[:, :k])
+                 & (jnp.arange(k)[None, :] < prop_lens[:, None]))
+        n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                        axis=1)
+        n_acc = jnp.clip(n_acc, 0, jnp.maximum(rem_after, 0))
+        n_acc = jnp.where(live & greedy_rows, n_acc, 0)
+        # append accepted proposals (the garbage tail beyond n_acc sits
+        # past buf_len and is overwritten before it is ever read)
+        bufs = jnp.where(live[:, None],
+                         jax.vmap(lambda b, pr, p:
+                                  jax.lax.dynamic_update_slice(
+                                      b, pr, (p,)))(bufs, proposals,
+                                                    buf_lens),
+                         bufs)
+        buf_lens = buf_lens + n_acc
+        produced = produced + n_acc
+        n_ctxs = n_ctxs + (1 + n_acc) * live
+        nxt = jnp.where(greedy_rows, greedy[rows, n_acc], sampled)
+        next_toks = jnp.where(live, nxt, next_toks)
+        return ((bufs, buf_lens, n_ctxs, next_toks, produced, ks[:, 0],
+                 storage),
+                (n_acc, live & greedy_rows))
+
+    produced0 = jnp.zeros((B,), jnp.int32)
+    (bufs, buf_lens, n_ctxs, next_toks, produced, keys, storage), \
+        (accepts, spec_lives) = jax.lax.scan(
+            round_, (bufs, buf_lens, n_ctxs, next_toks, produced0, keys,
+                     storage), None, length=n_rounds)
+    return (bufs, buf_lens, n_ctxs, next_toks, produced, keys, accepts,
+            spec_lives, storage)
 
 
 @functools.lru_cache(maxsize=8)
