@@ -329,6 +329,170 @@ def spec_paged_bench(params, cfg, *, page_size, slots, prompt_len, gen,
     return out
 
 
+def _simulate_dispatch_cost(service, rpc_s: float) -> None:
+    """Wrap every device-dispatch hook of ``service``'s batcher with a
+    constant ``rpc_s`` sleep — the in-process stand-in for the ~70 ms
+    tunnel RPC every dispatch pays in production (CLAUDE.md).  The
+    sleep releases the GIL, so N replica service loops overlap exactly
+    the way N co-tenant processes' tunnel waits do — which is the
+    resource the fleet router multiplies.  Single-device CPU dispatch
+    (async, sub-ms) cannot represent that; the tp-mesh proxy the other
+    scenarios use cannot either, because N in-process replicas would
+    contend for the same virtual devices."""
+    b = service._batcher
+    for hook in ("_step", "_step_n", "_step_mixed", "_step_spec",
+                 "_prefill_chunk_into"):
+        real = getattr(b, hook, None)
+        if real is None:
+            continue
+
+        def delayed(*a, _real=real, **k):
+            time.sleep(rpc_s)
+            return _real(*a, **k)
+
+        setattr(b, hook, delayed)
+
+
+def router_fleet_bench(params, cfg, *, fleet_sizes=(1, 2), slots,
+                       n_reqs, prompt_len, gen, sim_rpc_s,
+                       n_clients=8, prefix_block=8,
+                       affinity_reqs=16, shared_prefix_len=16):
+    """Aggregate /generate throughput through the fleet router at each
+    fleet size, on the simulated-dispatch-cost proxy (see
+    :func:`_simulate_dispatch_cost`), plus a prefix-affinity arm.
+
+    Scaling arms drive DISTINCT prompts (every request its own prefix,
+    so routing is pure load policy and the fleet shares the work);
+    the affinity arm drives shared-prefix traffic (one
+    ``shared_prefix_len``-token motif + a unique tail) through the
+    N=2 fleet and reports the measured affinity hit rate — the
+    traffic class where routing to the replica already holding the
+    prefix pages is the win.  All replicas share one params tree, so
+    streams are identical wherever a request lands (the re-dispatch
+    idempotence the router's retry safety argument rests on) and the
+    jit cache warms once for the whole fleet.
+
+    Importable so a test can smoke-run it at tiny sizes
+    (tier-1-safe).  Returns {"per_fleet": {N: {tokens_per_s, dt}},
+    "affinity": {hits, requests, hit_rate}}.
+    """
+    import json as _json
+    import threading
+    import urllib.request
+
+    from tpushare.serving.llm import LLMServer
+    from tpushare.serving.router import FleetRouter
+
+    def build_fleet(n):
+        servers = []
+        for _ in range(n):
+            srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                            n_slots=slots).start()
+            _simulate_dispatch_cost(srv._service, sim_rpc_s)
+            servers.append(srv)
+        # generous scrape timeout: in-process replicas answer /healthz
+        # through the same GIL the clients and dispatches contend for,
+        # and a spurious timeout eviction mid-drive would measure the
+        # proxy environment, not the router
+        router = FleetRouter(
+            [(f"r{i}", f"127.0.0.1:{s.port}")
+             for i, s in enumerate(servers)],
+            port=0, scrape_interval_s=0.25, scrape_timeout_s=10.0,
+            watch_poll_s=0.01, prefix_block=prefix_block).start()
+        return servers, router
+
+    def drive(router, prompts):
+        """POST every prompt through ``n_clients`` concurrent client
+        threads; returns (wall seconds, responses)."""
+        todo = list(enumerate(prompts))
+        results = [None] * len(prompts)
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    if not todo:
+                        return
+                    i, prompt = todo.pop(0)
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{router.port}/generate",
+                    data=_json.dumps({"tokens": [prompt],
+                                      "max_new_tokens": gen}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                # bounded client-side retry, like a real client: a
+                # transient 503 (every replica momentarily evicted
+                # under a GIL burst) must not silently kill this
+                # worker thread and strand the drive
+                for attempt in range(5):
+                    try:
+                        with urllib.request.urlopen(
+                                req, timeout=600) as resp:
+                            results[i] = _json.loads(resp.read())
+                        break
+                    except Exception:
+                        if attempt == 4:
+                            raise
+                        time.sleep(0.25)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert all(r is not None and len(r["tokens"][0]) ==
+                   len(prompts[0]) + gen for r in results), \
+            "fleet drive did not complete every request"
+        return dt, results
+
+    def distinct_prompts(n, salt):
+        # every request its own FIRST prefix block: the two lead
+        # tokens encode (salt, i) uniquely (i < 50*50) so no two
+        # prompts — and no warm-vs-timed pair, salts differ — share a
+        # block, and the affinity map never captures this traffic
+        # (the scaling arms must measure the PURE load policy)
+        assert n <= 50 * 50
+        return [[salt, 1 + (i % 50), 2 + (i // 50)]
+                + [2 + ((i + j) % 50) for j in range(prompt_len - 3)]
+                for i in range(n)]
+
+    out = {"per_fleet": {}}
+    for n in fleet_sizes:
+        servers, router = build_fleet(n)
+        try:
+            drive(router, distinct_prompts(n * slots, salt=60))  # warm
+            dt, _ = drive(router, distinct_prompts(n_reqs, salt=61))
+            out["per_fleet"][n] = {
+                "tokens_per_s": n_reqs * gen / dt,
+                "dt_s": round(dt, 3),
+            }
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    # affinity arm: shared-prefix traffic over N=2 (the hit-rate win;
+    # throughput is not the point here — one replica owns the prefix)
+    servers, router = build_fleet(2)
+    try:
+        shared = [3 + (j % 5) for j in range(shared_prefix_len)]
+        prompts = [shared + [7 + (i % 40)] for i in range(affinity_reqs)]
+        drive(router, prompts)
+        hits = sum(r.affinity_hits for r in router._replicas)
+        reqs = sum(r.requests for r in router._replicas)
+        out["affinity"] = {"hits": hits, "requests": reqs,
+                           "hit_rate": round(hits / reqs, 3)
+                           if reqs else None}
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    return out
+
+
 def main() -> int:
     import os
     import sys
@@ -819,6 +983,58 @@ def main() -> int:
         _emit("train_steps_per_s_layer_remat", n / dt_l, "steps/s",
               platform=platform, tokens_per_step=tokens_per_step,
               remat="layer", vs_none=round(dt / dt_l, 3), **extra_l)
+
+    # 5. FLEET ROUTER (round 15): aggregate /generate throughput over
+    # N in-process LLM-server replicas behind tpushare-router, on the
+    # simulated per-dispatch tunnel-RPC proxy (each replica's dispatch
+    # hooks sleep the RPC constant and release the GIL — the resource
+    # N co-tenant replicas genuinely overlap; see COTENANCY_r04 for
+    # the chip-side proof at 4.46x solo aggregate).  CPU only: running
+    # several in-process replicas against the real tunnel would
+    # serialize on it and measure nothing.  Distinct-prompt traffic
+    # for the scaling arms (pure load routing); shared-prefix traffic
+    # for the affinity hit-rate arm.  LAST on purpose, record emitted
+    # BEFORE the acceptance asserts: a noisy-box failure here must not
+    # cost the sweep any other record.
+    if not on_tpu:
+        # near-minimal model on purpose: the proxy must be DISPATCH-
+        # bound (the 70 ms sleep = the real tunnel constant), and a
+        # bigger forward would re-serialize the replicas on the shared
+        # XLA CPU thread pool — an artifact N real processes on N
+        # chip-shares do not have (Amdahl: at tiny()-size compute the
+        # N=2 aggregate capped at ~1.73x for exactly that reason)
+        rcfg = transformer.ModelConfig(vocab=64, d_model=32, n_layers=1,
+                                       n_heads=2, n_kv_heads=2, d_ff=64,
+                                       max_seq=96)
+        rparams = transformer.init_params(jax.random.PRNGKey(11), rcfg)
+        rf = router_fleet_bench(
+            rparams, rcfg, fleet_sizes=(1, 2, 4), slots=4,
+            n_reqs=64, prompt_len=8, gen=33, sim_rpc_s=0.07,
+            n_clients=24, prefix_block=4, affinity_reqs=16,
+            shared_prefix_len=12)
+        single = rf["per_fleet"][1]["tokens_per_s"]
+        duo = rf["per_fleet"][2]["tokens_per_s"]
+        quad = rf["per_fleet"].get(4, {}).get("tokens_per_s")
+        vs_single = round(duo / single, 3)
+        _emit("router_fleet_tokens_per_s", duo, "tokens/s",
+              platform=platform, replicas=2, slots=4,
+              sim_rpc_ms=70, vs_single=vs_single,
+              single_tokens_per_s=round(single, 2),
+              quad_tokens_per_s=round(quad, 2) if quad else None,
+              vs_single_quad=round(quad / single, 3) if quad else None,
+              affinity_hit_rate=rf["affinity"]["hit_rate"],
+              affinity_hits=rf["affinity"]["hits"],
+              note="aggregate /generate through tpushare-router over "
+                   "in-process replicas; per-dispatch tunnel RPC "
+                   "simulated (GIL-releasing sleep) — dispatch-"
+                   "parallelism proxy, chip-side aggregate lives in "
+                   "COTENANCY_r04")
+        # the acceptance bar: a front door that cannot keep two
+        # replicas nearly fully busy is routing, not multiplying
+        assert vs_single >= 1.8, \
+            f"fleet N=2 aggregate only {vs_single}x single"
+        assert (rf["affinity"]["hits"] or 0) > 0, \
+            "shared-prompt traffic produced no affinity hits"
     return 0
 
 

@@ -1,0 +1,181 @@
+"""Scriptable fake LLM-server replica for router/inspect/health tests.
+
+Speaks the slice of the ``tpushare-llm-server`` surface the fleet
+router (and ``kubectl inspect tpushare``) consume — ``/generate``,
+``/healthz``, ``/metrics``, ``/drain`` — with every behavior
+injectable from the test:
+
+* ``set_load(...)`` scripts the scraped serving metrics (prefill queue
+  depth, batch occupancy, TTFT p99) through a REAL private
+  :class:`~tpushare.telemetry.registry.Registry`, so the router's
+  parse + distill path runs for real instead of against canned text;
+* ``set_wedged(True)`` makes ``/healthz`` answer 503 with a wedged
+  body (the health-plane contract: non-200 exactly when WEDGED);
+* ``latency_s`` delays each ``/generate``; ``stall()`` blocks
+  ``/generate`` until ``release()`` (the mid-stream eviction drill:
+  a request in flight on a replica that then wedges);
+* ``/generate`` answers DETERMINISTICALLY from the prompt alone
+  (token ``i`` of the generation is ``(sum(prompt) + i) % vocab``), so
+  a request re-dispatched to any other fake completes with the same
+  tokens — the re-dispatch correctness check costs one equality.
+
+Loopback only, like every fake in this tree.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from tpushare.telemetry.registry import Registry
+from tpushare.utils.httpserver import JsonHTTPServer
+
+
+def expected_tokens(prompt: List[int], max_new: int,
+                    vocab: int = 50) -> List[int]:
+    """The row every fake answers for ``prompt`` — tests compare
+    router output against this."""
+    base = sum(prompt)
+    return list(prompt) + [(base + i) % vocab for i in range(max_new)]
+
+
+class FakeReplica:
+    """One scriptable replica server; ``.url``/``.address`` point at it."""
+
+    def __init__(self, name: str = "r0", vocab: int = 50,
+                 latency_s: float = 0.0):
+        self.name = name
+        self.vocab = vocab
+        self.latency_s = latency_s
+        self.wedged = False
+        self.draining = False
+        self.generate_calls: List[dict] = []   # every /generate body
+        self.drain_calls = 0
+        self.undrain_calls = 0
+        #: scripted (status, body) every /generate answers instead of
+        #: tokens — e.g. (500, {"Error": "boom"}) for the poison-
+        #: request drill; None = normal deterministic generation
+        self.generate_error = None
+        self._stall = threading.Event()        # set = /generate blocks
+        self._release = threading.Event()
+        self._lock = threading.Lock()
+        # a private registry: the fake's /metrics is a real Prometheus
+        # exposition rendered from real gauge/histogram primitives
+        self._registry = Registry()
+        self._qps = self._registry.gauge(
+            "tpushare_engine_qps", "fake qps")
+        self._occupancy = self._registry.gauge(
+            "tpushare_batch_occupancy", "fake occupancy")
+        self._prefill_q = self._registry.gauge(
+            "tpushare_prefill_queue_depth", "fake prefill queue")
+        self._ttft = self._registry.histogram(
+            "tpushare_engine_ttft_seconds", "fake ttft")
+        self._health_state = self._registry.gauge(
+            "tpushare_backend_health_state", "fake health state",
+            labels=("state",))
+        self.set_load()
+        self.set_wedged(False)             # seed the ok one-hot
+        self._http = JsonHTTPServer(0, "127.0.0.1", routes={
+            ("POST", "/generate"): self._generate,
+            ("POST", "/drain"): self._drain,
+            ("GET", "/healthz"): self._healthz,
+            ("GET", "/metrics"): self._metrics,
+        })
+        self.port = self._http.port
+        self.address = f"127.0.0.1:{self.port}"
+        self.url = f"http://{self.address}"
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "FakeReplica":
+        self._http.start()
+        return self
+
+    def stop(self) -> None:
+        self.release()                     # unblock any stalled handler
+        self._http.stop()
+
+    # -- scripting -----------------------------------------------------
+    def set_load(self, prefill_queue: float = 0.0, occupancy: float = 0.0,
+                 ttft_p99_s: float = 0.0, qps: float = 0.0) -> None:
+        """Script what the router's next scrape distills from /metrics."""
+        self._prefill_q.set(prefill_queue)
+        self._occupancy.set(occupancy)
+        self._qps.set(qps)
+        self._ttft.clear()
+        if ttft_p99_s:
+            self._ttft.observe(ttft_p99_s)
+
+    def set_wedged(self, wedged: bool = True) -> None:
+        self.wedged = wedged
+        for state in ("ok", "degraded", "wedged", "cpu_fallback"):
+            self._health_state.set(
+                1.0 if state == ("wedged" if wedged else "ok") else 0.0,
+                state=state)
+
+    def stall(self) -> None:
+        """Make the NEXT /generate calls block until :meth:`release`
+        (in-flight forwards hang like a wedged tunnel fetch would)."""
+        self._release.clear()
+        self._stall.set()
+
+    def release(self) -> None:
+        """Unblock stalled /generate handlers (they complete normally —
+        the abandoned-worker-finishes-late case)."""
+        self._stall.clear()
+        self._release.set()
+
+    # -- routes --------------------------------------------------------
+    def _generate(self, body):
+        with self._lock:
+            self.generate_calls.append(body)
+        if self.generate_error is not None:
+            return self.generate_error
+        if self.draining:
+            return 503, {"Error": "draining: not admitting new requests"}
+        if self._stall.is_set():
+            self._release.wait(timeout=60)   # bounded: a leaked stall
+            # must not hang the suite
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        tokens = body.get("tokens")
+        if not isinstance(tokens, list) or not tokens:
+            return 400, {"Error": "body must contain tokens: [[int, ...]]"}
+        max_new = int(body.get("max_new_tokens", 32))
+        return 200, {"tokens": [
+            expected_tokens([int(t) for t in row], max_new, self.vocab)
+            for row in tokens]}
+
+    def _drain(self, body=None):
+        if isinstance(body, dict) and body.get("undrain"):
+            with self._lock:
+                self.undrain_calls += 1
+            self.draining = False
+            return 200, {"draining": False, "inflight": 0,
+                         "drained": False}
+        with self._lock:
+            self.drain_calls += 1
+        self.draining = True
+        return 200, {"draining": True, "inflight": 0, "drained": True}
+
+    def _healthz(self, _body=None):
+        if self.wedged:
+            body = {"state": "wedged", "reason": "scripted",
+                    "stalled_dispatches": 1}
+            if self.draining:        # llm.py merges drain progress
+                body.update({"draining": True, "inflight": 0,
+                             "drained": True})
+            return 503, body
+        if self.draining:
+            # the llm.py contract: still 200 (draining is not WEDGED),
+            # body carries the drain progress
+            return 200, {"state": "ok", "draining": True,
+                         "inflight": 0, "drained": True}
+        return 200, "ok\n"
+
+    def _metrics(self, _body=None):
+        from tpushare.utils.httpserver import RawBody
+
+        from tpushare import telemetry
+        return 200, RawBody(self._registry.render(),
+                            telemetry.PROM_CONTENT_TYPE)

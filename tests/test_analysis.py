@@ -359,6 +359,34 @@ def test_rule_telemetry_lock():
                      "telemetry-lock")
 
 
+def test_rule_router_no_jax():
+    """The fleet router must stay stdlib-only, pre-jax importable: the
+    rule catches absolute jax imports AND relative imports of the
+    jax-heavy serving/model modules (resolved against the file's
+    package), while the stdlib + telemetry + inspect imports the
+    router actually needs stay legal — and the rule patrols ONLY the
+    router module."""
+    bad = ("import jax\n"
+           "from . import continuous\n"
+           "from ..models import transformer\n"
+           "from jax import numpy as jnp\n")
+    fs = _lint("tpushare/serving/router.py", bad, "router-no-jax")
+    assert [f.line for f in fs] == [1, 2, 3, 4]
+    ok = ("import json\n"
+          "from .. import telemetry\n"
+          "from ..inspect.metricsview import summarize_serving\n"
+          "from ..utils.httpserver import JsonHTTPServer\n"
+          "from . import metrics\n")
+    assert not _lint("tpushare/serving/router.py", ok, "router-no-jax")
+    # other serving modules import jax freely — the scope is the router
+    assert not _lint("tpushare/serving/continuous.py", bad,
+                     "router-no-jax")
+    # the committed router passes its own rule (belt and braces: the
+    # repo-wide CLI run covers this too)
+    assert not tpulint.run_rule("router-no-jax"), \
+        tpulint.format_findings(tpulint.run_rule("router-no-jax"))
+
+
 def test_run_rule_rejects_unknown_names():
     """A renamed rule cannot silently hollow out its pytest wrapper."""
     with pytest.raises(KeyError):

@@ -165,6 +165,71 @@ def test_inspect_metrics_json_and_unreachable(monkeypatch, capsys):
         srv.stop()
 
 
+def test_inspect_fleet_table_and_json_end_to_end(monkeypatch, capsys):
+    """ISSUE-10 acceptance: `kubectl inspect tpushare --fleet` renders
+    per-replica request-share/health/affinity-hits scraped from a LIVE
+    router's /metrics over live fake replicas, and `-o json` carries a
+    `fleet` key."""
+    import urllib.request
+
+    from fakes.replica import FakeReplica
+    from tpushare.serving.router import FleetRouter
+
+    r0 = FakeReplica("fa").start()
+    r1 = FakeReplica("fb").start()
+    router = FleetRouter([("fa", r0.address), ("fb", r1.address)],
+                         port=0, scrape_interval_s=30,
+                         watch_poll_s=0.02, prefix_block=4).start()
+    api = FakeApiServer().start()
+    try:
+        router.scrape_once()
+        prompt = [1, 2, 3, 4]
+        for tail in ([], [5]):             # shared prefix: 1 affinity hit
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/generate",
+                data=json.dumps({"tokens": [prompt + tail],
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30):
+                pass
+        api.nodes["node-a"] = make_node("node-a", ip="127.0.0.1")
+        rc = _run_inspect(monkeypatch, api,
+                          ["--fleet", "--metrics-port",
+                           str(router.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Fleet routing:" in out
+        fleet_view = out.split("Fleet routing:", 1)[1]
+        assert "AFFINITY HITS" in fleet_view and "RETRIES" in fleet_view
+        assert "fa" in fleet_view and "fb" in fleet_view
+        assert "UP" in fleet_view
+
+        rc = _run_inspect(monkeypatch, api,
+                          ["-o", "json", "--fleet", "--metrics-port",
+                           str(router.port)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        fleet = {n["name"]: n.get("fleet")
+                 for n in doc["nodes"]}["node-a"]
+        replicas = fleet["replicas"]
+        # the registry is process-global: earlier router tests' replica
+        # names may ride along — assert on THIS fleet's replicas only
+        assert {"fa", "fb"} <= set(replicas)
+        mine = [replicas["fa"], replicas["fb"]]
+        assert all(r["up"] for r in mine)
+        assert sum(r.get("requests", 0) for r in mine) >= 2
+        assert sum(r.get("affinity_hits", 0) for r in mine) >= 1
+        shares = [r["share"] for r in replicas.values()
+                  if r.get("share") is not None]
+        assert abs(sum(shares) - 1.0) < 1e-6
+    finally:
+        api.stop()
+        router.stop()
+        r0.stop()
+        r1.stop()
+
+
 def _fetch_local_only(port):
     """Fetch 127.0.0.1 for real; fail fast for any other address (the
     dead-node case) instead of waiting out a TCP timeout on a

@@ -1,6 +1,7 @@
 """LLM serving endpoint over real HTTP on the tiny model."""
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -138,6 +139,145 @@ def test_generate_stream_ndjson_over_http():
         assert code == 400 and "one row" in err["Error"]
     finally:
         srv.stop()
+
+
+def test_drain_stops_admission_and_reports_in_healthz():
+    """POST /drain (ISSUE-10 satellite): admission stops with a 503 —
+    the refusal the fleet router re-dispatches on — while in-flight
+    requests run to completion, and /healthz reports draining/drained
+    so a rolling restart knows when the process is safe to stop."""
+    import threading
+
+    cfg, params = build_model("tiny", quantize_int8=False)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                    n_slots=2).start()
+    try:
+        # warm the decode programs, then SLOW each fused dispatch so
+        # the straddling request deterministically outlives the drain
+        # checks below (a warm tiny-model request otherwise finishes
+        # in the microseconds between two HTTP calls)
+        _post(srv, "/generate", {"tokens": [[9, 9]],
+                                 "max_new_tokens": 9})
+        batcher = srv._service._batcher
+        real_step_n = batcher._step_n
+
+        def slowed(*a, **k):
+            time.sleep(0.3)
+            return real_step_n(*a, **k)
+
+        batcher._step_n = slowed
+
+        # an in-flight request straddles the drain: admitted before,
+        # must complete after
+        res = {}
+
+        def client():
+            res["out"] = _post(srv, "/generate",
+                               {"tokens": [[1, 2, 3]],
+                                "max_new_tokens": 24})
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.4)                    # surely admitted, mid-decode
+
+        out = _post(srv, "/drain", {})
+        assert out["draining"] is True
+
+        # new admissions refused on every admitting endpoint
+        for path, payload in (
+                ("/generate", {"tokens": [[1, 2]], "max_new_tokens": 2}),
+                ("/generate_stream", {"tokens": [[1, 2]],
+                                      "max_new_tokens": 2}),
+                ("/score", {"tokens": [[1, 2, 3]]})):
+            code, err = _post_err(srv, path, payload)
+            assert code == 503 and "draining" in err["Error"], (path, err)
+
+        # the straddling request is still in flight: healthz says so
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+        assert hz["draining"] is True and hz["drained"] is False
+        assert hz["inflight"] >= 1
+
+        # ...and completes with its full token row
+        t.join(timeout=60)
+        assert not t.is_alive(), "in-flight request did not finish"
+        assert len(res["out"]["tokens"][0]) == 3 + 24
+
+        # drained once nothing is left anywhere (poll: the service
+        # loop's completion drain runs on its own thread)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz",
+                    timeout=10) as r:
+                hz = json.loads(r.read())
+            if hz["drained"]:
+                break
+            time.sleep(0.1)
+        assert hz["drained"] is True and hz["inflight"] == 0
+
+        # drains are REVERSIBLE: {"undrain": true} re-admits (what the
+        # router posts when a replica it drained recovers)
+        out = _post(srv, "/drain", {"undrain": True})
+        assert out["draining"] is False
+        batcher._step_n = real_step_n      # full speed again
+        out = _post(srv, "/generate",
+                    {"tokens": [[5, 6]], "max_new_tokens": 2})
+        assert len(out["tokens"][0]) == 4
+    finally:
+        srv.stop()
+
+
+def test_stream_closed_before_iteration_does_not_leak_inflight():
+    """A streaming client gone before the first chunk (the httpserver
+    closes the body without ever iterating it) must still release the
+    in-flight count — a leak here pins /healthz at drained:false
+    forever and the deploy preStop then always waits out its timeout."""
+    cfg, params = build_model("tiny", quantize_int8=False)
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                    n_slots=2).start()
+    try:
+        code, payload = srv._generate_stream(
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 4})
+        assert code == 200
+        assert srv._inflight == 1
+        payload.chunks.close()             # never iterated
+        assert srv._inflight == 0
+        # ...and a normally-consumed stream balances too
+        code, payload = srv._generate_stream(
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 4})
+        list(payload.chunks)
+        payload.chunks.close()             # idempotent second release
+        assert srv._inflight == 0
+    finally:
+        srv.stop()
+
+
+def test_counted_chunks_releases_even_when_inner_close_raises():
+    """The in-flight release must survive a raising inner cleanup
+    (e.g. cancel during concurrent shutdown) — a swallowed release
+    would pin /healthz at drained:false forever."""
+    from tpushare.serving.llm import _CountedChunks
+
+    released = []
+
+    def inner():
+        try:
+            yield b"x"
+        finally:
+            raise RuntimeError("cancel blew up")
+
+    wrapped = _CountedChunks(inner(), lambda: released.append(1))
+    it = iter(wrapped)
+    assert next(it) == b"x"
+    try:
+        wrapped.close()
+    except RuntimeError:
+        pass
+    assert released == [1]
+    wrapped.close()                        # idempotent
+    assert released == [1]
 
 
 def test_score_endpoint_matches_forward(server):

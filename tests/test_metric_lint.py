@@ -175,7 +175,11 @@ def test_tenant_accounting_series_registered_with_contracted_names():
 #: seqs, and other per-request values are BANNED as labels (unbounded
 #: cardinality kills Prometheus); they ride flight-recorder events.
 ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
-                       "kv_dtype", "attn_kernel", "reason"}
+                       "kv_dtype", "attn_kernel", "reason",
+                       # fleet router: replica names come from the
+                       # router's CLI config (fleet-bounded), never
+                       # from request content; policy is enumerated
+                       "replica", "policy"}
 FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
                          "id"}
 #: label names whose VALUES are enumerated per family (one-hot states,
@@ -197,6 +201,9 @@ ENUMERATED_VALUES = {
     # below)
     ("tpushare_spec_fallback_total", "reason"):
         {"ring_margin", "sampling_only"},
+    # keep in sync with router.ROUTER_POLICIES (asserted below)
+    ("tpushare_router_requests_total", "policy"):
+        {"affinity", "load", "retry"},
 }
 
 
@@ -215,6 +222,28 @@ def test_spec_fallback_reason_enum_matches_constant():
     from tpushare.serving.continuous import SPEC_FALLBACK_REASONS
     assert set(SPEC_FALLBACK_REASONS) == ENUMERATED_VALUES[
         ("tpushare_spec_fallback_total", "reason")]
+
+
+def test_router_policy_enum_matches_constant():
+    """The fleet router's policy labels and the lint enum are one set —
+    a new routing policy without a deliberate enum entry here would
+    observe an un-enumerated label value."""
+    from tpushare.serving.router import ROUTER_POLICIES
+    assert set(ROUTER_POLICIES) == ENUMERATED_VALUES[
+        ("tpushare_router_requests_total", "policy")]
+
+
+def test_router_series_registered_with_contracted_names():
+    """The fleet-routing series exist under their contracted names and
+    kinds (what `kubectl inspect tpushare --fleet` and the router
+    dashboards key on)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_router_requests_total") == "counter"
+    assert by_name.get("tpushare_router_retries_total") == "counter"
+    assert by_name.get(
+        "tpushare_router_affinity_hits_total") == "counter"
+    assert by_name.get("tpushare_router_evictions_total") == "counter"
+    assert by_name.get("tpushare_router_replica_up") == "gauge"
 
 
 def _observed_label_sets():

@@ -354,6 +354,70 @@ def _subprocess_env_scrub(ctx: Context):
             f"tunnel when PALLAS_AXON_POOL_IPS is set")
 
 
+#: modules the pre-jax-importable layer must never import: jax itself
+#: and the jax-heavy tpushare modules whose import initializes a
+#: backend (prefix match, so ``jax.numpy`` and ``tpushare.models.
+#: transformer`` are caught through their roots)
+_JAX_HEAVY_PREFIXES = (
+    "jax", "jaxlib",
+    "tpushare.models", "tpushare.ops", "tpushare.parallel",
+    "tpushare.runtime",
+    "tpushare.serving.engine", "tpushare.serving.continuous",
+    "tpushare.serving.paged", "tpushare.serving.generate",
+    "tpushare.serving.speculative", "tpushare.serving.llm",
+    "tpushare.serving.score",
+)
+
+
+def _resolve_imports(ctx: Context, node: ast.AST):
+    """Absolute module names an import statement binds, resolving
+    relative ``from``-imports against the file's package path (so
+    ``from . import continuous`` inside tpushare/serving/ resolves to
+    ``tpushare.serving.continuous``)."""
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            yield a.name
+        return
+    if not isinstance(node, ast.ImportFrom):
+        return
+    if node.level:
+        pkg_parts = ctx.relpath.rsplit("/", 1)[0].split("/")
+        base_parts = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+        base = ".".join(base_parts)
+    else:
+        base = ""
+    module = node.module or ""
+    prefix = ".".join(p for p in (base, module) if p)
+    # both the module itself and each bound name can be a submodule
+    yield prefix
+    for a in node.names:
+        yield f"{prefix}.{a.name}" if prefix else a.name
+
+
+@rule(
+    "router-no-jax",
+    "The fleet router is the front door OUTSIDE every allocation: it "
+    "must stay stdlib-only and importable BEFORE jax (like "
+    "telemetry/health.py).  An ``import jax`` — or an import of a "
+    "jax-heavy tpushare module — in its import graph would dial the "
+    "TPU tunnel / initialize a backend in the routing process, which "
+    "owns no chip and must keep routing through a backend outage.",
+    lambda p: p == "tpushare/serving/router.py",
+    "tpushare/serving/router.py")
+def _router_no_jax(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        for mod in _resolve_imports(ctx, node):
+            if any(mod == p or mod.startswith(p + ".")
+                   for p in _JAX_HEAVY_PREFIXES):
+                yield node.lineno, (
+                    f"router imports jax-heavy module {mod!r} — the "
+                    f"front door must stay stdlib-only, pre-jax "
+                    f"importable (`{ctx.quote(node.lineno)}`)")
+                break
+
+
 #: the process-global telemetry singletons whose internals are
 #: lock-guarded
 _TELEMETRY_GLOBALS = frozenset({"MONITOR", "RECORDER", "REGISTRY"})

@@ -68,6 +68,12 @@ def main(argv=None) -> int:
                          "per-tenant accounting table (device-time share "
                          "vs HBM-fraction entitlement, Jain fairness "
                          "index, overshoot flags)")
+    ap.add_argument("-f", "--fleet", action="store_true",
+                    help="also fetch each node's /metrics and render the "
+                         "fleet-routing table (per-replica health/"
+                         "request-share/affinity-hits/evictions from a "
+                         "tpushare-router's exposition; include the "
+                         "router's port in --metrics-port)")
     ap.add_argument("--metrics-port",
                     default=str(metricsview.DEFAULT_METRICS_PORT),
                     help="comma-separated port(s) of per-node /metrics "
@@ -92,6 +98,9 @@ def main(argv=None) -> int:
     tenant_rows = (metricsview.gather_tenant_rows(infos,
                                                   args.metrics_port)
                    if args.tenants else None)
+    fleet_rows = (metricsview.gather_fleet_rows(infos,
+                                                args.metrics_port)
+                  if args.fleet else None)
     if args.output == "json":
         import json
 
@@ -137,6 +146,16 @@ def main(argv=None) -> int:
             for entry in out["nodes"]:
                 if entry["name"] in by_name:
                     entry["tenants"] = by_name[entry["name"]]
+        if fleet_rows is not None:
+            # the fleet-routing view: per-replica health/share/affinity
+            # from the router's exposition; dead nodes carry the
+            # uniform error key
+            by_name = {name: (summary if summary is not None
+                              else {"error": err, "replicas": {}})
+                       for name, _, summary, err in fleet_rows}
+            for entry in out["nodes"]:
+                if entry["name"] in by_name:
+                    entry["fleet"] = by_name[entry["name"]]
         json.dump(out, sys.stdout, indent=2)
         print()
         return 0
@@ -148,6 +167,9 @@ def main(argv=None) -> int:
     if tenant_rows is not None:
         sys.stdout.write("\n")
         sys.stdout.write(metricsview.render_tenants_table(tenant_rows))
+    if fleet_rows is not None:
+        sys.stdout.write("\n")
+        sys.stdout.write(metricsview.render_fleet_table(fleet_rows))
     return 0
 
 

@@ -196,6 +196,52 @@ def summarize_tenants(parsed: dict) -> dict:
     }
 
 
+def summarize_fleet(parsed: dict) -> dict:
+    """The fleet-routing view one exposition distills to — the scrape-
+    side mirror of the router's ``/fleet`` JSON: per-replica forwarded
+    requests (and the share of the fleet total), affinity hits,
+    evictions, and the router-side up/evicted verdict, plus the
+    router-wide re-dispatch count.  ``{}``-replica result means the
+    scraped endpoints include no router (no ``tpushare_router_*``
+    series)."""
+    replicas: Dict[str, dict] = {}
+
+    def fold(series: str, key: str):
+        for labels, value in parsed["samples"].get(series, ()):
+            name = labels.get("replica")
+            if name is not None:
+                r = replicas.setdefault(name, {})
+                r[key] = r.get(key, 0.0) + value
+
+    fold("tpushare_router_requests_total", "requests")
+    fold("tpushare_router_affinity_hits_total", "affinity_hits")
+    fold("tpushare_router_evictions_total", "evictions")
+    for labels, value in parsed["samples"].get(
+            "tpushare_router_replica_up", ()):
+        name = labels.get("replica")
+        if name is not None:
+            replicas.setdefault(name, {})["up"] = bool(value)
+    total = sum(r.get("requests", 0.0) for r in replicas.values())
+    for r in replicas.values():
+        r["share"] = (r.get("requests", 0.0) / total) if total else None
+    retries = parsed["samples"].get("tpushare_router_retries_total")
+    return {
+        "retries": retries[0][1] if retries else None,
+        "replicas": replicas,
+    }
+
+
+def gather_fleet_rows(infos, ports, timeout: float = 3.0
+                      ) -> List[Tuple[str, str, Optional[dict],
+                                      Optional[str]]]:
+    """One (node, address, fleet_summary|None, error|None) row per
+    sharing node — the same concurrent scrape-and-merge as
+    :func:`gather_metrics_rows`, distilled through
+    :func:`summarize_fleet` (pass the ROUTER's port in the port list;
+    daemon/workload expositions merge in harmlessly)."""
+    return _gather_rows(infos, ports, summarize_fleet, timeout)
+
+
 def _fmt(v, scale: float = 1.0, suffix: str = "",
          digits: int = 2) -> str:
     if v is None:
@@ -312,6 +358,45 @@ def render_tenants_table(
                 "+".join(flags) if flags else "ok",
             ])
     return "Tenant accounting:\n" + _table(table)
+
+
+def render_fleet_table(
+        rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
+    """``rows`` = [(node, address, fleet_summary|None, error|None)] —
+    one line per (node, replica) with the router-side health verdict,
+    forwarded-request share, affinity hits, and evictions; the node-
+    wide re-dispatch count rides the first row.  Nodes whose scrape
+    carried no router series render a placeholder row; dead nodes a
+    DOWN row."""
+    table = [["NAME", "REPLICA", "HEALTH", "REQUESTS", "SHARE",
+              "AFFINITY HITS", "EVICTIONS", "RETRIES"]]
+    for name, addr, summary, err in rows:
+        if summary is None:
+            table.append([name, "-", "DOWN", err or "unreachable",
+                          "-", "-", "-", "-"])
+            continue
+        replicas = summary["replicas"]
+        if not replicas:
+            table.append([name, "-", "-", "-", "-", "-", "-",
+                          "no router"])
+            continue
+        retries = summary.get("retries")
+        first = True
+        for rname in sorted(replicas):
+            r = replicas[rname]
+            up = r.get("up")
+            health = ("-" if up is None
+                      else ("UP" if up else "EVICTED"))
+            table.append([
+                name if first else "", rname, health,
+                _fmt(r.get("requests"), digits=0),
+                _fmt(r.get("share"), 100.0, "%", 0),
+                _fmt(r.get("affinity_hits"), digits=0),
+                _fmt(r.get("evictions"), digits=0),
+                (_fmt(retries, digits=0) if first else ""),
+            ])
+            first = False
+    return "Fleet routing:\n" + _table(table)
 
 
 def gather_tenant_rows(infos, ports, timeout: float = 3.0

@@ -1,3 +1,16 @@
-"""Batched inference serving under a tpushare allocation."""
+"""Batched inference serving under a tpushare allocation.
 
-from .engine import InferenceEngine, measure_qps  # noqa: F401
+The engine re-exports are LAZY (PEP 562): ``tpushare.serving`` is also
+the home of the stdlib-only fleet router (``router.py``), which must be
+importable before (and without) jax — an eager ``from .engine import
+...`` here would pull jax into every process that merely routes.
+"""
+
+__all__ = ["InferenceEngine", "measure_qps"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from . import engine
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

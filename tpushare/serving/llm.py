@@ -72,6 +72,41 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
     return cfg, params
 
 
+class _CountedChunks:
+    """Stream-body wrapper guaranteeing ``on_end`` fires EXACTLY once,
+    whether the stream is fully consumed, closed mid-iteration, or
+    closed before iteration ever starts (a generator closed un-started
+    never runs its own ``finally`` — the leak that would pin the
+    drain-progress in-flight counter forever)."""
+
+    def __init__(self, inner, on_end):
+        self._inner = inner
+        self._on_end = on_end
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._on_end()
+
+    def __iter__(self):
+        try:
+            for chunk in self._inner:
+                yield chunk
+        finally:
+            self._finish()
+
+    def close(self):
+        close = getattr(self._inner, "close", None)
+        try:
+            if close is not None:
+                close()
+        finally:
+            # even if the inner cleanup raises (e.g. cancel during a
+            # concurrent shutdown), the count MUST release
+            self._finish()
+
+
 class LLMServer:
     def __init__(self, cfg, params, port: int = 8000,
                  addr: str = "0.0.0.0",
@@ -100,7 +135,6 @@ class LLMServer:
         interleave."""
         from .. import telemetry
         from ..telemetry.events import debug_events_route
-        from ..telemetry.health import healthz_route
         from ..utils.httpserver import JsonHTTPServer, RawBody
 
         self.cfg = cfg
@@ -108,6 +142,17 @@ class LLMServer:
         self.default_max_new = default_max_new
         self._gen_lock = threading.Lock()   # decode caches are per-call;
         # serialize so co-tenant HBM stays bounded by one batch
+        # POST /drain flips this: stop ADMITTING (503 on generate/
+        # stream/score) while in-flight work runs to completion — the
+        # graceful half of a rolling restart, and what the fleet
+        # router's health eviction calls before dropping a replica.
+        self._draining = threading.Event()
+        self._inflight = 0                  # requests inside a handler
+        # its OWN lock: _gen_lock is held across whole device decodes
+        # (direct mode holds it for the full fused generation), and
+        # /drain + a draining /healthz must answer fast regardless —
+        # a scrape-timeout router would transport-evict a busy replica
+        self._inflight_lock = threading.Lock()
         self._service = None
         if tp > 1 and n_slots <= 0:
             # only the batcher path is mesh-aware; silently serving
@@ -145,9 +190,15 @@ class LLMServer:
             ("POST", "/generate"): self._generate,
             ("POST", "/generate_stream"): self._generate_stream,
             ("POST", "/score"): self._score,
+            # graceful drain: stop admitting, finish in-flight, report
+            # drained in the /healthz body (rolling restarts; the fleet
+            # router calls this on health eviction and undoes ITS
+            # drains with {"undrain": true} on recovery)
+            ("POST", "/drain"): self._drain,
             # health-plane view: non-200 exactly when the backend is
-            # WEDGED (a stalled dispatch past deadline / failed probe)
-            ("GET", "/healthz"): healthz_route,
+            # WEDGED (a stalled dispatch past deadline / failed probe);
+            # while draining the body carries draining/drained/inflight
+            ("GET", "/healthz"): self._healthz,
             ("GET", "/stats"): self._stats,
             # workload-side telemetry: the serving-plane series this
             # process recorded (engine/batcher/paged/spec), Prometheus
@@ -162,7 +213,83 @@ class LLMServer:
         })
         self.port = self._http.port
 
+    # -- drain plumbing ------------------------------------------------
+    def _begin_request(self):
+        """Admission gate shared by the request handlers: 503 while
+        draining (the router's eviction contract — refusals here are
+        what re-dispatch elsewhere), else count the request in-flight.
+        Returns the refusal response or None."""
+        with self._inflight_lock:
+            # check-and-increment atomically vs _drain's flag set (same
+            # lock): otherwise a request admitted between the check and
+            # the increment could be invisible to a drained:true
+            # /healthz and die with the pod
+            if self._draining.is_set():
+                return 503, {"Error": "draining: not admitting new "
+                                      "requests"}
+            self._inflight += 1
+        return None
+
+    def _end_request(self):
+        with self._inflight_lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def _drain_snapshot(self) -> dict:
+        """The drain-progress fields /drain and a draining /healthz
+        report: handler-level in-flight plus whatever the slot pool
+        still holds (a stream counts in BOTH until its batcher work and
+        its consumer finish — 'drained' means every view hit zero)."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        pending = inflight
+        if self._service is not None:
+            s = self._service.snapshot()
+            pending += s["active"] + s["prefilling"] + s["queued"]
+        return {"draining": self._draining.is_set(),
+                "inflight": inflight,
+                "drained": self._draining.is_set() and pending == 0}
+
+    def _drain(self, body=None):
+        """``{}`` drains; ``{"undrain": true}`` re-admits — drains must
+        be REVERSIBLE or a router-evicted replica that recovers would
+        503 forever (the fleet router undrains exactly the replicas it
+        drained; an operator's rolling-restart drain ends with the
+        process, so nothing else ever needs to undo it)."""
+        with self._inflight_lock:       # atomic vs _begin_request
+            if isinstance(body, dict) and body.get("undrain"):
+                was = self._draining.is_set()
+                self._draining.clear()
+                if was:
+                    log.info("undrained: admission re-opened")
+            else:
+                was = self._draining.is_set()
+                self._draining.set()
+                if not was:
+                    log.info("draining: admission stopped; in-flight "
+                             "requests run to completion")
+        return 200, self._drain_snapshot()
+
+    def _healthz(self, _body=None):
+        from ..telemetry.health import MONITOR
+        code, body = MONITOR.healthz()
+        if not self._draining.is_set():
+            return code, body
+        if isinstance(body, str):          # the bare-OK fast path
+            body = {"state": "ok"}
+        body = dict(body)
+        body.update(self._drain_snapshot())
+        return code, body
+
     def _generate(self, body):
+        refused = self._begin_request()
+        if refused is not None:
+            return refused
+        try:
+            return self._generate_impl(body)
+        finally:
+            self._end_request()
+
+    def _generate_impl(self, body):
         import jax
         import jax.numpy as jnp
 
@@ -301,6 +428,15 @@ class LLMServer:
         return f, None
 
     def _score(self, body):
+        refused = self._begin_request()
+        if refused is not None:
+            return refused
+        try:
+            return self._score_impl(body)
+        finally:
+            self._end_request()
+
+    def _score_impl(self, body):
         """Teacher-forced scoring: per-token log-probabilities of given
         sequences under the model — the eval-workload endpoint
         (perplexity, reranking, answer scoring).  One forward per
@@ -365,6 +501,27 @@ class LLMServer:
         return 200, {"scores": out}
 
     def _generate_stream(self, body):
+        from ..utils.httpserver import StreamingBody
+
+        refused = self._begin_request()
+        if refused is not None:
+            return refused
+        try:
+            code, payload = self._generate_stream_impl(body)
+        except BaseException:
+            self._end_request()            # a leak here would pin
+            raise                          # /healthz drained:false forever
+        if not isinstance(payload, StreamingBody):
+            self._end_request()            # refused before streaming
+            return code, payload
+        # the request stays in-flight until the stream ends — done,
+        # abort, client disconnect, or closed before the first chunk
+        # (the httpserver's finally calls .close() on every path)
+        payload.chunks = _CountedChunks(payload.chunks,
+                                        self._end_request)
+        return code, payload
+
+    def _generate_stream_impl(self, body):
         """NDJSON token streaming over the slot pool: one line per decode
         progress event — {"delta": [new tokens...]} as they are produced
         (chunk granularity under fused decode), then {"done": [full

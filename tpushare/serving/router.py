@@ -1,0 +1,690 @@
+"""``tpushare-router`` — the fleet front door over N LLM-server replicas.
+
+Everything through round 14 makes ONE ``ContinuousService`` fast; this
+process multiplies it: an HTTP router that spreads ``POST /generate``
+traffic over N ``tpushare-llm-server`` replicas (co-tenants on shared
+chips — COTENANCY_r04 measured quad tenants at 4.46x solo aggregate),
+using only the surfaces the replicas already serve (``/metrics``,
+``/healthz``, ``/drain``).  Three composable policies, applied in order:
+
+1. **Health eviction** (always on): the scrape loop probes every
+   replica's ``/healthz``; a non-200 answer (the WEDGED state — a
+   stalled dispatch past deadline), a wedged body, or repeated
+   transport failures DRAIN the replica from rotation (best-effort
+   ``POST /drain`` so it finishes what it holds and admits nothing
+   new).  A forward in flight to a replica that gets evicted is
+   ABANDONED (the worker thread is left to finish on its own — never
+   killed, the CLAUDE.md tunnel rule) and the request is re-dispatched
+   to another replica with a bounded retry budget
+   (``tpushare_router_retries_total``).  Re-dispatch is safe because
+   ``/generate`` is by construction idempotent — same prompt, seed,
+   and sampling knobs produce the same stream on every replica (shared
+   init seed), and the abandoned forward's late response is discarded,
+   so a client sees exactly one answer (DESIGN.md "Fleet routing").
+2. **Prefix-cache affinity** (``--no-affinity`` disables): the longest
+   committed prompt-prefix hash, at ``--prefix-block`` token
+   granularity, maps to the replica that last served that prefix — the
+   replica whose ``--prefix-cache`` pages already hold those tokens'
+   KV.  The affinity target is used only while live and unsaturated
+   (batch occupancy below ``--saturation``); otherwise the request
+   falls back to the load policy (fresh pages beat a queued hit).
+3. **Load-aware least-pending** (the fallback and the default): each
+   replica's scraped serving metrics distill (via the same
+   ``summarize_serving`` the inspect CLI uses) into a score of
+   router-side in-flight forwards + batch occupancy + prefill queue
+   depth + TTFT p99, with a FlexNPU-style prefill/decode split: a
+   prefill-heavy request (long prompt relative to its ``max_new``)
+   weights occupancy hardest — its prompt chunks would steal mixed-
+   round budget from replicas deep in decode — while a decode-heavy
+   request weights the prefill queue hardest (its tokens would wait
+   behind queued prompts).  Scrapes lag by ``--scrape-interval``; the
+   in-flight term is the router's own and keeps bursts from piling
+   onto the replica whose scrape happens to look idle.
+
+Stdlib-only, importable BEFORE jax, like ``telemetry/health.py`` — the
+router allocates no backend and must never dial the TPU tunnel
+(enforced: tpulint rule ``router-no-jax``).  Routing telemetry rides
+the process-global registry and renders on this process's ``/metrics``
+(``tpushare_router_*``; ``kubectl inspect tpushare --fleet`` scrapes
+it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from .. import telemetry
+# the ONE exposition distiller (inspect --metrics uses the same): the
+# router keys its load score on the identical fields the operator sees
+from ..inspect.metricsview import summarize_serving
+from ..utils.httpserver import JsonHTTPServer, RawBody
+from . import metrics
+
+log = logging.getLogger("tpushare.router")
+
+#: the policy label values tpushare_router_requests_total may carry
+#: (enum-linted in tests/test_metric_lint.py, like the fallback reasons)
+ROUTER_POLICIES = ("affinity", "load", "retry")
+
+#: longest prompt prefix the affinity hash considers, in blocks — a cap
+#: so hashing cost stays O(blocks * prefix), not O(len^2) on huge prompts
+MAX_AFFINITY_BLOCKS = 32
+
+
+class Replica:
+    """Router-side view of one LLM-server replica.
+
+    Mutable fields are guarded by the router's lock except ``inflight``
+    decrements, which the forward worker performs in its ``finally`` —
+    also under the router's lock (the worker may outlive an eviction;
+    its late decrement must not corrupt the count)."""
+
+    def __init__(self, name: str, address: str):
+        self.name = name
+        self.address = address            # "host:port"
+        self.summary: Optional[dict] = None   # last summarize_serving
+        self.evicted_reason: Optional[str] = None
+        self.inflight = 0                 # router-side pending forwards
+        self.consecutive_failures = 0
+        self.requests = 0                 # successful forwards
+        self.affinity_hits = 0
+        #: the ROUTER drained this replica (eviction): recovery must
+        #: undrain it, or it would 503 forever; an operator's own drain
+        #: (this flag unset) is never undone by the router
+        self.drain_sent = False
+        #: consecutive scrape passes observed healthy AND not draining
+        #: while drain_sent is set — after a grace pass the stale claim
+        #: clears (the replica restarted, or our drain never landed),
+        #: so a FUTURE operator drain cannot be mistaken for ours
+        self.clean_passes = 0
+
+    @property
+    def in_rotation(self) -> bool:
+        return self.evicted_reason is None
+
+    def view(self) -> dict:
+        """The /fleet JSON entry (point-in-time; lock held by caller)."""
+        return {"name": self.name, "address": self.address,
+                "up": self.in_rotation,
+                "evicted_reason": self.evicted_reason,
+                "inflight": self.inflight,
+                "requests": self.requests,
+                "affinity_hits": self.affinity_hits,
+                "summary": self.summary}
+
+
+class FleetRouter:
+    """HTTP front door spreading /generate over N replicas.
+
+    ``replicas``: "host:port" strings (names default ``r0..rN``) or
+    ``(name, "host:port")`` pairs.  ``port=0`` binds an ephemeral port
+    (tests); the CLI default is 8800.
+    """
+
+    def __init__(self, replicas: Sequence[Union[str, Tuple[str, str]]],
+                 port: int = 0, addr: str = "127.0.0.1", *,
+                 affinity: bool = True,
+                 prefix_block: int = 16,
+                 max_affinity_entries: int = 4096,
+                 scrape_interval_s: float = 2.0,
+                 scrape_timeout_s: float = 2.0,
+                 max_retries: int = 2,
+                 saturation: float = 0.95,
+                 request_timeout_s: float = 600.0,
+                 eviction_failures: int = 2,
+                 prefill_heavy_ratio: float = 2.0,
+                 watch_poll_s: float = 0.05):
+        self._replicas: List[Replica] = []
+        for i, spec in enumerate(replicas):
+            if isinstance(spec, str):
+                self._replicas.append(Replica(f"r{i}", spec))
+            else:
+                name, address = spec
+                self._replicas.append(Replica(name, address))
+        if not self._replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in self._replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self._affinity = bool(affinity)
+        self._prefix_block = max(1, int(prefix_block))
+        self._max_affinity_entries = int(max_affinity_entries)
+        #: prefix-block hash -> replica NAME, LRU-bounded (an evicted
+        #: entry just means one load-routed request re-warms the pages)
+        self._affinity_map: "OrderedDict[int, str]" = OrderedDict()
+        self._scrape_interval_s = float(scrape_interval_s)
+        self._scrape_timeout_s = float(scrape_timeout_s)
+        self._max_retries = max(0, int(max_retries))
+        self._saturation = float(saturation)
+        self._request_timeout_s = float(request_timeout_s)
+        self._eviction_failures = max(1, int(eviction_failures))
+        self._prefill_heavy_ratio = float(prefill_heavy_ratio)
+        self._watch_poll_s = float(watch_poll_s)
+        self._retries = 0
+        self._lock = threading.Lock()
+        self._halt = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+        # one persistent pool for the life of the router: the scrape
+        # loop fires every --scrape-interval forever, and rebuilding a
+        # pool per pass would churn up to 16 OS threads each time
+        self._scrape_pool = ThreadPoolExecutor(
+            max_workers=min(16, len(self._replicas)),
+            thread_name_prefix="tpushare-router-scrape")
+        for r in self._replicas:
+            metrics.ROUTER_REPLICA_UP.set(1.0, replica=r.name)
+        self._http = JsonHTTPServer(port, addr, routes={
+            ("POST", "/generate"): self._generate,
+            ("GET", "/healthz"): self._healthz,
+            ("GET", "/fleet"): self._fleet,
+            ("GET", "/metrics"): lambda _: (
+                200, RawBody(telemetry.REGISTRY.render(),
+                             telemetry.PROM_CONTENT_TYPE)),
+        })
+        self.port = self._http.port
+
+    # -- lifecycle -----------------------------------------------------
+    def _start_scrape(self) -> None:
+        if self._scrape_thread is None:
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop, daemon=True,
+                name="tpushare-router-scrape")
+            self._scrape_thread.start()
+
+    def start(self) -> "FleetRouter":
+        self._http.start()
+        self._start_scrape()
+        return self
+
+    def serve_forever(self) -> None:
+        self._start_scrape()
+        self._http.serve_forever()
+
+    def stop(self) -> None:
+        self._halt.set()
+        self._scrape_pool.shutdown(wait=False)
+        self._http.stop()
+
+    # -- scrape + health loop ------------------------------------------
+    def _scrape_loop(self) -> None:
+        self.scrape_once()       # initial verdict before first request
+        while not self._halt.wait(self._scrape_interval_s):
+            self.scrape_once()
+
+    def scrape_once(self) -> None:
+        """One health+metrics pass over the fleet.  Public so tests and
+        the bench drive the verdict deterministically (the loop calls
+        this too).  Replicas are probed CONCURRENTLY: one hung replica
+        must not delay the eviction verdict on the rest."""
+        try:
+            list(self._scrape_pool.map(self._scrape_replica,
+                                       self._replicas))
+        except RuntimeError:
+            pass                 # pool shut down mid-pass (stop())
+
+    def _scrape_replica(self, r: Replica) -> None:
+        ok, reason = self._probe_health(r)
+        if ok:
+            try:
+                text = self._get(r, "/metrics")
+                summary = summarize_serving(telemetry.parse_text(text))
+                with self._lock:
+                    r.summary = summary
+            except Exception as e:
+                # metrics failing while /healthz answers is odd but not
+                # an eviction by itself: route on the stale summary
+                log.debug("metrics scrape failed for %s: %s", r.name, e)
+            with self._lock:
+                # drain-claim hygiene: healthy AND not draining means
+                # our drain is no longer in effect (the replica
+                # restarted, or the POST never landed) — after TWO
+                # such passes (one pass of grace covers a drain POST
+                # still in flight) the stale claim clears, so a later
+                # OPERATOR drain cannot be mistaken for ours
+                if r.drain_sent:
+                    r.clean_passes += 1
+                    if r.clean_passes >= 2:
+                        r.drain_sent = False
+                        r.clean_passes = 0
+            self._restore(r)
+        elif reason == "draining" and r.drain_sent:
+            # the replica recovered from whatever evicted it and is now
+            # refusing admissions only because WE drained it — undo
+            # that (the next scrape pass restores rotation); a drain
+            # the router did not send is an operator's and stays.
+            # drain_sent clears only on a CONFIRMED undrain (inside
+            # _send_drain), so a lost undrain POST retries next pass.
+            log.info("replica %s healthy but still carrying our drain; "
+                     "undraining", r.name)
+            with self._lock:
+                r.clean_passes = 0
+            threading.Thread(target=self._send_drain,
+                             args=(r,), kwargs={"undrain": True},
+                             daemon=True,
+                             name=f"tpushare-router-undrain-{r.name}"
+                             ).start()
+        else:
+            with self._lock:
+                r.clean_passes = 0
+            self._evict(r, reason)
+
+    def _get(self, r: Replica, path: str) -> str:
+        with urllib.request.urlopen(f"http://{r.address}{path}",
+                                    timeout=self._scrape_timeout_s) as resp:
+            return resp.read().decode()
+
+    def _probe_health(self, r: Replica) -> Tuple[bool, str]:
+        """(in_rotation verdict, reason).  Non-200 is the WEDGED
+        contract (health plane: /healthz is non-200 exactly when
+        WEDGED); a 200 body may still carry a state dict (DEGRADED and
+        CPU_FALLBACK keep serving — they stay in rotation).  A DRAINING
+        replica refuses admissions, so it is out of rotation too —
+        whether the drain was ours (recovery undrains it, see
+        :meth:`_scrape_replica`) or an operator's rolling restart
+        (which the router must never undo)."""
+        try:
+            body = self._get(r, "/healthz")
+        except urllib.error.HTTPError as e:
+            # the non-200 body still matters: a WEDGED replica that is
+            # ALSO operator-draining must evict with the draining
+            # reason, or the eviction would post an ownership-claiming
+            # drain whose later undrain cancels the operator's
+            try:
+                if json.loads(e.read()).get("draining"):
+                    return False, "draining"
+            except Exception:
+                pass
+            return False, f"healthz {e.code}"
+        except Exception as e:
+            return False, f"unreachable ({type(e).__name__})"
+        try:
+            parsed = json.loads(body)
+            state = parsed.get("state")
+            draining = bool(parsed.get("draining"))
+        except (json.JSONDecodeError, AttributeError):
+            state, draining = None, False     # plain "ok\n"
+        if draining:                      # out of rotation whatever the
+            return False, "draining"      # state says — and the reason
+        if state == "wedged":             # must be draining for the
+            return False, "wedged"        # ownership protocol
+        return True, ""
+
+    def _evict(self, r: Replica, reason: str) -> None:
+        with self._lock:
+            if not r.in_rotation:
+                r.evicted_reason = reason     # keep the freshest verdict
+                return
+            r.evicted_reason = reason
+        log.warning("evicting replica %s from rotation: %s", r.name,
+                    reason)
+        metrics.ROUTER_EVICTIONS.inc(replica=r.name)
+        metrics.ROUTER_REPLICA_UP.set(0.0, replica=r.name)
+        if reason == "draining":
+            # already draining — and NOT by us: posting our own drain
+            # here would claim ownership (drain_sent) and make recovery
+            # undo what is really an operator's rolling restart
+            return
+        # Best-effort graceful drain in its own thread: a wedged
+        # replica's HTTP surface may hang past any timeout we pick, and
+        # the scrape pass must not wait on it.  _send_drain remembers
+        # WE drained it, so recovery can undo exactly our drain and no
+        # one else's.
+        threading.Thread(target=self._send_drain, args=(r,), daemon=True,
+                         name=f"tpushare-router-drain-{r.name}").start()
+
+    def _send_drain(self, r: Replica, undrain: bool = False) -> None:
+        """POST /drain (or the undrain) to ``r``, keeping the
+        drain-ownership flag truthful: claimed BEFORE the drain POST
+        (an ambiguous timeout may still land server-side, and an
+        unowned landed drain would strand the replica 503ing forever),
+        DISCLAIMED when the connection provably never happened (e.g.
+        refused at startup while the replica is still compiling — a
+        stale claim there would make the router undo the operator's
+        next rolling-restart drain), and cleared only by a CONFIRMED
+        undrain (a lost undrain retries next scrape pass)."""
+        if not undrain:
+            with self._lock:
+                r.drain_sent = True
+                r.clean_passes = 0
+        try:
+            req = urllib.request.Request(
+                f"http://{r.address}/drain",
+                data=json.dumps({"undrain": True}).encode()
+                if undrain else b"{}",
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=self._scrape_timeout_s):
+                pass
+            if undrain:
+                with self._lock:
+                    r.drain_sent = False   # confirmed: our drain is gone
+        except Exception as e:
+            reason = getattr(e, "reason", e)
+            if not undrain and isinstance(reason, ConnectionError):
+                with self._lock:
+                    r.drain_sent = False   # never connected: no drain
+                    # landed, so there is nothing of ours to undo
+            log.debug("%s of %s failed (%s); eviction stands",
+                      "undrain" if undrain else "drain", r.name, e)
+
+    def _restore(self, r: Replica) -> None:
+        # drain_sent deliberately NOT cleared here: our drain POST may
+        # still be in flight while the replica probes healthy, and
+        # dropping ownership now would make the late-landing drain read
+        # as an operator's — permanently out of rotation.  The flag
+        # clears only on a CONFIRMED undrain (_send_drain); the cost of
+        # keeping it is one spurious undrain round-trip in the
+        # drain-POST-was-lost corner, which self-corrects.
+        with self._lock:
+            if r.in_rotation:
+                r.consecutive_failures = 0
+                return
+            r.evicted_reason = None
+            r.consecutive_failures = 0
+        log.info("replica %s recovered; back in rotation", r.name)
+        metrics.ROUTER_REPLICA_UP.set(1.0, replica=r.name)
+
+    def _note_failure(self, r: Replica, reason: str) -> None:
+        """A forward to ``r`` failed.  Transport failures accumulate
+        toward eviction (the scrape loop restores on recovery); the
+        verdict is the router's own — it must not wait for the next
+        scrape pass to stop picking a dead replica."""
+        with self._lock:
+            r.consecutive_failures += 1
+            over = r.consecutive_failures >= self._eviction_failures
+        if over:
+            self._evict(r, f"{self._eviction_failures} consecutive "
+                           f"forward failures ({reason})")
+
+    # -- routing policies ----------------------------------------------
+    def _prefix_hashes(self, tokens: List[int]) -> List[int]:
+        """Prefix-block hashes, LONGEST first (the lookup wants the
+        most-specific committed prefix; registration wants them all)."""
+        n_blocks = min(len(tokens) // self._prefix_block,
+                       MAX_AFFINITY_BLOCKS)
+        return [hash(tuple(tokens[:i * self._prefix_block]))
+                for i in range(n_blocks, 0, -1)]
+
+    def _prefill_heavy(self, tokens: Optional[List[int]],
+                       max_new: int) -> bool:
+        """FlexNPU-style request class: a prompt long relative to its
+        generation budget is prefill work; the rest is decode work."""
+        if not tokens:
+            return False
+        return len(tokens) >= self._prefill_heavy_ratio * max(1, max_new)
+
+    @staticmethod
+    def _load_score(r: Replica, prefill_heavy: bool) -> float:
+        """Least-pending score (LOWER routes first).  The in-flight
+        term is router-side truth; the scraped terms are the replica's
+        own serving plane, normalized to comparable magnitudes:
+        occupancy is already a fraction, the prefill queue depth maps
+        through q/(q+4) (4 queued prompts ≈ a half-full replica), and
+        TTFT p99 clamps at one second."""
+        s = r.summary or {}
+        occ = s.get("occupancy") or 0.0
+        pq = s.get("prefill_queue") or 0.0
+        pq_n = pq / (pq + 4.0)
+        ttft_n = min(1.0, s.get("ttft_p99_s") or 0.0)
+        if prefill_heavy:
+            shape = 2.0 * occ + 0.5 * pq_n
+        else:
+            shape = 2.0 * pq_n + 0.5 * occ
+        return r.inflight + shape + 0.5 * ttft_n
+
+    def _saturated(self, r: Replica) -> bool:
+        occ = (r.summary or {}).get("occupancy")
+        return occ is not None and occ >= self._saturation
+
+    def _pick(self, tokens: Optional[List[int]], prefill_heavy: bool,
+              exclude: Sequence[str]) -> Tuple[Optional[Replica], str]:
+        """Choose a replica and the policy that chose it.  Re-dispatch
+        picks (``exclude`` non-empty) are pure load picks labeled
+        ``retry`` — the affinity target just failed or is excluded, and
+        a 'hit' that re-routes is not a hit.  Increments the pick's
+        in-flight count under the lock (the caller's forward owns the
+        decrement)."""
+        # hash once, OUTSIDE the lock (tuple-hashing long prompts is
+        # the expensive part, and this lock is the front door's one
+        # hot lock); the list serves both the lookup and registration
+        hashes = (self._prefix_hashes(tokens)
+                  if self._affinity and tokens else ())
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.in_rotation and r.name not in exclude]
+            if not candidates:
+                return None, "load"
+            chosen: Optional[Replica] = None
+            policy = "retry" if exclude else "load"
+            if hashes and not exclude:
+                by_name = {r.name: r for r in candidates}
+                for h in hashes:
+                    r = by_name.get(self._affinity_map.get(h, ""))
+                    if r is not None and not self._saturated(r):
+                        chosen, policy = r, "affinity"
+                        break
+            if chosen is None:
+                chosen = min(candidates,
+                             key=lambda r: self._load_score(
+                                 r, prefill_heavy))
+            if hashes:
+                # register every block prefix to the chosen replica —
+                # its pages will hold them once admitted; LRU-bounded
+                for h in hashes:
+                    self._affinity_map[h] = chosen.name
+                    self._affinity_map.move_to_end(h)
+                while len(self._affinity_map) > self._max_affinity_entries:
+                    self._affinity_map.popitem(last=False)
+            chosen.inflight += 1
+            return chosen, policy
+
+    # -- forwarding ----------------------------------------------------
+    def _forward(self, r: Replica, data: bytes) -> Tuple[int, object]:
+        req = urllib.request.Request(
+            f"http://{r.address}/generate", data=data,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self._request_timeout_s) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:
+                return e.code, {"Error": f"replica answered {e.code}"}
+
+    def _forward_watched(self, r: Replica,
+                         data: bytes) -> Optional[Tuple[int, object]]:
+        """Forward in a worker thread, watching the replica's rotation
+        state: if ``r`` is evicted while the forward is in flight, the
+        worker is ABANDONED (left to finish; never killed — its late
+        response is discarded) and None is returned so the caller
+        re-dispatches.  None also covers transport errors and the
+        request deadline."""
+        result: Dict[str, object] = {}
+        done = threading.Event()
+
+        def worker():
+            try:
+                result["resp"] = self._forward(r, data)
+            except Exception as e:
+                result["err"] = e
+            finally:
+                with self._lock:
+                    r.inflight = max(0, r.inflight - 1)
+                done.set()
+
+        threading.Thread(target=worker, daemon=True,
+                         name=f"tpushare-router-fwd-{r.name}").start()
+        deadline = time.monotonic() + self._request_timeout_s
+        while not done.wait(self._watch_poll_s):
+            if not r.in_rotation or time.monotonic() > deadline:
+                return None
+        if "err" in result:
+            return None
+        return result["resp"]            # type: ignore[return-value]
+
+    # -- routes --------------------------------------------------------
+    @staticmethod
+    def _request_tokens(body: dict) -> Optional[List[int]]:
+        """The first prompt row when it is well-formed token ints (the
+        affinity/classification input); None for text-mode or malformed
+        bodies — those still forward, the REPLICA owns validation."""
+        tokens = body.get("tokens")
+        if (isinstance(tokens, list) and tokens
+                and isinstance(tokens[0], list) and tokens[0]
+                and all(isinstance(t, int) for t in tokens[0])):
+            return tokens[0]
+        return None
+
+    def _generate(self, body):
+        if not isinstance(body, dict):
+            return 400, {"Error": "body must be a JSON object"}
+        tokens = self._request_tokens(body)
+        try:
+            max_new = int(body.get("max_new_tokens", 32))
+        except (TypeError, ValueError):
+            max_new = 32                  # replica 400s the real parse
+        prefill_heavy = self._prefill_heavy(tokens, max_new)
+        data = json.dumps(body).encode()
+        tried: List[str] = []
+        for attempt in range(self._max_retries + 1):
+            replica, policy = self._pick(tokens, prefill_heavy, tried)
+            if replica is None:
+                if tried:
+                    # candidates exist but were all tried and failed —
+                    # that is the 502 story below, not a fleet outage
+                    break
+                return 503, {"Error": "no replica in rotation"}
+            if attempt:
+                with self._lock:
+                    self._retries += 1
+                metrics.ROUTER_RETRIES.inc()
+            out = self._forward_watched(replica, data)
+            if out is not None and out[0] < 500:
+                code, payload = out
+                with self._lock:
+                    replica.requests += 1
+                    # "consecutive" means it: a success between two
+                    # failures restarts the eviction countdown
+                    replica.consecutive_failures = 0
+                    if policy == "affinity":
+                        replica.affinity_hits += 1
+                metrics.ROUTER_REQUESTS.inc(replica=replica.name,
+                                            policy=policy)
+                if policy == "affinity":
+                    metrics.ROUTER_AFFINITY_HITS.inc(
+                        replica=replica.name)
+                return code, payload
+            if out is not None and out[0] == 503 and isinstance(
+                    out[1], dict) and "draining" in str(
+                        out[1].get("Error", "")):
+                # the replica refuses because it is DRAINING (caught
+                # here before the next scrape pass sees it): evict with
+                # the draining reason so no ownership-claiming drain of
+                # our own is posted — counting this as a transport
+                # failure would later undo an OPERATOR's drain
+                self._evict(replica, "draining")
+            elif out is None:
+                # abandoned (evicted mid-flight, transport error, or
+                # deadline): the transport-level failure class that
+                # accumulates toward eviction
+                self._note_failure(
+                    replica, "abandoned (evicted mid-flight, "
+                             "transport error, or deadline)")
+            # else: an HTTP 5xx APPLICATION response — the replica's
+            # transport and HTTP stack are provably alive, so only
+            # re-dispatch; counting it toward transport eviction would
+            # let one poison request drain every healthy replica.
+            # Replica-health verdicts for a 500-spewing process belong
+            # to the /healthz scrape loop.
+            tried.append(replica.name)
+        return 502, {"Error": f"all forwards failed "
+                              f"(tried {', '.join(tried)})"}
+
+    def _healthz(self, _body=None):
+        with self._lock:
+            up = sum(1 for r in self._replicas if r.in_rotation)
+        body = {"state": "ok" if up else "no_replicas",
+                "replicas_up": up, "replicas": len(self._replicas)}
+        return (200, body) if up else (503, body)
+
+    def _fleet(self, _body=None):
+        """The authoritative per-replica view (inspect --fleet scrapes
+        the /metrics series; this JSON carries the same numbers plus
+        the scraped serving summaries for debugging)."""
+        with self._lock:
+            return 200, {
+                "retries": self._retries,
+                "policies": list(ROUTER_POLICIES),
+                "replicas": [r.view() for r in self._replicas],
+            }
+
+    # -- introspection (tests, bench) ----------------------------------
+    def replica(self, name: str) -> Replica:
+        for r in self._replicas:
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpushare-router",
+        description="Load-, prefix-, and health-aware request router "
+                    "over N tpushare-llm-server replicas")
+    ap.add_argument("replicas", nargs="+",
+                    help="replica addresses, host:port "
+                         "(optionally name=host:port)")
+    ap.add_argument("--port", type=int, default=8800)
+    ap.add_argument("--addr", default="0.0.0.0")
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="disable prefix-cache-affinity routing "
+                         "(load + health only)")
+    ap.add_argument("--prefix-block", type=int, default=16,
+                    help="prefix-hash granularity in tokens; match the "
+                         "replicas' --page-size so affinity hits map "
+                         "to whole cached pages (default 16)")
+    ap.add_argument("--scrape-interval", type=float, default=2.0,
+                    help="seconds between /metrics + /healthz sweeps")
+    ap.add_argument("--max-retries", type=int, default=2,
+                    help="re-dispatch budget per request after "
+                         "eviction/transport failure")
+    ap.add_argument("--saturation", type=float, default=0.95,
+                    help="batch occupancy at which an affinity target "
+                         "is skipped in favor of the load policy")
+    ap.add_argument("--request-timeout", type=float, default=600.0,
+                    help="per-forward deadline before re-dispatch")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    replicas = []
+    for spec in args.replicas:
+        if "=" in spec:
+            name, _, address = spec.partition("=")
+            replicas.append((name, address))
+        else:
+            replicas.append(spec)
+    router = FleetRouter(
+        replicas, port=args.port, addr=args.addr,
+        affinity=not args.no_affinity, prefix_block=args.prefix_block,
+        scrape_interval_s=args.scrape_interval,
+        max_retries=args.max_retries, saturation=args.saturation,
+        request_timeout_s=args.request_timeout)
+    log.info("router: %d replica(s) on :%d (affinity=%s)",
+             len(router._replicas), router.port, not args.no_affinity)
+    router.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

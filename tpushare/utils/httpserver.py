@@ -68,11 +68,17 @@ class JsonHTTPServer:
 
             def _send(self, code: int, payload) -> None:
                 if isinstance(payload, StreamingBody):
-                    self.send_response(code)
-                    self.send_header("Content-Type", payload.content_type)
-                    # no Content-Length: body is delimited by close
-                    self.end_headers()
                     try:
+                        # the header writes sit INSIDE the guarded
+                        # region: a client gone before headers must
+                        # still reach the finally, or stream-side
+                        # accounting (the LLM server's in-flight
+                        # counter) leaks on exactly that disconnect
+                        self.send_response(code)
+                        self.send_header("Content-Type",
+                                         payload.content_type)
+                        # no Content-Length: body delimited by close
+                        self.end_headers()
                         for chunk in payload.chunks:
                             self.wfile.write(chunk)
                             self.wfile.flush()
