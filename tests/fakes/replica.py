@@ -24,12 +24,24 @@ Loopback only, like every fake in this tree.
 
 from __future__ import annotations
 
+import base64
+import json
 import threading
 import time
 from typing import List, Optional
 
 from tpushare.telemetry.registry import Registry
 from tpushare.utils.httpserver import JsonHTTPServer
+
+
+def fake_blob(prompt: List[int], max_new: int) -> str:
+    """The fakes' stand-in for a migration blob: the router relays the
+    string OPAQUELY (only sender and receiver ever decode it), so the
+    fakes encode just enough to reproduce the deterministic stream —
+    any fake can 'import' any fake's export, mirroring the real
+    same-fingerprint fleet."""
+    return base64.b64encode(json.dumps(
+        {"prompt": prompt, "max_new": max_new}).encode()).decode()
 
 
 def expected_tokens(prompt: List[int], max_new: int,
@@ -57,6 +69,15 @@ class FakeReplica:
         #: tokens — e.g. (500, {"Error": "boom"}) for the poison-
         #: request drill; None = normal deterministic generation
         self.generate_error = None
+        #: scripted (status, body) every /migrate_in answers — e.g.
+        #: (409, {"Error": "migration refused: pool_full"}) for the
+        #: receiver-refusal drill; None = import + deterministic decode
+        self.migrate_error = None
+        #: every /migrate_in body, for drill assertions
+        self.migrate_calls: List[dict] = []
+        #: /migrate_in joins the stall() drill too (a receiver that
+        #: wedges MID-TRANSFER)
+        self.stall_migrate = False
         self._stall = threading.Event()        # set = /generate blocks
         self._release = threading.Event()
         self._lock = threading.Lock()
@@ -78,6 +99,7 @@ class FakeReplica:
         self.set_wedged(False)             # seed the ok one-hot
         self._http = JsonHTTPServer(0, "127.0.0.1", routes={
             ("POST", "/generate"): self._generate,
+            ("POST", "/migrate_in"): self._migrate_in,
             ("POST", "/drain"): self._drain,
             ("GET", "/healthz"): self._healthz,
             ("GET", "/metrics"): self._metrics,
@@ -142,9 +164,31 @@ class FakeReplica:
         if not isinstance(tokens, list) or not tokens:
             return 400, {"Error": "body must contain tokens: [[int, ...]]"}
         max_new = int(body.get("max_new_tokens", 32))
+        if body.get("phase") == "prefill":
+            # the disaggregation sender half: answer with the opaque
+            # session payload instead of decoding (the llm-server
+            # contract the router consumes)
+            return 200, {"migration": fake_blob(
+                [int(t) for t in tokens[0]], max_new)}
         return 200, {"tokens": [
             expected_tokens([int(t) for t in row], max_new, self.vocab)
             for row in tokens]}
+
+    def _migrate_in(self, body):
+        with self._lock:
+            self.migrate_calls.append(body)
+        if self.migrate_error is not None:
+            return self.migrate_error
+        if self.stall_migrate and self._stall.is_set():
+            self._release.wait(timeout=60)
+        blob = body.get("blob") if isinstance(body, dict) else None
+        try:
+            payload = json.loads(base64.b64decode(blob))
+            prompt, max_new = payload["prompt"], payload["max_new"]
+        except Exception:
+            return 400, {"Error": "migration refused: bad_blob"}
+        return 200, {"tokens": [expected_tokens(
+            [int(t) for t in prompt], int(max_new), self.vocab)]}
 
     def _drain(self, body=None):
         if isinstance(body, dict) and body.get("undrain"):

@@ -387,6 +387,34 @@ def test_rule_router_no_jax():
         tpulint.format_findings(tpulint.run_rule("router-no-jax"))
 
 
+def test_rule_migration_wire_confinement():
+    """KV wire (de)serialization is confined to serving/migrate.py:
+    byte-level codec primitives (struct.pack/unpack, np.frombuffer,
+    .tobytes()) anywhere else in the serving plane are a second wire
+    format waiting to fork — while migrate.py itself, and code
+    outside tpushare/serving/, stay legal."""
+    bad = ("import struct\n"
+           "hdr = struct.pack('>Q', n)\n"
+           "x = np.frombuffer(blob, dtype=np.int8)\n"
+           "payload = arr.tobytes()\n")
+    fs = _lint("tpushare/serving/newcodec.py", bad,
+               "migration-wire-confinement")
+    assert [f.line for f in fs] == [2, 3, 4]
+    # the one sanctioned codec module
+    assert not _lint("tpushare/serving/migrate.py", bad,
+                     "migration-wire-confinement")
+    # scope is the serving plane only
+    assert not _lint("tpushare/ops/quant.py", bad,
+                     "migration-wire-confinement")
+    # a bare pack() call (not struct's) stays legal
+    ok = "row = pack(x)\nheader = json.dumps(meta)\n"
+    assert not _lint("tpushare/serving/other.py", ok,
+                     "migration-wire-confinement")
+    assert not tpulint.run_rule("migration-wire-confinement"), \
+        tpulint.format_findings(
+            tpulint.run_rule("migration-wire-confinement"))
+
+
 def test_run_rule_rejects_unknown_names():
     """A renamed rule cannot silently hollow out its pytest wrapper."""
     with pytest.raises(KeyError):

@@ -179,7 +179,10 @@ ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
                        # fleet router: replica names come from the
                        # router's CLI config (fleet-bounded), never
                        # from request content; policy is enumerated
-                       "replica", "policy"}
+                       "replica", "policy",
+                       # KV-page migration plane: kind/direction/
+                       # outcome are enumerated below
+                       "kind", "direction", "outcome"}
 FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
                          "id"}
 #: label names whose VALUES are enumerated per family (one-hot states,
@@ -204,6 +207,17 @@ ENUMERATED_VALUES = {
     # keep in sync with router.ROUTER_POLICIES (asserted below)
     ("tpushare_router_requests_total", "policy"):
         {"affinity", "load", "retry"},
+    # keep in sync with the migrate.py / router.py constants
+    # (asserted below)
+    ("tpushare_migrations_out_total", "kind"):
+        {"handoff", "spill", "drain"},
+    ("tpushare_migrations_in_total", "kind"): {"import", "restore"},
+    ("tpushare_migration_refused_total", "reason"):
+        {"pool_full", "config_mismatch", "bad_blob",
+         "unsupported_storage", "spill_budget"},
+    ("tpushare_migration_bytes_total", "direction"): {"in", "out"},
+    ("tpushare_router_handoffs_total", "outcome"):
+        {"ok", "local_fallback", "reprefill"},
 }
 
 
@@ -231,6 +245,51 @@ def test_router_policy_enum_matches_constant():
     from tpushare.serving.router import ROUTER_POLICIES
     assert set(ROUTER_POLICIES) == ENUMERATED_VALUES[
         ("tpushare_router_requests_total", "policy")]
+
+
+def test_migration_enums_match_constants():
+    """The migration plane's kind/reason/outcome enums and the module
+    constants are one set each — a new kind without a deliberate enum
+    entry here would observe an un-enumerated label value."""
+    from tpushare.serving.migrate import (MIGRATION_IN_KINDS,
+                                          MIGRATION_OUT_KINDS,
+                                          MIGRATION_REFUSAL_REASONS)
+    from tpushare.serving.router import HANDOFF_OUTCOMES
+    assert set(MIGRATION_OUT_KINDS) == ENUMERATED_VALUES[
+        ("tpushare_migrations_out_total", "kind")]
+    assert set(MIGRATION_IN_KINDS) == ENUMERATED_VALUES[
+        ("tpushare_migrations_in_total", "kind")]
+    assert set(MIGRATION_REFUSAL_REASONS) == ENUMERATED_VALUES[
+        ("tpushare_migration_refused_total", "reason")]
+    assert set(HANDOFF_OUTCOMES) == ENUMERATED_VALUES[
+        ("tpushare_router_handoffs_total", "outcome")]
+
+
+def test_migration_series_registered_with_contracted_names():
+    """The KV-page migration plane's series exist under their
+    contracted names and kinds (what `kubectl inspect tpushare
+    --fleet`'s MIGR/SPILL columns and the disaggregation dashboards
+    key on)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_migrations_out_total") == "counter"
+    assert by_name.get("tpushare_migrations_in_total") == "counter"
+    assert by_name.get("tpushare_migration_refused_total") == "counter"
+    assert by_name.get("tpushare_migration_bytes_total") == "counter"
+    assert by_name.get("tpushare_router_handoffs_total") == "counter"
+    assert by_name.get("tpushare_spill_bytes") == "gauge"
+    assert by_name.get("tpushare_spill_sessions") == "gauge"
+    assert by_name.get("tpushare_spill_restore_seconds") == "histogram"
+
+
+def test_migration_wire_confined_to_migrate_module():
+    """KV wire (de)serialization lives in serving/migrate.py and
+    nowhere else in the serving plane — a second hand-rolled codec
+    would fork the blob format.  THIN WRAPPER over tpulint rule
+    ``migration-wire-confinement`` (tpushare/analysis/tpulint.py)."""
+    from tpushare.analysis import tpulint
+
+    findings = tpulint.run_rule("migration-wire-confinement")
+    assert not findings, tpulint.format_findings(findings)
 
 
 def test_router_series_registered_with_contracted_names():

@@ -299,3 +299,66 @@ def test_debug_trace_endpoint_is_valid_chrome_trace_json():
         if ev["ph"] == "X":
             assert ev["dur"] >= 0
     assert "roundtrip.test" in names
+
+
+def test_debug_events_since_cursor_across_ring_wrap():
+    """ISSUE-11 satellite: the ``/debug/events?since=<seq>`` incremental
+    tail stays exact ACROSS a ring-buffer wrap — a cursor that is still
+    inside the live window must neither replay events it already saw
+    nor skip ones recorded after it, even while old entries are being
+    evicted mid-tail; a cursor that has fallen off the back returns the
+    whole ring, and the seq gap tells the scraper how much it lost."""
+    from tpushare.telemetry.events import FlightRecorder
+
+    rec = FlightRecorder(capacity=8)
+    for i in range(5):
+        rec.record("e", i=i)
+    first = rec.events_since(0)
+    assert [e["seq"] for e in first] == [1, 2, 3, 4, 5]
+    cursor = first[-1]["seq"]
+    # wrap the ring: 6 more events evict seqs 1..3 (capacity 8)
+    for i in range(5, 11):
+        rec.record("e", i=i)
+    assert [e["seq"] for e in rec.events()] == list(range(4, 12))
+    tail = rec.events_since(cursor)
+    # exactly the delta: nothing replayed, nothing skipped
+    assert [e["seq"] for e in tail] == [6, 7, 8, 9, 10, 11]
+    # interleaved record-and-tail across further wraps keeps the
+    # no-replay/no-skip invariant (the mid-tail eviction case)
+    seen = [e["seq"] for e in first] + [e["seq"] for e in tail]
+    cursor = seen[-1]
+    for i in range(30):
+        rec.record("e", i=100 + i)
+        if i % 3 == 0:
+            delta = rec.events_since(cursor)
+            seen += [e["seq"] for e in delta]
+            cursor = seen[-1]
+    seen += [e["seq"] for e in rec.events_since(cursor)]
+    assert seen == list(range(1, 42)), "cursor tail replayed or skipped"
+    # a cursor evicted off the back returns the whole live ring; the
+    # gap between cursor+1 and the first seq is the loss signal
+    stale = rec.events_since(1)
+    assert [e["seq"] for e in stale] == [e["seq"] for e in rec.events()]
+    assert stale[0]["seq"] > 2
+
+
+def test_debug_events_route_since_query_roundtrip():
+    """The shared HTTP handler parses the cursor and serves exactly the
+    JSONL delta off the process-global ring (daemon + llm-server both
+    mount this route)."""
+    from tpushare.telemetry.events import RECORDER, debug_events_route
+
+    base = RECORDER.record("wrap_test_marker", phase=1)
+    assert base, "telemetry disabled?"
+    RECORDER.record("wrap_test_marker", phase=2)
+    RECORDER.record("wrap_test_marker", phase=3)
+    code, body = debug_events_route(None, {"since": str(base)})
+    assert code == 200
+    lines = [json.loads(ln) for ln in
+             body.data.decode().splitlines() if ln]
+    seqs = [e["seq"] for e in lines]
+    assert seqs == sorted(seqs) and min(seqs) == base + 1
+    assert sum(1 for e in lines
+               if e["kind"] == "wrap_test_marker") == 2
+    code, err = debug_events_route(None, {"since": "notanint"})
+    assert code == 400

@@ -418,6 +418,43 @@ def _router_no_jax(ctx: Context):
                 break
 
 
+#: the byte-level (de)serialization primitives a second KV wire codec
+#: would be built from
+_WIRE_ATTRS = frozenset({"frombuffer", "tobytes"})
+
+
+@rule(
+    "migration-wire-confinement",
+    "KV session wire (de)serialization lives in "
+    "tpushare/serving/migrate.py and NOWHERE else in the serving "
+    "plane: a second hand-rolled codec (struct.pack/unpack, "
+    "np.frombuffer, .tobytes()) would fork the migration wire format "
+    "— a blob exported by one replica must import on every peer, "
+    "which only holds while one module owns the layout (the "
+    "pallas_call/KV-byte-math confinement pattern).",
+    lambda p: p.startswith("tpushare/serving/"),
+    "tpushare/serving/",
+    allow=("tpushare/serving/migrate.py",),
+    allow_doc="the one sanctioned wire codec")
+def _migration_wire_confinement(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        hit = fn.attr in _WIRE_ATTRS or (
+            fn.attr in ("pack", "unpack", "pack_into", "unpack_from")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "struct")
+        if hit:
+            yield node.lineno, (
+                f"byte-level wire primitive "
+                f"(`{ctx.quote(node.lineno)}`) outside "
+                f"serving/migrate.py — KV wire (de)serialization is "
+                f"confined to the one codec module")
+
+
 #: the process-global telemetry singletons whose internals are
 #: lock-guarded
 _TELEMETRY_GLOBALS = frozenset({"MONITOR", "RECORDER", "REGISTRY"})

@@ -1,0 +1,141 @@
+"""``python -m tpushare.bench_trajectory`` — perf across rounds, at a glance.
+
+Collates the committed ``BENCH_r*.json`` records (one JSON line per
+metric, the ``bench_all.py`` emit format) into ONE per-metric
+trajectory table: every metric's value per round, with the latest
+round's drift against the previous appearance flagged — so a perf
+regression shows up as a red ratio in review instead of two numbers
+nobody diffs.  Markdown to stdout by default; ``--json`` emits the
+machine-readable collation.  Stdlib only (no jax, importable
+anywhere); the committed records are the input, so this runs — and is
+smoke-tested — without touching an accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+_ROUND_RE = re.compile(r"BENCH_(r\d+)\.json$")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_records(root: Optional[str] = None) -> Dict[str, List[dict]]:
+    """{round: [record, ...]} from every committed BENCH_r*.json
+    (JSONL — one emitted metric per line; unparsable lines are
+    skipped, a truncated record must not hide the rest)."""
+    root = root or repo_root()
+    out: Dict[str, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        records = []
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    records.append(rec)
+        out[m.group(1)] = records
+    return out
+
+
+def trajectory(root: Optional[str] = None) -> dict:
+    """The collation: rounds in order, and per metric its unit plus
+    {round: value}.  A metric appearing twice in one round keeps the
+    LAST record (bench reruns append)."""
+    by_round = load_records(root)
+    rounds = sorted(by_round)
+    metrics: Dict[str, dict] = {}
+    for rnd in rounds:
+        for rec in by_round[rnd]:
+            name = rec["metric"]
+            entry = metrics.setdefault(
+                name, {"unit": rec.get("unit"), "values": {}})
+            entry["values"][rnd] = rec.get("value")
+            if rec.get("unit"):
+                entry["unit"] = rec["unit"]
+    for entry in metrics.values():
+        seen = [r for r in rounds if r in entry["values"]]
+        if len(seen) >= 2 and entry["values"][seen[-2]]:
+            prev, last = (entry["values"][seen[-2]],
+                          entry["values"][seen[-1]])
+            try:
+                entry["last_vs_prev"] = round(last / prev, 3)
+            except (TypeError, ZeroDivisionError):
+                entry["last_vs_prev"] = None
+        else:
+            entry["last_vs_prev"] = None
+    return {"rounds": rounds, "metrics": metrics}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if abs(v) >= 100:
+            return f"{v:.0f}"
+        return f"{v:.3g}"
+    return str(v)
+
+
+def render_markdown(traj: dict) -> str:
+    """One metric per row, one column per round, trailing drift column
+    (latest round / its previous appearance; < 1 on a throughput
+    metric is the regression this table exists to surface)."""
+    rounds = traj["rounds"]
+    lines = ["# Bench trajectory (committed BENCH_r*.json)", ""]
+    header = (["metric", "unit"] + rounds + ["last/prev"])
+    lines.append("| " + " | ".join(header) + " |")
+    lines.append("|" + "---|" * len(header))
+    for name in sorted(traj["metrics"]):
+        entry = traj["metrics"][name]
+        cells = [name, entry.get("unit") or "-"]
+        cells += [_fmt(entry["values"].get(r)) for r in rounds]
+        ratio = entry.get("last_vs_prev")
+        cells.append(f"{ratio:.3f}x" if ratio is not None else "-")
+        lines.append("| " + " | ".join(cells) + " |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpushare.bench_trajectory",
+        description="Collate committed BENCH_r*.json records into one "
+                    "per-metric trajectory table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable collation instead "
+                         "of markdown")
+    ap.add_argument("--root", default=None,
+                    help="repo root holding the BENCH_r*.json records "
+                         "(default: this checkout)")
+    args = ap.parse_args(argv)
+    traj = trajectory(args.root)
+    if not traj["rounds"]:
+        print("no BENCH_r*.json records found", file=sys.stderr)
+        return 1
+    if args.json:
+        json.dump(traj, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        sys.stdout.write(render_markdown(traj))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

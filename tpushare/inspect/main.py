@@ -149,9 +149,12 @@ def main(argv=None) -> int:
         if fleet_rows is not None:
             # the fleet-routing view: per-replica health/share/affinity
             # from the router's exposition; dead nodes carry the
-            # uniform error key
+            # uniform error + health keys (like the serving view), and
+            # every replica entry carries an explicit "up" — evicted/
+            # unreachable replicas are marked, never omitted
             by_name = {name: (summary if summary is not None
-                              else {"error": err, "replicas": {}})
+                              else {"error": err, "health": "down",
+                                    "replicas": {}})
                        for name, _, summary, err in fleet_rows}
             for entry in out["nodes"]:
                 if entry["name"] in by_name:

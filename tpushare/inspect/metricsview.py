@@ -224,10 +224,31 @@ def summarize_fleet(parsed: dict) -> dict:
     total = sum(r.get("requests", 0.0) for r in replicas.values())
     for r in replicas.values():
         r["share"] = (r.get("requests", 0.0) / total) if total else None
+        # a replica the router knows but has never judged (no up
+        # sample in the scrape) gets an explicit None, and DOWN is
+        # ALWAYS present as a key — json consumers read
+        # replicas[name]["up"] uniformly instead of probing for it
+        r.setdefault("up", None)
     retries = parsed["samples"].get("tpushare_router_retries_total")
+
+    def _counter_sum(name):
+        samples = parsed["samples"].get(name)
+        return sum(v for _, v in samples) if samples else None
+
     return {
         "retries": retries[0][1] if retries else None,
         "replicas": replicas,
+        # KV-page migration plane (recorded by the llm-server
+        # expositions merged into this scrape): hand-offs/spills in
+        # and out of the node's pools, refusals, and the host-RAM
+        # spill tier's current occupancy
+        "migrations_out": _counter_sum("tpushare_migrations_out_total"),
+        "migrations_in": _counter_sum("tpushare_migrations_in_total"),
+        "migrations_refused": _counter_sum(
+            "tpushare_migration_refused_total"),
+        "handoffs": _counter_sum("tpushare_router_handoffs_total"),
+        "spill_sessions": _gauge(parsed, "tpushare_spill_sessions"),
+        "spill_bytes": _gauge(parsed, "tpushare_spill_bytes"),
     }
 
 
@@ -363,22 +384,37 @@ def render_tenants_table(
 def render_fleet_table(
         rows: List[Tuple[str, str, Optional[dict], Optional[str]]]) -> str:
     """``rows`` = [(node, address, fleet_summary|None, error|None)] —
-    one line per (node, replica) with the router-side health verdict,
-    forwarded-request share, affinity hits, and evictions; the node-
-    wide re-dispatch count rides the first row.  Nodes whose scrape
-    carried no router series render a placeholder row; dead nodes a
-    DOWN row."""
+    one line per (node, replica) with the router-side health verdict
+    (``DOWN`` for a replica the router evicted from rotation — the
+    same vocabulary the ``--metrics`` view uses for dead endpoints,
+    so an unreachable replica is a loud row, never a silent
+    omission), forwarded-request share, affinity hits, and evictions;
+    the node-wide re-dispatch count and the KV-page migration /
+    spill-tier tallies ride the first row."""
     table = [["NAME", "REPLICA", "HEALTH", "REQUESTS", "SHARE",
-              "AFFINITY HITS", "EVICTIONS", "RETRIES"]]
+              "AFFINITY HITS", "EVICTIONS", "RETRIES",
+              "MIGR(out/in)", "SPILL"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, "-", "DOWN", err or "unreachable",
-                          "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-"])
             continue
         replicas = summary["replicas"]
+        migr = "-"
+        if summary.get("migrations_out") is not None or \
+                summary.get("migrations_in") is not None:
+            migr = (f"{int(summary.get('migrations_out') or 0)}/"
+                    f"{int(summary.get('migrations_in') or 0)}")
+            if summary.get("migrations_refused"):
+                migr += f" (ref {int(summary['migrations_refused'])})"
+        spill = "-"
+        if summary.get("spill_sessions") is not None:
+            spill = f"{int(summary['spill_sessions'])}"
+            if summary.get("spill_bytes"):
+                spill += f" ({_fmt_bytes(summary['spill_bytes'])})"
         if not replicas:
             table.append([name, "-", "-", "-", "-", "-", "-",
-                          "no router"])
+                          "no router", migr, spill])
             continue
         retries = summary.get("retries")
         first = True
@@ -386,7 +422,7 @@ def render_fleet_table(
             r = replicas[rname]
             up = r.get("up")
             health = ("-" if up is None
-                      else ("UP" if up else "EVICTED"))
+                      else ("UP" if up else "DOWN"))
             table.append([
                 name if first else "", rname, health,
                 _fmt(r.get("requests"), digits=0),
@@ -394,6 +430,8 @@ def render_fleet_table(
                 _fmt(r.get("affinity_hits"), digits=0),
                 _fmt(r.get("evictions"), digits=0),
                 (_fmt(retries, digits=0) if first else ""),
+                (migr if first else ""),
+                (spill if first else ""),
             ])
             first = False
     return "Fleet routing:\n" + _table(table)

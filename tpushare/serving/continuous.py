@@ -726,6 +726,27 @@ class ContinuousBatcher:
             slot, pos, last_idx, self.cfg, chunk_len)
         return logits
 
+    # -- session migration capability ----------------------------------
+    def can_migrate(self) -> bool:
+        """Whether this storage supports session export/import (the
+        KV-page migration plane).  Only the PAGED pools do: pages are
+        the unit the wire format moves; a dense slot row has no
+        page-granular identity to rebuild on a receiver."""
+        return False
+
+    def export_session(self, rid: int) -> bytes:
+        raise ValueError("session migration requires paged storage "
+                         "(pass page_size)")
+
+    def import_session(self, blob: bytes,
+                       rid: Optional[int] = None) -> Optional[int]:
+        raise ValueError("session migration requires paged storage "
+                         "(pass page_size)")
+
+    def pop_session(self, rid: int) -> None:
+        raise ValueError("session migration requires paged storage "
+                         "(pass page_size)")
+
     # ------------------------------------------------------------------
     def _rich(self) -> bool:
         """True when any live slot needs the top-k/top-p sampler — the
@@ -1704,7 +1725,9 @@ class ContinuousService:
                  spec_rounds: Optional[int] = None,
                  prefix_cache: bool = False,
                  mixed_step: bool = True,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 spill_bytes: Optional[int] = None):
+        import os as _os
         import queue as _q
         import threading
 
@@ -1794,13 +1817,49 @@ class ContinuousService:
                             self._batcher.storage_info()["kind"])
                 metrics.SPEC_FALLBACK.inc(reason=reason)
                 self._spec_k = 0
+        # HOST-RAM SPILL TIER (paged storage only): when admission hits
+        # page backpressure, the oldest-resident decoding session past
+        # its TPUSHARE_SPILL_IDLE_S residency quantum exports to a
+        # byte-budgeted host-RAM store (serving/migrate.py), freeing
+        # its HBM pages for the admission; it faults back in — counted
+        # restore latency — once the waiting queue subsides and
+        # capacity frees.  Sessions ADMITTED therefore exceed what the
+        # pool can hold resident (the ParvaGPU-style capacity
+        # multiplier above the pool, beyond int8's in-pool 1.96x).
+        # The store never silently evicts a parked session (a blob IS
+        # a live client's stream): at budget, spilling refuses and the
+        # victim stays resident (counted reason="spill_budget").
+        self._spill = None
+        self._spill_idle_s = float(_os.environ.get(
+            "TPUSHARE_SPILL_IDLE_S", "0"))
+        if spill_bytes:
+            if not self._batcher.can_migrate():
+                log.warning("spill tier disabled: storage cannot "
+                            "migrate sessions (needs page_size)")
+                metrics.MIGRATION_REFUSED.inc(
+                    reason="unsupported_storage")
+            else:
+                from .migrate import HostSpillStore
+                self._spill = HostSpillStore(int(spill_bytes))
+        # KV-page migration plumbing (loop-owned except the command
+        # list, which rides self._lock like _waiting/_cancels):
+        # _mig_cmds carries export/import/deliver/reimport commands
+        # from HTTP handler threads onto the loop thread; rids of
+        # prefill-handoff submits park in _handoff_rids until
+        # activation exports them; sessions migrated OUT keep their
+        # local client's sink wired in _migrated_sinks until the peer
+        # returns the finished stream (llm.py /drain migrate_to).
+        self._mig_cmds: List[tuple] = []
+        self._handoff_rids: set = set()
+        self._migrated_sinks: Dict[int, dict] = {}
+        self._resident_since: Dict[int, float] = {}
         # _lock guards ONLY the _waiting handoff; the batcher and _sinks
         # are owned by the loop thread, so decode ticks run without the
         # lock and submit() never waits on a model forward.
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete, t_submit)
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete, t_submit, handoff)
         # rid -> [t_submit, prompt_len, t_first_token|None]: feeds the
         # request-latency / TTFT / per-token histograms (loop-owned,
         # like _sinks)
@@ -1860,6 +1919,18 @@ class ContinuousService:
         for entry in self._stream_sinks.values():
             entry[0].put_nowait(("aborted", None))
         self._stream_sinks.clear()
+        # sessions migrated out still awaiting the peer's result: their
+        # clients must not block past shutdown either
+        for entry in self._migrated_sinks.values():
+            sink = entry.get("sink")
+            if sink is None:
+                continue
+            try:
+                sink.put_nowait(("aborted", None) if entry.get("stream")
+                                else None)
+            except self._q.Full:
+                pass
+        self._migrated_sinks.clear()
 
     def submit_stream(self, prompt: List[int], max_new_tokens: int,
                       temperature: float = 0.0, seed: int = 0,
@@ -1893,8 +1964,74 @@ class ContinuousService:
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=False)
 
+    def submit_handoff(self, prompt: List[int], max_new_tokens: int,
+                       temperature: float = 0.0, seed: int = 0,
+                       eos_id: Optional[int] = None,
+                       top_k: int = 0, top_p: float = 1.0):
+        """PREFILL-ONLY submit (the disaggregation sender half): the
+        request prefills normally, and at the activation boundary —
+        prompt in cache, first token sampled, before it joins any
+        decode round — the session exports and the returned queue
+        yields ``("handoff", blob)`` instead of tokens.  A request
+        that COMPLETES at activation (max_new 1, instant eos) yields
+        its final token list like a plain submit: there is nothing
+        left to hand off.  Requires paged storage."""
+        if not self._batcher.can_migrate():
+            raise ValueError("prefill handoff requires paged storage "
+                             "(pass page_size)")
+        return self._submit(prompt, max_new_tokens, temperature, seed,
+                            eos_id, top_k, top_p, stream=False,
+                            handoff=True)
+
+    def import_session(self, blob: bytes):
+        """Schedule a migration blob for import on the loop thread;
+        returns a queue yielding the session's FINAL token list at
+        completion (exactly like :meth:`submit`), or ``("refused",
+        reason)`` when the pool cannot take it (reasons enumerate
+        :data:`tpushare.serving.migrate.MIGRATION_REFUSAL_REASONS`),
+        or None on shutdown."""
+        sink = self._q.Queue(maxsize=1)
+        with self._lock:
+            self._mig_cmds.append(("import", blob, sink))
+        self._work.set()
+        return sink
+
+    def migrate_out(self, timeout: float = 30.0):
+        """Export ONE decoding session off the pool (loop thread does
+        the work): returns ``(rid, blob)`` — the session's slot and
+        pages are FREED, its client's sink stays wired awaiting
+        :meth:`deliver_migrated` / :meth:`reimport` — or None when
+        nothing is migratable.  The /drain ``migrate_to`` sender
+        half."""
+        q = self._q.Queue(maxsize=1)
+        with self._lock:
+            self._mig_cmds.append(("export", q))
+        self._work.set()
+        try:
+            return q.get(timeout=timeout)
+        except self._q.Empty:
+            return None
+
+    def deliver_migrated(self, rid: int, tokens: List[int]) -> None:
+        """The peer finished migrated-out session ``rid``: route its
+        final token list to the local client's still-wired sink."""
+        with self._lock:
+            self._mig_cmds.append(("deliver", rid, tokens))
+        self._work.set()
+
+    def reimport(self, rid: int, blob: bytes) -> None:
+        """The peer refused migrated-out session ``rid``: scatter the
+        blob back into the local pool and resume serving it here (its
+        sink wiring is restored; retried with backoff until capacity
+        frees — the session's pages were just released, so it fits
+        once in-flight admissions settle)."""
+        with self._lock:
+            self._mig_cmds.append(("reimport", rid, blob, 0))
+        self._work.set()
+
     def _submit(self, prompt, max_new_tokens, temperature, seed, eos_id,
-                top_k, top_p, stream: bool, on_complete=None):
+                top_k, top_p, stream: bool, on_complete=None,
+                handoff: bool = False):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
         if self._spec_k:
@@ -1910,7 +2047,7 @@ class ContinuousService:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
                  top_k, top_p, stream, sink, on_complete,
-                 time.perf_counter()))
+                 time.perf_counter(), handoff))
         self._work.set()
         return sink
 
@@ -1939,6 +2076,7 @@ class ContinuousService:
                     self._batcher.cancel(rid)
                     del self._stream_sinks[rid]
                     self._req_meta.pop(rid, None)
+                    self._forget_session(rid)
                     break
             else:
                 for rid, s in list(self._sinks.items()):
@@ -1946,7 +2084,275 @@ class ContinuousService:
                         self._batcher.cancel(rid)
                         del self._sinks[rid]
                         self._req_meta.pop(rid, None)
+                        self._forget_session(rid)
                         break
+
+    def _forget_session(self, rid: int) -> None:
+        """Drop a cancelled request's migration-plane state: a SPILLED
+        session's blob (its slot/pages were never re-acquired, so the
+        batcher-side cancel found nothing) and any pending handoff/
+        residency bookkeeping."""
+        self._resident_since.pop(rid, None)
+        self._handoff_rids.discard(rid)
+        if self._spill is not None and self._spill.take(rid) is not None:
+            metrics.CANCELLATIONS.inc()
+            self._observe_spill()
+
+    # -- KV-page migration: loop-thread halves -------------------------
+    def _observe_spill(self) -> None:
+        if self._spill is not None:
+            metrics.SPILL_BYTES.set(self._spill.bytes_used)
+            metrics.SPILL_SESSIONS.set(len(self._spill))
+
+    def _abort_rid(self, rid: int) -> None:
+        """Terminal failure for an in-flight request: sentinel its sink
+        the way stop() would (None / ("aborted", None))."""
+        self._req_meta.pop(rid, None)
+        self._handoff_rids.discard(rid)
+        sink = self._sinks.pop(rid, None)
+        if sink is not None:
+            try:
+                sink.put_nowait(None)
+            except self._q.Full:
+                pass
+            return
+        entry = self._stream_sinks.pop(rid, None)
+        if entry is not None:
+            entry[0].put(("aborted", None))
+
+    def _spill_one(self) -> bool:
+        """Export the longest-resident decoding session past its
+        residency quantum into the host-RAM store, freeing its slot
+        and pages.  False when nothing is eligible or the store's byte
+        budget refuses (the victim then stays resident — counted)."""
+        if self._spill is None:
+            return False
+        now = time.monotonic()
+        cands = sorted(
+            (self._resident_since.get(s.request_id, 0.0), s.request_id)
+            for s in self._batcher.slots.values()
+            if s.request_id not in self._handoff_rids)
+        for since, rid in cands:
+            if now - since < self._spill_idle_s:
+                break       # longest-resident is still in quantum
+            blob = self._batcher.export_session(rid)
+            if not self._spill.put(rid, blob):
+                metrics.MIGRATION_REFUSED.inc(reason="spill_budget")
+                return False
+            self._batcher.pop_session(rid)
+            self._resident_since.pop(rid, None)
+            metrics.MIGRATIONS_OUT.inc(kind="spill")
+            RECORDER.record("session_spilled", rid=rid,
+                            bytes=len(blob))
+            self._observe_spill()
+            return True
+        return False
+
+    def _restore_spilled(self) -> None:
+        """Fault parked sessions back into the pool, oldest first —
+        only while the waiting queue is empty (new admissions keep
+        FIFO priority over re-residency; a restored session would
+        otherwise be re-spilled before decoding a token, starving it
+        behind a long queue)."""
+        if self._spill is None or not len(self._spill):
+            return
+        with self._lock:
+            if self._waiting:
+                return
+        while self._batcher.free_slots():
+            rid = self._spill.oldest()
+            if rid is None:
+                return
+            blob = self._spill.take(rid)
+            t0 = time.perf_counter()
+            try:
+                got = self._batcher.import_session(blob, rid=rid)
+            except Exception:
+                log.exception("restoring spilled session %d failed; "
+                              "aborting it", rid)
+                self._abort_rid(rid)
+                self._observe_spill()
+                continue
+            if got is None:
+                # pages still short: back to the FRONT (it keeps its
+                # restore priority), retry when capacity frees
+                self._spill.put(rid, blob, front=True)
+                return
+            metrics.SPILL_RESTORE.observe(time.perf_counter() - t0)
+            metrics.MIGRATIONS_IN.inc(kind="restore")
+            RECORDER.record("session_restored", rid=rid)
+            self._resident_since[rid] = time.monotonic()
+            self._observe_spill()
+
+    def _sweep_handoffs(self) -> None:
+        """Export prefill-handoff submits the moment they ACTIVATE:
+        the slot releases and the client's sink yields ("handoff",
+        blob) — the disaggregation boundary.  Requests that completed
+        at activation deliver tokens through the normal drain."""
+        if not self._handoff_rids:
+            return
+        b = self._batcher
+        by_rid = {s.request_id: i for i, s in b.slots.items()}
+        for rid in list(self._handoff_rids):
+            if rid in b.completed:
+                self._handoff_rids.discard(rid)   # nothing to hand off
+                continue
+            if rid not in by_rid:
+                continue                          # still prefilling
+            self._handoff_rids.discard(rid)
+            blob = b.export_session(rid)
+            b.pop_session(rid)
+            self._resident_since.pop(rid, None)
+            metrics.MIGRATIONS_OUT.inc(kind="handoff")
+            self._req_meta.pop(rid, None)
+            sink = self._sinks.pop(rid, None)
+            if sink is not None:
+                sink.put(("handoff", blob))
+
+    def _drain_migrations(self) -> None:
+        """Loop-thread half of the migration command queue."""
+        with self._lock:
+            if not self._mig_cmds:
+                return
+            cmds, self._mig_cmds = self._mig_cmds, []
+        retry = []
+        for cmd in cmds:
+            try:
+                if cmd[0] == "export":
+                    self._mig_export(cmd[1])
+                elif cmd[0] == "import":
+                    self._mig_import(cmd[1], cmd[2])
+                elif cmd[0] == "deliver":
+                    self._mig_deliver(cmd[1], cmd[2])
+                elif cmd[0] == "reimport":
+                    if not self._mig_reimport(cmd[1], cmd[2]):
+                        if cmd[3] >= 10_000:
+                            log.error("reimport of session %d starved; "
+                                      "aborting it", cmd[1])
+                            self._migrated_sinks.pop(cmd[1], None)
+                            self._abort_rid(cmd[1])
+                        else:
+                            retry.append(("reimport", cmd[1], cmd[2],
+                                          cmd[3] + 1))
+            except Exception:
+                # one poisoned command must NEVER kill the serving loop
+                # (every request on the replica would hang); the
+                # command's own handlers already map the expected
+                # failures to counted refusals — this is the backstop
+                log.exception("migration command %r failed; dropped",
+                              cmd[0])
+        if retry:
+            with self._lock:
+                self._mig_cmds.extend(retry)
+
+    def _mig_export(self, reply) -> None:
+        b = self._batcher
+        rid = None
+        if b.can_migrate():
+            for s in b.slots.values():
+                if s.request_id not in self._handoff_rids:
+                    rid = s.request_id
+                    break
+        if rid is None:
+            reply.put(None)
+            return
+        blob = b.export_session(rid)
+        b.pop_session(rid)
+        self._resident_since.pop(rid, None)
+        # the local client's sink stays wired: the peer's finished
+        # stream (deliver_migrated) or a reimport routes back to it
+        sink = self._sinks.pop(rid, None)
+        if sink is not None:
+            self._migrated_sinks[rid] = {"stream": False, "sink": sink}
+        else:
+            se = self._stream_sinks.pop(rid, None)
+            self._migrated_sinks[rid] = (
+                {"stream": True, "sink": se[0], "pushed": se[1],
+                 "on_complete": se[2]} if se is not None
+                else {"stream": False, "sink": None})
+        metrics.MIGRATIONS_OUT.inc(kind="drain")
+        RECORDER.record("session_migrated_out", rid=rid,
+                        bytes=len(blob))
+        reply.put((rid, blob))
+
+    def _mig_import(self, blob, sink) -> None:
+        from . import migrate
+        b = self._batcher
+
+        def refuse(reason):
+            metrics.MIGRATION_REFUSED.inc(reason=reason)
+            RECORDER.record("migration_refused", reason=reason)
+            sink.put(("refused", reason))
+
+        if not b.can_migrate():
+            refuse("unsupported_storage")
+            return
+        try:
+            rid = b.import_session(blob)
+            # capacity backpressure: the spill tier (when on) makes
+            # room the same way admission does
+            while rid is None and self._spill_one():
+                rid = b.import_session(blob)
+        except migrate.ConfigMismatch:
+            refuse("config_mismatch")
+            return
+        except migrate.BlobError:
+            refuse("bad_blob")
+            return
+        if rid is None:
+            refuse("pool_full")
+            return
+        slot = next(s for s in b.slots.values() if s.request_id == rid)
+        self._req_meta[rid] = [time.perf_counter(), slot.prompt_len,
+                               None]
+        self._sinks[rid] = sink
+        self._resident_since[rid] = time.monotonic()
+        metrics.MIGRATIONS_IN.inc(kind="import")
+        RECORDER.record("session_migrated_in", rid=rid,
+                        bytes=len(blob))
+
+    def _mig_deliver(self, rid: int, tokens: List[int]) -> None:
+        entry = self._migrated_sinks.pop(rid, None)
+        if entry is None:
+            return
+        self._observe_request(rid, len(tokens))
+        if entry.get("stream"):
+            pushed = entry.get("pushed", 0)
+            if len(tokens) > pushed:
+                entry["sink"].put(("delta", tokens[pushed:]))
+            cb = entry.get("on_complete")
+            if cb is not None:
+                try:
+                    cb(tokens)
+                except Exception:
+                    log.exception("migrated on_complete raised; "
+                                  "continuing")
+            entry["sink"].put(("done", tokens))
+        elif entry["sink"] is not None:
+            entry["sink"].put(tokens)
+
+    def _mig_reimport(self, rid: int, blob) -> bool:
+        try:
+            got = self._batcher.import_session(blob, rid=rid)
+        except Exception:
+            log.exception("reimport of session %d failed; aborting it",
+                          rid)
+            self._migrated_sinks.pop(rid, None)
+            self._abort_rid(rid)
+            return True
+        if got is None:
+            return False
+        entry = self._migrated_sinks.pop(rid, None)
+        if entry is not None:
+            if entry.get("stream"):
+                self._stream_sinks[rid] = [
+                    entry["sink"], entry.get("pushed", 0),
+                    entry.get("on_complete")]
+            elif entry["sink"] is not None:
+                self._sinks[rid] = entry["sink"]
+        self._resident_since[rid] = time.monotonic()
+        metrics.MIGRATIONS_IN.inc(kind="import")
+        return True
 
     def _observe_request(self, rid: int, out_len: int) -> None:
         """Feed the request-level histograms at completion (loop thread).
@@ -1956,6 +2362,7 @@ class ContinuousService:
         everything at once, so TTFT is the full latency and per-token
         time spreads it over the generated tokens.
         """
+        self._resident_since.pop(rid, None)   # migration bookkeeping
         meta = self._req_meta.pop(rid, None)
         if meta is None:
             return
@@ -1984,6 +2391,9 @@ class ContinuousService:
                 "active": len(self._batcher.slots),
                 "prefilling": len(self._batcher.prefilling),
                 "queued": queued}
+        if self._spill is not None:
+            snap["spilled"] = len(self._spill)
+            snap["spill_bytes"] = self._spill.bytes_used
         if self._spec_k:
             st = dict(self._batcher._spec_stats)
             st["tokens_per_round"] = (round(st["tokens"] / st["rounds"], 3)
@@ -2014,24 +2424,37 @@ class ContinuousService:
             if not self._work.wait(timeout=0.5):
                 continue   # stay asleep while idle; submit() re-sets it
             self._drain_cancels()
+            self._drain_migrations()
+            self._restore_spilled()
             # Take the waiting handoff under the lock, then decode without
             # it — admission and ticks only touch loop-owned state.
-            while self._batcher.free_slots():
+            while True:
                 with self._lock:
                     if not self._waiting:
                         break
                     item = self._waiting.pop(0)
                 (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
-                 sink, on_cb, t_sub) = item
-                rid = self._batcher.admit_chunked(
-                    prompt, max_new, temperature=temp, seed=seed,
-                    chunk=self._prefill_chunk, eos_id=eos_id,
-                    top_k=tk, top_p=tp)
+                 sink, on_cb, t_sub, handoff) = item
+                rid = None
+                while True:
+                    if self._batcher.free_slots():
+                        rid = self._batcher.admit_chunked(
+                            prompt, max_new, temperature=temp,
+                            seed=seed, chunk=self._prefill_chunk,
+                            eos_id=eos_id, top_k=tk, top_p=tp)
+                        if rid is not None:
+                            break
+                    # Backpressure (no slot, or paged storage out of
+                    # pages): the SPILL TIER parks the longest-resident
+                    # decoding session in host RAM and retries — the
+                    # capacity multiplier.  Bounded: each pass removes
+                    # one resident session.
+                    if not self._spill_one():
+                        break
                 if rid is None:
-                    # Backpressure beyond free slots (paged storage can
-                    # run out of pages with slots still free): requeue at
-                    # the FRONT and stop admitting until a tick releases
-                    # capacity — dropping here would strand the sink.
+                    # No spill capacity either: requeue at the FRONT
+                    # and stop admitting until a tick releases capacity
+                    # — dropping here would strand the sink.
                     with self._lock:
                         self._waiting.insert(0, item)
                     break
@@ -2043,6 +2466,9 @@ class ContinuousService:
                 # 1-token request finishes in advance_prefill); results
                 # are delivered by the post-tick completed drain below
                 self._req_meta[rid] = [t_sub, len(prompt), None]
+                self._resident_since[rid] = time.monotonic()
+                if handoff:
+                    self._handoff_rids.add(rid)
                 if stream:
                     self._stream_sinks[rid] = [sink, len(prompt), on_cb]
                 else:
@@ -2088,6 +2514,10 @@ class ContinuousService:
                 active = self._batcher.tick_fused(self._decode_chunk)
             else:
                 active = self._batcher.tick()
+            # prefill-handoff submits export the moment they activate
+            # (BEFORE stream/completed delivery: a handed-off session
+            # must never also deliver tokens locally)
+            self._sweep_handoffs()
             # streaming deltas: push whatever each live streaming slot
             # grew this iteration (the loop thread owns slot outputs)
             if self._stream_sinks:
@@ -2129,5 +2559,9 @@ class ContinuousService:
             with self._lock:
                 if (not active and not self._batcher.prefilling
                         and not self._waiting and not self._sinks
-                        and not self._stream_sinks):
+                        and not self._stream_sinks
+                        and not self._mig_cmds
+                        and not self._migrated_sinks
+                        and not (self._spill is not None
+                                 and len(self._spill))):
                     self._work.clear()

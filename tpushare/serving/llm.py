@@ -118,7 +118,8 @@ class LLMServer:
                  spec_k: int = 0,
                  prefix_cache: bool = False,
                  prefill_budget: int = 0,
-                 mixed_step: bool = True):
+                 mixed_step: bool = True,
+                 spill_bytes: int = 0):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
@@ -181,7 +182,8 @@ class LLMServer:
                 spec_k=spec_k,
                 prefix_cache=prefix_cache,
                 mixed_step=mixed_step,
-                prefill_budget=prefill_budget or None).start()
+                prefill_budget=prefill_budget or None,
+                spill_bytes=spill_bytes or None).start()
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -195,6 +197,11 @@ class LLMServer:
             # router calls this on health eviction and undoes ITS
             # drains with {"undrain": true} on recovery)
             ("POST", "/drain"): self._drain,
+            # KV-page migration receiver: scatter a peer's session blob
+            # into this pool and decode it to completion (the decode
+            # half of prefill/decode disaggregation, and the target of
+            # /drain {"migrate_to": ...} hand-offs)
+            ("POST", "/migrate_in"): self._migrate_in,
             # health-plane view: non-200 exactly when the backend is
             # WEDGED (a stalled dispatch past deadline / failed probe);
             # while draining the body carries draining/drained/inflight
@@ -254,7 +261,16 @@ class LLMServer:
         be REVERSIBLE or a router-evicted replica that recovers would
         503 forever (the fleet router undrains exactly the replicas it
         drained; an operator's rolling-restart drain ends with the
-        process, so nothing else ever needs to undo it)."""
+        process, so nothing else ever needs to undo it).
+        ``{"migrate_to": "host:port"}`` additionally MOVES in-flight
+        decoding sessions to the named peer (KV-page migration) instead
+        of waiting them out: each session's blob POSTs to the peer's
+        /migrate_in, the peer decodes it to completion, and this
+        process proxies the finished stream back to its still-connected
+        client — the fast half of a rolling restart.  A peer refusal
+        resumes the session locally (in-flight work always finishes
+        somewhere)."""
+        migrate_to = None
         with self._inflight_lock:       # atomic vs _begin_request
             if isinstance(body, dict) and body.get("undrain"):
                 was = self._draining.is_set()
@@ -267,7 +283,116 @@ class LLMServer:
                 if not was:
                     log.info("draining: admission stopped; in-flight "
                              "requests run to completion")
-        return 200, self._drain_snapshot()
+                if isinstance(body, dict):
+                    migrate_to = body.get("migrate_to") or None
+        snap = self._drain_snapshot()
+        if migrate_to is not None:
+            if self._service is None or \
+                    not self._service._batcher.can_migrate():
+                from . import metrics
+                metrics.MIGRATION_REFUSED.inc(
+                    reason="unsupported_storage")
+                snap["migrating_to"] = None
+                snap["Error"] = ("migrate_to needs paged slot-pool "
+                                 "serving (--slots + --page-size)")
+            else:
+                threading.Thread(
+                    target=self._migrate_sessions, args=(migrate_to,),
+                    daemon=True,
+                    name="tpushare-drain-migrate").start()
+                snap["migrating_to"] = migrate_to
+        return 200, snap
+
+    def _migrate_sessions(self, target: str) -> None:
+        """Move every decoding session to ``target`` (host:port), one
+        blob at a time, proxying each finished stream back to the
+        local client.  A transfer failure re-imports the session
+        locally and stops — the remaining sessions drain the classic
+        way (run to completion here)."""
+        import urllib.request
+
+        from . import migrate
+        if "://" not in target:
+            target = f"http://{target}"
+        moved = 0
+        while True:
+            got = self._service.migrate_out()
+            if got is None:
+                break
+            rid, blob = got
+            try:
+                req = urllib.request.Request(
+                    f"{target}/migrate_in",
+                    data=json.dumps(
+                        {"blob": migrate.encode_blob(blob)}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    payload = json.loads(resp.read())
+                tokens = payload["tokens"][0]
+            except Exception as e:
+                log.warning("session %d hand-off to %s failed (%s); "
+                            "resuming locally", rid, target, e)
+                self._service.reimport(rid, blob)
+                break
+            self._service.deliver_migrated(rid, tokens)
+            moved += 1
+        if moved:
+            log.info("drain migrated %d session(s) to %s", moved,
+                     target)
+
+    def _migrate_in(self, body):
+        refused = self._begin_request()
+        if refused is not None:
+            return refused
+        try:
+            return self._migrate_in_impl(body)
+        finally:
+            self._end_request()
+
+    def _migrate_in_impl(self, body):
+        """Import a migration blob and serve the session to COMPLETION:
+        responds like /generate (``{"tokens": [[...]]}``, the full
+        stream including what the sender already generated), so drain
+        senders and the disaggregating router can proxy the result
+        straight back to the original client.  Refusals answer 409
+        (the router's local-decode-fallback trigger) with the counted
+        reason."""
+        import queue as _q
+
+        from . import metrics, migrate
+
+        if self._service is None or \
+                not self._service._batcher.can_migrate():
+            metrics.MIGRATION_REFUSED.inc(reason="unsupported_storage")
+            return 409, {"Error": "migration refused: "
+                                  "unsupported_storage (this replica "
+                                  "runs without --slots/--page-size)"}
+        data = body.get("blob") if isinstance(body, dict) else None
+        if not isinstance(data, str) or not data:
+            return 400, {"Error": "body must carry blob: <base64>"}
+        try:
+            blob = migrate.decode_blob(data)
+            arrived = len(migrate.blob_meta(blob)["slot"]["output"])
+        except (migrate.BlobError, KeyError, TypeError):
+            metrics.MIGRATION_REFUSED.inc(reason="bad_blob")
+            return 400, {"Error": "migration refused: bad_blob"}
+        sink = self._service.import_session(blob)
+        try:
+            out = sink.get(timeout=600)
+        except _q.Empty:
+            return 504, {"Error": "migrated session timed out"}
+        if out is None:
+            return 503, {"Error": "server shutting down"}
+        if isinstance(out, tuple) and out and out[0] == "refused":
+            return 409, {"Error": f"migration refused: {out[1]}"}
+        with self._gen_lock:
+            self.requests_served += 1
+            self.sequences_served += 1
+            # only the tokens THIS replica decoded count here; the
+            # sender's share is in its own stats
+            self.tokens_generated += max(0, len(out) - arrived)
+        return 200, {"tokens": [out]}
 
     def _healthz(self, _body=None):
         from ..telemetry.health import MONITOR
@@ -336,6 +461,11 @@ class LLMServer:
         if max(len(row) for row in tokens) + max_new > self.cfg.max_seq:
             return 400, {"Error": f"prompt+max_new_tokens exceeds "
                                   f"max_seq={self.cfg.max_seq}"}
+        phase = body.get("phase", "full")
+        if phase not in ("full", "prefill"):
+            return 400, {"Error": "phase must be 'full' or 'prefill'"}
+        if phase == "prefill":
+            return self._generate_prefill_only(tokens, fields)
         if self._service is not None:
             # greedy and sampling both ride the slot pool (per-slot
             # temperature/keys) — no second KV cache beside the pool
@@ -392,6 +522,44 @@ class LLMServer:
             self.tokens_generated += sum(
                 len(r) - len(row) for r, row in zip(rows, tokens))
         return 200, self._result(rows, text_mode)
+
+    def _generate_prefill_only(self, tokens, fields):
+        """The disaggregation SENDER half of /generate: prefill the
+        prompt, sample the first token, and answer with the exported
+        session blob (``{"migration": <base64>}``) for the router to
+        stream to a decode replica's /migrate_in — or, when the
+        request COMPLETES at activation (max_new 1 / instant eos),
+        with the finished tokens like a plain /generate."""
+        import queue as _q
+
+        from . import migrate
+
+        if self._service is None or \
+                not self._service._batcher.can_migrate():
+            return 400, {"Error": "phase='prefill' needs paged "
+                                  "slot-pool serving (--slots + "
+                                  "--page-size)"}
+        if len(tokens) != 1:
+            return 400, {"Error": "phase='prefill' takes exactly one "
+                                  "prompt row"}
+        sink = self._service.submit_handoff(
+            [int(t) for t in tokens[0]], fields["max_new"],
+            temperature=fields["temperature"], seed=fields["seed"],
+            eos_id=fields["eos_id"], top_k=fields["top_k"],
+            top_p=fields["top_p"])
+        try:
+            out = sink.get(timeout=600)
+        except _q.Empty:
+            return 504, {"Error": "prefill timed out"}
+        if out is None:
+            return 503, {"Error": "server shutting down"}
+        with self._gen_lock:
+            self.requests_served += 1
+            self.sequences_served += 1
+            self.tokens_generated += 1     # the sampled first token
+        if isinstance(out, tuple) and out and out[0] == "handoff":
+            return 200, {"migration": migrate.encode_blob(out[1])}
+        return 200, {"tokens": [out]}      # completed at activation
 
     def _parse_gen_fields(self, body):
         """The ONE parse/validate path for /generate and /generate_stream
@@ -723,6 +891,16 @@ def main(argv=None) -> int:
                     help="reuse completed requests' prompt-prefix KV "
                          "pages for same-prefix admissions (requires "
                          "--page-size; full-causal models)")
+    ap.add_argument("--spill-bytes", type=int, default=0,
+                    help="host-RAM byte budget for the KV spill tier "
+                         "(0 = off; requires --slots and --page-size): "
+                         "admission past the pool's page capacity "
+                         "parks the longest-resident session's KV in "
+                         "host RAM and faults it back in when pressure "
+                         "subsides — more concurrent sessions per HBM "
+                         "byte, on top of --kv-dtype int8's ~2x.  "
+                         "TPUSHARE_SPILL_IDLE_S sets the minimum "
+                         "residency before a session may spill")
     ap.add_argument("--prefill-budget", type=int, default=0,
                     help="prompt tokens one mixed service round may "
                          "coalesce into its single-dispatch prefill "
@@ -734,6 +912,8 @@ def main(argv=None) -> int:
                          "decode dispatch per round (the reference "
                          "interleave)")
     args = ap.parse_args(argv)
+    if args.spill_bytes and not args.page_size:
+        ap.error("--spill-bytes requires --slots and --page-size")
     if args.prefill_budget and not args.slots:
         ap.error("--prefill-budget requires --slots")
     if args.sequential_prefill and not args.slots:
@@ -787,7 +967,8 @@ def main(argv=None) -> int:
                     n_pages=args.kv_pages, tp=args.tp,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
-                    mixed_step=not args.sequential_prefill)
+                    mixed_step=not args.sequential_prefill,
+                    spill_bytes=args.spill_bytes)
     # Tenant accounting: when the allocation injected a daemon status
     # port, report this tenant's usage (HBM peak + device-time/goodput/
     # qps/stalls, contract.report_usage) on a low-frequency loop — the

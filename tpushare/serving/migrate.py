@@ -1,0 +1,256 @@
+"""KV-page migration: THE wire format for moving a live session's KV.
+
+One serialized form — a length-prefixed binary blob holding a session's
+committed KV pages (int8 pools ship their ``[page, 1]`` f32 scale
+leaves alongside, so the transfer is ~half the bf16 bytes), its
+page-table layout, and the full host-side slot state (output so far,
+remaining budget, sampling knobs, and the CURRENT PRNG key data, so a
+sampled stream resumes on the receiver exactly where it left off) —
+shared by all three consumers:
+
+* **prefill/decode disaggregation**: a prefill replica exports the
+  session at the activation boundary and the router streams the blob to
+  a decode replica's ``POST /migrate_in``;
+* **live drain hand-off**: ``POST /drain {"migrate_to": url}`` moves
+  in-flight sessions to a peer instead of waiting them out;
+* **host-RAM spill tier**: idle/preempted sessions park their blob in
+  the byte-budgeted :class:`HostSpillStore` and fault back in on their
+  next turn.
+
+This module is the ONE place KV wire (de)serialization lives
+(lint-enforced: tpulint rule ``migration-wire-confinement`` — a second
+hand-rolled codec would fork the format).  numpy + stdlib only, no jax:
+the codec must be importable from processes that own no chip (tests,
+tooling); the device gather/scatter halves live with the paged batcher
+(:meth:`tpushare.serving.paged.PagedContinuousBatcher.export_session` /
+``import_session``).
+
+Why migrated streams stay exact: paged KV is position-indexed through
+the page table, so copying the distinct pages a slot references
+byte-for-byte and rebuilding the same table STRUCTURE (range -> local
+page index) on the receiver reproduces identical attention reads — the
+trash page, position masks, and past-the-end routing behave exactly as
+they did on the sender (DESIGN.md "KV-page migration").  int8 pools
+quantized at write time travel as their quantized bytes, so
+re-serving them cannot re-round anything.
+"""
+
+from __future__ import annotations
+
+import base64
+import collections
+import json
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: wire magic + format version (bump on any layout change; receivers
+#: refuse unknown versions instead of guessing)
+WIRE_MAGIC = b"TPUSKV1\n"
+WIRE_VERSION = 1
+
+#: every reason an incoming migration blob can be refused — the
+#: enumerated values of ``tpushare_migration_refused_total{reason=}``
+#: (enum-linted in tests/test_metric_lint.py like the other reason
+#: families): ``pool_full`` = the receiver's page pool / slot pool
+#: cannot fit the session right now (the router's local-decode-fallback
+#: trigger); ``config_mismatch`` = the blob's model/storage fingerprint
+#: differs from the receiver's (a blob is only portable between
+#: same-model same-layout replicas); ``bad_blob`` = the bytes do not
+#: parse as a versioned session blob; ``unsupported_storage`` = the
+#: receiver serves a non-paged pool (dense slots have no page
+#: primitive); ``spill_budget`` = the host-RAM spill store's byte
+#: budget is exhausted, so the would-be victim stays resident instead
+MIGRATION_REFUSAL_REASONS = ("pool_full", "config_mismatch", "bad_blob",
+                             "unsupported_storage", "spill_budget")
+
+#: the ``kind`` label values of ``tpushare_migrations_out_total`` /
+#: ``tpushare_migrations_in_total`` (enum-linted): out = why a session
+#: left this pool, in = how one arrived
+MIGRATION_OUT_KINDS = ("handoff", "spill", "drain")
+MIGRATION_IN_KINDS = ("import", "restore")
+
+
+class BlobError(ValueError):
+    """The bytes are not a (known-version) session blob."""
+
+
+class ConfigMismatch(ValueError):
+    """The blob's model/storage fingerprint differs from the receiver's
+    (the ``config_mismatch`` refusal: a session blob is only portable
+    between same-model, same-layout replicas)."""
+
+
+def config_fingerprint(cfg, page_size: int) -> dict:
+    """The compatibility contract a blob carries: everything that must
+    MATCH between sender and receiver for a page-for-page import to
+    reproduce the same stream (model geometry, KV storage dtype, page
+    geometry).  Duck-typed over ModelConfig so this module stays
+    jax-free."""
+    return {
+        "vocab": int(cfg.vocab), "d_model": int(cfg.d_model),
+        "n_layers": int(cfg.n_layers), "n_heads": int(cfg.n_heads),
+        "n_kv_heads": int(cfg.n_kv_heads), "d_ff": int(cfg.d_ff),
+        "max_seq": int(cfg.max_seq),
+        "window": (int(cfg.window) if cfg.window is not None else None),
+        "kv_dtype": str(cfg.kv_dtype),
+        "page_size": int(page_size),
+    }
+
+
+def pack_session(meta: dict, arrays: "Dict[str, np.ndarray]") -> bytes:
+    """``meta`` (JSON-serializable session state) + named numpy arrays
+    -> one length-prefixed blob.
+
+    Layout: magic | u64 header length | header JSON | raw array bytes
+    (C-order, concatenated in directory order).  The header carries the
+    array directory (name/dtype/shape/nbytes), so unpacking needs no
+    second schema source."""
+    directory: List[dict] = []
+    payloads: List[bytes] = []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        # dtype travels by NAME, not .str: extension dtypes (jax's
+        # bfloat16 via ml_dtypes) stringify as opaque void records
+        # ("|V2") that cannot round-trip
+        directory.append({"name": name, "dtype": a.dtype.name,
+                          "shape": list(a.shape), "nbytes": a.nbytes})
+        payloads.append(a.tobytes())
+    header = json.dumps({"version": WIRE_VERSION, "meta": meta,
+                         "arrays": directory},
+                        sort_keys=True).encode()
+    return b"".join([WIRE_MAGIC, struct.pack(">Q", len(header)), header]
+                    + payloads)
+
+
+def _parse_header(blob: bytes) -> Tuple[dict, int]:
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise BlobError("session blob must be bytes")
+    blob = bytes(blob)
+    if len(blob) < len(WIRE_MAGIC) + 8 or \
+            blob[:len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise BlobError("not a tpushare session blob (bad magic)")
+    (hlen,) = struct.unpack(
+        ">Q", blob[len(WIRE_MAGIC):len(WIRE_MAGIC) + 8])
+    start = len(WIRE_MAGIC) + 8
+    if len(blob) < start + hlen:
+        raise BlobError("truncated session blob header")
+    try:
+        header = json.loads(blob[start:start + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise BlobError(f"unparsable session header: {e}") from None
+    if header.get("version") != WIRE_VERSION:
+        raise BlobError(f"unknown session blob version "
+                        f"{header.get('version')!r}")
+    return header, start + hlen
+
+
+def blob_meta(blob: bytes) -> dict:
+    """The session meta alone (receivers pre-validate compatibility and
+    size the reservation before touching array bytes)."""
+    header, _ = _parse_header(blob)
+    return header["meta"]
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16, float8_*) register with numpy on
+        # import; ml_dtypes ships with jax but this module must not
+        # import jax itself
+        import ml_dtypes  # noqa: F401
+        return np.dtype(name)
+
+
+def unpack_session(blob: bytes) -> Tuple[dict, "Dict[str, np.ndarray]"]:
+    """Blob -> (meta, {name: array}); raises :class:`BlobError` on any
+    structural problem (the ``bad_blob`` refusal)."""
+    header, off = _parse_header(blob)
+    arrays: "collections.OrderedDict[str, np.ndarray]" = \
+        collections.OrderedDict()
+    for entry in header["arrays"]:
+        n = int(entry["nbytes"])
+        if off + n > len(blob):
+            raise BlobError(f"truncated array payload {entry['name']!r}")
+        try:
+            dtype = _wire_dtype(entry["dtype"])
+        except TypeError as e:
+            raise BlobError(f"unknown wire dtype "
+                            f"{entry['dtype']!r}: {e}") from None
+        arr = np.frombuffer(blob[off:off + n], dtype=dtype)
+        arrays[entry["name"]] = arr.reshape(entry["shape"])
+        off += n
+    return header["meta"], arrays
+
+
+def encode_blob(blob: bytes) -> str:
+    """Blob -> base64 string for the JSON HTTP surfaces (the router
+    relays this string verbatim; only sender and receiver decode)."""
+    return base64.b64encode(blob).decode("ascii")
+
+
+def decode_blob(data: str) -> bytes:
+    try:
+        return base64.b64decode(data.encode("ascii"), validate=True)
+    except Exception as e:
+        raise BlobError(f"undecodable blob encoding: {e}") from None
+
+
+class HostSpillStore:
+    """Byte-budgeted host-RAM store of spilled session blobs.
+
+    Restore order is FIFO over spill time (:meth:`oldest`; a failed
+    restore re-parks at the FRONT via ``put(front=True)``), and it
+    never evicts silently: a parked blob IS a live client's session,
+    so when the budget is exhausted :meth:`put` refuses (the would-be
+    victim stays resident in HBM, counted
+    ``tpushare_migration_refused_total{reason="spill_budget"}`` by the
+    caller) instead of destroying an older session.  Thread-safe; the
+    serving loop owns all mutation in practice."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._blobs: "collections.OrderedDict[int, bytes]" = \
+            collections.OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blobs)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._blobs.values())
+
+    def put(self, key: int, blob: bytes, front: bool = False) -> bool:
+        """Park ``key``'s blob; False when the budget would overflow
+        (nothing stored — the caller keeps the session resident).
+        ``front=True`` re-parks at the HEAD of the restore order (a
+        failed restore keeps its priority instead of going to the
+        back of the line)."""
+        with self._lock:
+            used = sum(len(b) for b in self._blobs.values())
+            if used + len(blob) > self.budget_bytes:
+                return False
+            self._blobs[key] = blob
+            if front:
+                self._blobs.move_to_end(key, last=False)
+            return True
+
+    def take(self, key: int) -> Optional[bytes]:
+        """Remove and return ``key``'s blob (None when absent)."""
+        with self._lock:
+            return self._blobs.pop(key, None)
+
+    def oldest(self) -> Optional[int]:
+        """The key parked longest ago (restore-priority order)."""
+        with self._lock:
+            return next(iter(self._blobs), None)
+
+    def keys(self) -> List[int]:
+        with self._lock:
+            return list(self._blobs)

@@ -45,7 +45,7 @@ import numpy as np
 
 from ..models import transformer
 from . import metrics
-from .continuous import ContinuousBatcher, _sample_next
+from .continuous import ContinuousBatcher, _Slot, _sample_next
 
 log = logging.getLogger("tpushare.serving")
 
@@ -209,6 +209,26 @@ def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
                     next_toks, remainings, actives, temps, keys, tks,
                     tps, pools, k, ngram, n_rounds, rich)
     return (sel,) + out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(pools, ids, blocks):
+    """Write ``blocks`` (pytree matching ``pools`` with page axis
+    ``len(ids)``) into the pool pages ``ids`` — the import half of
+    session migration.  The pool is DONATED so XLA updates it in place
+    instead of holding two full copies across the import (each distinct
+    page count compiles once, like the fused n_steps programs)."""
+    return jax.tree_util.tree_map(
+        lambda pool, blk: pool.at[:, ids].set(blk), pools, blocks)
+
+
+def _store_arrays(prefix: str, store) -> list:
+    """(name, leaf) pairs of one K or V store under the migration wire
+    naming: a bf16 store is one ``k``/``v`` array, an int8 store ships
+    its values and scales as ``k.q``/``k.s`` (etc.)."""
+    if isinstance(store, dict):
+        return [(f"{prefix}.q", store["q"]), (f"{prefix}.s", store["s"])]
+    return [(prefix, store)]
 
 
 @dataclasses.dataclass
@@ -693,3 +713,191 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def free_page_count(self) -> int:
         return len(self._free_pages)
+
+    # -- session migration (export / import / release) -----------------
+    def can_migrate(self) -> bool:
+        return True
+
+    def export_session(self, rid: int) -> bytes:
+        """Serialize DECODING request ``rid`` into one migration blob
+        (:mod:`tpushare.serving.migrate`): the distinct physical pages
+        its table references (content only for pages holding any
+        COMMITTED position — pages reserved ahead of the write
+        frontier carry garbage every consumer overwrites at
+        ``length==p`` before it becomes attendable, so their bytes
+        never travel), the table STRUCTURE (range -> local page
+        index, which reproduces full-causal, ring, and prefix-mapped
+        layouts alike), and the complete slot state including the
+        current PRNG key data.  Read-only: the slot keeps serving
+        until :meth:`pop_session`.  Raises ``KeyError`` for unknown
+        rids and ``ValueError`` for mid-prefill requests (their pages
+        are part-garbage; migration waits for activation)."""
+        from . import migrate
+        slot = self._slot_of(rid)
+        s = self.slots[slot]
+        row = self.page_table[slot]
+        n_ranges = int(np.count_nonzero(row))
+        page = self.page_size
+        ids: List[int] = []
+        local: Dict[int, int] = {}
+        ranges: List[int] = []
+        content = set()
+        for j in range(n_ranges):
+            p = int(row[j])
+            if p not in local:
+                local[p] = len(ids)
+                ids.append(p)
+            ranges.append(local[p])
+            if j * page < s.length:
+                content.add(local[p])
+        content_idx = sorted(content)
+        sel = np.asarray([ids[i] for i in content_idx], np.int32)
+        arrays = {}
+        for prefix, store in (("k", self.pools[0]), ("v", self.pools[1])):
+            for name, leaf in _store_arrays(prefix, store):
+                arrays[name] = np.asarray(leaf[:, sel])
+        key_data = None
+        if s.key is not None:
+            key_data = np.asarray(
+                jax.random.key_data(s.key)).tolist()
+        meta = {
+            "fingerprint": migrate.config_fingerprint(self.cfg,
+                                                      self.page_size),
+            "n_pages": len(ids),
+            "content_pages": content_idx,
+            "ranges": ranges,
+            "slot": {
+                "length": int(s.length),
+                "remaining": int(s.remaining),
+                "last_token": int(s.last_token),
+                "output": [int(t) for t in s.output],
+                "prompt_len": int(s.prompt_len),
+                "temperature": float(s.temperature),
+                "eos_id": (int(s.eos_id) if s.eos_id is not None
+                           else None),
+                "top_k": int(s.top_k),
+                "top_p": float(s.top_p),
+                "key_data": key_data,
+            },
+        }
+        blob = migrate.pack_session(meta, arrays)
+        metrics.MIGRATION_BYTES.inc(len(blob), direction="out")
+        return blob
+
+    def _slot_of(self, rid: int) -> int:
+        for i, s in self.slots.items():
+            if s.request_id == rid:
+                return i
+        for i, p in self.prefilling.items():
+            if p.request_id == rid:
+                raise ValueError(f"request {rid} is mid-prefill; "
+                                 f"sessions migrate at/after activation")
+        raise KeyError(f"no decoding request {rid}")
+
+    def pop_session(self, rid: int) -> None:
+        """Release request ``rid``'s slot and pages WITHOUT completing
+        or cancelling it — the sender-side end of a migration (the
+        stream now lives in the exported blob).  The caller owns
+        delivering the eventual result to the request's client."""
+        slot = self._slot_of(rid)
+        self._req_acct.pop(rid, None)
+        self._release(slot)
+        del self.slots[slot]
+
+    def import_session(self, blob: bytes,
+                       rid: Optional[int] = None) -> Optional[int]:
+        """Scatter a migration blob into free pages and resume the
+        session as a DECODING slot; returns its request id, or None on
+        capacity backpressure (no free slot / pool cannot fit — the
+        ``pool_full`` refusal the router's local-decode fallback keys
+        on).  Raises :class:`~tpushare.serving.migrate.BlobError` /
+        :class:`~tpushare.serving.migrate.ConfigMismatch` for blobs
+        that can NEVER import here.  ``rid`` pins the restored
+        request id (the spill tier re-imports under the original id so
+        its sink wiring survives); default allocates a fresh one."""
+        from . import migrate
+        meta, arrays = migrate.unpack_session(blob)
+        fp = migrate.config_fingerprint(self.cfg, self.page_size)
+        if meta.get("fingerprint") != fp:
+            raise migrate.ConfigMismatch(
+                f"blob fingerprint {meta.get('fingerprint')} != "
+                f"receiver {fp}")
+        # structural validation BEFORE any state mutates: a malformed-
+        # but-parsable header (corrupt peer, crafted request) must be
+        # the counted bad_blob refusal, never an escaping IndexError
+        # that could kill the serving loop mid-import
+        try:
+            need = int(meta["n_pages"])
+            ranges = [int(li) for li in meta["ranges"]]
+            content_idx = [int(i) for i in meta["content_pages"]]
+            st = dict(meta["slot"])
+            st_ints = {k: int(st[k]) for k in
+                       ("length", "remaining", "last_token",
+                        "prompt_len", "top_k")}
+            st_out = [int(t) for t in st["output"]]
+            st_temp = float(st["temperature"])
+            st_top_p = float(st["top_p"])
+            st_eos = (int(st["eos_id"]) if st.get("eos_id") is not None
+                      else None)
+            key = None
+            if st.get("key_data") is not None:
+                key = jax.random.wrap_key_data(jnp.asarray(
+                    np.asarray(st["key_data"], np.uint32)))
+            if not (1 <= need <= len(ranges) <= self.pages_per_slot):
+                raise ValueError(f"{need} pages over {len(ranges)} "
+                                 f"ranges does not fit the table")
+            if any(li < 0 or li >= need for li in ranges) or \
+                    any(i < 0 or i >= need for i in content_idx):
+                raise ValueError("range/content index out of bounds")
+        except (KeyError, TypeError, ValueError) as e:
+            raise migrate.BlobError(
+                f"malformed session meta: {e}") from None
+        free = self.free_slots()
+        if not free:
+            return None
+        if need > len(self._free_pages):
+            self._evict_prefixes(need)
+        if need > len(self._free_pages):
+            return None
+        slot = free[0]
+        pages = [self._free_pages.pop() for _ in range(need)]
+        if content_idx:
+            sel = jnp.asarray([pages[i] for i in content_idx], jnp.int32)
+
+            def rebuild(prefix, store):
+                if isinstance(store, dict):
+                    return {"q": jnp.asarray(arrays[f"{prefix}.q"]),
+                            "s": jnp.asarray(arrays[f"{prefix}.s"])}
+                return jnp.asarray(arrays[prefix])
+
+            try:
+                blocks = (rebuild("k", self.pools[0]),
+                          rebuild("v", self.pools[1]))
+                self.pools = _scatter_pages(self.pools, sel, blocks)
+            except (KeyError, TypeError, ValueError) as e:
+                self._free_pages.extend(pages)
+                raise migrate.BlobError(
+                    f"blob arrays do not match the pool layout: {e}") \
+                    from None
+        self.page_table[slot, :] = 0
+        for j, li in enumerate(ranges):
+            self.page_table[slot, j] = pages[li]
+        self._slot_pages[slot] = pages
+        self._update_page_gauges()
+        if rid is None:
+            rid = self._next_id
+            self._next_id += 1
+        else:
+            self._next_id = max(self._next_id, rid + 1)
+        self.slots[slot] = _Slot(
+            request_id=rid, length=st_ints["length"],
+            remaining=st_ints["remaining"],
+            last_token=st_ints["last_token"],
+            output=st_out,
+            prompt_len=st_ints["prompt_len"],
+            temperature=st_temp, key=key,
+            eos_id=st_eos, top_k=st_ints["top_k"],
+            top_p=st_top_p)
+        self._acct_open(rid, st_ints["prompt_len"])
+        metrics.MIGRATION_BYTES.inc(len(blob), direction="in")
+        return rid

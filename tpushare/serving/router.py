@@ -76,6 +76,17 @@ log = logging.getLogger("tpushare.router")
 #: (enum-linted in tests/test_metric_lint.py, like the fallback reasons)
 ROUTER_POLICIES = ("affinity", "load", "retry")
 
+#: outcomes of a disaggregated prefill->decode hand-off — the
+#: enumerated values of ``tpushare_router_handoffs_total{outcome=}``
+#: (enum-linted): ``ok`` = the blob imported on a decode replica;
+#: ``local_fallback`` = every decode target refused/failed, so the
+#: blob went back to the PREFILL replica for local decode (the
+#: counted receiver-pool-full degradation); ``reprefill`` = the blob
+#: could not land anywhere (e.g. the receiver wedged mid-transfer),
+#: so the request re-dispatched as a plain /generate from scratch —
+#: never corrupted, never duplicated, just re-prefilled
+HANDOFF_OUTCOMES = ("ok", "local_fallback", "reprefill")
+
 #: longest prompt prefix the affinity hash considers, in blocks — a cap
 #: so hashing cost stays O(blocks * prefix), not O(len^2) on huge prompts
 MAX_AFFINITY_BLOCKS = 32
@@ -89,9 +100,13 @@ class Replica:
     also under the router's lock (the worker may outlive an eviction;
     its late decrement must not corrupt the count)."""
 
-    def __init__(self, name: str, address: str):
+    def __init__(self, name: str, address: str, role: str = "any"):
         self.name = name
         self.address = address            # "host:port"
+        #: disaggregation role: "prefill" replicas take new prompts,
+        #: "decode" replicas take the handed-off KV and decode to
+        #: completion, "any" serves both (the non-disaggregated fleet)
+        self.role = role
         self.summary: Optional[dict] = None   # last summarize_serving
         self.evicted_reason: Optional[str] = None
         self.inflight = 0                 # router-side pending forwards
@@ -115,6 +130,7 @@ class Replica:
     def view(self) -> dict:
         """The /fleet JSON entry (point-in-time; lock held by caller)."""
         return {"name": self.name, "address": self.address,
+                "role": self.role,
                 "up": self.in_rotation,
                 "evicted_reason": self.evicted_reason,
                 "inflight": self.inflight,
@@ -133,6 +149,10 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence[Union[str, Tuple[str, str]]],
                  port: int = 0, addr: str = "127.0.0.1", *,
+                 prefill_replicas: Sequence[Union[str,
+                                                  Tuple[str, str]]] = (),
+                 decode_replicas: Sequence[Union[str,
+                                                 Tuple[str, str]]] = (),
                  affinity: bool = True,
                  prefix_block: int = 16,
                  max_affinity_entries: int = 4096,
@@ -145,12 +165,31 @@ class FleetRouter:
                  prefill_heavy_ratio: float = 2.0,
                  watch_poll_s: float = 0.05):
         self._replicas: List[Replica] = []
-        for i, spec in enumerate(replicas):
-            if isinstance(spec, str):
-                self._replicas.append(Replica(f"r{i}", spec))
-            else:
-                name, address = spec
-                self._replicas.append(Replica(name, address))
+
+        def _add(specs, role, prefix):
+            for i, spec in enumerate(specs):
+                if isinstance(spec, str):
+                    self._replicas.append(
+                        Replica(f"{prefix}{i}", spec, role=role))
+                else:
+                    name, address = spec
+                    self._replicas.append(
+                        Replica(name, address, role=role))
+
+        _add(replicas, "any", "r")
+        _add(prefill_replicas, "prefill", "p")
+        _add(decode_replicas, "decode", "d")
+        # PREFILL/DECODE DISAGGREGATION (FlexNPU taken to its
+        # conclusion): with both role lists populated, every /generate
+        # prefills on a prefill replica (phase="prefill" -> session
+        # blob at the activation boundary) and the router streams the
+        # blob to the least-loaded decode replica's /migrate_in — a
+        # prefill storm saturates prefill replicas while decode
+        # replicas keep serving pure-decode rounds.
+        self._disagg = bool(prefill_replicas) and bool(decode_replicas)
+        if (prefill_replicas or decode_replicas) and not self._disagg:
+            raise ValueError("disaggregation needs BOTH prefill and "
+                             "decode replicas")
         if not self._replicas:
             raise ValueError("router needs at least one replica")
         names = [r.name for r in self._replicas]
@@ -446,12 +485,30 @@ class FleetRouter:
         occ = (r.summary or {}).get("occupancy")
         return occ is not None and occ >= self._saturation
 
+    def _repoint_affinity(self, tokens: Optional[List[int]],
+                          name: str) -> None:
+        """Re-register a prompt's prefix-block hashes to ``name`` —
+        after a disaggregated hand-off the DECODE replica holds the
+        session's pages, so it is the new affinity target."""
+        if not self._affinity or not tokens:
+            return
+        hashes = self._prefix_hashes(tokens)
+        with self._lock:
+            for h in hashes:
+                self._affinity_map[h] = name
+                self._affinity_map.move_to_end(h)
+            while len(self._affinity_map) > self._max_affinity_entries:
+                self._affinity_map.popitem(last=False)
+
     def _pick(self, tokens: Optional[List[int]], prefill_heavy: bool,
-              exclude: Sequence[str]) -> Tuple[Optional[Replica], str]:
+              exclude: Sequence[str],
+              role: Optional[str] = None
+              ) -> Tuple[Optional[Replica], str]:
         """Choose a replica and the policy that chose it.  Re-dispatch
         picks (``exclude`` non-empty) are pure load picks labeled
         ``retry`` — the affinity target just failed or is excluded, and
-        a 'hit' that re-routes is not a hit.  Increments the pick's
+        a 'hit' that re-routes is not a hit.  ``role`` restricts the
+        candidates to that disaggregation role.  Increments the pick's
         in-flight count under the lock (the caller's forward owns the
         decrement)."""
         # hash once, OUTSIDE the lock (tuple-hashing long prompts is
@@ -461,7 +518,8 @@ class FleetRouter:
                   if self._affinity and tokens else ())
         with self._lock:
             candidates = [r for r in self._replicas
-                          if r.in_rotation and r.name not in exclude]
+                          if r.in_rotation and r.name not in exclude
+                          and (role is None or r.role == role)]
             if not candidates:
                 return None, "load"
             chosen: Optional[Replica] = None
@@ -489,9 +547,10 @@ class FleetRouter:
             return chosen, policy
 
     # -- forwarding ----------------------------------------------------
-    def _forward(self, r: Replica, data: bytes) -> Tuple[int, object]:
+    def _forward(self, r: Replica, data: bytes,
+                 path: str = "/generate") -> Tuple[int, object]:
         req = urllib.request.Request(
-            f"http://{r.address}/generate", data=data,
+            f"http://{r.address}{path}", data=data,
             headers={"Content-Type": "application/json"}, method="POST")
         try:
             with urllib.request.urlopen(
@@ -503,8 +562,9 @@ class FleetRouter:
             except Exception:
                 return e.code, {"Error": f"replica answered {e.code}"}
 
-    def _forward_watched(self, r: Replica,
-                         data: bytes) -> Optional[Tuple[int, object]]:
+    def _forward_watched(self, r: Replica, data: bytes,
+                         path: str = "/generate"
+                         ) -> Optional[Tuple[int, object]]:
         """Forward in a worker thread, watching the replica's rotation
         state: if ``r`` is evicted while the forward is in flight, the
         worker is ABANDONED (left to finish; never killed — its late
@@ -516,7 +576,7 @@ class FleetRouter:
 
         def worker():
             try:
-                result["resp"] = self._forward(r, data)
+                result["resp"] = self._forward(r, data, path=path)
             except Exception as e:
                 result["err"] = e
             finally:
@@ -556,10 +616,22 @@ class FleetRouter:
         except (TypeError, ValueError):
             max_new = 32                  # replica 400s the real parse
         prefill_heavy = self._prefill_heavy(tokens, max_new)
+        if self._disagg:
+            return self._generate_disagg(body, tokens)
+        return self._forward_balanced(body, tokens, prefill_heavy,
+                                      role=None)
+
+    def _forward_balanced(self, body, tokens, prefill_heavy,
+                          role: Optional[str] = None):
+        """The plain health/affinity/load retry loop over one role
+        class (None = the whole fleet) — the non-disaggregated
+        /generate path, and the re-prefill fallback the disaggregated
+        one degrades to."""
         data = json.dumps(body).encode()
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
-            replica, policy = self._pick(tokens, prefill_heavy, tried)
+            replica, policy = self._pick(tokens, prefill_heavy, tried,
+                                         role=role)
             if replica is None:
                 if tried:
                     # candidates exist but were all tried and failed —
@@ -612,6 +684,142 @@ class FleetRouter:
         return 502, {"Error": f"all forwards failed "
                               f"(tried {', '.join(tried)})"}
 
+    # -- disaggregated prefill/decode routing ---------------------------
+    def _generate_disagg(self, body, tokens):
+        """Prefill/decode-disaggregated /generate: the prompt prefills
+        on a PREFILL replica (``phase="prefill"`` — the replica answers
+        with the session blob at the activation boundary), then the
+        blob streams to the least-loaded DECODE replica's /migrate_in,
+        which serves the decode to completion.  Decode replicas never
+        see prompt chunks, so a prefill storm cannot steal their
+        mixed-round budget — the isolation the co-resident mixed step
+        cannot provide.
+
+        Degradation ladder (every rung counted in
+        ``tpushare_router_handoffs_total{outcome=}``): decode target
+        refuses (pool full) or fails mid-transfer -> the blob goes
+        BACK to the prefill replica for local decode
+        (``local_fallback``); that too fails -> plain re-prefill
+        through the whole fleet (``reprefill`` — the request re-runs
+        from scratch, so a WEDGED receiver can delay a stream but
+        never corrupt or duplicate it: the abandoned blob's orphan is
+        discarded wherever it landed)."""
+        pbody = dict(body)
+        pbody["phase"] = "prefill"
+        pdata = json.dumps(pbody).encode()
+        tried: List[str] = []
+        for attempt in range(self._max_retries + 1):
+            replica, policy = self._pick(tokens, True, tried,
+                                         role="prefill")
+            if replica is None:
+                if tried:
+                    break
+                return 503, {"Error": "no prefill replica in rotation"}
+            if attempt:
+                with self._lock:
+                    self._retries += 1
+                metrics.ROUTER_RETRIES.inc()
+            out = self._forward_watched(replica, pdata)
+            if out is not None and out[0] == 503 and isinstance(
+                    out[1], dict) and "draining" in str(
+                        out[1].get("Error", "")):
+                # same ownership protocol as the balanced path: a
+                # DRAINING refusal evicts with the draining reason (no
+                # ownership-claiming drain of our own) and re-dispatches
+                # — checked BEFORE the generic >=500 class, which would
+                # otherwise swallow the 503
+                self._evict(replica, "draining")
+                tried.append(replica.name)
+                continue
+            if out is None or out[0] >= 500:
+                if out is None:
+                    self._note_failure(
+                        replica, "abandoned (evicted mid-flight, "
+                                 "transport error, or deadline)")
+                tried.append(replica.name)
+                continue
+            code, payload = out
+            with self._lock:
+                replica.requests += 1
+                replica.consecutive_failures = 0
+                if policy == "affinity":
+                    replica.affinity_hits += 1
+            metrics.ROUTER_REQUESTS.inc(replica=replica.name,
+                                        policy=policy)
+            if policy == "affinity":
+                metrics.ROUTER_AFFINITY_HITS.inc(replica=replica.name)
+            if code != 200 or not isinstance(payload, dict) \
+                    or "migration" not in payload:
+                # a 4xx (the replica owns validation) or a request
+                # that COMPLETED at activation — nothing to hand off
+                return code, payload
+            return self._dispatch_handoff(replica, tokens, body,
+                                          payload["migration"])
+        return 502, {"Error": f"all prefill forwards failed "
+                              f"(tried {', '.join(tried)})"}
+
+    def _dispatch_handoff(self, prefill_r: Replica,
+                          tokens: Optional[List[int]], body,
+                          blob64: str):
+        """Land a prefilled session blob: decode replica, then the
+        prefill replica itself (local decode), then re-prefill."""
+        mdata = json.dumps({"blob": blob64}).encode()
+        outcome, result, holder = None, None, None
+        holder_policy = "load"
+        decode_r, dpolicy = self._pick(tokens, False, (), role="decode")
+        if decode_r is not None:
+            result = self._forward_watched(decode_r, mdata,
+                                           path="/migrate_in")
+            if result is not None and result[0] == 200:
+                outcome, holder = "ok", decode_r
+                holder_policy = dpolicy
+            elif result is None:
+                # wedged/evicted mid-transfer: the transport failure
+                # class — the scrape loop owns the health verdict, but
+                # this forward must not wait for it
+                self._note_failure(
+                    decode_r, "abandoned (evicted mid-flight, "
+                              "transport error, or deadline)")
+        if outcome is None:
+            # receiver refused (pool full — counted receiver-side) or
+            # died mid-transfer: LOCAL decode on the prefill replica,
+            # whose pool held the session a moment ago
+            with self._lock:
+                prefill_r.inflight += 1   # _pick increments; mirror it
+            result = self._forward_watched(prefill_r, mdata,
+                                           path="/migrate_in")
+            if result is not None and result[0] == 200:
+                outcome, holder = "local_fallback", prefill_r
+        if outcome is None:
+            # the blob could not land anywhere: re-prefill from
+            # scratch through the whole fleet (idempotent streams make
+            # this safe; an orphan of the blob is discarded wherever
+            # it landed, so no tokens duplicate)
+            metrics.ROUTER_HANDOFFS.inc(outcome="reprefill")
+            metrics.ROUTER_RETRIES.inc()
+            with self._lock:
+                self._retries += 1
+            try:
+                max_new = int(body.get("max_new_tokens", 32))
+            except (TypeError, ValueError):
+                max_new = 32
+            return self._forward_balanced(
+                body, tokens, self._prefill_heavy(tokens, max_new))
+        metrics.ROUTER_HANDOFFS.inc(outcome=outcome)
+        with self._lock:
+            holder.requests += 1
+            holder.consecutive_failures = 0
+            if holder_policy == "affinity":
+                holder.affinity_hits += 1
+        metrics.ROUTER_REQUESTS.inc(replica=holder.name,
+                                    policy=holder_policy)
+        if holder_policy == "affinity":
+            metrics.ROUTER_AFFINITY_HITS.inc(replica=holder.name)
+        # the decode holder now owns the session's pages — future
+        # same-prefix traffic should find them there
+        self._repoint_affinity(tokens, holder.name)
+        return result
+
     def _healthz(self, _body=None):
         with self._lock:
             up = sum(1 for r in self._replicas if r.in_rotation)
@@ -643,9 +851,21 @@ def main(argv=None) -> int:
         prog="tpushare-router",
         description="Load-, prefix-, and health-aware request router "
                     "over N tpushare-llm-server replicas")
-    ap.add_argument("replicas", nargs="+",
+    ap.add_argument("replicas", nargs="*",
                     help="replica addresses, host:port "
                          "(optionally name=host:port)")
+    ap.add_argument("--prefill-replicas", default="",
+                    help="comma-separated PREFILL-role replicas "
+                         "(host:port or name=host:port).  With "
+                         "--decode-replicas this turns on prefill/"
+                         "decode DISAGGREGATION: prompts prefill "
+                         "here, then the KV-page session blob streams "
+                         "to a decode replica's /migrate_in — a "
+                         "prefill storm can no longer degrade decodes "
+                         "(replicas need --slots and --page-size)")
+    ap.add_argument("--decode-replicas", default="",
+                    help="comma-separated DECODE-role replicas; see "
+                         "--prefill-replicas")
     ap.add_argument("--port", type=int, default=8800)
     ap.add_argument("--addr", default="0.0.0.0")
     ap.add_argument("--no-affinity", action="store_true",
@@ -667,21 +887,36 @@ def main(argv=None) -> int:
                     help="per-forward deadline before re-dispatch")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    replicas = []
-    for spec in args.replicas:
-        if "=" in spec:
-            name, _, address = spec.partition("=")
-            replicas.append((name, address))
-        else:
-            replicas.append(spec)
+
+    def parse_specs(specs):
+        out = []
+        for spec in specs:
+            spec = spec.strip()
+            if not spec:
+                continue
+            if "=" in spec:
+                name, _, address = spec.partition("=")
+                out.append((name, address))
+            else:
+                out.append(spec)
+        return out
+
+    replicas = parse_specs(args.replicas)
+    prefill = parse_specs(args.prefill_replicas.split(","))
+    decode = parse_specs(args.decode_replicas.split(","))
+    if not (replicas or (prefill and decode)):
+        ap.error("pass replica addresses, or both --prefill-replicas "
+                 "and --decode-replicas")
     router = FleetRouter(
         replicas, port=args.port, addr=args.addr,
+        prefill_replicas=prefill, decode_replicas=decode,
         affinity=not args.no_affinity, prefix_block=args.prefix_block,
         scrape_interval_s=args.scrape_interval,
         max_retries=args.max_retries, saturation=args.saturation,
         request_timeout_s=args.request_timeout)
-    log.info("router: %d replica(s) on :%d (affinity=%s)",
-             len(router._replicas), router.port, not args.no_affinity)
+    log.info("router: %d replica(s) on :%d (affinity=%s, disagg=%s)",
+             len(router._replicas), router.port, not args.no_affinity,
+             router._disagg)
     router.serve_forever()
     return 0
 
