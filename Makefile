@@ -2,7 +2,7 @@
 # vet+test+build; here make wraps the same).
 PY ?= python3
 
-.PHONY: all native proto test bench lint asan clean
+.PHONY: all native proto test bench lint asan clean tpu-records
 
 all: native
 
@@ -29,6 +29,17 @@ test: native
 
 bench:
 	$(PY) bench.py
+
+# Queue EVERY pending chip drive (missing/empty *_TPU.json record)
+# behind the round-4 tunnel health probe: probes in a subprocess with a
+# deadline, sleeps + retries while the tunnel is wedged, then pays the
+# whole record debt sequentially on the first healthy window —
+# unattended.  Run ALONE (the tunnel admits one dialing process); the
+# queue process itself never imports jax.  The composed router/
+# migration chip record (ROADMAP 2) needs two live servers on one chip
+# and stays a manual run — it has no single-drive script to queue.
+tpu-records:
+	$(PY) -m tpushare.record_queue
 
 clean:
 	$(MAKE) -C native clean

@@ -329,6 +329,113 @@ def spec_paged_bench(params, cfg, *, page_size, slots, prompt_len, gen,
     return out
 
 
+def sp_stripe_bench(params, cfg, *, page_size, pages_per_shard, sp,
+                    gen, decode_chunk, reps=2):
+    """Position-striped paged decode (round 17) at FIXED PER-SHARD pool
+    bytes: an unsharded pool of ``pages_per_shard`` pages vs the same
+    per-shard grant striped over ``sp`` position shards.
+
+    Two claims, measured: (1) CAPACITY — the striped pool admits a
+    sequence ~sp× one shard's max context (probed through
+    ``validate_request``, the real admission gate, not arithmetic);
+    (2) the long sequence actually DECODES at one dispatch per fused
+    round (dispatch counts recorded — the round-7 invariant must
+    survive striping).  Off-TPU the sp mesh rides the virtual CPU
+    devices, so tokens/s prices the shard_map/collective plumbing, not
+    chip HBM (the chip claim lives in drives/drive_sp_decode.py);
+    streams are asserted equal to an unsharded reference pool large
+    enough to hold the sequence (the striped xla read is bit-exact).
+
+    Importable so a test can smoke-run it at tiny sizes
+    (tier-1-safe).  Returns {"single_max_context", "striped_max_context",
+    "striped": {tokens_per_s, dispatches, rounds}}.
+    """
+    from tpushare.parallel.mesh import make_mesh
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    def max_context(b):
+        """Largest prompt+max_new the admission validator accepts, in
+        tokens (linear probe in page steps; the pool is small).
+        Probes whose prompt would be empty (tokens <= gen — possible
+        when gen spans multiple pages, as on the TPU arm) are skipped,
+        not treated as refusals."""
+        best = 0
+        for pages in range(1, cfg.max_seq // page_size + 1):
+            tokens = pages * page_size
+            if tokens <= gen:
+                continue
+            try:
+                b.validate_request([0] * (tokens - gen), gen)
+            except ValueError:
+                break
+            best = tokens
+        return best
+
+    single = PagedContinuousBatcher(params, cfg, n_slots=2,
+                                    page_size=page_size,
+                                    n_pages=pages_per_shard)
+    mesh = make_mesh({"sp": sp})
+    striped = PagedContinuousBatcher(params, cfg, n_slots=2,
+                                     page_size=page_size,
+                                     n_pages=pages_per_shard * sp,
+                                     mesh=mesh)
+    out = {"single_max_context": max_context(single),
+           "striped_max_context": max_context(striped),
+           "sp": sp,
+           "per_shard_pool_bytes":
+               striped.storage_info()["pool_bytes_per_shard"]}
+    # the long sequence: fills the striped pool's context, refused by
+    # the single-shard pool (the structural gap this feature closes)
+    prompt_len = out["striped_max_context"] - gen
+    prompt = [1 + (i % 50) for i in range(prompt_len)]
+    try:
+        single.validate_request(prompt, gen)
+        raise AssertionError("single-shard pool admitted the striped "
+                             "pool's max context — bench misconfigured")
+    except ValueError:
+        pass
+    # unsharded reference with enough pages: the exactness oracle
+    ref = PagedContinuousBatcher(params, cfg, n_slots=2,
+                                 page_size=page_size)
+    r = ref.admit(prompt, gen)
+    while ref.slots or ref.prefilling:
+        ref.tick_fused(decode_chunk)
+    ref_stream = ref.completed[r]
+
+    rec = None
+    for _ in range(reps):
+        b = PagedContinuousBatcher(params, cfg, n_slots=2,
+                                   page_size=page_size,
+                                   n_pages=pages_per_shard * sp,
+                                   mesh=mesh)
+        n_disp = [0]
+        real = b._step_n
+
+        def counted(*a, _real=real, **kw):
+            n_disp[0] += 1
+            return _real(*a, **kw)
+
+        b._step_n = counted
+        rid = b.admit(prompt, gen)
+        assert rid is not None, "striped pool refused its own context"
+        t0 = time.perf_counter()
+        rounds = 0
+        while b.slots:
+            b.tick_fused(decode_chunk)
+            rounds += 1
+        dt = time.perf_counter() - t0
+        assert n_disp[0] == rounds, \
+            "striping broke one-dispatch-per-fused-round"
+        assert b.completed[rid] == ref_stream, \
+            "striped long-context stream diverged from unsharded"
+        # admission produced the first token; the drain decodes the
+        # rest under the clock
+        rec = {"tokens_per_s": (gen - 1) / dt, "dispatches": n_disp[0],
+               "rounds": rounds}
+    out["striped"] = rec
+    return out
+
+
 def _simulate_dispatch_cost(service, rpc_s: float) -> None:
     """Wrap every device-dispatch hook of ``service``'s batcher with a
     constant ``rpc_s`` sleep — the in-process stand-in for the ~70 ms
@@ -1015,6 +1122,47 @@ def main() -> int:
                    "CPU arm is interpret-mode over the virtual mesh "
                    "(overhead-only proxy — chip claim lives in the "
                    "-m tpu lane)")
+
+    # 2b-sp. position-STRIPED paged decode (round 17): at fixed
+    # per-shard pool bytes, striping one sequence's pages over sp=4
+    # position shards multiplies its admissible context ~sp× — probed
+    # through the real admission gate — and the long sequence decodes
+    # at ONE dispatch per fused round with streams bit-equal to an
+    # unsharded reference (the striped gather is the exact merge).
+    # CPU arm over the virtual mesh: capacity is structural (real),
+    # tokens/s prices the collective plumbing only.
+    if len(jax.devices()) >= 4:
+        spcfg = (transformer.ModelConfig(
+                     vocab=32000, d_model=1024, n_layers=4, n_heads=8,
+                     n_kv_heads=4, d_ff=2816, max_seq=2048)
+                 if on_tpu else transformer.tiny(max_seq=256))
+        spparams = transformer.init_params(jax.random.PRNGKey(9), spcfg)
+        spb = sp_stripe_bench(
+            spparams, spcfg, page_size=16,
+            pages_per_shard=(32 if on_tpu else 6), sp=4,
+            gen=(33 if on_tpu else 9),
+            decode_chunk=(16 if on_tpu else 4))
+        ratio = (spb["striped_max_context"]
+                 / max(1, spb["single_max_context"]))
+        _emit("sp_decode_max_context", spb["striped_max_context"],
+              "tokens", platform=platform, sp=4, page_size=16,
+              pages_per_shard=(32 if on_tpu else 6),
+              single_shard_max_context=spb["single_max_context"],
+              vs_single_shard=round(ratio, 3),
+              per_shard_pool_bytes=spb["per_shard_pool_bytes"],
+              note="max admissible prompt+max_new at fixed per-shard "
+                   "pool bytes, probed via validate_request")
+        assert ratio >= 1.9, \
+            f"striping must multiply max context (got {ratio:.2f}x)"
+        _emit("sp_decode_tokens_per_s", spb["striped"]["tokens_per_s"],
+              "tokens/s", platform=platform, sp=4,
+              dispatches=spb["striped"]["dispatches"],
+              rounds=spb["striped"]["rounds"],
+              vs_single_shard_context=round(ratio, 3),
+              note="fused decode of a sequence no single shard could "
+                   "hold; one dispatch per round asserted, stream "
+                   "bit-equal to the unsharded reference; CPU arm "
+                   "prices shard_map plumbing only")
 
     # 2c. fused greedy decode, bf16 vs int8 vs int4: batch-1 decode is
     # WEIGHT-bound (every token re-reads all weights), so weight-only

@@ -1,0 +1,280 @@
+"""On-chip position-striped paged decode: compile-check + merge timing.
+
+The striped read's CPU-side contract is pinned in
+tests/test_sp_stripe.py (interpret mode).  What only the real chip can
+answer is
+
+* does the STRIPED kernel COMPILE AND LOWER on Mosaic per shard under
+  ``shard_map`` — the round-17 additions are the second scalar-prefetch
+  operand (the per-entry position map riding SMEM next to the page
+  table) and the two lane-broadcast ``[rows, 128]`` f32 STAT outputs
+  (the online-softmax partials), neither of which interpret mode can
+  prove (CLAUDE.md block-layout hazard), plus the cross-shard
+  ``pmax``/``psum`` merge lowering INSIDE the shard_map body;
+* what the merge costs — striped decode moves one f32 (out, max,
+  sumexp) 3-tuple per shard per layer over ICI where unsharded decode
+  moves nothing; the capacity win (pages, and so max context, x sp) is
+  architectural, the ICI tax is what this drive prices;
+* that a sequence LARGER than one shard's stripe actually serves: the
+  max-context arm decodes a sequence whose pages cannot fit any single
+  stripe.
+
+Method (CLAUDE.md tunnel rules): per cell, coalesced batch prefill then
+a device-resident ``lax.scan`` decode (ONE dispatch, host-fetch
+barrier); greedy stream agreement striped-vs-unsharded is reported per
+dtype (the striped kernel is accuracy-bounded via the merge, not
+bit-identical; the striped GATHER is bit-exact and asserted so).
+
+    python drives/drive_sp_decode.py        # real chip; ~6 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the on-chip serving shape this drive dispatches (must stay in sync
+#: with the TPU branch of main()): n_heads 16 / n_kv_heads 8 on
+#: d_model 2048 -> head_dim 128, page 64; decode reads are 2 q rows
+#: (n_rep 2, S=1) per kv head; n_pages below divides sp=2
+_TPU_SHAPE = dict(page=64, head_dim=128, rows=2, n_kv_heads=8,
+                  n_heads=16)
+_TPU_N_PAGES = 8 * 64 + 2       # batch * pages_per_slot + 2 trash
+
+
+def precheck() -> dict:
+    """Chip-free Mosaic verdicts for every striped cell this drive
+    would dispatch, BEFORE any jax import (importing jax dials the
+    tunnel when PALLAS_AXON_POOL_IPS is set).  ``cross_check=False``
+    pre-dial; the gate-agreement guarantee lives in tier-1
+    (tests/test_analysis.py)."""
+    from tpushare.analysis import mosaic
+
+    cells = {}
+    for kv_dtype in ("bf16", "int8"):
+        v = mosaic.precheck_paged(
+            quantized=kv_dtype == "int8", dtype="bf16", tp=1, sp=2,
+            n_pages=_TPU_N_PAGES, assume_tpu=True, cross_check=False,
+            **_TPU_SHAPE)
+        cells[f"{kv_dtype}_sp2"] = v.summary()
+    return cells
+
+
+def main() -> int:
+    pre = precheck()
+    precheck_ok = all(c["ok"] for c in pre.values())
+    if not precheck_ok:
+        print(json.dumps({"metric": "sp_decode",
+                          "precheck_ok": False, "precheck": pre}))
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.models import transformer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=4096)
+        batch, prompt_len, n_dec, page = 8, 1024, 64, 64
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96, dtype=jnp.bfloat16)
+        batch, prompt_len, n_dec, page = 2, 24, 8, 16
+    sp = 2
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab)
+    pages_per_slot = cfg.max_seq // page
+    w = -(-prompt_len // page) * page           # page-aligned prefill
+    padded = jnp.pad(prompt, ((0, 0), (0, w - prompt_len)))
+    n_pages = batch * pages_per_slot + sp      # equal stripes, sp trash
+    per = n_pages // sp
+
+    def striped_table():
+        """Round-robin allocation: range j -> stripe j % sp, stripe s
+        owning [s*per, (s+1)*per) with local 0 (global s*per) trash —
+        exactly PagedContinuousBatcher's striped layout."""
+        free = [list(range(s * per + 1, (s + 1) * per))
+                for s in range(sp)]
+        table = np.zeros((batch, pages_per_slot), np.int32)
+        for b in range(batch):
+            for j in range(pages_per_slot):
+                table[b, j] = free[j % sp].pop()
+        return jnp.asarray(table)
+
+    def flat_table():
+        table = np.zeros((batch, pages_per_slot), np.int32)
+        for b in range(batch):
+            table[b, :] = 1 + b * pages_per_slot + np.arange(
+                pages_per_slot)
+        return jnp.asarray(table)
+
+    out = {"metric": "sp_decode", "platform": dev.platform,
+           "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
+           "page_size": page, "sp": sp, "precheck_ok": precheck_ok,
+           "precheck": pre, "flavors": {}}
+
+    def run_cell(c, run_params, table, mesh=None):
+        """One (cfg, mesh, table) cell: coalesced batch prefill + one
+        device-resident decode scan; the host fetch is the barrier."""
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_jit(pools):
+            return transformer.forward_paged_prefill_batch(
+                run_params, padded, c, pools, table,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.full((batch,), prompt_len - 1, jnp.int32),
+                mesh=mesh)
+
+        @functools.partial(jax.jit, static_argnames=("n",),
+                           donate_argnums=(1,))
+        def decode_n(tok0, pools, n: int):
+            def body(carry, _):
+                tok, pools, lengths = carry
+                logits, pools = transformer.forward_paged_decode(
+                    run_params, tok[:, None], c, pools, table, lengths,
+                    mesh=mesh)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(
+                    tok.dtype)
+                return (nxt, pools, lengths + 1), nxt
+
+            lengths = jnp.full((batch,), prompt_len, jnp.int32)
+            (_, pools, _), toks = jax.lax.scan(
+                body, (tok0, pools, lengths), None, length=n)
+            return toks.T, pools
+
+        def run():
+            pools = transformer.init_paged_kv(c, n_pages=n_pages,
+                                              page_size=page)
+            if mesh is not None:
+                from tpushare.parallel.mesh import shard_kv_storage
+                pools = shard_kv_storage(pools, mesh, page_axis="sp")
+            sel, pools = prefill_jit(pools)
+            tok0 = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+            toks, pools = decode_n(tok0, pools, n_dec)
+            return sel, toks
+
+        t0 = time.perf_counter()
+        sel, toks = run()
+        first = [int(t) for t in toks[0]]            # compile + barrier
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sel, toks = run()                            # warm timed pass
+        int(toks[0, -1])                             # host fetch barrier
+        dt = time.perf_counter() - t0
+        finite = bool(np.isfinite(np.asarray(sel, np.float32)).all())
+        return compile_s, batch * n_dec / dt, first, finite
+
+    if len(jax.devices()) < sp:
+        out["skipped"] = f"needs >= {sp} devices for the sp mesh"
+        print(json.dumps(out))
+        return 0
+
+    from tpushare.parallel.mesh import make_mesh
+    mesh = make_mesh({"sp": sp})
+    streams = {}
+    for kv_dtype in ("bf16", "int8"):
+        streams[kv_dtype] = {}
+        out["flavors"][kv_dtype] = {}
+        for arm, kernel, m, tbl in (
+                ("single_pallas", "pallas", None, flat_table()),
+                ("striped_pallas", "pallas", mesh, striped_table()),
+                ("single_xla", "xla", None, flat_table()),
+                ("striped_xla", "xla", mesh, striped_table())):
+            c = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                    attn_kernel=kernel)
+            if kernel == "pallas" and on_tpu and m is not None:
+                from tpushare.ops.attention import paged_kernel_viable
+                rows = (cfg.n_heads // cfg.n_kv_heads) * w
+                assert paged_kernel_viable(
+                    page, cfg.head_dim, kv_dtype == "int8", cfg.dtype,
+                    rows=rows, sp=sp, n_pages=n_pages), (page, kv_dtype)
+            compile_s, tps, first, finite = run_cell(c, params, tbl,
+                                                     mesh=m)
+            streams[kv_dtype][arm] = first
+            out["flavors"][kv_dtype][arm] = {
+                "compile_s": round(compile_s, 1),
+                "tokens_per_s": round(tps, 1),
+                "finite": finite,
+            }
+        # the striped GATHER is the bit-exact degenerate merge — any
+        # disagreement is a table/stripe bug, never float noise
+        assert streams[kv_dtype]["striped_xla"] == \
+            streams[kv_dtype]["single_xla"], \
+            f"striped xla stream diverged on {kv_dtype}"
+        agree = sum(a == b for a, b in zip(
+            streams[kv_dtype]["single_pallas"],
+            streams[kv_dtype]["striped_pallas"]))
+        out[f"stream_agreement_{kv_dtype}"] = f"{agree}/{n_dec}"
+        f = out["flavors"][kv_dtype]
+        out[f"striped_vs_single_pallas_{kv_dtype}"] = round(
+            f["striped_pallas"]["tokens_per_s"]
+            / f["single_pallas"]["tokens_per_s"], 3)
+    out["compile_ok"] = all(
+        cell["finite"] for f in out["flavors"].values()
+        for cell in f.values())
+    out["sp2"] = {"compile_ok": out["compile_ok"]}
+
+    # -- max-context arm: a sequence NO single stripe could hold -------
+    # a pool of pages_per_slot + sp pages (per stripe: about half a
+    # sequence's ranges, plus trash) cannot fit a full-max_seq
+    # reservation on any ONE stripe, but the striped allocation spreads
+    # it across both — prefill + decode one such row and require finite
+    # logits.  This is the capacity claim the feature exists for, on
+    # real Mosaic.
+    small_pages = pages_per_slot + sp
+    small_per = small_pages // sp
+    assert pages_per_slot > small_per - 1, "arm must span stripes"
+    free = [list(range(s * small_per + 1, (s + 1) * small_per))
+            for s in range(sp)]
+    row_tbl = np.zeros((1, pages_per_slot), np.int32)
+    for j in range(pages_per_slot):
+        row_tbl[0, j] = free[j % sp].pop()
+    row_tbl = jnp.asarray(row_tbl)
+    long_prompt = jnp.pad(prompt[:1], ((0, 0), (0, w - prompt_len)))
+    cl = dataclasses.replace(cfg, attn_kernel="pallas")
+    from tpushare.parallel.mesh import shard_kv_storage
+    pools = shard_kv_storage(
+        transformer.init_paged_kv(cl, n_pages=small_pages,
+                                  page_size=page), mesh,
+        page_axis="sp")
+    sel, pools = jax.jit(
+        lambda p: transformer.forward_paged_prefill_batch(
+            params, long_prompt, cl, p, row_tbl,
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), prompt_len - 1, jnp.int32), mesh=mesh)
+    )(pools)
+    logits, pools = jax.jit(
+        lambda t, p: transformer.forward_paged_decode(
+            params, t, cl, p, row_tbl,
+            jnp.full((1,), prompt_len, jnp.int32), mesh=mesh)
+    )(jnp.argmax(sel, axis=-1).astype(jnp.int32)[:, None], pools)
+    out["max_context"] = {
+        "pool_pages": int(small_pages),
+        "per_stripe_usable": int(small_per - 1),
+        "sequence_pages": int(pages_per_slot),
+        "spans_stripes": True,
+        "finite": bool(np.isfinite(
+            np.asarray(logits, np.float32)).all()),
+    }
+    out["compile_ok"] = out["compile_ok"] and out["max_context"]["finite"]
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -199,7 +199,8 @@ ENUMERATED_VALUES = {
     ("tpushare_hbm_peak_bytes", "over_grant"): {"true", "false"},
     # keep in sync with ops.attention.FALLBACK_REASONS (asserted below)
     ("tpushare_attn_kernel_fallback_total", "reason"):
-        {"head_dim", "page_tile", "max_rows", "tp_heads", "forced"},
+        {"head_dim", "page_tile", "max_rows", "tp_heads", "sp_pool",
+         "forced"},
     # keep in sync with continuous.SPEC_FALLBACK_REASONS (asserted
     # below)
     ("tpushare_spec_fallback_total", "reason"):

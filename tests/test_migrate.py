@@ -633,3 +633,48 @@ def test_bench_trajectory_smoke(tmp_path):
     t2 = bench_trajectory.trajectory(str(tmp_path))
     assert t2["metrics"]["m"]["last_vs_prev"] == 0.5
     assert "0.500x" in bench_trajectory.render_markdown(t2)
+
+
+def test_bench_trajectory_degraded_lines_skip_cells_not_files(tmp_path):
+    """A BENCH_r*.json record missing its value or carrying a
+    non-numeric one (a degraded/outage line) must not drop the whole
+    file from the trajectory: the bad CELL is skipped, the metric row
+    and every other record in the round survive."""
+    from tpushare import bench_trajectory
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"metric": "good", "value": 10.0,
+                    "unit": "tokens/s"}) + "\n"
+        + json.dumps({"metric": "flaky", "value": 4.0,
+                      "unit": "qps"}) + "\n")
+    # round 2: one degraded line (null value), one string value (an
+    # outage note), one record missing "value" entirely, one healthy
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"metric": "flaky", "value": None, "unit": "qps",
+                    "degraded": True}) + "\n"
+        + json.dumps({"metric": "wedge_note", "value": "wedged"}) + "\n"
+        + json.dumps({"metric": "no_value", "unit": "x"}) + "\n"
+        + json.dumps({"metric": "good", "value": 20.0,
+                      "unit": "tokens/s"}) + "\n")
+    traj = bench_trajectory.trajectory(str(tmp_path))
+    # the round is kept and its healthy record collates
+    assert traj["rounds"] == ["r01", "r02"]
+    assert traj["metrics"]["good"]["values"] == {"r01": 10.0,
+                                                 "r02": 20.0}
+    assert traj["metrics"]["good"]["last_vs_prev"] == 2.0
+    # the degraded cell is skipped; the row survives with its r01 cell
+    assert traj["metrics"]["flaky"]["values"] == {"r01": 4.0}
+    # rows whose every record is non-numeric render as all dashes
+    # instead of crashing the markdown
+    assert traj["metrics"]["wedge_note"]["values"] == {}
+    md = bench_trajectory.render_markdown(traj)
+    assert "wedge_note" in md and "flaky" in md
+    # a degraded rerun APPENDED after a healthy record must not
+    # overwrite the real measurement
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"metric": "good", "value": 20.0,
+                    "unit": "tokens/s"}) + "\n"
+        + json.dumps({"metric": "good", "value": None,
+                      "degraded": True}) + "\n")
+    t3 = bench_trajectory.trajectory(str(tmp_path))
+    assert t3["metrics"]["good"]["values"]["r02"] == 20.0

@@ -1,0 +1,127 @@
+"""The chip-record queue (``make tpu-records``) on a fake probe: the
+round-4 survival pattern — probe, sleep, retry, then pay the whole
+record debt on first success — must be testable without a tunnel.
+Stdlib-only module; no jax import anywhere in these tests."""
+
+import json
+import os
+
+from tpushare import record_queue
+
+
+def _manifest_root(tmp_path, records=()):
+    """A fake repo root: drives/ exists, only ``records`` committed."""
+    (tmp_path / "drives").mkdir()
+    for drive, _ in record_queue.MANIFEST:
+        (tmp_path / "drives" / drive).write_text("# fake drive\n")
+    for name, content in records:
+        (tmp_path / name).write_text(content)
+    return str(tmp_path)
+
+
+def test_pending_is_derived_from_missing_or_bad_records(tmp_path):
+    root = _manifest_root(tmp_path, records=[
+        ("PAGED_ATTN_TPU.json", json.dumps({"metric": "x", "v": 1})),
+        ("SPEC_PAGED_TPU.json", ""),            # empty slot = debt
+        ("KV_QUANT_TPU.json", "{not json"),     # truncated = debt
+    ])
+    pend = record_queue.pending_records(root)
+    names = {os.path.basename(r) for _, r in pend}
+    # committed+parsable is NOT pending; empty/unparsable/missing are
+    assert "PAGED_ATTN_TPU.json" not in names
+    assert {"SPEC_PAGED_TPU.json", "KV_QUANT_TPU.json",
+            "SP_DECODE_TPU.json", "PREFIX_CACHE_TPU.json"} <= names
+
+
+def test_queue_sleeps_until_probe_passes_then_runs_all(tmp_path):
+    root = _manifest_root(tmp_path)
+    entries = record_queue.pending_records(root)
+    events = []
+    verdicts = iter([False, False, True])
+
+    def probe():
+        events.append("probe")
+        return next(verdicts)
+
+    def runner(drive, record):
+        events.append(("run", os.path.basename(drive)))
+        with open(record, "w") as f:
+            json.dump({"metric": "fake"}, f)
+        return True
+
+    summary = record_queue.run_queue(
+        entries, probe=probe, runner=runner, sleep_s=7.0,
+        sleep=lambda s: events.append(("sleep", s)))
+    # probe-sleep-probe-sleep-probe, THEN every drive in order — no
+    # drive ever runs before a healthy probe
+    assert events[:5] == ["probe", ("sleep", 7.0), "probe",
+                          ("sleep", 7.0), "probe"]
+    ran = [e[1] for e in events[5:]]
+    assert ran == [d for d, _ in record_queue.MANIFEST]
+    assert summary["probes"] == 3
+    assert summary["ran"] == ran and not summary["failed"]
+    # the debt is paid: records committed, nothing pending
+    assert record_queue.pending_records(root) == []
+
+
+def test_queue_gives_up_after_max_probes_without_running(tmp_path):
+    root = _manifest_root(tmp_path)
+    entries = record_queue.pending_records(root)
+    ran = []
+    summary = record_queue.run_queue(
+        entries, probe=lambda: False,
+        runner=lambda d, r: ran.append(d) or True,
+        max_probe_attempts=4, sleep=lambda s: None)
+    assert summary["probes"] == 4
+    assert not ran and not summary["ran"]
+    assert record_queue.pending_records(root) == entries
+
+
+def test_failed_drive_is_recorded_not_fatal(tmp_path):
+    root = _manifest_root(tmp_path)
+    entries = record_queue.pending_records(root)
+
+    def runner(drive, record):
+        ok = "spec" not in drive
+        if ok:
+            with open(record, "w") as f:
+                json.dump({"metric": "fake"}, f)
+        return ok
+
+    summary = record_queue.run_queue(entries, probe=lambda: True,
+                                     runner=runner)
+    assert "drive_spec_paged.py" in summary["failed"]
+    assert "drive_paged_attn.py" in summary["ran"]
+    # the failed slot stays debt for the next window
+    names = {os.path.basename(r)
+             for _, r in record_queue.pending_records(root)}
+    assert "SPEC_PAGED_TPU.json" in names
+
+
+def test_default_runner_refuses_skipped_and_refused_stubs(tmp_path):
+    """A drive that exits 0 with a skipped/precheck-refused JSON line
+    (too few devices, statically-refused layout) must NOT have that
+    stub committed as the record — the debt stays pending for a host
+    that can actually measure."""
+    record = str(tmp_path / "X_TPU.json")
+    for payload in ({"metric": "x", "skipped": "needs >= 2 devices"},
+                    {"metric": "x", "precheck_ok": False}):
+        drive = tmp_path / "fake_drive.py"
+        drive.write_text("import json\n"
+                         f"print(json.dumps({payload!r}))\n")
+        assert record_queue.default_runner(str(drive), record) is False
+        assert not os.path.exists(record)
+    # a real record commits
+    drive = tmp_path / "fake_drive.py"
+    drive.write_text("import json\n"
+                     "print(json.dumps({'metric': 'x', 'v': 1.0}))\n")
+    assert record_queue.default_runner(str(drive), record) is True
+    assert record_queue.has_record(record)
+
+
+def test_empty_debt_probes_nothing():
+    summary = record_queue.run_queue(
+        [], probe=lambda: (_ for _ in ()).throw(AssertionError),
+        runner=lambda d, r: True)
+    assert summary == {"probes": 0, "ran": [], "failed": [],
+                       "pending": 0}

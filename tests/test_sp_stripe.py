@@ -1,0 +1,362 @@
+"""Position-striped paged decode (round 17): one sequence's KV pages
+round-robin across the sp mesh axis.
+
+Contract mirrors round 12, adapted to position sharding:
+
+* the striped XLA gather is the EXACT merge — each shard's local
+  stripe gather all-gathers back into the unsharded key order, so
+  ``attn_kernel="xla"`` striped streams are bit-identical to the
+  unsharded path on every dtype (asserted, not tolerance-bounded);
+* the striped Pallas kernel does the true online-softmax merge of
+  per-shard (out, max, sumexp) partials — agreement-pinned against the
+  unsharded kernel on the f32 tiny config;
+* ``kv_dtype="int8"`` stays exactly self-consistent across dispatch
+  flavors (ticked == fused == mixed == spec) because quantization is
+  append-only per write — striping moves WHERE a page lives, never
+  when it quantizes;
+* capacity: per-stripe allocation multiplies the admissible context by
+  the stripe count at fixed per-shard pool bytes, and the one-dispatch-
+  per-round invariant survives striping (counted).
+
+Runs on the conftest 8-device CPU mesh; the Mosaic lowering claims
+live in drives/drive_sp_decode.py (``-m tpu`` lane).
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from tpushare.models import transformer
+from tpushare.parallel.mesh import make_mesh
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+CFG = transformer.tiny(max_seq=96)
+PROMPTS = [[5, 9, 2], [11, 3], [1, 2, 3, 4, 5]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(jax.random.PRNGKey(7), CFG)
+
+
+def _drain(b, prompts=PROMPTS, gen=8):
+    rids = [b.admit(list(p), gen) for p in prompts]
+    assert all(r is not None for r in rids)
+    b.run_until_drained()
+    return [b.completed[r] for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# gates / allocation structure (no device compute)
+# ---------------------------------------------------------------------------
+def test_sp_pool_gate_and_mosaic_agreement():
+    from tpushare.analysis import mosaic
+    from tpushare.ops.attention import (FALLBACK_REASONS,
+                                        paged_kernel_fallback_reason)
+
+    assert "sp_pool" in FALLBACK_REASONS
+    # structural: refuses on EVERY platform, like tp_heads
+    for assume_tpu in (False, True):
+        r = paged_kernel_fallback_reason(
+            64, 128, False, "bfloat16", sp=2, n_pages=127,
+            assume_tpu=assume_tpu)
+        assert r == "sp_pool"
+        v = mosaic.precheck_paged(page=64, head_dim=128, quantized=False,
+                                  dtype="bf16", sp=2, n_pages=127,
+                                  assume_tpu=assume_tpu,
+                                  cross_check=True)
+        assert v.reason == "sp_pool"
+    # divisible pools pass, and the striped call derives the two stat
+    # output blocks the unsharded call does not have
+    v = mosaic.precheck_paged(page=64, head_dim=128, quantized=True,
+                              dtype="bf16", sp=2, n_pages=128,
+                              cross_check=True)
+    assert v.ok
+    names = [b.name for b in v.blocks]
+    assert "m_out" in names and "l_out" in names
+    v1 = mosaic.precheck_paged(page=64, head_dim=128, quantized=True,
+                               dtype="bf16", cross_check=True)
+    assert "m_out" not in [b.name for b in v1.blocks]
+
+
+def test_striped_allocation_structure(params):
+    sp = 4
+    b = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                               n_pages=24, mesh=make_mesh({"sp": sp}))
+    assert b.sp_shards == sp and b.n_pages == 24
+    per = b.n_pages // sp
+    rid = b.admit([1, 2, 3, 4] * 8, 16)          # 48 tokens = 3 ranges
+    slot = next(s for s, st in b.slots.items() if st.request_id == rid)
+    row = b.page_table[slot]
+    for j in range(3):
+        p = int(row[j])
+        # range j's page lives on stripe j % sp, never on a trash page
+        assert p // per == j % sp
+        assert p % per != 0
+    # per-stripe trash pages are never allocatable
+    for s in range(sp):
+        for lst in b._free_by_stripe:
+            assert s * per not in lst
+    # gauges exclude one trash page per stripe
+    from tpushare.serving import metrics
+    assert (metrics.KV_PAGES_FREE.value() + metrics.KV_PAGES_USED.value()
+            == b.n_pages - sp)
+
+
+def test_striped_capacity_and_refusals(params):
+    # fixed per-shard pool: 6 pages; striped over 4 -> ~4x the context
+    single = PagedContinuousBatcher(params, transformer.tiny(max_seq=256),
+                                    n_slots=2, page_size=16, n_pages=6)
+    striped = PagedContinuousBatcher(
+        params, transformer.tiny(max_seq=256), n_slots=2, page_size=16,
+        n_pages=16, mesh=make_mesh({"sp": 4}))
+    with pytest.raises(ValueError, match="usable pages"):
+        single.validate_request([1] * 100, 8)
+    # 108 tokens = 7 ranges -> worst stripe carries 2 of the 3 usable
+    striped.validate_request([1] * 100, 8)
+    # 256 tokens = 16 ranges -> 4 per stripe > 3 usable: the refusal
+    # names the per-stripe arithmetic
+    with pytest.raises(ValueError, match="position stripe"):
+        striped.validate_request([1] * 248, 8)
+    # windowed page ring cannot stripe
+    with pytest.raises(ValueError, match="full-causal"):
+        PagedContinuousBatcher(params, transformer.tiny(max_seq=96,
+                                                        window=16),
+                               n_slots=2, page_size=16,
+                               mesh=make_mesh({"sp": 2}))
+    # an explicit n_pages rounds UP to equal stripes
+    b = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                               n_pages=13, mesh=make_mesh({"sp": 4}))
+    assert b.n_pages == 16
+    # a byte budget rounds DOWN (never exceed the grant) and refuses
+    # when it cannot fund one usable page per stripe
+    bytes_per_page = b.storage_info()["bytes_per_page"]
+    b2 = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                                pool_bytes=bytes_per_page * 11,
+                                mesh=make_mesh({"sp": 4}))
+    assert b2.n_pages == 8
+    with pytest.raises(ValueError, match="per position stripe"):
+        PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                               pool_bytes=bytes_per_page * 7,
+                               mesh=make_mesh({"sp": 4}))
+
+
+def test_striped_storage_info_and_gauge(params):
+    from tpushare.serving import metrics
+    b = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                               mesh=make_mesh({"sp": 2}))
+    info = b.storage_info()
+    assert info["sp_shards"] == 2
+    assert info["pool_bytes_per_shard"] * 2 == info["pool_bytes"]
+    assert info["sp_merge_transient_bytes"] > 0
+    assert metrics.KV_STRIPE_SHARDS.value() == 2
+    # unsharded pools report stripe 1 (and reset the gauge)
+    b1 = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16)
+    assert b1.storage_info()["sp_shards"] == 1
+    assert metrics.KV_STRIPE_SHARDS.value() == 1
+
+
+def test_spec_fallback_and_validate_on_striped(params):
+    b = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                               mesh=make_mesh({"sp": 2}), spec_k=4)
+    # full-causal striped pools verify without extra reservation,
+    # exactly like unsharded paged pools (trash-page containment is
+    # per-write and shard-local)
+    assert b.spec_fallback_reason(4) is None
+    b.validate_spec_request(20, 8, 4)
+    # paged storage never needs dense headroom; an over-long request
+    # still refuses through the base validation (max_seq)
+    with pytest.raises(ValueError):
+        b.validate_request([1] * 95, 8)
+
+
+def test_pallas_striped_fallback_reason_surfaces(params):
+    # page 8 pools fail the bf16 16-row sublane tile ON TPU; off-TPU
+    # the gate is vacuous, so force a structural one: indivisible pool
+    cfg = dataclasses.replace(transformer.tiny(max_seq=96),
+                              attn_kernel="pallas")
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=16,
+                               n_pages=25, mesh=make_mesh({"sp": 2}))
+    # 25 rounds up to 26 = divisible, so build the indivisible case
+    # through storage_info's gate directly
+    info = b.storage_info()
+    assert info["attn_fallback_reason"] is None
+    from tpushare.ops.attention import paged_kernel_fallback_reason
+    assert paged_kernel_fallback_reason(
+        16, 16, False, "float32", sp=2, n_pages=25) == "sp_pool"
+
+
+# ---------------------------------------------------------------------------
+# stream equivalence (device compute; small shapes)
+# ---------------------------------------------------------------------------
+def test_striped_xla_streams_bit_identical(params):
+    base = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
+                                         page_size=16))
+    got = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
+                                        page_size=16,
+                                        mesh=make_mesh({"sp": 2})))
+    assert got == base
+
+
+def test_striped_long_context_beyond_one_stripe(params):
+    """A sequence whose pages cannot fit any single stripe admits,
+    decodes, and reproduces the unsharded stream exactly."""
+    cfg = transformer.tiny(max_seq=256)
+    p = transformer.init_params(jax.random.PRNGKey(7), cfg)
+    prompt = [1 + (i % 7) for i in range(100)]
+    striped = PagedContinuousBatcher(p, cfg, n_slots=2, page_size=16,
+                                     n_pages=24,
+                                     mesh=make_mesh({"sp": 4}))
+    # 108 tokens = 7 ranges; a single stripe holds only 5 usable pages
+    assert 7 > striped.n_pages // 4 - 1
+    rid = striped.admit(prompt, 8)
+    assert rid is not None
+    striped.run_until_drained()
+    ref = PagedContinuousBatcher(p, cfg, n_slots=2, page_size=16)
+    r2 = ref.admit(prompt, 8)
+    ref.run_until_drained()
+    assert striped.completed[rid] == ref.completed[r2]
+
+
+def test_striped_one_dispatch_per_round(params):
+    """The round-7 invariant survives striping: fused rounds and mixed
+    rounds each stay ONE device dispatch on a striped pool."""
+    b = PagedContinuousBatcher(params, CFG, n_slots=3, page_size=4,
+                               mesh=make_mesh({"sp": 2}))
+    counts = {"n": 0, "mixed": 0, "other": 0}
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    rd = b.admit([1, 2, 3], 9)
+    rp = b.admit_chunked([5] * 20, 3, chunk=4)
+    wrap("_step_n", "n")
+    wrap("_step_mixed", "mixed")
+    wrap("_step", "other")
+    wrap("_prefill_chunk_into", "other")
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    assert counts["mixed"] == rounds and rounds >= 1
+    fused = 0
+    while b.slots:
+        b.tick_fused(4)
+        fused += 1
+    assert counts["n"] == fused and fused >= 1
+    assert counts["other"] == 0
+    assert rd in b.completed and rp in b.completed
+
+
+def test_export_import_roundtrip_across_striping(params):
+    """Session blobs are layout-agnostic: striped -> unsharded and
+    unsharded -> striped migrations reproduce the stream token for
+    token (the receiver re-allocates each page on the stripe its
+    range demands)."""
+    cfg = transformer.tiny(max_seq=256)
+    p = transformer.init_params(jax.random.PRNGKey(7), cfg)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6] * 4
+    ref = PagedContinuousBatcher(p, cfg, n_slots=2, page_size=16)
+    rr = ref.admit(prompt, 12)
+    ref.run_until_drained()
+    want = ref.completed[rr]
+
+    def roundtrip(src_mesh, dst_mesh):
+        src = PagedContinuousBatcher(
+            p, cfg, n_slots=2, page_size=16,
+            n_pages=24 if src_mesh else None, mesh=src_mesh)
+        rid = src.admit(prompt, 12)
+        for _ in range(3):
+            src.tick()
+        blob = src.export_session(rid)
+        src.pop_session(rid)
+        dst = PagedContinuousBatcher(
+            p, cfg, n_slots=2, page_size=16,
+            n_pages=24 if dst_mesh else None, mesh=dst_mesh)
+        rid2 = dst.import_session(blob)
+        assert rid2 is not None
+        dst.run_until_drained()
+        return dst.completed[rid2]
+
+    sp4 = make_mesh({"sp": 4})
+    assert roundtrip(sp4, None) == want
+    assert roundtrip(None, sp4) == want
+
+
+def test_bench_sp_stripe_smoke(params):
+    import bench_all
+    cfg = transformer.tiny(max_seq=256)
+    p = transformer.init_params(jax.random.PRNGKey(9), cfg)
+    out = bench_all.sp_stripe_bench(p, cfg, page_size=16,
+                                    pages_per_shard=6, sp=4, gen=9,
+                                    decode_chunk=4, reps=1)
+    assert (out["striped_max_context"]
+            >= 1.9 * out["single_max_context"])
+    assert out["striped"]["dispatches"] == out["striped"]["rounds"]
+
+
+# ---------------------------------------------------------------------------
+# heavier matrices (slow lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_striped_pallas_agreement(params):
+    cfgp = dataclasses.replace(CFG, attn_kernel="pallas")
+    base = _drain(PagedContinuousBatcher(params, cfgp, n_slots=4,
+                                         page_size=16))
+    got = _drain(PagedContinuousBatcher(params, cfgp, n_slots=4,
+                                        page_size=16,
+                                        mesh=make_mesh({"sp": 2})))
+    # the merge is exact in exact arithmetic; on the f32 tiny config
+    # greedy streams agree (the round-8/12 empirical-exactness bar)
+    assert got == base
+
+
+@pytest.mark.slow
+def test_tp_sp_composed_streams(params):
+    base = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
+                                         page_size=16))
+    got = _drain(PagedContinuousBatcher(
+        params, CFG, n_slots=4, page_size=16,
+        mesh=make_mesh({"tp": 2, "sp": 2})))
+    assert got == base
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("attn_kernel", ["xla", "pallas"])
+def test_int8_striped_self_consistency(params, attn_kernel):
+    """int8 striped pools stay EXACTLY self-consistent across dispatch
+    flavors: ticked == fused == spec (append-only quantization; the
+    stripe decides where a page lives, never when it quantizes)."""
+    cfg = dataclasses.replace(transformer.tiny(max_seq=96),
+                              kv_dtype="int8", attn_kernel=attn_kernel)
+    mesh = make_mesh({"sp": 2})
+    prompt = [1, 2, 3, 4] * 3
+    gen = 9
+
+    def build():
+        return PagedContinuousBatcher(params, cfg, n_slots=2,
+                                      page_size=16, mesh=mesh,
+                                      spec_k=4)
+
+    b1 = build()
+    r1 = b1.admit(prompt, gen)
+    while b1.slots:
+        b1.tick()
+    b2 = build()
+    r2 = b2.admit(prompt, gen)
+    while b2.slots:
+        b2.tick_fused(4)
+    b3 = build()
+    r3 = b3.admit(prompt, gen)
+    while b3.slots:
+        b3.tick_spec(2, k=4)
+    assert b1.completed[r1] == b2.completed[r2] == b3.completed[r3]

@@ -294,6 +294,36 @@ def test_spec_paged_on_chip():
 
 
 @_skip
+def test_sp_decode_on_chip():
+    """Position-striped paged decode (round 17): the striped kernel's
+    NEW lowering surface — the second scalar-prefetch operand (the
+    per-entry position map), the two lane-broadcast [rows, 128] f32
+    stat outputs, and the pmax/psum merge — must COMPILE AND LOWER per
+    shard under shard_map on real Mosaic, which interpret mode cannot
+    prove; the striped XLA gather must stay bit-exact (asserted inside
+    the drive); and a sequence no single stripe could hold must
+    decode.  The merge's ICI tax must not sink striped decode below
+    the guard of its committed record."""
+    rec = _run("drive_sp_decode.py", timeout=3600)
+    assert rec.get("precheck_ok", True), rec
+    if rec.get("skipped"):
+        pytest.skip(rec["skipped"])     # single-device host: no sp mesh
+    assert rec["compile_ok"], rec
+    assert rec["sp2"].get("compile_ok", True), rec
+    assert rec["max_context"]["finite"], rec
+    committed = _committed("SP_DECODE_TPU.json",
+                           "striped_vs_single_pallas_int8", default=None)
+    got = rec["striped_vs_single_pallas_int8"]
+    if committed:
+        assert got >= _GUARD * committed, (rec, committed)
+    else:
+        # first record: the merge moves one small f32 3-tuple per
+        # layer — striped decode must stay within ~2x of unsharded
+        # (the capacity win is the point; this bounds the ICI tax)
+        assert got >= 0.5, rec
+
+
+@_skip
 def test_int4_capacity_demo_on_chip():
     rec = _run("drive_int4_capacity.py", timeout=3600)
     assert rec["only_int4_fits_grant"], rec

@@ -190,12 +190,20 @@ def _forced() -> bool:
 # Paged decode kernel (ops.attention.paged_decode_attention)
 # ---------------------------------------------------------------------------
 def paged_blocks(page: int, head_dim: int, quantized: bool, dtype,
-                 rows: int = 1) -> List[Block]:
+                 rows: int = 1, sp: int = 1) -> List[Block]:
     """Every block ``paged_decode_attention`` would hand
     ``pallas_call`` (inputs, output, VMEM scratch), mirrored shape for
     shape from the kernel body — change the kernel, change this list,
     and the agreement sweep in tests/test_analysis.py will tell you if
-    you forgot."""
+    you forgot.
+
+    ``sp`` > 1 models the POSITION-STRIPED call (round 17): each shard
+    runs the same kernel over its local page stripe with
+    ``return_stats`` — two extra lane-broadcast ``[rows, 128]`` f32
+    outputs (the online-softmax partials the cross-shard merge folds).
+    The per-entry position map rides SCALAR PREFETCH (SMEM, like the
+    page table itself), not a block, so it adds no tile to validate —
+    the stat outputs are the new lowering surface."""
     compute = canon_dtype(dtype)[0]
     store = "int8" if quantized else compute
     rows_p = max(8, -(-rows // 8) * 8)
@@ -219,20 +227,29 @@ def paged_blocks(page: int, head_dim: int, quantized: bool, dtype,
                        "to lower"),
             Block("v_scale", (page, 1), "f32"),
         ]
+    if sp > 1:
+        blocks += [
+            Block("m_out", (rows_p, LANE), "f32",
+                  note="striped partial: per-row running max, "
+                       "lane-broadcast like the flash lse"),
+            Block("l_out", (rows_p, LANE), "f32",
+                  note="striped partial: per-row sum-of-exp"),
+        ]
     return blocks
 
 
 def paged_vmem_bytes(page: int, head_dim: int, quantized: bool, dtype,
-                     rows: int = 1) -> int:
+                     rows: int = 1, sp: int = 1) -> int:
     """VMEM the paged kernel holds live per program (blocks + scratch)."""
     return sum(b.nbytes for b in paged_blocks(page, head_dim, quantized,
-                                              dtype, rows))
+                                              dtype, rows, sp=sp))
 
 
 def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
                    rows: int = 1, tp: int = 1, n_kv_heads: int = 0,
                    n_heads: int = 0, assume_tpu: bool = True,
-                   cross_check: bool = False) -> Verdict:
+                   cross_check: bool = False, sp: int = 1,
+                   n_pages: int = 0) -> Verdict:
     """Would ``paged_decode_attention`` LOWER at these parameters on a
     real chip?  The chip-free twin of the dispatch gate
     (``ops.attention.paged_kernel_fallback_reason``): same parameters,
@@ -240,11 +257,15 @@ def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
     layout rules, with every violation named in ``findings``.
 
     ``assume_tpu=False`` answers for an interpret-mode host (Mosaic
-    gates vacuous — only the structural ``tp_heads``/``forced`` gates
-    apply), exactly like the live gate off-TPU.  ``cross_check=True``
-    imports the live gate and raises :class:`GateDriftError` on any
-    disagreement — NEVER pass it from a pre-dial drive (it imports
-    jax)."""
+    gates vacuous — only the structural ``tp_heads``/``sp_pool``/
+    ``forced`` gates apply), exactly like the live gate off-TPU.
+    ``sp``/``n_pages`` model the round-17 position-striped call: the
+    pool's page count must divide into equal per-shard stripes
+    (``sp_pool``, structural like ``tp_heads``), and the striped
+    kernel's two stat outputs join the derived block list.
+    ``cross_check=True`` imports the live gate and raises
+    :class:`GateDriftError` on any disagreement — NEVER pass it from a
+    pre-dial drive (it imports jax)."""
     findings: List[str] = []
     reason: Optional[str] = None
 
@@ -262,11 +283,21 @@ def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
             f"degree (shard_map runs the kernel per shard with no "
             f"cross-shard softmax) — structural, refuses on EVERY "
             f"platform, degrades to the sharded XLA gather")
+    if sp > 1 and n_pages and n_pages % sp:
+        reason = reason or "sp_pool"
+        findings.append(
+            f"sp={sp} cannot split the pool into equal page stripes: "
+            f"n_pages={n_pages} must divide the sp degree (shard_map "
+            f"splits the page axis evenly per position shard) — "
+            f"structural, refuses on EVERY platform, degrades to the "
+            f"replicated-pool gather")
 
     # per-shard shapes: head counts divide by tp, everything else is
     # shard-invariant (rows = n_rep * S with n_rep = n_heads/n_kv_heads
-    # unchanged by a division of both counts)
-    blocks = tuple(paged_blocks(page, head_dim, quantized, dtype, rows))
+    # unchanged by a division of both counts); the page stripe leaves
+    # page/head_dim tiles untouched, so sp only adds the stat outputs
+    blocks = tuple(paged_blocks(page, head_dim, quantized, dtype, rows,
+                                sp=sp))
     vmem = sum(b.nbytes for b in blocks)
 
     mosaic_findings: List[str] = []
@@ -300,7 +331,8 @@ def precheck_paged(page: int, head_dim: int, quantized: bool, dtype,
                 findings=tuple(findings), blocks=blocks, vmem_bytes=vmem)
     if cross_check:
         _cross_check_paged(v, page, head_dim, quantized, dtype, rows,
-                           tp, n_kv_heads, n_heads, assume_tpu)
+                           tp, n_kv_heads, n_heads, assume_tpu, sp,
+                           n_pages)
     return v
 
 
@@ -332,7 +364,8 @@ def precheck_spec_paged(page: int, head_dim: int, quantized: bool, dtype,
 
 
 def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
-                       rows, tp, n_kv_heads, n_heads, assume_tpu):
+                       rows, tp, n_kv_heads, n_heads, assume_tpu,
+                       sp=1, n_pages=0):
     """Assert the symbolic verdict equals the LIVE gate's (imports jax;
     also pins the duplicated max-rows constant)."""
     # NOT ``from ..ops import attention`` — the ops __init__ re-exports
@@ -348,11 +381,12 @@ def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
     gate = paged_kernel_fallback_reason(
         page, head_dim, quantized, canon_dtype(dtype)[0], rows=rows,
         tp=tp, n_kv_heads=n_kv_heads, n_heads=n_heads,
-        assume_tpu=assume_tpu)
+        assume_tpu=assume_tpu, sp=sp, n_pages=n_pages)
     if gate != v.reason:
         raise GateDriftError(
             f"verdict drift at page={page} head_dim={head_dim} "
             f"quantized={quantized} dtype={dtype} rows={rows} tp={tp} "
+            f"sp={sp} n_pages={n_pages} "
             f"heads={n_heads}/{n_kv_heads} assume_tpu={assume_tpu}: "
             f"gate says {gate!r}, prechecker says {v.reason!r} "
             f"(findings: {list(v.findings)})")
@@ -560,6 +594,36 @@ def default_sweep() -> List[dict]:
                       note="spec row multiplier past "
                            "PAGED_KERNEL_MAX_ROWS falls back per "
                            "dispatch"))
+    # round-17 position striping: the per-shard stripe walk with the
+    # stat outputs and the pos_map scalar prefetch — the drive shape,
+    # both dtypes, sp alone and composed with tp
+    for quantized in (False, True):
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                          n_heads=16, sp=2, n_pages=128, expect=None))
+        cases.append(dict(page=64, head_dim=128, quantized=quantized,
+                          dtype="bf16", rows=8, tp=2, n_kv_heads=8,
+                          n_heads=16, sp=2, n_pages=128, expect=None,
+                          note="2-D heads x positions mesh: whole GQA "
+                               "groups per tp shard, equal page "
+                               "stripes per sp shard"))
+    # sp_pool: an sp-indivisible pool refuses on EVERY platform
+    # (structural, like tp_heads — the sweep test checks it under
+    # assume_tpu=False too)
+    cases.append(dict(page=64, head_dim=128, quantized=False,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=16, sp=2, n_pages=127, expect="sp_pool",
+                      note="unequal stripes cannot shard_map the page "
+                           "axis; the batcher always sizes divisible "
+                           "pools — this gate protects direct callers"))
+    # precedence: the structural gates outrank the Mosaic tile gates
+    # (tp_heads > sp_pool > head_dim, mirroring the gate order)
+    cases.append(dict(page=64, head_dim=64, quantized=False,
+                      dtype="bf16", rows=8, tp=1, n_kv_heads=8,
+                      n_heads=16, sp=2, n_pages=127, expect="sp_pool"))
+    cases.append(dict(page=64, head_dim=128, quantized=False,
+                      dtype="bf16", rows=8, tp=2, n_kv_heads=3,
+                      n_heads=6, sp=2, n_pages=127, expect="tp_heads"))
     return cases
 
 

@@ -54,10 +54,21 @@ def load_records(root: Optional[str] = None) -> Dict[str, List[dict]]:
     return out
 
 
+def _numeric(v) -> bool:
+    """True for a real measurement: int/float, finite-ish, not bool.
+    Degraded/outage lines carry null or string values ("wedged",
+    "cpu_fallback notes") — those must SKIP THE CELL, never poison the
+    row or hide the round's other metrics."""
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
 def trajectory(root: Optional[str] = None) -> dict:
     """The collation: rounds in order, and per metric its unit plus
     {round: value}.  A metric appearing twice in one round keeps the
-    LAST record (bench reruns append)."""
+    LAST record (bench reruns append).  A record missing its value or
+    carrying a non-numeric one (a degraded/outage line) contributes
+    its metric ROW but no cell — the rest of that round's records
+    still collate."""
     by_round = load_records(root)
     rounds = sorted(by_round)
     metrics: Dict[str, dict] = {}
@@ -66,7 +77,11 @@ def trajectory(root: Optional[str] = None) -> dict:
             name = rec["metric"]
             entry = metrics.setdefault(
                 name, {"unit": rec.get("unit"), "values": {}})
-            entry["values"][rnd] = rec.get("value")
+            val = rec.get("value")
+            if _numeric(val):
+                # last NUMERIC record wins; a degraded line never
+                # overwrites a real measurement from the same round
+                entry["values"][rnd] = val
             if rec.get("unit"):
                 entry["unit"] = rec["unit"]
     for entry in metrics.values():

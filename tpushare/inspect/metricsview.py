@@ -140,6 +140,10 @@ def summarize_serving(parsed: dict) -> dict:
         "attn_fallbacks": sum(
             v for _, v in parsed["samples"].get(
                 "tpushare_attn_kernel_fallback_total", ())) or None,
+        # position striping (round 17): how many shards one sequence's
+        # KV pages span (1 = unstriped; > 1 multiplies per-sequence
+        # max context by the degree)
+        "kv_stripe_shards": _gauge(parsed, "tpushare_kv_stripe_shards"),
         # mixed-step scheduler: mid-prefill queue depth and how full the
         # last round's coalesced prefill block was
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
@@ -288,12 +292,13 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "ATTN", "SPEC", "PREFILL Q",
+              "KV BYTES(dtype)", "ATTN", "STRIPE", "SPEC", "PREFILL Q",
               "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-", "-", "-",
+                          "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -309,6 +314,11 @@ def render_metrics_table(
             # the viability gates demoted some compiled program(s) to
             # the gather — the ATTN column must not read "pallas" clean
             attn += f" (fb {int(summary['attn_fallbacks'])})"
+        # STRIPE: position shards per sequence ("x4" = this pool
+        # stripes every sequence's pages over 4 shards)
+        stripe = "-"
+        if summary.get("kv_stripe_shards"):
+            stripe = f"x{int(summary['kv_stripe_shards'])}"
         # SPEC: tokens committed per verify round (the acceptance win),
         # with the skipped/disabled fallback count alongside so a
         # "spec on, nothing speculating" node explains itself
@@ -330,6 +340,7 @@ def render_metrics_table(
             kv,
             kv_bytes,
             attn,
+            stripe,
             spec,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),

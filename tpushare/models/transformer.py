@@ -746,7 +746,8 @@ def paged_read_transient_bytes(cfg: ModelConfig, rows: int,
 
 
 def paged_attention(q, k_store, v_store, page_table, positions,
-                    cfg: ModelConfig, mesh=None, tp_axis: str = "tp"):
+                    cfg: ModelConfig, mesh=None, tp_axis: str = "tp",
+                    sp_axis: str = "sp"):
     """THE paged-pool attention read dispatcher — every paged forward
     flavor (decode tick, prefill chunk, coalesced prefill batch, page
     ring, prefix cache) routes here, so ``cfg.attn_kernel`` governs one
@@ -765,20 +766,34 @@ def paged_attention(q, k_store, v_store, page_table, positions,
     runs per-shard through
     :func:`tpushare.ops.attention.sharded_paged_decode_attention`
     (pallas_call is not SPMD-partitionable; the gather path needs no
-    wrapper — XLA's partitioner shards it)."""
+    wrapper — XLA's partitioner shards it).
+
+    A ``mesh`` with a >1 ``sp`` axis (round 17) STRIPES the pool's
+    pages over position shards and routes through
+    :func:`_sp_striped_attention`: the kernel runs per shard over its
+    local stripe with an online-softmax merge across shards, the
+    gather fallback all-gathers the per-shard stripe views back into
+    the bit-exact full-key read.  An sp-indivisible pool
+    (``sp_pool``) runs the plain paths below over the
+    legalization-replicated pool instead."""
+    from ..ops.attention import tp_degree
+    sp = tp_degree(mesh, sp_axis)
+    leaf = _kv_leaf(k_store)
+    if sp > 1 and leaf.shape[0] % sp == 0:
+        return _sp_striped_attention(q, k_store, v_store, page_table,
+                                     positions, cfg, mesh,
+                                     tp_axis=tp_axis, sp_axis=sp_axis)
     if cfg.attn_kernel == "pallas":
         from ..ops.attention import (count_attn_fallback,
                                      paged_decode_attention,
                                      paged_kernel_fallback_reason,
-                                     sharded_paged_decode_attention,
-                                     tp_degree)
-        leaf = _kv_leaf(k_store)
+                                     sharded_paged_decode_attention)
         rows = (q.shape[1] // cfg.n_kv_heads) * q.shape[2]
         tp = tp_degree(mesh, tp_axis)
         reason = paged_kernel_fallback_reason(
             leaf.shape[2], leaf.shape[3], kv_quantized(cfg), cfg.dtype,
             rows=rows, tp=tp, n_kv_heads=leaf.shape[1],
-            n_heads=q.shape[1])
+            n_heads=q.shape[1], sp=sp, n_pages=leaf.shape[0])
         if reason is None:
             if tp > 1:
                 return sharded_paged_decode_attention(
@@ -795,6 +810,95 @@ def paged_attention(q, k_store, v_store, page_table, positions,
         _expand_kv(_paged_gather_deq(v_store, page_table, cfg),
                    h // hkv),
         positions, window=cfg.window)
+
+
+def _sp_striped_attention(q, k_store, v_store, page_table, positions,
+                          cfg: ModelConfig, mesh, tp_axis: str = "tp",
+                          sp_axis: str = "sp"):
+    """Position-striped paged read (round 17): dispatch between the
+    striped Pallas kernel and the striped XLA gather.
+
+    Kernel path: per-shard page walk + cross-shard online-softmax
+    merge (:func:`tpushare.ops.attention
+    .sp_striped_paged_decode_attention`) — the perf path, no dense
+    transient, accuracy-bounded vs the gather exactly like the
+    unsharded kernel is.  Gather path (``attn_kernel="xla"`` or any
+    kernel gate refusal): each shard gathers its LOCAL stripe (a
+    view-sized transient, NOT the pool-sized all-gather the
+    partitioner would emit for a global gather on a page-sharded
+    pool), the stripes all-gather and interleave back into global
+    position order, and ONE :func:`cached_attention` runs over the
+    reassembled full-key view — the SAME key order, shapes, and
+    reduction the unsharded gather path computes, so striped "xla"
+    streams are BIT-IDENTICAL to unsharded "xla" streams on every
+    dtype (the degenerate exact merge; the kernel path's logaddexp
+    merge is the online one).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.attention import (count_attn_fallback,
+                                 paged_kernel_fallback_reason,
+                                 sp_striped_paged_decode_attention,
+                                 striped_local_view, tp_degree)
+    from ..parallel.shardmap_compat import shard_map
+
+    leaf = _kv_leaf(k_store)
+    sp = tp_degree(mesh, sp_axis)
+    tp = tp_degree(mesh, tp_axis)
+    n_pages, page = leaf.shape[0], leaf.shape[2]
+    if cfg.attn_kernel == "pallas":
+        rows = (q.shape[1] // cfg.n_kv_heads) * q.shape[2]
+        reason = paged_kernel_fallback_reason(
+            leaf.shape[2], leaf.shape[3], kv_quantized(cfg), cfg.dtype,
+            rows=rows, tp=tp, n_kv_heads=leaf.shape[1],
+            n_heads=q.shape[1], sp=sp, n_pages=n_pages)
+        if reason is None:
+            return sp_striped_paged_decode_attention(
+                q, k_store, v_store, page_table, positions, mesh,
+                sp_axis=sp_axis, tp_axis=tp_axis, window=cfg.window)
+        count_attn_fallback(reason)
+    # striped XLA gather: local stripe gather -> all-gather -> global
+    # position-order reassembly -> the ONE cached_attention
+    per_shard = n_pages // sp
+    n_tbl = page_table.shape[1]
+    n_local = -(-n_tbl // sp)
+    tp_ok = (tp > 1 and cfg.n_heads % tp == 0
+             and cfg.n_kv_heads % tp == 0)
+    head = P(None, tp_axis, None, None) if tp_ok else P()
+    pool = P(sp_axis, tp_axis if tp_ok else None, None, None)
+    rep = P()
+
+    def store_specs(store):
+        return jax.tree_util.tree_map(lambda _: pool, store)
+
+    def body(q, ks, vs, tbl, pos):
+        shard = jax.lax.axis_index(sp_axis)
+        ltbl, _ = striped_local_view(tbl, sp, shard, per_shard, page)
+        kl = _paged_gather_deq(ks, ltbl, cfg)   # [B, Hkv/tp, Tl, D]
+        vl = _paged_gather_deq(vs, ltbl, cfg)
+
+        def regather(x):
+            g = jax.lax.all_gather(x, sp_axis, axis=0, tiled=False)
+            spn, bb, hh, _, d = g.shape
+            # [sp, B, H, n_local, page, D] -> range-major interleave
+            # (jj, s) -> global range jj*sp + s, then drop the padding
+            # ranges past the table
+            g = g.reshape(spn, bb, hh, n_local, page, d)
+            g = g.transpose(1, 2, 3, 0, 4, 5)
+            return g.reshape(bb, hh, n_local * spn * page,
+                             d)[:, :, :n_tbl * page, :]
+
+        n_rep = q.shape[1] // kl.shape[1]
+        return cached_attention(
+            q, _expand_kv(regather(kl), n_rep),
+            _expand_kv(regather(vl), n_rep), pos, window=cfg.window)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(head, store_specs(k_store), store_specs(v_store),
+                  rep, rep),
+        out_specs=head, check_vma=False,
+    )(q, k_store, v_store, jnp.asarray(page_table, jnp.int32), positions)
 
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,

@@ -610,8 +610,9 @@ def _flash_bwd_pallas(causal, block_q, block_k, interpret, res, g,
 # ---------------------------------------------------------------------------
 # Pallas paged-attention decode kernel
 # ---------------------------------------------------------------------------
-def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
-                       scale: float, window: int, quantized: bool):
+def _paged_attn_kernel(*refs, page: int, scale: float, window: int,
+                       quantized: bool, with_pos_map: bool = False,
+                       with_stats: bool = False):
     """One (batch, kv-head, table-entry) program of the paged decode
     read: the grid's LAST dim walks the row's page table in logical
     order (TPU grids run sequentially, so the online-softmax carry
@@ -619,6 +620,16 @@ def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
     page block via the BlockSpec index map (scalar-prefetch), and int8
     pages dequantize IN REGISTER — the dense gathered view and its
     bf16 copy of the cache never exist.
+
+    ``with_pos_map`` (position striping, round 17): a SECOND
+    scalar-prefetch array gives each table entry's starting POSITION —
+    on a position shard, local entry j covers global positions
+    ``pos_map[j] .. pos_map[j]+page-1`` instead of ``j*page ..`` —
+    so per-shard page stripes mask in GLOBAL coordinates.
+    ``with_stats`` additionally writes the online-softmax statistics
+    (running max, sum-of-exp) as lane-broadcast ``[rows, 128]``
+    outputs, the partials the cross-shard merge
+    (:func:`sp_merge_partials`) consumes.
 
     Layouts (Mosaic wants (8k, 128) tiles in every block's last two
     dims; the interpreter does not enforce this — drive_paged_attn.py
@@ -646,10 +657,22 @@ def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
     """
     from jax.experimental import pallas as pl
 
+    refs = list(refs)
+    refs.pop(0)                                       # tbl_ref (index maps)
+    pos_ref = refs.pop(0) if with_pos_map else None
+    qpos_ref, q_ref = refs.pop(0), refs.pop(0)
     if quantized:
-        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_sc, l_sc, acc_sc = refs
+        k_ref, ks_ref, v_ref, vs_ref = refs[:4]
+        refs = refs[4:]
     else:
-        k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc = refs
+        k_ref, v_ref = refs[:2]
+        refs = refs[2:]
+    if with_stats:
+        o_ref, m_out, l_out = refs[:3]
+        refs = refs[3:]
+    else:
+        o_ref = refs.pop(0)
+    m_sc, l_sc, acc_sc = refs
 
     j = pl.program_id(2)
 
@@ -673,7 +696,8 @@ def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
 
     s = _dotf32(q, kk, transpose_b=True) * scale      # [rows, page] f32
     q_pos = qpos_ref[...][:, :1]                      # [rows, 1] (lane 0)
-    k_pos = j * page + jax.lax.broadcasted_iota(
+    base = pos_ref[j] if with_pos_map else j * page
+    k_pos = base + jax.lax.broadcasted_iota(
         jnp.int32, (rows, page), 1)
     keep = k_pos <= q_pos
     if window:
@@ -695,6 +719,9 @@ def _paged_attn_kernel(tbl_ref, qpos_ref, q_ref, *refs, page: int,
     def _finalize():
         o_ref[...] = (acc_new
                       / jnp.maximum(l_new[:, :1], 1e-30)).astype(o_ref.dtype)
+        if with_stats:
+            m_out[...] = m_new
+            l_out[...] = l_new
 
 
 #: Max query ROWS (n_rep * S, pre-padding) one kernel program holds on
@@ -711,7 +738,7 @@ PAGED_KERNEL_MAX_ROWS = 2048
 #: ``tpushare_attn_kernel_fallback_total`` (tests/test_metric_lint.py
 #: pins observations to this set)
 FALLBACK_REASONS = ("head_dim", "page_tile", "max_rows", "tp_heads",
-                    "forced")
+                    "sp_pool", "forced")
 
 
 def spec_verify_rows(n_heads: int, n_kv_heads: int, spec_k: int) -> int:
@@ -741,7 +768,8 @@ def paged_kernel_fallback_reason(page: int, head_dim: int,
                                  quantized: bool, dtype, rows: int = 1,
                                  tp: int = 1, n_kv_heads: int = 0,
                                  n_heads: int = 0,
-                                 assume_tpu: Optional[bool] = None
+                                 assume_tpu: Optional[bool] = None,
+                                 sp: int = 1, n_pages: int = 0
                                  ) -> Optional[str]:
     """THE viability gate for :func:`paged_decode_attention`, returning
     WHY the kernel cannot run (None = viable) so fallback sites can
@@ -766,6 +794,14 @@ def paged_kernel_fallback_reason(page: int, head_dim: int,
     are identical on every shard, so the fallback decision is uniform
     across shards by construction.
 
+    ``sp_pool`` (round 17) is the position-striping twin of
+    ``tp_heads``: ``sp`` > 1 runs the kernel per POSITION shard over
+    its local page stripe (:func:`sp_striped_paged_decode_attention`),
+    which needs the pool's ``n_pages`` divisible by the sp degree —
+    every shard must hold an equal stripe for the ``shard_map`` page
+    split.  Structural, refuses on every platform, degrades to the
+    striped (or, on an indivisible pool, replicated) XLA gather.
+
     ``assume_tpu`` overrides platform detection (None = detect): the
     chip-free Mosaic prechecker (``analysis.mosaic``) passes True to
     ask "would this lower on a REAL chip?" from a CPU host and
@@ -777,6 +813,8 @@ def paged_kernel_fallback_reason(page: int, head_dim: int,
     if tp > 1 and ((n_kv_heads and n_kv_heads % tp)
                    or (n_heads and n_heads % tp)):
         return "tp_heads"
+    if sp > 1 and n_pages and n_pages % sp:
+        return "sp_pool"
     if not (_on_tpu() if assume_tpu is None else assume_tpu):
         return None
     if head_dim % 128:
@@ -796,18 +834,21 @@ def paged_kernel_fallback_reason(page: int, head_dim: int,
 
 def paged_kernel_viable(page: int, head_dim: int, quantized: bool,
                         dtype, rows: int = 1, tp: int = 1,
-                        n_kv_heads: int = 0, n_heads: int = 0) -> bool:
+                        n_kv_heads: int = 0, n_heads: int = 0,
+                        sp: int = 1, n_pages: int = 0) -> bool:
     """Boolean view of :func:`paged_kernel_fallback_reason` (True =
     the kernel runs).  Callers fall back to the XLA gather when this
     returns False."""
     return paged_kernel_fallback_reason(
         page, head_dim, quantized, dtype, rows=rows, tp=tp,
-        n_kv_heads=n_kv_heads, n_heads=n_heads) is None
+        n_kv_heads=n_kv_heads, n_heads=n_heads, sp=sp,
+        n_pages=n_pages) is None
 
 
 def paged_decode_attention(q, k_store, v_store, page_table, positions,
                            window: Optional[int] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           pos_map=None, return_stats: bool = False):
     """Paged-pool attention read as ONE memory-bound Pallas pass.
 
     q: [B, H, S, D] (S = 1 decode, or a prefill window attending its
@@ -828,6 +869,15 @@ def paged_decode_attention(q, k_store, v_store, page_table, positions,
     .py), while dispatch flavors WITHIN this path stay exactly
     self-consistent.  GQA is native: K/V pages are read once per
     kv-head, never expanded.
+
+    Position striping (round 17): ``pos_map`` (int32 [n_tbl]) overrides
+    each table entry's starting position (default ``j * page``) — a
+    position shard's local table covers global ranges ``shard, shard +
+    sp, ...`` and masks in global coordinates.  ``return_stats`` also
+    returns the per-row online-softmax statistics ``(m, sumexp)``
+    [B, H, S] f32, the partials :func:`sp_merge_partials` folds across
+    shards.  Rows with NO kept key on this shard return m = NEG_INF,
+    sumexp = 0 and a zero output — weight zero in the merge.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -860,14 +910,24 @@ def paged_decode_attention(q, k_store, v_store, page_table, positions,
     qpos = jnp.broadcast_to(qpos[:, :, None], (b, rows_p, 128))
 
     n_pg = page_table.shape[1]
+    # index maps take *_ so the same lambdas serve 1 (table) or 2
+    # (table + pos_map) scalar-prefetch operands
     pool_spec = pl.BlockSpec(
-        (None, None, page, d), lambda bb, hh, j, tbl: (tbl[bb, j], hh, 0, 0))
+        (None, None, page, d),
+        lambda bb, hh, j, tbl, *_: (tbl[bb, j], hh, 0, 0))
     scale_spec = pl.BlockSpec(
-        (None, None, page, 1), lambda bb, hh, j, tbl: (tbl[bb, j], hh, 0, 0))
+        (None, None, page, 1),
+        lambda bb, hh, j, tbl, *_: (tbl[bb, j], hh, 0, 0))
+    row_spec = pl.BlockSpec((None, rows_p, 128),
+                            lambda bb, hh, j, tbl, *_: (bb, 0, 0))
+    out_spec = pl.BlockSpec((None, None, rows_p, d),
+                            lambda bb, hh, j, tbl, *_: (bb, hh, 0, 0))
+    stat_spec = pl.BlockSpec((None, None, rows_p, 128),
+                             lambda bb, hh, j, tbl, *_: (bb, hh, 0, 0))
     in_specs = [
-        pl.BlockSpec((None, rows_p, 128), lambda bb, hh, j, tbl: (bb, 0, 0)),
+        row_spec,
         pl.BlockSpec((None, None, rows_p, d),
-                     lambda bb, hh, j, tbl: (bb, hh, 0, 0)),
+                     lambda bb, hh, j, tbl, *_: (bb, hh, 0, 0)),
         pool_spec,
     ]
     args = [qpos, qr, kq]
@@ -880,25 +940,46 @@ def paged_decode_attention(q, k_store, v_store, page_table, positions,
         in_specs.append(scale_spec)
         args.append(v_store["s"])
 
+    out_specs: object = out_spec
+    out_shape: object = jax.ShapeDtypeStruct((b, hkv, rows_p, d), q.dtype)
+    if return_stats:
+        out_specs = [out_spec, stat_spec, stat_spec]
+        out_shape = [
+            out_shape,
+            # stats ride lane-broadcast [rows, 128] tiles like the
+            # flash kernel's lse (Mosaic cannot lower squeezed vectors)
+            jax.ShapeDtypeStruct((b, hkv, rows_p, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, rows_p, 128), jnp.float32),
+        ]
+    prefetch = [jnp.asarray(page_table, jnp.int32)]
+    if pos_map is not None:
+        prefetch.append(jnp.asarray(pos_map, jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=len(prefetch),
         grid=(b, hkv, n_pg),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, None, rows_p, d),
-                               lambda bb, hh, j, tbl: (bb, hh, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[pltpu.VMEM((rows_p, 128), jnp.float32),
                         pltpu.VMEM((rows_p, 128), jnp.float32),
                         pltpu.VMEM((rows_p, d), jnp.float32)],
     )
     kernel = functools.partial(_paged_attn_kernel, page=page, scale=scale,
-                               window=win, quantized=quantized)
-    out = pl.pallas_call(
+                               window=win, quantized=quantized,
+                               with_pos_map=pos_map is not None,
+                               with_stats=return_stats)
+    res = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows_p, d), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
-    )(jnp.asarray(page_table, jnp.int32), *args)
+    )(*prefetch, *args)
+    out = res[0] if return_stats else res
     out = out[:, :, :rows, :].reshape(b, hkv, n_rep, s, d)
-    return out.reshape(b, h, s, d)
+    out = out.reshape(b, h, s, d)
+    if not return_stats:
+        return out
+    m = res[1][:, :, :rows, 0].reshape(b, hkv, n_rep, s).reshape(b, h, s)
+    l = res[2][:, :, :rows, 0].reshape(b, hkv, n_rep, s).reshape(b, h, s)
+    return out, m, l
 
 
 def sharded_paged_decode_attention(q, k_store, v_store, page_table,
@@ -942,6 +1023,120 @@ def sharded_paged_decode_attention(q, k_store, v_store, page_table,
                   rep, rep),
         out_specs=head, check_vma=False,
     )(q, k_store, v_store, page_table, positions)
+
+
+def striped_local_view(page_table, sp: int, shard, pages_per_shard: int,
+                       page: int):
+    """One position shard's view of a GLOBAL striped page table.
+
+    Striped allocation (round 17) round-robins a sequence's logical
+    page ranges over the sp mesh axis — range ``j`` lives on shard
+    ``j % sp`` — and shards the pool's page axis contiguously, shard
+    ``s`` owning global pages ``[s*per, (s+1)*per)`` with local page 0
+    (global ``s*per``) as that shard's TRASH page.  Given the global
+    table [B, n_tbl] and a (traced) shard index, this returns
+
+    * ``local_table`` [B, ceil(n_tbl/sp)]: the shard's stripe of the
+      table in LOCAL page indices — entry ``jj`` covers global range
+      ``jj*sp + shard``; unreserved (0) and past-the-table entries map
+      to the shard's local trash page 0;
+    * ``pos_map`` [ceil(n_tbl/sp)] int32: each local entry's starting
+      POSITION, ``(jj*sp + shard) * page`` — what keeps masking in
+      global coordinates (past-the-table entries get positions >=
+      max_seq, beyond every query, so they mask out causally exactly
+      like unreserved ranges do in the unsharded walk).
+    """
+    n_tbl = page_table.shape[1]
+    n_local = -(-n_tbl // sp)
+    cols = shard + sp * jnp.arange(n_local)
+    safe = jnp.minimum(cols, n_tbl - 1)
+    g = jnp.take(page_table, safe, axis=1)
+    g = jnp.where((cols < n_tbl)[None, :], g, 0)
+    local = jnp.where(g == 0, 0, g - shard * pages_per_shard)
+    return local.astype(jnp.int32), (cols * page).astype(jnp.int32)
+
+
+def sp_merge_partials(out, m, l, axis_name: str):
+    """Online-softmax merge of per-position-shard attention partials.
+
+    Each shard's kernel walk produced ``out`` (its local keys'
+    softmax-weighted value average), ``m`` (running max of kept scaled
+    scores) and ``l`` (sum of exp relative to ``m``), all [B, H, S]
+    (+D).  The merge is the SAME logaddexp-weighted fold the kernel
+    applies per page, now across shards: with M = max_s(m_s),
+
+        out = sum_s exp(m_s - M) * l_s * out_s / sum_s exp(m_s - M) * l_s
+
+    — exact in exact arithmetic (it reconstitutes the full-key
+    softmax), and implemented as one ``pmax`` + two ``psum`` over the
+    sp axis (the all-reduce form of the 3-tuple ring the merge
+    literature describes).  A shard with no kept keys carries
+    m = NEG_INF (finite -1e30), l = 0: its weight ``exp(m - M) * l``
+    is 0 whether M is finite (exp underflows) or NEG_INF too (exp(0)
+    * 0) — no NaN path, matching the kernel's keep-multiply rule.
+    """
+    mf = m.astype(jnp.float32)
+    big = jax.lax.pmax(mf, axis_name)
+    w = jnp.exp(mf - big) * l.astype(jnp.float32)       # [B, H, S]
+    den = jax.lax.psum(w, axis_name)
+    num = jax.lax.psum(w[..., None] * out.astype(jnp.float32), axis_name)
+    return (num / jnp.maximum(den, 1e-30)[..., None]).astype(out.dtype)
+
+
+def sp_striped_paged_decode_attention(q, k_store, v_store, page_table,
+                                      positions, mesh,
+                                      sp_axis: str = "sp",
+                                      tp_axis: str = "tp",
+                                      window: Optional[int] = None,
+                                      interpret: Optional[bool] = None):
+    """:func:`paged_decode_attention` with the POOL'S PAGES striped
+    over the ``sp`` mesh axis: every shard runs the kernel over its
+    local page stripe (the ranges ``shard, shard+sp, ...`` of each
+    row's table, via :func:`striped_local_view`), producing per-shard
+    ``(out, max, sumexp)`` partials that :func:`sp_merge_partials`
+    folds into the full-key softmax — one sequence's KV pages, and so
+    its maximum context, now span the WHOLE mesh instead of one
+    shard's pool.
+
+    Composes with head sharding (2-D ``tp`` × ``sp`` mesh): q and the
+    pool's kv-head dim shard over ``tp`` exactly as in
+    :func:`sharded_paged_decode_attention` (whole GQA groups per
+    shard, no cross-head collective), while the page dim shards over
+    ``sp`` (the position merge is the only cross-shard collective).
+    Callers gate beforehand: head counts divide ``tp`` (``tp_heads``)
+    and n_pages divides ``sp`` (``sp_pool``) — see
+    :func:`paged_kernel_fallback_reason`.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.shardmap_compat import shard_map
+
+    sp = tp_degree(mesh, sp_axis)
+    tp = tp_degree(mesh, tp_axis)
+    leaf = k_store["q"] if isinstance(k_store, dict) else k_store
+    per_shard = leaf.shape[0] // sp
+    page = leaf.shape[2]
+    head = P(None, tp_axis if tp > 1 else None, None, None)
+    pool = P(sp_axis, tp_axis if tp > 1 else None, None, None)
+    rep = P()
+
+    def store_specs(store):
+        return jax.tree_util.tree_map(lambda _: pool, store)
+
+    def body(q, ks, vs, tbl, pos):
+        shard = jax.lax.axis_index(sp_axis)
+        ltbl, pmap = striped_local_view(tbl, sp, shard, per_shard, page)
+        o, m, l = paged_decode_attention(
+            q, ks, vs, ltbl, pos, window=window, interpret=interpret,
+            pos_map=pmap, return_stats=True)
+        return sp_merge_partials(o, m, l, sp_axis)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(head, store_specs(k_store), store_specs(v_store),
+                  rep, rep),
+        out_specs=head, check_vma=False,
+    )(q, k_store, v_store, jnp.asarray(page_table, jnp.int32), positions)
 
 
 def sharded_attention(q, k, v, mesh, axis: str = "tp",

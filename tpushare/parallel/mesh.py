@@ -156,7 +156,8 @@ def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     return P(*out)
 
 
-def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp"):
+def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp",
+                     page_axis: Optional[str] = None):
     """Place stacked KV serving storage onto the mesh, sharded on the
     kv-head dim.
 
@@ -169,12 +170,24 @@ def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp"):
     fractional grant.  Falls back to replication (via the divisibility
     legalization) when Hkv doesn't divide, e.g. deep-GQA models on a
     wide tp axis.
+
+    ``page_axis`` (paged pools only — dim 1 is the PAGE dim there, the
+    batch dim in dense caches) additionally shards the page dim: the
+    round-17 position striping that spreads ONE sequence's KV pages
+    across the mesh, multiplying per-sequence context and HBM by the
+    axis size.  Same divisibility legalization: an indivisible page
+    count replicates, and the read dispatcher's ``sp_pool`` gate
+    degrades to the unsharded paths.
     """
-    if axis not in mesh.axis_names:
+    page_entry = page_axis if (page_axis and page_axis
+                               in mesh.axis_names) else None
+    head_entry = axis if axis in mesh.axis_names else None
+    if page_entry is None and head_entry is None:
         return storage
 
     def _place(leaf):
-        spec = _legalize(P(None, None, axis, None, None), leaf.shape, mesh)
+        spec = _legalize(P(None, page_entry, head_entry, None, None),
+                         leaf.shape, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(_place, storage)

@@ -554,6 +554,7 @@ class ContinuousBatcher:
         metrics.ATTN_KERNEL_INFO.clear()
         metrics.ATTN_KERNEL_INFO.set(
             1, attn_kernel=info.get("attn_kernel", "xla"))
+        metrics.KV_STRIPE_SHARDS.set(info.get("sp_shards", 1))
 
     def _observe_tick(self, t0: float) -> None:
         """Record one tick's wall time and the post-tick occupancy."""

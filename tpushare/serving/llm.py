@@ -115,6 +115,7 @@ class LLMServer:
                  page_size: int = 0,
                  n_pages: int = 0,
                  tp: int = 0,
+                 sp: int = 0,
                  spec_k: int = 0,
                  prefix_cache: bool = False,
                  prefill_budget: int = 0,
@@ -161,6 +162,12 @@ class LLMServer:
             raise ValueError("tp > 1 requires n_slots > 0 "
                              "(tensor-parallel serving rides the "
                              "continuous batcher)")
+        if sp > 1 and (n_slots <= 0 or page_size <= 0):
+            # position striping spreads PAGES over the mesh; only the
+            # paged pool has pages to stripe
+            raise ValueError("sp > 1 requires n_slots > 0 and "
+                             "page_size > 0 (position striping is a "
+                             "paged-pool feature)")
         # attn_kernel="pallas" + tp > 1 is served: the paged dispatcher
         # shard_maps the kernel over the tp axis (whole GQA head groups
         # per shard; ops.attention.sharded_paged_decode_attention) and
@@ -171,9 +178,14 @@ class LLMServer:
             from .continuous import ContinuousService
 
             mesh = None
-            if tp > 1:
+            if tp > 1 or sp > 1:
                 from ..parallel.mesh import make_mesh
-                mesh = make_mesh({"tp": tp})
+                axes = {}
+                if tp > 1:
+                    axes["tp"] = tp
+                if sp > 1:
+                    axes["sp"] = sp     # position striping (round 17)
+                mesh = make_mesh(axes)
             self._service = ContinuousService(
                 params, cfg, n_slots,
                 page_size=page_size or None,
@@ -184,6 +196,21 @@ class LLMServer:
                 mixed_step=mixed_step,
                 prefill_budget=prefill_budget or None,
                 spill_bytes=spill_bytes or None).start()
+            # Operator-visible kernel demotion (round 17 satellite): a
+            # pallas config whose pool fails a viability gate (e.g. a
+            # page_size=16 int8 pool's 32-row sublane tile) serves the
+            # XLA gather on every tick — say so ONCE at startup instead
+            # of leaving only the "(fb N)" metric to find.
+            info = self._service._batcher.storage_info()
+            reason = info.get("attn_fallback_reason")
+            if reason:
+                log.warning(
+                    "attn_kernel='pallas' cannot run on this pool "
+                    "(reason=%s): serving falls back to the XLA "
+                    "gather read — see "
+                    "tpushare_attn_kernel_fallback_total{reason=%r} "
+                    "and the ATTN column in `kubectl inspect tpushare "
+                    "--metrics`", reason, reason)
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -872,6 +899,20 @@ def main(argv=None) -> int:
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel degree over the pod's visible "
                          "devices (0/1 = single device); requires --slots")
+    ap.add_argument("--sp", type=int, default=0,
+                    help="position-striping degree: stripe every "
+                         "sequence's KV pages round-robin across this "
+                         "many mesh shards, multiplying per-sequence "
+                         "max context and HBM by the degree (the "
+                         "long-context knob — a sequence no longer "
+                         "fits one shard's pool or nothing).  Requires "
+                         "--slots and --page-size (full-causal models; "
+                         "the windowed page ring cannot stripe); "
+                         "composes with --tp (tp*sp devices), "
+                         "--kv-dtype int8 (half the merge traffic), "
+                         "--attn-kernel pallas (per-shard page walk + "
+                         "online-softmax merge), --spec-k, and "
+                         "session migration")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="prompt-lookup speculation depth (0 = off; "
                          "greedy-exact; requires --slots).  Works on "
@@ -928,6 +969,8 @@ def main(argv=None) -> int:
         ap.error("--kv-pages requires --page-size")
     if args.tp > 1 and not args.slots:
         ap.error("--tp requires --slots")
+    if args.sp > 1 and not (args.slots and args.page_size):
+        ap.error("--sp requires --slots and --page-size")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -964,7 +1007,7 @@ def main(argv=None) -> int:
                 "TPUSHARE_PROBE_DEADLINE_S", "180")))
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
-                    n_pages=args.kv_pages, tp=args.tp,
+                    n_pages=args.kv_pages, tp=args.tp, sp=args.sp,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill,
@@ -986,9 +1029,10 @@ def main(argv=None) -> int:
         threading.Thread(target=_report_loop, daemon=True,
                          name="tpushare-usage-report").start()
         log.info("usage reporting to daemon every %.0fs", interval)
-    log.info("llm server: model=%s quant=%s kv=%s tp=%d on :%d", args.model,
+    log.info("llm server: model=%s quant=%s kv=%s tp=%d sp=%d on :%d",
+             args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
-             args.kv_dtype, args.tp, srv.port)
+             args.kv_dtype, args.tp, args.sp, srv.port)
     srv.serve_forever()
     return 0
 

@@ -261,6 +261,22 @@ class PagedContinuousBatcher(ContinuousBatcher):
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq // page_size
+        # POSITION STRIPING (round 17): a mesh with a >1 "sp" axis
+        # round-robins each sequence's logical page ranges over the
+        # position shards (range j -> stripe j % sp) and shards the
+        # pool's page axis, so ONE sequence's KV pages — and its max
+        # context — span the whole mesh instead of one shard's pool.
+        from ..ops.attention import tp_degree
+        self.sp_shards = tp_degree(mesh, "sp")
+        if self.sp_shards > 1 and transformer.wants_rolling(cfg):
+            # the windowed page RING recycles pages in place; striping
+            # its eviction arithmetic across shards buys nothing (the
+            # ring is already O(window)) and would entangle the margin
+            # logic — refuse loudly instead of serving a subtle alias
+            raise ValueError(
+                "position striping (sp mesh axis) requires a "
+                "full-causal config — the windowed page ring recycles "
+                "pages in place")
         if pool_bytes is not None:
             # size the pool by an HBM BUDGET instead of a page count:
             # the same byte grant buys ~2x the pages under kv_dtype=int8
@@ -269,6 +285,11 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 raise ValueError("pass n_pages or pool_bytes, not both")
             from ..ops.quant import kv_cache_bytes
             n_pages = int(pool_bytes) // kv_cache_bytes(cfg, page_size)
+            if self.sp_shards > 1:
+                # a byte budget rounds DOWN to equal stripes (never
+                # exceed the grant); a budget too small for one usable
+                # page per stripe raises below like any tiny pool
+                n_pages = (n_pages // self.sp_shards) * self.sp_shards
         # Upper bound on any prefill chunk through this batcher —
         # admission clamps to it.  Sized into the windowed page ring
         # (see _held_pages); irrelevant for full-causal requests.
@@ -297,8 +318,19 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # dense equivalent + 1 trash page). Pass a smaller n_pages to
         # overcommit slots against the real traffic mix — the point.
         self.n_pages = (n_pages if n_pages is not None
-                        else n_slots * self.pages_per_slot + 1)
-        if self.n_pages < 2:
+                        else n_slots * self.pages_per_slot
+                        + self.sp_shards)
+        if self.sp_shards > 1:
+            # equal stripes: every shard holds n_pages/sp pages with
+            # its own local trash page (global s*per) — an explicit
+            # n_pages rounds UP so no stripe comes up short of what
+            # the caller asked for
+            sp = self.sp_shards
+            self.n_pages = -(-self.n_pages // sp) * sp
+            if self.n_pages < 2 * sp:
+                raise ValueError("need at least one non-trash page "
+                                 "per position stripe")
+        elif self.n_pages < 2:
             raise ValueError("need at least one non-trash page")
         # paged storage is position-indexed (no ring wraparound); the
         # rolling-slot layout is a dense-pool concern
@@ -309,6 +341,20 @@ class PagedContinuousBatcher(ContinuousBatcher):
                          max_new_tokens: int) -> None:
         super().validate_request(prompt, max_new_tokens)
         need = self._held_pages(len(prompt), max_new_tokens)
+        sp = self.sp_shards
+        if sp > 1:
+            # capacity is PER STRIPE: range j draws from stripe j % sp,
+            # each stripe holding n_pages/sp - 1 usable pages (its
+            # local trash is never allocatable) — a request fits iff
+            # every stripe can carry its share of the ranges
+            usable = self.n_pages // sp - 1
+            worst = -(-need // sp)          # stripe 0 carries the ceil
+            if worst > usable:
+                raise ValueError(
+                    f"request needs {worst} pages on a position stripe "
+                    f"but each of the {sp} stripes holds only {usable} "
+                    f"usable pages")
+            return
         if need > self.n_pages - 1:     # page 0 is never allocatable
             raise ValueError(
                 f"request needs {need} pages but the pool holds only "
@@ -346,7 +392,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         an int8 pool prices its pages (and the ``pool_bytes`` sizing
         knob admits ~2x of them) with the same model the gauges and
         ``/usage`` reporting use."""
-        from ..ops.attention import (paged_kernel_viable,
+        from ..ops.attention import (paged_kernel_fallback_reason,
                                      spec_verify_rows, tp_degree)
         from ..ops.quant import kv_cache_bytes
         cfg = self.cfg
@@ -355,7 +401,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # config whose pool cannot lower on Mosaic (page below the
         # dtype's sublane tile, lane-unaligned head_dim), whose head
         # counts a tp mesh cannot split into whole GQA groups per
-        # shard, or a forced reference escape hatch runs the XLA
+        # shard, whose page count an sp mesh cannot split into equal
+        # stripes, or a forced reference escape hatch runs the XLA
         # gather — telemetry must say so, or an operator debugging HBM
         # pressure / a flat speedup reads "pallas, transient 0" while
         # every tick pays the dense gather.  A spec-provisioned pool
@@ -364,26 +411,55 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # rows wide, not 1
         rows = (spec_verify_rows(cfg.n_heads, cfg.n_kv_heads,
                                  self.spec_k) if self.spec_k else 1)
+        sp = self.sp_shards
         kernel = cfg.attn_kernel
-        if kernel == "pallas" and not paged_kernel_viable(
+        reason = None
+        if kernel == "pallas":
+            reason = paged_kernel_fallback_reason(
                 self.page_size, cfg.head_dim,
                 transformer.kv_quantized(cfg), cfg.dtype, rows=rows,
                 tp=tp_degree(self.mesh), n_kv_heads=cfg.n_kv_heads,
-                n_heads=cfg.n_heads):
-            kernel = "xla"
-        return {"kind": "paged", "kv_dtype": cfg.kv_dtype,
+                n_heads=cfg.n_heads, sp=sp, n_pages=self.n_pages)
+            if reason is not None:
+                kernel = "xla"
+        pool_bytes = int(bytes_per_page * self.n_pages)
+        info = {"kind": "paged", "kv_dtype": cfg.kv_dtype,
                 # the attention READ path + what the XLA gather's dense
                 # per-layer transient peaks at (0 under the Pallas
                 # kernel — the saving the kernel exists for; see
                 # transformer.paged_read_transient_bytes)
                 "attn_kernel": kernel,
+                # WHY a configured pallas kernel degrades (None/absent
+                # when clean) — what llm.py logs once at service start
+                # so a silent page_tile/head_dim/sp_pool demotion is an
+                # operator-visible fact, not a buried "(fb N)"
+                "attn_fallback_reason": reason,
                 "attn_read_transient_bytes":
                     transformer.paged_read_transient_bytes(
                         cfg, self.n_slots, attn_kernel=kernel),
                 "page_tokens": self.page_size,
                 "bytes_per_page": int(bytes_per_page),
                 "n_pages": self.n_pages,
-                "pool_bytes": int(bytes_per_page * self.n_pages)}
+                "pool_bytes": pool_bytes,
+                # position striping (round 17): shards one sequence's
+                # pages span, and what each shard persistently holds
+                "sp_shards": sp,
+                "pool_bytes_per_shard": pool_bytes // sp}
+        if sp > 1:
+            # what the cross-shard merge moves per striped KERNEL
+            # dispatch per layer: each shard contributes its f32
+            # (out, max, sumexp) partial 3-tuple over `rows` query
+            # rows per slot — head_dim + 2 stat lanes of f32 per
+            # (slot, head).  The striped GATHER path instead
+            # all-gathers the dense view, which is exactly
+            # attn_read_transient_bytes (now crossing the interconnect
+            # rather than staying HBM-local)
+            # rows = n_rep * (1 + spec_k) per kv head, so a slot's
+            # query rows total n_kv_heads * rows
+            info["sp_merge_transient_bytes"] = int(
+                self.n_slots * cfg.n_kv_heads * rows
+                * (cfg.head_dim + 2) * 4)
+        return info
 
     # -- storage hooks -------------------------------------------------
     def _init_storage(self) -> None:
@@ -391,19 +467,56 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.cfg, self.n_pages, self.page_size)
         if self.mesh is not None:
             from ..parallel.mesh import shard_kv_storage
-            self.pools = shard_kv_storage(self.pools, self.mesh)
+            self.pools = shard_kv_storage(self.pools, self.mesh,
+                                          page_axis="sp")
         self.page_table = np.zeros(
             (self.n_slots, self.pages_per_slot), np.int32)
-        self._free_pages: List[int] = list(range(1, self.n_pages))  # 0=trash
+        # Free pages, one list per position stripe.  Unstriped (sp==1)
+        # this is one list and page 0 the one trash page — byte-for-
+        # byte the old layout.  Striped, stripe s owns global pages
+        # [s*per, (s+1)*per) and its local page 0 (global s*per) is
+        # that stripe's TRASH page: striped_local_view maps global 0
+        # (the 0-padded table convention) onto it per shard, and the
+        # allocator never hands any of them out.
+        per = self.n_pages // self.sp_shards
+        self._pages_per_stripe = per
+        self._free_by_stripe: List[List[int]] = [
+            list(range(s * per + 1, (s + 1) * per))
+            for s in range(self.sp_shards)]
         self._slot_pages: Dict[int, List[int]] = {}
         self._update_page_gauges()
 
+    # -- striped free-list helpers -------------------------------------
+    # (sp == 1 degenerates to one list; every mutation routes through
+    # these so the stripe invariant — range j's page on stripe j % sp —
+    # cannot be violated by one forgotten call site)
+    def _stripe_of_page(self, p: int) -> int:
+        return int(p) // self._pages_per_stripe
+
+    def _free_pages_return(self, pages) -> None:
+        for p in pages:
+            self._free_by_stripe[self._stripe_of_page(p)].append(int(p))
+
+    def free_page_count(self) -> int:
+        return sum(len(s) for s in self._free_by_stripe)
+
+    def _stripe_need(self, ranges) -> List[int]:
+        need = [0] * self.sp_shards
+        for j in ranges:
+            need[j % self.sp_shards] += 1
+        return need
+
+    def _stripes_short(self, need: List[int]) -> bool:
+        return any(n > len(self._free_by_stripe[s])
+                   for s, n in enumerate(need))
+
     def _update_page_gauges(self) -> None:
-        """KV-pool utilization for /metrics (page 0 — trash — excluded:
-        it is never allocatable, so used+free == n_pages-1)."""
-        free = len(self._free_pages)
+        """KV-pool utilization for /metrics (trash pages — one per
+        stripe, page 0 alone unstriped — excluded: never allocatable,
+        so used+free == n_pages - sp_shards)."""
+        free = self.free_page_count()
         metrics.KV_PAGES_FREE.set(free)
-        metrics.KV_PAGES_USED.set(self.n_pages - 1 - free)
+        metrics.KV_PAGES_USED.set(self.n_pages - self.sp_shards - free)
 
     def _held_pages(self, prompt_len: int, max_new: int) -> int:
         """Physical pages a request occupies SIMULTANEOUSLY.
@@ -453,17 +566,25 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 best = entry
         return best
 
-    def _evict_prefixes(self, need_pages: int,
+    def _evict_prefixes(self, need_pages,
                         registry_room: int = 0) -> None:
         """Free LRU zero-active cached prefixes until ``need_pages``
         free pages exist AND ``registry_room`` more cached pages would
         fit the budget (or nothing evictable remains).  Entries with
         active mappings are never victims — a matched prefix must bump
         ``active`` BEFORE any eviction runs, or it could evict itself
-        and alias its pages."""
+        and alias its pages.  ``need_pages`` is a total count, or a
+        PER-STRIPE list on a striped pool (the binding constraint
+        there; a victim's pages relieve whichever stripes they live
+        on)."""
+        def _short():
+            if isinstance(need_pages, (list, tuple)):
+                return self._stripes_short(list(need_pages))
+            return self.free_page_count() < need_pages
+
         def _over():
             cached = sum(len(e.pages) for e in self._prefixes.values())
-            return (len(self._free_pages) < need_pages
+            return (_short()
                     or cached + registry_room > self.max_cached_pages)
 
         while _over():
@@ -472,7 +593,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 return
             victim = min(idle, key=lambda e: e.last_used)
             del self._prefixes[victim.tokens]
-            self._free_pages.extend(victim.pages)
+            self._free_pages_return(victim.pages)
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int,
                  prompt: Optional[List[int]] = None) -> bool:
@@ -486,28 +607,46 @@ class PagedContinuousBatcher(ContinuousBatcher):
             shared.active += 1
             shared.last_used = time.monotonic()
         own = held - n_shared
-        if own > len(self._free_pages):
-            self._evict_prefixes(own)
-        if own > len(self._free_pages):
+        # STRIPE-AWARE need: range j draws from stripe j % sp (the
+        # round-robin the striped read reconstructs); unstriped this
+        # is one stripe and the old total-count check
+        own_ranges = (list(range(n_shared, n_ranges))
+                      if held == n_ranges else list(range(own)))
+        need = self._stripe_need(own_ranges)
+        if self._stripes_short(need):
+            self._evict_prefixes(need)
+        if self._stripes_short(need):
             if shared is not None:
                 shared.active -= 1      # claim rolled back
             return False                # page backpressure
-        pages = [self._free_pages.pop() for _ in range(own)]
         self.page_table[slot, :] = 0
+        pages: List[int] = []
         if shared is not None:
             # read-only mapping of the registry's pages over the shared
-            # prefix; this slot's own pages take over from there
+            # prefix (the donor allocated them stripe-aligned, so range
+            # j's page already lives on stripe j % sp); this slot's own
+            # pages take over from there
             self.page_table[slot, :n_shared] = shared.pages
             self._slot_prefix[slot] = shared.tokens
             self._slot_shared[slot] = n_shared * self.page_size
             for j in range(n_shared, n_ranges):
-                self.page_table[slot, j] = pages[j - n_shared]
+                p = self._free_by_stripe[j % self.sp_shards].pop()
+                self.page_table[slot, j] = p
+                pages.append(p)
+        elif held == n_ranges:
+            # full-causal identity layout, one page per range, each
+            # from its stripe (sp == 1: the single free list, the old
+            # pop order)
+            for j in range(n_ranges):
+                p = self._free_by_stripe[j % self.sp_shards].pop()
+                self.page_table[slot, j] = p
+                pages.append(p)
         else:
-            # STATIC ring mapping: position range j -> pages[j % held];
-            # for full-causal requests held == n_ranges so this is the
-            # identity layout.  No mid-decode table updates, ever — the
-            # fixed-table invariant _tick_n depends on holds by
-            # construction.
+            # STATIC ring mapping: position range j -> pages[j % held]
+            # (windowed page ring; never striped — __init__ refuses).
+            # No mid-decode table updates, ever — the fixed-table
+            # invariant _tick_n depends on holds by construction.
+            pages = [self._free_by_stripe[0].pop() for _ in range(own)]
             for j in range(n_ranges):
                 self.page_table[slot, j] = pages[j % held]
         self._slot_pages[slot] = pages
@@ -536,7 +675,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         elif self.prefix_cache_enabled:
             self._maybe_register(slot)
         self.page_table[slot, :] = 0
-        self._free_pages.extend(self._slot_pages.pop(slot, []))
+        self._free_pages_return(self._slot_pages.pop(slot, []))
         self._update_page_gauges()
 
     def _maybe_register(self, slot: int) -> None:
@@ -711,9 +850,6 @@ class PagedContinuousBatcher(ContinuousBatcher):
                                      chunk=chunk, eos_id=eos_id,
                                      top_k=top_k, top_p=top_p)
 
-    def free_page_count(self) -> int:
-        return len(self._free_pages)
-
     # -- session migration (export / import / release) -----------------
     def can_migrate(self) -> bool:
         return True
@@ -852,15 +988,35 @@ class PagedContinuousBatcher(ContinuousBatcher):
         except (KeyError, TypeError, ValueError) as e:
             raise migrate.BlobError(
                 f"malformed session meta: {e}") from None
+        # STRIPE placement (round 17): the blob is layout-agnostic
+        # (logical ranges + page content), so sessions migrate freely
+        # between pools of DIFFERENT striping degrees — the receiver
+        # re-allocates each blob page on the stripe its range demands.
+        # A page referenced at ranges on different stripes (only a
+        # ring layout produces multi-range pages, and ring configs
+        # never fingerprint-match a striped receiver) cannot be
+        # represented here and refuses as a malformed blob.
+        stripe_of_local: Dict[int, int] = {}
+        for j, li in enumerate(ranges):
+            s = j % self.sp_shards
+            if stripe_of_local.setdefault(li, s) != s:
+                raise migrate.BlobError(
+                    "session blob maps one page at ranges on "
+                    "different position stripes; it cannot import "
+                    "into this striped pool")
+        need_by_stripe = [0] * self.sp_shards
+        for li in range(need):
+            need_by_stripe[stripe_of_local.get(li, 0)] += 1
         free = self.free_slots()
         if not free:
             return None
-        if need > len(self._free_pages):
-            self._evict_prefixes(need)
-        if need > len(self._free_pages):
+        if self._stripes_short(need_by_stripe):
+            self._evict_prefixes(need_by_stripe)
+        if self._stripes_short(need_by_stripe):
             return None
         slot = free[0]
-        pages = [self._free_pages.pop() for _ in range(need)]
+        pages = [self._free_by_stripe[stripe_of_local.get(li, 0)].pop()
+                 for li in range(need)]
         if content_idx:
             sel = jnp.asarray([pages[i] for i in content_idx], jnp.int32)
 
@@ -875,7 +1031,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                           rebuild("v", self.pools[1]))
                 self.pools = _scatter_pages(self.pools, sel, blocks)
             except (KeyError, TypeError, ValueError) as e:
-                self._free_pages.extend(pages)
+                self._free_pages_return(pages)
                 raise migrate.BlobError(
                     f"blob arrays do not match the pool layout: {e}") \
                     from None
