@@ -2,7 +2,7 @@
 # vet+test+build; here make wraps the same).
 PY ?= python3
 
-.PHONY: all native proto test bench lint asan clean tpu-records
+.PHONY: all native proto test bench lint asan tsan clean tpu-records
 
 all: native
 
@@ -16,9 +16,12 @@ native:
 lint:
 	env -u PALLAS_AXON_POOL_IPS $(PY) -m tpushare.analysis
 
-# Sanitizer self-check for the native shim (see native/Makefile).
+# Sanitizer self-checks for the native shim (see native/Makefile).
 asan:
 	$(MAKE) -C native asan
+
+tsan:
+	$(MAKE) -C native tsan
 
 proto:
 	protoc --python_out=tpushare/plugin/api \

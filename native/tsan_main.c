@@ -1,0 +1,105 @@
+/* ThreadSanitizer self-check driver for tpushim.c (`make -C native
+ * tsan`), mirroring the round-13 ASan lane (asan_main.c).
+ *
+ * The shim's thread contract: discovery/poll calls return pointers
+ * into static buffers and are SERIALIZED BY THE CALLER — in production
+ * that caller is the daemon's single poll loop (plus Python's GIL
+ * around the ctypes calls); tpushim_version() returns a string literal
+ * and is safe from any thread concurrently.  This driver encodes that
+ * contract under TSan:
+ *
+ *   1. the sequential full-surface walk (same edges as the ASan main);
+ *   2. N threads each doing the full walk under one pthread mutex —
+ *      TSan proves the documented serialization really is sufficient
+ *      (no hidden thread-unsafe state BESIDE the static buffers);
+ *   3. N lock-free concurrent tpushim_version() readers — the one
+ *      call documented as unconditionally thread-safe.
+ *
+ * Any data race aborts with a TSan report; a clean run prints
+ * "tsan-ok".  Opt-in test: TPUSHARE_RUN_TSAN=1 pytest
+ * tests/test_nativeshim.py
+ */
+
+#include <pthread.h>
+#include <stdio.h>
+
+int tpushim_init(void);
+void tpushim_shutdown(void);
+int tpushim_chip_count(void);
+const char *tpushim_chip_info_json(int index);
+const char *tpushim_poll_events_json(void);
+const char *tpushim_version(void);
+
+static pthread_mutex_t walk_lock = PTHREAD_MUTEX_INITIALIZER;
+
+static int walk_surface(void) {
+  tpushim_init();
+  int n = tpushim_chip_count();
+  for (int i = -1; i <= n; i++) {
+    const char *info = tpushim_chip_info_json(i);
+    if (info != NULL && i >= 0 && i < n) {
+      size_t len = 0;
+      while (info[len] != '\0') len++;
+      if (len == 0) return 1;
+    }
+  }
+  tpushim_poll_events_json();
+  tpushim_poll_events_json();
+  if (tpushim_version() == NULL) return 1;
+  return 0;
+}
+
+static void *serialized_walker(void *arg) {
+  long *failed = arg;
+  for (int round = 0; round < 4; round++) {
+    pthread_mutex_lock(&walk_lock);
+    if (walk_surface() != 0) *failed = 1; /* under the lock: no race */
+    pthread_mutex_unlock(&walk_lock);
+  }
+  return NULL;
+}
+
+static void *version_reader(void *arg) {
+  long *failed = arg;
+  for (int i = 0; i < 1000; i++) {
+    if (tpushim_version() == NULL) {
+      __atomic_store_n(failed, 1, __ATOMIC_RELAXED);
+    }
+  }
+  return NULL;
+}
+
+#define N_THREADS 4
+
+int main(void) {
+  /* 1: sequential reference walk (the ASan main's edges) */
+  if (walk_surface() != 0) {
+    fprintf(stderr, "sequential walk failed\n");
+    return 1;
+  }
+  tpushim_shutdown();
+
+  /* 2 + 3: mutex-serialized walkers alongside lock-free version
+   * readers — the documented concurrency envelope */
+  pthread_t walkers[N_THREADS], readers[N_THREADS];
+  long walk_failed[N_THREADS] = {0};
+  long read_failed = 0;
+  for (int i = 0; i < N_THREADS; i++) {
+    pthread_create(&walkers[i], NULL, serialized_walker,
+                   &walk_failed[i]);
+    pthread_create(&readers[i], NULL, version_reader, &read_failed);
+  }
+  int failed = 0;
+  for (int i = 0; i < N_THREADS; i++) {
+    pthread_join(walkers[i], NULL);
+    pthread_join(readers[i], NULL);
+    if (walk_failed[i]) failed = 1;
+  }
+  if (failed || __atomic_load_n(&read_failed, __ATOMIC_RELAXED)) {
+    fprintf(stderr, "threaded walk failed\n");
+    return 1;
+  }
+  tpushim_shutdown();
+  puts("tsan-ok");
+  return 0;
+}

@@ -415,6 +415,36 @@ def test_rule_migration_wire_confinement():
             tpulint.run_rule("migration-wire-confinement"))
 
 
+def test_rule_telemetry_lock_aliased_writes():
+    """The round-18 evasion: ``r = RECORDER; r._x = ...`` binds the
+    global then writes through the alias — caught now, resolved against
+    the write's enclosing function scope (an unrelated name reusing the
+    alias spelling in ANOTHER function stays legal)."""
+    bad = ("from tpushare.telemetry.events import RECORDER\n"
+           "def f():\n"
+           "    r = RECORDER\n"
+           "    r._buf = None\n"
+           "    r.state = 'ok'\n")
+    fs = _lint("tests/test_new.py", bad, "telemetry-lock")
+    assert [f.line for f in fs] == [4, 5]
+    # module-level aliases reach into functions too
+    mod = ("from tpushare.telemetry import health\n"
+           "m = health.MONITOR\n"
+           "def g():\n"
+           "    m._inflight = {}\n")
+    assert _lint("tests/test_new.py", mod, "telemetry-lock")
+    # an unrelated object using the same name in a DIFFERENT scope is
+    # not an alias (the scope resolution the global-set version lacked)
+    ok = ("from tpushare.telemetry.events import RECORDER\n"
+          "def f():\n"
+          "    r = RECORDER\n"
+          "    r.clear()\n"
+          "def g():\n"
+          "    r = object()\n"
+          "    r._buf = 1\n")
+    assert not _lint("tests/test_new.py", ok, "telemetry-lock")
+
+
 def test_run_rule_rejects_unknown_names():
     """A renamed rule cannot silently hollow out its pytest wrapper."""
     with pytest.raises(KeyError):
@@ -432,6 +462,313 @@ def test_repo_file_walk_covers_all_planes():
     assert "tests/test_metric_lint.py" in files
     assert "drives/drive_paged_attn.py" in files
     assert "bench.py" in files
+
+
+# ---------------------------------------------------------------------------
+# Layer 3: thread-confinement checker
+# ---------------------------------------------------------------------------
+from tpushare.analysis import confinement, dispatch_audit
+
+_SVC_FIXTURE = '''
+import threading
+_THREAD_MANIFEST = {
+    "class": "Svc",
+    "loop_roots": ("_loop",),
+    "construction": ("__init__",),
+    "join_synced": ("stop",),
+    "loop_confined": ("_sinks", "_batcher"),
+    "lock_crossed": ("_waiting",),
+    "batcher_attr": "_batcher",
+    "batcher_readonly": ("validate",),
+}
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sinks = {}
+        self._waiting = []
+        self._batcher = object()
+    def submit(self):
+        self._batcher.validate(1)
+        with self._lock:
+            self._waiting.append(3)
+    def stop(self):
+        self._sinks.clear()
+    def _loop(self):
+        with self._lock:
+            item = self._waiting.pop(0)
+        self._sinks[1] = item
+        self._batcher.tick()
+'''
+
+
+def test_confinement_clean_fixture_and_repo():
+    """The sanctioned patterns pass — loop mutations, locked queue
+    crossings, read-only batcher calls off-loop, join-synced cleanup —
+    and the REAL tree is clean (every round-16 offender repaired, not
+    allowlisted: llm.py goes through the public service API now)."""
+    assert confinement.check_source("tpushare/serving/continuous.py",
+                                    _SVC_FIXTURE) == []
+    findings = confinement.check_tree(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_confinement_catches_off_loop_mutation():
+    """Seeded violation: an HTTP-handler-thread method mutating a
+    loop-confined attribute directly."""
+    bad = _SVC_FIXTURE.replace(
+        "        self._batcher.validate(1)\n",
+        "        self._batcher.validate(1)\n"
+        "        self._sinks[9] = object()\n")
+    fs = confinement.check_source("tpushare/serving/continuous.py", bad)
+    assert [f.rule for f in fs] == ["loop-confined"], fs
+    assert "_sinks" in fs[0].message
+
+
+def test_confinement_catches_bypassed_command_queue():
+    """Seeded violation: appending to the waiting queue WITHOUT the
+    lock — the crossing exists, the discipline is bypassed."""
+    bad = _SVC_FIXTURE.replace(
+        "        with self._lock:\n"
+        "            self._waiting.append(3)\n",
+        "        self._waiting.append(3)\n")
+    fs = confinement.check_source("tpushare/serving/continuous.py", bad)
+    assert [f.rule for f in fs] == ["queue-crossing"], fs
+
+
+def test_confinement_catches_off_loop_batcher_call_and_alias():
+    """Seeded violations: a mutating batcher call from a handler
+    method, both direct and through a local alias."""
+    bad = _SVC_FIXTURE.replace(
+        "        self._batcher.validate(1)\n",
+        "        self._batcher.cancel(7)\n"
+        "        b = self._batcher\n"
+        "        b.tick()\n")
+    fs = confinement.check_source("tpushare/serving/continuous.py", bad)
+    assert [f.rule for f in fs] == ["batcher-ownership"] * 2, fs
+
+
+def test_confinement_manifest_staleness_is_loud():
+    """A manifest naming an attribute __init__ no longer creates (the
+    rename hazard) fails, as does naming a missing method."""
+    bad = _SVC_FIXTURE.replace('"loop_confined": ("_sinks", "_batcher")',
+                               '"loop_confined": ("_renamed",)')
+    fs = confinement.check_source("tpushare/serving/continuous.py", bad)
+    assert any(f.rule == "manifest-sync" and "_renamed" in f.message
+               for f in fs), fs
+
+
+def test_confinement_lock_discipline():
+    """Telemetry lock manifests: mutations outside ``with self._lock:``
+    are findings; ``__init__`` and ``*_locked`` (callers hold the lock,
+    registry.py's ``_state_locked`` convention) are exempt."""
+    fixture = '''
+import threading
+_LOCK_GUARDED = {"Mon": ("state", "_inflight")}
+class Mon:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "ok"
+        self._inflight = {}
+    def good(self):
+        with self._lock:
+            self.state = "bad"
+            self._inflight.clear()
+    def _grow_locked(self):
+        self._inflight[1] = 2
+'''
+    assert confinement.check_lock_discipline(
+        "tpushare/telemetry/new.py", fixture) == []
+    bad = fixture + ('    def bad(self):\n'
+                     '        self.state = "wedged"\n'
+                     '        self._inflight.pop(1)\n')
+    fs = confinement.check_lock_discipline("tpushare/telemetry/new.py",
+                                           bad)
+    assert [f.rule for f in fs] == ["lock-discipline"] * 2, fs
+
+
+def test_confinement_reach_rule():
+    """Service internals accessed outside continuous.py are findings
+    (the round-16 llm.py reach-throughs, now repaired); the protected
+    name set derives from the LIVE manifest."""
+    protected = confinement.protected_names(REPO)
+    assert "_batcher" in protected and "_waiting" in protected
+    fs = confinement.check_reach(
+        "tpushare/serving/llm.py",
+        "x = svc._batcher.storage_info()\n", protected)
+    assert [f.rule for f in fs] == ["service-internals"], fs
+    assert not confinement.check_reach(
+        "tpushare/serving/llm.py",
+        "x = svc.storage_info()\n", protected)
+
+
+# ---------------------------------------------------------------------------
+# Layer 4: dispatch auditor
+# ---------------------------------------------------------------------------
+_AUDIT_FIXTURE = '''
+import functools
+import jax
+import numpy as np
+from ..telemetry import health
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _tick_prog(x, n):
+    return x
+
+@functools.partial(jax.jit)
+def _other_prog(x):
+    return x
+
+_JIT_ENTRIES = [_tick_prog, _other_prog]
+
+class B:
+    def _step(self, x):
+        out = _tick_prog(x, 1)
+        return out
+    def tick(self):
+        with health.MONITOR.dispatch_guard("decode") as g:
+            out = self._step(1)
+            host = np.asarray(out)
+        return host
+'''
+
+
+def test_dispatch_audit_clean_fixture_and_repo():
+    """The sanctioned shape passes (one guarded hook dispatch, fetch
+    inside the guard), and the REAL tree audits clean: every tick
+    entry x storage flavor proves the one-dispatch round statically."""
+    assert dispatch_audit.audit_pair(_AUDIT_FIXTURE) == []
+    findings = dispatch_audit.audit_tree(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_dispatch_audit_catches_planted_second_dispatch():
+    bad = _AUDIT_FIXTURE.replace(
+        "            out = self._step(1)\n",
+        "            out = self._step(1)\n"
+        "            out = self._step(2)\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["dispatch-count"], fs
+    assert "exactly one _step" in fs[0].message
+
+
+def test_dispatch_audit_catches_direct_jit_on_steady_path():
+    """A jitted program called from the entry body bypasses the
+    storage hooks — the second-dispatch evasion that never names a
+    hook."""
+    bad = _AUDIT_FIXTURE.replace(
+        "            out = self._step(1)\n",
+        "            out = self._step(1)\n"
+        "            extra = _other_prog(out)\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert any(f.rule == "dispatch-count" and "_other_prog" in f.message
+               for f in fs), fs
+
+
+def test_dispatch_audit_catches_unguarded_dispatch_and_fetch():
+    bad = _AUDIT_FIXTURE.replace(
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n'
+        "            out = self._step(1)\n"
+        "            host = np.asarray(out)\n"
+        "        return host",
+        "        out = self._step(1)\n"
+        "        return np.asarray(out)")
+    rules = sorted(f.rule for f in dispatch_audit.audit_pair(bad))
+    assert rules == ["dispatch-fetch", "dispatch-guard"], rules
+
+
+def test_dispatch_audit_catches_eager_fetch_outside_guard():
+    """The fetch escaping the guard is the stall the watchdog cannot
+    attribute — caught even with the dispatch itself guarded."""
+    bad = _AUDIT_FIXTURE.replace(
+        "            host = np.asarray(out)\n        return host",
+        "        host = np.asarray(out)\n        return host")
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["dispatch-fetch"], fs
+
+
+def test_dispatch_audit_recurses_through_helper_chains():
+    """The one-extra-wrapper evasion: entry -> _outer() -> _inner() ->
+    jitted program.  The steady-path walk recurses through module
+    helpers to arbitrary depth (review finding, round 18)."""
+    bad = _AUDIT_FIXTURE.replace(
+        "class B:",
+        "def _inner(x):\n"
+        "    return _other_prog(x)\n"
+        "def _outer(x):\n"
+        "    return _inner(x)\n"
+        "class B:").replace(
+        "            out = self._step(1)\n",
+        "            out = self._step(1)\n"
+        "            extra = _outer(out)\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert any(f.rule == "dispatch-count" and "_other_prog" in f.message
+               for f in fs), fs
+
+
+def test_dispatch_audit_catches_item_fetch_outside_guard():
+    """``x.item()`` is the CLAUDE.md scalar-fetch barrier spelling —
+    an .item() on the hook result escaping the guard is the same
+    unattributable stall as a naked np.asarray (review finding,
+    round 18); a float() cast of plain host math stays legal."""
+    bad = _AUDIT_FIXTURE.replace(
+        "            host = np.asarray(out)\n        return host",
+        "            host = np.asarray(out)\n"
+        "        scalar = out.item()\n"
+        "        return scalar")
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["dispatch-fetch"], fs
+    # float() on host-math values (no hook-result names) is not a fetch
+    ok = _AUDIT_FIXTURE.replace(
+        "        return host",
+        "        pad = float(len([1]))\n        return host")
+    assert dispatch_audit.audit_pair(ok) == []
+
+
+def test_dispatch_audit_catches_fetch_inside_hook():
+    bad = _AUDIT_FIXTURE.replace(
+        "        out = _tick_prog(x, 1)\n",
+        "        out = np.asarray(_tick_prog(x, 1))\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert any(f.rule == "hook-body" and "host-fetches" in f.message
+               for f in fs), fs
+
+
+def test_dispatch_audit_catches_unregistered_jit():
+    bad = _AUDIT_FIXTURE.replace(
+        "_JIT_ENTRIES = [_tick_prog, _other_prog]",
+        "_JIT_ENTRIES = [_tick_prog]")
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["jit-registry"], fs
+    assert "_other_prog" in fs[0].message
+
+
+def test_dispatch_contract_matches_runtime_wrap_lists():
+    """The runtime dispatch-count tests build their counter wrap lists
+    FROM ENTRY_CONTRACT (tests/test_mixed_step.py,
+    tests/test_spec_storage.py) — pin the names those tests rely on so
+    a contract edit cannot silently hollow them out."""
+    assert dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"] \
+        == "_step_mixed"
+    assert dispatch_audit.ENTRY_CONTRACT["tick_mixed_spec"]["steady"] \
+        == "_step_mixed_spec"
+    hooks = set(dispatch_audit.TICK_HOOKS)
+    assert {c["steady"] for c in
+            dispatch_audit.ENTRY_CONTRACT.values()} == hooks
+
+
+def test_dispatch_cross_check_raises_on_drift():
+    """The live pin, mosaic-style: an unregistered jitted program (or
+    a renamed entry/hook) is a loud DispatchDriftError, not a silently
+    stale audit."""
+    from tpushare.serving import continuous  # noqa: F401 (jax-heavy)
+
+    dispatch_audit.cross_check_live()        # clean on the real tree
+    dropped = continuous._JIT_ENTRIES.pop()
+    try:
+        with pytest.raises(dispatch_audit.DispatchDriftError):
+            dispatch_audit.cross_check_live()
+    finally:
+        continuous._JIT_ENTRIES.append(dropped)
 
 
 # ---------------------------------------------------------------------------
@@ -467,6 +804,27 @@ def test_cli_flags_a_seeded_offender(tmp_path):
         env=_clean_env())
     assert out.returncode == 1, (out.stdout, out.stderr)
     assert "no-block-until-ready" in out.stdout
+
+
+def test_cli_json_findings(tmp_path):
+    """``--json`` emits machine-readable findings (rule id, file:line,
+    message) for CI/editors; exit code stays the contract."""
+    import json
+
+    bad = tmp_path / "tpushare" / "serving"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text("import jax\njax.block_until_ready(x)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "tpushare.analysis", "--json", "--root",
+         str(tmp_path), "tpushare/serving/bad.py"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env=_clean_env())
+    assert out.returncode == 1, (out.stdout, out.stderr)
+    findings = json.loads(out.stdout)
+    assert findings and findings[0]["rule"] == "no-block-until-ready"
+    assert findings[0]["path"] == "tpushare/serving/bad.py"
+    assert findings[0]["line"] == 2
+    assert findings[0]["message"]
 
 
 def test_lints_catalog_in_sync():

@@ -221,49 +221,77 @@ ENUMERATED_VALUES = {
         {"ok", "local_fallback", "reprefill"},
 }
 
+# -- enum pins (round-18 satellite): ONE declarative table ------------------
+#: label names whose values must be pinned to a module enum constant on
+#: every COUNTER family declaring them.  The rounds 14-17 families each
+#: grew an ad-hoc "enum matches constant" test; this table replaces
+#: them: a new counter with a reason/kind/outcome/policy/direction
+#: label fails the completeness sweep until it gets a pin, and a pinned
+#: constant drifting from ENUMERATED_VALUES fails the drift sweep.
+ENUM_PIN_LABELS = ("reason", "kind", "outcome", "policy", "direction")
+#: (family, label) -> (module, constant) — the ONE place a labelled
+#: counter's value enum is tied to the code that observes it
+ENUM_PINS = {
+    ("tpushare_attn_kernel_fallback_total", "reason"):
+        ("tpushare.ops.attention", "FALLBACK_REASONS"),
+    ("tpushare_spec_fallback_total", "reason"):
+        ("tpushare.serving.continuous", "SPEC_FALLBACK_REASONS"),
+    ("tpushare_router_requests_total", "policy"):
+        ("tpushare.serving.router", "ROUTER_POLICIES"),
+    ("tpushare_router_handoffs_total", "outcome"):
+        ("tpushare.serving.router", "HANDOFF_OUTCOMES"),
+    ("tpushare_migrations_out_total", "kind"):
+        ("tpushare.serving.migrate", "MIGRATION_OUT_KINDS"),
+    ("tpushare_migrations_in_total", "kind"):
+        ("tpushare.serving.migrate", "MIGRATION_IN_KINDS"),
+    ("tpushare_migration_refused_total", "reason"):
+        ("tpushare.serving.migrate", "MIGRATION_REFUSAL_REASONS"),
+    ("tpushare_migration_bytes_total", "direction"):
+        ("tpushare.serving.migrate", "MIGRATION_DIRECTIONS"),
+}
 
-def test_fallback_reason_enum_matches_gate():
-    """The lint's enumerated reasons and the gate's FALLBACK_REASONS
-    are the same set — a new gate reason without a deliberate enum
-    entry here would otherwise observe an un-enumerated label value."""
-    from tpushare.ops.attention import FALLBACK_REASONS
-    assert set(FALLBACK_REASONS) == ENUMERATED_VALUES[
-        ("tpushare_attn_kernel_fallback_total", "reason")]
+
+def test_every_enum_labelled_counter_is_pinned():
+    """Completeness sweep: every registered counter family declaring a
+    reason/kind/outcome/policy/direction label appears in ENUM_PINS —
+    adding a labelled counter without pinning its enum constant is a
+    reviewable decision made HERE, not an ad-hoc allowlisting."""
+    from tpushare import telemetry
+
+    _registered()
+    unpinned = []
+    for name, kind, _, labels in telemetry.REGISTRY.families():
+        if kind != "counter":
+            continue
+        for label in labels:
+            if label in ENUM_PIN_LABELS and (name, label) not in ENUM_PINS:
+                unpinned.append((name, label))
+    assert not unpinned, (
+        f"labelled counter(s) without a pinned enum constant: "
+        f"{unpinned}; add a module constant and an ENUM_PINS entry")
 
 
-def test_spec_fallback_reason_enum_matches_constant():
-    """Same discipline for the speculation capability/routing reasons:
-    the serving constant and the lint enum must be one set."""
-    from tpushare.serving.continuous import SPEC_FALLBACK_REASONS
-    assert set(SPEC_FALLBACK_REASONS) == ENUMERATED_VALUES[
-        ("tpushare_spec_fallback_total", "reason")]
+def test_enum_pins_match_module_constants():
+    """Drift sweep: each pinned module constant, the ENUMERATED_VALUES
+    entry, and the declared family agree — one set each, so a new enum
+    value ships its lint entry (and its dashboards) or fails here."""
+    import importlib
 
+    from tpushare import telemetry
 
-def test_router_policy_enum_matches_constant():
-    """The fleet router's policy labels and the lint enum are one set —
-    a new routing policy without a deliberate enum entry here would
-    observe an un-enumerated label value."""
-    from tpushare.serving.router import ROUTER_POLICIES
-    assert set(ROUTER_POLICIES) == ENUMERATED_VALUES[
-        ("tpushare_router_requests_total", "policy")]
-
-
-def test_migration_enums_match_constants():
-    """The migration plane's kind/reason/outcome enums and the module
-    constants are one set each — a new kind without a deliberate enum
-    entry here would observe an un-enumerated label value."""
-    from tpushare.serving.migrate import (MIGRATION_IN_KINDS,
-                                          MIGRATION_OUT_KINDS,
-                                          MIGRATION_REFUSAL_REASONS)
-    from tpushare.serving.router import HANDOFF_OUTCOMES
-    assert set(MIGRATION_OUT_KINDS) == ENUMERATED_VALUES[
-        ("tpushare_migrations_out_total", "kind")]
-    assert set(MIGRATION_IN_KINDS) == ENUMERATED_VALUES[
-        ("tpushare_migrations_in_total", "kind")]
-    assert set(MIGRATION_REFUSAL_REASONS) == ENUMERATED_VALUES[
-        ("tpushare_migration_refused_total", "reason")]
-    assert set(HANDOFF_OUTCOMES) == ENUMERATED_VALUES[
-        ("tpushare_router_handoffs_total", "outcome")]
+    _registered()
+    declared = {name: set(labels)
+                for name, _, _, labels in telemetry.REGISTRY.families()}
+    for (family, label), (mod, const) in ENUM_PINS.items():
+        values = set(getattr(importlib.import_module(mod), const))
+        assert (family, label) in ENUMERATED_VALUES, \
+            f"{family}{{{label}}} pinned but not enumerated"
+        assert values == ENUMERATED_VALUES[(family, label)], (
+            f"{mod}.{const} drifted from the lint enum for "
+            f"{family}{{{label}}}")
+        assert family in declared and label in declared[family], (
+            f"ENUM_PINS pins {family}{{{label}}} but the registry "
+            f"declares no such family/label")
 
 
 def test_migration_series_registered_with_contracted_names():

@@ -40,8 +40,16 @@ def _drain_mixed(b, n_steps=2, chunk=4, budget=8, max_rounds=300):
 
 def _count_dispatches(b):
     """Wrap every device-dispatching batcher hook with a counter —
-    the dispatch-count assertion instrument."""
+    the dispatch-count assertion instrument.  The wrap list derives
+    FROM the static auditor's contract
+    (tpushare.analysis.dispatch_audit.ENTRY_CONTRACT), so the runtime
+    count and the static audit prove the SAME invariant and cannot
+    drift apart silently — a contract edit that disagrees with the
+    serving code fails here at runtime, and vice versa."""
+    from tpushare.analysis import dispatch_audit
+
     counts = {"mixed": 0, "other": 0}
+    steady = dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"]
 
     def wrap(name, key):
         real = getattr(b, name)
@@ -52,11 +60,11 @@ def _count_dispatches(b):
 
         setattr(b, name, counted)
 
-    wrap("_step_mixed", "mixed")
-    wrap("_step", "other")
-    wrap("_step_n", "other")
-    wrap("_prefill_chunk_into", "other")
-    wrap("_prefill_into", "other")
+    wrap(steady, "mixed")
+    for hook in (dispatch_audit.TICK_HOOKS
+                 + dispatch_audit.PREFILL_HOOKS):
+        if hook != steady:
+            wrap(hook, "other")
     return counts
 
 

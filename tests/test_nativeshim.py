@@ -222,6 +222,32 @@ def test_shim_asan_clean(tmp_path):
     assert "asan-ok" in out.stdout
 
 
+@pytest.mark.skipif(
+    os.environ.get("TPUSHARE_RUN_TSAN") != "1",
+    reason="opt-in sanitizer lane: set TPUSHARE_RUN_TSAN=1 "
+           "(needs gcc with libtsan)")
+def test_shim_tsan_clean(tmp_path):
+    """ThreadSanitizer build mode (`make -C native tsan`, the round-18
+    mirror of the ASan lane): the shim plus a threaded self-check main
+    as one TSan executable.  The driver encodes the shim's thread
+    contract — discovery/poll serialized by the caller (a pthread
+    mutex standing in for the daemon's single poll loop + the GIL),
+    ``version()`` read lock-free from four threads — so a data race in
+    the shim OR an erosion of the contract aborts with a TSan report.
+    A clean run prints tsan-ok."""
+    subprocess.run(["make", "-C", os.path.join(REPO, "native"), "tsan"],
+                   check=True, capture_output=True)
+    for i in range(3):
+        (tmp_path / f"accel{i}").touch()
+    out = subprocess.run(
+        [os.path.join(REPO, "native", "tpushim_tsan_check")],
+        env=_cpu_env(TPUSHIM_DEV_GLOB=str(tmp_path / "accel*"),
+                     TPUSHIM_ACCELERATOR_TYPE="v5e-4"),
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    assert "tsan-ok" in out.stdout
+
+
 def test_libtpu_backend_translates_native_events():
     """LibtpuBackend.poll_health maps the shim's JSON transitions onto
     HealthEvents (chip -1 = unattributable passes through)."""

@@ -202,7 +202,13 @@ def test_int8_rolling_and_ring_spec_match_nonspec(wmodel):
 # the single-dispatch invariant with speculation fused in
 # ---------------------------------------------------------------------------
 def _count_dispatches(b):
+    # wrap list derived from the static auditor's contract — the
+    # runtime count and the static audit prove the SAME invariant
+    # (see tests/test_mixed_step.py's twin)
+    from tpushare.analysis import dispatch_audit
+
     counts = {"mixed_spec": 0, "other": 0}
+    steady = dispatch_audit.ENTRY_CONTRACT["tick_mixed_spec"]["steady"]
 
     def wrap(name, key):
         real = getattr(b, name)
@@ -213,13 +219,11 @@ def _count_dispatches(b):
 
         setattr(b, name, counted)
 
-    wrap("_step_mixed_spec", "mixed_spec")
-    wrap("_step_mixed", "other")
-    wrap("_step_spec", "other")
-    wrap("_step", "other")
-    wrap("_step_n", "other")
-    wrap("_prefill_chunk_into", "other")
-    wrap("_prefill_into", "other")
+    wrap(steady, "mixed_spec")
+    for hook in (dispatch_audit.TICK_HOOKS
+                 + dispatch_audit.PREFILL_HOOKS):
+        if hook != steady:
+            wrap(hook, "other")
     return counts
 
 

@@ -451,3 +451,41 @@ def test_jit_retrace_counter_sees_new_program():
         b.tick_fused(odd_steps)
     continuous._observe_retraces()
     assert metrics.JIT_RETRACES.value() > base
+
+
+def test_late_registered_jit_entries_first_compiles_never_count():
+    """A program registered AFTER the baseline (the paged module
+    imported into a process already serving dense traffic) is
+    baselined at its own first observation — its expected first
+    compiles must not inflate the retrace counter; growth past that
+    observation still counts (round-18 register_jit_entries
+    regression)."""
+    from tpushare.serving import continuous, metrics
+
+    class FakeJit:
+        def __init__(self):
+            self.n = 0
+
+        def _cache_size(self):
+            return self.n
+
+    saved_entries = list(continuous._JIT_ENTRIES)
+    saved_baseline = continuous._TRACE_BASELINE
+    try:
+        early = FakeJit()
+        continuous._JIT_ENTRIES[:] = [early]
+        continuous._TRACE_BASELINE = None
+        continuous._observe_retraces()          # baseline: {early: 0}
+        base = metrics.JIT_RETRACES.value()
+        late = FakeJit()
+        continuous.register_jit_entries(late)
+        late.n = 2                              # its first compiles
+        continuous._observe_retraces()
+        assert metrics.JIT_RETRACES.value() == base, \
+            "late-registered first compiles counted as retraces"
+        late.n = 3                              # a REAL retrace
+        continuous._observe_retraces()
+        assert metrics.JIT_RETRACES.value() == base + 1
+    finally:
+        continuous._JIT_ENTRIES[:] = saved_entries
+        continuous._TRACE_BASELINE = saved_baseline

@@ -23,10 +23,28 @@ findings — wired as ``make lint`` and run in tier-1):
   false-positive class and lets rules see scope (the one sanctioned
   ``_paged_gather`` body, keyword arguments, assignment targets).
 
+* :mod:`tpushare.analysis.confinement` — Layer 3 (round 18): the
+  serving plane's thread model as a checked contract.  The loop thread
+  owns the batcher and all declared loop-confined state
+  (``_THREAD_MANIFEST`` in serving/continuous.py); untrusted roots
+  (HTTP handlers) cross only through the lock-guarded command queues;
+  telemetry internals mutate only under their own lock
+  (``_LOCK_GUARDED`` manifests).  Verified before anything runs, the
+  gpu_ext verify-then-load model applied to concurrency.
+
+* :mod:`tpushare.analysis.dispatch_audit` — Layer 4 (round 18): the
+  one-dispatch-per-round economics (rounds 7/14/17) proven statically.
+  Walks the serving call graph from every tick entry per storage
+  flavor, counts device-dispatch sites, checks guard/fetch discipline,
+  and pins every jitted serving program to the retrace watch list —
+  cross-checked against the live classes the way mosaic cross-checks
+  the dispatch gate (drift raises).
+
 ``python -m tpushare.analysis --catalog`` renders docs/LINTS.md (the
-rule catalog; sync-tested like docs/METRICS.md).
+rule catalog; sync-tested like docs/METRICS.md); ``--json`` emits
+machine-readable findings.
 """
 
-from . import mosaic, tpulint  # noqa: F401
+from . import confinement, dispatch_audit, mosaic, tpulint  # noqa: F401
 
-__all__ = ["mosaic", "tpulint"]
+__all__ = ["confinement", "dispatch_audit", "mosaic", "tpulint"]

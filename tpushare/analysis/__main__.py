@@ -1,40 +1,50 @@
-"""``python -m tpushare.analysis`` — run both analysis layers, exit
+"""``python -m tpushare.analysis`` — run every analysis layer, exit
 non-zero on findings (wired as ``make lint``; tier-1 runs it via
 tests/test_analysis.py in a clean subprocess).
 
-Layer 2 (tpulint) needs only the stdlib; Layer 1's gate cross-check
-imports jax (ops.attention), so run the CLI with the tunnel scrubbed
-(``env -u PALLAS_AXON_POOL_IPS``, as the Makefile target does) — the
-gate itself never initializes a backend, but a sitecustomize hook dials
-on ANY jax import when the variable is set.
+Layers 2-4 (tpulint, confinement, dispatch audit) need only the
+stdlib; Layer 1's gate cross-check and Layer 4's registry pin import
+jax (ops.attention / the serving modules), so run the CLI with the
+tunnel scrubbed (``env -u PALLAS_AXON_POOL_IPS``, as the Makefile
+target does) — nothing here initializes a backend, but a sitecustomize
+hook dials on ANY jax import when the variable is set.
 
+``--json`` emits machine-readable findings (rule id, file:line,
+message) for CI and editors; ``make lint`` stays exit-code based.
 ``--catalog`` renders docs/LINTS.md (stdlib-only, no jax) and exits 0.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
-from . import mosaic, tpulint
+from . import confinement, dispatch_audit, mosaic, tpulint
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpushare.analysis",
         description="tpushare static analysis: Mosaic layout precheck "
-                    "+ AST invariant lints")
+                    "+ AST invariant lints + thread-confinement check "
+                    "+ dispatch audit")
     ap.add_argument("paths", nargs="*",
                     help="repo-relative files to lint (default: the "
-                         "whole repo tree + the Mosaic drift sweep)")
+                         "whole repo tree + the confinement/dispatch "
+                         "layers + the Mosaic drift sweep)")
     ap.add_argument("--catalog", action="store_true",
                     help="print the docs/LINTS.md rule catalog and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array "
+                         "[{rule, path, line, message}] on stdout")
     ap.add_argument("--root", default=None,
                     help="checkout root (default: derived from the "
                          "package location)")
     ap.add_argument("--no-mosaic", action="store_true",
-                    help="skip the Mosaic gate-agreement sweep (it "
-                         "imports jax for the live cross-check)")
+                    help="skip the jax-importing live cross-checks "
+                         "(the Mosaic gate-agreement sweep and the "
+                         "dispatch auditor's retrace-registry pin)")
     args = ap.parse_args(argv)
 
     if args.catalog:
@@ -42,21 +52,35 @@ def main(argv=None) -> int:
         return 0
 
     root = args.root or tpulint.repo_root()
+    findings: list = []
     if args.paths:
-        findings = [str(f) for f in tpulint.lint_paths(args.paths,
-                                                       root=root)]
+        findings.extend(tpulint.lint_paths(args.paths, root=root))
         n_files = len(args.paths)
     else:
         files = tpulint.repo_python_files(root)
-        findings = [str(f) for f in tpulint.lint_paths(files, root=root)]
+        findings.extend(tpulint.lint_paths(files, root=root))
         n_files = len(files)
+        findings.extend(confinement.check_tree(root))
+        findings.extend(dispatch_audit.audit_tree(root))
         if not args.no_mosaic:
             findings.extend(mosaic.sweep_findings(cross_check=True))
+            dispatch_audit.cross_check_live()   # DispatchDriftError raises
 
-    for f in findings:
-        print(f)
+    def as_dict(f):
+        if isinstance(f, tpulint.Finding):
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "message": f.message}
+        return {"rule": "mosaic-sweep", "path": "", "line": 0,
+                "message": str(f)}
+
+    if args.as_json:
+        print(json.dumps([as_dict(f) for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
     print(f"tpushare.analysis: {n_files} files, {len(tpulint.RULES)} "
-          f"rules, {len(findings)} finding(s)", file=sys.stderr)
+          f"rules + confinement + dispatch audit, {len(findings)} "
+          f"finding(s)", file=sys.stderr)
     return 1 if findings else 0
 
 

@@ -1,0 +1,435 @@
+"""Layer 3: thread-confinement checker for the serving plane.
+
+The serving plane's concurrency model is simple to state and easy to
+erode: ONE loop thread owns the batcher and every piece of per-request
+delivery state; HTTP-handler threads (llm/daemon/router routes) are
+untrusted roots that may only cross into loop state through the
+lock-guarded command queues (``_waiting``, the migration command queue,
+``_cancels``) the loop drains.  Rounds 15-17 grew that surface —
+router eviction drains, migration commands, spill restores — while the
+discipline lived only in comments ("loop-thread private").  This module
+verifies it statically, gpu_ext-style: the policy is DECLARED in the
+code (:data:`MANIFEST_NAME` in serving/continuous.py,
+:data:`LOCK_GUARDED_NAME` in the telemetry modules) and checked before
+anything runs.
+
+Four checks:
+
+* **loop-confined mutations** — every MUTATION site of a manifest-
+  declared loop-confined attribute (assignment, ``del``, a mutating
+  method call like ``.pop()``/``.clear()``, including through a local
+  alias ``b = self._batcher``) must sit in a method reachable only from
+  the loop roots, the construction phase, or a declared join-
+  synchronized method.  Reads stay legal everywhere: they are the
+  documented point-in-time snapshots (``snapshot()``).
+* **queue crossings** — every touch of a ``lock_crossed`` attribute
+  (the command queues, reads included: list-swap drains read under the
+  same lock) must sit lexically inside ``with self._lock:``.
+* **batcher ownership** — a direct method CALL on the batcher attribute
+  outside the loop closure must name a declared read-only method
+  (validation, capability, economics); everything else (ticks,
+  admission, session export) is loop-only.
+* **lock discipline** (telemetry) — mutations of attributes declared in
+  a module's ``_LOCK_GUARDED`` manifest must sit inside
+  ``with self._lock:``; methods whose name ends in ``_locked`` are the
+  callers-hold-the-lock convention and are exempt, as is ``__init__``.
+  This extends the round-13 ``telemetry-lock`` tpulint rule (which
+  patrols the OUTSIDE of the telemetry package) to the inside.
+
+A fifth, repo-wide check — **service internals** — patrols everything
+under tpushare/ EXCEPT serving/continuous.py for attribute access to
+the confined names (``._batcher``, ``._sinks``, ``._waiting``, ...):
+an HTTP handler reaching through the service's privates bypasses the
+whole model (the round-16 llm.py ``self._service._batcher.*`` sites
+were exactly this; they now go through public accessors).
+
+Stdlib-only; everything here parses source, nothing imports jax.
+Fixture entry points (:func:`check_source`, :func:`check_reach`) take
+raw source under a virtual path, mirroring ``tpulint.lint_source``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .tpulint import Finding, repo_root
+
+#: the serving thread-model manifest (serving/continuous.py)
+MANIFEST_NAME = "_THREAD_MANIFEST"
+#: the per-module telemetry lock manifest ({class: (attrs...)})
+LOCK_GUARDED_NAME = "_LOCK_GUARDED"
+
+#: method names that mutate their receiver (the container/state surface
+#: the serving plane actually uses; a new mutator spelling joins here)
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "put", "take",
+})
+
+#: the serving module that declares the thread manifest
+SERVICE_MODULE = "tpushare/serving/continuous.py"
+#: sub-tree the lock-discipline manifests live in
+TELEMETRY_DIR = "tpushare/telemetry/"
+
+
+def _load_manifest(tree: ast.Module, name: str):
+    """The module-level ``NAME = <literal>`` assignment, evaluated —
+    None when absent; a non-literal value is a loud error (the manifest
+    must stay a reviewable constant)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return ast.literal_eval(node.value)
+    return None
+
+
+def _self_root(expr: ast.AST) -> Optional[str]:
+    """First attribute after ``self`` in an attribute/subscript chain
+    (``self._sinks[rid]`` -> ``_sinks``), or None."""
+    node = expr
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        parent = node.value
+        if isinstance(node, ast.Attribute) and \
+                isinstance(parent, ast.Name) and parent.id == "self":
+            return node.attr
+        node = parent
+    return None
+
+
+def _flat_targets(targets: Iterable[ast.AST]):
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            yield from _flat_targets(t.elts)
+        elif isinstance(t, ast.Starred):
+            yield from _flat_targets([t.value])
+        else:
+            yield t
+
+
+class _MethodScan:
+    """Per-method facts: self-attribute mutation sites, lock-crossed
+    uses with their lock context, self-method call edges, and
+    batcher-alias call sites."""
+
+    def __init__(self, fn: ast.AST, batcher_attr: Optional[str] = None):
+        self.fn = fn
+        #: [(attr, lineno, in_lock)] — writes/mutations rooted at
+        #: ``self.<attr>`` (aliases of the batcher attr included under
+        #: the batcher attr's name)
+        self.mutations: List[Tuple[str, int, bool]] = []
+        #: [(attr, lineno, in_lock)] — EVERY self.<attr> use
+        self.uses: List[Tuple[str, int, bool]] = []
+        #: self-method call edges (callee names)
+        self.calls: Set[str] = set()
+        #: [(method, lineno)] — depth-1 calls on the batcher attr (or
+        #: a local alias of it)
+        self.batcher_calls: List[Tuple[str, int]] = []
+        self._aliases: Set[str] = set()
+        self._batcher_attr = batcher_attr
+        body = fn.body if isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else [fn]
+        for stmt in body:
+            self._visit(stmt, in_lock=False)
+
+    # -- visitors ------------------------------------------------------
+    def _is_lock_with(self, node: ast.With) -> bool:
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Attribute) and ctx.attr == "_lock" \
+                    and isinstance(ctx.value, ast.Name) \
+                    and ctx.value.id == "self":
+                return True
+        return False
+
+    def _visit(self, node: ast.AST, in_lock: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = in_lock or self._is_lock_with(node)
+            for item in node.items:
+                self._visit(item.context_expr, in_lock)
+            for child in node.body:
+                self._visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def/lambda runs LATER, on whatever thread calls
+            # it — its body never inherits the enclosing lock
+            body = node.body if not isinstance(node, ast.Lambda) \
+                else [node.body]
+            for child in body:
+                self._visit(child, in_lock=False)
+            return
+        self._classify(node, in_lock)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, in_lock)
+
+    def _classify(self, node: ast.AST, in_lock: bool) -> None:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            self.uses.append((node.attr, node.lineno, in_lock))
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in _flat_targets(targets):
+                root = _self_root(t)
+                if root is not None:
+                    self.mutations.append((root, t.lineno, in_lock))
+            # batcher aliasing: ``b = self._batcher``
+            if isinstance(node, ast.Assign) and self._batcher_attr:
+                val = node.value
+                if isinstance(val, ast.Attribute) and \
+                        val.attr == self._batcher_attr and \
+                        isinstance(val.value, ast.Name) and \
+                        val.value.id == "self":
+                    for t in _flat_targets(node.targets):
+                        if isinstance(t, ast.Name):
+                            self._aliases.add(t.id)
+        elif isinstance(node, ast.Delete):
+            for t in _flat_targets(node.targets):
+                root = _self_root(t)
+                if root is not None:
+                    self.mutations.append((root, t.lineno, in_lock))
+        elif isinstance(node, ast.Call):
+            fnode = node.func
+            if isinstance(fnode, ast.Attribute):
+                base = fnode.value
+                # self.m(...) -> call-graph edge
+                if isinstance(base, ast.Name) and base.id == "self":
+                    self.calls.add(fnode.attr)
+                # depth-1 batcher call: self._batcher.m(...) / alias.m(...)
+                is_batcher = (
+                    (isinstance(base, ast.Attribute)
+                     and base.attr == self._batcher_attr
+                     and isinstance(base.value, ast.Name)
+                     and base.value.id == "self")
+                    or (isinstance(base, ast.Name)
+                        and base.id in self._aliases))
+                if self._batcher_attr and is_batcher:
+                    self.batcher_calls.append((fnode.attr, node.lineno))
+                # mutating call rooted at self.<attr>
+                if fnode.attr in MUTATOR_METHODS:
+                    root = _self_root(base)
+                    if root is not None:
+                        self.mutations.append(
+                            (root, node.lineno, in_lock))
+
+
+def _class_methods(tree: ast.Module, class_name: str):
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {m.name: m for m in node.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return None
+
+
+def _closure(roots: Iterable[str], edges: Dict[str, Set[str]],
+             members: Iterable[str]) -> Set[str]:
+    members = set(members)
+    seen: Set[str] = set()
+    todo = [r for r in roots if r in members]
+    while todo:
+        m = todo.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        todo.extend(c for c in edges.get(m, ()) if c in members)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# Check: the serving thread manifest
+# ---------------------------------------------------------------------------
+def check_service(relpath: str, source: str) -> List[Finding]:
+    """Verify a module's :data:`MANIFEST_NAME` contract (no manifest =
+    no findings; fixtures declare their own)."""
+    out: List[Finding] = []
+    tree = ast.parse(source, filename=relpath)
+    manifest = _load_manifest(tree, MANIFEST_NAME)
+    if manifest is None:
+        return out
+    cls = manifest["class"]
+    methods = _class_methods(tree, cls)
+    if methods is None:
+        return [Finding("manifest-sync", relpath, 1,
+                        f"{MANIFEST_NAME} names class {cls!r} which this "
+                        f"module does not define")]
+    batcher_attr = manifest.get("batcher_attr")
+    readonly = set(manifest.get("batcher_readonly", ()))
+    confined = set(manifest["loop_confined"])
+    crossed = set(manifest["lock_crossed"])
+    loop_roots = tuple(manifest["loop_roots"])
+    construction = set(manifest["construction"])
+    join_synced = set(manifest["join_synced"])
+
+    # manifest freshness: named methods exist, named attrs are
+    # initialized in __init__ (a rename must update the manifest)
+    for group, names in (("loop_roots", loop_roots),
+                         ("construction", construction),
+                         ("join_synced", join_synced)):
+        for name in names:
+            if name not in methods:
+                out.append(Finding(
+                    "manifest-sync", relpath, 1,
+                    f"{MANIFEST_NAME}.{group} names method {name!r} "
+                    f"which {cls} does not define"))
+    scans = {name: _MethodScan(fn, batcher_attr=batcher_attr)
+             for name, fn in methods.items()}
+    init_writes = {a for a, _, _ in scans["__init__"].mutations} \
+        if "__init__" in scans else set()
+    for attr in sorted((confined | crossed) - init_writes):
+        out.append(Finding(
+            "manifest-sync", relpath, 1,
+            f"{MANIFEST_NAME} declares attribute {attr!r} which "
+            f"{cls}.__init__ never initializes (stale manifest?)"))
+
+    edges = {name: s.calls for name, s in scans.items()}
+    loop_closure = _closure(loop_roots, edges, methods)
+    public_roots = [m for m in methods
+                    if not m.startswith("_")
+                    and m not in construction and m not in join_synced
+                    and m not in loop_roots]
+    untrusted = _closure(public_roots, edges, methods)
+
+    for name, scan in scans.items():
+        exempt = name in construction or name in join_synced
+        off_loop = name in untrusted and not exempt
+        for attr, line, _ in scan.mutations:
+            if attr in confined and off_loop:
+                out.append(Finding(
+                    "loop-confined", relpath, line,
+                    f"{cls}.{name} mutates loop-confined attribute "
+                    f"{attr!r} but is reachable from a non-loop thread "
+                    f"— cross through the command queues "
+                    f"({', '.join(sorted(crossed))}) instead"))
+        for attr, line, in_lock in scan.uses:
+            if attr in crossed and not in_lock and name != "__init__":
+                out.append(Finding(
+                    "queue-crossing", relpath, line,
+                    f"{cls}.{name} touches lock-crossed queue {attr!r} "
+                    f"outside `with self._lock:` — every producer and "
+                    f"the loop's drain must hold the lock"))
+        for m, line in scan.batcher_calls:
+            if m not in readonly and name not in loop_closure \
+                    and not (name in construction or name in join_synced):
+                out.append(Finding(
+                    "batcher-ownership", relpath, line,
+                    f"{cls}.{name} calls batcher method {m!r} off the "
+                    f"loop thread — only {sorted(readonly)} are safe "
+                    f"from other threads; mutating calls belong to the "
+                    f"loop"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Check: telemetry lock discipline
+# ---------------------------------------------------------------------------
+def check_lock_discipline(relpath: str, source: str) -> List[Finding]:
+    out: List[Finding] = []
+    tree = ast.parse(source, filename=relpath)
+    manifest = _load_manifest(tree, LOCK_GUARDED_NAME)
+    if manifest is None:
+        return out
+    for cls, attrs in manifest.items():
+        methods = _class_methods(tree, cls)
+        if methods is None:
+            out.append(Finding(
+                "manifest-sync", relpath, 1,
+                f"{LOCK_GUARDED_NAME} names class {cls!r} which this "
+                f"module does not define"))
+            continue
+        guarded = set(attrs)
+        for name, fn in methods.items():
+            if name == "__init__" or name.endswith("_locked"):
+                continue        # construction / callers-hold-the-lock
+            scan = _MethodScan(fn)
+            for attr, line, in_lock in scan.mutations:
+                if attr in guarded and not in_lock:
+                    out.append(Finding(
+                        "lock-discipline", relpath, line,
+                        f"{cls}.{name} mutates lock-guarded attribute "
+                        f"{attr!r} outside `with self._lock:`"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Check: service internals stay inside continuous.py
+# ---------------------------------------------------------------------------
+def check_reach(relpath: str, source: str,
+                protected: Set[str]) -> List[Finding]:
+    """Flag attribute access to the service's confined names anywhere
+    outside the service module — handlers must use the public API
+    (``can_migrate()``/``storage_info()``/``mesh``/``snapshot()``)."""
+    out: List[Finding] = []
+    tree = ast.parse(source, filename=relpath)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in protected:
+            out.append(Finding(
+                "service-internals", relpath, node.lineno,
+                f"access to serving-loop internal {node.attr!r} outside "
+                f"{SERVICE_MODULE} — HTTP handlers and peers must use "
+                f"the ContinuousService public API"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def check_source(relpath: str, source: str) -> List[Finding]:
+    """Run the manifest-driven checks one module declares (the fixture
+    entry: a module carrying a thread manifest gets the service checks,
+    one carrying a lock manifest gets lock discipline)."""
+    relpath = relpath.replace(os.sep, "/")
+    try:
+        return (check_service(relpath, source)
+                + check_lock_discipline(relpath, source))
+    except SyntaxError as e:
+        return [Finding("parse", relpath, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+
+
+def protected_names(root: Optional[str] = None) -> Set[str]:
+    """The reach-rule name set, derived from the live manifest."""
+    root = root or repo_root()
+    with open(os.path.join(root, SERVICE_MODULE), encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    manifest = _load_manifest(tree, MANIFEST_NAME) or {}
+    names = set(manifest.get("loop_confined", ()))
+    names |= set(manifest.get("lock_crossed", ()))
+    if manifest.get("batcher_attr"):
+        names.add(manifest["batcher_attr"])
+    return names
+
+
+def check_tree(root: Optional[str] = None) -> List[Finding]:
+    """The repo run ``python -m tpushare.analysis`` wires in: manifest
+    checks on the serving module, lock discipline across telemetry, and
+    the reach rule across tpushare/ (tests excluded: white-box tests
+    legitimately reach into internals)."""
+    root = root or repo_root()
+    out: List[Finding] = []
+
+    def read(rel):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return f.read()
+
+    out.extend(check_source(SERVICE_MODULE, read(SERVICE_MODULE)))
+    protected = protected_names(root)
+    for dirpath, dirnames, files in os.walk(os.path.join(root,
+                                                         "tpushare")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn),
+                                  root).replace(os.sep, "/")
+            if rel == SERVICE_MODULE:
+                continue
+            src = read(rel)
+            out.extend(check_reach(rel, src, protected))
+            if rel.startswith(TELEMETRY_DIR):
+                out.extend(check_lock_discipline(rel, src))
+    return out

@@ -1,0 +1,538 @@
+"""Layer 4: symbolic auditor of the serving plane's dispatch structure.
+
+The one-dispatch-per-round economics (rounds 7/14/17) is the serving
+plane's load-bearing performance invariant: a mixed/spec/sp round costs
+ONE jitted device program plus its lazy host fetches — every extra
+dispatch is a ~70 ms tunnel RPC on real hardware.  The runtime
+dispatch-count tests (tests/test_mixed_step.py,
+tests/test_spec_storage.py) prove it for the configurations they run;
+this module proves it STATICALLY, for every path, by walking the
+serving call graph from each tick entry and counting device-dispatch
+sites — the mosaic pattern applied to dispatch structure instead of
+block layouts.
+
+The audited contract (:data:`ENTRY_CONTRACT`, mirrored here the way
+mosaic mirrors ``PAGED_KERNEL_MAX_ROWS``; the runtime tests build their
+wrap lists FROM it, and :func:`cross_check_live` raises
+:class:`DispatchDriftError` when the live classes drift):
+
+* **dispatch-count** — from each tick entry (``tick`` /``tick_fused``/
+  ``tick_mixed``/``tick_spec``/``tick_mixed_spec``), the steady-state
+  path reaches EXACTLY ONE storage-hook call — the entry's declared
+  hook — per storage flavor (dense = continuous.py, paged = paged.py
+  overlays).  Sanctioned extra dispatches (max_seq-boundary stragglers,
+  the sequential reference fallback) live only in the contract's
+  ``sanctioned`` helpers; lambdas are deferred thunks attributed to the
+  helper they are passed to.
+* **hook-body** — each tick hook dispatches exactly one jitted program
+  and never host-fetches (hooks return device values; the entry's
+  guard owns the fetch).
+* **dispatch-guard** — every hook call site outside a hook rides a
+  ``MONITOR.dispatch_guard`` with-block (the stall watchdog would
+  otherwise miss the dispatch; hook-to-hook delegation inherits the
+  caller's guard).
+* **dispatch-fetch** — in entry bodies, ``np.asarray`` fetches of the
+  hook's results stay INSIDE the guard with-block: the fetch is the
+  true barrier (CLAUDE.md), so a fetch outside the guard is a hang the
+  watchdog cannot attribute.
+* **jit-registry** — every ``@jax.jit`` definition in the serving
+  modules is covered by the retrace watch list
+  (``continuous._JIT_ENTRIES`` / ``register_jit_entries`` in paged.py):
+  an unwatched program's cache growth would be invisible to
+  ``tpushare_jit_retraces_total``.
+
+Stdlib-only; :func:`audit_pair` takes raw source (the fixture entry),
+:func:`audit_tree` reads the two serving modules, and
+:func:`cross_check_live` imports them (jax-heavy, mosaic-style) to pin
+the contract to the live classes.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .tpulint import Finding, repo_root
+
+#: per-entry dispatch contract: the ONE steady-state storage hook, and
+#: the helpers sanctioned to dispatch extra (boundary stragglers, the
+#: sequential reference composition).  The runtime dispatch-count tests
+#: derive their counter wrap lists from this table, so editing it
+#: without editing the serving code fails them — and vice versa.
+ENTRY_CONTRACT = {
+    "tick": {"steady": "_step", "sanctioned": ()},
+    "tick_fused": {"steady": "_step_n", "sanctioned": ()},
+    "tick_mixed": {"steady": "_step_mixed",
+                   "sanctioned": ("_mixed_fallback",
+                                  "_finish_mixed_round")},
+    "tick_spec": {"steady": "_step_spec", "sanctioned": ()},
+    "tick_mixed_spec": {"steady": "_step_mixed_spec",
+                        "sanctioned": ("_mixed_fallback",
+                                       "_finish_mixed_round")},
+}
+
+#: the tick storage hooks — one jitted program each, no fetches
+TICK_HOOKS = ("_step", "_step_n", "_step_mixed", "_step_spec",
+              "_step_mixed_spec")
+#: admission dispatch hooks (guarded by their callers; the paged
+#: whole-prompt hook may legally chunk-loop — prefix cache, page ring)
+PREFILL_HOOKS = ("_prefill_into", "_prefill_chunk_into")
+#: jitted operand-prep helpers that are NOT device-program dispatches
+#: for counting purposes (host key wrapping rides the next dispatch)
+AUX_JIT = ("_wrap_keys",)
+
+#: the serving modules the tree audit reads, by flavor
+DENSE_MODULE = "tpushare/serving/continuous.py"
+PAGED_MODULE = "tpushare/serving/paged.py"
+
+
+class DispatchDriftError(AssertionError):
+    """The audited contract and the live serving classes disagree."""
+
+
+def _is_jax_jit(expr: ast.AST) -> bool:
+    """True when ``expr`` mentions ``jax.jit`` (plain decorator, or a
+    ``functools.partial(jax.jit, ...)`` wrapper, or the call form)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "jit" and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "jax":
+            return True
+    return False
+
+
+class ModuleFacts:
+    """Per-module parse results: jitted definitions, module functions,
+    classes with their method tables, and the declared jit registry."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.tree = ast.parse(source, filename=relpath)
+        self.jitted: Set[str] = set()
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.classes: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.registry: Optional[Set[str]] = None    # _JIT_ENTRIES names
+        self.registered: Set[str] = set()           # register_jit_entries args
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+                if any(_is_jax_jit(d) for d in node.decorator_list):
+                    self.jitted.add(node.name)
+            elif isinstance(node, ast.Assign):
+                if _is_jax_jit(node.value) and \
+                        isinstance(node.value, ast.Call):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.jitted.add(t.id)
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id == "_JIT_ENTRIES" and \
+                            isinstance(node.value, (ast.List, ast.Tuple)):
+                        self.registry = {
+                            e.id for e in node.value.elts
+                            if isinstance(e, ast.Name)}
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = {
+                    m.name: m for m in node.body
+                    if isinstance(m, ast.FunctionDef)}
+            elif isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                fn = node.value.func
+                name = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if name == "register_jit_entries":
+                    self.registered |= {
+                        a.id for a in node.value.args
+                        if isinstance(a, ast.Name)}
+
+    def batcher_class(self) -> Optional[str]:
+        """The class defining tick entries and/or storage hooks."""
+        best, score = None, 0
+        for name, methods in self.classes.items():
+            s = sum(1 for m in methods
+                    if m in ENTRY_CONTRACT or m in TICK_HOOKS)
+            if s > score:
+                best, score = name, s
+        return best
+
+
+class _GuardWalk:
+    """Per-method lexical facts: call sites with their guard context,
+    and fetch (``np.asarray``) call sites — lambdas are skipped (a
+    thunk dispatches on behalf of whoever invokes it)."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        #: [(callee, lineno, in_guard)] for self.X(...) calls
+        self.self_calls: List[Tuple[str, int, bool]] = []
+        #: [(callee, lineno, in_guard)] for bare-name f(...) calls
+        self.fn_calls: List[Tuple[str, int, bool]] = []
+        #: [(lineno, in_guard, names, kind)] — host-fetch sites:
+        #: ``np.asarray``/``jax.device_get`` ("array"), ``x.item()``
+        #: ("array", names include the receiver), and bare
+        #: ``float(...)``/``int(...)`` casts ("cast" — weaker signal:
+        #: only the entry-body hook-result rule consumes those, a cast
+        #: of plain host math must not trip the hook-body rule)
+        self.fetches: List[Tuple[int, bool, Set[str], str]] = []
+        #: names bound by assignments whose value contains a given call
+        self.fn_node = fn
+        for stmt in fn.body:
+            self._visit(stmt, in_guard=False)
+
+    @staticmethod
+    def _is_guard_with(node: ast.With) -> bool:
+        for item in node.items:
+            for sub in ast.walk(item.context_expr):
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr == "dispatch_guard":
+                    return True
+        return False
+
+    def _visit(self, node: ast.AST, in_guard: bool) -> None:
+        if isinstance(node, ast.With):
+            inner = in_guard or self._is_guard_with(node)
+            for child in node.body:
+                self._visit(child, inner)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return                      # deferred — not this path
+        if isinstance(node, ast.Call):
+            fn = node.func
+
+            def arg_names(extra=()):
+                return {n.id for a in list(node.args) + list(extra)
+                        for n in ast.walk(a)
+                        if isinstance(n, ast.Name)}
+
+            if isinstance(fn, ast.Attribute):
+                if isinstance(fn.value, ast.Name) and \
+                        fn.value.id == "self":
+                    self.self_calls.append((fn.attr, node.lineno,
+                                            in_guard))
+                if fn.attr in ("asarray", "device_get") and \
+                        isinstance(fn.value, ast.Name) and \
+                        fn.value.id in ("np", "jax"):
+                    self.fetches.append((node.lineno, in_guard,
+                                         arg_names(), "array"))
+                elif fn.attr == "item" and not node.args:
+                    # the CLAUDE.md scalar-fetch barrier spelling:
+                    # x.item() — the receiver carries the names
+                    self.fetches.append((node.lineno, in_guard,
+                                         arg_names([fn.value]),
+                                         "array"))
+            elif isinstance(fn, ast.Name):
+                self.fn_calls.append((fn.id, node.lineno, in_guard))
+                if fn.id in ("float", "int") and node.args:
+                    self.fetches.append((node.lineno, in_guard,
+                                         arg_names(), "cast"))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, in_guard)
+
+
+def _hook_result_names(entry_fn: ast.FunctionDef, hook: str) -> Set[str]:
+    """Names bound to DEVICE values from the steady hook's call in the
+    entry body (``toks, keys = self._step_n(...)`` -> {toks, keys}).
+    A binding that fetches at the call site
+    (``nxt = np.asarray(self._step(...))``) binds a HOST value — the
+    name is excluded; the guard discipline of that spelling is carried
+    by the dispatch-guard rule on the hook call itself."""
+    out: Set[str] = set()
+
+    def is_fetch_call(c: ast.AST) -> bool:
+        return (isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr in ("asarray", "device_get")
+                and isinstance(c.func.value, ast.Name)
+                and c.func.value.id in ("np", "jax"))
+
+    def contains_hook(tree: ast.AST) -> bool:
+        return any(
+            isinstance(c, ast.Call) and isinstance(c.func, ast.Attribute)
+            and c.func.attr == hook
+            and isinstance(c.func.value, ast.Name)
+            and c.func.value.id == "self"
+            for c in ast.walk(tree))
+
+    for node in ast.walk(entry_fn):
+        if not isinstance(node, ast.Assign) or \
+                not contains_hook(node.value):
+            continue
+        fetched_at_bind = any(
+            is_fetch_call(c) and contains_hook(c)
+            for c in ast.walk(node.value))
+        if fetched_at_bind:
+            continue
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+class _Flavor:
+    """One storage flavor's resolved method table: (method ast, owning
+    ModuleFacts) per name — the paged table overlays the dense one."""
+
+    def __init__(self, name: str, layers: List[Tuple[Dict, "ModuleFacts"]]):
+        self.name = name
+        self.table: Dict[str, Tuple[ast.FunctionDef, ModuleFacts]] = {}
+        for methods, facts in layers:           # base first, overlay last
+            for m, fn in methods.items():
+                self.table[m] = (fn, facts)
+
+
+def _audit_flavor(flavor: _Flavor) -> List[Finding]:
+    out: List[Finding] = []
+    scans: Dict[str, _GuardWalk] = {}
+
+    def scan(m: str) -> _GuardWalk:
+        if m not in scans:
+            scans[m] = _GuardWalk(flavor.table[m][0])
+        return scans[m]
+
+    def path_of(m: str) -> str:
+        return flavor.table[m][1].relpath
+
+    # -- hook bodies: one jitted program, no hooks, no fetches ---------
+    for hook in TICK_HOOKS:
+        if hook not in flavor.table:
+            continue
+        fn, facts = flavor.table[hook]
+        s = scan(hook)
+        jit_calls = [(n, ln) for n, ln, _ in s.fn_calls
+                     if n in facts.jitted and n not in AUX_JIT]
+        if len(jit_calls) != 1:
+            out.append(Finding(
+                "hook-body", path_of(hook), fn.lineno,
+                f"{flavor.name} hook {hook} dispatches "
+                f"{len(jit_calls)} jitted programs "
+                f"({[n for n, _ in jit_calls]}) — a tick hook is "
+                f"exactly ONE device program"))
+        for n, ln, _ in s.self_calls:
+            if n in TICK_HOOKS or n in PREFILL_HOOKS:
+                out.append(Finding(
+                    "hook-body", path_of(hook), ln,
+                    f"{flavor.name} hook {hook} calls hook {n} — "
+                    f"tick hooks dispatch one program themselves"))
+        for ln, _, _, kind in s.fetches:
+            if kind == "cast":
+                continue        # plain host math casts are not fetches
+            out.append(Finding(
+                "hook-body", path_of(hook), ln,
+                f"{flavor.name} hook {hook} host-fetches mid-round — "
+                f"hooks return device values; the entry's guarded "
+                f"drain owns the fetch"))
+
+    # -- guard discipline: hook call sites outside hooks ---------------
+    for method in flavor.table:
+        if method in TICK_HOOKS or method in PREFILL_HOOKS:
+            continue                    # hook-to-hook inherits the guard
+        s = scan(method)
+        for n, ln, guarded in s.self_calls:
+            if (n in TICK_HOOKS or n in PREFILL_HOOKS) and not guarded:
+                out.append(Finding(
+                    "dispatch-guard", path_of(method), ln,
+                    f"{flavor.name} {method} dispatches hook {n} "
+                    f"outside a MONITOR.dispatch_guard with-block — "
+                    f"the stall watchdog cannot see it"))
+
+    # -- steady-path dispatch count per entry --------------------------
+    for entry, contract in ENTRY_CONTRACT.items():
+        if entry not in flavor.table:
+            continue
+        sanctioned = set(contract["sanctioned"])
+        hook_hits: List[Tuple[str, str, int]] = []   # (hook, method, line)
+        seen: Set[str] = set()
+
+        def walk_helper(facts: ModuleFacts, name: str,
+                        via: str) -> None:
+            """Recurse through module-level helper FUNCTIONS too — a
+            jitted dispatch hiding two wrappers deep is the same
+            evasion as one wrapper deep."""
+            key = f"::{id(facts)}::{name}"
+            if key in seen:
+                return
+            seen.add(key)
+            w = _GuardWalk(facts.functions[name])
+            for nn, lln, _ in w.fn_calls:
+                if nn in AUX_JIT:
+                    continue
+                if nn in facts.jitted:
+                    out.append(Finding(
+                        "dispatch-count", facts.relpath, lln,
+                        f"{flavor.name} {entry}: helper {name} "
+                        f"(reached from {via}) dispatches jitted "
+                        f"program {nn} on the steady path"))
+                elif nn in facts.functions:
+                    walk_helper(facts, nn, f"{via} -> {name}")
+
+        def walk(method: str) -> None:
+            if method in seen or method in sanctioned:
+                return
+            seen.add(method)
+            fn, facts = flavor.table[method]
+            s = scan(method)
+            for n, ln, _ in s.self_calls:
+                if n in TICK_HOOKS or n in PREFILL_HOOKS:
+                    hook_hits.append((n, method, ln))
+                elif n in flavor.table:
+                    walk(n)
+            for n, ln, _ in s.fn_calls:
+                if n in AUX_JIT:
+                    continue
+                if n in facts.jitted:
+                    out.append(Finding(
+                        "dispatch-count", path_of(method), ln,
+                        f"{flavor.name} {entry}: steady path calls "
+                        f"jitted program {n} directly from {method} — "
+                        f"device dispatch belongs in the storage "
+                        f"hooks"))
+                elif n in facts.functions:
+                    walk_helper(facts, n, method)
+
+        walk(entry)
+        steady = contract["steady"]
+        got = sorted({h for h, _, _ in hook_hits})
+        if len(hook_hits) != 1 or got != [steady]:
+            fn, _ = flavor.table[entry]
+            sites = ", ".join(f"{h}@{m}:{ln}" for h, m, ln in hook_hits)
+            out.append(Finding(
+                "dispatch-count", path_of(entry), fn.lineno,
+                f"{flavor.name} {entry}: steady path dispatches "
+                f"{len(hook_hits)} hook site(s) [{sites or 'none'}] — "
+                f"the contract is exactly one {steady} call (extra "
+                f"dispatches belong in sanctioned helpers: "
+                f"{sorted(sanctioned) or 'none declared'})"))
+
+        # -- lazy-fetch rule: hook results fetched under the guard -----
+        entry_fn, _ = flavor.table[entry]
+        result_names = _hook_result_names(entry_fn, steady)
+        s = scan(entry)
+        for ln, guarded, names, _ in s.fetches:
+            if not guarded and names & result_names:
+                out.append(Finding(
+                    "dispatch-fetch", path_of(entry), ln,
+                    f"{flavor.name} {entry}: host fetch of dispatch "
+                    f"result ({sorted(names & result_names)}) outside "
+                    f"the dispatch_guard with-block — the fetch is the "
+                    f"true barrier and must ride the stall watchdog"))
+    return out
+
+
+def _audit_registry(facts: ModuleFacts) -> List[Finding]:
+    """Every jitted def is covered by the retrace watch list."""
+    out: List[Finding] = []
+    declared = facts.registry if facts.registry is not None \
+        else facts.registered
+    missing = facts.jitted - declared
+    stale = declared - facts.jitted
+    for name in sorted(missing):
+        out.append(Finding(
+            "jit-registry", facts.relpath,
+            facts.functions[name].lineno if name in facts.functions
+            else 1,
+            f"jitted serving program {name} is not on the retrace "
+            f"watch list (_JIT_ENTRIES / register_jit_entries) — its "
+            f"cache growth would be invisible to "
+            f"tpushare_jit_retraces_total"))
+    for name in sorted(stale):
+        out.append(Finding(
+            "jit-registry", facts.relpath, 1,
+            f"retrace watch list names {name} which is not a jitted "
+            f"definition in this module (stale registration)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def audit_pair(dense_src: str, paged_src: Optional[str] = None,
+               dense_path: str = DENSE_MODULE,
+               paged_path: str = PAGED_MODULE,
+               require_all_entries: bool = False) -> List[Finding]:
+    try:
+        dense = ModuleFacts(dense_path, dense_src)
+    except SyntaxError as e:
+        return [Finding("parse", dense_path, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    out: List[Finding] = []
+    cls = dense.batcher_class()
+    if cls is None:
+        return [Finding("audit-sync", dense_path, 1,
+                        "no class with tick entries / storage hooks "
+                        "found")]
+    flavors = [_Flavor("dense", [(dense.classes[cls], dense)])]
+    out.extend(_audit_registry(dense))
+    if paged_src is not None:
+        try:
+            paged = ModuleFacts(paged_path, paged_src)
+        except SyntaxError as e:
+            return [Finding("parse", paged_path, e.lineno or 0,
+                            f"syntax error: {e.msg}")]
+        pcls = paged.batcher_class()
+        if pcls is None:
+            out.append(Finding("audit-sync", paged_path, 1,
+                               "no paged batcher class found"))
+        else:
+            flavors.append(_Flavor("paged", [
+                (dense.classes[cls], dense),
+                (paged.classes[pcls], paged)]))
+        out.extend(_audit_registry(paged))
+    for flavor in flavors:
+        if require_all_entries:
+            for entry in ENTRY_CONTRACT:
+                if entry not in flavor.table:
+                    out.append(Finding(
+                        "audit-sync",
+                        dense_path if flavor.name == "dense"
+                        else paged_path, 1,
+                        f"{flavor.name}: contract entry {entry} not "
+                        f"found on the batcher class (contract "
+                        f"drift?)"))
+        out.extend(_audit_flavor(flavor))
+    return out
+
+
+def audit_tree(root: Optional[str] = None) -> List[Finding]:
+    root = root or repo_root()
+
+    def read(rel):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return f.read()
+
+    return audit_pair(read(DENSE_MODULE), read(PAGED_MODULE),
+                      require_all_entries=True)
+
+
+def cross_check_live() -> None:
+    """Pin the mirrored contract to the LIVE serving classes (imports
+    jax, mosaic-style): entries/hooks must exist, and every statically
+    discovered jitted program must be on the live retrace watch list.
+    Raises :class:`DispatchDriftError` on disagreement — edit the
+    contract and the serving code together."""
+    from ..serving import continuous, paged
+
+    for entry in ENTRY_CONTRACT:
+        if not hasattr(continuous.ContinuousBatcher, entry):
+            raise DispatchDriftError(
+                f"contract entry {entry} missing on ContinuousBatcher")
+    for hook in TICK_HOOKS + PREFILL_HOOKS:
+        for cls in (continuous.ContinuousBatcher,
+                    paged.PagedContinuousBatcher):
+            if not hasattr(cls, hook):
+                raise DispatchDriftError(
+                    f"contract hook {hook} missing on {cls.__name__}")
+    root = repo_root()
+    for rel, module in ((DENSE_MODULE, continuous),
+                        (PAGED_MODULE, paged)):
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            facts = ModuleFacts(rel, f.read())
+        for name in sorted(facts.jitted):
+            fn = getattr(module, name, None)
+            if fn is None or not any(fn is e
+                                     for e in continuous._JIT_ENTRIES):
+                raise DispatchDriftError(
+                    f"jitted program {rel}:{name} is not registered in "
+                    f"continuous._JIT_ENTRIES — the retrace counter "
+                    f"cannot watch it")

@@ -471,31 +471,62 @@ _GUARDED_PUBLIC_ATTRS = frozenset({"state", "reason"})
     "inside tpushare/telemetry/.  Assigning a private attribute (or "
     "``.state``/``.reason``) from outside bypasses the lock and the "
     "metric mirroring — use the methods (``set_state``, ``reset``, "
-    "``clear``, ``set_capacity``).  Public float knobs "
-    "(``dispatch_deadline_s``, ``slow_record_s``) stay assignable: "
-    "they are single-word reads the guards sample once.",
+    "``clear``, ``set_capacity``).  ALIASED writes are caught too "
+    "(``r = RECORDER; r._x = ...`` — the round-18 evasion the direct "
+    "spelling match missed), resolved against the write's enclosing "
+    "function scope.  Public float knobs (``dispatch_deadline_s``, "
+    "``slow_record_s``) stay assignable: they are single-word reads "
+    "the guards sample once.",
     _outside_telemetry, "whole repo except tpushare/telemetry/")
 def _telemetry_lock(ctx: Context):
-    def base_is_global(value: ast.AST) -> bool:
+    def is_global_expr(value: ast.AST) -> bool:
         return ((isinstance(value, ast.Name)
                  and value.id in _TELEMETRY_GLOBALS)
                 or (isinstance(value, ast.Attribute)
                     and value.attr in _TELEMETRY_GLOBALS))
 
+    def enclosing_fn(node: ast.AST):
+        parents = ctx.parent_map()
+        while node in parents:
+            node = parents[node]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None                       # module scope
+
+    # alias pre-pass: plain-Name targets assigned FROM a telemetry
+    # global, keyed by the assignment's enclosing function (None =
+    # module scope) — a later attribute write through the alias in the
+    # same scope is the same lock bypass with one extra hop
+    aliases: Dict[Optional[ast.AST], set] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and is_global_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    aliases.setdefault(enclosing_fn(node),
+                                       set()).add(t.id)
+
+    def base_hits(value: ast.AST, scope) -> bool:
+        if is_global_expr(value):
+            return True
+        return (isinstance(value, ast.Name)
+                and (value.id in aliases.get(scope, ())
+                     or value.id in aliases.get(None, ())))
+
     for node in ast.walk(ctx.tree):
         if isinstance(node, (ast.Assign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]
+            scope = enclosing_fn(node)
             for t in targets:
                 if isinstance(t, ast.Attribute) and \
-                        base_is_global(t.value) and \
+                        base_hits(t.value, scope) and \
                         (t.attr.startswith("_")
                          or t.attr in _GUARDED_PUBLIC_ATTRS):
                     yield t.lineno, (
                         f"direct write to {t.attr!r} on a process-"
-                        f"global telemetry object bypasses its lock — "
-                        f"use the mutation methods (set_state / reset "
-                        f"/ clear)")
+                        f"global telemetry object (possibly via an "
+                        f"alias) bypasses its lock — use the mutation "
+                        f"methods (set_state / reset / clear)")
 
 
 # ---------------------------------------------------------------------------
@@ -606,6 +637,47 @@ _CATALOG_RULES_HEADER = """\
 |---|---|---|---|
 """
 
+_CATALOG_CONFINEMENT = """\
+
+## Layer 3 — thread-confinement checker (`tpushare.analysis.confinement`)
+
+The serving plane's concurrency model as a checked contract: the
+policy is DECLARED in the code (`_THREAD_MANIFEST` in
+serving/continuous.py, `_LOCK_GUARDED` in the telemetry modules) and
+verified before anything runs.  Reads of loop state stay legal (they
+are documented point-in-time snapshots); mutations are confined.
+
+| Check | Rule |
+|---|---|
+| `loop-confined` | every MUTATION of a declared loop-confined ContinuousService attribute (assignment, `del`, a mutating method call — aliases of the batcher included) happens only in methods reachable from the loop roots, the construction phase, or a declared join-synchronized method |
+| `queue-crossing` | every touch of a lock-crossed command queue (`_waiting`, the migration commands, `_cancels`) sits inside `with self._lock:` — the queues are the ONLY sanctioned handler-to-loop crossing |
+| `batcher-ownership` | a batcher method CALL outside the loop closure must name a declared read-only method (validation/capability/economics); ticks, admission, and session export belong to the loop |
+| `service-internals` | nothing under tpushare/ outside serving/continuous.py touches the confined names (`._batcher`, `._sinks`, ...) — handlers use the public API (`can_migrate()`/`storage_info()`/`mesh`/`snapshot()`) |
+| `lock-discipline` | inside tpushare/telemetry/, mutations of `_LOCK_GUARDED` attributes sit inside `with self._lock:`; `*_locked` methods are the callers-hold-the-lock convention |
+| `manifest-sync` | manifest-declared classes/methods/attributes must exist (a rename updates the manifest or the check fails) |
+"""
+
+_CATALOG_DISPATCH = """\
+
+## Layer 4 — dispatch auditor (`tpushare.analysis.dispatch_audit`)
+
+The one-dispatch-per-round economics (rounds 7/14/17) proven
+statically, per storage flavor (dense / paged), by walking the serving
+call graph from every tick entry.  The contract is mirrored in
+`ENTRY_CONTRACT` and cross-checked against the live classes
+(`cross_check_live`, DispatchDriftError on drift); the runtime
+dispatch-count tests derive their counter wrap lists from the same
+table.
+
+| Check | Rule |
+|---|---|
+| `dispatch-count` | each tick entry's steady path reaches EXACTLY ONE storage-hook call — the declared hook; extra dispatches live only in the sanctioned boundary-straggler/fallback helpers; lambdas are deferred thunks attributed to the helper they ride |
+| `hook-body` | each tick hook dispatches exactly one jitted program, never calls another hook, never host-fetches |
+| `dispatch-guard` | every hook call site outside a hook sits inside a `MONITOR.dispatch_guard` with-block (the stall watchdog must see every dispatch) |
+| `dispatch-fetch` | `np.asarray` fetches of a hook's results stay inside the guard with-block — the fetch is the true barrier (CLAUDE.md) |
+| `jit-registry` | every `@jax.jit` definition in the serving modules is on the retrace watch list (`_JIT_ENTRIES` / `register_jit_entries`), so `tpushare_jit_retraces_total` sees every program |
+"""
+
 
 def render_catalog() -> str:
     from . import mosaic
@@ -654,4 +726,6 @@ def render_catalog() -> str:
         allow_cell = " ".join(allow.split()).replace("|", r"\|")
         lines.append(f"| `{r.name}` | {r.scope_doc} | {allow_cell} "
                      f"| {help_cell} |\n")
+    lines.append(_CATALOG_CONFINEMENT)
+    lines.append(_CATALOG_DISPATCH)
     return "".join(lines)

@@ -201,7 +201,7 @@ class LLMServer:
             # page_size=16 int8 pool's 32-row sublane tile) serves the
             # XLA gather on every tick — say so ONCE at startup instead
             # of leaving only the "(fb N)" metric to find.
-            info = self._service._batcher.storage_info()
+            info = self._service.storage_info()
             reason = info.get("attn_fallback_reason")
             if reason:
                 log.warning(
@@ -315,7 +315,7 @@ class LLMServer:
         snap = self._drain_snapshot()
         if migrate_to is not None:
             if self._service is None or \
-                    not self._service._batcher.can_migrate():
+                    not self._service.can_migrate():
                 from . import metrics
                 metrics.MIGRATION_REFUSED.inc(
                     reason="unsupported_storage")
@@ -390,7 +390,7 @@ class LLMServer:
         from . import metrics, migrate
 
         if self._service is None or \
-                not self._service._batcher.can_migrate():
+                not self._service.can_migrate():
             metrics.MIGRATION_REFUSED.inc(reason="unsupported_storage")
             return 409, {"Error": "migration refused: "
                                   "unsupported_storage (this replica "
@@ -562,7 +562,7 @@ class LLMServer:
         from . import migrate
 
         if self._service is None or \
-                not self._service._batcher.can_migrate():
+                not self._service.can_migrate():
             return 400, {"Error": "phase='prefill' needs paged "
                                   "slot-pool serving (--slots + "
                                   "--page-size)"}
@@ -669,7 +669,7 @@ class LLMServer:
                                   f"{self.cfg.max_seq}"}
         if not 1 <= prompt_len < len(rows[0]):
             return 400, {"Error": "prompt_len must be in [1, len-1]"}
-        if self._service is not None and self._service._batcher.mesh \
+        if self._service is not None and self._service.mesh \
                 is not None:
             # tp serving shards the BATCHER's param copy; self.params is
             # the unsharded original, and a model needing tp won't fit
@@ -842,7 +842,7 @@ class LLMServer:
             stats["batcher"] = self._service.snapshot()
             # KV storage economics (what a slot/page costs, slots per
             # GiB) — the number the rolling pool / page ring change
-            stats["kv_storage"] = self._service._batcher.storage_info()
+            stats["kv_storage"] = self._service.storage_info()
         return 200, stats
 
     def start(self):

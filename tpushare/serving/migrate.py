@@ -72,6 +72,11 @@ MIGRATION_REFUSAL_REASONS = ("pool_full", "config_mismatch", "bad_blob",
 MIGRATION_OUT_KINDS = ("handoff", "spill", "drain")
 MIGRATION_IN_KINDS = ("import", "restore")
 
+#: the ``direction`` label values of ``tpushare_migration_bytes_total``
+#: (enum-linted through the declarative pin table in
+#: tests/test_metric_lint.py, round 18): which way the blob bytes moved
+MIGRATION_DIRECTIONS = ("in", "out")
+
 
 class BlobError(ValueError):
     """The bytes are not a (known-version) session blob."""

@@ -45,7 +45,8 @@ import numpy as np
 
 from ..models import transformer
 from . import metrics
-from .continuous import ContinuousBatcher, _Slot, _sample_next
+from .continuous import (ContinuousBatcher, _Slot, _sample_next,
+                         register_jit_entries)
 
 log = logging.getLogger("tpushare.serving")
 
@@ -220,6 +221,15 @@ def _scatter_pages(pools, ids, blocks):
     page count compiles once, like the fused n_steps programs)."""
     return jax.tree_util.tree_map(
         lambda pool, blk: pool.at[:, ids].set(blk), pools, blocks)
+
+
+# every paged jitted program joins the retrace watch list (and the
+# dispatch auditor's registry cross-check): before round 18 the
+# retrace counter saw only the DENSE programs, so steady cache growth
+# on a paged service was invisible to tpushare_jit_retraces_total
+register_jit_entries(_prefill, _prefill_chunk, _tick, _tick_n,
+                     _tick_mixed, _tick_spec, _tick_mixed_spec,
+                     _scatter_pages)
 
 
 def _store_arrays(prefix: str, store) -> list:

@@ -45,6 +45,13 @@ def snapshot_dir() -> str:
     return os.environ.get(SNAPSHOT_DIR_ENV) or tempfile.gettempdir()
 
 
+#: Lock-discipline manifest (tpushare.analysis.confinement): ring and
+#: sequence mutations happen only under the recorder's own lock.
+_LOCK_GUARDED = {
+    "FlightRecorder": ("_buf", "_seq"),
+}
+
+
 class FlightRecorder:
     """Fixed-capacity deque of event dicts; thread-safe; JSONL dumps."""
 

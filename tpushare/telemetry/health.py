@@ -201,6 +201,23 @@ class _DispatchGuard:
         return False
 
 
+#: Lock-discipline manifest — verified statically by
+#: ``tpushare.analysis.confinement`` (Layer 3 of ``make lint``): every
+#: MUTATION of these :class:`HealthMonitor` attributes outside
+#: ``__init__`` must sit inside ``with self._lock:`` (methods whose
+#: name ends in ``_locked`` are the documented callers-hold-the-lock
+#: exception, registry.py style).  The public float knobs
+#: (``dispatch_deadline_s``, ``slow_record_s``) and the probe-loop
+#: lifecycle handles (``_probe_thread``, ``_probe_halt``) stay out:
+#: the knobs are single-word reads the guards sample once, and the
+#: probe loop is started/stopped by one owner.
+_LOCK_GUARDED = {
+    "HealthMonitor": ("state", "reason", "last_snapshot_path",
+                      "_transitions", "_inflight", "_next_token",
+                      "_scanner"),
+}
+
+
 class HealthMonitor:
     """The process-global backend health state machine.
 
@@ -257,8 +274,12 @@ class HealthMonitor:
         RECORDER.record("health_transition", frm=prev, to=state,
                         reason=reason)
         if state == WEDGED:
-            self.last_snapshot_path = RECORDER.snapshot_to(
-                reason=f"WEDGED: {reason}")
+            # the snapshot write (disk I/O) stays OUTSIDE the lock —
+            # /healthz must answer while forensics flush — only the
+            # path publication takes it
+            path = RECORDER.snapshot_to(reason=f"WEDGED: {reason}")
+            with self._lock:
+                self.last_snapshot_path = path
 
     def mark_cpu_fallback(self, reason: str) -> None:
         """This process pinned the CPU backend (probe failure, backend
@@ -312,21 +333,24 @@ class HealthMonitor:
         RECORDER.record("probe", ok=ok, latency_s=round(latency_s, 6),
                         reason=reason or None)
         if ok:
+            recovered = False
             with self._lock:
                 any_stalled = any(r["stalled"]
                                   for r in self._inflight.values())
-            if any_stalled:
-                # Small RPCs answering while a real dispatch is still
-                # hung is the tunnel's classic half-dead mode: the
-                # probe must not paint a wedged machine green (the
-                # stall record never re-fires — see _scan_loop's
-                # not-stalled filter).
-                self.reason = ("probe ok but a stalled dispatch is "
-                               "still in flight")
-            elif self.state in (DEGRADED, WEDGED):
+                if any_stalled:
+                    # Small RPCs answering while a real dispatch is
+                    # still hung is the tunnel's classic half-dead
+                    # mode: the probe must not paint a wedged machine
+                    # green (the stall record never re-fires — see
+                    # _scan_loop's not-stalled filter).
+                    self.reason = ("probe ok but a stalled dispatch is "
+                                   "still in flight")
+                elif self.state in (DEGRADED, WEDGED):
+                    recovered = True     # transition takes the lock itself
+                elif self.state == OK:
+                    self.reason = "probe ok"
+            if recovered:
                 self.set_state(OK, "probe recovered")
-            elif self.state == OK:
-                self.reason = "probe ok"
         elif timed_out:
             self.set_state(WEDGED, reason or "probe deadline exceeded")
         else:

@@ -82,6 +82,20 @@ def _fmt_value(v) -> str:
     return repr(f)
 
 
+#: Lock-discipline manifest (tpushare.analysis.confinement): metric
+#: value stores and the registry's family table mutate only under their
+#: own lock.  The ``*_locked`` method-name suffix is the documented
+#: callers-hold-the-lock convention (``Histogram._state_locked``) — the
+#: checker exempts those bodies.
+_LOCK_GUARDED = {
+    "_Metric": ("_vals",),
+    "Counter": ("_vals",),
+    "Gauge": ("_vals",),
+    "Histogram": ("_vals",),
+    "Registry": ("_metrics",),
+}
+
+
 class _Metric:
     kind = "untyped"
 
