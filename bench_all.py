@@ -640,6 +640,299 @@ def disagg_bench(params, cfg, *, slots, page_size, storm_reqs,
     return out
 
 
+def _simulate_shared_chip(service, chip, ledger, lock, name, pacer,
+                          rpc_s, prefill_token_s, decode_step_s):
+    """Co-tenancy proxy for the tenant-isolation bench: wrap
+    ``service``'s dispatch hooks with work-proportional charges
+    SERIALIZED on one shared ``chip`` lock (one chip executes one
+    dispatch at a time — the resource two co-tenants actually fight
+    over), pacing each dispatch through the tenant's ``pacer`` BEFORE
+    the chip is taken (the in-process stand-in for the dispatch
+    guard's pre-dispatch hook: MONITOR is process-global, so two
+    in-process tenants cannot share its one policy slot) and crediting
+    the tenant's device-time ``ledger`` — the same measured-residency
+    feed the real guard exit debits."""
+    b = service._batcher
+
+    def charge(phase, cost_s):
+        pacer.acquire(phase)
+        with chip:
+            time.sleep(rpc_s + cost_s)
+        pacer.debit(phase, cost_s)
+        with lock:
+            ledger[name] += cost_s
+
+    real_chunk = b._prefill_chunk_into
+
+    def prefill_chunk(slot, padded, pos, last_idx, chunk_len, *a, **k):
+        charge("prefill", chunk_len * prefill_token_s)
+        return real_chunk(slot, padded, pos, last_idx, chunk_len,
+                          *a, **k)
+
+    b._prefill_chunk_into = prefill_chunk
+    real_step = b._step
+
+    def step(*a, **k):
+        charge("decode", decode_step_s)
+        return real_step(*a, **k)
+
+    b._step = step
+    real_step_n = b._step_n
+
+    def step_n(*a, **k):
+        charge("decode", a[-1] * decode_step_s)
+        return real_step_n(*a, **k)
+
+    b._step_n = step_n
+    real_mixed = b._step_mixed
+
+    def step_mixed(p_tokens, *a, **k):
+        chunk_len, n_steps = a[-2], a[-1]
+        charge("mixed", p_tokens.shape[0] * chunk_len * prefill_token_s
+               + n_steps * decode_step_s)
+        return real_mixed(p_tokens, *a, **k)
+
+    b._step_mixed = step_mixed
+
+
+def tenant_isolation_bench(params, cfg, *, slots, noisy_prompt_len,
+                           noisy_gen, victim_prompt_len, victim_gen,
+                           victim_reqs,
+                           noisy_hbm_fraction=0.2,
+                           victim_hbm_fraction=0.6,
+                           rpc_s=0.002, prefill_token_s=0.0004,
+                           decode_step_s=0.002,
+                           report_interval_s=0.15, settle_s=1.0,
+                           noisy_clients=6, victim_clients=2,
+                           victim_warm_reqs=8):
+    """Two-tenant ANTAGONIST drill over the whole enforcement loop:
+    a noisy tenant storms long prompts at a shared chip (the
+    serialized-dispatch proxy above) next to a victim serving short
+    decode requests; three arms measure the victim's latency —
+
+    * ``solo``: the victim alone (its baseline p99);
+    * ``off``: co-resident, daemon policy off (round 4's world:
+      verdicts always ok, the noisy tenant reaches the full-chip
+      ceiling and the victim's TTFT collapses);
+    * ``enforce``: co-resident, daemon ``--tenant-policy enforce`` —
+      each tenant reports usage every ``report_interval_s`` and
+      applies the verdict: the noisy tenant (10x over its entitlement
+      against a BUSY victim, so no SGDRC donation) climbs the ladder
+      to admission refusal, its clients honor Retry-After, and the
+      victim's latency is restored while the noisy tenant's
+      device-time share over the measurement window collapses under
+      its entitlement.
+
+    The enforcement loop is REAL end to end — StatusServer ingest →
+    aggregate → verdict → HTTP response → PolicyClient → pacer/429 —
+    only the chip itself is simulated (CPU dispatch cannot price
+    co-residency; round-16 note).  Importable so a test can smoke-run
+    it at tiny sizes.  Returns per-arm victim p50/p99 plus the
+    enforce arm's share accounting and verdict counters."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from tpushare.plugin.status import StatusServer
+    from tpushare.serving.llm import LLMServer
+    from tpushare.serving.policy import PolicyClient
+
+    ENTS = {"noisy": noisy_hbm_fraction, "victim": victim_hbm_fraction}
+
+    def post(port, path, body, timeout=600):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, _json.loads(r.read()), dict(r.headers)
+
+    def run_arm(mode):
+        """mode: None = solo (victim only), else the daemon policy."""
+        chip = threading.Lock()
+        lock = threading.Lock()
+        ledger = {"noisy": 0.0, "victim": 0.0}
+        halt = threading.Event()
+        threads = []
+        clients = {}
+        servers = {}
+        daemon = None
+        names = ["victim"] if mode is None else ["victim", "noisy"]
+        for name in names:
+            # refusal windows track the (fast) report cadence, exactly
+            # as llm.py main() wires the real loop
+            clients[name] = PolicyClient(
+                verdict_interval_s=report_interval_s)
+            srv = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                            n_slots=slots,
+                            policy_client=clients[name]).start()
+            _simulate_shared_chip(srv._service, chip, ledger, lock,
+                                  name, clients[name].pacer, rpc_s,
+                                  prefill_token_s, decode_step_s)
+            servers[name] = srv
+        # MONITOR has ONE policy slot per process; each service start
+        # above installed its tenant's pacer there, last one winning —
+        # which would cross-wire BOTH tenants' real dispatch guards
+        # (and their chip-lock wall time) onto one tenant's bucket.
+        # In this bench the pacing site is the charge() wrapper (the
+        # per-tenant stand-in for the guard hook), so disarm the
+        # global slot entirely.
+        from tpushare.telemetry.health import MONITOR
+        MONITOR.uninstall_policy()
+        if mode is not None:
+            daemon = StatusServer(0, policy=mode).start()
+
+            def reporter(name):
+                srv = servers[name]
+                while not halt.is_set():
+                    snap = srv._service.snapshot()
+                    busy = snap["active"] + snap["prefilling"] \
+                        + snap["queued"]
+                    with lock:
+                        dev = ledger[name]
+                    body = {"pod": name, "device_time_s": dev,
+                            "hbm_fraction": ENTS[name],
+                            "occupancy": (snap["active"]
+                                          / max(1, snap["slots"])),
+                            "queued": snap["queued"] + snap["active"]
+                            if busy else 0}
+                    try:
+                        _, resp, _ = post(daemon.port, "/usage", body,
+                                          timeout=5)
+                        clients[name].apply(resp)
+                    except Exception:
+                        pass
+                    halt.wait(report_interval_s)
+
+            for name in names:
+                t = threading.Thread(target=reporter, args=(name,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+
+        refused_429 = {"n": 0}
+        if mode is not None:
+            noisy_body = {"tokens": [[11] * noisy_prompt_len],
+                          "max_new_tokens": noisy_gen}
+
+            def noisy_client():
+                while not halt.is_set():
+                    try:
+                        code, _, headers = post(
+                            servers["noisy"].port, "/generate",
+                            noisy_body, timeout=600)
+                    except urllib.error.HTTPError as e:
+                        code = e.code
+                        headers = dict(e.headers)
+                        e.read()
+                    except Exception:
+                        halt.wait(0.1)
+                        continue
+                    if code == 429:
+                        with lock:
+                            refused_429["n"] += 1
+                        # a well-behaved client honors Retry-After
+                        # (capped so the arm ends promptly)
+                        halt.wait(min(2.0, float(
+                            headers.get("Retry-After", 1))))
+
+            for _ in range(noisy_clients):
+                t = threading.Thread(target=noisy_client, daemon=True)
+                t.start()
+                threads.append(t)
+            time.sleep(settle_s)     # burst + first verdicts land
+
+        vbody = {"tokens": [[7] * victim_prompt_len],
+                 "max_new_tokens": victim_gen}
+        lat = []
+
+        def drive_victims(n, timed):
+            todo = list(range(n))
+
+            def victim_client():
+                while True:
+                    with lock:
+                        if not todo:
+                            return
+                        todo.pop()
+                    t0 = time.perf_counter()
+                    code, payload, _ = post(servers["victim"].port,
+                                            "/generate", vbody)
+                    assert code == 200 and len(payload["tokens"][0]) \
+                        == victim_prompt_len + victim_gen
+                    now = time.perf_counter()
+                    if timed:
+                        with lock:
+                            lat.append(now - t0)
+
+            vthreads = [threading.Thread(target=victim_client)
+                        for _ in range(victim_clients)]
+            for t in vthreads:
+                t.start()
+            for t in vthreads:
+                t.join()
+
+        # UNTIMED warm-up traffic: compiles the victim shapes, and —
+        # the load-bearing part — RETURNS the victim's demand before
+        # the measurement window, so the SGDRC donation its idle
+        # settle-phase share was funding the antagonist with is
+        # revoked and the verdict ladder engages first.  The timed
+        # window measures steady-state restoration, not the one
+        # demand-returns transient (whose cost is the noisy backlog
+        # admitted while the victim was genuinely idle — correct
+        # sharing, not a policy failure).
+        drive_victims(victim_warm_reqs, timed=False)
+        # the victim measurement window
+        with lock:
+            window0 = dict(ledger)
+        drive_victims(victim_reqs, timed=True)
+        with lock:
+            window1 = dict(ledger)
+        halt.set()
+        for srv in servers.values():
+            srv.stop()
+        if daemon is not None:
+            daemon.stop()
+        lat.sort()
+        delta = {n: window1[n] - window0[n] for n in window1}
+        total_delta = sum(delta.values())
+        out = {
+            "victim_p50_s": round(lat[len(lat) // 2], 4),
+            "victim_p99_s": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
+            "noisy_429s": refused_429["n"],
+            "window_device_s": {n: round(v, 4)
+                                for n, v in delta.items()},
+        }
+        if mode is not None and total_delta > 0:
+            ent_share = ENTS["noisy"] / sum(ENTS.values())
+            share = delta["noisy"] / total_delta
+            out["noisy_window_share"] = round(share, 4)
+            out["noisy_share_vs_entitlement"] = round(
+                share / ent_share, 4)
+            cum_total = sum(window1.values())
+            out["noisy_cumulative_share"] = round(
+                window1["noisy"] / cum_total, 4) if cum_total else None
+        return out
+
+    out = {"solo": run_arm(None), "off": run_arm("off"),
+           "enforce": run_arm("enforce")}
+    # daemon verdict ledger (process-global counters; the two policy
+    # arms are the only writers for these tenant labels in a sweep)
+    from tpushare import telemetry
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+
+    def counter_sum(name):
+        return sum(v for labels, v in parsed["samples"].get(name, ())
+                   if labels.get("tenant") == "noisy")
+
+    out["daemon_refused"] = counter_sum(
+        "tpushare_tenant_admission_refused_total")
+    out["daemon_paced"] = counter_sum("tpushare_tenant_paced_total")
+    return out
+
+
 def spill_capacity_bench(params, cfg, *, page_size, n_pages, slots,
                          n_reqs, prompt_len, gen,
                          spill_bytes=256 * 2**20):
@@ -1485,6 +1778,55 @@ def main() -> int:
             f"disaggregation did not beat co-residency ({vs_base}x)"
         assert cap_ratio >= 2.0, \
             f"spill tier admitted only {cap_ratio}x sessions"
+
+        # 8. ENFORCED TENANT ISOLATION (round 19): the two-tenant
+        # antagonist — noisy long-prompt storm vs a short-decode
+        # victim on one serialized chip, with the REAL daemon policy
+        # loop (usage reports -> verdicts -> pacing/429) closing the
+        # round-4 "caps are advisory" hole.  Record emitted BEFORE the
+        # acceptance asserts, like the router arm.
+        ti = tenant_isolation_bench(
+            rparams, rcfg, slots=4,
+            noisy_prompt_len=80, noisy_gen=4,
+            victim_prompt_len=8, victim_gen=16, victim_reqs=24)
+        restored = round(ti["enforce"]["victim_p99_s"]
+                         / max(1e-9, ti["solo"]["victim_p99_s"]), 3)
+        degraded = round(ti["off"]["victim_p99_s"]
+                         / max(1e-9, ti["solo"]["victim_p99_s"]), 3)
+        _emit("tenant_isolation_victim_p99_ms",
+              ti["enforce"]["victim_p99_s"] * 1000.0, "ms",
+              platform=platform, slots=4,
+              solo_p99_ms=round(ti["solo"]["victim_p99_s"] * 1000, 2),
+              off_p99_ms=round(ti["off"]["victim_p99_s"] * 1000, 2),
+              victim_p99_restored_ratio=restored,
+              off_degradation_ratio=degraded,
+              noisy_share_vs_entitlement=ti["enforce"].get(
+                  "noisy_share_vs_entitlement"),
+              noisy_window_share=ti["enforce"].get(
+                  "noisy_window_share"),
+              noisy_cumulative_share=ti["enforce"].get(
+                  "noisy_cumulative_share"),
+              noisy_429s=ti["enforce"]["noisy_429s"],
+              daemon_refused=ti["daemon_refused"],
+              daemon_paced=ti["daemon_paced"],
+              note="victim request p99 under a noisy co-tenant storm "
+                   "on the serialized shared-chip proxy: solo vs "
+                   "policy-off vs --tenant-policy enforce (real "
+                   "daemon verdict loop; chip simulated — round-16 "
+                   "note)")
+        # the ISSUE-14 acceptance bars: victim restored near solo,
+        # noisy capped under its entitlement+slack over the window,
+        # and the off arm actually demonstrates the problem
+        assert restored <= 1.25, \
+            f"enforcement left victim p99 at {restored}x solo"
+        share_ratio = ti["enforce"].get("noisy_share_vs_entitlement")
+        assert share_ratio is not None and share_ratio <= 1.1, \
+            f"noisy window share {share_ratio}x entitlement"
+        assert degraded >= 1.5, \
+            f"policy-off arm degraded victim only {degraded}x (the " \
+            f"antagonist is not antagonizing)"
+        assert ti["daemon_refused"] > 0 or ti["daemon_paced"] > 0, \
+            "enforcement never issued a pace/refuse verdict"
     return 0
 
 

@@ -742,6 +742,87 @@ def test_dispatch_audit_catches_unregistered_jit():
     assert "_other_prog" in fs[0].message
 
 
+def test_dispatch_audit_pacing_guard_interior_is_clean():
+    """The sanctioned shape: a tenant-policy pacing acquire INSIDE the
+    dispatch guard (where health.py's own guard-enter hook lives)
+    audits clean."""
+    ok = _AUDIT_FIXTURE.replace(
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n',
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n'
+        '            self._policy.acquire("decode")\n')
+    assert dispatch_audit.audit_pair(ok) == []
+
+
+def test_dispatch_audit_catches_unguarded_pacing_sleep():
+    """Seeded violation (round-19 satellite): a pacing acquire OUTSIDE
+    the guard is a serving-loop sleep the stall watchdog cannot see —
+    the exact evasion the pacing-guard rule exists for."""
+    bad = _AUDIT_FIXTURE.replace(
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n',
+        '        self._policy.acquire("decode")\n'
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n')
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["pacing-guard"], fs
+    assert "outside" in fs[0].message
+    # ...and through a pacer-named alias too
+    bad2 = _AUDIT_FIXTURE.replace(
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n',
+        '        PACER.acquire("decode")\n'
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n')
+    assert [f.rule for f in dispatch_audit.audit_pair(bad2)] \
+        == ["pacing-guard"]
+    # a LOCK acquire is not pacing — no finding
+    ok = _AUDIT_FIXTURE.replace(
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n',
+        '        self._lock.acquire()\n'
+        '        with health.MONITOR.dispatch_guard("decode") as g:\n')
+    assert dispatch_audit.audit_pair(ok) == []
+
+
+def test_dispatch_audit_catches_pacing_inside_hook():
+    """Seeded violation: pacing inside the tick hook would sleep
+    between trace and dispatch of the jitted program — hooks stay
+    pure single-program dispatch."""
+    bad = _AUDIT_FIXTURE.replace(
+        "        out = _tick_prog(x, 1)\n",
+        '        self._policy.acquire("decode")\n'
+        "        out = _tick_prog(x, 1)\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert [f.rule for f in fs] == ["pacing-guard"], fs
+    assert "hook" in fs[0].message
+
+
+def test_confinement_lock_discipline_covers_policy_module():
+    """Layer 3's lock-discipline walk now patrols EVERY tpushare
+    module declaring a _LOCK_GUARDED manifest — the tenant-policy
+    pacer included (its state is shared by the serving loop, the
+    guard exit, and the usage-report thread)."""
+    fixture = '''
+import threading
+_LOCK_GUARDED = {"DispatchPacer": ("_rate", "_deficit")}
+class DispatchPacer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rate = None
+        self._deficit = 0.0
+    def set_rate(self, rate):
+        with self._lock:
+            self._rate = rate
+'''
+    assert confinement.check_lock_discipline(
+        "tpushare/serving/policy.py", fixture) == []
+    bad = fixture + ('    def leak(self, d):\n'
+                     '        self._deficit += d\n')
+    fs = confinement.check_lock_discipline(
+        "tpushare/serving/policy.py", bad)
+    assert [f.rule for f in fs] == ["lock-discipline"], fs
+    # and the REAL policy module is clean under the live manifest
+    with open(os.path.join(REPO, "tpushare/serving/policy.py"),
+              encoding="utf-8") as f:
+        assert confinement.check_lock_discipline(
+            "tpushare/serving/policy.py", f.read()) == []
+
+
 def test_dispatch_contract_matches_runtime_wrap_lists():
     """The runtime dispatch-count tests build their counter wrap lists
     FROM ENTRY_CONTRACT (tests/test_mixed_step.py,

@@ -219,6 +219,12 @@ ENUMERATED_VALUES = {
     ("tpushare_migration_bytes_total", "direction"): {"in", "out"},
     ("tpushare_router_handoffs_total", "outcome"):
         {"ok", "local_fallback", "reprefill"},
+    # keep in sync with serving.policy constants (asserted below /
+    # enum-pinned)
+    ("tpushare_tenant_admission_refused_total", "reason"):
+        {"over_share"},
+    ("tpushare_tenant_policy_info", "policy"):
+        {"off", "observe", "enforce"},
 }
 
 # -- enum pins (round-18 satellite): ONE declarative table ------------------
@@ -248,6 +254,8 @@ ENUM_PINS = {
         ("tpushare.serving.migrate", "MIGRATION_REFUSAL_REASONS"),
     ("tpushare_migration_bytes_total", "direction"):
         ("tpushare.serving.migrate", "MIGRATION_DIRECTIONS"),
+    ("tpushare_tenant_admission_refused_total", "reason"):
+        ("tpushare.serving.policy", "POLICY_REFUSAL_REASONS"),
 }
 
 
@@ -292,6 +300,31 @@ def test_enum_pins_match_module_constants():
         assert family in declared and label in declared[family], (
             f"ENUM_PINS pins {family}{{{label}}} but the registry "
             f"declares no such family/label")
+
+
+def test_policy_series_registered_with_contracted_names():
+    """The tenant-policy enforcement plane's series exist under their
+    contracted names and kinds (what `inspect --tenants`' POLICY/PACED/
+    REFUSED columns and the enforcement dashboards key on), and the
+    info gauge's policy enum pins to serving.policy.POLICY_MODES (the
+    gauge twin of the counter ENUM_PINS — the pin table covers
+    counters only)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_tenant_paced_total") == "counter"
+    assert by_name.get(
+        "tpushare_tenant_admission_refused_total") == "counter"
+    assert by_name.get("tpushare_tenant_policy_info") == "gauge"
+    assert by_name.get(
+        "tpushare_tenant_effective_entitlement_share") == "gauge"
+    assert by_name.get("tpushare_policy_pace_seconds") == "histogram"
+    assert by_name.get(
+        "tpushare_policy_admission_refused_total") == "counter"
+    assert by_name.get("tpushare_router_steered_total") == "counter"
+    assert by_name.get("tpushare_request_queue_depth") == "gauge"
+    from tpushare.serving import policy
+    assert set(policy.POLICY_MODES) == ENUMERATED_VALUES[
+        ("tpushare_tenant_policy_info", "policy")], \
+        "POLICY_MODES drifted from the lint enum"
 
 
 def test_migration_series_registered_with_contracted_names():

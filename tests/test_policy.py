@@ -1,0 +1,530 @@
+"""Tenant-isolation enforcement (round 19): pacing, verdicts, refusal.
+
+Covers the whole ladder on fakes and tiny configs:
+
+* the pure daemon-side policy math (``compute_verdicts`` — SGDRC slack
+  reallocation with the busy-donor gate, pace-rate self-tightening,
+  the pacing-before-refusal ladder);
+* the workload-side :class:`DispatchPacer` token bucket (rate capping,
+  disarm forgiveness) and :class:`PolicyClient` (mode gating, bounded
+  Retry-After backoff);
+* the dispatch-guard choke point end to end (install → guard paces
+  and debits → uninstall) and the ContinuousService lifecycle;
+* the antagonist drill on a simulated shared chip: a noisy tenant
+  saturates, pacing caps it, the victim's queue wait drops;
+* the daemon loop over real loopback HTTP (/usage → verdict → counted
+  per tenant) and the LLM server's 429 + Retry-After refusal with the
+  idempotent-seed re-submission contract;
+* policy=off inertness: no pacer installed, streams byte-identical by
+  construction (the goldens elsewhere in the suite run with no policy
+  armed, which IS the off path).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare.plugin.status import StatusServer
+from tpushare.serving import policy
+from tpushare.serving.policy import (DispatchPacer, PolicyClient,
+                                     compute_verdicts,
+                                     effective_entitlements,
+                                     parse_pace_rate)
+from tpushare.telemetry import health
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    """The monitor's policy hook is process-global: never leak an
+    armed pacer into other tests (the same discipline test_health.py
+    applies to the state machine)."""
+    yield
+    health.MONITOR.uninstall_policy()
+
+
+# ------------------------------------------------------- verdict math
+def _tenants(noisy_share, victim_share, noisy_ent=0.5, victim_ent=0.5,
+             victim_busy=True):
+    return {
+        "noisy": {"share": noisy_share, "entitlement": noisy_ent},
+        "victim": {"share": victim_share, "entitlement": victim_ent,
+                   "occupancy": 0.5 if victim_busy else 0.0,
+                   "queued": 2 if victim_busy else 0},
+    }
+
+
+def test_off_mode_is_inert():
+    v = compute_verdicts(_tenants(0.95, 0.05), "off")
+    assert all(t["verdict"] == "ok" for t in v.values())
+
+
+def test_unknown_mode_is_loud():
+    with pytest.raises(ValueError):
+        compute_verdicts({}, "aggressive")
+
+
+def test_within_entitlement_is_ok():
+    v = compute_verdicts(_tenants(0.5, 0.5), "enforce")
+    assert v["noisy"]["verdict"] == "ok"
+    assert v["victim"]["verdict"] == "ok"
+
+
+def test_pace_band_and_self_tightening_rate():
+    # 20% over a busy victim's untouched entitlement: inside the pace
+    # band (1.05 < 1.2 < 1.3), with rate = eff/ratio < eff
+    v = compute_verdicts(_tenants(0.6, 0.4), "enforce")
+    rate = parse_pace_rate(v["noisy"]["verdict"])
+    assert rate is not None
+    assert rate == pytest.approx(0.5 / (0.6 / 0.5))
+    assert rate < 0.5
+    assert v["victim"]["verdict"] == "ok"
+
+
+def test_way_over_refuses_with_reason():
+    v = compute_verdicts(_tenants(0.9, 0.1), "enforce")
+    assert v["noisy"]["verdict"] == "refuse"
+    assert v["noisy"]["reason"] == "over_share"
+    assert v["noisy"]["reason"] in policy.POLICY_REFUSAL_REASONS
+
+
+def test_idle_donor_funds_the_over_user():
+    """SGDRC: a genuinely IDLE under-user donates its headroom — the
+    over-user's effective entitlement absorbs it and the same share
+    that would refuse against a busy victim rides free."""
+    idle = _tenants(0.9, 0.1, victim_busy=False)
+    eff = effective_entitlements(idle)
+    assert eff["noisy"] == pytest.approx(0.9)   # 0.5 + donated 0.4
+    v = compute_verdicts(idle, "enforce")
+    assert v["noisy"]["verdict"] == "ok"
+
+
+def test_starved_victim_donates_nothing():
+    """The same under-use with DEMAND behind it (queued work / active
+    slots) is starvation, not idleness: no donation, the antagonist is
+    judged against its raw entitlement and refused."""
+    starved = _tenants(0.9, 0.1, victim_busy=True)
+    assert effective_entitlements(starved)["noisy"] == pytest.approx(0.5)
+    assert compute_verdicts(starved, "enforce")["noisy"]["verdict"] \
+        == "refuse"
+
+
+def test_donation_retightens_when_the_donor_returns():
+    """The reallocation is stateless: the donor's usage returning
+    shrinks the pool on the very next verdict."""
+    idle = _tenants(0.75, 0.05, victim_busy=False)
+    returned = _tenants(0.75, 0.45, victim_busy=False)
+    assert effective_entitlements(idle)["noisy"] > \
+        effective_entitlements(returned)["noisy"]
+
+
+def test_parse_pace_rate_rejects_malformed():
+    assert parse_pace_rate("pace:0.5") == 0.5
+    assert parse_pace_rate("pace:zoom") is None
+    assert parse_pace_rate("pace:-1") is None
+    assert parse_pace_rate("refuse") is None
+    assert parse_pace_rate(None) is None
+
+
+# ------------------------------------------------------- DispatchPacer
+def test_pacer_disarmed_is_free_and_armed_caps_rate():
+    p = DispatchPacer()
+    assert p.acquire("decode") == 0.0
+    p.set_rate(0.1)                      # 0.1 device-s per wall-s
+    p.debit("decode", 0.05)              # half a second of debt
+    t0 = time.monotonic()
+    slept = p.acquire("decode")
+    wall = time.monotonic() - t0
+    assert slept == pytest.approx(0.5, rel=0.3)
+    assert wall >= 0.25
+    # deficit repaid by the sleep: the next acquire is ~free
+    assert p.acquire("decode") < 0.05
+
+
+def test_pacer_sleep_is_bounded_per_round():
+    p = DispatchPacer(rate=0.001)
+    p.debit("decode", 10.0)              # 10000 s of nominal debt
+    t0 = time.monotonic()
+    slept = p.acquire("decode")
+    assert slept == pytest.approx(policy.MAX_PACE_SLEEP_S, rel=0.01)
+    assert time.monotonic() - t0 < policy.MAX_PACE_SLEEP_S + 1.0
+
+
+def test_pacer_disarm_forgives_the_deficit():
+    p = DispatchPacer(rate=0.01)
+    p.debit("decode", 5.0)
+    p.set_rate(None)
+    assert p.acquire("decode") == 0.0
+    p.set_rate(1000.0)                   # re-arm: no carried debt
+    assert p.acquire("decode") == 0.0
+
+
+# ------------------------------------------------------- PolicyClient
+def test_client_gates_on_enforce_mode():
+    c = PolicyClient()
+    assert c.apply({"policy": "pace:0.5", "mode": "observe"}) is None
+    assert c.pacer.rate() is None
+    assert c.apply({"policy": "refuse", "mode": "off"}) is None
+    assert c.refusal_retry_after() == 0.0
+    assert c.apply({"policy": "pace:0.5", "mode": "enforce"}) \
+        == "pace:0.5"
+    assert c.pacer.rate() == 0.5
+
+
+def test_client_refusal_backoff_is_bounded_and_resets():
+    c = PolicyClient()
+    backoffs = []
+    for _ in range(8):
+        c.apply({"policy": "refuse", "mode": "enforce"})
+        backoffs.append(c.snapshot()["backoff_s"])
+    assert backoffs[0] == policy.REFUSE_RETRY_AFTER_S
+    assert backoffs[-1] == policy.REFUSE_RETRY_AFTER_MAX_S
+    assert all(b <= policy.REFUSE_RETRY_AFTER_MAX_S for b in backoffs)
+    assert c.refusal_retry_after() > 0
+    c.apply({"policy": "ok", "mode": "enforce"})
+    assert c.refusal_retry_after() == 0.0
+    assert c.snapshot()["backoff_s"] == 0.0
+
+
+def test_client_ok_restores_the_static_floor():
+    c = PolicyClient(static_rate=0.25)
+    assert c.pacer.rate() == 0.25
+    c.apply({"policy": "pace:0.1", "mode": "enforce"})
+    assert c.pacer.rate() == 0.1
+    c.apply({"policy": "ok", "mode": "enforce"})
+    assert c.pacer.rate() == 0.25
+
+
+def test_client_ignores_unknown_verdicts():
+    c = PolicyClient(static_rate=0.25)
+    assert c.apply({"policy": "obliterate", "mode": "enforce"}) is None
+    assert c.pacer.rate() == 0.25
+    assert c.apply("nonsense") is None
+
+
+# ------------------------------------------- the dispatch-guard hook
+def test_guard_paces_and_debits_installed_policy():
+    pacer = DispatchPacer(rate=0.05)
+    health.MONITOR.install_policy(pacer)
+    # one "dispatch" costing ~0.03 s of device time
+    with health.MONITOR.dispatch_guard("decode"):
+        time.sleep(0.03)
+    snap = pacer.snapshot()
+    assert snap["deficit_s"] > 0         # the guard debited it
+    t0 = time.monotonic()
+    with health.MONITOR.dispatch_guard("decode"):
+        pass
+    assert time.monotonic() - t0 >= 0.2  # paced: ~0.03/0.05 = 0.6 s
+    assert pacer.paced_rounds >= 1
+    health.MONITOR.uninstall_policy(pacer)
+    t0 = time.monotonic()
+    with health.MONITOR.dispatch_guard("decode"):
+        pass
+    assert time.monotonic() - t0 < 0.1   # disarmed again
+
+
+def test_uninstall_is_owner_scoped():
+    mine, theirs = DispatchPacer(), DispatchPacer()
+    health.MONITOR.install_policy(theirs)
+    health.MONITOR.uninstall_policy(mine)     # not mine: no-op
+    assert health.MONITOR._policy is theirs
+    health.MONITOR.uninstall_policy(theirs)
+    assert health.MONITOR._policy is None
+
+
+def test_disarmed_guard_overhead_stays_negligible():
+    """The policy hook on the guard hot path is one attribute read
+    when no pacer is installed, and one lock-free rate read when an
+    installed pacer is disarmed — microseconds either way (the <2%
+    telemetry overhead guard runs the same code; this pins the new
+    hook specifically, with a generous absolute bound for box
+    noise)."""
+    def cost(n=2000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with health.MONITOR.dispatch_guard("decode"):
+                pass
+        return (time.perf_counter() - t0) / n
+
+    bare = cost()
+    health.MONITOR.install_policy(DispatchPacer())   # armed, rate=None
+    armed = cost()
+    health.MONITOR.uninstall_policy()
+    assert bare < 200e-6 and armed < 200e-6
+    assert armed < bare + 100e-6
+
+
+# ------------------------------------------- antagonist drill (fakes)
+def test_antagonist_pacing_restores_victim_queue_wait():
+    """The enforcement claim at its smallest: a noisy worker saturates
+    a shared chip (one lock = one chip's serialized dispatch stream);
+    pacing the noisy worker to a sliver of the chip drops the victim's
+    lock-acquisition wait.  Work-proportional costs like the bench;
+    generous margins (this box is noisy)."""
+    chip = threading.Lock()
+    halt = threading.Event()
+    NOISY_HOLD = 0.02
+
+    def noisy(pacer):
+        while not halt.is_set():
+            pacer.acquire("prefill")
+            with chip:
+                time.sleep(NOISY_HOLD)   # a long prefill dispatch
+            pacer.debit("prefill", NOISY_HOLD)
+
+    def victim_wait():
+        waits = []
+        for _ in range(15):
+            t0 = time.monotonic()
+            with chip:
+                waits.append(time.monotonic() - t0)
+                time.sleep(0.001)
+            time.sleep(0.002)
+        waits.sort()
+        return waits[len(waits) // 2]
+
+    results = {}
+    for arm, rate in (("unpoliced", None), ("paced", 0.05 * NOISY_HOLD)):
+        pacer = DispatchPacer(rate=rate)
+        halt.clear()
+        t = threading.Thread(target=noisy, args=(pacer,))
+        t.start()
+        time.sleep(0.05)                 # let the noisy loop saturate
+        try:
+            results[arm] = victim_wait()
+        finally:
+            halt.set()
+            t.join()
+    # unpoliced: the victim's median wait is about one noisy hold;
+    # paced to 5% duty, most acquisitions find the chip free
+    assert results["paced"] < results["unpoliced"]
+    assert results["paced"] < NOISY_HOLD / 4
+
+
+# ---------------------------------------------- daemon loop over HTTP
+def _post_usage(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/usage",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_daemon_verdict_loop_counts_per_tenant():
+    srv = StatusServer(0, policy="enforce").start()
+    try:
+        ok = _post_usage(srv.port, {"pod": "victim-a",
+                                    "device_time_s": 1.0,
+                                    "hbm_fraction": 0.3,
+                                    "occupancy": 0.4, "queued": 1})
+        assert ok["policy"] == "ok" and ok["mode"] == "enforce"
+        ref = _post_usage(srv.port, {"pod": "noisy-a",
+                                     "device_time_s": 9.0,
+                                     "hbm_fraction": 0.3})
+        assert ref["policy"] == "refuse"
+        # into the pace band: share 1.15x of effective entitlement
+        pace = _post_usage(srv.port, {"pod": "noisy-a",
+                                      "device_time_s": 1.15,
+                                      "hbm_fraction": 0.3})
+        rate = parse_pace_rate(pace["policy"])
+        assert rate is not None and 0 < rate < 0.5
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert ('tpushare_tenant_admission_refused_total'
+                '{reason="over_share",tenant="noisy-a"} 1') in text
+        assert 'tpushare_tenant_paced_total{tenant="noisy-a"} 1' in text
+        assert 'tpushare_tenant_policy_info{policy="enforce"} 1' in text
+        assert 'tpushare_tenant_effective_entitlement_share' in text
+    finally:
+        srv.stop()
+
+
+def test_daemon_observe_counts_but_client_ignores():
+    srv = StatusServer(0, policy="observe").start()
+    try:
+        _post_usage(srv.port, {"pod": "quiet-b", "device_time_s": 1.0,
+                               "hbm_fraction": 0.3, "occupancy": 0.4})
+        resp = _post_usage(srv.port, {"pod": "noisy-b",
+                                      "device_time_s": 9.0,
+                                      "hbm_fraction": 0.3})
+        assert resp["policy"] == "refuse" and resp["mode"] == "observe"
+        c = PolicyClient()
+        assert c.apply(resp) is None     # observe: measured, not acted
+        assert c.refusal_retry_after() == 0.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert 'tpushare_tenant_policy_info{policy="observe"} 1' in text
+    finally:
+        srv.stop()
+
+
+def test_daemon_off_mode_always_answers_ok():
+    srv = StatusServer(0).start()        # policy defaults off
+    try:
+        resp = _post_usage(srv.port, {"pod": "noisy",
+                                      "device_time_s": 9.0,
+                                      "hbm_fraction": 0.1})
+        assert resp["policy"] == "ok" and resp["mode"] == "off"
+    finally:
+        srv.stop()
+
+
+def test_status_server_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        StatusServer(0, policy="nuke")
+
+
+# ------------------------------- inspect --tenants enforcement columns
+def test_inspect_tenants_view_carries_enforcement_state():
+    from tpushare import telemetry
+    from tpushare.inspect.metricsview import (render_tenants_table,
+                                              summarize_tenants)
+    srv = StatusServer(0, policy="enforce").start()
+    try:
+        _post_usage(srv.port, {"pod": "victim-c", "device_time_s": 1.0,
+                               "hbm_fraction": 0.3, "occupancy": 0.4,
+                               "queued": 1})
+        _post_usage(srv.port, {"pod": "noisy-c", "device_time_s": 9.0,
+                               "hbm_fraction": 0.3})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10) as r:
+            parsed = telemetry.parse_text(r.read().decode())
+    finally:
+        srv.stop()
+    summary = summarize_tenants(parsed)
+    assert summary["policy"] == "enforce"
+    noisy = summary["tenants"]["noisy-c"]
+    assert noisy["refused"] == 1
+    assert noisy["effective_entitlement"] == pytest.approx(0.5)
+    table = render_tenants_table([("node-a", "1.2.3.4", summary, None)])
+    head = table.splitlines()[1]
+    for col in ("POLICY", "PACED", "REFUSED"):
+        assert col in head
+    assert "enforce" in table
+
+
+# --------------------------- LLM server refusal + re-submission (429)
+def test_llm_server_refusal_is_graceful_and_resubmittable():
+    """A refuse verdict answers 429 + Retry-After; the SAME request
+    re-submitted after the window serves the SAME stream (the
+    idempotent-seed contract the router's re-dispatch already relies
+    on) — refusal never corrupts, never crashes."""
+    import jax
+
+    from tpushare.serving import metrics as serving_metrics
+    from tpushare.serving.llm import LLMServer
+    from tpushare.models import transformer
+
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    client = PolicyClient()
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1", n_slots=2,
+                    policy_client=client).start()
+    body = {"tokens": [[1, 2, 3]], "max_new_tokens": 4, "seed": 7,
+            "temperature": 0.9}
+
+    def gen():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, json.loads(r.read()), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    try:
+        refusals0 = serving_metrics.POLICY_REFUSALS.value()
+        code, payload, _ = gen()
+        assert code == 200
+        reference = payload["tokens"]
+        client.apply({"policy": "refuse", "mode": "enforce"})
+        code, payload, headers = gen()
+        assert code == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert "policy" in payload["Error"]
+        assert serving_metrics.POLICY_REFUSALS.value() == refusals0 + 1
+        client.apply({"policy": "ok", "mode": "enforce"})
+        code, payload, _ = gen()
+        assert code == 200
+        assert payload["tokens"] == reference   # same seed, same stream
+        # DRAINING beats the policy refusal: the router's eviction
+        # contract string-matches the 503 draining body, and a 429
+        # would read as an application answer instead of "serve it
+        # elsewhere"
+        client.apply({"policy": "refuse", "mode": "enforce"})
+        srv._drain({})
+        code, payload, _ = gen()
+        assert code == 503 and "draining" in payload["Error"]
+        srv._drain({"undrain": True})
+        code, payload, _ = gen()
+        assert code == 429
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------- antagonist bench smoke
+def test_tenant_isolation_bench_smoke():
+    """bench_all.tenant_isolation_bench at tiny sizes: the three arms
+    run, every stream completes, and the enforcement machinery
+    demonstrably engaged (verdicts issued / admissions refused).  The
+    BENCH_r14 ratios live in the sweep — this pins that the harness
+    itself keeps working."""
+    import jax
+
+    from bench_all import tenant_isolation_bench
+    from tpushare.models import transformer
+
+    cfg = transformer.ModelConfig(vocab=64, d_model=32, n_layers=1,
+                                  n_heads=2, n_kv_heads=2, d_ff=64,
+                                  max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(3), cfg)
+    ti = tenant_isolation_bench(
+        params, cfg, slots=2, noisy_prompt_len=40, noisy_gen=2,
+        victim_prompt_len=4, victim_gen=6, victim_reqs=6,
+        settle_s=0.4, report_interval_s=0.1, noisy_clients=3,
+        victim_warm_reqs=4, rpc_s=0.001, prefill_token_s=0.0002,
+        decode_step_s=0.001)
+    for arm in ("solo", "off", "enforce"):
+        assert ti[arm]["victim_p99_s"] > 0
+    assert ti["enforce"]["noisy_share_vs_entitlement"] is not None
+    assert ti["daemon_refused"] > 0 or ti["daemon_paced"] > 0
+    # deliberately NO enforce-vs-off latency comparison here: a raw
+    # two-arm timing assert at tiny sizes flakes under this box's
+    # ±5%+ co-tenant noise (CLAUDE.md round-11 rule) — the latency
+    # ratios are the bench's own acceptance checks at its real sizes
+
+
+# ------------------------------------------- service lifecycle + off
+def test_service_installs_and_uninstalls_its_pacer():
+    import jax
+
+    from tpushare.serving.continuous import ContinuousService
+    from tpushare.models import transformer
+
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    pacer = DispatchPacer()              # armed later via set_rate
+    svc = ContinuousService(params, cfg, n_slots=2, policy=pacer).start()
+    try:
+        assert health.MONITOR._policy is pacer
+        out = svc.submit([1, 2, 3], 3).get(timeout=300)
+        assert len(out) == 6
+        assert svc.snapshot()["policy"]["rate"] is None
+    finally:
+        svc.stop()
+    assert health.MONITOR._policy is None
+    # policy=None (the off path) never touches the monitor
+    svc2 = ContinuousService(params, cfg, n_slots=2).start()
+    try:
+        assert health.MONITOR._policy is None
+        assert "policy" not in svc2.snapshot()
+    finally:
+        svc2.stop()

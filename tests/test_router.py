@@ -158,6 +158,98 @@ def test_affinity_routes_shared_prefix_and_saturation_falls_back(duo):
                for e in _get(router.port, "/fleet")["replicas"]) == 2
 
 
+def test_over_share_tenant_steers_to_load_policy():
+    """Tenant-aware steering (round 19): an over-share tenant's
+    requests skip prefix affinity and spread by pure load — counted in
+    ``tpushare_router_steered_total`` and visible in /fleet — while an
+    in-entitlement tenant keeps its affinity hits.  The over-share
+    verdict comes from scraping a REAL daemon exposition
+    (--status-endpoints)."""
+    import json as _json
+
+    from tpushare.plugin.status import StatusServer
+    from tpushare.serving import metrics as serving_metrics
+
+    daemon = StatusServer(0).start()
+
+    def report(pod, device_time_s, busy):
+        body = {"pod": pod, "device_time_s": device_time_s,
+                "hbm_fraction": 0.3}
+        if busy:
+            body.update(occupancy=0.5, queued=1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.port}/usage",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+
+    r0 = FakeReplica("a").start()
+    r1 = FakeReplica("b").start()
+    router = FleetRouter(
+        [("a", r0.address), ("b", r1.address)], port=0,
+        scrape_interval_s=30.0, watch_poll_s=0.02, prefix_block=4,
+        status_endpoints=[f"127.0.0.1:{daemon.port}"]).start()
+    try:
+        # noisy-r way over its entitlement against a BUSY victim (no
+        # donation), victim-r within its own
+        report("victim-r", 1.0, busy=True)
+        report("noisy-r", 9.0, busy=False)
+        router.scrape_once()
+        fleet = _get(router.port, "/fleet")
+        assert fleet["over_share_tenants"] == ["noisy-r"]
+
+        prefix = [1, 2, 3, 4, 5, 6, 7, 8]
+        # register + hit the prefix for the in-entitlement tenant
+        _post(router.port, "/generate",
+              {"tokens": [prefix], "max_new_tokens": 4,
+               "tenant": "victim-r"})
+        _post(router.port, "/generate",
+              {"tokens": [prefix + [9]], "max_new_tokens": 4,
+               "tenant": "victim-r"})
+        hits0 = sum(e["affinity_hits"]
+                    for e in _get(router.port, "/fleet")["replicas"])
+        assert hits0 == 1                 # affinity intact for victim-r
+        steered0 = serving_metrics.ROUTER_STEERED.value()
+        # the over-share tenant's identical prompt is STEERED: no
+        # affinity hit, counted, still served
+        out = _post(router.port, "/generate",
+                    {"tokens": [prefix + [10]], "max_new_tokens": 4,
+                     "tenant": "noisy-r"})
+        assert out["tokens"][0] == expected_tokens(prefix + [10], 4)
+        assert serving_metrics.ROUTER_STEERED.value() == steered0 + 1
+        assert sum(e["affinity_hits"] for e in
+                   _get(router.port, "/fleet")["replicas"]) == hits0
+    finally:
+        router.stop()
+        r0.stop()
+        r1.stop()
+        daemon.stop()
+
+
+def test_router_relays_policy_429_retry_after(duo):
+    """A replica's tenant-policy 429 is an application answer (< 500:
+    no re-dispatch — every same-tenant replica would refuse too), and
+    its Retry-After header must survive the proxy hop: stripping it
+    would defeat the bounded backoff the 429 exists to communicate."""
+    router, r0, r1 = duo
+    router.scrape_once()
+    for r in (r0, r1):
+        r.generate_error = (429, {"Error": "admission refused by "
+                                           "tenant policy"},
+                            {"Retry-After": "5"})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/generate",
+        data=json.dumps({"tokens": [[1, 2, 3]],
+                         "max_new_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc.value.code == 429
+    assert exc.value.headers.get("Retry-After") == "5"
+    assert "policy" in json.loads(exc.value.read())["Error"]
+
+
 def test_wedged_midstream_evicted_resubmitted_and_recovers(duo):
     """THE eviction drill (ISSUE-10 acceptance): a replica wedges with
     a request in flight — the router's health loop drains it from

@@ -29,12 +29,15 @@ Four checks:
   outside the loop closure must name a declared read-only method
   (validation, capability, economics); everything else (ticks,
   admission, session export) is loop-only.
-* **lock discipline** (telemetry) — mutations of attributes declared in
-  a module's ``_LOCK_GUARDED`` manifest must sit inside
-  ``with self._lock:``; methods whose name ends in ``_locked`` are the
-  callers-hold-the-lock convention and are exempt, as is ``__init__``.
-  This extends the round-13 ``telemetry-lock`` tpulint rule (which
-  patrols the OUTSIDE of the telemetry package) to the inside.
+* **lock discipline** — mutations of attributes declared in a module's
+  ``_LOCK_GUARDED`` manifest must sit inside ``with self._lock:``;
+  methods whose name ends in ``_locked`` are the callers-hold-the-lock
+  convention and are exempt, as is ``__init__``.  This extends the
+  round-13 ``telemetry-lock`` tpulint rule (which patrols the OUTSIDE
+  of the telemetry package) to the inside — and, since round 19, to
+  EVERY tpushare module that declares a manifest (the tenant-policy
+  pacer in serving/policy.py shares the pattern: its state is touched
+  by the serving loop, the guard exit, and the usage-report thread).
 
 A fifth, repo-wide check — **service internals** — patrols everything
 under tpushare/ EXCEPT serving/continuous.py for attribute access to
@@ -70,8 +73,6 @@ MUTATOR_METHODS = frozenset({
 
 #: the serving module that declares the thread manifest
 SERVICE_MODULE = "tpushare/serving/continuous.py"
-#: sub-tree the lock-discipline manifests live in
-TELEMETRY_DIR = "tpushare/telemetry/"
 
 
 def _load_manifest(tree: ast.Module, name: str):
@@ -406,9 +407,11 @@ def protected_names(root: Optional[str] = None) -> Set[str]:
 
 def check_tree(root: Optional[str] = None) -> List[Finding]:
     """The repo run ``python -m tpushare.analysis`` wires in: manifest
-    checks on the serving module, lock discipline across telemetry, and
-    the reach rule across tpushare/ (tests excluded: white-box tests
-    legitimately reach into internals)."""
+    checks on the serving module, lock discipline across EVERY tpushare
+    module declaring a ``_LOCK_GUARDED`` manifest (telemetry, the
+    metrics registry, the tenant-policy pacer), and the reach rule
+    across tpushare/ (tests excluded: white-box tests legitimately
+    reach into internals)."""
     root = root or repo_root()
     out: List[Finding] = []
 
@@ -430,6 +433,7 @@ def check_tree(root: Optional[str] = None) -> List[Finding]:
                 continue
             src = read(rel)
             out.extend(check_reach(rel, src, protected))
-            if rel.startswith(TELEMETRY_DIR):
-                out.extend(check_lock_discipline(rel, src))
+            # manifest-gated: a module without _LOCK_GUARDED yields no
+            # findings, so patrolling the whole package is free
+            out.extend(check_lock_discipline(rel, src))
     return out

@@ -40,6 +40,16 @@ wrap lists FROM it, and :func:`cross_check_live` raises
   (``continuous._JIT_ENTRIES`` / ``register_jit_entries`` in paged.py):
   an unwatched program's cache growth would be invisible to
   ``tpushare_jit_retraces_total``.
+* **pacing-guard** — a tenant-policy pacing ``acquire`` call
+  (``*policy*.acquire(...)`` / ``*pacer*.acquire(...)``,
+  serving/policy.py) in the serving modules must sit inside a
+  ``dispatch_guard`` with-block and NEVER inside a tick hook: the
+  sanctioned pacing site is the guard's own pre-dispatch hook
+  (health.py ``_DispatchGuard.__enter__``), so an in-plane acquire is
+  legal only as guard-interior — an unguarded pacing sleep would stall
+  the loop invisibly to the watchdog, and a hook-interior one would
+  sleep between trace and dispatch of a jitted program.  The policy
+  layer adds ZERO device dispatches; this rule keeps it that way.
 
 Stdlib-only; :func:`audit_pair` takes raw source (the fixture entry),
 :func:`audit_tree` reads the two serving modules, and
@@ -81,6 +91,11 @@ PREFILL_HOOKS = ("_prefill_into", "_prefill_chunk_into")
 #: jitted operand-prep helpers that are NOT device-program dispatches
 #: for counting purposes (host key wrapping rides the next dispatch)
 AUX_JIT = ("_wrap_keys",)
+
+#: receiver-name fragments that identify a tenant-policy pacing object
+#: (serving/policy.py DispatchPacer / PolicyClient) for the
+#: pacing-guard rule
+PACING_NAME_FRAGMENTS = ("policy", "pacer")
 
 #: the serving modules the tree audit reads, by flavor
 DENSE_MODULE = "tpushare/serving/continuous.py"
@@ -167,6 +182,10 @@ class _GuardWalk:
         self.self_calls: List[Tuple[str, int, bool]] = []
         #: [(callee, lineno, in_guard)] for bare-name f(...) calls
         self.fn_calls: List[Tuple[str, int, bool]] = []
+        #: [(lineno, in_guard)] — tenant-policy pacing acquire sites
+        #: (``self._policy.acquire(...)`` / ``PACER.acquire(...)``):
+        #: legal only guard-interior, never in hooks (pacing-guard)
+        self.pacing_calls: List[Tuple[int, bool]] = []
         #: [(lineno, in_guard, names, kind)] — host-fetch sites:
         #: ``np.asarray``/``jax.device_get`` ("array"), ``x.item()``
         #: ("array", names include the receiver), and bare
@@ -178,6 +197,23 @@ class _GuardWalk:
         self.fn_node = fn
         for stmt in fn.body:
             self._visit(stmt, in_guard=False)
+
+    @staticmethod
+    def _is_pacing(recv: ast.AST) -> bool:
+        """Does the receiver chain of an ``.acquire`` call name a
+        tenant-policy object (a name/attribute containing 'policy' or
+        'pacer')?  Lock ``.acquire()`` spellings never match — the
+        serving plane holds locks as ``with self._lock:``."""
+        for sub in ast.walk(recv):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name and any(f in name.lower()
+                            for f in PACING_NAME_FRAGMENTS):
+                return True
+        return False
 
     @staticmethod
     def _is_guard_with(node: ast.With) -> bool:
@@ -210,6 +246,8 @@ class _GuardWalk:
                         fn.value.id == "self":
                     self.self_calls.append((fn.attr, node.lineno,
                                             in_guard))
+                if fn.attr == "acquire" and self._is_pacing(fn.value):
+                    self.pacing_calls.append((node.lineno, in_guard))
                 if fn.attr in ("asarray", "device_get") and \
                         isinstance(fn.value, ast.Name) and \
                         fn.value.id in ("np", "jax"):
@@ -323,6 +361,13 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                 f"{flavor.name} hook {hook} host-fetches mid-round — "
                 f"hooks return device values; the entry's guarded "
                 f"drain owns the fetch"))
+        for ln, _ in s.pacing_calls:
+            out.append(Finding(
+                "pacing-guard", path_of(hook), ln,
+                f"{flavor.name} hook {hook} calls a tenant-policy "
+                f"pacing acquire — pacing belongs at the dispatch "
+                f"guard, BEFORE the hook's jitted program (the guard's "
+                f"own pre-dispatch hook is the sanctioned site)"))
 
     # -- guard discipline: hook call sites outside hooks ---------------
     for method in flavor.table:
@@ -336,6 +381,15 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                     f"{flavor.name} {method} dispatches hook {n} "
                     f"outside a MONITOR.dispatch_guard with-block — "
                     f"the stall watchdog cannot see it"))
+        for ln, guarded in s.pacing_calls:
+            if not guarded:
+                out.append(Finding(
+                    "pacing-guard", path_of(method), ln,
+                    f"{flavor.name} {method} calls a tenant-policy "
+                    f"pacing acquire outside a MONITOR.dispatch_guard "
+                    f"with-block — an unguarded pacing sleep stalls "
+                    f"the serving loop invisibly to the watchdog; "
+                    f"pacing rides the guard's pre-dispatch hook"))
 
     # -- steady-path dispatch count per entry --------------------------
     for entry, contract in ENTRY_CONTRACT.items():

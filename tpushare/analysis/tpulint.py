@@ -396,14 +396,17 @@ def _resolve_imports(ctx: Context, node: ast.AST):
 
 @rule(
     "router-no-jax",
-    "The fleet router is the front door OUTSIDE every allocation: it "
-    "must stay stdlib-only and importable BEFORE jax (like "
-    "telemetry/health.py).  An ``import jax`` — or an import of a "
-    "jax-heavy tpushare module — in its import graph would dial the "
-    "TPU tunnel / initialize a backend in the routing process, which "
-    "owns no chip and must keep routing through a backend outage.",
-    lambda p: p == "tpushare/serving/router.py",
-    "tpushare/serving/router.py")
+    "The fleet router is the front door OUTSIDE every allocation, and "
+    "the tenant-policy layer is imported by the daemon: both must stay "
+    "stdlib-only and importable BEFORE jax (like telemetry/health.py). "
+    "An ``import jax`` — or an import of a jax-heavy tpushare module — "
+    "in their import graphs would dial the TPU tunnel / initialize a "
+    "backend in a process that owns no chip (the router must keep "
+    "routing, and the daemon must keep issuing verdicts, through a "
+    "backend outage).",
+    lambda p: p in ("tpushare/serving/router.py",
+                    "tpushare/serving/policy.py"),
+    "tpushare/serving/{router,policy}.py")
 def _router_no_jax(ctx: Context):
     for node in ast.walk(ctx.tree):
         if not isinstance(node, (ast.Import, ast.ImportFrom)):
@@ -412,9 +415,9 @@ def _router_no_jax(ctx: Context):
             if any(mod == p or mod.startswith(p + ".")
                    for p in _JAX_HEAVY_PREFIXES):
                 yield node.lineno, (
-                    f"router imports jax-heavy module {mod!r} — the "
-                    f"front door must stay stdlib-only, pre-jax "
-                    f"importable (`{ctx.quote(node.lineno)}`)")
+                    f"pre-jax module imports jax-heavy module {mod!r} "
+                    f"— the router/policy layer must stay stdlib-only, "
+                    f"pre-jax importable (`{ctx.quote(node.lineno)}`)")
                 break
 
 
@@ -653,7 +656,7 @@ are documented point-in-time snapshots); mutations are confined.
 | `queue-crossing` | every touch of a lock-crossed command queue (`_waiting`, the migration commands, `_cancels`) sits inside `with self._lock:` — the queues are the ONLY sanctioned handler-to-loop crossing |
 | `batcher-ownership` | a batcher method CALL outside the loop closure must name a declared read-only method (validation/capability/economics); ticks, admission, and session export belong to the loop |
 | `service-internals` | nothing under tpushare/ outside serving/continuous.py touches the confined names (`._batcher`, `._sinks`, ...) — handlers use the public API (`can_migrate()`/`storage_info()`/`mesh`/`snapshot()`) |
-| `lock-discipline` | inside tpushare/telemetry/, mutations of `_LOCK_GUARDED` attributes sit inside `with self._lock:`; `*_locked` methods are the callers-hold-the-lock convention |
+| `lock-discipline` | in EVERY tpushare module declaring a `_LOCK_GUARDED` manifest (telemetry, the registry, the tenant-policy pacer in serving/policy.py), mutations of manifest attributes sit inside `with self._lock:`; `*_locked` methods are the callers-hold-the-lock convention |
 | `manifest-sync` | manifest-declared classes/methods/attributes must exist (a rename updates the manifest or the check fails) |
 """
 
@@ -676,6 +679,7 @@ table.
 | `dispatch-guard` | every hook call site outside a hook sits inside a `MONITOR.dispatch_guard` with-block (the stall watchdog must see every dispatch) |
 | `dispatch-fetch` | `np.asarray` fetches of a hook's results stay inside the guard with-block — the fetch is the true barrier (CLAUDE.md) |
 | `jit-registry` | every `@jax.jit` definition in the serving modules is on the retrace watch list (`_JIT_ENTRIES` / `register_jit_entries`), so `tpushare_jit_retraces_total` sees every program |
+| `pacing-guard` | a tenant-policy pacing `acquire` (`*policy*`/`*pacer*` receivers) in the serving modules sits inside a `dispatch_guard` with-block and never inside a tick hook — the sanctioned pacing site is the guard's own pre-dispatch hook, an unguarded sleep stalls the loop invisibly, and the policy layer adds ZERO device dispatches |
 """
 
 

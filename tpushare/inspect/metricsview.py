@@ -181,6 +181,17 @@ def summarize_tenants(parsed: dict) -> dict:
     fold("tpushare_tenant_device_time_seconds", "device_time_s")
     fold("tpushare_tenant_device_share", "share")
     fold("tpushare_tenant_entitlement_share", "entitlement")
+    # enforcement plane (round 19): the SGDRC-adjusted entitlement the
+    # verdicts pace against, and the daemon's issued-verdict ledger
+    fold("tpushare_tenant_effective_entitlement_share",
+         "effective_entitlement")
+    fold("tpushare_tenant_paced_total", "paced")
+    for labels, value in parsed["samples"].get(
+            "tpushare_tenant_admission_refused_total", ()):
+        name = labels.get("tenant")
+        if name is not None:       # summed over the reason label
+            t = tenants.setdefault(name, {})
+            t["refused"] = t.get("refused", 0.0) + value
     fold("tpushare_hbm_grant_bytes", "hbm_grant_bytes", label="pod")
     fold("tpushare_hbm_peak_bytes", "hbm_peak_bytes", label="pod")
     for labels, _ in parsed["samples"].get("tpushare_hbm_grant_bytes", ()):
@@ -196,6 +207,10 @@ def summarize_tenants(parsed: dict) -> dict:
                                and share > ent * SHARE_OVERSHOOT_SLACK)
     return {
         "fairness_index": _gauge(parsed, "tpushare_tenant_fairness_index"),
+        # the daemon's enforcement mode (off/observe/enforce; None =
+        # a pre-policy daemon's exposition)
+        "policy": _info_label(parsed, "tpushare_tenant_policy_info",
+                              "policy"),
         "tenants": tenants,
     }
 
@@ -354,21 +369,26 @@ def render_tenants_table(
     one line per (node, tenant) with device-time share vs entitlement
     and the flag column (``OVER`` = share past entitlement+slack: the
     measured form of the round-4 "HBM caps are advisory" finding), plus
-    the node's Jain fairness index.  Nodes without reports render a
-    placeholder row (the daemon is up but no tenant reported), dead
-    nodes a DOWN row."""
+    the node's Jain fairness index and the enforcement state (round
+    19): the daemon's POLICY mode and the per-tenant PACED/REFUSED
+    verdict counts, with the ENTITLEMENT cell growing the
+    SGDRC-adjusted effective value when slack donation changed it.
+    Nodes without reports render a placeholder row (the daemon is up
+    but no tenant reported), dead nodes a DOWN row."""
     table = [["NAME", "TENANT", "DEVICE TIME(s)", "SHARE", "ENTITLEMENT",
-              "HBM PEAK/GRANT", "FAIRNESS", "FLAG"]]
+              "HBM PEAK/GRANT", "FAIRNESS", "POLICY", "PACED",
+              "REFUSED", "FLAG"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, "-", "DOWN", err or "unreachable",
-                          "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-"])
             continue
         fairness = _fmt(summary.get("fairness_index"), digits=3)
+        policy = summary.get("policy") or "-"
         tenants = summary["tenants"]
         if not tenants:
             table.append([name, "-", "-", "-", "-", "-", fairness,
-                          "no reports"])
+                          policy, "-", "-", "no reports"])
             continue
         for tenant in sorted(tenants):
             t = tenants[tenant]
@@ -376,6 +396,14 @@ def render_tenants_table(
             if t.get("hbm_peak_bytes") is not None:
                 hbm = (f"{_fmt_bytes(t['hbm_peak_bytes'])}/"
                        f"{_fmt_bytes(t.get('hbm_grant_bytes'))}")
+            # entitlement cell grows the SGDRC-adjusted effective
+            # value when donation changed it — the denominator the
+            # policy verdicts actually pace against
+            ent = _fmt(t.get("entitlement"), 100.0, "%", 0)
+            eff = t.get("effective_entitlement")
+            if eff is not None and t.get("entitlement") is not None \
+                    and abs(eff - t["entitlement"]) > 1e-9:
+                ent += f" (eff {eff * 100:.0f}%)"
             flags = []
             if t.get("over_share"):
                 flags.append("OVER")
@@ -385,8 +413,10 @@ def render_tenants_table(
                 name, tenant,
                 _fmt(t.get("device_time_s")),
                 _fmt(t.get("share"), 100.0, "%", 0),
-                _fmt(t.get("entitlement"), 100.0, "%", 0),
-                hbm, fairness,
+                ent,
+                hbm, fairness, policy,
+                _fmt(t.get("paced"), digits=0),
+                _fmt(t.get("refused"), digits=0),
                 "+".join(flags) if flags else "ok",
             ])
     return "Tenant accounting:\n" + _table(table)

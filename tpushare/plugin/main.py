@@ -75,6 +75,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "status surface (/usage ingest, /debug/*)")
     ap.add_argument("--metrics-addr", default="0.0.0.0",
                     help="bind address for the scrape-only listener")
+    ap.add_argument("--tenant-policy",
+                    choices=("off", "observe", "enforce"), default="off",
+                    help="tenant-isolation policy mode: each /usage "
+                         "ingest answers with a verdict (ok | "
+                         "pace:<rate> | refuse) from the tenant's "
+                         "device-time share vs its slack-reallocated "
+                         "entitlement — 'off' always answers ok, "
+                         "'observe' computes and counts verdicts "
+                         "without tenants acting on them, 'enforce' "
+                         "closes the loop (tenants pace dispatches and "
+                         "429 admissions); requires --status-port")
     ap.add_argument("--dev-glob", default=os.environ.get(
                         "TPUSHARE_DEV_GLOB", "/dev/accel*"),
                     help="device-node glob for metadata discovery (env "
@@ -197,10 +208,13 @@ def main(argv=None) -> int:
                                   addr=args.status_addr,
                                   on_usage=on_usage,
                                   metrics_port=args.metrics_port or None,
-                                  metrics_addr=args.metrics_addr).start()
-        log.info("status endpoint on :%d%s", status_srv.port,
+                                  metrics_addr=args.metrics_addr,
+                                  policy=args.tenant_policy).start()
+        log.info("status endpoint on :%d%s (tenant policy: %s)",
+                 status_srv.port,
                  (f" (scrape-only metrics on :{status_srv.metrics_port})"
-                  if status_srv.metrics_port else ""))
+                  if status_srv.metrics_port else ""),
+                 args.tenant_policy)
     try:
         mgr.run()
     finally:

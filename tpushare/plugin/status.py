@@ -22,6 +22,9 @@ import threading
 import time
 
 from .. import telemetry
+# the tenant-policy math (stdlib, like this whole plane): verdicts,
+# SGDRC slack reallocation, and the ONE overshoot-slack constant
+from ..serving import policy as tenant_policy
 from ..telemetry.events import RECORDER, debug_events_route
 from ..telemetry.health import healthz_route
 from ..utils import stackdump
@@ -97,8 +100,49 @@ _TENANT_FAIRNESS = telemetry.gauge(
     "its entitlement; 1/n = one tenant has the whole chip)")
 
 #: a tenant is flagged over-share when actual share > entitlement share
-#: times this slack (10% grace keeps jitter from counting as overshoot)
-SHARE_OVERSHOOT_SLACK = 1.1
+#: times this slack (10% grace keeps jitter from counting as overshoot).
+#: ONE definition, now in the policy module (the enforcement thresholds
+#: sit against it there); re-exported here for the existing consumers
+#: (inspect.metricsview keys its OVER column on this name)
+SHARE_OVERSHOOT_SLACK = tenant_policy.SHARE_OVERSHOOT_SLACK
+
+# -- tenant-policy enforcement plane (round 19) ----------------------------
+# The daemon is the only process that sees EVERY tenant's usage, so the
+# policy verdict is computed here, at /usage ingest, and pushed back to
+# the reporting tenant in the response — the tenant's PolicyClient
+# paces/refuses locally.  These series are the daemon-side ledger of
+# what it told whom (the workload-side twins in serving/metrics.py
+# count what each tenant actually did).
+_TENANT_PACED = telemetry.counter(
+    "tpushare_tenant_paced_total",
+    "pace verdicts issued to the tenant through the /usage response "
+    "(device-time share past the pace threshold of its effective, "
+    "slack-reallocated entitlement); counted in observe AND enforce "
+    "modes — observe shows what enforcement WOULD do",
+    labels=("tenant",))
+_TENANT_REFUSED = telemetry.counter(
+    "tpushare_tenant_admission_refused_total",
+    "refuse verdicts issued to the tenant through the /usage response, "
+    "by reason (over_share = device-time share so far past the "
+    "effective entitlement that pacing has not contained it).  "
+    "Reasons enumerate serving.policy.POLICY_REFUSAL_REASONS "
+    "(enum-linted); counted in observe AND enforce modes",
+    labels=("tenant", "reason"))
+_POLICY_INFO = telemetry.gauge(
+    "tpushare_tenant_policy_info",
+    "The daemon's tenant-policy mode (constant 1; the mode rides the "
+    "policy label: off = verdicts always ok, observe = verdicts "
+    "computed and counted but tenants do not act, enforce = tenants "
+    "pace/refuse on them; Prometheus info idiom)",
+    labels=("policy",))
+_TENANT_EFF_ENTITLEMENT = telemetry.gauge(
+    "tpushare_tenant_effective_entitlement_share",
+    "Per-tenant EFFECTIVE entitlement after SGDRC-style slack "
+    "reallocation: idle under-users' headroom granted to the "
+    "over-users in proportion to their entitlements (equals the raw "
+    "entitlement share when nothing is donated) — the denominator the "
+    "policy verdicts pace against",
+    labels=("tenant",))
 
 
 def aggregate_tenants(reports) -> dict:
@@ -137,6 +181,10 @@ def aggregate_tenants(reports) -> dict:
             "generated_tokens": r.get("generated_tokens"),
             "stalls": r.get("stalls"),
             "health_state": r.get("health_state"),
+            # demand signals (round 19): what the policy layer's slack
+            # reallocation keys on — see serving.policy.tenant_is_busy
+            "occupancy": r.get("occupancy"),
+            "queued": r.get("queued"),
         }
         if share is not None and ent:
             xs.append(share / ent)
@@ -177,7 +225,18 @@ class StatusServer:
 
     def __init__(self, port: int, plugin_ref=None, addr: str = "127.0.0.1",
                  on_usage=None, metrics_port: int = None,
-                 metrics_addr: str = "0.0.0.0"):
+                 metrics_addr: str = "0.0.0.0", policy: str = "off"):
+        if policy not in tenant_policy.POLICY_MODES:
+            raise ValueError(f"policy must be one of "
+                             f"{tenant_policy.POLICY_MODES}, got "
+                             f"{policy!r}")
+        # tenant-policy mode (--tenant-policy): each /usage ingest
+        # computes the reporting tenant's verdict from the aggregate
+        # share-vs-effective-entitlement view and answers with it —
+        # "off" answers ok always (byte-identical tenants), "observe"
+        # computes + counts without tenants acting (mode gates the
+        # client), "enforce" closes the loop
+        self.policy_mode = policy
         self.plugin_ref = plugin_ref   # callable returning current plugin
         # latest usage report per tenant pod: the workload runtime
         # (tpushare.runtime.contract.report_usage) POSTs observed HBM
@@ -260,6 +319,9 @@ class StatusServer:
                "health_state": (str(body["health_state"])[:32]
                                 if body.get("health_state") is not None
                                 else None),
+               # demand signals (round 19): same coerce-or-drop posture
+               "occupancy": _flt("occupancy"),
+               "queued": _num("queued"),
                "ts": time.time()}
         with _LOCK:
             self.usage_reports[rec["pod"]] = rec
@@ -282,6 +344,25 @@ class StatusServer:
             RECORDER.record("share_overshoot", pod=rec["pod"],
                             share=round(me["share"], 4),
                             entitlement=round(me["entitlement"], 4))
+        # tenant-policy verdict for THIS tenant, pushed back in the
+        # response: the round-11 observation plane becomes an
+        # enforcement input (pacing before refusal — the ladder lives
+        # in compute_verdicts; the tenant's PolicyClient acts on it
+        # only when mode == "enforce")
+        verdicts = tenant_policy.compute_verdicts(agg["tenants"],
+                                                  self.policy_mode)
+        mine = verdicts.get(rec["pod"]) or {}
+        verdict = mine.get("verdict", "ok")
+        if verdict.startswith("pace:"):
+            _TENANT_PACED.inc(tenant=rec["pod"])
+            RECORDER.record("policy_pace", pod=rec["pod"],
+                            verdict=verdict,
+                            ratio=round(mine["ratio"], 4))
+        elif verdict == "refuse":
+            _TENANT_REFUSED.inc(tenant=rec["pod"],
+                                reason=mine.get("reason") or "over_share")
+            RECORDER.record("policy_refuse", pod=rec["pod"],
+                            ratio=round(mine["ratio"], 4))
         if self.on_usage is not None:
             try:
                 self.on_usage(reports)
@@ -289,7 +370,8 @@ class StatusServer:
                 import logging
                 logging.getLogger("tpushare.status").exception(
                     "on_usage hook failed (non-fatal)")
-        return 200, {"ok": True}
+        return 200, {"ok": True, "policy": verdict,
+                     "mode": self.policy_mode}
 
     def _evict_locked(self) -> None:
         """Drop expired / excess usage reports (callers hold _LOCK)."""
@@ -352,15 +434,23 @@ class StatusServer:
         _TENANT_SHARE.clear()
         _TENANT_ENTITLEMENT.clear()
         _TENANT_FAIRNESS.clear()
+        _TENANT_EFF_ENTITLEMENT.clear()
         agg = aggregate_tenants(reports)
+        eff = tenant_policy.effective_entitlements(agg["tenants"])
         for pod, t in agg["tenants"].items():
             _TENANT_DEVICE_TIME.set(t["device_time_s"], tenant=pod)
             if t["share"] is not None:
                 _TENANT_SHARE.set(t["share"], tenant=pod)
             if t["entitlement"] is not None:
                 _TENANT_ENTITLEMENT.set(t["entitlement"], tenant=pod)
+            if eff.get(pod) is not None:
+                _TENANT_EFF_ENTITLEMENT.set(eff[pod], tenant=pod)
         if agg["fairness_index"] is not None:
             _TENANT_FAIRNESS.set(agg["fairness_index"])
+        # policy-mode info gauge (one-hot on the policy label): what
+        # the POLICY column in `inspect --tenants` renders
+        _POLICY_INFO.clear()
+        _POLICY_INFO.set(1, policy=self.policy_mode)
         return telemetry.REGISTRY.render()
 
     def start(self) -> "StatusServer":

@@ -212,6 +212,8 @@ def serving_snapshot() -> dict:
     from ..telemetry import registry as _registry
     qps_g = _registry.REGISTRY.find("tpushare_engine_qps")
     tok_c = _registry.REGISTRY.find("tpushare_generated_tokens_total")
+    occ_g = _registry.REGISTRY.find("tpushare_batch_occupancy")
+    qd_g = _registry.REGISTRY.find("tpushare_request_queue_depth")
     qps = qps_g.value() if qps_g is not None else None
     tokens = tok_c.value() if tok_c is not None else 0
     return {
@@ -222,6 +224,14 @@ def serving_snapshot() -> dict:
         "generated_tokens": int(tokens),
         "stalls": int(_health.DISPATCH_STALLS.value()),
         "health_state": _health.MONITOR.state,
+        # the DEMAND signals the daemon's slack reallocation reads
+        # (serving/policy.py tenant_is_busy): a tenant with active
+        # slots or queued admissions under-uses involuntarily and
+        # donates no entitlement headroom
+        "occupancy": occ_g.value() if occ_g is not None else None,
+        "queued": (int(qd_g.value())
+                   if qd_g is not None and qd_g.value() is not None
+                   else None),
     }
 
 
@@ -241,7 +251,12 @@ def report_usage(device=None, env: Optional[dict] = None,
     index (``kubectl inspect tpushare --tenants``).  Address comes from
     the injected ``TPUSHARE_STATUS_PORT`` (+ optional ``_HOST``, default
     loopback — the daemon runs hostNetwork).  Best-effort: returns
-    False, never raises, when unallocated or the daemon is unreachable.
+    False, never raises, when unallocated or the daemon is unreachable;
+    on success returns the daemon's parsed response body — which now
+    carries the tenant-policy verdict (``{"policy": "ok|pace:<rate>|
+    refuse", "mode": ...}``) the workload feeds to
+    ``serving.policy.PolicyClient.apply`` to close the enforcement
+    loop.
     """
     import json as _json
     import urllib.request
@@ -304,7 +319,17 @@ def report_usage(device=None, env: Optional[dict] = None,
             data=_json.dumps(body).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status == 200
+            if r.status != 200:
+                return False
+            # the daemon's answer now carries the tenant-policy
+            # verdict ({"policy": "ok|pace:<rate>|refuse", "mode":
+            # ...}); return the parsed body (truthy, so existing
+            # boolean callers keep working) for PolicyClient.apply
+            try:
+                resp = _json.loads(r.read() or b"{}")
+            except ValueError:
+                resp = None
+            return resp if isinstance(resp, dict) and resp else True
     except Exception:
         log.debug("usage report failed (daemon unreachable?)",
                   exc_info=True)

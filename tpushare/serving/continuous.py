@@ -1750,7 +1750,8 @@ _THREAD_MANIFEST = {
     "join_synced": ("stop",),
     "loop_confined": ("_sinks", "_stream_sinks", "_req_meta",
                       "_handoff_rids", "_migrated_sinks",
-                      "_resident_since", "_spill", "_batcher"),
+                      "_resident_since", "_spill", "_batcher",
+                      "_policy_pacer"),
     "lock_crossed": ("_waiting", "_mig_cmds", "_cancels"),
     "batcher_attr": "_batcher",
     "batcher_readonly": ("validate_request", "validate_sampling",
@@ -1781,12 +1782,22 @@ class ContinuousService:
                  prefix_cache: bool = False,
                  mixed_step: bool = True,
                  prefill_budget: Optional[int] = None,
-                 spill_bytes: Optional[int] = None):
+                 spill_bytes: Optional[int] = None,
+                 policy=None):
         import os as _os
         import queue as _q
         import threading
 
         self._q = _q
+        # Tenant-policy pacer (serving/policy.py DispatchPacer, or
+        # None): installed on the process-global health monitor for
+        # the service's lifetime, so every dispatch guard the loop
+        # enters paces/debits against this tenant's device-time
+        # bucket.  The pacing state itself lives in the pacer (its own
+        # _LOCK_GUARDED manifest); the service only owns the install/
+        # uninstall lifecycle — start() arms, stop() disarms exactly
+        # what it armed.  None = byte-identical pre-policy serving.
+        self._policy_pacer = policy
         # MIXED rounds (default): while anything is mid-prefill, each
         # loop iteration is ONE device dispatch — the pending chunks of
         # up to prefill_budget//prefill_chunk slots coalesced into a
@@ -1936,6 +1947,8 @@ class ContinuousService:
                                         name="tpushare-continuous")
 
     def start(self) -> "ContinuousService":
+        if self._policy_pacer is not None:
+            health.MONITOR.install_policy(self._policy_pacer)
         self._thread.start()
         return self
 
@@ -1944,6 +1957,10 @@ class ContinuousService:
         self._work.set()
         if self._thread.ident is not None:   # never-started is a no-op
             self._thread.join(timeout=10)
+        if self._policy_pacer is not None:
+            # disarm exactly our pacer (idempotent against a successor
+            # service having installed its own)
+            health.MONITOR.uninstall_policy(self._policy_pacer)
         # Sentinel BOTH queued and in-flight requests — a stranded sink
         # would block its client until its own timeout. put_nowait only:
         # blocking on a full maxsize-1 sink could deadlock stop().
@@ -2472,6 +2489,8 @@ class ContinuousService:
             st["tokens_per_round"] = (round(st["tokens"] / st["rounds"], 3)
                                       if st["rounds"] else None)
             snap["speculation"] = st
+        if self._policy_pacer is not None:
+            snap["policy"] = self._policy_pacer.snapshot()
         return snap
 
     def _spec_route(self) -> bool:
@@ -2630,11 +2649,18 @@ class ContinuousService:
                                           "raised; continuing")
                     entry[0].put(("done", out))
             with self._lock:
+                queued = len(self._waiting)
                 if (not active and not self._batcher.prefilling
-                        and not self._waiting and not self._sinks
+                        and not queued and not self._sinks
                         and not self._stream_sinks
                         and not self._mig_cmds
                         and not self._migrated_sinks
                         and not (self._spill is not None
                                  and len(self._spill))):
                     self._work.clear()
+            # backpressure visibility: requests submitted but not yet
+            # admitted to a slot — the DEMAND signal the tenant-policy
+            # slack reallocation reads (a tenant with queued work is
+            # under-using involuntarily and donates nothing; see
+            # serving/policy.py effective_entitlements)
+            metrics.REQUEST_QUEUE_DEPTH.set(queued)

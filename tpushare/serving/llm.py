@@ -120,7 +120,8 @@ class LLMServer:
                  prefix_cache: bool = False,
                  prefill_budget: int = 0,
                  mixed_step: bool = True,
-                 spill_bytes: int = 0):
+                 spill_bytes: int = 0,
+                 policy_client=None):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
@@ -149,6 +150,13 @@ class LLMServer:
         # graceful half of a rolling restart, and what the fleet
         # router's health eviction calls before dropping a replica.
         self._draining = threading.Event()
+        # Tenant-policy enforcement (serving/policy.py PolicyClient, or
+        # None): the admission gate answers 429 + Retry-After while the
+        # client's refusal window (a daemon "refuse" verdict, bounded
+        # backoff) is open, and the client's pacer rides the dispatch
+        # guards — installed via the ContinuousService below, or
+        # directly on the health monitor in per-request mode.
+        self._policy_client = policy_client
         self._inflight = 0                  # requests inside a handler
         # its OWN lock: _gen_lock is held across whole device decodes
         # (direct mode holds it for the full fused generation), and
@@ -195,7 +203,9 @@ class LLMServer:
                 prefix_cache=prefix_cache,
                 mixed_step=mixed_step,
                 prefill_budget=prefill_budget or None,
-                spill_bytes=spill_bytes or None).start()
+                spill_bytes=spill_bytes or None,
+                policy=(policy_client.pacer
+                        if policy_client is not None else None)).start()
             # Operator-visible kernel demotion (round 17 satellite): a
             # pallas config whose pool fails a viability gate (e.g. a
             # page_size=16 int8 pool's 32-row sublane tile) serves the
@@ -211,6 +221,13 @@ class LLMServer:
                     "tpushare_attn_kernel_fallback_total{reason=%r} "
                     "and the ATTN column in `kubectl inspect tpushare "
                     "--metrics`", reason, reason)
+        if policy_client is not None and self._service is None:
+            # per-request mode has no service lifecycle to ride: arm
+            # the dispatch-guard pacer directly (the slot-pool path
+            # installs through ContinuousService.start above; stop()
+            # mirrors the disarm)
+            from ..telemetry.health import MONITOR
+            MONITOR.install_policy(policy_client.pacer)
         self.requests_served = 0
         self.sequences_served = 0
         self.tokens_generated = 0
@@ -257,10 +274,31 @@ class LLMServer:
             # check-and-increment atomically vs _drain's flag set (same
             # lock): otherwise a request admitted between the check and
             # the increment could be invisible to a drained:true
-            # /healthz and die with the pod
+            # /healthz and die with the pod.  DRAINING wins over the
+            # policy refusal: the router's eviction/re-dispatch
+            # contract string-matches the 503 draining body, and a
+            # 429 here would read as an application answer instead of
+            # "serve it elsewhere".
             if self._draining.is_set():
                 return 503, {"Error": "draining: not admitting new "
                                       "requests"}
+            if self._policy_client is not None:
+                # tenant-policy refusal window (a daemon "refuse"
+                # verdict): 429 + Retry-After, bounded backoff, fully
+                # re-submittable — the request never reaches the
+                # device, so a refused tenant stops costing the chip
+                # anything at all
+                retry_s = self._policy_client.refusal_retry_after()
+                if retry_s > 0:
+                    from . import metrics
+                    metrics.POLICY_REFUSALS.inc()
+                    return (429,
+                            {"Error": "admission refused by tenant "
+                                      "policy (device-time share over "
+                                      "entitlement); retry after the "
+                                      "indicated backoff"},
+                            {"Retry-After":
+                             str(max(1, int(retry_s + 0.5)))})
             self._inflight += 1
         return None
 
@@ -856,6 +894,9 @@ class LLMServer:
         self._http.stop()
         if self._service is not None:
             self._service.stop()
+        elif self._policy_client is not None:
+            from ..telemetry.health import MONITOR
+            MONITOR.uninstall_policy(self._policy_client.pacer)
 
 
 def main(argv=None) -> int:
@@ -952,7 +993,31 @@ def main(argv=None) -> int:
                          "dispatch per prefilling slot plus one fused "
                          "decode dispatch per round (the reference "
                          "interleave)")
+    ap.add_argument("--policy", choices=("auto", "off"), default="auto",
+                    help="tenant-isolation policy: 'auto' (default) "
+                         "honors the daemon's /usage verdicts when "
+                         "allocated under a TPUSHARE_STATUS_PORT "
+                         "daemon running --tenant-policy enforce — "
+                         "pace:<rate> verdicts token-bucket-pace the "
+                         "device dispatches at the dispatch guard, "
+                         "refuse verdicts answer 429 + Retry-After at "
+                         "admission (bounded backoff, re-submittable); "
+                         "'off' ignores verdicts entirely "
+                         "(byte-identical pre-policy serving)")
+    ap.add_argument("--pace-rate", type=float, default=0.0,
+                    help="static self-pacing floor in device-seconds "
+                         "per wall-second (0 = none): pace this "
+                         "tenant's dispatches without any daemon — a "
+                         "courtesy cap for a known-noisy batch tenant; "
+                         "daemon pace verdicts override it while "
+                         "active and an ok verdict restores it")
     args = ap.parse_args(argv)
+    if args.pace_rate and args.policy == "off":
+        # --policy off promises byte-identical pre-policy serving;
+        # silently dropping an explicit self-pacing request would be
+        # the worst of both
+        ap.error("--pace-rate needs the policy machinery; drop "
+                 "--policy off (auto self-paces without any daemon)")
     if args.spill_bytes and not args.page_size:
         ap.error("--spill-bytes requires --slots and --page-size")
     if args.prefill_budget and not args.slots:
@@ -1005,30 +1070,47 @@ def main(argv=None) -> int:
                 "TPUSHARE_PROBE_INTERVAL_S", "60")),
             deadline_s=float(_os.environ.get(
                 "TPUSHARE_PROBE_DEADLINE_S", "180")))
+    # Tenant policy (round 19): with --policy auto the daemon's /usage
+    # verdicts drive a local PolicyClient — its pacer rides every
+    # dispatch guard (installed through the service below) and its
+    # refusal window gates admission with 429 + Retry-After.  A static
+    # --pace-rate arms the same machinery without any daemon.
+    policy_client = None
+    reporting = bool(view.allocated
+                     and _os.environ.get("TPUSHARE_STATUS_PORT"))
+    interval = float(_os.environ.get("TPUSHARE_USAGE_REPORT_S", "30"))
+    if args.policy != "off" and (args.pace_rate > 0 or reporting):
+        from .policy import PolicyClient
+        policy_client = PolicyClient(static_rate=args.pace_rate or None,
+                                     verdict_interval_s=interval)
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp, sp=args.sp,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill,
-                    spill_bytes=args.spill_bytes)
+                    spill_bytes=args.spill_bytes,
+                    policy_client=policy_client)
     # Tenant accounting: when the allocation injected a daemon status
     # port, report this tenant's usage (HBM peak + device-time/goodput/
     # qps/stalls, contract.report_usage) on a low-frequency loop — the
     # feed behind the daemon's per-tenant share-vs-entitlement view and
     # `kubectl inspect tpushare --tenants`.  Best-effort by contract
     # (report_usage never raises); daemon thread dies with the server.
-    if view.allocated and _os.environ.get("TPUSHARE_STATUS_PORT"):
-        interval = float(_os.environ.get("TPUSHARE_USAGE_REPORT_S", "30"))
-
+    # The response carries the tenant-policy verdict; the PolicyClient
+    # (when armed) closes the enforcement loop on each report.
+    if reporting:
         def _report_loop():
             while True:
                 time.sleep(interval)
-                contract.report_usage()
+                resp = contract.report_usage()
+                if policy_client is not None and isinstance(resp, dict):
+                    policy_client.apply(resp)
 
         threading.Thread(target=_report_loop, daemon=True,
                          name="tpushare-usage-report").start()
-        log.info("usage reporting to daemon every %.0fs", interval)
+        log.info("usage reporting to daemon every %.0fs (policy: %s)",
+                 interval, args.policy)
     log.info("llm server: model=%s quant=%s kv=%s tp=%d sp=%d on :%d",
              args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),

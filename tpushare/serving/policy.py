@@ -1,0 +1,375 @@
+"""Tenant-isolation enforcement: pacing, admission verdicts, slack
+reallocation (the ROADMAP-2 loop closed).
+
+Round 4 proved the HBM fraction caps are ADVISORY on this backend
+(COTENANCY_r04: every 0.22-grant tenant reached the full-chip ceiling)
+and round 11 built the measurement substrate — per-tenant device-time
+share vs HBM-fraction entitlement, Jain fairness, overshoot counters —
+but the daemon only *observed* it.  This module is the enforcement
+half, gpu_ext-style: a small pluggable policy layer hooked into choke
+points that already exist, never a new dispatch path.
+
+Three pieces:
+
+* :func:`compute_verdicts` — the daemon-side policy math (pure, unit-
+  tested directly): folds the ``aggregate_tenants`` view into one
+  verdict per tenant, ``ok | pace:<rate> | refuse``, with SGDRC-style
+  slack reallocation — a tenant under-using its entitlement donates
+  the headroom to the over-users (proportionally to their
+  entitlements), and the donation re-tightens the moment the donor's
+  own usage returns.  The pace rate is *self-tightening*
+  (``effective_entitlement / overshoot_ratio`` device-seconds per
+  wall-second): the further over, the slower, so the cumulative share
+  converges back under the pace threshold instead of plateauing at it.
+* :class:`DispatchPacer` — the workload-side token bucket the
+  ``MONITOR.dispatch_guard`` choke point consults: ``acquire(phase)``
+  sleeps the SERVING LOOP before its next dispatch (never a hung
+  worker, never inside a jitted program — the sleep happens before the
+  guard's timer starts, so paced wall time is never attributed as
+  device time), ``debit(phase, device_s)`` charges each dispatch's
+  measured device residency against the bucket.
+* :class:`PolicyClient` — applies the daemon's ``/usage`` response
+  verdict (``contract.report_usage`` returns it) to the local pacer
+  and keeps the admission-refusal window: a ``refuse`` verdict makes
+  the LLM server answer 429 with a bounded-backoff ``Retry-After``
+  (graceful: pacing before refusal, refusal counted and served —
+  never a crash), cleared by the next ``ok``/``pace`` verdict.
+
+Stdlib-only and pre-jax importable, like router.py and
+telemetry/health.py (lint rule ``router-no-jax`` patrols both): the
+policy layer adds ZERO device dispatches — it only spaces and gates
+the ones the serving plane already makes (dispatch_audit Layer 4
+checks any in-plane ``*.acquire`` pacing call rides a dispatch
+guard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from . import metrics
+
+#: the daemon's enforcement modes (``--tenant-policy``): ``off`` issues
+#: only ``ok`` verdicts (byte-identical serving), ``observe`` computes
+#: and counts verdicts without any tenant acting on them (``mode`` in
+#: the /usage response gates the client), ``enforce`` closes the loop
+POLICY_MODES = ("off", "observe", "enforce")
+
+#: reasons ``tpushare_tenant_admission_refused_total`` may carry
+#: (enum-pinned in tests/test_metric_lint.py)
+POLICY_REFUSAL_REASONS = ("over_share",)
+
+#: a tenant is FLAGGED over-share (tpushare_tenant_share_overshoot_total,
+#: the inspect OVER column) past this ratio of its raw entitlement —
+#: the round-11 observation threshold, now defined here so the
+#: enforcement thresholds below sit against it in one place
+#: (plugin/status.py re-exports it for the existing consumers)
+SHARE_OVERSHOOT_SLACK = 1.1
+
+#: enforcement ladder thresholds against the EFFECTIVE (slack-
+#: reallocated) entitlement: pacing engages below the observation
+#: slack on purpose — the controller oscillates around PACE_RATIO, so
+#: it must sit under the 1.1 bound the acceptance criteria (and the
+#: overshoot counter) are stated against
+PACE_RATIO = 1.05
+#: past this ratio pacing has demonstrably not contained the tenant
+#: (or it burst faster than the report loop): refuse admissions until
+#: the share decays back into the pace band
+REFUSE_RATIO = 1.3
+
+#: refusal Retry-After bounds (seconds): exponential backoff per
+#: consecutive refuse verdict, capped — bounded-backoff by contract
+REFUSE_RETRY_AFTER_S = 1.0
+REFUSE_RETRY_AFTER_MAX_S = 8.0
+
+#: one pacing sleep never exceeds this (the loop stays responsive to
+#: rate updates and cancellations; a large deficit paces over several
+#: rounds instead of wedging one)
+MAX_PACE_SLEEP_S = 2.0
+
+#: small credit burst (seconds of device time at the paced rate) so
+#: pacing spaces dispatches instead of oscillating around every one
+PACE_BURST_S = 0.25
+
+_PACE_PREFIX = "pace:"
+
+
+def tenant_is_busy(t: dict) -> bool:
+    """The DEMAND signal slack reallocation keys on: a tenant with
+    queued admissions or active batcher slots has unmet/ongoing work —
+    its under-use is starvation (or pacing), not idleness.  Reports
+    without the serving signals (pure-training tenants, older
+    workloads) read as idle: they volunteer their headroom exactly the
+    way the pre-policy advisory world already let everyone take it."""
+    return bool(t.get("queued") or t.get("occupancy"))
+
+
+def effective_entitlements(tenants: Dict[str, dict]) -> Dict[str, float]:
+    """SGDRC-style slack reallocation over the ``aggregate_tenants``
+    per-tenant view: IDLE tenants using less than their entitlement
+    donate the headroom (``entitlement - share``), and the pool is
+    granted to the over-users proportionally to their entitlements.
+    A donor's effective entitlement stays its own (its unused share is
+    what it donates, not its claim); when the donor's demand returns
+    (:func:`tenant_is_busy` — queued work or active slots), its
+    donation vanishes on the next verdict and the over-users
+    re-tighten.  The busy gate is what separates a genuinely idle
+    co-tenant (whose headroom SHOULD flow — that is the whole point of
+    sharing the chip) from a starved victim, whose involuntary
+    under-use must never fund its antagonist.  No state; the
+    reallocation is recomputed per report."""
+    shares = {pod: t for pod, t in tenants.items()
+              if t.get("share") is not None and t.get("entitlement")}
+    donated = sum(t["entitlement"] - t["share"] for t in shares.values()
+                  if t["share"] < t["entitlement"]
+                  and not tenant_is_busy(t))
+    over_ent = sum(t["entitlement"] for t in shares.values()
+                   if t["share"] > t["entitlement"])
+    out = {}
+    for pod, t in shares.items():
+        eff = t["entitlement"]
+        if donated > 0 and over_ent > 0 and t["share"] > t["entitlement"]:
+            eff += donated * (t["entitlement"] / over_ent)
+        out[pod] = eff
+    return out
+
+
+def compute_verdicts(tenants: Dict[str, dict], mode: str) -> Dict[str, dict]:
+    """Fold the per-tenant accounting view into policy verdicts.
+
+    ``tenants`` is ``aggregate_tenants(...)["tenants"]``.  Returns
+    ``{pod: {"verdict", "ratio", "effective_entitlement", "reason"}}``
+    where verdict is ``"ok"``, ``"pace:<rate>"`` (rate in device-
+    seconds per wall-second) or ``"refuse"``.  ``mode="off"`` issues
+    only ``ok`` (effective entitlements still computed — the gauges
+    render in observe-nothing deployments too).  Pure function."""
+    if mode not in POLICY_MODES:
+        raise ValueError(f"unknown policy mode {mode!r} "
+                         f"(have {POLICY_MODES})")
+    eff = effective_entitlements(tenants)
+    out: Dict[str, dict] = {}
+    for pod, t in tenants.items():
+        e = eff.get(pod)
+        share = t.get("share")
+        ratio = (share / e) if (e and share is not None) else None
+        verdict, reason = "ok", None
+        if mode != "off" and ratio is not None:
+            if ratio > REFUSE_RATIO:
+                verdict, reason = "refuse", "over_share"
+            elif ratio > PACE_RATIO:
+                # self-tightening: rate shrinks with the overshoot, so
+                # the cumulative share decays TOWARD the band instead
+                # of riding its edge
+                verdict = f"{_PACE_PREFIX}{e / ratio:.6f}"
+        out[pod] = {"verdict": verdict, "ratio": ratio,
+                    "effective_entitlement": e, "reason": reason}
+    return out
+
+
+def parse_pace_rate(verdict: str) -> Optional[float]:
+    """The device-seconds-per-wall-second rate of a ``pace:`` verdict,
+    None for anything else (including malformed rates — an unparsable
+    verdict must degrade to un-paced, never crash the tenant)."""
+    if not isinstance(verdict, str) or \
+            not verdict.startswith(_PACE_PREFIX):
+        return None
+    try:
+        rate = float(verdict[len(_PACE_PREFIX):])
+    except ValueError:
+        return None
+    return rate if rate > 0 else None
+
+
+#: Lock-discipline manifest — verified by tpushare.analysis.confinement
+#: (Layer 3 of ``make lint``, same contract as telemetry/health.py):
+#: every mutation of these attributes outside ``__init__`` sits inside
+#: ``with self._lock:``.  The pacer is shared between the serving loop
+#: (acquire on guard enter), the guard exit (debit), and the usage-
+#: report thread (set_rate from verdicts).
+_LOCK_GUARDED = {
+    "DispatchPacer": ("_rate", "_deficit", "_t_mark"),
+    "PolicyClient": ("_refuse_until", "_backoff_s", "_last_verdict"),
+}
+
+
+class DispatchPacer:
+    """Token bucket over DEVICE time: the bucket drains by each
+    dispatch's measured device residency (:meth:`debit` — the guard's
+    own attribution, wall minus the tunnel-RPC constant) and refills at
+    ``rate`` device-seconds per wall second.  :meth:`acquire` sleeps
+    the caller — the serving loop, before its next dispatch — until
+    the deficit clears (bounded per call; a large deficit paces over
+    several rounds).  ``rate=None`` disarms: acquire is one lock-free
+    attribute read, so an installed-but-idle pacer costs nothing on
+    the guard hot path."""
+
+    def __init__(self, rate: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._rate: Optional[float] = rate if rate and rate > 0 else None
+        self._deficit = 0.0          # device-seconds owed
+        self._t_mark = time.monotonic()
+        #: cumulative injected pacing sleep (monotonic counter, read by
+        #: snapshot()/bench; the histogram carries the distribution)
+        self.paced_s = 0.0
+        self.paced_rounds = 0
+
+    # -- configuration (usage-report thread) ---------------------------
+    def set_rate(self, rate: Optional[float]) -> None:
+        """Install/replace/clear the paced rate (device-seconds per
+        wall-second).  Clearing forgives the deficit: an un-paced
+        tenant must not carry debt into its next pacing episode."""
+        with self._lock:
+            self._settle_locked()
+            self._rate = rate if rate and rate > 0 else None
+            if self._rate is None:
+                self._deficit = 0.0
+
+    def rate(self) -> Optional[float]:
+        return self._rate
+
+    # -- the guard hook (serving loop thread) --------------------------
+    def _settle_locked(self) -> None:
+        now = time.monotonic()
+        rate = self._rate
+        if rate:
+            self._deficit = max(-rate * PACE_BURST_S,
+                                self._deficit - (now - self._t_mark) * rate)
+        self._t_mark = now
+
+    def acquire(self, phase: str) -> float:
+        """Pre-dispatch pacing: sleep until the device-time deficit
+        clears (bounded by :data:`MAX_PACE_SLEEP_S`).  Runs on the
+        serving loop thread BEFORE the dispatch guard's timer starts —
+        paced wall time is never attributed as device time, and the
+        stall watchdog never sees it.  Returns the seconds slept."""
+        if self._rate is None:          # lock-free disarmed fast path
+            return 0.0
+        with self._lock:
+            self._settle_locked()
+            rate = self._rate
+            if rate is None or self._deficit <= 0:
+                return 0.0
+            # the sleep itself repays the deficit: the NEXT settle
+            # credits the slept wall time at the paced rate, so the
+            # deficit is deliberately not touched here
+            wait = min(self._deficit / rate, MAX_PACE_SLEEP_S)
+            self.paced_s += wait
+            self.paced_rounds += 1
+        time.sleep(wait)                # sleep OUTSIDE the lock
+        metrics.POLICY_PACE_WAIT.observe(wait)
+        return wait
+
+    def debit(self, phase: str, device_s: float) -> None:
+        """Post-dispatch charge: the guard's measured device residency
+        drains the bucket (phase kept for symmetry/telemetry; the
+        budget is chip-wide, exactly like the entitlement)."""
+        if self._rate is None or not device_s or device_s <= 0:
+            return
+        with self._lock:
+            self._settle_locked()
+            if self._rate is not None:
+                self._deficit += device_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"rate": self._rate,
+                    "deficit_s": round(self._deficit, 6),
+                    "paced_s": round(self.paced_s, 6),
+                    "paced_rounds": self.paced_rounds}
+
+
+class PolicyClient:
+    """The workload half of the verdict loop: feed each ``/usage``
+    response (``contract.report_usage`` returns the parsed body)
+    through :meth:`apply` and the local enforcement state follows —
+    the pacer's rate tracks ``pace:`` verdicts, and ``refuse``
+    verdicts open a bounded-backoff admission-refusal window the LLM
+    server serves as 429 + ``Retry-After`` (never a crash; the window
+    closes on the next non-refuse verdict or by timeout, so a dead
+    daemon can never refuse forever).
+
+    ``static_rate`` (the ``--pace-rate`` knob) is the floor
+    configuration an ``ok`` verdict restores — a standalone tenant
+    can self-pace without any daemon.  ``verdict_interval_s`` is the
+    usage-report cadence: a refusal window must stay open until the
+    NEXT verdict can arrive (with margin), or a tenant refused on a
+    30-second report loop would admit freely for 29 of every 30
+    seconds — the window is closed early by any ok/pace verdict, and
+    the Retry-After the clients see stays the bounded backoff."""
+
+    def __init__(self, pacer: Optional[DispatchPacer] = None,
+                 static_rate: Optional[float] = None,
+                 verdict_interval_s: float = 30.0):
+        self.pacer = pacer if pacer is not None else DispatchPacer(
+            rate=static_rate)
+        self._static_rate = static_rate
+        self._verdict_interval_s = max(0.0, float(verdict_interval_s))
+        self._lock = threading.Lock()
+        self._refuse_until = 0.0
+        self._backoff_s = 0.0
+        self._last_verdict: Optional[str] = None
+
+    def apply(self, response: dict) -> Optional[str]:
+        """Apply one /usage response.  Only ``mode == "enforce"``
+        responses act (observe mode measures, off mode is inert — the
+        tenant serves byte-identically); returns the verdict applied,
+        or None when the response carried none / enforcement is off."""
+        if not isinstance(response, dict):
+            return None
+        verdict = response.get("policy")
+        if response.get("mode") != "enforce" or \
+                not isinstance(verdict, str):
+            return None
+        rate = parse_pace_rate(verdict)
+        if verdict == "refuse":
+            with self._lock:
+                self._backoff_s = min(
+                    REFUSE_RETRY_AFTER_MAX_S,
+                    (self._backoff_s * 2) if self._backoff_s
+                    else REFUSE_RETRY_AFTER_S)
+                # the window outlives the advertised backoff: it must
+                # reach the NEXT verdict (1.25x the report cadence for
+                # skew) or enforcement is inert between reports; an
+                # ok/pace verdict closes it immediately below, and the
+                # cap bounds a dead daemon's ghost refusal
+                self._refuse_until = time.monotonic() + max(
+                    self._backoff_s, self._verdict_interval_s * 1.25)
+                self._last_verdict = verdict
+            # refusal still paces whatever is already in flight: keep
+            # the last paced rate rather than opening the throttle
+            return verdict
+        if rate is not None:
+            self.pacer.set_rate(rate)
+        elif verdict == "ok":
+            self.pacer.set_rate(self._static_rate)
+        else:
+            return None                 # unknown verdict: ignore
+        with self._lock:
+            self._refuse_until = 0.0
+            self._backoff_s = 0.0
+            self._last_verdict = verdict
+        return verdict
+
+    def refusal_retry_after(self) -> float:
+        """Seconds the admission gate should advertise in Retry-After:
+        0 exactly when the refusal window is closed, else the BOUNDED
+        backoff (never the whole window — the window spans report
+        intervals so enforcement holds between verdicts, but a client
+        retrying at the backoff cadence just meets the next 429, which
+        is the graceful contract)."""
+        with self._lock:
+            remaining = self._refuse_until - time.monotonic()
+            if remaining <= 0:
+                return 0.0
+            return min(self._backoff_s, remaining) or remaining
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"last_verdict": self._last_verdict,
+                    "refusing_for_s": round(
+                        max(0.0, self._refuse_until - time.monotonic()),
+                        3),
+                    "backoff_s": self._backoff_s,
+                    "pacer": self.pacer.snapshot()}

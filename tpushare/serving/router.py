@@ -41,6 +41,14 @@ using only the surfaces the replicas already serve (``/metrics``,
    in-flight term is the router's own and keeps bursts from piling
    onto the replica whose scrape happens to look idle.
 
+A fourth, tenant-aware rung (round 19, ``--status-endpoints``): the
+scrape loop reads the daemon's per-tenant device-time-share series,
+and a request whose body names an over-share tenant is STEERED —
+affinity bypassed, pure load pick — so the noisy tenant's overflow
+spreads to under-loaded replicas before its own process paces or
+refuses it (the router is the gentlest rung of the enforcement
+ladder; see DESIGN.md "Enforced sharing").
+
 Stdlib-only, importable BEFORE jax, like ``telemetry/health.py`` — the
 router allocates no backend and must never dial the TPU tunnel
 (enforced: tpulint rule ``router-no-jax``).  Routing telemetry rides
@@ -69,6 +77,10 @@ from .. import telemetry
 from ..inspect.metricsview import summarize_serving
 from ..utils.httpserver import JsonHTTPServer, RawBody
 from . import metrics
+# the ONE over-share threshold (stdlib policy module, same no-jax
+# contract as this file): the steering verdict must agree with the
+# daemon's OVER flag and the pacing thresholds
+from .policy import SHARE_OVERSHOOT_SLACK
 
 log = logging.getLogger("tpushare.router")
 
@@ -163,8 +175,23 @@ class FleetRouter:
                  request_timeout_s: float = 600.0,
                  eviction_failures: int = 2,
                  prefill_heavy_ratio: float = 2.0,
-                 watch_poll_s: float = 0.05):
+                 watch_poll_s: float = 0.05,
+                 status_endpoints: Sequence[str] = ()):
         self._replicas: List[Replica] = []
+        # TENANT-AWARE STEERING (round 19): the scrape loop also reads
+        # each listed daemon exposition's per-tenant share-vs-
+        # entitlement series; a request whose body names an over-share
+        # tenant ("tenant": <pod>) skips prefix affinity and routes by
+        # pure load — its overflow spreads to the under-loaded replica
+        # BEFORE the tenant is paced locally (the router's rung of the
+        # enforcement ladder: steer, then pace, then refuse).
+        self._status_endpoints = [e for e in status_endpoints if e]
+        self._over_share: set = set()
+        #: last successful per-endpoint verdict sets: an unreachable
+        #: daemon KEEPS its tenants' last verdicts (a partial outage
+        #: must not silently un-steer one daemon's noisy tenants while
+        #: the others still answer)
+        self._over_share_by_ep: Dict[str, set] = {}
 
         def _add(specs, role, prefix):
             for i, spec in enumerate(specs):
@@ -269,6 +296,48 @@ class FleetRouter:
                                        self._replicas))
         except RuntimeError:
             pass                 # pool shut down mid-pass (stop())
+        self._scrape_tenants()
+
+    def _scrape_tenants(self) -> None:
+        """Refresh the over-share tenant set from the configured daemon
+        expositions (``--status-endpoints``): a tenant whose device-
+        time share exceeds its EFFECTIVE (slack-reallocated)
+        entitlement past the shared overshoot slack steers to pure
+        load routing.  Best-effort — an unreachable daemon keeps the
+        last verdict (steering is an optimization rung; pacing and
+        refusal enforce regardless)."""
+        if not self._status_endpoints:
+            return
+        for addr in self._status_endpoints:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/metrics",
+                        timeout=self._scrape_timeout_s) as resp:
+                    parsed = telemetry.parse_text(resp.read().decode())
+            except Exception as e:
+                # keep this endpoint's LAST verdicts: a partial daemon
+                # outage must not un-steer its tenants while the other
+                # daemons still answer
+                log.debug("tenant scrape failed for %s: %s", addr, e)
+                continue
+
+            def series(name):
+                return {labels.get("tenant"): value
+                        for labels, value in
+                        parsed["samples"].get(name, ())}
+
+            share = series("tpushare_tenant_device_share")
+            eff = series("tpushare_tenant_effective_entitlement_share")
+            ent = series("tpushare_tenant_entitlement_share")
+            over = set()
+            for tenant, s in share.items():
+                base = eff.get(tenant, ent.get(tenant))
+                if tenant and base and s > base * SHARE_OVERSHOOT_SLACK:
+                    over.add(tenant)
+            with self._lock:
+                self._over_share_by_ep[addr] = over
+                self._over_share = set().union(
+                    *self._over_share_by_ep.values())
 
     def _scrape_replica(self, r: Replica) -> None:
         ok, reason = self._probe_health(r)
@@ -502,20 +571,25 @@ class FleetRouter:
 
     def _pick(self, tokens: Optional[List[int]], prefill_heavy: bool,
               exclude: Sequence[str],
-              role: Optional[str] = None
+              role: Optional[str] = None,
+              steer: bool = False
               ) -> Tuple[Optional[Replica], str]:
         """Choose a replica and the policy that chose it.  Re-dispatch
         picks (``exclude`` non-empty) are pure load picks labeled
         ``retry`` — the affinity target just failed or is excluded, and
         a 'hit' that re-routes is not a hit.  ``role`` restricts the
-        candidates to that disaggregation role.  Increments the pick's
+        candidates to that disaggregation role.  ``steer`` (an
+        over-share tenant's request) bypasses affinity ENTIRELY —
+        lookup and registration: the overflow must spread by load, and
+        registering its prefixes to the spread target would drag the
+        tenant's future traffic after it.  Increments the pick's
         in-flight count under the lock (the caller's forward owns the
         decrement)."""
         # hash once, OUTSIDE the lock (tuple-hashing long prompts is
         # the expensive part, and this lock is the front door's one
         # hot lock); the list serves both the lookup and registration
         hashes = (self._prefix_hashes(tokens)
-                  if self._affinity and tokens else ())
+                  if self._affinity and tokens and not steer else ())
         with self._lock:
             candidates = [r for r in self._replicas
                           if r.in_rotation and r.name not in exclude
@@ -547,24 +621,35 @@ class FleetRouter:
             return chosen, policy
 
     # -- forwarding ----------------------------------------------------
+    @staticmethod
+    def _relay_headers(headers) -> dict:
+        """The replica response headers the router must relay: today
+        just Retry-After (the tenant-policy 429's bounded backoff —
+        stripping it would defeat the pacing the 429 exists for)."""
+        v = headers.get("Retry-After") if headers is not None else None
+        return {"Retry-After": v} if v else {}
+
     def _forward(self, r: Replica, data: bytes,
-                 path: str = "/generate") -> Tuple[int, object]:
+                 path: str = "/generate") -> Tuple[int, object, dict]:
         req = urllib.request.Request(
             f"http://{r.address}{path}", data=data,
             headers={"Content-Type": "application/json"}, method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=self._request_timeout_s) as resp:
-                return resp.status, json.loads(resp.read())
+                return (resp.status, json.loads(resp.read()),
+                        self._relay_headers(resp.headers))
         except urllib.error.HTTPError as e:
+            hdrs = self._relay_headers(e.headers)
             try:
-                return e.code, json.loads(e.read())
+                return e.code, json.loads(e.read()), hdrs
             except Exception:
-                return e.code, {"Error": f"replica answered {e.code}"}
+                return (e.code, {"Error": f"replica answered {e.code}"},
+                        hdrs)
 
     def _forward_watched(self, r: Replica, data: bytes,
                          path: str = "/generate"
-                         ) -> Optional[Tuple[int, object]]:
+                         ) -> Optional[Tuple[int, object, dict]]:
         """Forward in a worker thread, watching the replica's rotation
         state: if ``r`` is evicted while the forward is in flight, the
         worker is ABANDONED (left to finish; never killed — its late
@@ -616,13 +701,24 @@ class FleetRouter:
         except (TypeError, ValueError):
             max_new = 32                  # replica 400s the real parse
         prefill_heavy = self._prefill_heavy(tokens, max_new)
+        # tenant-aware steering: an over-share tenant's overflow
+        # spreads by pure load instead of piling onto its warm
+        # affinity replica — the enforcement rung BEFORE local pacing
+        tenant = body.get("tenant")
+        steer = False
+        if isinstance(tenant, str) and tenant:
+            with self._lock:
+                steer = tenant in self._over_share
+            if steer:
+                metrics.ROUTER_STEERED.inc()
         if self._disagg:
-            return self._generate_disagg(body, tokens)
+            return self._generate_disagg(body, tokens, steer=steer)
         return self._forward_balanced(body, tokens, prefill_heavy,
-                                      role=None)
+                                      role=None, steer=steer)
 
     def _forward_balanced(self, body, tokens, prefill_heavy,
-                          role: Optional[str] = None):
+                          role: Optional[str] = None,
+                          steer: bool = False):
         """The plain health/affinity/load retry loop over one role
         class (None = the whole fleet) — the non-disaggregated
         /generate path, and the re-prefill fallback the disaggregated
@@ -631,7 +727,7 @@ class FleetRouter:
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
             replica, policy = self._pick(tokens, prefill_heavy, tried,
-                                         role=role)
+                                         role=role, steer=steer)
             if replica is None:
                 if tried:
                     # candidates exist but were all tried and failed —
@@ -644,7 +740,6 @@ class FleetRouter:
                 metrics.ROUTER_RETRIES.inc()
             out = self._forward_watched(replica, data)
             if out is not None and out[0] < 500:
-                code, payload = out
                 with self._lock:
                     replica.requests += 1
                     # "consecutive" means it: a success between two
@@ -657,7 +752,7 @@ class FleetRouter:
                 if policy == "affinity":
                     metrics.ROUTER_AFFINITY_HITS.inc(
                         replica=replica.name)
-                return code, payload
+                return out          # (code, payload, relayed headers)
             if out is not None and out[0] == 503 and isinstance(
                     out[1], dict) and "draining" in str(
                         out[1].get("Error", "")):
@@ -685,7 +780,7 @@ class FleetRouter:
                               f"(tried {', '.join(tried)})"}
 
     # -- disaggregated prefill/decode routing ---------------------------
-    def _generate_disagg(self, body, tokens):
+    def _generate_disagg(self, body, tokens, steer: bool = False):
         """Prefill/decode-disaggregated /generate: the prompt prefills
         on a PREFILL replica (``phase="prefill"`` — the replica answers
         with the session blob at the activation boundary), then the
@@ -710,7 +805,7 @@ class FleetRouter:
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
             replica, policy = self._pick(tokens, True, tried,
-                                         role="prefill")
+                                         role="prefill", steer=steer)
             if replica is None:
                 if tried:
                     break
@@ -738,7 +833,7 @@ class FleetRouter:
                                  "transport error, or deadline)")
                 tried.append(replica.name)
                 continue
-            code, payload = out
+            code, payload = out[0], out[1]
             with self._lock:
                 replica.requests += 1
                 replica.consecutive_failures = 0
@@ -752,21 +847,24 @@ class FleetRouter:
                     or "migration" not in payload:
                 # a 4xx (the replica owns validation) or a request
                 # that COMPLETED at activation — nothing to hand off
-                return code, payload
+                # (headers relayed: a policy 429's Retry-After)
+                return out
             return self._dispatch_handoff(replica, tokens, body,
-                                          payload["migration"])
+                                          payload["migration"],
+                                          steer=steer)
         return 502, {"Error": f"all prefill forwards failed "
                               f"(tried {', '.join(tried)})"}
 
     def _dispatch_handoff(self, prefill_r: Replica,
                           tokens: Optional[List[int]], body,
-                          blob64: str):
+                          blob64: str, steer: bool = False):
         """Land a prefilled session blob: decode replica, then the
         prefill replica itself (local decode), then re-prefill."""
         mdata = json.dumps({"blob": blob64}).encode()
         outcome, result, holder = None, None, None
         holder_policy = "load"
-        decode_r, dpolicy = self._pick(tokens, False, (), role="decode")
+        decode_r, dpolicy = self._pick(tokens, False, (), role="decode",
+                                       steer=steer)
         if decode_r is not None:
             result = self._forward_watched(decode_r, mdata,
                                            path="/migrate_in")
@@ -804,7 +902,8 @@ class FleetRouter:
             except (TypeError, ValueError):
                 max_new = 32
             return self._forward_balanced(
-                body, tokens, self._prefill_heavy(tokens, max_new))
+                body, tokens, self._prefill_heavy(tokens, max_new),
+                steer=steer)
         metrics.ROUTER_HANDOFFS.inc(outcome=outcome)
         with self._lock:
             holder.requests += 1
@@ -816,8 +915,11 @@ class FleetRouter:
         if holder_policy == "affinity":
             metrics.ROUTER_AFFINITY_HITS.inc(replica=holder.name)
         # the decode holder now owns the session's pages — future
-        # same-prefix traffic should find them there
-        self._repoint_affinity(tokens, holder.name)
+        # same-prefix traffic should find them there (not for STEERED
+        # requests: registering the spread target would drag the
+        # over-share tenant's future traffic after its overflow)
+        if not steer:
+            self._repoint_affinity(tokens, holder.name)
         return result
 
     def _healthz(self, _body=None):
@@ -835,6 +937,7 @@ class FleetRouter:
             return 200, {
                 "retries": self._retries,
                 "policies": list(ROUTER_POLICIES),
+                "over_share_tenants": sorted(self._over_share),
                 "replicas": [r.view() for r in self._replicas],
             }
 
@@ -885,6 +988,14 @@ def main(argv=None) -> int:
                          "is skipped in favor of the load policy")
     ap.add_argument("--request-timeout", type=float, default=600.0,
                     help="per-forward deadline before re-dispatch")
+    ap.add_argument("--status-endpoints", default="",
+                    help="comma-separated daemon /metrics addresses "
+                         "(host:port) to scrape for per-tenant "
+                         "share-vs-entitlement: requests whose body "
+                         "names an over-share tenant (\"tenant\": "
+                         "<pod>) steer to pure load routing — the "
+                         "overflow spreads to under-loaded replicas "
+                         "before the tenant is paced locally")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -913,7 +1024,10 @@ def main(argv=None) -> int:
         affinity=not args.no_affinity, prefix_block=args.prefix_block,
         scrape_interval_s=args.scrape_interval,
         max_retries=args.max_retries, saturation=args.saturation,
-        request_timeout_s=args.request_timeout)
+        request_timeout_s=args.request_timeout,
+        status_endpoints=[e.strip()
+                          for e in args.status_endpoints.split(",")
+                          if e.strip()])
     log.info("router: %d replica(s) on :%d (affinity=%s, disagg=%s)",
              len(router._replicas), router.port, not args.no_affinity,
              router._disagg)

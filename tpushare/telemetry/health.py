@@ -25,7 +25,14 @@ shared answer to "is the backend OK, slow, or wedged":
 * per-phase device-time attribution: guard exit observes
   ``tpushare_device_time_seconds{phase=prefill|decode|mixed}`` with the
   known constant tunnel-RPC overhead subtracted — the measured usage
-  feedback SGDRC-style co-location decisions need.
+  feedback SGDRC-style co-location decisions need;
+* the tenant-policy choke point (round 19): an installed
+  ``serving.policy.DispatchPacer`` (:meth:`HealthMonitor.
+  install_policy`) is consulted on guard ENTER (``acquire(phase)`` —
+  the pacing sleep, on the serving loop thread, before the timer) and
+  fed on guard EXIT (``debit(phase, device_s)`` — the same measured
+  residency the attribution records), turning the advisory device-time
+  accounting into enforcement without a second dispatch path.
 
 ``bench.py``'s probe-deadline / CPU-fallback / stall-watchdog logic
 lives here too (:func:`probe_platform`, :func:`start_stall_watchdog`)
@@ -191,6 +198,17 @@ class _DispatchGuard:
         self.device_s: Optional[float] = None
 
     def __enter__(self):
+        pol = self._mon._policy
+        if pol is not None:
+            # pre-dispatch pacing hook (tpushare/serving/policy.py):
+            # sleeps the CALLING thread — the serving loop, before its
+            # next round's dispatch — when the tenant is over its
+            # device-time share.  Deliberately BEFORE the timer and
+            # before the watchdog registration: paced wall time is
+            # neither attributed as device time nor mistakable for a
+            # stall, and the hook never touches a hung worker or a
+            # jitted program.
+            pol.acquire(self.phase)
         self._t0 = time.monotonic()
         self._mon._guard_enter(self)
         return self
@@ -214,7 +232,7 @@ class _DispatchGuard:
 _LOCK_GUARDED = {
     "HealthMonitor": ("state", "reason", "last_snapshot_path",
                       "_transitions", "_inflight", "_next_token",
-                      "_scanner"),
+                      "_scanner", "_policy"),
 }
 
 
@@ -249,6 +267,11 @@ class HealthMonitor:
         self._scanner: Optional[threading.Thread] = None
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_halt = threading.Event()
+        #: installed tenant policy (serving/policy.py DispatchPacer or
+        #: None): the dispatch guard's pre-dispatch pacing hook and
+        #: post-dispatch device-time debit consult it.  One single-
+        #: word read per guard — the disarmed path stays free.
+        self._policy = None
         self._mirror_state()
 
     # -- state machine -------------------------------------------------
@@ -318,7 +341,26 @@ class HealthMonitor:
             self._inflight.clear()
             self._transitions = 0
             self.last_snapshot_path = None
+            self._policy = None
             self._mirror_state()
+
+    # -- tenant policy hook --------------------------------------------
+    def install_policy(self, policy) -> None:
+        """Arm the dispatch guard's pacing hook with a
+        ``serving.policy.DispatchPacer`` (or anything exposing
+        ``acquire(phase)`` / ``debit(phase, device_s)``).  One policy
+        per process — the entitlement is per-tenant-process, exactly
+        like the health machine itself."""
+        with self._lock:
+            self._policy = policy
+
+    def uninstall_policy(self, policy=None) -> None:
+        """Disarm pacing.  Pass the policy you installed to make the
+        call idempotent against a later owner (a stopping service must
+        not disarm its successor's pacer)."""
+        with self._lock:
+            if policy is None or self._policy is policy:
+                self._policy = None
 
     # -- probes --------------------------------------------------------
     def record_probe(self, ok: bool, latency_s: float,
@@ -483,6 +525,11 @@ class HealthMonitor:
             # "fully busy" during exactly the hours it was zero
             g.device_s = max(0.0, wall_s - rpc_overhead_s())
             DEVICE_TIME.observe(g.device_s, phase=g.phase)
+            pol = self._policy
+            if pol is not None:
+                # the same measured residency the attribution uses
+                # drains the pacing bucket — one cost definition
+                pol.debit(g.phase, g.device_s)
         if not (stalled or error or wall_s >= self.slow_record_s
                 or self.state in (WEDGED, DEGRADED)):
             # WEDGED/DEGRADED traffic is forensics; sticky CPU_FALLBACK

@@ -48,8 +48,10 @@ class RawBody:
 
 class JsonHTTPServer:
     """Routes: {(method, path): handler}; handler(body_dict|None) ->
-    (code, payload).  Payload str -> text/plain, RawBody -> explicit
-    content type, StreamingBody -> incremental write, else JSON."""
+    (code, payload) or (code, payload, headers_dict).  Payload str ->
+    text/plain, RawBody -> explicit content type, StreamingBody ->
+    incremental write, else JSON; the optional headers dict adds
+    response headers (e.g. Retry-After on a policy 429)."""
 
     def __init__(self, port: int, addr: str,
                  routes: dict,
@@ -66,7 +68,11 @@ class JsonHTTPServer:
             def log_message(self, *a):
                 pass
 
-            def _send(self, code: int, payload) -> None:
+            def _send(self, code: int, payload, headers=None) -> None:
+                def _extra_headers():
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, str(v))
+
                 if isinstance(payload, StreamingBody):
                     try:
                         # the header writes sit INSIDE the guarded
@@ -75,6 +81,7 @@ class JsonHTTPServer:
                         # accounting (the LLM server's in-flight
                         # counter) leaks on exactly that disconnect
                         self.send_response(code)
+                        _extra_headers()
                         self.send_header("Content-Type",
                                          payload.content_type)
                         # no Content-Length: body delimited by close
@@ -103,6 +110,7 @@ class JsonHTTPServer:
                     data = json.dumps(payload).encode()
                     ctype = "application/json"
                 self.send_response(code)
+                _extra_headers()
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -133,16 +141,23 @@ class JsonHTTPServer:
                     except json.JSONDecodeError:
                         self._send(400, {"Error": "bad json"})
                         return
+                headers = None
                 try:
                     if getattr(handler, "wants_query", False):
-                        code, payload = handler(
-                            body, dict(parse_qsl(rawq)))
+                        result = handler(body, dict(parse_qsl(rawq)))
                     else:
-                        code, payload = handler(body)
+                        result = handler(body)
+                    if len(result) == 3:
+                        # (code, payload, headers) — responses whose
+                        # HEADERS carry protocol meaning (the policy
+                        # layer's 429 + Retry-After)
+                        code, payload, headers = result
+                    else:
+                        code, payload = result
                 except Exception as e:  # keep serving either way
                     code = 200 if outer.inband_errors else 500
                     payload = {"Error": str(e)}
-                self._send(code, payload)
+                self._send(code, payload, headers)
 
             def do_GET(self):
                 self._dispatch("GET")
