@@ -329,6 +329,118 @@ def spec_paged_bench(params, cfg, *, page_size, slots, prompt_len, gen,
     return out
 
 
+def lora_multi_adapter_bench(params, cfg, *, slots, rank, n_adapters,
+                             page_size, prompt_len, gen, decode_chunk,
+                             reps=2, mesh=None):
+    """Batched multi-adapter LoRA decode (round 20): an N-adapter
+    mixed batch through ONE adapter-pool batcher (one dispatch per
+    fused round, per-row pool gather inside it) vs the PER-ADAPTER
+    SEQUENTIAL dispatch-group baseline — one batcher per adapter,
+    groups ticked round-robin, so every round costs one dispatch per
+    distinct adapter (the merged-model-per-tenant deployment shape
+    the batched gather replaces).
+
+    ``mesh`` (CPU runs): the tp=4 virtual-mesh per-dispatch cost
+    proxy, exactly like the mixed-step and spec scenarios — SPMD
+    launch overhead stands in for the ~70 ms tunnel RPC; dispatch
+    counts are recorded per arm so the record reads as overhead-only
+    (the chip claim lives in drives/drive_lora_gather.py).
+
+    Streams are asserted IDENTICAL between the arms per (prompt,
+    adapter) — the row-independence contract.  The capacity side
+    rides :func:`tpushare.ops.lora` byte pricing: adapters resident
+    per byte vs one merged model copy per adapter.
+
+    Importable so a test can smoke-run it at tiny sizes
+    (tier-1-safe).  Returns {"batched": {...}, "sequential": {...},
+    "capacity": {...}}.
+    """
+    from tpushare.ops import lora as ops_lora
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    prompts = [[1 + ((3 * i + j) % 13) for j in range(prompt_len)]
+               for i in range(slots)]
+    names = [f"tenant-{i % n_adapters}" for i in range(slots)]
+
+    def run_batched():
+        b = PagedContinuousBatcher(params, cfg, n_slots=slots,
+                                   page_size=page_size, mesh=mesh,
+                                   adapter_slots=n_adapters,
+                                   adapter_rank=rank)
+        n_disp = [0]
+        real = b._step_n
+
+        def counted(*a, **k):
+            n_disp[0] += 1
+            return real(*a, **k)
+
+        b._step_n = counted
+        rids = [b.admit(p, gen, adapter=a)
+                for p, a in zip(prompts, names)]
+        t0 = time.perf_counter()
+        while b.slots:
+            b.tick_fused(decode_chunk)
+        dt = time.perf_counter() - t0
+        return dt, n_disp[0], {
+            (tuple(p), a): b.completed[r]
+            for p, a, r in zip(prompts, names, rids)}
+
+    def run_sequential():
+        groups = {}
+        for p, a in zip(prompts, names):
+            groups.setdefault(a, []).append(p)
+        batchers = []
+        for a, ps in groups.items():
+            b = PagedContinuousBatcher(params, cfg, n_slots=slots,
+                                       page_size=page_size, mesh=mesh,
+                                       adapter_slots=1,
+                                       adapter_rank=rank)
+            n_disp = [0]
+            real = b._step_n
+
+            def counted(*aa, _real=real, _n=n_disp, **k):
+                _n[0] += 1
+                return _real(*aa, **k)
+
+            b._step_n = counted
+            rids = [b.admit(p, gen, adapter=a) for p in ps]
+            batchers.append((a, b, rids, n_disp))
+        t0 = time.perf_counter()
+        while any(b.slots for _, b, _, _ in batchers):
+            for _, b, _, _ in batchers:
+                if b.slots:
+                    b.tick_fused(decode_chunk)
+        dt = time.perf_counter() - t0
+        streams = {}
+        for a, b, rids, _ in batchers:
+            for p, r in zip(groups[a], rids):
+                streams[(tuple(p), a)] = b.completed[r]
+        return dt, sum(n[0] for _, _, _, n in batchers), streams
+
+    out = {}
+    for _ in range(reps):       # first rep absorbs the compiles
+        dt_b, disp_b, st_b = run_batched()
+        dt_s, disp_s, st_s = run_sequential()
+        out = {
+            "batched": {"tokens_per_s": slots * gen / dt_b,
+                        "dispatches": disp_b},
+            "sequential": {"tokens_per_s": slots * gen / dt_s,
+                           "dispatches": disp_s},
+        }
+    assert st_b == st_s, \
+        "batched multi-adapter streams diverged from sequential groups"
+    per_adapter = ops_lora.adapter_entry_bytes(cfg, rank)
+    merged = ops_lora.merged_adapter_bytes(cfg)
+    out["capacity"] = {
+        "bytes_per_adapter": per_adapter,
+        "merged_bytes_per_adapter": merged,
+        "adapters_per_merged_copy": round(merged / per_adapter, 1),
+        "pool_bytes": ops_lora.adapter_pool_bytes(cfg, rank,
+                                                  n_adapters + 1),
+    }
+    return out
+
+
 def sp_stripe_bench(params, cfg, *, page_size, pages_per_shard, sp,
                     gen, decode_chunk, reps=2):
     """Position-striped paged decode (round 17) at FIXED PER-SHARD pool
@@ -1593,6 +1705,58 @@ def main() -> int:
                "repetitive prompts; greedy exactness asserted per "
                "dtype; CPU arm is a dispatch-count proxy "
                "(overhead-only — chip claim in drive_spec_paged)")
+
+    # 2f. BATCHED MULTI-ADAPTER LORA DECODE (round 20): N-adapter
+    # mixed batch in ONE dispatch per fused round (per-row pool
+    # gather) vs the per-adapter sequential dispatch groups a
+    # merged-model deployment pays — off-TPU over the tp=4 virtual
+    # mesh (the per-dispatch cost proxy of 2a-dispatch/2e; the N=8
+    # groups pay ~N dispatches per round where the pool pays one).
+    # Streams asserted identical between arms; capacity is structural
+    # (byte model, real on every platform).
+    lora_mesh = None
+    if not on_tpu and len(jax.devices()) >= 4:
+        from tpushare.parallel.mesh import make_mesh
+        lora_mesh = make_mesh({"tp": 4})
+    lora_adapters = 8
+    lcf = (transformer.ModelConfig(vocab=32000, d_model=512,
+                                   n_layers=4, n_heads=4, n_kv_heads=4,
+                                   d_ff=1408, max_seq=512)
+           if on_tpu else
+           transformer.ModelConfig(vocab=256, d_model=256, n_layers=2,
+                                   n_heads=4, n_kv_heads=4, d_ff=128,
+                                   max_seq=96, dtype=jnp.float32))
+    lpar = transformer.init_params(jax.random.PRNGKey(10), lcf)
+    la = lora_multi_adapter_bench(
+        lpar, lcf, slots=8, rank=8, n_adapters=lora_adapters,
+        page_size=16 if on_tpu else 8,
+        prompt_len=(3 * 16) if on_tpu else 8,
+        gen=33 if on_tpu else 9,
+        decode_chunk=16 if on_tpu else 4, mesh=lora_mesh)
+    vs_seq = round(la["batched"]["tokens_per_s"]
+                   / la["sequential"]["tokens_per_s"], 3)
+    _emit("lora_multi_adapter_decode_tokens_per_s",
+          la["batched"]["tokens_per_s"], "tokens/s",
+          platform=platform, slots=8, n_adapters=lora_adapters,
+          rank=8, tp=(4 if lora_mesh is not None else 0),
+          dispatches=la["batched"]["dispatches"],
+          sequential_dispatches=la["sequential"]["dispatches"],
+          vs_sequential=vs_seq,
+          sequential_tokens_per_s=round(
+              la["sequential"]["tokens_per_s"], 2),
+          adapters_per_merged_copy=la["capacity"][
+              "adapters_per_merged_copy"],
+          bytes_per_adapter=la["capacity"]["bytes_per_adapter"],
+          merged_bytes_per_adapter=la["capacity"][
+              "merged_bytes_per_adapter"],
+          note="N-adapter mixed batch, one dispatch per fused round "
+               "vs per-adapter sequential dispatch groups; streams "
+               "asserted identical; CPU arm is the tp=4 dispatch-cost "
+               "proxy (chip claim in drive_lora_gather)")
+    assert vs_seq >= 1.5, \
+        f"batched multi-adapter only {vs_seq}x sequential groups"
+    assert la["capacity"]["adapters_per_merged_copy"] >= 4, \
+        "adapter pool capacity under 4x merged-model bytes at rank 8"
 
     # 3. speculative decoding ceiling: draft == target isolates the
     # mechanism (acceptance 1.0); with randomly-initialized models a

@@ -1,0 +1,196 @@
+"""On-chip batched multi-adapter LoRA decode: compile-check + batched
+vs per-adapter sequential dispatch groups through the paged batcher.
+
+The CPU-side contract is pinned in tests/test_lora_serving.py
+(adapter-0 bit-identity, mixed-batch row independence, one dispatch
+per round with adapters active).  What only the real chip can answer:
+
+* does the STACKED-ADAPTER GATHER lower on Mosaic — ``jnp.take`` of
+  the [N, d_in, r] / [N, r, d_out] pools by a per-row id vector inside
+  the decode scan (a dynamic cross-row gather feeding two skinny
+  matmuls per projection, seven projections per layer), and does it
+  lower PER SHARD under the tp=2 mesh (the adapter B leaves shard
+  d_out with their column-parallel base projections, A leaves shard
+  d_in with the row-parallel ones — the partitioner must place the
+  gather without an all-gather of the whole pool);
+* what the adapter path COSTS at serving shapes — mixed-adapter fused
+  decode vs the identical pool-less batcher (the two skinny matmuls
+  should be noise next to the base matmul), and vs the per-adapter
+  SEQUENTIAL dispatch-group baseline (one forward per adapter group
+  per round), which is the deployment the batched gather replaces.
+
+No Pallas kernel rides this path — the gather + einsums are plain XLA
+— so the static precheck records ``xla_only`` instead of a mosaic
+arm (there are no BlockSpecs to derive; the compile check IS the
+chip run).
+
+    python drives/drive_lora_gather.py        # real chip; ~6 min
+
+Prints ONE JSON line (LORA_GATHER_TPU.json when committed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def precheck() -> dict:
+    """No Pallas path: nothing for the mosaic prechecker to derive —
+    the record says so explicitly instead of silently omitting the
+    arm (`make tpu-records` and the lane key on precheck_ok)."""
+    return {"mode": "xla_only", "ok": True}
+
+
+def main() -> int:
+    pre = precheck()
+
+    import jax
+
+    from tpushare.models import transformer
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=512)
+        slots, prompt_len, gen, page = 8, 64, 33, 16
+        rank, n_adapters, decode_chunk = 8, 8, 16
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=2, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96)
+        slots, prompt_len, gen, page = 4, 8, 9, 8
+        rank, n_adapters, decode_chunk = 4, 4, 4
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [[1 + ((3 * i + j) % 13) for j in range(prompt_len)]
+               for i in range(slots)]
+    adapters = [f"tenant-{i % n_adapters}" for i in range(slots)]
+
+    out = {"metric": "lora_gather", "platform": dev.platform,
+           "slots": slots, "prompt_len": prompt_len, "gen": gen,
+           "page_size": page, "rank": rank, "n_adapters": n_adapters,
+           "precheck_ok": pre["ok"], "precheck": pre}
+
+    def drain_batched(run_params, names, mesh=None, pool_slots=None):
+        """One mixed-adapter fused drain; returns (wall_s, dispatches,
+        streams)."""
+        b = PagedContinuousBatcher(
+            run_params, cfg, n_slots=slots, page_size=page, mesh=mesh,
+            adapter_slots=pool_slots if pool_slots is not None
+            else n_adapters, adapter_rank=rank)
+        n_disp = [0]
+        real = b._step_n
+
+        def counted(*a, **k):
+            n_disp[0] += 1
+            return real(*a, **k)
+
+        b._step_n = counted
+        rids = [b.admit(p, gen, adapter=a)
+                for p, a in zip(prompts, names)]
+        t0 = time.perf_counter()
+        while b.slots:
+            b.tick_fused(decode_chunk)
+        dt = time.perf_counter() - t0
+        return dt, n_disp[0], [[int(t) for t in b.completed[r]]
+                               for r in rids]
+
+    def drain_sequential(run_params, names):
+        """The per-adapter dispatch-group baseline: each adapter group
+        is its OWN batcher (one merged-model-per-tenant deployment
+        shape), groups ticked round-robin — N dispatches where the
+        batched pool pays one."""
+        groups = {}
+        for p, a in zip(prompts, names):
+            groups.setdefault(a, []).append(p)
+        batchers = []
+        for a, ps in groups.items():
+            b = PagedContinuousBatcher(
+                run_params, cfg, n_slots=slots, page_size=page,
+                adapter_slots=1, adapter_rank=rank)
+            rids = [b.admit(p, gen, adapter=a) for p in ps]
+            batchers.append((b, rids))
+        n_disp = 0
+        t0 = time.perf_counter()
+        while any(b.slots for b, _ in batchers):
+            for b, _ in batchers:
+                if b.slots:
+                    b.tick_fused(decode_chunk)
+                    n_disp += 1
+        dt = time.perf_counter() - t0
+        streams = {}
+        for b, rids in batchers:
+            for r in rids:
+                streams[tuple(b.completed[r][:prompt_len])] = \
+                    [int(t) for t in b.completed[r]]
+        return dt, n_disp, streams
+
+    # warm (absorbs every compile), then timed
+    drain_batched(params, adapters)
+    t_compile0 = time.perf_counter()
+    dt_b, disp_b, streams_b = drain_batched(params, adapters)
+    out["compile_ok"] = True
+    out["batched"] = {"wall_s": round(dt_b, 3), "dispatches": disp_b,
+                      "tokens_per_s": round(slots * gen / dt_b, 1)}
+
+    drain_sequential(params, adapters)
+    dt_s, disp_s, streams_s = drain_sequential(params, adapters)
+    out["sequential_groups"] = {
+        "wall_s": round(dt_s, 3), "dispatches": disp_s,
+        "tokens_per_s": round(slots * gen / dt_s, 1)}
+    out["speedup_batched_vs_sequential"] = round(dt_s / dt_b, 3)
+
+    # exactness: every batched row equals its sequential-group twin
+    # (same adapter, same prompt -> same stream; row independence)
+    exact = all(streams_s.get(tuple(s[:prompt_len])) == s
+                for s in streams_b)
+    out["exact"] = exact
+
+    # identity rows: a pool-carrying batcher serving base requests
+    # must match the pool-less batcher bit for bit
+    b_ref = PagedContinuousBatcher(params, cfg, n_slots=slots,
+                                   page_size=page)
+    r_ref = b_ref.admit(prompts[0], gen)
+    while b_ref.slots:
+        b_ref.tick_fused(decode_chunk)
+    _, _, st_id = drain_batched(params, [None] * slots)
+    out["identity_exact"] = st_id[0] == [int(t) for t in
+                                         b_ref.completed[r_ref]]
+
+    # -- tp=2 shard_map arm ---------------------------------------------
+    # What ONLY this arm proves: the per-row pool gather + skinny
+    # matmuls lowering when the adapter B/A leaves shard with their
+    # base projections — neither the CPU run nor the single-device
+    # compile exercises the partitioned gather.
+    if len(jax.devices()) >= 2 and cfg.n_heads % 2 == 0 \
+            and cfg.n_kv_heads % 2 == 0:
+        from tpushare.parallel.mesh import make_mesh
+        mesh = make_mesh({"tp": 2})
+        drain_batched(params, adapters, mesh=mesh)
+        dt_tp, disp_tp, st_tp = drain_batched(params, adapters,
+                                              mesh=mesh)
+        agree = sum(x == y for sa, sb in zip(streams_b, st_tp)
+                    for x, y in zip(sa[prompt_len:], sb[prompt_len:]))
+        out["tp2"] = {"compile_ok": True,
+                      "wall_s": round(dt_tp, 3),
+                      "dispatches": disp_tp,
+                      "tokens_per_s": round(slots * gen / dt_tp, 1),
+                      # bf16 disagreement would be partitioner matmul
+                      # reassociation; the f32 CPU shape is exact
+                      "agreement_vs_single": f"{agree}/{slots * gen}"}
+    else:
+        out["tp2"] = {"skipped": "single device or indivisible heads"}
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

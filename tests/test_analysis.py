@@ -724,6 +724,40 @@ def test_dispatch_audit_catches_item_fetch_outside_guard():
     assert dispatch_audit.audit_pair(ok) == []
 
 
+def test_dispatch_audit_adapter_operand_helper_rules():
+    """The round-20 adapter-operand contract: ``_adapter_operands`` is
+    host-side handle passing — a jitted dispatch, a hook call, or a
+    host fetch hiding inside it is a second device program per round
+    (each seeded violation caught by name; the clean helper passes)."""
+    ok = _AUDIT_FIXTURE.replace(
+        "class B:",
+        "class B:\n"
+        "    def _adapter_operands(self, ads):\n"
+        "        if ads is None:\n"
+        "            return None, None\n"
+        "        return self.pool, ads\n")
+    assert dispatch_audit.audit_pair(ok) == []
+    bad_jit = ok.replace(
+        "        return self.pool, ads\n",
+        "        return _other_prog(self.pool), ads\n")
+    fs = dispatch_audit.audit_pair(bad_jit)
+    assert any(f.rule == "adapter-operand" and "_other_prog"
+               in f.message for f in fs), fs
+    bad_fetch = ok.replace(
+        "        return self.pool, ads\n",
+        "        return self.pool, np.asarray(ads)\n")
+    fs = dispatch_audit.audit_pair(bad_fetch)
+    assert any(f.rule == "adapter-operand" and "host-fetches"
+               in f.message for f in fs), fs
+    bad_hook = ok.replace(
+        "        return self.pool, ads\n",
+        "        self._step(ads)\n"
+        "        return self.pool, ads\n")
+    fs = dispatch_audit.audit_pair(bad_hook)
+    assert any(f.rule == "adapter-operand" and "calls hook"
+               in f.message for f in fs), fs
+
+
 def test_dispatch_audit_catches_fetch_inside_hook():
     bad = _AUDIT_FIXTURE.replace(
         "        out = _tick_prog(x, 1)\n",

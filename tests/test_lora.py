@@ -166,6 +166,112 @@ def test_merge_requantize_preserves_group(base):
     assert merged["layers"]["wq"]["q4"].shape[-2] == 16
 
 
+def test_partition_combine_round_trip(base):
+    """combine(partition(p)) reproduces the tree EXACTLY — leaf
+    identity for the frozen base (no copies) and value equality for
+    the adapters; the treedef survives the round trip (what the QLoRA
+    train step's grad-through-adapters plumbing rests on)."""
+    cfg, params, _ = base
+    lp = lora.loraize_params(params, rank=4)
+    adapters, frozen = lora.partition(lp)
+    # frozen carries None exactly at adapter positions
+    assert all(k.endswith("['a']") or k.endswith("['b']")
+               for k in adapters)
+    back = lora.combine(adapters, frozen)
+    assert jax.tree_util.tree_structure(back) == \
+        jax.tree_util.tree_structure(lp)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(lp),
+            jax.tree_util.tree_leaves_with_path(back)):
+        assert jax.tree_util.keystr(pa) == jax.tree_util.keystr(pb)
+        assert (np.asarray(a) == np.asarray(b)).all(), \
+            jax.tree_util.keystr(pa)
+    # base leaves pass through by REFERENCE (partition never copies)
+    assert back["layers"]["wq"]["w"] is lp["layers"]["wq"]["w"]
+
+
+def test_merge_requantize_int8_matches_dense_merge(base):
+    """merge_lora(requantize_bits=8) == quantize(merge_lora()) — the
+    requantize path must be the dense merge followed by the ONE int8
+    quantizer, not a second quantization recipe."""
+    cfg, params, _ = base
+    qp = lora.loraize_params(quant.quantize_params(params), rank=4)
+    # give the adapters nonzero effect so the merge isn't trivial
+    qp["layers"]["wq"]["b"] = (
+        jax.random.normal(jax.random.PRNGKey(3),
+                          qp["layers"]["wq"]["b"].shape,
+                          jnp.float32) * 0.01
+    ).astype(qp["layers"]["wq"]["b"].dtype)
+    merged_q = lora.merge_lora(qp, requantize_bits=8)
+    dense = lora.merge_lora(qp)
+    q_ref, s_ref = quant.quantize(dense["layers"]["wq"])
+    assert (np.asarray(merged_q["layers"]["wq"]["q"])
+            == np.asarray(q_ref)).all()
+    np.testing.assert_allclose(np.asarray(merged_q["layers"]["wq"]["s"]),
+                               np.asarray(s_ref))
+
+
+def test_lora_mask_treedef_agreement(base):
+    """lora_mask returns the SAME treedef as its input (the optax
+    multi_transform contract) for plain, loraized, and QLoRA trees."""
+    cfg, params, _ = base
+    for tree in (params, lora.loraize_params(params, rank=2),
+                 lora.loraize_params(quant.quantize_params(params),
+                                     rank=2)):
+        mask = lora.lora_mask(tree)
+        assert jax.tree_util.tree_structure(mask) == \
+            jax.tree_util.tree_structure(tree)
+        leaves = jax.tree_util.tree_leaves(mask)
+        assert all(isinstance(v, bool) for v in leaves)
+
+
+def test_batched_adapter_matmul_matches_per_row_lora(base):
+    """The BGMV gather == the train-time per-leaf LoRA apply
+    (matmul_maybe_q) row by row, and the identity row's delta is
+    exactly zero."""
+    cfg, params, _ = base
+    rank, n = 4, 3
+    d_in, d_out = cfg.d_model, cfg.d_ff
+    key = jax.random.PRNGKey(5)
+    ka, kb, kx = jax.random.split(key, 3)
+    a_pool = jax.random.normal(ka, (n, d_in, rank), jnp.float32)
+    b_pool = jax.random.normal(kb, (n, rank, d_out), jnp.float32)
+    a_pool = a_pool.at[0].set(0.0)
+    b_pool = b_pool.at[0].set(0.0)
+    scales = jnp.asarray([0.0, 2.0, 0.5], jnp.float32)
+    x = jax.random.normal(kx, (3, 5, d_in), jnp.float32)
+    ids = jnp.asarray([1, 0, 2], jnp.int32)
+    w = jnp.zeros((d_in, d_out), jnp.float32)
+    delta = lora.batched_adapter_matmul(x, a_pool, b_pool, scales, ids)
+    assert (np.asarray(delta[1]) == 0.0).all(), "identity row delta"
+    for row, idx in ((0, 1), (2, 2)):
+        leaf = {"w": w, "a": a_pool[idx], "b": b_pool[idx],
+                "scale": scales[idx]}
+        ref = quant.matmul_maybe_q(x[row:row + 1], leaf)
+        np.testing.assert_allclose(np.asarray(delta[row:row + 1]),
+                                   np.asarray(ref), rtol=1e-6)
+
+
+def test_adapter_pool_byte_pricing(base):
+    """The serving pool's byte model: entry bytes = sum of a/b leaves
+    + scale, pool bytes scale linearly, and the rank-8 capacity win
+    over merged-per-adapter models clears 4x (the acceptance bar)."""
+    cfg, params, _ = base
+    pool = lora.init_adapter_pool_arrays(cfg, rank=8, n_adapters=3)
+    measured = sum(
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(pool))
+    assert measured == lora.adapter_pool_bytes(cfg, 8, 3)
+    assert lora.adapter_pool_bytes(cfg, 8, 6) == \
+        2 * lora.adapter_pool_bytes(cfg, 8, 3)
+    assert lora.merged_adapter_bytes(cfg) >= \
+        4 * lora.adapter_entry_bytes(cfg, 8)
+    with pytest.raises(ValueError):
+        lora.init_adapter_pool_arrays(cfg, rank=0, n_adapters=2)
+    with pytest.raises(ValueError):
+        lora.init_adapter_pool_arrays(cfg, rank=4, n_adapters=0)
+
+
 def test_lora_train_step_remat_variants(base):
     """remat plumbing: layer/full rematerialized LoRA steps produce the
     same loss trajectory as remat='none' (recompute changes memory, not

@@ -1,0 +1,511 @@
+"""Batched multi-adapter LoRA serving (round 20).
+
+The exactness contract under test:
+
+* IDENTITY — a batcher built WITH an adapter pool but serving only
+  base (adapter-0) requests produces bit-identical streams to a
+  pool-less batcher, on dense AND paged storage (the zero identity
+  row's delta is exactly 0.0, and a pool-less batcher traces the
+  byte-identical pre-adapter program);
+* ROW INDEPENDENCE — a mixed-adapter batch's per-row streams equal
+  the same requests served SOLO with their adapter, across every
+  dispatch flavor (ticked, fused, mixed, spec) — the gather and the
+  two skinny matmuls are row-local, so co-tenants cannot perturb each
+  other (f32 tiny config: exact equality);
+* ONE DISPATCH PER ROUND survives with adapters active (the wrap
+  lists derive from dispatch_audit.ENTRY_CONTRACT, so the runtime
+  count and the static audit prove the same invariant);
+* RESIDENCY — LRU eviction never victimizes a pinned adapter, pool
+  pressure refuses admission (and the llm server answers 503 +
+  Retry-After), and migration carries the adapter by NAME.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpushare.models import transformer
+from tpushare.ops import lora
+from tpushare.serving import metrics
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _mk(params, cfg, paged, **kw):
+    if paged:
+        return PagedContinuousBatcher(params, cfg, n_slots=3,
+                                      page_size=4, **kw)
+    return ContinuousBatcher(params, cfg, n_slots=3, **kw)
+
+
+def _drain(b, mode="tick", max_rounds=500):
+    for _ in range(max_rounds):
+        if not b.slots and not b.prefilling:
+            return b
+        if mode == "mixed":
+            b.tick_mixed(2, chunk=4, budget=8)
+        elif mode == "spec":
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick_spec(2, k=3)
+        elif mode == "fused":
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick_fused(2)
+        else:
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick()
+    raise RuntimeError("did not drain")
+
+
+def _solo(params, cfg, paged, prompt, gen, adapter, mode="tick"):
+    b = _mk(params, cfg, paged, adapter_slots=2,
+            spec_k=3 if mode == "spec" else 0)
+    rid = b.admit(prompt, gen, adapter=adapter)
+    _drain(b, mode)
+    return b.completed[rid]
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_adapter0_streams_bit_identical_to_pool_less(model, paged):
+    """Acceptance bar: adapter-0 (identity) streams == pre-PR streams
+    on both storage flavors, across ticked/fused/mixed dispatch."""
+    params, cfg = model
+    prompts = [([1, 2, 3], 8), ([4, 5, 6, 7], 8)]
+    for mode in ("tick", "fused", "mixed"):
+        ref = _mk(params, cfg, paged)
+        rids = [ref.admit_chunked(p, n, chunk=4) for p, n in prompts]
+        _drain(ref, mode)
+        got = _mk(params, cfg, paged, adapter_slots=2)
+        gids = [got.admit_chunked(p, n, chunk=4) for p, n in prompts]
+        _drain(got, mode)
+        for r, g in zip(rids, gids):
+            assert got.completed[g] == ref.completed[r], \
+                f"identity broke on {mode} (paged={paged})"
+
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("mode", ["tick", "fused", "mixed", "spec"])
+def test_mixed_adapter_batch_rows_equal_solo(model, paged, mode):
+    """A mixed batch (adapter A, adapter B, base) per-row equals the
+    same rows served solo with their adapter — on every dispatch
+    flavor, exact on the f32 tiny config."""
+    params, cfg = model
+    reqs = [([1, 2, 3] * 3, 8, "alice"), ([4, 5, 6, 7], 8, "bob"),
+            ([8, 9], 8, None)]
+    b = _mk(params, cfg, paged, adapter_slots=2,
+            spec_k=3 if mode == "spec" else 0)
+    rids = [b.admit_chunked(p, n, chunk=4, adapter=a)
+            for p, n, a in reqs]
+    _drain(b, mode)
+    for rid, (p, n, a) in zip(rids, reqs):
+        assert b.completed[rid] == _solo(params, cfg, paged, p, n, a,
+                                         mode), \
+            f"row (adapter={a}) drifted in the mixed batch ({mode})"
+    # the adapters actually do something: alice's stream differs from
+    # the base stream for the same prompt
+    assert b.completed[rids[0]] != _solo(params, cfg, paged,
+                                         reqs[0][0], 8, None, mode)
+
+
+def test_bf16_mixed_batch_greedy_agreement():
+    """The bf16 arm of the exactness contract (agreement-pinned like
+    int8/pallas): greedy streams of a mixed-adapter bf16 batch agree
+    with the same rows served solo — the gather and skinny matmuls
+    stay row-local even in half precision."""
+    import jax.numpy as jnp
+
+    cfg = transformer.tiny(max_seq=64, dtype=jnp.bfloat16)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    b = ContinuousBatcher(params, cfg, n_slots=3, adapter_slots=2)
+    rids = [b.admit([1, 2, 3], 6, adapter="alice"),
+            b.admit([4, 5, 6], 6, adapter="bob"),
+            b.admit([7, 8], 6)]
+    _drain(b)
+    for rid, (p, a) in zip(rids, [([1, 2, 3], "alice"),
+                                  ([4, 5, 6], "bob"), ([7, 8], None)]):
+        solo = ContinuousBatcher(params, cfg, n_slots=3,
+                                 adapter_slots=2)
+        sr = solo.admit(p, 6, adapter=a)
+        _drain(solo)
+        assert b.completed[rid] == solo.completed[sr], \
+            f"bf16 greedy agreement broke for adapter={a}"
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_one_dispatch_per_mixed_round_with_adapters(model, paged):
+    """The round-7 invariant with adapters active: a steady mixed
+    round carrying mixed-adapter prefill AND decode rows is exactly
+    ONE device dispatch (wrap lists derive from the audited
+    contract)."""
+    from tpushare.analysis import dispatch_audit
+
+    params, cfg = model
+    b = _mk(params, cfg, paged, adapter_slots=2)
+    b.admit([1, 2, 3], 12, adapter="alice")     # decoding throughout
+    b.admit_chunked([5] * 20, 3, chunk=4, adapter="bob")
+    b.admit_chunked([6] * 20, 3, chunk=4)
+    counts = {"mixed": 0, "other": 0}
+    steady = dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"]
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    wrap(steady, "mixed")
+    for hook in (dispatch_audit.TICK_HOOKS
+                 + dispatch_audit.PREFILL_HOOKS):
+        if hook != steady:
+            wrap(hook, "other")
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    assert rounds > 1
+    assert counts["mixed"] == rounds, \
+        "not one dispatch per adapter-threaded mixed round"
+    assert counts["other"] == 0, \
+        "an adapter mixed round leaked an extra dispatch"
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_one_dispatch_per_spec_round_with_adapters(model, paged):
+    """tick_spec with adapters stays one dispatch per call and greedy-
+    exact vs the ticked path with the same adapters."""
+    from tpushare.analysis import dispatch_audit
+
+    params, cfg = model
+    prompt = [1 + (j % 4) for j in range(12)]
+    ref = _mk(params, cfg, paged, adapter_slots=2)
+    rr = ref.admit(prompt, 9, adapter="alice")
+    _drain(ref, "tick")
+    b = _mk(params, cfg, paged, adapter_slots=2, spec_k=3)
+    rid = b.admit(prompt, 9, adapter="alice")
+    steady = dispatch_audit.ENTRY_CONTRACT["tick_spec"]["steady"]
+    n = [0]
+    real = getattr(b, steady)
+
+    def counted(*a, **k):
+        n[0] += 1
+        return real(*a, **k)
+
+    setattr(b, steady, counted)
+    calls = 0
+    while b.slots:
+        b.tick_spec(2, k=3)
+        calls += 1
+    assert n[0] == calls, "spec round with adapters != one dispatch"
+    assert b.completed[rid] == ref.completed[rr], \
+        "speculation broke greedy exactness under adapters"
+
+
+def test_pool_lru_pinning_and_metrics(model):
+    """Eviction skips pinned rows, pressure reads correctly, loads/
+    evictions count, and the byte gauge prices through ops.lora."""
+    params, cfg = model
+    loads0 = metrics.ADAPTER_LOADS.value(reason="miss")
+    ev0 = metrics.ADAPTER_EVICTIONS.value(reason="capacity")
+    b = ContinuousBatcher(params, cfg, n_slots=3, adapter_slots=2,
+                          adapter_rank=4)
+    pool = b.adapter_pool
+    assert metrics.ADAPTER_POOL_BYTES.value() == \
+        lora.adapter_pool_bytes(cfg, 4, 3)
+    i1 = pool.acquire("a1")
+    i2 = pool.acquire("a2")
+    assert metrics.ADAPTER_LOADS.value(reason="miss") == loads0 + 2
+    # both pinned: a third name refuses and reads as pressure
+    assert pool.acquire("a3") is None
+    assert pool.pressure("a3") and not pool.pressure("a1")
+    assert b.adapter_pressure("a3")
+    # unpin one -> LRU eviction makes room, pinned row untouched
+    pool.release(i1)
+    i3 = pool.acquire("a3")
+    assert i3 == i1 and pool.name_of(i2) == "a2"
+    assert metrics.ADAPTER_EVICTIONS.value(reason="capacity") == ev0 + 1
+    info = b.storage_info()
+    assert info["adapter_slots"] == 2 and info["adapter_rank"] == 4
+    # the capacity story: pool bytes per adapter << merged model bytes
+    assert info["merged_bytes_per_adapter"] \
+        >= 4 * info["bytes_per_adapter"]
+
+
+def test_admission_rolls_back_pin_on_storage_refusal(model):
+    """A page-pool refusal after the adapter pin must unpin (the pin
+    would otherwise leak until process exit)."""
+    params, cfg = model
+    # a request that FITS the pool's capacity but not its current free
+    # pages (a first admission holds most of them): refusal happens at
+    # _reserve, AFTER the adapter pin
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                               n_pages=8, adapter_slots=1)
+    assert b.admit([1] * 8, 16) is not None       # holds 6 of 7 pages
+    rid = b.admit([2] * 8, 16, adapter="alice")   # needs 6, 1 free
+    assert rid is None
+    assert b.adapter_pool._rows[1]["refs"] == 0, "pin leaked"
+
+
+def test_validate_adapter_without_pool_raises(model):
+    params, cfg = model
+    b = ContinuousBatcher(params, cfg, n_slots=2)
+    with pytest.raises(ValueError, match="adapter"):
+        b.admit([1, 2], 4, adapter="alice")
+    with pytest.raises(ValueError, match="non-empty"):
+        ContinuousBatcher(params, cfg, n_slots=2,
+                          adapter_slots=1).admit([1, 2], 4, adapter="")
+
+
+def test_migration_carries_adapter_by_name(model):
+    """export -> import on a fresh pool: the receiver re-acquires the
+    adapter by name and the migrated stream stays token-identical to
+    an unmigrated run."""
+    params, cfg = model
+    src = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                                 adapter_slots=2)
+    rid = src.admit([1, 2, 3, 4], 10, adapter="alice")
+    for _ in range(3):
+        src.tick()
+    blob = src.export_session(rid)
+    src.pop_session(rid)
+    assert src.adapter_pool._rows[1]["refs"] == 0, \
+        "pop_session left the adapter pinned"
+    dst = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                                 adapter_slots=2)
+    got = dst.import_session(blob)
+    assert got is not None
+    assert dst.adapter_pool.name_of(1) == "alice"
+    _drain(dst)
+    assert dst.completed[got] == _solo(params, cfg, True, [1, 2, 3, 4],
+                                       10, "alice")
+    # a receiver WITHOUT a pool refuses the blob as a config mismatch
+    from tpushare.serving import migrate
+    bare = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4)
+    with pytest.raises(migrate.ConfigMismatch):
+        bare.import_session(blob)
+
+
+def test_service_and_llm_server_adapters(model):
+    """End-to-end: the service threads adapters submit->stream, the
+    llm server accepts {"adapter": name}, 400s without a pool, and
+    503s (Retry-After) on pool pressure."""
+    import json
+    import urllib.request
+    import urllib.error
+
+    from tpushare.serving.llm import LLMServer
+
+    params, cfg = model
+    srv = LLMServer(cfg, params, port=0, addr="127.0.0.1", n_slots=2,
+                    adapter_slots=2).start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return resp.status, json.loads(resp.read()), \
+                        resp.headers
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read()), e.headers
+
+        code, out, _ = post({"tokens": [[1, 2, 3]],
+                             "max_new_tokens": 6,
+                             "adapter": "alice"})
+        assert code == 200
+        assert out["tokens"][0] == _solo(params, cfg, False, [1, 2, 3],
+                                         6, "alice")
+        base_code, base_out, _ = post({"tokens": [[1, 2, 3]],
+                                       "max_new_tokens": 6})
+        assert base_code == 200
+        assert base_out["tokens"][0] != out["tokens"][0]
+        # pressure -> 503 + Retry-After (verdict pinned for the test)
+        srv._service.adapter_pressure = lambda a: bool(a)
+        code, out, headers = post({"tokens": [[1, 2, 3]],
+                                   "max_new_tokens": 4,
+                                   "adapter": "carol"})
+        assert code == 503 and headers.get("Retry-After")
+    finally:
+        srv.stop()
+
+    # no pool -> 400
+    srv2 = LLMServer(cfg, params, port=0, addr="127.0.0.1",
+                     n_slots=2).start()
+    try:
+        import json as _json
+        import urllib.request as _u
+        req = _u.Request(
+            f"http://127.0.0.1:{srv2.port}/generate",
+            data=_json.dumps({"tokens": [[1, 2]], "adapter": "x",
+                              "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with _u.urlopen(req, timeout=60) as resp:
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 400
+    finally:
+        srv2.stop()
+
+
+def test_prefix_cache_never_crosses_adapters(model):
+    """Cached prefix K/V carries the DONOR's adapter deltas, so the
+    registry is namespaced by adapter: a base request must not map an
+    adapter-donor's pages (and vice versa), while same-adapter reuse
+    still hits — exactness first, reuse second."""
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=4,
+                               prefix_cache=True, adapter_slots=2)
+    shared = [5, 6, 7, 5, 6, 7, 5, 6]           # two full pages
+    # donor: adapter 'alice' completes and donates its prompt pages
+    r0 = b.admit(shared + [9], 4, adapter="alice")
+    _drain(b)
+    assert b._prefixes, "donation never registered"
+    hits0 = metrics.PREFIX_HITS.value()
+    # a BASE request with the same prefix must not map alice's pages
+    r1 = b.admit(shared + [9], 4)
+    _drain(b)
+    assert metrics.PREFIX_HITS.value() == hits0, \
+        "base request mapped an adapter-tainted cached prefix"
+    assert b.completed[r1] == _solo(params, cfg, True, shared + [9],
+                                    4, None), \
+        "base stream corrupted by adapter-donor prefix pages"
+    # a SAME-adapter request does reuse, and stays exact
+    r2 = b.admit(shared + [3], 4, adapter="alice")
+    _drain(b)
+    assert metrics.PREFIX_HITS.value() == hits0 + 1, \
+        "same-adapter prefix reuse stopped hitting"
+    assert b.completed[r2] == _solo(params, cfg, True, shared + [3],
+                                    4, "alice")
+
+
+def test_loader_failure_aborts_request_not_service(model):
+    """A failing adapter LOADER (bad name, missing weights) aborts the
+    ONE request naming it — the serving loop survives and keeps
+    serving every other tenant."""
+    params, cfg = model
+
+    def loader(name):
+        if name == "broken":
+            raise FileNotFoundError("no such adapter weights")
+        from tpushare.ops import lora as ops_lora
+        return ops_lora.make_adapter(cfg, 4, seed=1)
+
+    from tpushare.serving.adapters import AdapterLoadError
+    b = ContinuousBatcher(params, cfg, n_slots=2, adapter_slots=2,
+                          adapter_rank=4, adapter_loader=loader)
+    with pytest.raises(AdapterLoadError):
+        b.admit([1, 2], 4, adapter="broken")
+    svc = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                            decode_chunk=2, adapter_slots=2,
+                            adapter_rank=4)
+    svc._batcher.adapter_pool._loader = loader
+    svc.start()
+    try:
+        bad = svc.submit([1, 2, 3], 4, adapter="broken")
+        assert bad.get(timeout=60) is None, \
+            "broken-adapter request not aborted"
+        ok = svc.submit([1, 2, 3], 4, adapter="fine")
+        out = ok.get(timeout=60)
+        assert out is not None and len(out) == 7, \
+            "service loop died after a loader failure"
+    finally:
+        svc.stop()
+
+
+def test_adapter_spill_can_help_reads_decoding_pins(model):
+    """The spill-gating helper: True only while a DECODING session
+    holds an adapter pin (the one export that can release a pin)."""
+    params, cfg = model
+    b = PagedContinuousBatcher(params, cfg, n_slots=3, page_size=4,
+                               adapter_slots=2)
+    b.admit([1, 2, 3], 8)                       # base decoder
+    assert not b.adapter_spill_can_help()
+    rid = b.admit([4, 5, 6], 8, adapter="alice")
+    assert b.adapter_spill_can_help()
+    b.cancel(rid)
+    assert not b.adapter_spill_can_help()
+
+
+def test_bench_scenario_smoke(model):
+    """The bench_all multi-adapter scenario runs at tiny sizes and
+    reports both arms with their dispatch counts (tier-1-safe; the
+    >=1.5x ratio claim is for the committed BENCH run)."""
+    import bench_all
+
+    params, cfg = model
+    out = bench_all.lora_multi_adapter_bench(
+        params, cfg, slots=2, rank=2, n_adapters=2, page_size=4,
+        prompt_len=4, gen=5, decode_chunk=2, reps=1)
+    for arm in ("batched", "sequential"):
+        assert out[arm]["tokens_per_s"] > 0
+    assert out["batched"]["dispatches"] < out["sequential"]["dispatches"]
+    assert out["capacity"]["adapters_per_merged_copy"] >= 4
+
+
+def test_router_adapter_affinity(model):
+    """Same-adapter traffic sticks to the replica that first served it
+    (the hit counter moves); distinct-adapter traffic still spreads."""
+    from tpushare.serving.router import FleetRouter
+    import json
+    import urllib.request
+    from fakes.replica import FakeReplica
+
+    r0 = FakeReplica("a").start()
+    r1 = FakeReplica("b").start()
+    router = FleetRouter([("a", f"127.0.0.1:{r0.port}"),
+                          ("b", f"127.0.0.1:{r1.port}")],
+                         port=0, scrape_interval_s=30.0).start()
+    try:
+        router.scrape_once()
+
+        def post(adapter, salt):
+            body = {"tokens": [[salt, salt + 1]], "max_new_tokens": 3,
+                    "adapter": adapter}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        hits0 = sum(
+            metrics.ROUTER_ADAPTER_AFFINITY_HITS.value(replica=n)
+            for n in ("a", "b"))
+        post("tenant-7", 3)              # registers the adapter hash
+        first_holder = max(router._replicas, key=lambda r: r.requests)
+        for salt in (9, 15, 21):         # distinct prompts, one adapter
+            post("tenant-7", salt)
+        hits1 = sum(
+            metrics.ROUTER_ADAPTER_AFFINITY_HITS.value(replica=n)
+            for n in ("a", "b"))
+        assert hits1 - hits0 >= 3, "adapter affinity never hit"
+        assert first_holder.requests >= 4, \
+            "same-adapter traffic did not stick to its replica"
+    finally:
+        router.stop()
+        r0.stop()
+        r1.stop()

@@ -225,6 +225,9 @@ ENUMERATED_VALUES = {
         {"over_share"},
     ("tpushare_tenant_policy_info", "policy"):
         {"off", "observe", "enforce"},
+    # keep in sync with the serving.adapters constants (enum-pinned)
+    ("tpushare_adapter_loads_total", "reason"): {"miss"},
+    ("tpushare_adapter_evictions_total", "reason"): {"capacity"},
 }
 
 # -- enum pins (round-18 satellite): ONE declarative table ------------------
@@ -256,6 +259,10 @@ ENUM_PINS = {
         ("tpushare.serving.migrate", "MIGRATION_DIRECTIONS"),
     ("tpushare_tenant_admission_refused_total", "reason"):
         ("tpushare.serving.policy", "POLICY_REFUSAL_REASONS"),
+    ("tpushare_adapter_loads_total", "reason"):
+        ("tpushare.serving.adapters", "ADAPTER_LOAD_REASONS"),
+    ("tpushare_adapter_evictions_total", "reason"):
+        ("tpushare.serving.adapters", "ADAPTER_EVICTION_REASONS"),
 }
 
 
@@ -363,8 +370,21 @@ def test_router_series_registered_with_contracted_names():
     assert by_name.get("tpushare_router_retries_total") == "counter"
     assert by_name.get(
         "tpushare_router_affinity_hits_total") == "counter"
+    assert by_name.get(
+        "tpushare_router_adapter_affinity_hits_total") == "counter"
     assert by_name.get("tpushare_router_evictions_total") == "counter"
     assert by_name.get("tpushare_router_replica_up") == "gauge"
+
+
+def test_adapter_series_registered_with_contracted_names():
+    """The multi-adapter serving plane's series exist under their
+    contracted names and kinds (what the ADAPTERS column in `kubectl
+    inspect tpushare --metrics` and the capacity dashboards key on)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_adapter_pool_bytes") == "gauge"
+    assert by_name.get("tpushare_adapter_resident") == "gauge"
+    assert by_name.get("tpushare_adapter_loads_total") == "counter"
+    assert by_name.get("tpushare_adapter_evictions_total") == "counter"
 
 
 def _observed_label_sets():

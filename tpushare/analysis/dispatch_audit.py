@@ -92,6 +92,16 @@ PREFILL_HOOKS = ("_prefill_into", "_prefill_chunk_into")
 #: for counting purposes (host key wrapping rides the next dispatch)
 AUX_JIT = ("_wrap_keys",)
 
+#: HOST-side operand-prep helpers the tick hooks call to assemble the
+#: multi-adapter pool operands (round 20).  Audited like hook bodies —
+#: NEVER a jitted dispatch, never a host fetch, never a hook call: the
+#: adapter-ID gather itself is HOOK-INTERIOR (it runs inside the one
+#: jitted program each hook dispatches), so the prep helper only hands
+#: device handles through.  A dispatch hiding here would be a second
+#: device program per round — exactly the drift the dispatch-count
+#: rule exists to forbid.
+OPERAND_HELPERS = ("_adapter_operands",)
+
 #: receiver-name fragments that identify a tenant-policy pacing object
 #: (serving/policy.py DispatchPacer / PolicyClient) for the
 #: pacing-guard rule
@@ -369,6 +379,34 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                 f"guard, BEFORE the hook's jitted program (the guard's "
                 f"own pre-dispatch hook is the sanctioned site)"))
 
+    # -- adapter-operand helpers: host handle passing ONLY -------------
+    for helper in OPERAND_HELPERS:
+        if helper not in flavor.table:
+            continue
+        fn, facts = flavor.table[helper]
+        s = scan(helper)
+        for n, ln, _ in s.fn_calls:
+            if n in facts.jitted and n not in AUX_JIT:
+                out.append(Finding(
+                    "adapter-operand", path_of(helper), ln,
+                    f"{flavor.name} operand helper {helper} dispatches "
+                    f"jitted program {n} — adapter operand prep is "
+                    f"host-side handle passing; the gather is "
+                    f"hook-interior (inside the hook's one program)"))
+        for n, ln, _ in s.self_calls:
+            if n in TICK_HOOKS or n in PREFILL_HOOKS:
+                out.append(Finding(
+                    "adapter-operand", path_of(helper), ln,
+                    f"{flavor.name} operand helper {helper} calls hook "
+                    f"{n} — operand prep must not dispatch"))
+        for ln, _, _, kind in s.fetches:
+            if kind == "cast":
+                continue
+            out.append(Finding(
+                "adapter-operand", path_of(helper), ln,
+                f"{flavor.name} operand helper {helper} host-fetches — "
+                f"it hands device handles through, never synchronizes"))
+
     # -- guard discipline: hook call sites outside hooks ---------------
     for method in flavor.table:
         if method in TICK_HOOKS or method in PREFILL_HOOKS:
@@ -571,7 +609,7 @@ def cross_check_live() -> None:
         if not hasattr(continuous.ContinuousBatcher, entry):
             raise DispatchDriftError(
                 f"contract entry {entry} missing on ContinuousBatcher")
-    for hook in TICK_HOOKS + PREFILL_HOOKS:
+    for hook in TICK_HOOKS + PREFILL_HOOKS + OPERAND_HELPERS:
         for cls in (continuous.ContinuousBatcher,
                     paged.PagedContinuousBatcher):
             if not hasattr(cls, hook):

@@ -680,6 +680,7 @@ table.
 | `dispatch-fetch` | `np.asarray` fetches of a hook's results stay inside the guard with-block — the fetch is the true barrier (CLAUDE.md) |
 | `jit-registry` | every `@jax.jit` definition in the serving modules is on the retrace watch list (`_JIT_ENTRIES` / `register_jit_entries`), so `tpushare_jit_retraces_total` sees every program |
 | `pacing-guard` | a tenant-policy pacing `acquire` (`*policy*`/`*pacer*` receivers) in the serving modules sits inside a `dispatch_guard` with-block and never inside a tick hook — the sanctioned pacing site is the guard's own pre-dispatch hook, an unguarded sleep stalls the loop invisibly, and the policy layer adds ZERO device dispatches |
+| `adapter-operand` | the multi-adapter operand helpers (`_adapter_operands`) are host-side handle passing ONLY — no jitted dispatch, no hook call, no host fetch may hide in operand prep: the per-row adapter gather is hook-interior (inside the hook's one jitted program), so the adapter plane adds ZERO dispatches per round |
 """
 
 

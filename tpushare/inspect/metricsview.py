@@ -159,6 +159,19 @@ def summarize_serving(parsed: dict) -> dict:
         "spec_fallbacks": sum(
             v for _, v in parsed["samples"].get(
                 "tpushare_spec_fallback_total", ())) or None,
+        # multi-adapter LoRA serving (round 20): named adapters
+        # resident in the pool, its HBM footprint, and the load/evict
+        # churn (evictions rising under steady traffic = the pool is
+        # thrashing — raise --adapter-slots or add replicas)
+        "adapters_resident": _gauge(parsed, "tpushare_adapter_resident"),
+        "adapter_pool_bytes": _gauge(parsed,
+                                     "tpushare_adapter_pool_bytes"),
+        "adapter_loads": sum(
+            v for _, v in parsed["samples"].get(
+                "tpushare_adapter_loads_total", ())) or None,
+        "adapter_evictions": sum(
+            v for _, v in parsed["samples"].get(
+                "tpushare_adapter_evictions_total", ())) or None,
     }
 
 
@@ -234,6 +247,8 @@ def summarize_fleet(parsed: dict) -> dict:
 
     fold("tpushare_router_requests_total", "requests")
     fold("tpushare_router_affinity_hits_total", "affinity_hits")
+    fold("tpushare_router_adapter_affinity_hits_total",
+         "adapter_affinity_hits")
     fold("tpushare_router_evictions_total", "evictions")
     for labels, value in parsed["samples"].get(
             "tpushare_router_replica_up", ()):
@@ -307,13 +322,13 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "ATTN", "STRIPE", "SPEC", "PREFILL Q",
-              "BUDGET%"]]
+              "KV BYTES(dtype)", "ATTN", "STRIPE", "SPEC", "ADAPTERS",
+              "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
                           "-", "-", "-", "-", "-", "-", "-", "-", "-",
-                          "-"])
+                          "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -345,6 +360,14 @@ def render_metrics_table(
         if summary.get("spec_fallbacks"):
             spec = (("" if spec == "-" else spec + " ")
                     + f"(fb {int(summary['spec_fallbacks'])})")
+        # ADAPTERS: resident named adapters, with eviction churn
+        # alongside (a nonzero eviction count under steady traffic is
+        # the pool-thrash signal this column exists to surface)
+        adapters = "-"
+        if summary.get("adapters_resident") is not None:
+            adapters = f"{int(summary['adapters_resident'])}"
+            if summary.get("adapter_evictions"):
+                adapters += f" (ev {int(summary['adapter_evictions'])})"
         health = (summary.get("health") or "-").upper()
         table.append([
             name, addr, health,
@@ -357,6 +380,7 @@ def render_metrics_table(
             attn,
             stripe,
             spec,
+            adapters,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])
@@ -433,12 +457,12 @@ def render_fleet_table(
     the node-wide re-dispatch count and the KV-page migration /
     spill-tier tallies ride the first row."""
     table = [["NAME", "REPLICA", "HEALTH", "REQUESTS", "SHARE",
-              "AFFINITY HITS", "EVICTIONS", "RETRIES",
+              "AFFINITY HITS", "ADAPTER HITS", "EVICTIONS", "RETRIES",
               "MIGR(out/in)", "SPILL"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, "-", "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-"])
             continue
         replicas = summary["replicas"]
         migr = "-"
@@ -454,7 +478,7 @@ def render_fleet_table(
             if summary.get("spill_bytes"):
                 spill += f" ({_fmt_bytes(summary['spill_bytes'])})"
         if not replicas:
-            table.append([name, "-", "-", "-", "-", "-", "-",
+            table.append([name, "-", "-", "-", "-", "-", "-", "-",
                           "no router", migr, spill])
             continue
         retries = summary.get("retries")
@@ -469,6 +493,7 @@ def render_fleet_table(
                 _fmt(r.get("requests"), digits=0),
                 _fmt(r.get("share"), 100.0, "%", 0),
                 _fmt(r.get("affinity_hits"), digits=0),
+                _fmt(r.get("adapter_affinity_hits"), digits=0),
                 _fmt(r.get("evictions"), digits=0),
                 (_fmt(retries, digits=0) if first else ""),
                 (migr if first else ""),

@@ -253,13 +253,53 @@ def _kv_unpack(store, cfg: ModelConfig):
     return store
 
 
-def _qkv(p, x, cfg: ModelConfig, positions):
+# ---------------------------------------------------------------------------
+# Batched multi-adapter (LoRA) serving plumbing
+# ---------------------------------------------------------------------------
+# ``lora`` threads as ``None`` (byte-identical pre-adapter trace: the
+# helpers degenerate to the exact `_mm` call) or as the 3-tuple
+# ``(ad, scales, adapter_ids)`` where ``ad`` is ONE layer's slice of
+# the stacked serving pool ({leaf: {"a": [N, d_in, r], "b": [N, r,
+# d_out]}}), ``scales`` [N] f32, and ``adapter_ids`` [B] int32 names
+# each batch row's adapter (0 = the all-zero identity row).  The
+# gather and the two skinny matmuls ride INSIDE the jitted forward —
+# the serving plane only hands operands through (dispatch-audited).
+
+def _adapter_scan_split(adapters):
+    """Split a stacked serving pool into (per-layer scanned leaves,
+    scale vector): the a/b buffers carry a leading L axis and join the
+    layer ``lax.scan`` xs; the [N] scale is layer-invariant and rides
+    the closure.  (None, None) when no pool is threading through —
+    None is an EMPTY pytree, so the scan xs keep one structure and the
+    no-adapter trace stays byte-identical."""
+    if adapters is None:
+        return None, None
+    return ({k: v for k, v in adapters.items() if k != "scale"},
+            adapters["scale"])
+
+
+def _mm_ad(x, w, lora, name: str):
+    """``_mm`` plus the per-row gathered adapter delta when this leaf
+    carries adapters (the one composition point — base quantization
+    recurses inside ``_mm`` unchanged, QLoRA-style)."""
+    y = _mm(x, w)
+    if lora is None:
+        return y
+    ad, scales, ids = lora
+    if name not in ad:
+        return y
+    from ..ops.lora import batched_adapter_matmul
+    return y + batched_adapter_matmul(x, ad[name]["a"], ad[name]["b"],
+                                      scales, ids)
+
+
+def _qkv(p, x, cfg: ModelConfig, positions, lora=None):
     """Project + RoPE: x [B,S,d] -> q [B,H,S,D], k/v [B,Hkv,S,D]."""
     b, s, _ = x.shape
     h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q = _mm(x, p["wq"]).reshape(b, s, h, hd)
-    k = _mm(x, p["wk"]).reshape(b, s, hkv, hd)
-    v = _mm(x, p["wv"]).reshape(b, s, hkv, hd)
+    q = _mm_ad(x, p["wq"], lora, "wq").reshape(b, s, h, hd)
+    k = _mm_ad(x, p["wk"], lora, "wk").reshape(b, s, hkv, hd)
+    v = _mm_ad(x, p["wv"], lora, "wv").reshape(b, s, hkv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     return (q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
@@ -304,7 +344,8 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                   cache_len: Optional[jnp.ndarray] = None,
                   attention_fn=None,
                   kv_write_len=None,
-                  mesh=None):
+                  mesh=None,
+                  lora=None):
     """Dense attention step: (o [B,H,S,D] pre-projection, new_cache).
 
     ``kv_write_len`` (traced scalar, ROLLING caches only): number of
@@ -319,7 +360,7 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
     of the highest position ≡ slot (mod W) below the true length — so
     the next forward's k_pos reconstruction stays exact."""
     h, hkv = cfg.n_heads, cfg.n_kv_heads
-    q, k, v = _qkv(p, xin, cfg, positions)
+    q, k, v = _qkv(p, xin, cfg, positions, lora=lora)
 
     if kv_cache is not None:
         ck, cv = kv_cache          # stores: [B, Hkv, max_seq|W, D] (+s)
@@ -458,26 +499,31 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                      mesh=mesh), None
 
 
-def _attn_ffn(layer, x, cfg: ModelConfig, attend):
+def _attn_ffn(layer, x, cfg: ModelConfig, attend, lora=None):
     """THE pre-norm decoder layer, once: rmsnorm -> attend -> o-proj
     residual -> rmsnorm -> ffn residual.
 
     ``attend(layer, xin) -> (o [B,H,S,D] pre-projection, carry)`` plugs
     in the cache flavor (none / dense / paged); every forward variant
     routes through here so the block wiring cannot drift between them.
+    ``lora`` (see :func:`_mm_ad`) adds each row's gathered adapter
+    delta to the o-projection and FFN matmuls (the attend closure
+    threads it into :func:`_qkv` itself).
     """
     b, s, _ = x.shape
     xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
     o, carry = attend(layer, xin)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-    x = x + _mm(o, layer["wo"])
-    x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps))
+    x = x + _mm_ad(o, layer["wo"], lora, "wo")
+    x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps),
+                      lora=lora)
     return x, carry
 
 
-def ffn_block(p, x):
-    return _mm(jax.nn.silu(_mm(x, p["w_gate"])) * _mm(x, p["w_up"]),
-               p["w_down"])
+def ffn_block(p, x, lora=None):
+    g = _mm_ad(x, p["w_gate"], lora, "w_gate")
+    u = _mm_ad(x, p["w_up"], lora, "w_up")
+    return _mm_ad(jax.nn.silu(g) * u, p["w_down"], lora, "w_down")
 
 
 def forward(params, tokens, cfg: ModelConfig,
@@ -488,7 +534,9 @@ def forward(params, tokens, cfg: ModelConfig,
             remat_policy=None,
             kv_write_len=None,
             return_hidden: bool = False,
-            mesh=None):
+            mesh=None,
+            adapters=None,
+            adapter_ids=None):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -515,6 +563,12 @@ def forward(params, tokens, cfg: ModelConfig,
     back to the XLA reference (``pallas_call`` is not
     SPMD-partitionable without it).
 
+    ``adapters``/``adapter_ids`` (serving) thread the stacked
+    multi-adapter LoRA pool through every projection: each batch row's
+    adapter (id 0 = the zero identity entry) gathers from the pool
+    inside this one jitted program — see :func:`_mm_ad`.  ``None``
+    (the default) traces the exact pre-adapter program.
+
     ``remat_policy`` (no-cache path only) wraps the scanned layer body
     in per-layer ``jax.checkpoint``: the backward holds one layer's
     internals at a time plus whatever the policy saves — pass
@@ -535,33 +589,41 @@ def forward(params, tokens, cfg: ModelConfig,
             positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
 
     x = params["embed"][tokens].astype(cfg.dtype)
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
+
+    def lora_of(ad):
+        return None if ad is None else (ad, ad_scales, adapter_ids)
 
     if kv_caches is None:
-        def body(x, layer):
+        def body(x, layer_and_ad):
+            layer, ad = layer_and_ad
+            lora = lora_of(ad)
             return _attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, attention_fn=attention_fn,
-                    mesh=mesh))
+                    mesh=mesh, lora=lora), lora=lora)
 
         if remat_policy is not None:
             body = jax.checkpoint(
                 body, policy=None if remat_policy is True else remat_policy,
                 prevent_cse=False)   # scan carries already block CSE
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        x, _ = jax.lax.scan(body, x, (params["layers"], ad_scan))
         new_caches = None
     else:
         def body(x, layer_and_cache):
-            layer, ck, cv = layer_and_cache
+            layer, ad, ck, cv = layer_and_cache
+            lora = lora_of(ad)
             return _attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, kv_cache=(ck, cv),
-                    cache_len=cache_len, kv_write_len=kv_write_len))
+                    cache_len=cache_len, kv_write_len=kv_write_len,
+                    lora=lora), lora=lora)
 
         ck, cv = kv_caches
         x, (new_ck, new_cv) = jax.lax.scan(
-            body, x, (params["layers"], ck, cv))
+            body, x, (params["layers"], ad_scan, ck, cv))
         new_caches = (new_ck, new_cv)
 
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
@@ -902,7 +964,8 @@ def _sp_striped_attention(q, k_store, v_store, page_table, positions,
 
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
-                         page_table, lengths, mesh=None):
+                         page_table, lengths, mesh=None,
+                         adapters=None, adapter_ids=None):
     """One decode step for every slot against the paged pool.
 
     tokens [B, 1]; pools from :func:`init_paged_kv`; page_table
@@ -924,12 +987,14 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     page_ids = jnp.take_along_axis(
         page_table, (lengths // page)[:, None], axis=1)[:, 0]
     offsets = lengths % page
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
 
     def body(x, layer_and_pool):
-        layer, kpool, vpool = layer_and_pool
+        layer, ad, kpool, vpool = layer_and_pool
+        lora = None if ad is None else (ad, ad_scales, adapter_ids)
 
         def attend(lyr, xin):
-            q, k, v = _qkv(lyr, xin, cfg, positions)
+            q, k, v = _qkv(lyr, xin, cfg, positions, lora=lora)
             k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
             kp2 = _smap(lambda c, n: c.at[page_ids, :, offsets, :]
                         .set(n[:, :, 0, :]), kpool, k_st)
@@ -939,16 +1004,18 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend)
+        return _attn_ffn(layer, x, cfg, attend, lora=lora)
 
-    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x, (new_kp, new_vp) = jax.lax.scan(
+        body, x, (params["layers"], ad_scan, kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x, params["lm_head"])
     return logits, (new_kp, new_vp)
 
 
 def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
-                         page_table, lengths, mesh=None):
+                         page_table, lengths, mesh=None,
+                         adapters=None, adapter_ids=None):
     """Speculative VERIFY step against the paged pool: every slot's
     pending token plus its k proposal tokens scored in one forward.
 
@@ -990,12 +1057,15 @@ def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
                             axis=1),
         0)
     offs = positions % page
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
 
     def body(x, layer_and_pool):
-        layer, kpool, vpool = layer_and_pool
+        layer, ad, kpool, vpool = layer_and_pool
+        lora = None if ad is None else (ad, ad_scales, adapter_ids)
 
         def attend(lyr, xin):
-            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [B,Hkv,S,D]
+            q, k, v = _qkv(lyr, xin, cfg, positions,  # k/v [B,Hkv,S,D]
+                           lora=lora)
 
             def put(c, n):
                 # [B, Hkv, S, D] -> [B, S, Hkv, D] rides the advanced-
@@ -1009,16 +1079,18 @@ def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend)
+        return _attn_ffn(layer, x, cfg, attend, lora=lora)
 
-    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x, (new_kp, new_vp) = jax.lax.scan(
+        body, x, (params["layers"], ad_scan, kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x, params["lm_head"])
     return logits, (new_kp, new_vp)
 
 
 def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
-                                page_rows, pos, last_idx, mesh=None):
+                                page_rows, pos, last_idx, mesh=None,
+                                adapters=None, adapter_ids=None):
     """One prompt WINDOW into a slot's reserved pages at offset ``pos``.
 
     tokens [1, W] with W a multiple of the page size and ``pos``
@@ -1046,12 +1118,15 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
     x = params["embed"][tokens].astype(cfg.dtype)
     n_chunks = s // page                        # static
     first_page = pos // page                    # traced
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
 
     def body(x, layer_and_pool):
-        layer, kpool, vpool = layer_and_pool
+        layer, ad, kpool, vpool = layer_and_pool
+        lora = None if ad is None else (ad, ad_scales, adapter_ids)
 
         def attend(lyr, xin):
-            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [1, Hkv, W, D]
+            q, k, v = _qkv(lyr, xin, cfg, positions,  # [1, Hkv, W, D]
+                           lora=lora)
             k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
             kp2, vp2 = kpool, vpool
             for j in range(n_chunks):           # static page walk
@@ -1067,16 +1142,18 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
                                 cfg, mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend)
+        return _attn_ffn(layer, x, cfg, attend, lora=lora)
 
-    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x, (new_kp, new_vp) = jax.lax.scan(
+        body, x, (params["layers"], ad_scan, kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x[0, last_idx], params["lm_head"])
     return logits, (new_kp, new_vp)
 
 
 def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
-                                page_rows, pos, last_idx, mesh=None):
+                                page_rows, pos, last_idx, mesh=None,
+                                adapters=None, adapter_ids=None):
     """Coalesced MULTI-prompt prefill: one window per row, each into its
     own slot's reserved pages, in a single forward — the paged half of
     the mixed-step scheduler (one device dispatch per service round).
@@ -1118,11 +1195,15 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
         return (t.reshape(r, hh, n_chunks, page, d)
                 .transpose(0, 2, 1, 3, 4).reshape(r * n_chunks, hh, page, d))
 
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
+
     def body(x, layer_and_pool):
-        layer, kpool, vpool = layer_and_pool
+        layer, ad, kpool, vpool = layer_and_pool
+        lora = None if ad is None else (ad, ad_scales, adapter_ids)
 
         def attend(lyr, xin):
-            q, k, v = _qkv(lyr, xin, cfg, positions)  # k/v [R, Hkv, W, D]
+            q, k, v = _qkv(lyr, xin, cfg, positions,  # [R, Hkv, W, D]
+                           lora=lora)
             k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
             kp2 = _smap(lambda c, n: c.at[flat_pids].set(pieces(n)),
                         kpool, k_st)
@@ -1132,9 +1213,10 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend)
+        return _attn_ffn(layer, x, cfg, attend, lora=lora)
 
-    x, (new_kp, new_vp) = jax.lax.scan(body, x, (params["layers"], kp, vp))
+    x, (new_kp, new_vp) = jax.lax.scan(
+        body, x, (params["layers"], ad_scan, kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     xl = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = _head_mm(xl, params["lm_head"])
@@ -1142,7 +1224,8 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
 
 
 def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
-                          page_rows, prompt_len: int, mesh=None):
+                          page_rows, prompt_len: int, mesh=None,
+                          adapters=None, adapter_ids=None):
     """Prefill ONE whole request into its reserved pages: the page-
     aligned chunk body (:func:`forward_paged_prefill_chunk`) at pos 0,
     with the prompt padded to a page multiple.  Returns (last-position
@@ -1155,5 +1238,5 @@ def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
         tokens = jnp.pad(tokens[:, :s], ((0, 0), (0, w - s)))
     logits, pools = forward_paged_prefill_chunk(
         params, tokens, cfg, pools, page_rows, 0, prompt_len - 1,
-        mesh=mesh)
+        mesh=mesh, adapters=adapters, adapter_ids=adapter_ids)
     return logits[None], pools

@@ -211,6 +211,132 @@ def make_lora_train_step(cfg, optimizer, remat: str = "none"):
     return step
 
 
+# ---------------------------------------------------------------------------
+# Batched multi-adapter serving (S-LoRA / Punica BGMV formulation)
+# ---------------------------------------------------------------------------
+# Serving per-customer fine-tunes does NOT merge adapters into the base
+# (one merged model per adapter = one replica per tenant).  Instead ONE
+# base model stays resident and the adapters live in a STACKED pool —
+# per target leaf an ``a`` buffer [L, N, d_in, r] and a ``b`` buffer
+# [L, N, r, d_out] (leading L so the model's layer ``lax.scan`` slices
+# adapters alongside the stacked base layers) plus one f32 ``scale``
+# [N].  Every batched forward gathers each ROW's adapter by index and
+# pays two skinny matmuls per projection (r ~ 8-64: FLOPs/HBM noise
+# next to the base matmul), so a mixed batch of N tenants is ONE
+# dispatch.  Pool index 0 is the IDENTITY adapter by convention: its
+# a/b are zero, its delta is exactly 0.0, and the allocator never
+# hands it out — base-model rows ride the same program unchanged.
+
+
+def serving_adapter_dims(cfg, suffixes=LORA_SUFFIXES) -> Dict:
+    """{leaf name: (d_in, d_out)} of the adapter targets — THE one
+    definition of which projections carry serving adapters and their
+    shapes; pool construction, byte pricing, and the synthetic loader
+    all derive from it so they cannot drift."""
+    d = cfg.d_model
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    dims = {"wq": (d, d), "wk": (d, kvd), "wv": (d, kvd),
+            "wo": (d, d), "w_gate": (d, cfg.d_ff),
+            "w_up": (d, cfg.d_ff), "w_down": (cfg.d_ff, d)}
+    return {k: dims[k] for k in suffixes if k in dims}
+
+
+def init_adapter_pool_arrays(cfg, rank: int, n_adapters: int,
+                             dtype=None) -> Dict:
+    """Zeroed stacked serving pool: {leaf: {"a": [L, N, d_in, r],
+    "b": [L, N, r, d_out]}, "scale": [N] f32}.  All-zero entries ARE
+    the identity adapter (delta exactly 0), so a fresh pool serves
+    base-model traffic before any adapter loads."""
+    if rank < 1:
+        raise ValueError("rank must be >= 1")
+    if n_adapters < 1:
+        raise ValueError("n_adapters must be >= 1 (index 0 is the "
+                         "identity adapter)")
+    dtype = dtype or cfg.dtype
+    ll = cfg.n_layers
+    pool = {}
+    for name, (d_in, d_out) in serving_adapter_dims(cfg).items():
+        pool[name] = {
+            "a": jnp.zeros((ll, n_adapters, d_in, rank), dtype),
+            "b": jnp.zeros((ll, n_adapters, rank, d_out), dtype),
+        }
+    pool["scale"] = jnp.zeros((n_adapters,), jnp.float32)
+    return pool
+
+
+def make_adapter(cfg, rank: int, seed: int, alpha: float = 16.0,
+                 dtype=None) -> Dict:
+    """One synthetic NON-identity adapter (deterministic in ``seed``):
+    {leaf: {"a": [L, d_in, r], "b": [L, r, d_out]}, "scale": f32}.
+    Unlike training zero-init, ``b`` is nonzero (scaled ~1/sqrt(r·d))
+    so distinct adapters produce distinct streams — what the serving
+    tests and benches need; real deployments load trained a/b here."""
+    dtype = dtype or cfg.dtype
+    key = jax.random.PRNGKey(seed)
+    out = {}
+    for name, (d_in, d_out) in serving_adapter_dims(cfg).items():
+        key, ka, kb = jax.random.split(key, 3)
+        a = (jax.random.normal(ka, (cfg.n_layers, d_in, rank),
+                               jnp.float32) / np.sqrt(d_in))
+        b = (jax.random.normal(kb, (cfg.n_layers, rank, d_out),
+                               jnp.float32) / np.sqrt(rank * d_out))
+        out[name] = {"a": a.astype(dtype), "b": b.astype(dtype)}
+    out["scale"] = float(alpha / rank)
+    return out
+
+
+def adapter_entry_bytes(cfg, rank: int, dtype=None) -> int:
+    """Persistent pool bytes ONE resident adapter costs (a + b across
+    every target leaf and layer, plus its f32 scale) — the adapter
+    pool's analogue of :func:`tpushare.ops.quant.kv_cache_bytes`:
+    every capacity/gauge computation prices entries through here."""
+    dtype = dtype or cfg.dtype
+    item = jnp.dtype(dtype).itemsize
+    elems = sum(rank * (d_in + d_out)
+                for d_in, d_out in serving_adapter_dims(cfg).values())
+    return int(cfg.n_layers * elems * item + 4)
+
+
+def adapter_pool_bytes(cfg, rank: int, n_adapters: int,
+                       dtype=None) -> int:
+    """Persistent HBM of a whole stacked pool (``n_adapters`` entries
+    including the identity row)."""
+    return adapter_entry_bytes(cfg, rank, dtype) * n_adapters
+
+
+def merged_adapter_bytes(cfg, dtype=None) -> int:
+    """What ONE per-adapter MERGED model costs in the target leaves
+    alone (d_in × d_out per leaf per layer) — the bytes-per-tenant a
+    merged-base deployment pays, and the denominator of the adapter
+    pool's capacity win (rank·(d_in+d_out) vs d_in·d_out)."""
+    dtype = dtype or cfg.dtype
+    item = jnp.dtype(dtype).itemsize
+    elems = sum(d_in * d_out
+                for d_in, d_out in serving_adapter_dims(cfg).values())
+    return int(cfg.n_layers * elems * item)
+
+
+def batched_adapter_matmul(x, a_pool, b_pool, scales, adapter_ids):
+    """Gathered per-row LoRA delta (Punica's BGMV shape): row i of
+    ``x`` [B, S, d_in] rides adapter ``adapter_ids[i]`` from the
+    stacked pools ``a_pool`` [N, d_in, r] / ``b_pool`` [N, r, d_out];
+    returns ``((x @ A[id]) @ B[id]) * scale[id]`` as [B, S, d_out].
+
+    Rows with adapter 0 gather the all-zero identity entry, so their
+    delta is EXACTLY 0.0 — adding it to the base projection leaves
+    base-path rows' values unchanged (the mixed-batch identity
+    contract).  The gather + two skinny matmuls stay row-local: the
+    batch dim never enters a reduction, so a row's numbers are
+    independent of which other adapters share the dispatch.
+    """
+    a = jnp.take(a_pool, adapter_ids, axis=0)      # [B, d_in, r]
+    b = jnp.take(b_pool, adapter_ids, axis=0)      # [B, r, d_out]
+    s = jnp.take(scales, adapter_ids, axis=0)      # [B] f32
+    xa = jnp.einsum("bsd,bdr->bsr", x, a.astype(x.dtype))
+    delta = jnp.einsum("bsr,bro->bso", xa, b.astype(x.dtype))
+    return delta * s[:, None, None].astype(x.dtype)
+
+
 def merge_lora(params, requantize_bits: int = 0):
     """Fold adapters into dense weights for serving: ``w + a @ b *
     scale``.  A quantized base is dequantized first; pass

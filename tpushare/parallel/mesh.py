@@ -193,6 +193,48 @@ def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp",
     return jax.tree_util.tree_map(_place, storage)
 
 
+#: adapter targets whose BASE projection is column-parallel (output
+#: dim sharded on tp) — their adapter ``b`` [L, N, r, d_out] shards
+#: d_out alongside; the row-parallel targets (wo, w_down) shard their
+#: adapter ``a`` [L, N, d_in, r] on d_in with the base instead
+_ADAPTER_COL_TARGETS = ("wq", "wk", "wv", "w_gate", "w_up")
+
+
+def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp"):
+    """Place a stacked serving LoRA pool (:func:`tpushare.ops.lora
+    .init_adapter_pool_arrays`) onto the mesh with each adapter leaf
+    sharded LIKE ITS BASE projection: column-parallel targets shard
+    ``b``'s d_out on tp (the skinny ``xa @ B`` matmul produces the
+    same output-sharded activation as the base matmul, no extra
+    collective), row-parallel targets shard ``a``'s d_in (the ``x @
+    A`` contraction joins the base's reduce), and everything else —
+    the rank dim, the scale vector, the [N] pool axis — replicates
+    (rank is tiny; sharding the POOL axis would turn every per-row
+    gather into a cross-shard shuffle).  Same divisibility
+    legalization as :func:`shard_params`."""
+    if axis not in mesh.axis_names:
+        return pool
+    out = {}
+    for name, leaves in pool.items():
+        if name == "scale":
+            out[name] = jax.device_put(
+                leaves, NamedSharding(mesh, P()))
+            continue
+        placed = {}
+        for key, leaf in leaves.items():
+            if key == "b" and name in _ADAPTER_COL_TARGETS:
+                spec = P(None, None, None, axis)
+            elif key == "a" and name not in _ADAPTER_COL_TARGETS:
+                spec = P(None, None, axis, None)
+            else:
+                spec = P()
+            placed[key] = jax.device_put(
+                leaf, NamedSharding(mesh, _legalize(spec, leaf.shape,
+                                                    mesh)))
+        out[name] = placed
+    return out
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
     """Shard array leaves along their leading (batch) dim on ``axis``."""
     if axis not in mesh.axis_names:

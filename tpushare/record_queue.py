@@ -47,6 +47,7 @@ MANIFEST: List[Tuple[str, str]] = [
     ("drive_sp_decode.py", "SP_DECODE_TPU.json"),
     ("drive_kv_quant.py", "KV_QUANT_TPU.json"),
     ("drive_prefix_cache.py", "PREFIX_CACHE_TPU.json"),
+    ("drive_lora_gather.py", "LORA_GATHER_TPU.json"),
 ]
 
 

@@ -1,0 +1,258 @@
+"""Serving adapter pool: multi-tenant LoRA residency (round 20).
+
+S-LoRA's observation, applied to this serving plane: per-customer
+fine-tunes should cost ADAPTER bytes, not model replicas.  One base
+model stays resident; every adapter lives as one row of the stacked
+device pool (:func:`tpushare.ops.lora.init_adapter_pool_arrays` —
+row 0 is the all-zero IDENTITY adapter, never allocated, so base-model
+traffic rides the same batched program), and each batched forward
+gathers per-row adapters inside the ONE jitted dispatch
+(:func:`tpushare.ops.lora.batched_adapter_matmul`).
+
+This module is the HOST-side residency manager — the adapter analogue
+of the paged batcher's page free-list:
+
+* byte-priced capacity: the pool holds ``n_slots`` named adapters
+  (plus identity) costing ``adapter_entry_bytes`` each — the second
+  HBM pool class beyond KV, surfaced through ``storage_info()`` /
+  ``tpushare_adapter_pool_bytes`` so the grant-vs-usage view sees it;
+* LRU residency: an acquire for a non-resident name loads it into a
+  free row, or EVICTS the least-recently-used row with no in-flight
+  pins (``tpushare_adapter_evictions_total{reason=capacity}``) — a
+  pinned row (live slots decoding with it) is never a victim, so a
+  dispatch can never gather evicted garbage;
+* pinning: every admitted request holding adapter idx pins it until
+  its slot releases (completion, cancel, migration pop) — the
+  batcher's ``_slot_adapter`` map owns the release calls.
+
+Thread model: the pool is LOOP-OWNED state, exactly like the batcher
+that holds it — every MUTATION (acquire/load/evict/release) happens on
+the service loop thread (admission and release paths), reached only
+through the ``_batcher`` confinement the thread manifest declares.
+Reads (:meth:`pressure`, :meth:`snapshot`, :meth:`storage_info`) are
+point-in-time snapshots, safe from handler threads — what the llm
+server's 503-on-pressure admission gate and ``/stats`` consume.
+
+Default loader: a DETERMINISTIC synthetic adapter derived from the
+adapter name (sha256-seeded ``ops.lora.make_adapter``), so every
+replica materializes the same weights for the same name — the
+property that keeps ``/generate`` idempotent across the fleet (the
+router's re-dispatch safety argument).  Real deployments pass a
+``loader`` that reads trained weights.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import lora as ops_lora
+from . import metrics
+from .continuous import register_jit_entries
+
+log = logging.getLogger("tpushare.serving")
+
+#: why an adapter LOAD ran — the enumerated values of
+#: ``tpushare_adapter_loads_total{reason=}`` (enum-pinned in
+#: tests/test_metric_lint.py): ``miss`` = the name was not resident
+#: (cold, or previously evicted) and a pool row was written
+ADAPTER_LOAD_REASONS = ("miss",)
+
+#: why a resident adapter was EVICTED — the enumerated values of
+#: ``tpushare_adapter_evictions_total{reason=}``: ``capacity`` = the
+#: pool was full and an unpinned LRU row made way for a load
+ADAPTER_EVICTION_REASONS = ("capacity",)
+
+
+class AdapterLoadError(RuntimeError):
+    """The adapter LOADER failed for a name (missing weights, bad
+    file, ...).  A per-REQUEST failure, never a pool/service one: the
+    admission path aborts the one request naming the adapter (the
+    serving loop catches admission exceptions and sentinels the sink)
+    instead of refusing-and-retrying forever or killing the loop."""
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_adapter(pool, idx, entry, scale):
+    """Scatter one adapter's a/b/scale into pool row ``idx`` (the pool
+    is DONATED — XLA updates in place instead of copying the stacked
+    buffers per load).  One compile per pool shape; loads are
+    admission-path work, never tick-path (dispatch-audited: the tick
+    hooks only hand the pool THROUGH)."""
+    out = {}
+    for name, leaves in pool.items():
+        if name == "scale":
+            continue
+        out[name] = {k: leaves[k].at[:, idx].set(entry[name][k])
+                     for k in ("a", "b")}
+    out["scale"] = pool["scale"].at[idx].set(scale)
+    return out
+
+
+register_jit_entries(_write_adapter)
+
+
+def _name_seed(name: str) -> int:
+    """Deterministic, process-salt-free seed for a named synthetic
+    adapter (``hash()`` is salted per process — replicas would build
+    DIFFERENT weights for the same name and break re-dispatch
+    idempotence)."""
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4],
+                          "big")
+
+
+class AdapterPool:
+    """Host-side residency manager over the stacked device pool."""
+
+    def __init__(self, cfg, rank: int, n_slots: int, mesh=None,
+                 loader: Optional[Callable[[str], Dict]] = None,
+                 dtype=None):
+        if n_slots < 1:
+            raise ValueError("adapter pool needs >= 1 named slot")
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.n_slots = int(n_slots)
+        self._dtype = dtype or cfg.dtype
+        # +1: row 0 is the identity adapter (all-zero, never allocated)
+        self._pool = ops_lora.init_adapter_pool_arrays(
+            cfg, self.rank, self.n_slots + 1, dtype=self._dtype)
+        if mesh is not None:
+            from ..parallel.mesh import shard_adapter_pool
+            self._pool = shard_adapter_pool(self._pool, mesh)
+        self._by_name: Dict[str, int] = {}
+        #: idx -> {"name", "refs", "last_used"} for rows 1..n_slots
+        self._rows: Dict[int, dict] = {
+            i: {"name": None, "refs": 0, "last_used": 0.0}
+            for i in range(1, self.n_slots + 1)}
+        self._loader = loader or self._synthetic_loader
+        self.loads = 0
+        self.evictions = 0
+        metrics.ADAPTER_POOL_BYTES.set(
+            ops_lora.adapter_pool_bytes(cfg, self.rank,
+                                        self.n_slots + 1,
+                                        dtype=self._dtype))
+        metrics.ADAPTER_RESIDENT.set(0)
+
+    # -- loaders -------------------------------------------------------
+    def _synthetic_loader(self, name: str) -> Dict:
+        return ops_lora.make_adapter(self.cfg, self.rank,
+                                     seed=_name_seed(name),
+                                     dtype=self._dtype)
+
+    # -- device operands (loop thread; handed through the tick hooks) --
+    def device_operands(self):
+        """The stacked pool pytree the jitted programs consume —
+        functional arrays: a dispatch holds whichever snapshot it was
+        handed, and loads/evictions only ever touch rows no live slot
+        references (pins gate eviction)."""
+        return self._pool
+
+    # -- residency (MUTATIONS: service loop thread only) ---------------
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin ``name`` and return its pool row, loading (and
+        LRU-evicting) as needed; None = pressure (every row pinned by
+        an in-flight request) — the admission-backpressure verdict."""
+        idx = self._by_name.get(name)
+        if idx is not None:
+            row = self._rows[idx]
+            row["refs"] += 1
+            row["last_used"] = time.monotonic()
+            return idx
+        idx = self._free_row()
+        if idx is None:
+            return None
+        try:
+            entry = self._loader(name)
+        except Exception as e:
+            # the loader runs on the SERVING LOOP thread (admission) —
+            # an escaping exception there would kill every tenant's
+            # serving; a bad adapter name is one request's problem
+            raise AdapterLoadError(
+                f"adapter {name!r} failed to load: {e}") from e
+        scale = entry.get("scale", 1.0)
+        arrays = {n: entry[n] for n in entry if n != "scale"}
+        self._pool = _write_adapter(self._pool, jnp.int32(idx), arrays,
+                                    jnp.float32(scale))
+        self._by_name[name] = idx
+        self._rows[idx] = {"name": name, "refs": 1,
+                           "last_used": time.monotonic()}
+        self.loads += 1
+        metrics.ADAPTER_LOADS.inc(reason="miss")
+        metrics.ADAPTER_RESIDENT.set(len(self._by_name))
+        return idx
+
+    def _free_row(self) -> Optional[int]:
+        free = [i for i, r in self._rows.items() if r["name"] is None]
+        if free:
+            return free[0]
+        idle = [i for i, r in self._rows.items() if r["refs"] <= 0]
+        if not idle:
+            return None
+        victim = min(idle, key=lambda i: self._rows[i]["last_used"])
+        name = self._rows[victim]["name"]
+        del self._by_name[name]
+        self._rows[victim] = {"name": None, "refs": 0, "last_used": 0.0}
+        self.evictions += 1
+        metrics.ADAPTER_EVICTIONS.inc(reason="capacity")
+        metrics.ADAPTER_RESIDENT.set(len(self._by_name))
+        log.info("adapter %r evicted (capacity)", name)
+        # the stale row content stays in HBM until the load overwrites
+        # it — harmless: nothing can reference an unpinned, unnamed row
+        return victim
+
+    def release(self, idx: int) -> None:
+        """Drop one pin (slot released its request)."""
+        row = self._rows.get(idx)
+        if row is not None and row["refs"] > 0:
+            row["refs"] -= 1
+            row["last_used"] = time.monotonic()
+
+    def name_of(self, idx: int) -> Optional[str]:
+        """Resident name at ``idx`` (session-migration metadata: the
+        NAME travels in the blob; the receiver re-acquires it into its
+        own pool rows)."""
+        row = self._rows.get(idx)
+        return row["name"] if row else None
+
+    # -- read-only views (any thread: point-in-time snapshots) ---------
+    def pressure(self, name: str) -> bool:
+        """Would an acquire for ``name`` refuse right now?  The llm
+        admission gate's 503 verdict — non-resident name against a
+        fully-pinned pool."""
+        if name in self._by_name:
+            return False
+        return all(r["name"] is not None and r["refs"] > 0
+                   for r in self._rows.values())
+
+    def snapshot(self) -> dict:
+        return {"slots": self.n_slots,
+                "resident": len(self._by_name),
+                "loads": self.loads,
+                "evictions": self.evictions}
+
+    def storage_info(self) -> dict:
+        """The adapter pool's HBM economics — the second pool class
+        ``storage_info()`` carries beyond KV: what the pool costs,
+        and what the same tenants would cost as per-adapter MERGED
+        models (the capacity win multi-adapter serving exists for)."""
+        per = ops_lora.adapter_entry_bytes(self.cfg, self.rank,
+                                           dtype=self._dtype)
+        return {
+            "adapter_slots": self.n_slots,
+            "adapter_rank": self.rank,
+            "adapters_resident": len(self._by_name),
+            "bytes_per_adapter": int(per),
+            "adapter_pool_bytes": int(
+                ops_lora.adapter_pool_bytes(self.cfg, self.rank,
+                                            self.n_slots + 1,
+                                            dtype=self._dtype)),
+            "merged_bytes_per_adapter": int(
+                ops_lora.merged_adapter_bytes(self.cfg,
+                                              dtype=self._dtype)),
+        }

@@ -45,7 +45,7 @@ _wrap_keys = jax.jit(jax.vmap(jax.random.wrap_key_data))
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len"),
                    donate_argnums=(2,))
 def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
-                   chunk_len: int):
+                   chunk_len: int, adapters=None, aids=None):
     """One prompt chunk into row ``slot`` at cache offset ``pos`` —
     whole-prompt prefill is just the ``pos=0`` single-chunk case, so
     the slice-row/forward/scatter body exists ONCE.
@@ -76,7 +76,7 @@ def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
     # length==p before attendable).
     logits, row = transformer.forward(
         params, tokens[:, :chunk_len], cfg, kv_caches=row, cache_len=pos,
-        kv_write_len=last_idx + 1)
+        kv_write_len=last_idx + 1, adapters=adapters, adapter_ids=aids)
     caches = jax.tree_util.tree_map(
         lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
         caches, row)
@@ -147,7 +147,7 @@ def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
 @functools.partial(jax.jit, static_argnames=("cfg", "rich"),
                    donate_argnums=(2,))
 def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
-          rich: bool = False):
+          rich: bool = False, adapters=None, aids=None):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
     Per-slot sampling via :func:`_sample_next` — greedy and sampling
@@ -158,14 +158,16 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
     full copies across the hot loop.
     """
     logits, caches = transformer.forward(
-        params, tokens, cfg, kv_caches=caches, cache_len=lengths)
+        params, tokens, cfg, kv_caches=caches, cache_len=lengths,
+        adapters=adapters, adapter_ids=aids)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
     return nxt, caches
 
 
 def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
-                 incs, cfg, n: int, rich: bool):
+                 incs, cfg, n: int, rich: bool, adapters=None,
+                 aids=None):
     """The fused decode scan BODY (trace-level, not jitted itself) —
     the one definition shared by :func:`_tick_n` and the mixed-step
     program :func:`_tick_mixed`, so the two dispatch flavors cannot
@@ -174,7 +176,8 @@ def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
         tok, caches, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
         logits, caches = transformer.forward(
-            params, tok, cfg, kv_caches=caches, cache_len=lengths)
+            params, tok, cfg, kv_caches=caches, cache_len=lengths,
+            adapters=adapters, adapter_ids=aids)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
         return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
@@ -187,7 +190,7 @@ def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
-            cfg, n: int, rich: bool = False):
+            cfg, n: int, rich: bool = False, adapters=None, aids=None):
     """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
     round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
     per token, the same fusion :func:`tpushare.serving.generate
@@ -216,7 +219,8 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     by queries < pos, all already computed.)
     """
     return _decode_scan(params, tokens, caches, lengths, temps, keys,
-                        tks, tps, incs, cfg, n, rich)
+                        tks, tps, incs, cfg, n, rich, adapters=adapters,
+                        aids=aids)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
@@ -224,7 +228,8 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
                    donate_argnums=(7,))
 def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
                 src_mask, caches, tokens, lengths, temps, keys, tks, tps,
-                incs, cfg, chunk_len: int, n: int, rich: bool = False):
+                incs, cfg, chunk_len: int, n: int, rich: bool = False,
+                adapters=None, aids=None, p_aids=None):
     """ONE device program per mixed service round: (a) the pending
     chunks of up to R mid-prefill slots coalesced into a single batched,
     padded prefill forward, then (b) the fused ``n``-step decode scan
@@ -264,7 +269,8 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
         lambda c: jnp.take(c, p_slots, axis=1), caches)
     p_logits, rows = transformer.forward(
         params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
-        cache_len=p_pos, kv_write_len=p_last + 1)
+        cache_len=p_pos, kv_write_len=p_last + 1, adapters=adapters,
+        adapter_ids=p_aids)
 
     def put(c, r):
         g = jnp.take(r, src_rows, axis=1)
@@ -275,11 +281,11 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
     toks, keys, caches = _decode_scan(
         params, tokens, caches, lengths, temps, keys, tks, tps, incs,
-        cfg, n, rich)
+        cfg, n, rich, adapters=adapters, aids=aids)
     return sel, toks, keys, caches
 
 
-def _dense_spec_verify(params, cfg):
+def _dense_spec_verify(params, cfg, adapters=None, aids=None):
     """The dense slot pool's ``verify`` closure for
     :func:`tpushare.serving.speculative.spec_scan`: one cached forward
     over the ``[B, 1+k]`` blocks at each row's own depth.
@@ -295,7 +301,8 @@ def _dense_spec_verify(params, cfg):
     def verify(blocks, n_ctxs, live, caches):
         logits, caches = transformer.forward(
             params, blocks, cfg, kv_caches=caches, cache_len=n_ctxs,
-            kv_write_len=jnp.where(live, blocks.shape[1], 0))
+            kv_write_len=jnp.where(live, blocks.shape[1], 0),
+            adapters=adapters, adapter_ids=aids)
         return logits, caches
 
     return verify
@@ -306,7 +313,8 @@ def _dense_spec_verify(params, cfg):
                    donate_argnums=(2,))
 def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
                remainings, actives, temps, keys, tks, tps, cfg, k: int,
-               ngram: int, n_rounds: int, rich: bool = False):
+               ngram: int, n_rounds: int, rich: bool = False,
+               adapters=None, aids=None):
     """``n_rounds`` of batched PROMPT-LOOKUP speculative decoding in one
     dispatch — the continuous batcher's speculation path (the serving
     integration of :mod:`.speculative`'s single-request while_loop; the
@@ -342,10 +350,10 @@ def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
     ``bufs[i, old_len : old_len + produced[i]]``.
     """
     from .speculative import spec_scan
-    return spec_scan(_dense_spec_verify(params, cfg), _sample_next,
-                     bufs, buf_lens, n_ctxs, next_toks, remainings,
-                     actives, temps, keys, tks, tps, caches, k, ngram,
-                     n_rounds, rich)
+    return spec_scan(_dense_spec_verify(params, cfg, adapters, aids),
+                     _sample_next, bufs, buf_lens, n_ctxs, next_toks,
+                     remainings, actives, temps, keys, tks, tps, caches,
+                     k, ngram, n_rounds, rich)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "k",
@@ -356,7 +364,8 @@ def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
                      src_mask, caches, bufs, buf_lens, n_ctxs,
                      next_toks, remainings, actives, temps, keys, tks,
                      tps, cfg, chunk_len: int, k: int, ngram: int,
-                     n_rounds: int, rich: bool = False):
+                     n_rounds: int, rich: bool = False,
+                     adapters=None, aids=None, p_aids=None):
     """ONE device program per mixed service round WITH speculation: the
     coalesced budget-bounded prefill block (identical to
     :func:`_tick_mixed`'s prefill half), then ``n_rounds`` speculative
@@ -375,7 +384,8 @@ def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
         lambda c: jnp.take(c, p_slots, axis=1), caches)
     p_logits, rows = transformer.forward(
         params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
-        cache_len=p_pos, kv_write_len=p_last + 1)
+        cache_len=p_pos, kv_write_len=p_last + 1, adapters=adapters,
+        adapter_ids=p_aids)
 
     def put(c, r):
         g = jnp.take(r, src_rows, axis=1)
@@ -386,10 +396,10 @@ def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
 
     from .speculative import spec_scan
-    out = spec_scan(_dense_spec_verify(params, cfg), _sample_next,
-                    bufs, buf_lens, n_ctxs, next_toks, remainings,
-                    actives, temps, keys, tks, tps, caches, k, ngram,
-                    n_rounds, rich)
+    out = spec_scan(_dense_spec_verify(params, cfg, adapters, aids),
+                    _sample_next, bufs, buf_lens, n_ctxs, next_toks,
+                    remainings, actives, temps, keys, tks, tps, caches,
+                    k, ngram, n_rounds, rich)
     return (sel,) + out
 
 
@@ -496,7 +506,8 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  mesh=None, rolling_slots: Optional[bool] = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0, adapter_slots: int = 0,
+                 adapter_rank: int = 8, adapter_loader=None):
         """``mesh``: optional ``jax.sharding.Mesh`` for tensor-parallel
         serving — params take the Megatron tp layout
         (:func:`tpushare.parallel.mesh.shard_params`) and KV storage
@@ -519,7 +530,15 @@ class ContinuousBatcher:
         evicts only keys already outside every future query's window
         (``init_kv_caches(ring_slack=)``); other storages need no
         provisioning.  ``tick_spec`` itself takes ``k`` per call —
-        ``spec_k`` is the capacity bound the storage was built for."""
+        ``spec_k`` is the capacity bound the storage was built for.
+
+        ``adapter_slots > 0`` builds the multi-adapter LoRA serving
+        pool (:class:`tpushare.serving.adapters.AdapterPool`, rank
+        ``adapter_rank``): requests may name an adapter at admission,
+        every tick flavor gathers each row's adapter inside its ONE
+        jitted dispatch, and streams for adapter-0 (base) rows stay
+        bit-identical to a pool-less batcher's.  0 (default) threads
+        None everywhere — the byte-identical pre-adapter programs."""
         self.mesh = mesh
         self.spec_k = max(0, int(spec_k))
         if rolling_slots is None:
@@ -540,6 +559,18 @@ class ContinuousBatcher:
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
+        # Multi-adapter LoRA pool (round 20): loop-owned like every
+        # other batcher internal — admission acquires/loads, release
+        # unpins; _slot_adapter maps slot -> pinned pool row (absent =
+        # identity/base).  None = the pre-adapter programs, traced
+        # byte-identically (the operands thread as empty pytrees).
+        self.adapter_pool = None
+        if adapter_slots:
+            from .adapters import AdapterPool
+            self.adapter_pool = AdapterPool(
+                cfg, adapter_rank, adapter_slots, mesh=mesh,
+                loader=adapter_loader)
+        self._slot_adapter: Dict[int, int] = {}
         self.slots: Dict[int, _Slot] = {}      # slot index -> live request
         self.prefilling: Dict[int, _Prefill] = {}   # slot -> mid-prefill
         # round-robin cursor over mid-prefill SLOT ids: when a round's
@@ -695,13 +726,18 @@ class ContinuousBatcher:
         # dense slot reads never route through the paged dispatcher, so
         # the read path is the XLA dense cached_attention regardless of
         # cfg.attn_kernel — report what actually runs
-        return {"kind": "rolling" if self.rolling_slots else "dense",
+        info = {"kind": "rolling" if self.rolling_slots else "dense",
                 "attn_kernel": "xla",
                 "kv_dtype": cfg.kv_dtype,
                 "slot_tokens": int(slot_tokens),
                 "bytes_per_slot": int(bytes_per_slot),
                 "slots_per_gib": (2 ** 30) // bytes_per_slot,
                 "pool_bytes": int(bytes_per_slot * self.n_slots)}
+        if self.adapter_pool is not None:
+            # the SECOND HBM pool class (round 20): adapter residency
+            # economics next to the KV pool's
+            info.update(self.adapter_pool.storage_info())
+        return info
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int,
                  prompt: Optional[List[int]] = None) -> bool:
@@ -718,35 +754,45 @@ class ContinuousBatcher:
 
     def _release(self, slot: int) -> None:
         """Return per-request storage on completion."""
+        self._release_adapter(slot)
 
     def _prefill_into(self, slot: int, tokens, prompt_len: int):
         """Whole-prompt prefill = one chunk at pos 0; returns [V] logits
         at the prompt's last position."""
+        adapters, aids = self._adapter_operands(
+            [self._slot_adapter.get(slot, 0)])
         logits, self.caches = _prefill_chunk(
             self.params, tokens, self.caches, slot, 0, prompt_len - 1,
-            self.cfg, prompt_len)
+            self.cfg, prompt_len, adapters=adapters, aids=aids)
         return logits
 
-    def _step(self, tokens, lengths, temps, keys, tks, tps, rich):
+    def _step(self, tokens, lengths, temps, keys, tks, tps, rich,
+              ads=None):
+        adapters, aids = self._adapter_operands(ads)
         nxt, self.caches = _tick(
             self.params, tokens, self.caches, lengths, temps, keys,
-            tks, tps, self.cfg, rich)
+            tks, tps, self.cfg, rich, adapters=adapters, aids=aids)
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
-                n_steps: int):
+                n_steps: int, ads=None):
+        adapters, aids = self._adapter_operands(ads)
         toks, keys, self.caches = _tick_n(
             self.params, tokens, self.caches, lengths, temps, keys,
-            tks, tps, incs, self.cfg, n_steps, rich)
+            tks, tps, incs, self.cfg, n_steps, rich, adapters=adapters,
+            aids=aids)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
                             last_idx: int, chunk_len: int):
         """One padded prompt chunk into the slot's cache; returns the
         logits at ``last_idx`` (the chunk's final real position)."""
+        adapters, aids = self._adapter_operands(
+            [self._slot_adapter.get(slot, 0)])
         logits, self.caches = _prefill_chunk(
             self.params, jnp.asarray(padded_tokens), self.caches,
-            slot, pos, last_idx, self.cfg, chunk_len)
+            slot, pos, last_idx, self.cfg, chunk_len, adapters=adapters,
+            aids=aids)
         return logits
 
     # -- session migration capability ----------------------------------
@@ -804,6 +850,97 @@ class ContinuousBatcher:
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1] (1 = off)")
 
+    # -- multi-adapter serving (round 20) ------------------------------
+    def validate_adapter(self, adapter: Optional[str]) -> None:
+        """Raise for an adapter request this batcher can NEVER serve
+        (no pool configured / malformed name) — pure validation, safe
+        from any thread like :meth:`validate_request`."""
+        if adapter is None:
+            return
+        if not isinstance(adapter, str) or not adapter:
+            raise ValueError("adapter must be a non-empty string")
+        if self.adapter_pool is None:
+            raise ValueError("this server runs without an adapter pool "
+                             "(pass adapter_slots / --adapter-slots)")
+
+    def adapter_pressure(self, adapter: Optional[str]) -> bool:
+        """Read-only: would an admission naming ``adapter`` refuse
+        RIGHT NOW for adapter-pool pressure (non-resident name, every
+        pool row pinned by an in-flight request)?  A point-in-time
+        snapshot safe off-loop — the llm server's 503 admission gate."""
+        if not adapter or self.adapter_pool is None:
+            return False
+        return self.adapter_pool.pressure(adapter)
+
+    def adapter_info(self) -> Optional[dict]:
+        """Point-in-time pool snapshot (None without a pool)."""
+        if self.adapter_pool is None:
+            return None
+        return self.adapter_pool.snapshot()
+
+    def _acquire_adapter(self, adapter: Optional[str]) -> Optional[int]:
+        """Resolve + PIN an adapter name at admission: 0 for base
+        requests, the pinned pool row otherwise, None = pool pressure
+        (the admission-backpressure verdict — retry when a slot
+        releases its pin)."""
+        if adapter is None:
+            return 0
+        return self.adapter_pool.acquire(adapter)
+
+    def _release_adapter(self, slot: int) -> None:
+        """Unpin the slot's adapter (every release path funnels here
+        via the storage ``_release`` hooks)."""
+        idx = self._slot_adapter.pop(slot, 0)
+        if idx and self.adapter_pool is not None:
+            self.adapter_pool.release(idx)
+
+    def _adapter_name_of(self, slot: int) -> Optional[str]:
+        """The NAME of the adapter pinned to ``slot`` (None = base) —
+        what prefix-registry namespacing and session-migration
+        metadata carry (pool indices are process-local)."""
+        if self.adapter_pool is None:
+            return None
+        idx = self._slot_adapter.get(slot, 0)
+        return self.adapter_pool.name_of(idx) if idx else None
+
+    def adapter_spill_can_help(self) -> bool:
+        """Whether exporting a DECODING session could release an
+        adapter pin — the ONLY way the spill tier can relieve
+        adapter-pool pressure (spilling base-model sessions frees
+        pages, never pins).  Loop-thread admission helper: gates the
+        spill loop so adapter pressure against purely base-model
+        residents does not park unrelated sessions in host RAM for a
+        refusal spilling cannot fix."""
+        return any(self._slot_adapter.get(i, 0) for i in self.slots)
+
+    def _adapter_ids_array(self, slots=None):
+        """[B] (or per-``slots``) adapter pool rows for a dispatch —
+        0 (identity) for base rows, empty rows, and pool-less
+        batchers."""
+        ids = np.zeros((self.n_slots if slots is None else len(slots),),
+                       np.int32)
+        if self.adapter_pool is not None:
+            if slots is None:
+                for i, a in self._slot_adapter.items():
+                    ids[i] = a
+            else:
+                for r, i in enumerate(slots):
+                    ids[r] = self._slot_adapter.get(int(i), 0)
+        return ids
+
+    def _adapter_operands(self, ads):
+        """Device operands for the adapter-threaded programs: (stacked
+        pool pytree, ids) — or (None, None), which traces the
+        byte-identical pre-adapter program.  HOST-side handle passing
+        only: the per-row gather runs INSIDE the one jitted dispatch
+        (hook-interior — audited by dispatch_audit's adapter-operand
+        rule; this helper must never dispatch or fetch)."""
+        if self.adapter_pool is None:
+            return None, None
+        if ads is None:
+            ads = np.zeros((self.n_slots,), np.int32)  # all-identity
+        return self.adapter_pool.device_operands(), jnp.asarray(ads)
+
     # -- speculation capability ----------------------------------------
     def spec_fallback_reason(self, k: int) -> Optional[str]:
         """Why ``spec_k=k`` speculation cannot run on THIS storage
@@ -846,26 +983,40 @@ class ContinuousBatcher:
               temperature: float = 0.0,
               seed: int = 0,
               eos_id: Optional[int] = None,
-              top_k: int = 0, top_p: float = 1.0) -> Optional[int]:
+              top_k: int = 0, top_p: float = 1.0,
+              adapter: Optional[str] = None) -> Optional[int]:
         """Prefill into a free slot; returns request id, or None when the
         pool is FULL (backpressure).  Invalid requests raise instead —
         None must stay unambiguous for retry loops.  ``eos_id`` finishes
         the request EARLY when sampled, releasing the slot — output is
         the prompt + generated tokens up to and including the eos (what
         ``generate(..., eos_id=...)`` yields once its masked tail is
-        dropped; asserted in tests)."""
+        dropped; asserted in tests).  ``adapter`` names this request's
+        LoRA adapter (pool required; pinned resident until release;
+        None on pool pressure, like every other backpressure)."""
         self.validate_request(prompt, max_new_tokens)
         self.validate_sampling(top_k, top_p)
+        self.validate_adapter(adapter)
         free = self.free_slots()
         if not free:
             RECORDER.record("admit_refused", reason="no_free_slot",
                             prompt_len=len(prompt))
             return None
         slot = free[0]
+        aidx = self._acquire_adapter(adapter)
+        if aidx is None:
+            RECORDER.record("admit_refused", reason="adapter_pool",
+                            prompt_len=len(prompt))
+            return None
+        if aidx:
+            # mapped BEFORE _reserve: the paged prefix-cache lookup
+            # namespaces by the slot's adapter
+            self._slot_adapter[slot] = aidx
         if not self._reserve(slot, len(prompt), max_new_tokens,
                              prompt=prompt):
             # storage backpressure: the pool's HBM budget said no — the
             # refusal event is the serving-plane grant/refusal record
+            self._release_adapter(slot)           # pin rolled back
             RECORDER.record("admit_refused", reason="storage",
                             prompt_len=len(prompt))
             return None
@@ -935,16 +1086,19 @@ class ContinuousBatcher:
                       temperature: float = 0.0, seed: int = 0,
                       chunk: int = 64,
                       eos_id: Optional[int] = None,
-                      top_k: int = 0, top_p: float = 1.0) -> Optional[int]:
+                      top_k: int = 0, top_p: float = 1.0,
+                      adapter: Optional[str] = None) -> Optional[int]:
         """Admit with the prompt streamed ``chunk`` tokens at a time by
         subsequent :meth:`advance_prefill` calls, so a long prompt never
         stalls decoding slots for more than one chunk's forward (the
         prefill/decode co-location trade).  Same validation and
-        backpressure contract as :meth:`admit`; outputs are
-        bit-identical to unchunked admission.
+        backpressure contract as :meth:`admit` (including the
+        ``adapter`` pin); outputs are bit-identical to unchunked
+        admission.
         """
         self.validate_request(prompt, max_new_tokens)
         self.validate_sampling(top_k, top_p)
+        self.validate_adapter(adapter)
         if chunk < 1:
             raise ValueError("chunk must be >= 1")
         free = self.free_slots()
@@ -953,8 +1107,17 @@ class ContinuousBatcher:
                             prompt_len=len(prompt))
             return None
         slot = free[0]
+        aidx = self._acquire_adapter(adapter)
+        if aidx is None:
+            RECORDER.record("admit_refused", reason="adapter_pool",
+                            prompt_len=len(prompt))
+            return None
+        if aidx:
+            # mapped BEFORE _reserve (prefix-cache namespacing)
+            self._slot_adapter[slot] = aidx
         if not self._reserve(slot, len(prompt), max_new_tokens,
                              prompt=prompt):
+            self._release_adapter(slot)           # pin rolled back
             RECORDER.record("admit_refused", reason="storage",
                             prompt_len=len(prompt))
             return None
@@ -1097,7 +1260,8 @@ class ContinuousBatcher:
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
                 _wrap_keys(jnp.asarray(keys)),
-                jnp.asarray(tks), jnp.asarray(tps), self._rich()))
+                jnp.asarray(tks), jnp.asarray(tps), self._rich(),
+                ads=self._adapter_ids_array()))
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         for i in list(self.slots):
@@ -1155,7 +1319,8 @@ class ContinuousBatcher:
                     jnp.asarray(temps),
                     _wrap_keys(jnp.asarray(keys)),
                     jnp.asarray(tks), jnp.asarray(tps), jnp.asarray(incs),
-                    self._rich(), n_steps)
+                    self._rich(), n_steps,
+                    ads=self._adapter_ids_array())
             toks = np.asarray(toks)
             new_keys = np.asarray(jax.random.key_data(new_keys))
         self._acct_credit(g.device_s, rids)
@@ -1204,17 +1369,20 @@ class ContinuousBatcher:
 
     def _step_mixed(self, p_tokens, p_slots, p_active, p_pos, p_last,
                     tokens, lengths, temps, keys, tks, tps, incs, rich,
-                    chunk_len: int, n_steps: int):
+                    chunk_len: int, n_steps: int, ads=None, p_ads=None):
         """THE one device dispatch of a mixed round (storage hook).
         Returns (chunk-final logits [R, V], decode tokens [B, n], final
         keys)."""
         src_rows, src_mask = self._mixed_src(p_slots, p_active)
+        adapters, aids = self._adapter_operands(ads)
+        _, p_aids = self._adapter_operands(p_ads)
         sel, toks, keys, self.caches = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
             jnp.asarray(p_pos), jnp.asarray(p_last),
             jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
             tokens, lengths, temps, keys, tks, tps, incs,
-            self.cfg, chunk_len, n_steps, rich)
+            self.cfg, chunk_len, n_steps, rich, adapters=adapters,
+            aids=aids, p_aids=p_aids)
         return sel, toks, keys
 
     def _mixed_src(self, p_slots, p_active):
@@ -1231,26 +1399,29 @@ class ContinuousBatcher:
     # -- speculative step hooks ----------------------------------------
     def _step_spec(self, bufs, buf_lens, n_ctxs, next_toks, remainings,
                    actives, temps, keys, tks, tps, rich, k: int,
-                   ngram: int, n_rounds: int):
+                   ngram: int, n_rounds: int, ads=None):
         """THE one device dispatch of a speculative round batch
         (storage hook).  Returns (bufs, produced, next_toks, keys,
         accepts, spec_lives)."""
+        adapters, aids = self._adapter_operands(ads)
         (bufs, _, _, next_toks, produced, keys, accepts, lives,
          self.caches) = _tick_spec(
             self.params, bufs, self.caches, buf_lens, n_ctxs, next_toks,
             remainings, actives, temps, keys, tks, tps, self.cfg, k,
-            ngram, n_rounds, rich)
+            ngram, n_rounds, rich, adapters=adapters, aids=aids)
         return bufs, produced, next_toks, keys, accepts, lives
 
     def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
                          p_last, bufs, buf_lens, n_ctxs, next_toks,
                          remainings, actives, temps, keys, tks, tps,
                          rich, chunk_len: int, k: int, ngram: int,
-                         n_rounds: int):
+                         n_rounds: int, ads=None, p_ads=None):
         """THE one device dispatch of a mixed round with speculation
         (storage hook).  Returns (chunk-final logits [R, V],) + the
         :meth:`_step_spec` outputs."""
         src_rows, src_mask = self._mixed_src(p_slots, p_active)
+        adapters, aids = self._adapter_operands(ads)
+        _, p_aids = self._adapter_operands(p_ads)
         (sel, bufs, _, _, next_toks, produced, keys, accepts, lives,
          self.caches) = _tick_mixed_spec(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
@@ -1258,7 +1429,8 @@ class ContinuousBatcher:
             jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
             bufs, buf_lens, n_ctxs, next_toks, remainings, actives,
             temps, keys, tks, tps, self.cfg, chunk_len, k, ngram,
-            n_rounds, rich)
+            n_rounds, rich, adapters=adapters, aids=aids,
+            p_aids=p_aids)
         return sel, bufs, produced, next_toks, keys, accepts, lives
 
     def _plan_mixed_round(self, chunk: int, budget: int):
@@ -1284,6 +1456,7 @@ class ContinuousBatcher:
         p_active = np.zeros((R,), bool)
         p_pos = np.zeros((R,), np.int32)
         p_last = np.zeros((R,), np.int32)
+        p_ads = np.zeros((R,), np.int32)
         plan = []                      # (row, slot, state, chunk end)
         n_real = 0
         for r, i in enumerate(picked):
@@ -1295,6 +1468,7 @@ class ContinuousBatcher:
             p_active[r] = True
             p_pos[r] = st.pos
             p_last[r] = len(piece) - 1
+            p_ads[r] = self._slot_adapter.get(i, 0)
             plan.append((r, i, st, end))
             n_real += len(piece)
         metrics.MIXED_STEPS.inc()
@@ -1302,7 +1476,7 @@ class ContinuousBatcher:
         metrics.MIXED_BUDGET_UTILIZATION.set(n_real / float(R * C))
         return {"C": C, "p_tokens": p_tokens, "p_slots": p_slots,
                 "p_active": p_active, "p_pos": p_pos, "p_last": p_last,
-                "plan": plan}, overflow
+                "p_ads": p_ads, "plan": plan}, overflow
 
     def _mixed_fallback(self, overflow, t0, decode) -> int:
         """Nothing for the fixed-width block to do this round: advance
@@ -1415,7 +1589,8 @@ class ContinuousBatcher:
                     _wrap_keys(jnp.asarray(keys)),
                     jnp.asarray(tks), jnp.asarray(tps),
                     jnp.asarray(incs), self._rich(), block["C"],
-                    n_steps)
+                    n_steps, ads=self._adapter_ids_array(),
+                    p_ads=block["p_ads"])
             # Host fetches are the real sync points (CLAUDE.md): fetch
             # ONLY what this round consumes, so pure-prefill rounds
             # with no completions stay fully async and pipeline like
@@ -1631,7 +1806,8 @@ class ContinuousBatcher:
                                            spec_rounds=n_rounds,
                                            rids=rids) as g:
             out = self._step_spec(*self._spec_operands(arrays),
-                                  self._rich(), k, ngram, n_rounds)
+                                  self._rich(), k, ngram, n_rounds,
+                                  ads=self._adapter_ids_array())
             bufs_h = np.asarray(out[0])
             produced = np.asarray(out[1])
             next_h = np.asarray(out[2])
@@ -1698,7 +1874,9 @@ class ContinuousBatcher:
                     block["p_tokens"], block["p_slots"],
                     block["p_active"], block["p_pos"], block["p_last"],
                     *self._spec_operands(arrays), self._rich(),
-                    block["C"], k, ngram, n_rounds)
+                    block["C"], k, ngram, n_rounds,
+                    ads=self._adapter_ids_array(),
+                    p_ads=block["p_ads"])
             sel = out[0]
             # host fetches only what this round consumes (lazy, like
             # tick_mixed): pure-prefill rounds stay fully async
@@ -1754,9 +1932,18 @@ _THREAD_MANIFEST = {
                       "_policy_pacer"),
     "lock_crossed": ("_waiting", "_mig_cmds", "_cancels"),
     "batcher_attr": "_batcher",
+    # adapter-pool note (round 20): the multi-adapter LoRA pool is
+    # LOOP-OWNED state inside the batcher (reached only through
+    # ``_batcher``) — acquire/load/evict run at admission and release
+    # at completion, both loop-side; handler threads see it only
+    # through the read-only snapshots below (``adapter_pressure`` is
+    # the llm server's 503 gate, ``validate_adapter``/``adapter_info``
+    # pure views), exactly like the page free-list before it.
     "batcher_readonly": ("validate_request", "validate_sampling",
                          "validate_spec_request", "spec_fallback_reason",
-                         "can_migrate", "storage_info", "free_slots"),
+                         "can_migrate", "storage_info", "free_slots",
+                         "validate_adapter", "adapter_pressure",
+                         "adapter_info"),
 }
 
 
@@ -1783,7 +1970,9 @@ class ContinuousService:
                  mixed_step: bool = True,
                  prefill_budget: Optional[int] = None,
                  spill_bytes: Optional[int] = None,
-                 policy=None):
+                 policy=None,
+                 adapter_slots: int = 0,
+                 adapter_rank: int = 8):
         import os as _os
         import queue as _q
         import threading
@@ -1864,14 +2053,17 @@ class ContinuousService:
             self._batcher = PagedContinuousBatcher(
                 params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
                 mesh=mesh, max_prefill_chunk=self._prefill_chunk,
-                prefix_cache=prefix_cache, spec_k=self._spec_k)
+                prefix_cache=prefix_cache, spec_k=self._spec_k,
+                adapter_slots=adapter_slots, adapter_rank=adapter_rank)
         else:
             if prefix_cache:
                 raise ValueError("prefix_cache rides the paged pool; "
                                  "pass page_size too")
             self._batcher = ContinuousBatcher(params, cfg, n_slots,
                                               mesh=mesh,
-                                              spec_k=self._spec_k)
+                                              spec_k=self._spec_k,
+                                              adapter_slots=adapter_slots,
+                                              adapter_rank=adapter_rank)
         if self._spec_k:
             # the REAL capability check (replaced the round-5 dense-only
             # refusal): a storage that cannot contain a k-token rejected
@@ -1925,7 +2117,7 @@ class ContinuousService:
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._halt = threading.Event()
-        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete, t_submit, handoff)
+        self._waiting: List[Tuple] = []   # (prompt, max_new, temp, seed, eos, top_k, top_p, stream, sink, on_complete, t_submit, handoff, adapter)
         # rid -> [t_submit, prompt_len, t_first_token|None]: feeds the
         # request-latency / TTFT / per-token histograms (loop-owned,
         # like _sinks)
@@ -2012,6 +2204,17 @@ class ContinuousService:
         confinement lint enforces it)."""
         return self._batcher.can_migrate()
 
+    def adapter_pressure(self, adapter: Optional[str]) -> bool:
+        """Read-only adapter-pool pressure verdict (the llm server's
+        503 admission gate) — a point-in-time snapshot, safe from
+        handler threads like :meth:`storage_info`."""
+        return self._batcher.adapter_pressure(adapter)
+
+    def validate_adapter(self, adapter: Optional[str]) -> None:
+        """Pure adapter validation (raises for requests this service
+        could never serve) — callable from any thread."""
+        self._batcher.validate_adapter(adapter)
+
     def storage_info(self) -> dict:
         """The storage economics dict of the underlying pool (pure
         derivation from construction-time config — safe off-loop)."""
@@ -2026,7 +2229,8 @@ class ContinuousService:
                       temperature: float = 0.0, seed: int = 0,
                       eos_id: Optional[int] = None,
                       top_k: int = 0, top_p: float = 1.0,
-                      on_complete=None):
+                      on_complete=None,
+                      adapter: Optional[str] = None):
         """Streaming submit: the returned queue yields ``("delta",
         [new generated tokens])`` items as decoding progresses (chunk
         granularity under fused decode), then ``("done", full_output)``
@@ -2040,24 +2244,29 @@ class ContinuousService:
         decode loop); exceptions are swallowed with a log line."""
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=True,
-                            on_complete=on_complete)
+                            on_complete=on_complete, adapter=adapter)
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               top_k: int = 0, top_p: float = 1.0):
+               top_k: int = 0, top_p: float = 1.0,
+               adapter: Optional[str] = None):
         """Returns a queue that yields the full token list (or None on
         shutdown). Raises ValueError for invalid requests (including
         ones the batcher's storage could never hold).  ``eos_id``
         finishes the request early, releasing its slot; ``top_k``/
-        ``top_p`` filter the sampling distribution per request."""
+        ``top_p`` filter the sampling distribution per request;
+        ``adapter`` names the request's LoRA adapter (adapter pool
+        required — ``adapter_slots``)."""
         return self._submit(prompt, max_new_tokens, temperature, seed,
-                            eos_id, top_k, top_p, stream=False)
+                            eos_id, top_k, top_p, stream=False,
+                            adapter=adapter)
 
     def submit_handoff(self, prompt: List[int], max_new_tokens: int,
                        temperature: float = 0.0, seed: int = 0,
                        eos_id: Optional[int] = None,
-                       top_k: int = 0, top_p: float = 1.0):
+                       top_k: int = 0, top_p: float = 1.0,
+                       adapter: Optional[str] = None):
         """PREFILL-ONLY submit (the disaggregation sender half): the
         request prefills normally, and at the activation boundary —
         prompt in cache, first token sampled, before it joins any
@@ -2071,7 +2280,7 @@ class ContinuousService:
                              "(pass page_size)")
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=False,
-                            handoff=True)
+                            handoff=True, adapter=adapter)
 
     def import_session(self, blob: bytes):
         """Schedule a migration blob for import on the loop thread;
@@ -2121,9 +2330,10 @@ class ContinuousService:
 
     def _submit(self, prompt, max_new_tokens, temperature, seed, eos_id,
                 top_k, top_p, stream: bool, on_complete=None,
-                handoff: bool = False):
+                handoff: bool = False, adapter: Optional[str] = None):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
+        self._batcher.validate_adapter(adapter)
         if self._spec_k:
             # storage-aware: only the full-size dense pool still needs
             # the +k cache headroom (see validate_spec_request)
@@ -2137,7 +2347,7 @@ class ContinuousService:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
                  top_k, top_p, stream, sink, on_complete,
-                 time.perf_counter(), handoff))
+                 time.perf_counter(), handoff, adapter))
         self._work.set()
         return sink
 
@@ -2484,6 +2694,9 @@ class ContinuousService:
         if self._spill is not None:
             snap["spilled"] = len(self._spill)
             snap["spill_bytes"] = self._spill.bytes_used
+        adapters = self._batcher.adapter_info()
+        if adapters is not None:
+            snap["adapters"] = adapters
         if self._spec_k:
             st = dict(self._batcher._spec_stats)
             st["tokens_per_round"] = (round(st["tokens"] / st["rounds"], 3)
@@ -2526,23 +2739,52 @@ class ContinuousService:
                         break
                     item = self._waiting.pop(0)
                 (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
-                 sink, on_cb, t_sub, handoff) = item
+                 sink, on_cb, t_sub, handoff, adapter) = item
                 rid = None
+                admit_failed = False
                 while True:
                     if self._batcher.free_slots():
-                        rid = self._batcher.admit_chunked(
-                            prompt, max_new, temperature=temp,
-                            seed=seed, chunk=self._prefill_chunk,
-                            eos_id=eos_id, top_k=tk, top_p=tp)
+                        try:
+                            rid = self._batcher.admit_chunked(
+                                prompt, max_new, temperature=temp,
+                                seed=seed, chunk=self._prefill_chunk,
+                                eos_id=eos_id, top_k=tk, top_p=tp,
+                                adapter=adapter)
+                        except Exception:
+                            # a per-request admission failure (e.g. an
+                            # adapter LOADER error for a bad name) must
+                            # abort THAT request, never the loop every
+                            # tenant's serving rides on
+                            log.exception(
+                                "admission failed for a queued request"
+                                " (adapter=%r); aborting it", adapter)
+                            admit_failed = True
+                            break
                         if rid is not None:
                             break
                     # Backpressure (no slot, or paged storage out of
                     # pages): the SPILL TIER parks the longest-resident
                     # decoding session in host RAM and retries — the
                     # capacity multiplier.  Bounded: each pass removes
-                    # one resident session.
+                    # one resident session.  ADAPTER-pool pressure only
+                    # spills while some decoding session holds a pin
+                    # (exporting it releases the pin; spilling
+                    # base-model sessions frees pages this refusal
+                    # does not need).
+                    if (adapter is not None
+                            and self._batcher.adapter_pressure(adapter)
+                            and not
+                            self._batcher.adapter_spill_can_help()):
+                        break
                     if not self._spill_one():
                         break
+                if admit_failed:
+                    try:
+                        sink.put_nowait(("aborted", None) if stream
+                                        else None)
+                    except self._q.Full:
+                        pass
+                    continue
                 if rid is None:
                     # No spill capacity either: requeue at the FRONT
                     # and stop admitting until a tick releases capacity

@@ -121,7 +121,9 @@ class LLMServer:
                  prefill_budget: int = 0,
                  mixed_step: bool = True,
                  spill_bytes: int = 0,
-                 policy_client=None):
+                 policy_client=None,
+                 adapter_slots: int = 0,
+                 adapter_rank: int = 8):
         """``n_slots > 0`` serves requests (greedy or sampled) through the
         continuous batcher; ``n_slots == 0`` uses the serialized
         per-request path.  ``page_size > 0`` stores the KV cache in a
@@ -135,7 +137,11 @@ class LLMServer:
         the prompt tokens one MIXED service round coalesces into its
         single-dispatch prefill block (0 = two prefill chunks);
         ``mixed_step=False`` restores the sequential advance-then-fuse
-        interleave."""
+        interleave.  ``adapter_slots > 0`` builds the multi-adapter
+        LoRA pool (rank ``adapter_rank``): /generate accepts
+        ``"adapter": <name>`` and a mixed-adapter batch still runs ONE
+        dispatch per round; admissions naming a non-resident adapter
+        against a fully-pinned pool answer 503 + Retry-After."""
         from .. import telemetry
         from ..telemetry.events import debug_events_route
         from ..utils.httpserver import JsonHTTPServer, RawBody
@@ -169,6 +175,11 @@ class LLMServer:
             # unsharded would defeat the point of asking for tp
             raise ValueError("tp > 1 requires n_slots > 0 "
                              "(tensor-parallel serving rides the "
+                             "continuous batcher)")
+        self._adapter_slots = int(adapter_slots)
+        if adapter_slots > 0 and n_slots <= 0:
+            raise ValueError("adapter_slots > 0 requires n_slots > 0 "
+                             "(multi-adapter serving rides the "
                              "continuous batcher)")
         if sp > 1 and (n_slots <= 0 or page_size <= 0):
             # position striping spreads PAGES over the mesh; only the
@@ -205,7 +216,9 @@ class LLMServer:
                 prefill_budget=prefill_budget or None,
                 spill_bytes=spill_bytes or None,
                 policy=(policy_client.pacer
-                        if policy_client is not None else None)).start()
+                        if policy_client is not None else None),
+                adapter_slots=adapter_slots,
+                adapter_rank=adapter_rank).start()
             # Operator-visible kernel demotion (round 17 satellite): a
             # pallas config whose pool fails a viability gate (e.g. a
             # page_size=16 int8 pool's 32-row sublane tile) serves the
@@ -532,6 +545,20 @@ class LLMServer:
         if phase == "prefill":
             return self._generate_prefill_only(tokens, fields)
         if self._service is not None:
+            adapter = fields["adapter"]
+            if adapter and self._service.adapter_pressure(adapter):
+                # adapter-pool pressure: every pool row pinned by an
+                # in-flight request and this name not resident — the
+                # usual bounded-backoff refusal (re-submittable; pins
+                # release as requests complete, and the fleet router
+                # re-dispatches a 503 to a replica that may already
+                # hold the adapter)
+                return (503,
+                        {"Error": "adapter pool at capacity (every "
+                                  "resident adapter pinned by an "
+                                  "in-flight request); retry after "
+                                  "the indicated backoff"},
+                        {"Retry-After": "2"})
             # greedy and sampling both ride the slot pool (per-slot
             # temperature/keys) — no second KV cache beside the pool
             # Derive a per-row seed: identical prompts in one request must
@@ -540,7 +567,8 @@ class LLMServer:
             sinks = [self._service.submit([int(t) for t in row], max_new,
                                           temperature=temperature,
                                           seed=seed + i, eos_id=eos_id,
-                                          top_k=top_k, top_p=top_p)
+                                          top_k=top_k, top_p=top_p,
+                                          adapter=adapter)
                      for i, row in enumerate(tokens)]
             import queue as _q
 
@@ -607,11 +635,16 @@ class LLMServer:
         if len(tokens) != 1:
             return 400, {"Error": "phase='prefill' takes exactly one "
                                   "prompt row"}
+        if fields["adapter"] and self._service.adapter_pressure(
+                fields["adapter"]):
+            return (503, {"Error": "adapter pool at capacity; retry "
+                                   "after the indicated backoff"},
+                    {"Retry-After": "2"})
         sink = self._service.submit_handoff(
             [int(t) for t in tokens[0]], fields["max_new"],
             temperature=fields["temperature"], seed=fields["seed"],
             eos_id=fields["eos_id"], top_k=fields["top_k"],
-            top_p=fields["top_p"])
+            top_p=fields["top_p"], adapter=fields["adapter"])
         try:
             out = sink.get(timeout=600)
         except _q.Empty:
@@ -645,6 +678,16 @@ class LLMServer:
             f["eos_id"] = int(eos) if eos is not None else None
         except (TypeError, ValueError) as e:
             return None, (400, {"Error": f"malformed field: {e}"})
+        adapter = body.get("adapter")
+        if adapter is not None and (not isinstance(adapter, str)
+                                    or not adapter):
+            return None, (400, {"Error": "adapter must be a non-empty "
+                                         "string"})
+        f["adapter"] = adapter
+        if adapter and not self._adapter_slots:
+            return None, (400, {"Error": "adapter serving needs the "
+                                         "adapter pool; run with "
+                                         "--slots and --adapter-slots"})
         if f["max_new"] < 1:
             return None, (400, {"Error": "max_new_tokens must be >= 1"})
         if (f["eos_id"] is not None
@@ -740,13 +783,14 @@ class LLMServer:
         if refused is not None:
             return refused
         try:
-            code, payload = self._generate_stream_impl(body)
+            out = self._generate_stream_impl(body)
         except BaseException:
             self._end_request()            # a leak here would pin
             raise                          # /healthz drained:false forever
+        code, payload = out[0], out[1]
         if not isinstance(payload, StreamingBody):
             self._end_request()            # refused before streaming
-            return code, payload
+            return out                     # may carry headers (503s)
         # the request stays in-flight until the stream ends — done,
         # abort, client disconnect, or closed before the first chunk
         # (the httpserver's finally calls .close() on every path)
@@ -800,10 +844,15 @@ class LLMServer:
                 self.sequences_served += 1
                 self.tokens_generated += len(out) - len(row)
 
+        if fields["adapter"] and self._service.adapter_pressure(
+                fields["adapter"]):
+            return (503, {"Error": "adapter pool at capacity; retry "
+                                   "after the indicated backoff"},
+                    {"Retry-After": "2"})
         sink = self._service.submit_stream(
             row, max_new, temperature=temperature, seed=seed,
             eos_id=eos_id, top_k=top_k, top_p=top_p,
-            on_complete=on_complete)
+            on_complete=on_complete, adapter=fields["adapter"])
         import queue as _q
 
         def chunks():
@@ -969,6 +1018,25 @@ def main(argv=None) -> int:
                          "tokens (page ring without the eviction "
                          "margin) disables speculation with a counted "
                          "fallback instead of refusing to serve")
+    ap.add_argument("--adapter-slots", type=int, default=0,
+                    help="multi-adapter LoRA pool capacity: named "
+                         "adapters resident per server (0 = off; "
+                         "requires --slots).  /generate accepts "
+                         "\"adapter\": <name>; each request's adapter "
+                         "gathers per-row INSIDE the one batched "
+                         "dispatch (two skinny matmuls per "
+                         "projection), so thousands of tenants share "
+                         "one resident base model instead of one "
+                         "merged replica each.  Adapters load "
+                         "on-demand (deterministic per name across "
+                         "replicas), LRU-evict when unpinned, and "
+                         "admissions against a fully-pinned pool "
+                         "answer 503 + Retry-After")
+    ap.add_argument("--adapter-rank", type=int, default=8,
+                    help="LoRA rank of the serving adapter pool "
+                         "(every resident adapter costs "
+                         "rank*(d_in+d_out) per projection instead "
+                         "of a merged model copy)")
     ap.add_argument("--prefix-cache", action="store_true",
                     help="reuse completed requests' prompt-prefix KV "
                          "pages for same-prefix admissions (requires "
@@ -1028,6 +1096,8 @@ def main(argv=None) -> int:
         ap.error("--prefix-cache requires --page-size")
     if args.spec_k and not args.slots:
         ap.error("--spec-k requires --slots")
+    if args.adapter_slots and not args.slots:
+        ap.error("--adapter-slots requires --slots")
     if args.page_size and not args.slots:
         ap.error("--page-size requires --slots")
     if args.kv_pages and not args.page_size:
@@ -1090,7 +1160,9 @@ def main(argv=None) -> int:
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill,
                     spill_bytes=args.spill_bytes,
-                    policy_client=policy_client)
+                    policy_client=policy_client,
+                    adapter_slots=args.adapter_slots,
+                    adapter_rank=args.adapter_rank)
     # Tenant accounting: when the allocation injected a daemon status
     # port, report this tenant's usage (HBM peak + device-time/goodput/
     # qps/stalls, contract.report_usage) on a low-frequency loop — the

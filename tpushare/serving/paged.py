@@ -55,30 +55,33 @@ log = logging.getLogger("tpushare.serving")
                                              "mesh"),
                    donate_argnums=(2,))
 def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int,
-             mesh=None):
+             mesh=None, adapters=None, aids=None):
     return transformer.forward_paged_prefill(
-        params, tokens, cfg, pools, page_rows, prompt_len, mesh=mesh)
+        params, tokens, cfg, pools, page_rows, prompt_len, mesh=mesh,
+        adapters=adapters, adapter_ids=aids)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "window", "mesh"),
                    donate_argnums=(2,))
 def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
-                   window: int, mesh=None):
+                   window: int, mesh=None, adapters=None, aids=None):
     return transformer.forward_paged_prefill_chunk(
         params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx,
-        mesh=mesh)
+        mesh=mesh, adapters=adapters, adapter_ids=aids)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "rich", "mesh"),
                    donate_argnums=(2,))
 def _tick(params, tokens, pools, page_table, lengths, temps, keys,
-          tks, tps, cfg, rich: bool = False, mesh=None):
+          tks, tps, cfg, rich: bool = False, mesh=None, adapters=None,
+          aids=None):
     """Paged twin of continuous._tick (same sampling helper).  ``mesh``
     is STATIC (jax.sharding.Mesh hashes by devices+axes): under tp it
     reaches the paged-attention dispatcher, which shard_maps the Pallas
     read per device."""
     logits, pools = transformer.forward_paged_decode(
-        params, tokens, cfg, pools, page_table, lengths, mesh=mesh)
+        params, tokens, cfg, pools, page_table, lengths, mesh=mesh,
+        adapters=adapters, adapter_ids=aids)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
     return nxt, pools
@@ -87,7 +90,8 @@ def _tick(params, tokens, pools, page_table, lengths, temps, keys,
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "mesh"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
-            tks, tps, incs, cfg, n: int, rich: bool = False, mesh=None):
+            tks, tps, incs, cfg, n: int, rich: bool = False, mesh=None,
+            adapters=None, aids=None):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
@@ -104,11 +108,13 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     the coupling between decode_chunk and the ring size entirely.
     """
     return _decode_scan(params, tokens, pools, page_table, lengths,
-                        temps, keys, tks, tps, incs, cfg, n, rich, mesh)
+                        temps, keys, tks, tps, incs, cfg, n, rich, mesh,
+                        adapters=adapters, aids=aids)
 
 
 def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
-                 tks, tps, incs, cfg, n: int, rich: bool, mesh=None):
+                 tks, tps, incs, cfg, n: int, rich: bool, mesh=None,
+                 adapters=None, aids=None):
     """The paged fused decode scan BODY (trace-level) shared by
     :func:`_tick_n` and the mixed-step program :func:`_tick_mixed` —
     one definition, so the two dispatch flavors cannot drift."""
@@ -116,7 +122,8 @@ def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
         tok, pools, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)
         logits, pools = transformer.forward_paged_decode(
-            params, tok, cfg, pools, page_table, lengths, mesh=mesh)
+            params, tok, cfg, pools, page_table, lengths, mesh=mesh,
+            adapters=adapters, adapter_ids=aids)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
         return (nxt[:, None], pools, lengths + incs, ks[:, 0]), nxt
@@ -132,7 +139,7 @@ def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
 def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
                 page_table, tokens, lengths, temps, keys, tks, tps, incs,
                 cfg, chunk_len: int, n: int, rich: bool = False,
-                mesh=None):
+                mesh=None, adapters=None, aids=None, p_aids=None):
     """Paged twin of continuous._tick_mixed: the coalesced multi-prompt
     prefill (:func:`transformer.forward_paged_prefill_batch` — live rows
     write their own distinct pages, padded rows ride all-zero tables so
@@ -142,10 +149,10 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
     writes through each row's own table row, never reshaping it."""
     sel, pools = transformer.forward_paged_prefill_batch(
         params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
-        p_last, mesh=mesh)
+        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids)
     toks, keys, pools = _decode_scan(
         params, tokens, pools, page_table, lengths, temps, keys, tks,
-        tps, incs, cfg, n, rich, mesh)
+        tps, incs, cfg, n, rich, mesh, adapters=adapters, aids=aids)
     return sel, toks, keys, pools
 
 
@@ -155,7 +162,7 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
 def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
                next_toks, remainings, actives, temps, keys, tks, tps,
                cfg, k: int, ngram: int, n_rounds: int,
-               rich: bool = False, mesh=None):
+               rich: bool = False, mesh=None, adapters=None, aids=None):
     """Paged twin of continuous._tick_spec: ``n_rounds`` of batched
     prompt-lookup speculation against the page pool in one dispatch
     (the shared round body, :func:`tpushare.serving.speculative
@@ -173,7 +180,8 @@ def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
 
     def verify(blocks, n_ctxs, live, pools):
         return transformer.forward_paged_verify(
-            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh)
+            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh,
+            adapters=adapters, adapter_ids=aids)
 
     return spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
                      next_toks, remainings, actives, temps, keys, tks,
@@ -188,7 +196,8 @@ def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
                      page_table, bufs, buf_lens, n_ctxs, next_toks,
                      remainings, actives, temps, keys, tks, tps, cfg,
                      chunk_len: int, k: int, ngram: int, n_rounds: int,
-                     rich: bool = False, mesh=None):
+                     rich: bool = False, mesh=None, adapters=None,
+                     aids=None, p_aids=None):
     """Paged twin of continuous._tick_mixed_spec: the coalesced
     multi-prompt prefill (:func:`transformer.forward_paged_prefill_
     batch`) followed by the speculative verify rounds, in ONE dispatch
@@ -198,13 +207,14 @@ def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
     like the plain mixed scan's ``incs``-frozen rows."""
     sel, pools = transformer.forward_paged_prefill_batch(
         params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
-        p_last, mesh=mesh)
+        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids)
 
     from .speculative import spec_scan
 
     def verify(blocks, n_ctxs, live, pools):
         return transformer.forward_paged_verify(
-            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh)
+            params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh,
+            adapters=adapters, adapter_ids=aids)
 
     out = spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
                     next_toks, remainings, actives, temps, keys, tks,
@@ -250,12 +260,20 @@ class _CachedPrefix:
     nothing ever writes a registered page (decode/prefill writes start
     past the shared region, garbage writes are aimed at each slot's own
     positions).  Evictable only at active == 0.
+
+    ``adapter`` names the LoRA adapter the donor request ran with
+    (None = base model): cached K/V depends on the donor's wk/wv
+    adapter deltas, so a prefix is reusable ONLY by requests running
+    the SAME adapter — the registry keys and the lookup both carry it
+    (cross-adapter reuse would serve adapter-tainted keys and break
+    the mixed-batch == solo exactness contract).
     """
 
     tokens: tuple          # the full-page prefix, exactly
     pages: list            # physical pages, in position order
     active: int = 0        # slots currently mapping these pages
     last_used: float = 0.0
+    adapter: Optional[str] = None
 
 
 class PagedContinuousBatcher(ContinuousBatcher):
@@ -266,7 +284,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
                  mesh=None, max_prefill_chunk: int = 64,
                  prefix_cache: bool = False,
                  pool_bytes: Optional[int] = None,
-                 spec_k: int = 0):
+                 spec_k: int = 0, adapter_slots: int = 0,
+                 adapter_rank: int = 8, adapter_loader=None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
@@ -345,7 +364,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
         # paged storage is position-indexed (no ring wraparound); the
         # rolling-slot layout is a dense-pool concern
         super().__init__(params, cfg, n_slots, mesh=mesh,
-                         rolling_slots=False, spec_k=spec_k)
+                         rolling_slots=False, spec_k=spec_k,
+                         adapter_slots=adapter_slots,
+                         adapter_rank=adapter_rank,
+                         adapter_loader=adapter_loader)
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -469,6 +491,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
             info["sp_merge_transient_bytes"] = int(
                 self.n_slots * cfg.n_kv_heads * rows
                 * (cfg.head_dim + 2) * 4)
+        if self.adapter_pool is not None:
+            # the SECOND HBM pool class (round 20): adapter residency
+            # economics next to the KV pool's
+            info.update(self.adapter_pool.storage_info())
         return info
 
     # -- storage hooks -------------------------------------------------
@@ -561,17 +587,31 @@ class PagedContinuousBatcher(ContinuousBatcher):
         c_pages = -(-self.max_prefill_chunk // self.page_size)
         return w_pages + c_pages + 1
 
-    def _lookup_prefix(self, prompt: List[int]) -> Optional[_CachedPrefix]:
-        """Longest registered prefix usable for this prompt: a full-page
-        token prefix, capped one token short of the prompt (admission
-        must still prefill >= 1 position to produce the first logits)."""
+    @staticmethod
+    def _registry_key(adapter: Optional[str], tokens: tuple):
+        """Prefix-registry key: the token tuple for base requests
+        (byte-identical to the pre-adapter registry), namespaced by the
+        adapter name otherwise — same tokens under different adapters
+        are DIFFERENT cached K/V."""
+        return tokens if adapter is None else (adapter, tokens)
+
+    def _lookup_prefix(self, prompt: List[int],
+                       adapter: Optional[str] = None
+                       ) -> Optional[_CachedPrefix]:
+        """Longest registered prefix usable for this prompt UNDER THIS
+        ADAPTER: a full-page token prefix, capped one token short of
+        the prompt (admission must still prefill >= 1 position to
+        produce the first logits); entries donated under a different
+        adapter never match (their K/V carries that adapter's
+        deltas)."""
         if not self.prefix_cache_enabled or prompt is None:
             return None
         usable = ((len(prompt) - 1) // self.page_size) * self.page_size
         best = None
-        for key, entry in self._prefixes.items():
-            n = len(key)
-            if (n <= usable and tuple(prompt[:n]) == key
+        for entry in self._prefixes.values():
+            n = len(entry.tokens)
+            if (entry.adapter == adapter and n <= usable
+                    and tuple(prompt[:n]) == entry.tokens
                     and (best is None or n > len(best.tokens))):
                 best = entry
         return best
@@ -602,14 +642,19 @@ class PagedContinuousBatcher(ContinuousBatcher):
             if not idle:
                 return
             victim = min(idle, key=lambda e: e.last_used)
-            del self._prefixes[victim.tokens]
+            del self._prefixes[self._registry_key(victim.adapter,
+                                                  victim.tokens)]
             self._free_pages_return(victim.pages)
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int,
                  prompt: Optional[List[int]] = None) -> bool:
         n_ranges = -(-(prompt_len + max_new) // self.page_size)
         held = self._held_pages(prompt_len, max_new)
-        shared = self._lookup_prefix(prompt) if held == n_ranges else None
+        # the slot's adapter is pinned (and mapped) BEFORE _reserve, so
+        # the prefix lookup matches only same-adapter donations
+        ad_name = self._adapter_name_of(slot)
+        shared = (self._lookup_prefix(prompt, ad_name)
+                  if held == n_ranges else None)
         n_shared = len(shared.pages) if shared is not None else 0
         if shared is not None:
             # claim BEFORE any eviction: an idle matched entry must not
@@ -637,7 +682,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             # j's page already lives on stripe j % sp); this slot's own
             # pages take over from there
             self.page_table[slot, :n_shared] = shared.pages
-            self._slot_prefix[slot] = shared.tokens
+            self._slot_prefix[slot] = self._registry_key(shared.adapter,
+                                                         shared.tokens)
             self._slot_shared[slot] = n_shared * self.page_size
             for j in range(n_shared, n_ranges):
                 p = self._free_by_stripe[j % self.sp_shards].pop()
@@ -684,6 +730,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 entry.last_used = time.monotonic()
         elif self.prefix_cache_enabled:
             self._maybe_register(slot)
+        # adapter unpin LAST: _maybe_register reads the slot's adapter
+        # name to namespace its donation
+        self._release_adapter(slot)
         self.page_table[slot, :] = 0
         self._free_pages_return(self._slot_pages.pop(slot, []))
         self._update_page_gauges()
@@ -707,7 +756,9 @@ class PagedContinuousBatcher(ContinuousBatcher):
         k_pure = s.prompt_len // self.page_size     # whole-prompt pages
         if k_pure < 1:
             return
-        key = tuple(s.output[:k_pure * self.page_size])
+        tokens = tuple(s.output[:k_pure * self.page_size])
+        ad_name = self._adapter_name_of(slot)
+        key = self._registry_key(ad_name, tokens)
         if key in self._prefixes:
             return
         self._evict_prefixes(0, registry_room=k_pure)
@@ -720,8 +771,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         if any(p == 0 for p in donated) or len(own) < k_pure:
             return                      # defensive: never donate trash
         self._prefixes[key] = _CachedPrefix(
-            tokens=key, pages=donated, active=0,
-            last_used=time.monotonic())
+            tokens=tokens, pages=donated, active=0,
+            last_used=time.monotonic(), adapter=ad_name)
         self._slot_pages[slot] = [p for p in own if p not in set(donated)]
 
     def _prefill_into(self, slot: int, tokens, prompt_len: int):
@@ -750,33 +801,40 @@ class PagedContinuousBatcher(ContinuousBatcher):
                     slot, padded, pos, len(piece) - 1, window)
                 pos += len(piece)
             return logits_v
+        adapters, aids = self._adapter_operands(
+            [self._slot_adapter.get(slot, 0)])
         logits, self.pools = _prefill(
             self.params, tokens, self.pools,
             jnp.asarray(self.page_table[slot]), self.cfg, prompt_len,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids)
         return logits[0]      # [V]: the prompt's last-position logits
 
-    def _step(self, tokens, lengths, temps, keys, tks, tps, rich):
+    def _step(self, tokens, lengths, temps, keys, tks, tps, rich,
+              ads=None):
+        adapters, aids = self._adapter_operands(ads)
         nxt, self.pools = _tick(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, self.cfg, rich,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids)
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
-                n_steps: int):
+                n_steps: int, ads=None):
+        adapters, aids = self._adapter_operands(ads)
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
                             last_idx: int, chunk_len: int):
+        adapters, aids = self._adapter_operands(
+            [self._slot_adapter.get(slot, 0)])
         logits, self.pools = _prefill_chunk(
             self.params, jnp.asarray(padded_tokens), self.pools,
             jnp.asarray(self.page_table[slot]), pos, last_idx, self.cfg,
-            chunk_len, mesh=self.mesh)
+            chunk_len, mesh=self.mesh, adapters=adapters, aids=aids)
         return logits
 
     def _mixed_chunk_len(self, chunk: int) -> int:
@@ -791,14 +849,16 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def _step_mixed(self, p_tokens, p_slots, p_active, p_pos, p_last,
                     tokens, lengths, temps, keys, tks, tps, incs, rich,
-                    chunk_len: int, n_steps: int):
+                    chunk_len: int, n_steps: int, ads=None, p_ads=None):
         p_tables = self._prefill_tables(p_slots, p_active)
+        adapters, aids = self._adapter_operands(ads)
+        _, p_aids = self._adapter_operands(p_ads)
         sel, toks, keys, self.pools = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
             jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
             jnp.asarray(self.page_table), tokens, lengths, temps, keys,
             tks, tps, incs, self.cfg, chunk_len, n_steps, rich,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids)
         return sel, toks, keys
 
     def _prefill_tables(self, p_slots, p_active):
@@ -813,21 +873,24 @@ class PagedContinuousBatcher(ContinuousBatcher):
 
     def _step_spec(self, bufs, buf_lens, n_ctxs, next_toks, remainings,
                    actives, temps, keys, tks, tps, rich, k: int,
-                   ngram: int, n_rounds: int):
+                   ngram: int, n_rounds: int, ads=None):
+        adapters, aids = self._adapter_operands(ads)
         (bufs, _, _, next_toks, produced, keys, accepts, lives,
          self.pools) = _tick_spec(
             self.params, bufs, self.pools, jnp.asarray(self.page_table),
             buf_lens, n_ctxs, next_toks, remainings, actives, temps,
             keys, tks, tps, self.cfg, k, ngram, n_rounds, rich,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids)
         return bufs, produced, next_toks, keys, accepts, lives
 
     def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
                          p_last, bufs, buf_lens, n_ctxs, next_toks,
                          remainings, actives, temps, keys, tks, tps,
                          rich, chunk_len: int, k: int, ngram: int,
-                         n_rounds: int):
+                         n_rounds: int, ads=None, p_ads=None):
         p_tables = self._prefill_tables(p_slots, p_active)
+        adapters, aids = self._adapter_operands(ads)
+        _, p_aids = self._adapter_operands(p_ads)
         (sel, bufs, _, _, next_toks, produced, keys, accepts, lives,
          self.pools) = _tick_mixed_spec(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
@@ -835,13 +898,13 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.asarray(self.page_table), bufs, buf_lens, n_ctxs,
             next_toks, remainings, actives, temps, keys, tks, tps,
             self.cfg, chunk_len, k, ngram, n_rounds, rich,
-            mesh=self.mesh)
+            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids)
         return sel, bufs, produced, next_toks, keys, accepts, lives
 
     # ------------------------------------------------------------------
     def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,
                       seed: int = 0, chunk: int = 64, eos_id=None,
-                      top_k: int = 0, top_p: float = 1.0):
+                      top_k: int = 0, top_p: float = 1.0, adapter=None):
         """Chunked admission with the window rounded UP to a page
         multiple: paged writes are page-aligned (pos stays a multiple of
         the window, the window a multiple of the page — max_seq is a
@@ -858,7 +921,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         return super().admit_chunked(prompt, max_new_tokens,
                                      temperature=temperature, seed=seed,
                                      chunk=chunk, eos_id=eos_id,
-                                     top_k=top_k, top_p=top_p)
+                                     top_k=top_k, top_p=top_p,
+                                     adapter=adapter)
 
     # -- session migration (export / import / release) -----------------
     def can_migrate(self) -> bool:
@@ -924,6 +988,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 "top_k": int(s.top_k),
                 "top_p": float(s.top_p),
                 "key_data": key_data,
+                # adapter travels by NAME (pool rows are receiver-
+                # local); the importer re-acquires it into its own
+                # pool — a missing/None name is a base-model session
+                "adapter": self._adapter_name_of(slot),
             },
         }
         blob = migrate.pack_session(meta, arrays)
@@ -1020,11 +1088,31 @@ class PagedContinuousBatcher(ContinuousBatcher):
         free = self.free_slots()
         if not free:
             return None
+        # the session's adapter re-acquires into THIS pool by name; a
+        # blob naming an adapter this receiver cannot serve is a
+        # config mismatch (it could never decode correctly), while a
+        # full pool is plain capacity backpressure like pages/slots
+        ad_name = st.get("adapter")
+        if ad_name is not None and not isinstance(ad_name, str):
+            raise migrate.BlobError("session adapter must be a string")
+        aidx = 0
+        if ad_name:
+            if self.adapter_pool is None:
+                raise migrate.ConfigMismatch(
+                    f"session rides adapter {ad_name!r} but the "
+                    f"receiver has no adapter pool")
+            aidx = self.adapter_pool.acquire(ad_name)
+            if aidx is None:
+                return None           # adapter-pool pressure
         if self._stripes_short(need_by_stripe):
             self._evict_prefixes(need_by_stripe)
         if self._stripes_short(need_by_stripe):
+            if aidx and self.adapter_pool is not None:
+                self.adapter_pool.release(aidx)
             return None
         slot = free[0]
+        if aidx:
+            self._slot_adapter[slot] = aidx
         pages = [self._free_by_stripe[stripe_of_local.get(li, 0)].pop()
                  for li in range(need)]
         if content_idx:
@@ -1042,6 +1130,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 self.pools = _scatter_pages(self.pools, sel, blocks)
             except (KeyError, TypeError, ValueError) as e:
                 self._free_pages_return(pages)
+                self._release_adapter(slot)     # pin rolled back
                 raise migrate.BlobError(
                     f"blob arrays do not match the pool layout: {e}") \
                     from None

@@ -555,13 +555,17 @@ class FleetRouter:
         return occ is not None and occ >= self._saturation
 
     def _repoint_affinity(self, tokens: Optional[List[int]],
-                          name: str) -> None:
-        """Re-register a prompt's prefix-block hashes to ``name`` —
-        after a disaggregated hand-off the DECODE replica holds the
-        session's pages, so it is the new affinity target."""
-        if not self._affinity or not tokens:
+                          name: str,
+                          adapter: Optional[str] = None) -> None:
+        """Re-register a prompt's prefix-block hashes (and its adapter
+        hash) to ``name`` — after a disaggregated hand-off the DECODE
+        replica holds the session's pages AND its re-acquired adapter,
+        so it is the new affinity target for both signals."""
+        if not self._affinity or not (tokens or adapter):
             return
-        hashes = self._prefix_hashes(tokens)
+        hashes = self._prefix_hashes(tokens) if tokens else []
+        if adapter:
+            hashes = hashes + [self._adapter_hash(adapter)]
         with self._lock:
             for h in hashes:
                 self._affinity_map[h] = name
@@ -569,56 +573,82 @@ class FleetRouter:
             while len(self._affinity_map) > self._max_affinity_entries:
                 self._affinity_map.popitem(last=False)
 
+    @staticmethod
+    def _adapter_hash(adapter: str) -> int:
+        """Affinity-map key for an ADAPTER name — same LRU map as the
+        prefix-block hashes, namespaced so a token-prefix hash can
+        never collide with an adapter name's."""
+        return hash(("adapter", adapter))
+
     def _pick(self, tokens: Optional[List[int]], prefill_heavy: bool,
               exclude: Sequence[str],
               role: Optional[str] = None,
-              steer: bool = False
-              ) -> Tuple[Optional[Replica], str]:
-        """Choose a replica and the policy that chose it.  Re-dispatch
-        picks (``exclude`` non-empty) are pure load picks labeled
-        ``retry`` — the affinity target just failed or is excluded, and
-        a 'hit' that re-routes is not a hit.  ``role`` restricts the
-        candidates to that disaggregation role.  ``steer`` (an
-        over-share tenant's request) bypasses affinity ENTIRELY —
-        lookup and registration: the overflow must spread by load, and
-        registering its prefixes to the spread target would drag the
-        tenant's future traffic after it.  Increments the pick's
-        in-flight count under the lock (the caller's forward owns the
-        decrement)."""
+              steer: bool = False,
+              adapter: Optional[str] = None
+              ) -> Tuple[Optional[Replica], str, bool]:
+        """Choose a replica; returns (replica, policy, adapter_hit).
+        Re-dispatch picks (``exclude`` non-empty) are pure load picks
+        labeled ``retry`` — the affinity target just failed or is
+        excluded, and a 'hit' that re-routes is not a hit.  ``role``
+        restricts the candidates to that disaggregation role.
+        ``steer`` (an over-share tenant's request) bypasses affinity
+        ENTIRELY — lookup and registration: the overflow must spread
+        by load, and registering its prefixes to the spread target
+        would drag the tenant's future traffic after it.  ``adapter``
+        (the request body's adapter name) is the STRONGEST affinity
+        signal and is consulted BEFORE the prompt-prefix hashes: the
+        replica that last served this adapter holds it resident in
+        its pool — routing elsewhere costs a load (and maybe an
+        eviction) there, which is what makes thousands of adapters
+        per FLEET tractable (each stays hot on ~one replica).
+        Saturated targets fall back to load like prefix hits.
+        Increments the pick's in-flight count under the lock (the
+        caller's forward owns the decrement)."""
         # hash once, OUTSIDE the lock (tuple-hashing long prompts is
         # the expensive part, and this lock is the front door's one
         # hot lock); the list serves both the lookup and registration
         hashes = (self._prefix_hashes(tokens)
                   if self._affinity and tokens and not steer else ())
+        ahash = (self._adapter_hash(adapter)
+                 if self._affinity and adapter and not steer else None)
         with self._lock:
             candidates = [r for r in self._replicas
                           if r.in_rotation and r.name not in exclude
                           and (role is None or r.role == role)]
             if not candidates:
-                return None, "load"
+                return None, "load", False
             chosen: Optional[Replica] = None
+            adapter_hit = False
             policy = "retry" if exclude else "load"
-            if hashes and not exclude:
+            if not exclude:
                 by_name = {r.name: r for r in candidates}
-                for h in hashes:
-                    r = by_name.get(self._affinity_map.get(h, ""))
+                if ahash is not None:
+                    r = by_name.get(self._affinity_map.get(ahash, ""))
                     if r is not None and not self._saturated(r):
                         chosen, policy = r, "affinity"
-                        break
+                        adapter_hit = True
+                if chosen is None:
+                    for h in hashes:
+                        r = by_name.get(self._affinity_map.get(h, ""))
+                        if r is not None and not self._saturated(r):
+                            chosen, policy = r, "affinity"
+                            break
             if chosen is None:
                 chosen = min(candidates,
                              key=lambda r: self._load_score(
                                  r, prefill_heavy))
-            if hashes:
-                # register every block prefix to the chosen replica —
-                # its pages will hold them once admitted; LRU-bounded
-                for h in hashes:
+            reg = list(hashes) + ([ahash] if ahash is not None else [])
+            if reg:
+                # register every block prefix (and the adapter) to the
+                # chosen replica — its pages/pool will hold them once
+                # admitted; LRU-bounded
+                for h in reg:
                     self._affinity_map[h] = chosen.name
                     self._affinity_map.move_to_end(h)
                 while len(self._affinity_map) > self._max_affinity_entries:
                     self._affinity_map.popitem(last=False)
             chosen.inflight += 1
-            return chosen, policy
+            return chosen, policy, adapter_hit
 
     # -- forwarding ----------------------------------------------------
     @staticmethod
@@ -711,14 +741,22 @@ class FleetRouter:
                 steer = tenant in self._over_share
             if steer:
                 metrics.ROUTER_STEERED.inc()
+        # adapter affinity (round 20): a request naming a LoRA adapter
+        # routes to the replica already holding it resident
+        adapter = body.get("adapter")
+        if not (isinstance(adapter, str) and adapter):
+            adapter = None
         if self._disagg:
-            return self._generate_disagg(body, tokens, steer=steer)
+            return self._generate_disagg(body, tokens, steer=steer,
+                                         adapter=adapter)
         return self._forward_balanced(body, tokens, prefill_heavy,
-                                      role=None, steer=steer)
+                                      role=None, steer=steer,
+                                      adapter=adapter)
 
     def _forward_balanced(self, body, tokens, prefill_heavy,
                           role: Optional[str] = None,
-                          steer: bool = False):
+                          steer: bool = False,
+                          adapter: Optional[str] = None):
         """The plain health/affinity/load retry loop over one role
         class (None = the whole fleet) — the non-disaggregated
         /generate path, and the re-prefill fallback the disaggregated
@@ -726,8 +764,9 @@ class FleetRouter:
         data = json.dumps(body).encode()
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
-            replica, policy = self._pick(tokens, prefill_heavy, tried,
-                                         role=role, steer=steer)
+            replica, policy, ahit = self._pick(
+                tokens, prefill_heavy, tried, role=role, steer=steer,
+                adapter=adapter)
             if replica is None:
                 if tried:
                     # candidates exist but were all tried and failed —
@@ -745,12 +784,18 @@ class FleetRouter:
                     # "consecutive" means it: a success between two
                     # failures restarts the eviction countdown
                     replica.consecutive_failures = 0
-                    if policy == "affinity":
+                    if policy == "affinity" and not ahit:
                         replica.affinity_hits += 1
                 metrics.ROUTER_REQUESTS.inc(replica=replica.name,
                                             policy=policy)
-                if policy == "affinity":
+                # the two affinity signals count SEPARATELY: a pick
+                # from the adapter hash is an adapter hit only (the
+                # prefix series stays the prefix-cache hit rate)
+                if policy == "affinity" and not ahit:
                     metrics.ROUTER_AFFINITY_HITS.inc(
+                        replica=replica.name)
+                if ahit:
+                    metrics.ROUTER_ADAPTER_AFFINITY_HITS.inc(
                         replica=replica.name)
                 return out          # (code, payload, relayed headers)
             if out is not None and out[0] == 503 and isinstance(
@@ -780,7 +825,8 @@ class FleetRouter:
                               f"(tried {', '.join(tried)})"}
 
     # -- disaggregated prefill/decode routing ---------------------------
-    def _generate_disagg(self, body, tokens, steer: bool = False):
+    def _generate_disagg(self, body, tokens, steer: bool = False,
+                         adapter: Optional[str] = None):
         """Prefill/decode-disaggregated /generate: the prompt prefills
         on a PREFILL replica (``phase="prefill"`` — the replica answers
         with the session blob at the activation boundary), then the
@@ -804,8 +850,10 @@ class FleetRouter:
         pdata = json.dumps(pbody).encode()
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
-            replica, policy = self._pick(tokens, True, tried,
-                                         role="prefill", steer=steer)
+            replica, policy, ahit = self._pick(tokens, True, tried,
+                                               role="prefill",
+                                               steer=steer,
+                                               adapter=adapter)
             if replica is None:
                 if tried:
                     break
@@ -837,12 +885,15 @@ class FleetRouter:
             with self._lock:
                 replica.requests += 1
                 replica.consecutive_failures = 0
-                if policy == "affinity":
+                if policy == "affinity" and not ahit:
                     replica.affinity_hits += 1
             metrics.ROUTER_REQUESTS.inc(replica=replica.name,
                                         policy=policy)
-            if policy == "affinity":
+            if policy == "affinity" and not ahit:
                 metrics.ROUTER_AFFINITY_HITS.inc(replica=replica.name)
+            if ahit:
+                metrics.ROUTER_ADAPTER_AFFINITY_HITS.inc(
+                    replica=replica.name)
             if code != 200 or not isinstance(payload, dict) \
                     or "migration" not in payload:
                 # a 4xx (the replica owns validation) or a request
@@ -851,26 +902,28 @@ class FleetRouter:
                 return out
             return self._dispatch_handoff(replica, tokens, body,
                                           payload["migration"],
-                                          steer=steer)
+                                          steer=steer, adapter=adapter)
         return 502, {"Error": f"all prefill forwards failed "
                               f"(tried {', '.join(tried)})"}
 
     def _dispatch_handoff(self, prefill_r: Replica,
                           tokens: Optional[List[int]], body,
-                          blob64: str, steer: bool = False):
+                          blob64: str, steer: bool = False,
+                          adapter: Optional[str] = None):
         """Land a prefilled session blob: decode replica, then the
         prefill replica itself (local decode), then re-prefill."""
         mdata = json.dumps({"blob": blob64}).encode()
         outcome, result, holder = None, None, None
-        holder_policy = "load"
-        decode_r, dpolicy = self._pick(tokens, False, (), role="decode",
-                                       steer=steer)
+        holder_policy, holder_ahit = "load", False
+        decode_r, dpolicy, dhit = self._pick(tokens, False, (),
+                                             role="decode", steer=steer,
+                                             adapter=adapter)
         if decode_r is not None:
             result = self._forward_watched(decode_r, mdata,
                                            path="/migrate_in")
             if result is not None and result[0] == 200:
                 outcome, holder = "ok", decode_r
-                holder_policy = dpolicy
+                holder_policy, holder_ahit = dpolicy, dhit
             elif result is None:
                 # wedged/evicted mid-transfer: the transport failure
                 # class — the scrape loop owns the health verdict, but
@@ -903,23 +956,26 @@ class FleetRouter:
                 max_new = 32
             return self._forward_balanced(
                 body, tokens, self._prefill_heavy(tokens, max_new),
-                steer=steer)
+                steer=steer, adapter=adapter)
         metrics.ROUTER_HANDOFFS.inc(outcome=outcome)
         with self._lock:
             holder.requests += 1
             holder.consecutive_failures = 0
-            if holder_policy == "affinity":
+            if holder_policy == "affinity" and not holder_ahit:
                 holder.affinity_hits += 1
         metrics.ROUTER_REQUESTS.inc(replica=holder.name,
                                     policy=holder_policy)
-        if holder_policy == "affinity":
+        if holder_policy == "affinity" and not holder_ahit:
             metrics.ROUTER_AFFINITY_HITS.inc(replica=holder.name)
+        if holder_ahit:
+            metrics.ROUTER_ADAPTER_AFFINITY_HITS.inc(
+                replica=holder.name)
         # the decode holder now owns the session's pages — future
         # same-prefix traffic should find them there (not for STEERED
         # requests: registering the spread target would drag the
         # over-share tenant's future traffic after its overflow)
         if not steer:
-            self._repoint_affinity(tokens, holder.name)
+            self._repoint_affinity(tokens, holder.name, adapter=adapter)
         return result
 
     def _healthz(self, _body=None):
