@@ -17,7 +17,14 @@ injectable from the test:
 * ``/generate`` answers DETERMINISTICALLY from the prompt alone
   (token ``i`` of the generation is ``(sum(prompt) + i) % vocab``), so
   a request re-dispatched to any other fake completes with the same
-  tokens — the re-dispatch correctness check costs one equality.
+  tokens — the re-dispatch correctness check costs one equality;
+* fleet tracing: trace contexts the router stamps on ``/generate`` /
+  ``/migrate_in`` bodies are parsed (via the one propagation codec)
+  and ECHOED as spans in a canned ``/debug/trace`` dump, complete
+  with a ``tpushareClock`` anchor — ``clock_skew_s`` offsets this
+  fake's private monotonic base so the scraper's clock normalizer is
+  testable without two real processes; a WEDGED fake 503s the route
+  (the merge must render a DOWN track, not fail).
 
 Loopback only, like every fake in this tree.
 """
@@ -26,10 +33,12 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import threading
 import time
 from typing import List, Optional
 
+from tpushare.telemetry import propagation
 from tpushare.telemetry.registry import Registry
 from tpushare.utils.httpserver import JsonHTTPServer
 
@@ -56,10 +65,20 @@ class FakeReplica:
     """One scriptable replica server; ``.url``/``.address`` point at it."""
 
     def __init__(self, name: str = "r0", vocab: int = 50,
-                 latency_s: float = 0.0):
+                 latency_s: float = 0.0, clock_skew_s: float = 0.0):
         self.name = name
         self.vocab = vocab
         self.latency_s = latency_s
+        #: received trace contexts, in arrival order (router→replica
+        #: propagation assertions read these)
+        self.trace_contexts: List[propagation.TraceContext] = []
+        #: echoed trace spans for the canned /debug/trace dump
+        self._spans: List[dict] = []
+        # a PRIVATE monotonic epoch, optionally offset: two fakes with
+        # different clock_skew_s values emit ts on unrelated bases,
+        # exactly like two real processes' perf_counter epochs — the
+        # fleet merge must reorder them onto one timeline
+        self._trace_epoch = time.perf_counter() - clock_skew_s
         self.wedged = False
         self.draining = False
         self.generate_calls: List[dict] = []   # every /generate body
@@ -103,6 +122,7 @@ class FakeReplica:
             ("POST", "/drain"): self._drain,
             ("GET", "/healthz"): self._healthz,
             ("GET", "/metrics"): self._metrics,
+            ("GET", "/debug/trace"): self._debug_trace,
         })
         self.port = self._http.port
         self.address = f"127.0.0.1:{self.port}"
@@ -147,8 +167,50 @@ class FakeReplica:
         self._stall.clear()
         self._release.set()
 
+    # -- fleet tracing -------------------------------------------------
+    def _note_trace(self, body, name: str, t_entry: float):
+        """Parse + echo a router-stamped trace context: record it for
+        assertions and append a span (on this fake's PRIVATE, possibly
+        skewed monotonic base) to the canned /debug/trace dump."""
+        ctx = propagation.extract(body) if isinstance(body, dict) \
+            else None
+        if ctx is None:
+            return
+        with self._lock:
+            self.trace_contexts.append(ctx)
+            self._spans.append({
+                "name": name, "cat": "fake-replica", "ph": "X",
+                "ts": (t_entry - self._trace_epoch) * 1e6,
+                "dur": (time.perf_counter() - t_entry) * 1e6,
+                "pid": os.getpid(), "tid": 0,
+                "seq": len(self._spans) + 1,
+                "args": {"trace": ctx.trace_id,
+                         "parent_span": ctx.span_id,
+                         "replica": self.name},
+            })
+
+    def _debug_trace(self, _body=None):
+        """Canned Chrome dump of the echoed spans, with the same
+        ``tpushareClock`` anchor contract the real tracer serves —
+        WEDGED answers 503 so the fleet merge's DOWN-track arm runs."""
+        if self.wedged:
+            return 503, {"Error": "wedged"}
+        with self._lock:
+            events = [dict(e) for e in self._spans]
+        return 200, {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "tpushareClock": {
+                "pid": os.getpid(),
+                "wall_time_s": time.time(),
+                "trace_time_us":
+                    (time.perf_counter() - self._trace_epoch) * 1e6,
+            },
+        }
+
     # -- routes --------------------------------------------------------
     def _generate(self, body):
+        t_entry = time.perf_counter()
         with self._lock:
             self.generate_calls.append(body)
         if self.generate_error is not None:
@@ -168,13 +230,16 @@ class FakeReplica:
             # the disaggregation sender half: answer with the opaque
             # session payload instead of decoding (the llm-server
             # contract the router consumes)
+            self._note_trace(body, "prefill", t_entry)
             return 200, {"migration": fake_blob(
                 [int(t) for t in tokens[0]], max_new)}
+        self._note_trace(body, "generate", t_entry)
         return 200, {"tokens": [
             expected_tokens([int(t) for t in row], max_new, self.vocab)
             for row in tokens]}
 
     def _migrate_in(self, body):
+        t_entry = time.perf_counter()
         with self._lock:
             self.migrate_calls.append(body)
         if self.migrate_error is not None:
@@ -187,8 +252,13 @@ class FakeReplica:
             prompt, max_new = payload["prompt"], payload["max_new"]
         except Exception:
             return 400, {"Error": "migration refused: bad_blob"}
+        self._note_trace(body, "migrate_in_decode", t_entry)
+        # served_s mirrors the real llm-server contract: the handler's
+        # import+decode wall, which the router pops to split its
+        # hand-off hop into decode_ttft vs migration_wire
         return 200, {"tokens": [expected_tokens(
-            [int(t) for t in prompt], int(max_new), self.vocab)]}
+            [int(t) for t in prompt], int(max_new), self.vocab)],
+            "served_s": time.perf_counter() - t_entry}
 
     def _drain(self, body=None):
         if isinstance(body, dict) and body.get("undrain"):

@@ -415,6 +415,41 @@ def test_rule_migration_wire_confinement():
             tpulint.run_rule("migration-wire-confinement"))
 
 
+def test_rule_trace_wire_confinement():
+    """The fleet trace-context wire format is confined to
+    telemetry/propagation.py: naming the body field literally or
+    building/matching the ``00-`` header shape anywhere else under
+    tpushare/ is a second trace codec waiting to fork — while
+    propagation.py itself, and code outside the package (tests, the
+    fake replica echoing the field), stay legal."""
+    bad = ('field = "traceparent"\n'
+           'hdr = f"00-{tid}-{sid}-01"\n'
+           'prefix = "00-deadbeef"\n')
+    fs = _lint("tpushare/serving/newhop.py", bad,
+               "trace-wire-confinement")
+    assert [f.line for f in fs] == [1, 2, 3]
+    # the one sanctioned codec module
+    assert not _lint("tpushare/telemetry/propagation.py", bad,
+                     "trace-wire-confinement")
+    # scope is the tpushare package: the fake replica echoes the field
+    # literally and stays legal
+    assert not _lint("tests/fakes/replica.py", bad,
+                     "trace-wire-confinement")
+    # routing through the propagation helpers is the legal spelling
+    ok = ("from ..telemetry import propagation\n"
+          "ctx = propagation.extract(body)\n"
+          "body = propagation.inject(body, propagation.child(ctx))\n")
+    assert not _lint("tpushare/serving/router.py", ok,
+                     "trace-wire-confinement")
+    assert not tpulint.run_rule("trace-wire-confinement"), \
+        tpulint.format_findings(
+            tpulint.run_rule("trace-wire-confinement"))
+    # the router-no-jax scope grew with propagation.py: the codec sits
+    # in the router's (pre-jax) import graph
+    assert _lint("tpushare/telemetry/propagation.py", "import jax\n",
+                 "router-no-jax")
+
+
 def test_rule_telemetry_lock_aliased_writes():
     """The round-18 evasion: ``r = RECORDER; r._x = ...`` binds the
     global then writes through the alias — caught now, resolved against

@@ -182,9 +182,17 @@ ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
                        "replica", "policy",
                        # KV-page migration plane: kind/direction/
                        # outcome are enumerated below
-                       "kind", "direction", "outcome"}
+                       "kind", "direction", "outcome",
+                       # fleet tracing: the request-hop decomposition
+                       # (enum-pinned to propagation.REQUEST_HOPS)
+                       "hop"}
 FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
-                         "id"}
+                         "id",
+                         # fleet trace ids are per-request values:
+                         # they ride span args and flight-recorder
+                         # events, NEVER metric labels
+                         "trace", "traces", "trace_id", "span_id",
+                         "traceparent"}
 #: label names whose VALUES are enumerated per family (one-hot states,
 #: phase attributions) — an observation outside the enum is a typo'd
 #: series that dashboards silently miss
@@ -228,6 +236,11 @@ ENUMERATED_VALUES = {
     # keep in sync with the serving.adapters constants (enum-pinned)
     ("tpushare_adapter_loads_total", "reason"): {"miss"},
     ("tpushare_adapter_evictions_total", "reason"): {"capacity"},
+    # keep in sync with telemetry.propagation.REQUEST_HOPS (enum-
+    # pinned): the router's critical-path decomposition
+    ("tpushare_request_hop_seconds", "hop"):
+        {"router_queue", "prefill_device", "migration_wire",
+         "decode_ttft"},
 }
 
 # -- enum pins (round-18 satellite): ONE declarative table ------------------
@@ -237,7 +250,8 @@ ENUMERATED_VALUES = {
 #: them: a new counter with a reason/kind/outcome/policy/direction
 #: label fails the completeness sweep until it gets a pin, and a pinned
 #: constant drifting from ENUMERATED_VALUES fails the drift sweep.
-ENUM_PIN_LABELS = ("reason", "kind", "outcome", "policy", "direction")
+ENUM_PIN_LABELS = ("reason", "kind", "outcome", "policy", "direction",
+                   "hop")
 #: (family, label) -> (module, constant) — the ONE place a labelled
 #: counter's value enum is tied to the code that observes it
 ENUM_PINS = {
@@ -263,6 +277,10 @@ ENUM_PINS = {
         ("tpushare.serving.adapters", "ADAPTER_LOAD_REASONS"),
     ("tpushare_adapter_evictions_total", "reason"):
         ("tpushare.serving.adapters", "ADAPTER_EVICTION_REASONS"),
+    # a histogram pin (the completeness sweep covers counters; the
+    # drift sweep checks every pin against the declared family)
+    ("tpushare_request_hop_seconds", "hop"):
+        ("tpushare.telemetry.propagation", "REQUEST_HOPS"),
 }
 
 

@@ -92,7 +92,8 @@ def test_attribution_and_tenant_reporting_stay_under_two_percent():
     """ISSUE-6 acceptance: the <2% guard with the FULL attribution path
     armed — request IDs on every guard and span, per-request device-
     time accounting credited at each tick and flushed at completion,
-    the queue/request histograms live, the stall watchdog armed, and
+    the queue/request histograms live, the stall watchdog armed, trace
+    contexts threaded onto every guard/span (``_traces``), and
     ``contract.report_usage`` feeding a live StatusServer each round
     (outside the timed window, like production's low-frequency loop; it
     must merely not corrupt the measurement).
@@ -142,7 +143,11 @@ def test_attribution_and_tenant_reporting_stay_under_two_percent():
     noop = lambda *a, **k: None
     stubs = {"_acct_open": noop, "_acct_credit": noop,
              "_acct_flush": noop,
-             "_rids": lambda self, prefilling=False: []}
+             "_rids": lambda self, prefilling=False: [],
+             # trace-context threading (round 21) rides the same guard
+             # sites; stub it with the rids so the armed arm prices the
+             # full request-lifecycle machinery, propagation included
+             "_traces": lambda self, rids=(): []}
     saved = {name: getattr(ContinuousBatcher, name) for name in stubs}
 
     def one_arm(armed: bool) -> float:

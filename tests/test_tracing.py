@@ -1,0 +1,416 @@
+"""Fleet-wide distributed tracing (ISSUE 16): one request, one trace.
+
+The contract under test, layer by layer:
+
+* the propagation codec (the ONE traceparent parse/format — strict,
+  silent on malformed input, copy-on-inject);
+* the ring tracer's fleet-merge support (monotonic ``seq``,
+  ``?since=`` tailing, the ``tpushareClock`` anchor);
+* the scraper's clock normalizer (``inspect --trace``): dumps from
+  processes with unrelated — arbitrarily skewed — monotonic epochs
+  merge into ONE ordered timeline with no negative timestamps or
+  durations, and a dead endpoint renders a DOWN track instead of
+  failing the merge;
+* the router: every forward carries a child context (fresh span id per
+  ATTEMPT, same trace id), and the critical-path decomposition
+  ``tpushare_request_hop_seconds{hop=}`` sums to the request wall;
+* end-to-end disaggregation: router -> prefill fake -> /migrate_in ->
+  decode fake produces spans on THREE tracks under ONE trace id;
+* the serving plane: an admitted request's trace id rides guards and
+  spans, travels inside the migration blob, and re-registers on the
+  importing pool (the migrated decode joins the originating trace).
+
+Everything above the last bullet is stdlib + fakes (no jax).
+"""
+
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpushare.inspect import traceview
+from tpushare.telemetry import propagation
+from tpushare.telemetry.trace import Tracer, debug_trace_route
+
+
+# ---------------------------------------------------------------------------
+# propagation codec
+# ---------------------------------------------------------------------------
+def test_traceparent_roundtrip():
+    ctx = propagation.new_context()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    wire = propagation.format_traceparent(ctx)
+    assert propagation.parse_traceparent(wire) == ctx
+    # extract/inject round trip through a body dict
+    body = {"tokens": [[1, 2]], "max_new_tokens": 4}
+    stamped = propagation.inject(body, ctx)
+    assert propagation.extract(stamped) == ctx
+    # inject COPIES: the caller's dict is never mutated (retry loops
+    # re-inject a fresh child per attempt into the same base body)
+    assert propagation.TRACEPARENT_FIELD not in body
+    assert stamped is not body
+
+
+def test_parse_is_strict_and_silent():
+    good = propagation.format_traceparent(propagation.new_context())
+    for bad in (None, 42, "", "nonsense", good.upper(),
+                good[:-1], good + "0",
+                good.replace("00-", "01-", 1),      # wrong version
+                "-".join(good.split("-")[:3])):      # missing flags
+        assert propagation.parse_traceparent(bad) is None, bad
+    # a body with a malformed context is simply untraced, never an error
+    assert propagation.extract({"traceparent": "garbage"}) is None
+    assert propagation.extract("not a dict") is None
+    assert propagation.extract({}) is None
+
+
+def test_child_keeps_trace_fresh_span():
+    ctx = propagation.new_context()
+    kid = propagation.child(ctx)
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+
+
+# ---------------------------------------------------------------------------
+# ring tracer: seq, ?since tailing, clock anchor
+# ---------------------------------------------------------------------------
+def test_tracer_seq_and_since_cursor():
+    t = Tracer(capacity=3)
+    for i in range(5):
+        t.instant(f"e{i}")
+    evs = t.events()
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and len(seqs) == 3    # ring kept 3,4,5
+    assert t.events_since(seqs[0]) == evs[1:]
+    assert t.events_since(seqs[-1]) == []
+    # a cursor that has fallen off the back returns the whole ring —
+    # the seq gap tells the scraper how much it lost
+    assert t.events_since(1) == evs
+
+
+def test_to_chrome_carries_clock_anchor():
+    t = Tracer(capacity=8)
+    with t.span("work", cat="test", trace="abc"):
+        pass
+    dump = t.to_chrome()
+    assert dump["displayTimeUnit"] == "ms"
+    clock = dump["tpushareClock"]
+    assert set(clock) == {"pid", "wall_time_s", "trace_time_us"}
+    # the anchor is AT-dump-time: no buffered event's ts can exceed it
+    assert all(e["ts"] <= clock["trace_time_us"]
+               for e in dump["traceEvents"])
+    assert dump["traceEvents"][0]["args"]["trace"] == "abc"
+
+
+def test_debug_trace_route_since_and_400():
+    code, body = debug_trace_route(None, query={"since": "notanint"})
+    assert code == 400
+    from tpushare.telemetry.trace import TRACER
+    TRACER.instant("cursor-probe")
+    code, dump = debug_trace_route(None, query=None)
+    assert code == 200 and "tpushareClock" in dump
+    last = dump["traceEvents"][-1]["seq"]
+    code, tail = debug_trace_route(None, query={"since": str(last)})
+    assert code == 200 and tail["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# fake replica: context echo + canned /debug/trace
+# ---------------------------------------------------------------------------
+def _fresh_fake(name="f0", **kw):
+    from fakes.replica import FakeReplica
+    return FakeReplica(name, **kw)       # NOT started: handlers are
+    # plain methods, so codec/merge tests need no sockets
+
+
+def test_fake_replica_echoes_context():
+    f = _fresh_fake()
+    ctx = propagation.new_context()
+    code, out = f._generate(propagation.inject(
+        {"tokens": [[1, 2, 3]], "max_new_tokens": 4}, ctx))
+    assert code == 200
+    assert [c.trace_id for c in f.trace_contexts] == [ctx.trace_id]
+    code, dump = f._debug_trace()
+    assert code == 200
+    (span,) = dump["traceEvents"]
+    assert span["args"] == {"trace": ctx.trace_id,
+                            "parent_span": ctx.span_id,
+                            "replica": "f0"}
+    assert span["dur"] >= 0
+    # an untraced body is served but never echoed
+    f._generate({"tokens": [[1]], "max_new_tokens": 2})
+    assert len(f.trace_contexts) == 1
+    # WEDGED 503s the trace route (the merge's DOWN-track arm)
+    f.set_wedged(True)
+    code, _ = f._debug_trace()
+    assert code == 503
+
+
+# ---------------------------------------------------------------------------
+# clock-skew normalizer (satellite: two offset fakes, one timeline)
+# ---------------------------------------------------------------------------
+def test_merge_rebases_skewed_clocks():
+    """Two fakes whose private monotonic epochs differ by SECONDS in
+    opposite directions: event order on the merged timeline must follow
+    actual wall order, with no negative ts and untouched durations."""
+    a = _fresh_fake("a", clock_skew_s=4.0)
+    b = _fresh_fake("b", clock_skew_s=-7.5)
+    ctx = propagation.new_context()
+    body = propagation.inject({"tokens": [[2, 2]],
+                               "max_new_tokens": 2}, ctx)
+    a._generate(dict(body))
+    time.sleep(0.02)                     # real wall gap a -> b
+    b._generate(dict(body))
+    fetches = []
+    for f in (a, b):
+        code, dump = f._debug_trace()
+        assert code == 200
+        fetches.append({"label": f.name, "dump": dump,
+                        "local_mid": time.time(), "error": None})
+    merged = traceview.merge_dumps(fetches, trace_id=ctx.trace_id)
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 2
+    by_pid = {e["pid"]: e for e in spans}
+    sa, sb = by_pid[1], by_pid[2]
+    # raw dumps sat ~11.5 s apart; rebased they are ~20 ms apart and
+    # correctly ordered
+    assert 0.0 <= sa["ts"] <= sb["ts"]
+    assert 0.0 < (sb["ts"] - sa["ts"]) / 1e6 < 1.0
+    assert all(e["dur"] >= 0 for e in spans)
+    skews = {t["label"]: t["skew_s"] for t in
+             merged["tpushareMerge"]["tracks"]}
+    # wall clocks agree in-process: reported skew is the scrape RTT
+    assert all(abs(s) < 1.0 for s in skews.values())
+
+
+def test_merge_renders_down_track():
+    a = _fresh_fake("up")
+    ctx = propagation.new_context()
+    a._generate(propagation.inject({"tokens": [[1]],
+                                    "max_new_tokens": 1}, ctx))
+    code, dump = a._debug_trace()
+    fetches = [
+        {"label": "up", "dump": dump, "local_mid": time.time(),
+         "error": None},
+        {"label": "dead", "dump": None, "local_mid": None,
+         "error": "unreachable (URLError)"},
+    ]
+    merged = traceview.merge_dumps(fetches)
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert any(n.startswith("dead (DOWN:") for n in names)
+    assert any(e["name"] == "DOWN" and e["pid"] == 2
+               for e in merged["traceEvents"])
+    tracks = merged["tpushareMerge"]["tracks"]
+    assert [t["down"] for t in tracks] == [False, True]
+
+
+# ---------------------------------------------------------------------------
+# router propagation + hop decomposition (HTTP, scripted fakes)
+# ---------------------------------------------------------------------------
+def _post(port, body, path="/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _hop_sums():
+    from tpushare.serving import metrics
+    return {h: (metrics.REQUEST_HOP.count(hop=h),
+                metrics.REQUEST_HOP.sum(hop=h))
+            for h in propagation.REQUEST_HOPS}
+
+
+def test_router_stamps_child_context_and_queue_hop():
+    from fakes.replica import FakeReplica
+    from tpushare.serving.router import FleetRouter
+
+    r0 = FakeReplica("a").start()
+    router = FleetRouter([("a", r0.address)], port=0,
+                         scrape_interval_s=0.1, watch_poll_s=0.01,
+                         request_timeout_s=5.0).start()
+    time.sleep(0.25)
+    try:
+        before = _hop_sums()
+        ctx = propagation.new_context()
+        code, _ = _post(router.port, propagation.inject(
+            {"tokens": [[5, 5, 5]], "max_new_tokens": 4}, ctx))
+        assert code == 200
+        # the replica saw a CHILD of the client's context: same trace,
+        # fresh span id (per-attempt spans stay distinguishable)
+        (got,) = r0.trace_contexts
+        assert got.trace_id == ctx.trace_id
+        assert got.span_id != ctx.span_id
+        after = _hop_sums()
+        assert after["router_queue"][0] == before["router_queue"][0] + 1
+        # the plain path observes ONLY the queue hop
+        for h in ("prefill_device", "migration_wire", "decode_ttft"):
+            assert after[h] == before[h]
+        # a request WITHOUT a context gets a minted root (still traced)
+        r0.trace_contexts.clear()
+        code, _ = _post(router.port, {"tokens": [[1, 2]],
+                                      "max_new_tokens": 2})
+        assert code == 200 and len(r0.trace_contexts) == 1
+        assert r0.trace_contexts[0].trace_id != ctx.trace_id
+    finally:
+        router.stop()
+        r0.stop()
+        time.sleep(0.05)
+
+
+def test_disagg_one_trace_three_tracks_and_hop_sum():
+    """THE acceptance drill: a disaggregated request (prefill hand-off
+    -> /migrate_in -> decode) leaves spans on three tracks — router,
+    prefill fake, decode fake — all under ONE trace id, and the four
+    hop observations sum to the measured request wall."""
+    from fakes.replica import FakeReplica, expected_tokens
+    from tpushare.serving.router import FleetRouter
+
+    p = FakeReplica("p0", latency_s=0.08,
+                    clock_skew_s=3.0).start()        # slow prefill +
+    d = FakeReplica("d0", clock_skew_s=-2.0).start()  # skewed clocks
+    router = FleetRouter(
+        [], port=0,
+        prefill_replicas=[("p0", p.address)],
+        decode_replicas=[("d0", d.address)],
+        scrape_interval_s=0.1, watch_poll_s=0.01,
+        request_timeout_s=10.0).start()
+    time.sleep(0.25)
+    try:
+        before = _hop_sums()
+        ctx = propagation.new_context()
+        prompt = [3, 1, 4, 1, 5, 9]
+        t0 = time.perf_counter()
+        code, out = _post(router.port, propagation.inject(
+            {"tokens": [prompt], "max_new_tokens": 6}, ctx))
+        wall = time.perf_counter() - t0
+        assert code == 200
+        assert out["tokens"] == [expected_tokens(prompt, 6)]
+        # the decode reply's served_s is a measurement channel the
+        # router POPS — it never leaks to the client
+        assert "served_s" not in out
+
+        # one trace, both fakes
+        assert {c.trace_id for c in p.trace_contexts} == {ctx.trace_id}
+        assert {c.trace_id for c in d.trace_contexts} == {ctx.trace_id}
+
+        # hop decomposition: every hop observed once, summing to the
+        # router's wall (≤ the client wall, which adds two local HTTP
+        # crossings — generous bounds, this box is noisy)
+        after = _hop_sums()
+        deltas = {h: after[h][1] - before[h][1]
+                  for h in propagation.REQUEST_HOPS}
+        for h, (cnt, _) in after.items():
+            assert cnt == before[h][0] + 1, h
+        total = sum(deltas.values())
+        assert deltas["prefill_device"] >= 0.06      # the scripted lag
+        assert 0.5 * wall <= total <= wall * 1.05, (deltas, wall)
+
+        # fleet scrape: router (global tracer) + the two fakes merge
+        # into one Chrome trace with three tracks under the trace id
+        fetches = []
+        for label, port in (("router", router.port),
+                            ("p0", p.port), ("d0", d.port)):
+            dump, mid = traceview.fetch_trace("127.0.0.1", port)
+            fetches.append({"label": label, "dump": dump,
+                            "local_mid": mid, "error": None})
+        merged = traceview.merge_dumps(fetches, trace_id=ctx.trace_id)
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        pids = {e["pid"] for e in spans}
+        assert pids == {1, 2, 3}, spans
+        router_names = {e["name"] for e in spans if e["pid"] == 1}
+        assert "router.prefill_forward" in router_names
+        assert "router.migrate_in_forward" in router_names
+        # ordered despite the ±seconds epoch skew: prefill (track 2)
+        # completes before the decode import (track 3) starts
+        (pf,) = [e for e in spans if e["pid"] == 2]
+        (dec,) = [e for e in spans if e["pid"] == 3]
+        assert pf["ts"] + pf["dur"] <= dec["ts"] + 1e3   # 1 ms slack
+        assert all(e["ts"] >= 0 and e.get("dur", 0) >= 0 for e in spans)
+        assert merged["tpushareMerge"]["trace_id"] == ctx.trace_id
+        assert json.loads(json.dumps(merged))        # valid JSON out
+    finally:
+        router.stop()
+        p.stop()
+        d.stop()
+        time.sleep(0.05)
+
+
+def test_gather_fleet_trace_marks_unreachable():
+    """The --trace entry: a live endpoint and a dead port on one node
+    merge into one dump with an up track and a DOWN track."""
+    from fakes.replica import FakeReplica
+
+    f = FakeReplica("live").start()
+    ctx = propagation.new_context()
+    try:
+        _post(f.port, propagation.inject(
+            {"tokens": [[4, 4]], "max_new_tokens": 2}, ctx))
+        # a closed port: bind-and-release to find one that refuses
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        info = types.SimpleNamespace(name="node0", address="127.0.0.1",
+                                     total_mem=8)
+        merged = traceview.gather_fleet_trace(
+            [info], f"{f.port},{dead_port}", trace_id=ctx.trace_id,
+            timeout=2.0)
+        tracks = merged["tpushareMerge"]["tracks"]
+        assert [t["down"] for t in tracks] == [False, True]
+        assert any(e.get("name") == "DOWN"
+                   for e in merged["traceEvents"])
+    finally:
+        f.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving plane: trace rides admission, spans, and migration blobs
+# ---------------------------------------------------------------------------
+def test_trace_rides_service_and_migration_blob():
+    jax = pytest.importorskip("jax")
+
+    from tpushare import telemetry
+    from tpushare.models import transformer
+    from tpushare.serving import migrate
+    from tpushare.serving.paged import PagedContinuousBatcher
+
+    cfg = transformer.tiny(max_seq=96)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    tid = propagation.new_trace_id()
+    a = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=8)
+    rid = a.admit([1] * 24, 16, trace=tid)
+    assert rid is not None
+    assert a._traces([rid]) == [tid]
+    a.tick()
+    # the decode dispatch span carries the trace (what the fleet
+    # scraper's trace-id filter matches server-side)
+    ticks = [e for e in telemetry.tracer.events()
+             if e["name"] == "batcher.tick"
+             and tid in (e["args"].get("traces") or ())]
+    assert ticks, "tick span lost the trace id"
+
+    # the blob carries it; the importing pool re-registers it, so the
+    # migrated decode's spans join the originating trace
+    blob = a.export_session(rid)
+    assert migrate.session_trace(migrate.blob_meta(blob)) == tid
+    a.pop_session(rid)
+    assert a._traces([rid]) == []
+    b = PagedContinuousBatcher(params, cfg, n_slots=2, page_size=8)
+    rid2 = b.import_session(blob)
+    assert rid2 is not None
+    assert b._traces([rid2]) == [tid]
+    # untraced admissions stay untraced end to end
+    rid3 = b.admit([2] * 8, 4)
+    assert b._traces([rid3]) == []
+    assert b._traces([rid2, rid3]) == [tid]

@@ -405,8 +405,10 @@ def _resolve_imports(ctx: Context, node: ast.AST):
     "routing, and the daemon must keep issuing verdicts, through a "
     "backend outage).",
     lambda p: p in ("tpushare/serving/router.py",
-                    "tpushare/serving/policy.py"),
-    "tpushare/serving/{router,policy}.py")
+                    "tpushare/serving/policy.py",
+                    "tpushare/telemetry/propagation.py"),
+    "tpushare/serving/{router,policy}.py + "
+    "tpushare/telemetry/propagation.py")
 def _router_no_jax(ctx: Context):
     for node in ast.walk(ctx.tree):
         if not isinstance(node, (ast.Import, ast.ImportFrom)):
@@ -456,6 +458,51 @@ def _migration_wire_confinement(ctx: Context):
                 f"(`{ctx.quote(node.lineno)}`) outside "
                 f"serving/migrate.py — KV wire (de)serialization is "
                 f"confined to the one codec module")
+
+
+@rule(
+    "trace-wire-confinement",
+    "The fleet trace-context wire format (the W3C-traceparent-style "
+    "``\"00-<trace>-<span>-01\"`` string under the ``traceparent`` "
+    "body field) is owned by tpushare/telemetry/propagation.py and "
+    "NOWHERE else under tpushare/: a hand-rolled parse or format "
+    "(naming the field literally, or building/matching the ``00-`` "
+    "header shape) would fork the wire format the same way a second "
+    "migration codec would fork the blob layout — every producer and "
+    "consumer must route through propagation.extract/inject/"
+    "format_traceparent/parse_traceparent (the "
+    "migration-wire-confinement pattern).",
+    lambda p: p.startswith("tpushare/"),
+    "all of tpushare/",
+    allow=("tpushare/telemetry/propagation.py",
+           "tpushare/analysis/tpulint.py"),
+    allow_doc="the one sanctioned trace-context codec (and this "
+              "rule's own matcher literals)")
+def _trace_wire_confinement(ctx: Context):
+    # f-string constant parts are reported via their OWNING JoinedStr
+    # (one finding per construction site, not one per fragment)
+    fstring_parts = {id(v) for node in ast.walk(ctx.tree)
+                     if isinstance(node, ast.JoinedStr)
+                     for v in node.values}
+    for node in ast.walk(ctx.tree):
+        hit = None
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                id(node) not in fstring_parts:
+            if node.value == "traceparent" or \
+                    node.value.startswith("00-"):
+                hit = "trace-context wire literal"
+        elif isinstance(node, ast.JoinedStr):
+            first = node.values[0] if node.values else None
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and \
+                    first.value.startswith("00-"):
+                hit = "trace-context header construction"
+        if hit:
+            yield getattr(node, "lineno", 1), (
+                f"{hit} (`{ctx.quote(node.lineno)}`) outside "
+                f"telemetry/propagation.py — traceparent parse/format "
+                f"is confined to the one propagation module")
 
 
 #: the process-global telemetry singletons whose internals are

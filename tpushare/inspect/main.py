@@ -74,6 +74,17 @@ def main(argv=None) -> int:
                          "request-share/affinity-hits/evictions from a "
                          "tpushare-router's exposition; include the "
                          "router's port in --metrics-port)")
+    ap.add_argument("--trace", action="store_true",
+                    help="scrape each endpoint's /debug/trace (ports "
+                         "from --metrics-port: router + replica ports), "
+                         "normalize clocks against the scrape round "
+                         "trip, and emit ONE merged Chrome/Perfetto "
+                         "trace JSON on stdout (load in "
+                         "ui.perfetto.dev; see docs/TRACING.md)")
+    ap.add_argument("--trace-id", default=None, metavar="HEX",
+                    help="with --trace: keep only spans belonging to "
+                         "this fleet trace id (one request's "
+                         "router/prefill/decode path)")
     ap.add_argument("--metrics-port",
                     default=str(metricsview.DEFAULT_METRICS_PORT),
                     help="comma-separated port(s) of per-node /metrics "
@@ -92,6 +103,17 @@ def main(argv=None) -> int:
         return 1
 
     infos = build_node_infos(nodes, pods)
+    if args.trace:
+        # the merged trace IS the output (a trace file, not a table):
+        # pipe it to a .json and load it in a trace viewer
+        import json
+
+        from . import traceview
+        merged = traceview.gather_fleet_trace(infos, args.metrics_port,
+                                              trace_id=args.trace_id)
+        json.dump(merged, sys.stdout)
+        print()
+        return 0
     metrics_rows = (metricsview.gather_metrics_rows(infos,
                                                     args.metrics_port)
                     if args.metrics else None)

@@ -269,9 +269,29 @@ def summarize_fleet(parsed: dict) -> dict:
         samples = parsed["samples"].get(name)
         return sum(v for _, v in samples) if samples else None
 
+    # per-request critical-path decomposition (fleet tracing): mean
+    # seconds per hop from the router's hop histogram — where a
+    # disaggregated request's wall actually goes (router queue vs
+    # prefill device vs migration wire vs decode TTFT)
+    hop_sums: Dict[str, float] = {}
+    hop_counts: Dict[str, float] = {}
+    for labels, value in parsed["samples"].get(
+            "tpushare_request_hop_seconds_sum", ()):
+        h = labels.get("hop")
+        if h is not None:
+            hop_sums[h] = hop_sums.get(h, 0.0) + value
+    for labels, value in parsed["samples"].get(
+            "tpushare_request_hop_seconds_count", ()):
+        h = labels.get("hop")
+        if h is not None:
+            hop_counts[h] = hop_counts.get(h, 0.0) + value
+    hops = {h: {"count": c,
+                "mean_s": (hop_sums.get(h, 0.0) / c) if c else None}
+            for h, c in hop_counts.items()}
     return {
         "retries": retries[0][1] if retries else None,
         "replicas": replicas,
+        "hops": hops,
         # KV-page migration plane (recorded by the llm-server
         # expositions merged into this scrape): hand-offs/spills in
         # and out of the node's pools, refusals, and the host-RAM
@@ -458,13 +478,27 @@ def render_fleet_table(
     spill-tier tallies ride the first row."""
     table = [["NAME", "REPLICA", "HEALTH", "REQUESTS", "SHARE",
               "AFFINITY HITS", "ADAPTER HITS", "EVICTIONS", "RETRIES",
-              "MIGR(out/in)", "SPILL"]]
+              "MIGR(out/in)", "SPILL", "HOPS(mean)"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, "-", "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-", "-"])
             continue
         replicas = summary["replicas"]
+        # HOPS: the request-wall decomposition, mean ms per hop in
+        # path order (rq = router queue, pf = prefill device, mw =
+        # migration wire, dt = decode TTFT) — the fleet-trace summary
+        # without opening a trace viewer
+        hop_abbrev = {"router_queue": "rq", "prefill_device": "pf",
+                      "migration_wire": "mw", "decode_ttft": "dt"}
+        hop_parts = []
+        for h in ("router_queue", "prefill_device", "migration_wire",
+                  "decode_ttft"):
+            info = (summary.get("hops") or {}).get(h)
+            if info and info.get("mean_s") is not None:
+                hop_parts.append(
+                    f"{hop_abbrev[h]} {info['mean_s'] * 1000:.1f}ms")
+        hop_cell = " ".join(hop_parts) if hop_parts else "-"
         migr = "-"
         if summary.get("migrations_out") is not None or \
                 summary.get("migrations_in") is not None:
@@ -479,7 +513,7 @@ def render_fleet_table(
                 spill += f" ({_fmt_bytes(summary['spill_bytes'])})"
         if not replicas:
             table.append([name, "-", "-", "-", "-", "-", "-", "-",
-                          "no router", migr, spill])
+                          "no router", migr, spill, hop_cell])
             continue
         retries = summary.get("retries")
         first = True
@@ -498,6 +532,7 @@ def render_fleet_table(
                 (_fmt(retries, digits=0) if first else ""),
                 (migr if first else ""),
                 (spill if first else ""),
+                (hop_cell if first else ""),
             ])
             first = False
     return "Fleet routing:\n" + _table(table)

@@ -1,0 +1,171 @@
+"""``--trace`` mode: one fleet-wide Chrome/Perfetto trace.
+
+Scrapes every endpoint's ``/debug/trace`` (router, LLM-server
+replicas, daemon — pass their ports in ``--metrics-port``), normalizes
+each process's private monotonic clock against the scrape round-trip,
+and merges the per-process rings into ONE Chrome trace-event JSON
+(docs/TRACING.md explains the tracks; load the output in
+ui.perfetto.dev or ``chrome://tracing``).
+
+Clock normalization: every dump carries a ``tpushareClock`` anchor —
+the remote's ``perf_counter``-based trace time paired with its wall
+time AT DUMP TIME.  The scraper records its OWN wall clock either side
+of the round trip; the RTT midpoint is the best local estimate of the
+dump moment, so an event's local wall time is simply
+
+    local_mid - (trace_time_us - ts) / 1e6
+
+— the remote wall clock cancels out entirely (it is kept only to
+report the skew), which makes the merge robust to arbitrary wall-clock
+skew between hosts.  Durations are epoch-free and survive the rebase
+unchanged, so no span can acquire a negative duration.  Residual error
+is bounded by half the scrape RTT per endpoint, plenty for eyeballing
+a multi-millisecond serving path.
+
+Unreachable endpoints render a DOWN metadata track (the anomaly this
+view should surface) instead of failing the merge — the same
+vocabulary as the ``--metrics`` table's DOWN rows.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import List, Optional
+
+#: scrape timeout per endpoint (the RTT also bounds the rebase error,
+#: so a slow endpoint yields a fuzzy track, not a broken merge)
+DEFAULT_TRACE_TIMEOUT_S = 3.0
+
+
+def fetch_trace(address: str, port: int,
+                timeout: float = DEFAULT_TRACE_TIMEOUT_S):
+    """GET one endpoint's /debug/trace, recording the local wall clock
+    either side of the round trip.  Returns ``(dump, local_mid)`` —
+    the parsed Chrome dict and the RTT-midpoint local wall time its
+    clock anchor is pinned to."""
+    url = f"http://{address}:{port}/debug/trace"
+    t_before = time.time()
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        dump = json.loads(r.read().decode())
+    t_after = time.time()
+    return dump, (t_before + t_after) / 2.0
+
+
+def _event_matches(event: dict, trace_id: str) -> bool:
+    """Does this span/instant belong to ``trace_id``?  Router spans
+    carry ``args.trace``; serving dispatch spans carry ``args.traces``
+    (one guard covers every request in the round)."""
+    args = event.get("args") or {}
+    if args.get("trace") == trace_id:
+        return True
+    traces = args.get("traces")
+    return isinstance(traces, (list, tuple)) and trace_id in traces
+
+
+def merge_dumps(fetches: List[dict],
+                trace_id: Optional[str] = None) -> dict:
+    """Pure merge core (unit-testable without sockets): ``fetches`` is
+    a list of ``{"label", "dump", "local_mid", "error"}`` — ``dump``
+    None marks a dead endpoint (DOWN track).  Returns one Chrome
+    trace-event object whose pids are per-endpoint track indices
+    (process_name metadata carries the endpoint label) and whose
+    timeline is local wall time rebased to the earliest event."""
+    tracks: List[dict] = []
+    for idx, f in enumerate(fetches, start=1):
+        label = f.get("label") or f"endpoint-{idx}"
+        dump = f.get("dump")
+        if dump is None:
+            tracks.append({"pid": idx,
+                           "label": label,
+                           "error": f.get("error") or "unreachable",
+                           "events": [], "down": True, "skew_s": None})
+            continue
+        clock = dump.get("tpushareClock") or {}
+        anchor_us = clock.get("trace_time_us")
+        local_mid = f.get("local_mid")
+        events = []
+        for e in dump.get("traceEvents", ()):
+            if e.get("ph") == "M":
+                continue             # remote metadata; we re-label
+            if trace_id is not None and not _event_matches(e, trace_id):
+                continue
+            wall = None
+            if anchor_us is not None and local_mid is not None:
+                wall = local_mid - (anchor_us - e.get("ts", 0.0)) / 1e6
+            events.append((wall, e))
+        skew = None
+        if clock.get("wall_time_s") is not None and local_mid is not None:
+            skew = clock["wall_time_s"] - local_mid
+        tracks.append({"pid": idx, "label": label, "error": None,
+                       "events": events, "down": False, "skew_s": skew})
+    walls = [w for t in tracks for (w, _) in t["events"] if w is not None]
+    epoch = min(walls) if walls else 0.0
+    merged: List[dict] = []
+    for t in tracks:
+        name = t["label"]
+        if t["down"]:
+            name += f" (DOWN: {t['error']})"
+        merged.append({"name": "process_name", "ph": "M",
+                       "pid": t["pid"], "tid": 0,
+                       "args": {"name": name}})
+        if t["down"]:
+            # a loud zero-width marker so the dead endpoint is visible
+            # on the timeline itself, not only in the track label
+            merged.append({"name": "DOWN", "cat": "tpushare", "ph": "i",
+                           "s": "p", "ts": 0.0, "pid": t["pid"],
+                           "tid": 0, "args": {"error": t["error"]}})
+            continue
+        for wall, e in t["events"]:
+            ev = dict(e)
+            ev["pid"] = t["pid"]
+            if wall is not None:
+                # rebase onto the merged timeline; durations untouched
+                ev["ts"] = (wall - epoch) * 1e6
+            merged.append(ev)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        # merge bookkeeping (ignored by trace viewers, like the
+        # per-process tpushareClock): which pid is which endpoint and
+        # how far each remote wall clock sat from the scraper's
+        "tpushareMerge": {
+            "epoch_wall_s": epoch,
+            "trace_id": trace_id,
+            "tracks": [{"pid": t["pid"], "label": t["label"],
+                        "down": t["down"], "skew_s": t["skew_s"]}
+                       for t in tracks],
+        },
+    }
+
+
+def gather_fleet_trace(infos, ports, trace_id: Optional[str] = None,
+                       timeout: float = DEFAULT_TRACE_TIMEOUT_S) -> dict:
+    """Scrape (node, port) × /debug/trace concurrently and merge —
+    the ``inspect --trace`` entry.  ``ports`` is the same comma list
+    ``--metrics-port`` takes (router + replica ports; the daemon's
+    full loopback surface serves /debug/trace too when inspecting a
+    node locally)."""
+    from .metricsview import parse_ports
+    port_list = parse_ports(ports)
+    sharing = [info for info in infos if info.total_mem > 0]
+    jobs = [(info, port) for info in sharing for port in port_list]
+
+    def one(job):
+        info, port = job
+        label = f"{info.name} {info.address}:{port}"
+        try:
+            dump, mid = fetch_trace(info.address, port, timeout=timeout)
+            return {"label": label, "dump": dump, "local_mid": mid,
+                    "error": None}
+        except Exception as e:
+            return {"label": label, "dump": None, "local_mid": None,
+                    "error": f"unreachable ({type(e).__name__})"}
+
+    if not jobs:
+        return merge_dumps([], trace_id=trace_id)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(16, len(jobs))) as pool:
+        fetches = list(pool.map(one, jobs))
+    return merge_dumps(fetches, trace_id=trace_id)

@@ -27,6 +27,7 @@ from .. import telemetry
 from ..serving import policy as tenant_policy
 from ..telemetry.events import RECORDER, debug_events_route
 from ..telemetry.health import healthz_route
+from ..telemetry.trace import debug_trace_route
 from ..utils import stackdump
 from ..utils.httpserver import JsonHTTPServer, RawBody
 
@@ -259,8 +260,7 @@ class StatusServer:
                 200, RawBody(self.render_metrics(),
                              telemetry.PROM_CONTENT_TYPE)),
             ("GET", "/debug/stacks"): lambda _: (200, stackdump.stack_trace()),
-            ("GET", "/debug/trace"): lambda _: (
-                200, telemetry.tracer.to_chrome()),
+            ("GET", "/debug/trace"): debug_trace_route,
             ("GET", "/debug/events"): debug_events_route,
             ("POST", "/usage"): self._ingest_usage,
         })

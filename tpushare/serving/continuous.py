@@ -589,6 +589,11 @@ class ContinuousBatcher:
         # time shares by phase + token count, observed into the request
         # histograms at completion (see _acct_credit/_acct_flush)
         self._req_acct: Dict[int, dict] = {}
+        # propagated trace contexts: rid -> fleet trace_id (opaque —
+        # the wire format lives in telemetry.propagation), threaded
+        # alongside rids into dispatch guards/spans and migration
+        # blobs; populated only for requests that arrived with one
+        self._rid_traces: Dict[int, str] = {}
         self._tick_count = 0
         self._init_storage()
         self._observe_storage()
@@ -632,6 +637,9 @@ class ContinuousBatcher:
         instant-finish admission path funnel through it)."""
         self.completed[rid] = output
         metrics.COMPLETIONS.inc()
+        # the finishing dispatch already carried the trace; delivery
+        # happens host-side, so the context's batcher life ends here
+        self._rid_traces.pop(rid, None)
         acct = self._req_acct.get(rid)
         if acct is not None:
             # observed at the next _acct_flush, not here: the dispatch
@@ -648,6 +656,22 @@ class ContinuousBatcher:
         if prefilling:
             rids += [p.request_id for p in self.prefilling.values()]
         return rids
+
+    def _traces(self, rids: List[int]) -> List[str]:
+        """The distinct propagated trace ids among ``rids`` — the
+        cross-process correlators dispatch-guard flight events and
+        trace spans carry next to the rids (args/events only, NEVER
+        metric labels — lint-enforced).  Empty for untraced traffic,
+        so the common single-process path records nothing extra."""
+        tr = self._rid_traces
+        if not tr:
+            return []
+        seen = []
+        for r in rids:
+            t = tr.get(r)
+            if t is not None and t not in seen:
+                seen.append(t)
+        return seen
 
     def _acct_open(self, rid: int, prompt_len: int) -> None:
         if telemetry.enabled():
@@ -984,7 +1008,8 @@ class ContinuousBatcher:
               seed: int = 0,
               eos_id: Optional[int] = None,
               top_k: int = 0, top_p: float = 1.0,
-              adapter: Optional[str] = None) -> Optional[int]:
+              adapter: Optional[str] = None,
+              trace: Optional[str] = None) -> Optional[int]:
         """Prefill into a free slot; returns request id, or None when the
         pool is FULL (backpressure).  Invalid requests raise instead —
         None must stay unambiguous for retry loops.  ``eos_id`` finishes
@@ -993,7 +1018,9 @@ class ContinuousBatcher:
         ``generate(..., eos_id=...)`` yields once its masked tail is
         dropped; asserted in tests).  ``adapter`` names this request's
         LoRA adapter (pool required; pinned resident until release;
-        None on pool pressure, like every other backpressure)."""
+        None on pool pressure, like every other backpressure).
+        ``trace`` is the request's propagated fleet trace id (opaque;
+        rides guards/spans/flight events and migration blobs)."""
         self.validate_request(prompt, max_new_tokens)
         self.validate_sampling(top_k, top_p)
         self.validate_adapter(adapter)
@@ -1022,15 +1049,19 @@ class ContinuousBatcher:
             return None
         rid = self._next_id
         self._next_id += 1
+        if trace:
+            self._rid_traces[rid] = trace
         metrics.ADMISSIONS.inc()
         RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
-                        max_new=max_new_tokens)
+                        max_new=max_new_tokens, trace=trace)
         self._acct_open(rid, len(prompt))
 
         tokens = jnp.asarray([prompt], jnp.int32)
         with health.MONITOR.dispatch_guard("prefill",
                                            tokens=len(prompt),
-                                           rids=[rid]) as g:
+                                           rids=[rid],
+                                           traces=self._traces([rid])
+                                           ) as g:
             logits_v = self._prefill_into(slot, tokens, len(prompt))
             self._activate(slot, rid, list(prompt), logits_v,
                            max_new_tokens, temperature, seed, eos_id,
@@ -1087,7 +1118,8 @@ class ContinuousBatcher:
                       chunk: int = 64,
                       eos_id: Optional[int] = None,
                       top_k: int = 0, top_p: float = 1.0,
-                      adapter: Optional[str] = None) -> Optional[int]:
+                      adapter: Optional[str] = None,
+                      trace: Optional[str] = None) -> Optional[int]:
         """Admit with the prompt streamed ``chunk`` tokens at a time by
         subsequent :meth:`advance_prefill` calls, so a long prompt never
         stalls decoding slots for more than one chunk's forward (the
@@ -1123,9 +1155,12 @@ class ContinuousBatcher:
             return None
         rid = self._next_id
         self._next_id += 1
+        if trace:
+            self._rid_traces[rid] = trace
         metrics.ADMISSIONS.inc()
         RECORDER.record("admit", rid=rid, prompt_len=len(prompt),
-                        max_new=max_new_tokens, chunked=True)
+                        max_new=max_new_tokens, chunked=True,
+                        trace=trace)
         self._acct_open(rid, len(prompt))
         self.prefilling[slot] = _Prefill(
             request_id=rid, prompt=list(prompt),
@@ -1178,9 +1213,10 @@ class ContinuousBatcher:
         # stall-watch without observing, or the prefill device-time
         # histogram would fill with ~0 samples
         final = end >= n
-        with health.MONITOR.dispatch_guard("prefill", observe=final,
-                                           tokens=len(piece),
-                                           rids=[st.request_id]) as g:
+        with health.MONITOR.dispatch_guard(
+                "prefill", observe=final, tokens=len(piece),
+                rids=[st.request_id],
+                traces=self._traces([st.request_id])) as g:
             logits_v = self._prefill_chunk_into(
                 slot, padded, st.pos, len(piece) - 1, window)
             st.pos = end
@@ -1251,11 +1287,14 @@ class ContinuousBatcher:
                 s.key, sub = jax.random.split(s.key)
                 keys[i] = np.asarray(jax.random.key_data(sub))
         rids = self._rids() if telemetry.enabled() else []
+        traces = self._traces(rids)
         with health.MONITOR.dispatch_guard("decode",
                                            active=len(self.slots),
-                                           rids=rids) as g, \
+                                           rids=rids,
+                                           traces=traces) as g, \
                 telemetry.span("batcher.tick", cat="serving",
-                               active=len(self.slots), rids=rids):
+                               active=len(self.slots), rids=rids,
+                               traces=traces):
             nxt = np.asarray(self._step(
                 jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(temps),
@@ -1307,13 +1346,15 @@ class ContinuousBatcher:
         # fetch is the true barrier, so this is the window that hangs
         # on a dead tunnel and the window device time is measured over
         rids = self._rids() if telemetry.enabled() else []
+        traces = self._traces(rids)
         with health.MONITOR.dispatch_guard("decode",
                                            active=len(self.slots),
                                            steps=n_steps,
-                                           rids=rids) as g:
+                                           rids=rids,
+                                           traces=traces) as g:
             with telemetry.span("batcher.tick_fused", cat="serving",
                                 active=len(self.slots), steps=n_steps,
-                                rids=rids):
+                                rids=rids, traces=traces):
                 toks, new_keys = self._step_n(
                     jnp.asarray(tokens), jnp.asarray(lengths),
                     jnp.asarray(temps),
@@ -1571,16 +1612,19 @@ class ContinuousBatcher:
             prefill_rids = [st.request_id for _, _, st, _ in plan]
         else:
             decode_rids, prefill_rids = [], []
+        traces = self._traces(decode_rids + prefill_rids)
         with health.MONITOR.dispatch_guard("mixed",
                                            active=len(self.slots),
                                            prefilling=len(plan),
                                            steps=n_steps,
                                            rids=decode_rids
-                                           + prefill_rids) as g:
+                                           + prefill_rids,
+                                           traces=traces) as g:
             with telemetry.span("batcher.tick_mixed", cat="serving",
                                 active=len(self.slots),
                                 prefilling=len(plan), steps=n_steps,
-                                rids=decode_rids + prefill_rids):
+                                rids=decode_rids + prefill_rids,
+                                traces=traces):
                 sel, toks, new_keys = self._step_mixed(
                     block["p_tokens"], block["p_slots"],
                     block["p_active"], block["p_pos"], block["p_last"],
@@ -1617,6 +1661,7 @@ class ContinuousBatcher:
         # a cancelled request's partial attribution is dropped, not
         # observed — the request histograms describe COMPLETED lifecycles
         self._req_acct.pop(rid, None)
+        self._rid_traces.pop(rid, None)
         for i, s in list(self.slots.items()):
             if s.request_id == rid:
                 self._release(i)
@@ -1804,7 +1849,9 @@ class ContinuousBatcher:
         with health.MONITOR.dispatch_guard("decode",
                                            active=len(self.slots),
                                            spec_rounds=n_rounds,
-                                           rids=rids) as g:
+                                           rids=rids,
+                                           traces=self._traces(rids)
+                                           ) as g:
             out = self._step_spec(*self._spec_operands(arrays),
                                   self._rich(), k, ngram, n_rounds,
                                   ads=self._adapter_ids_array())
@@ -1859,17 +1906,20 @@ class ContinuousBatcher:
             prefill_rids = [st.request_id for _, _, st, _ in plan]
         else:
             decode_rids, prefill_rids = [], []
+        traces = self._traces(decode_rids + prefill_rids)
         with health.MONITOR.dispatch_guard("mixed",
                                            active=len(self.slots),
                                            prefilling=len(plan),
                                            spec_rounds=n_rounds,
                                            rids=decode_rids
-                                           + prefill_rids) as g:
+                                           + prefill_rids,
+                                           traces=traces) as g:
             with telemetry.span("batcher.tick_mixed_spec", cat="serving",
                                 active=len(self.slots),
                                 prefilling=len(plan),
                                 spec_rounds=n_rounds,
-                                rids=decode_rids + prefill_rids):
+                                rids=decode_rids + prefill_rids,
+                                traces=traces):
                 out = self._step_mixed_spec(
                     block["p_tokens"], block["p_slots"],
                     block["p_active"], block["p_pos"], block["p_last"],
@@ -2230,7 +2280,8 @@ class ContinuousService:
                       eos_id: Optional[int] = None,
                       top_k: int = 0, top_p: float = 1.0,
                       on_complete=None,
-                      adapter: Optional[str] = None):
+                      adapter: Optional[str] = None,
+                      trace: Optional[str] = None):
         """Streaming submit: the returned queue yields ``("delta",
         [new generated tokens])`` items as decoding progresses (chunk
         granularity under fused decode), then ``("done", full_output)``
@@ -2244,29 +2295,34 @@ class ContinuousService:
         decode loop); exceptions are swallowed with a log line."""
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=True,
-                            on_complete=on_complete, adapter=adapter)
+                            on_complete=on_complete, adapter=adapter,
+                            trace=trace)
 
     def submit(self, prompt: List[int], max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                eos_id: Optional[int] = None,
                top_k: int = 0, top_p: float = 1.0,
-               adapter: Optional[str] = None):
+               adapter: Optional[str] = None,
+               trace: Optional[str] = None):
         """Returns a queue that yields the full token list (or None on
         shutdown). Raises ValueError for invalid requests (including
         ones the batcher's storage could never hold).  ``eos_id``
         finishes the request early, releasing its slot; ``top_k``/
         ``top_p`` filter the sampling distribution per request;
         ``adapter`` names the request's LoRA adapter (adapter pool
-        required — ``adapter_slots``)."""
+        required — ``adapter_slots``); ``trace`` is the propagated
+        fleet trace id (opaque — the wire format lives in
+        telemetry.propagation)."""
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=False,
-                            adapter=adapter)
+                            adapter=adapter, trace=trace)
 
     def submit_handoff(self, prompt: List[int], max_new_tokens: int,
                        temperature: float = 0.0, seed: int = 0,
                        eos_id: Optional[int] = None,
                        top_k: int = 0, top_p: float = 1.0,
-                       adapter: Optional[str] = None):
+                       adapter: Optional[str] = None,
+                       trace: Optional[str] = None):
         """PREFILL-ONLY submit (the disaggregation sender half): the
         request prefills normally, and at the activation boundary —
         prompt in cache, first token sampled, before it joins any
@@ -2280,7 +2336,7 @@ class ContinuousService:
                              "(pass page_size)")
         return self._submit(prompt, max_new_tokens, temperature, seed,
                             eos_id, top_k, top_p, stream=False,
-                            handoff=True, adapter=adapter)
+                            handoff=True, adapter=adapter, trace=trace)
 
     def import_session(self, blob: bytes):
         """Schedule a migration blob for import on the loop thread;
@@ -2330,7 +2386,8 @@ class ContinuousService:
 
     def _submit(self, prompt, max_new_tokens, temperature, seed, eos_id,
                 top_k, top_p, stream: bool, on_complete=None,
-                handoff: bool = False, adapter: Optional[str] = None):
+                handoff: bool = False, adapter: Optional[str] = None,
+                trace: Optional[str] = None):
         self._batcher.validate_request(prompt, max_new_tokens)
         self._batcher.validate_sampling(top_k, top_p)
         self._batcher.validate_adapter(adapter)
@@ -2347,7 +2404,7 @@ class ContinuousService:
             self._waiting.append(
                 (prompt, max_new_tokens, temperature, seed, eos_id,
                  top_k, top_p, stream, sink, on_complete,
-                 time.perf_counter(), handoff, adapter))
+                 time.perf_counter(), handoff, adapter, trace))
         self._work.set()
         return sink
 
@@ -2739,7 +2796,7 @@ class ContinuousService:
                         break
                     item = self._waiting.pop(0)
                 (prompt, max_new, temp, seed, eos_id, tk, tp, stream,
-                 sink, on_cb, t_sub, handoff, adapter) = item
+                 sink, on_cb, t_sub, handoff, adapter, trace) = item
                 rid = None
                 admit_failed = False
                 while True:
@@ -2749,7 +2806,7 @@ class ContinuousService:
                                 prompt, max_new, temperature=temp,
                                 seed=seed, chunk=self._prefill_chunk,
                                 eos_id=eos_id, top_k=tk, top_p=tp,
-                                adapter=adapter)
+                                adapter=adapter, trace=trace)
                         except Exception:
                             # a per-request admission failure (e.g. an
                             # adapter LOADER error for a bad name) must

@@ -144,6 +144,7 @@ class LLMServer:
         against a fully-pinned pool answer 503 + Retry-After."""
         from .. import telemetry
         from ..telemetry.events import debug_events_route
+        from ..telemetry.trace import debug_trace_route
         from ..utils.httpserver import JsonHTTPServer, RawBody
 
         self.cfg = cfg
@@ -269,10 +270,9 @@ class LLMServer:
             # text format — what `kubectl inspect tpushare --metrics`
             # scrapes per node
             ("GET", "/metrics"): self._metrics,
-            ("GET", "/debug/trace"): lambda _: (
-                200, telemetry.tracer.to_chrome()),
-            # ?since=<seq> tails the flight recorder incrementally
-            # (one shared route implementation with the daemon)
+            # ?since=<seq> tails both rings incrementally (shared
+            # route implementations with the daemon and the router)
+            ("GET", "/debug/trace"): debug_trace_route,
             ("GET", "/debug/events"): debug_events_route,
         })
         self.port = self._http.port
@@ -435,10 +435,15 @@ class LLMServer:
         senders and the disaggregating router can proxy the result
         straight back to the original client.  Refusals answer 409
         (the router's local-decode-fallback trigger) with the counted
-        reason."""
+        reason.  The 200 payload carries ``served_s`` — this handler's
+        import+decode wall — which the disaggregating router POPS to
+        split its hand-off hop into decode_ttft vs migration_wire
+        (one-shot delivery makes the serve wall the TTFT)."""
         import queue as _q
 
         from . import metrics, migrate
+
+        t_in = time.perf_counter()
 
         if self._service is None or \
                 not self._service.can_migrate():
@@ -470,7 +475,8 @@ class LLMServer:
             # only the tokens THIS replica decoded count here; the
             # sender's share is in its own stats
             self.tokens_generated += max(0, len(out) - arrived)
-        return 200, {"tokens": [out]}
+        return 200, {"tokens": [out],
+                     "served_s": time.perf_counter() - t_in}
 
     def _healthz(self, _body=None):
         from ..telemetry.health import MONITOR
@@ -568,7 +574,8 @@ class LLMServer:
                                           temperature=temperature,
                                           seed=seed + i, eos_id=eos_id,
                                           top_k=top_k, top_p=top_p,
-                                          adapter=adapter)
+                                          adapter=adapter,
+                                          trace=fields["trace"])
                      for i, row in enumerate(tokens)]
             import queue as _q
 
@@ -644,7 +651,8 @@ class LLMServer:
             [int(t) for t in tokens[0]], fields["max_new"],
             temperature=fields["temperature"], seed=fields["seed"],
             eos_id=fields["eos_id"], top_k=fields["top_k"],
-            top_p=fields["top_p"], adapter=fields["adapter"])
+            top_p=fields["top_p"], adapter=fields["adapter"],
+            trace=fields["trace"])
         try:
             out = sink.get(timeout=600)
         except _q.Empty:
@@ -701,6 +709,12 @@ class LLMServer:
         if (f["top_k"] or f["top_p"] < 1.0) and self._service is None:
             return None, (400, {"Error": "top_k/top_p need the slot "
                                          "pool; run with --slots"})
+        # fleet trace context (router-stamped or client-supplied):
+        # malformed values are silently untraced — tracing never 400s
+        # a request the replica would otherwise serve
+        from ..telemetry import propagation
+        ctx = propagation.extract(body)
+        f["trace"] = ctx.trace_id if ctx else None
         return f, None
 
     def _score(self, body):
@@ -852,7 +866,8 @@ class LLMServer:
         sink = self._service.submit_stream(
             row, max_new, temperature=temperature, seed=seed,
             eos_id=eos_id, top_k=top_k, top_p=top_p,
-            on_complete=on_complete, adapter=fields["adapter"])
+            on_complete=on_complete, adapter=fields["adapter"],
+            trace=fields["trace"])
         import queue as _q
 
         def chunks():

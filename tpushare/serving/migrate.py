@@ -159,6 +159,18 @@ def blob_meta(blob: bytes) -> dict:
     return header["meta"]
 
 
+def session_trace(meta: dict) -> "Optional[str]":
+    """The fleet trace id a session header carries, or None — a
+    migrated/disaggregated session's decode spans must join the
+    ORIGINATING request's trace, so the opaque id (minted by
+    :mod:`tpushare.telemetry.propagation`) rides the generic session
+    meta with no wire-layout change and re-registers on import.
+    Anything non-string (an old sender, a crafted header) is silently
+    untraced — tracing never refuses a blob."""
+    trace = meta.get("trace")
+    return trace if isinstance(trace, str) and trace else None
+
+
 def _wire_dtype(name: str) -> np.dtype:
     try:
         return np.dtype(name)

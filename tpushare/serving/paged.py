@@ -904,7 +904,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
     # ------------------------------------------------------------------
     def admit_chunked(self, prompt, max_new_tokens, temperature: float = 0.0,
                       seed: int = 0, chunk: int = 64, eos_id=None,
-                      top_k: int = 0, top_p: float = 1.0, adapter=None):
+                      top_k: int = 0, top_p: float = 1.0, adapter=None,
+                      trace=None):
         """Chunked admission with the window rounded UP to a page
         multiple: paged writes are page-aligned (pos stays a multiple of
         the window, the window a multiple of the page — max_seq is a
@@ -922,7 +923,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                                      temperature=temperature, seed=seed,
                                      chunk=chunk, eos_id=eos_id,
                                      top_k=top_k, top_p=top_p,
-                                     adapter=adapter)
+                                     adapter=adapter, trace=trace)
 
     # -- session migration (export / import / release) -----------------
     def can_migrate(self) -> bool:
@@ -973,6 +974,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
         meta = {
             "fingerprint": migrate.config_fingerprint(self.cfg,
                                                       self.page_size),
+            # the originating request's fleet trace id (opaque; see
+            # migrate.session_trace) — the receiver's decode spans
+            # join the trace the prefill/drain sender started
+            "trace": self._rid_traces.get(rid),
             "n_pages": len(ids),
             "content_pages": content_idx,
             "ranges": ranges,
@@ -1015,6 +1020,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
         delivering the eventual result to the request's client."""
         slot = self._slot_of(rid)
         self._req_acct.pop(rid, None)
+        self._rid_traces.pop(rid, None)
         self._release(slot)
         del self.slots[slot]
 
@@ -1154,5 +1160,11 @@ class PagedContinuousBatcher(ContinuousBatcher):
             eos_id=st_eos, top_k=st_ints["top_k"],
             top_p=st_top_p)
         self._acct_open(rid, st_ints["prompt_len"])
+        trace = migrate.session_trace(meta)
+        if trace:
+            # the imported session's dispatches join the originating
+            # request's fleet trace (guards/spans pick it up via
+            # _rid_traces like any locally-admitted request)
+            self._rid_traces[rid] = trace
         metrics.MIGRATION_BYTES.inc(len(blob), direction="in")
         return rid

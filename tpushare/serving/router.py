@@ -72,6 +72,11 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import telemetry
+# trace-context wire format (stdlib, same no-jax contract): the router
+# MINTS the fleet trace id for untraced requests and stamps a child
+# context on every forward/retry/handoff so replica spans join it
+from ..telemetry import propagation
+from ..telemetry.trace import debug_trace_route
 # the ONE exposition distiller (inspect --metrics uses the same): the
 # router keys its load score on the identical fields the operator sees
 from ..inspect.metricsview import summarize_serving
@@ -255,6 +260,9 @@ class FleetRouter:
             ("GET", "/metrics"): lambda _: (
                 200, RawBody(telemetry.REGISTRY.render(),
                              telemetry.PROM_CONTENT_TYPE)),
+            # the router's own forward spans — one of the tracks
+            # `inspect --trace` merges into the fleet timeline
+            ("GET", "/debug/trace"): debug_trace_route,
         })
         self.port = self._http.port
 
@@ -725,6 +733,14 @@ class FleetRouter:
     def _generate(self, body):
         if not isinstance(body, dict):
             return 400, {"Error": "body must be a JSON object"}
+        # fleet trace: continue the caller's context or mint one (the
+        # router is the trace root for unadorned clients); t0 anchors
+        # the critical-path hop decomposition — router_queue is receipt
+        # to the first forward, and the disaggregated hops below
+        # partition the REMAINING wall exactly (their sum is the
+        # router's measured request wall)
+        t0 = time.perf_counter()
+        ctx = propagation.extract(body) or propagation.new_context()
         tokens = self._request_tokens(body)
         try:
             max_new = int(body.get("max_new_tokens", 32))
@@ -748,19 +764,25 @@ class FleetRouter:
             adapter = None
         if self._disagg:
             return self._generate_disagg(body, tokens, steer=steer,
-                                         adapter=adapter)
+                                         adapter=adapter, ctx=ctx, t0=t0)
         return self._forward_balanced(body, tokens, prefill_heavy,
                                       role=None, steer=steer,
-                                      adapter=adapter)
+                                      adapter=adapter, ctx=ctx, t0=t0)
 
     def _forward_balanced(self, body, tokens, prefill_heavy,
                           role: Optional[str] = None,
                           steer: bool = False,
-                          adapter: Optional[str] = None):
+                          adapter: Optional[str] = None,
+                          ctx: "Optional[propagation.TraceContext]" = None,
+                          t0: Optional[float] = None):
         """The plain health/affinity/load retry loop over one role
         class (None = the whole fleet) — the non-disaggregated
         /generate path, and the re-prefill fallback the disaggregated
-        one degrades to."""
+        one degrades to.  ``ctx`` stamps a CHILD context per forward
+        attempt (each retry is its own span on the replica); ``t0`` is
+        set only by the top-level /generate entry and arms the
+        router_queue hop observation (the re-prefill fallback already
+        observed it)."""
         data = json.dumps(body).encode()
         tried: List[str] = []
         for attempt in range(self._max_retries + 1):
@@ -777,7 +799,21 @@ class FleetRouter:
                 with self._lock:
                     self._retries += 1
                 metrics.ROUTER_RETRIES.inc()
-            out = self._forward_watched(replica, data)
+            if ctx is not None:
+                # fresh span id per ATTEMPT: a retried request shows
+                # two replica-side spans under one trace, not one
+                # ambiguous span claimed by both forwards
+                data = json.dumps(
+                    propagation.inject(body,
+                                       propagation.child(ctx))).encode()
+            if t0 is not None:
+                metrics.REQUEST_HOP.observe(time.perf_counter() - t0,
+                                            hop="router_queue")
+                t0 = None
+            with telemetry.span("router.forward", cat="router",
+                                replica=replica.name,
+                                trace=ctx.trace_id if ctx else None):
+                out = self._forward_watched(replica, data)
             if out is not None and out[0] < 500:
                 with self._lock:
                     replica.requests += 1
@@ -826,7 +862,9 @@ class FleetRouter:
 
     # -- disaggregated prefill/decode routing ---------------------------
     def _generate_disagg(self, body, tokens, steer: bool = False,
-                         adapter: Optional[str] = None):
+                         adapter: Optional[str] = None,
+                         ctx: "Optional[propagation.TraceContext]" = None,
+                         t0: Optional[float] = None):
         """Prefill/decode-disaggregated /generate: the prompt prefills
         on a PREFILL replica (``phase="prefill"`` — the replica answers
         with the session blob at the activation boundary), then the
@@ -849,6 +887,10 @@ class FleetRouter:
         pbody["phase"] = "prefill"
         pdata = json.dumps(pbody).encode()
         tried: List[str] = []
+        # t1 = first prefill forward start: router_queue ends here;
+        # prefill retries (rare) lump into prefill_device so the four
+        # hops still partition the router's wall exactly
+        t1: Optional[float] = None
         for attempt in range(self._max_retries + 1):
             replica, policy, ahit = self._pick(tokens, True, tried,
                                                role="prefill",
@@ -862,7 +904,19 @@ class FleetRouter:
                 with self._lock:
                     self._retries += 1
                 metrics.ROUTER_RETRIES.inc()
-            out = self._forward_watched(replica, pdata)
+            if ctx is not None:
+                pdata = json.dumps(
+                    propagation.inject(pbody,
+                                       propagation.child(ctx))).encode()
+            if t1 is None:
+                t1 = time.perf_counter()
+                if t0 is not None:
+                    metrics.REQUEST_HOP.observe(t1 - t0,
+                                                hop="router_queue")
+            with telemetry.span("router.prefill_forward", cat="router",
+                                replica=replica.name,
+                                trace=ctx.trace_id if ctx else None):
+                out = self._forward_watched(replica, pdata)
             if out is not None and out[0] == 503 and isinstance(
                     out[1], dict) and "draining" in str(
                         out[1].get("Error", "")):
@@ -900,27 +954,57 @@ class FleetRouter:
                 # that COMPLETED at activation — nothing to hand off
                 # (headers relayed: a policy 429's Retry-After)
                 return out
+            # prefill succeeded with a blob to land: close the
+            # prefill_device hop here so the hand-off owns the rest
+            t2 = time.perf_counter()
+            if t1 is not None:
+                metrics.REQUEST_HOP.observe(t2 - t1,
+                                            hop="prefill_device")
             return self._dispatch_handoff(replica, tokens, body,
                                           payload["migration"],
-                                          steer=steer, adapter=adapter)
+                                          steer=steer, adapter=adapter,
+                                          ctx=ctx, t2=t2)
         return 502, {"Error": f"all prefill forwards failed "
                               f"(tried {', '.join(tried)})"}
 
     def _dispatch_handoff(self, prefill_r: Replica,
                           tokens: Optional[List[int]], body,
                           blob64: str, steer: bool = False,
-                          adapter: Optional[str] = None):
+                          adapter: Optional[str] = None,
+                          ctx: "Optional[propagation.TraceContext]" = None,
+                          t2: Optional[float] = None):
         """Land a prefilled session blob: decode replica, then the
-        prefill replica itself (local decode), then re-prefill."""
-        mdata = json.dumps({"blob": blob64}).encode()
+        prefill replica itself (local decode), then re-prefill.
+        ``t2`` (prefill completion) anchors the hand-off's two hops:
+        the receiver reports its import+decode wall as ``served_s`` in
+        the /migrate_in payload (popped below — never relayed to the
+        client), decode_ttft = served_s, and migration_wire is the
+        REMAINDER (t4 - t2 - served_s: blob transfer plus routing
+        gap), so the hops sum to the router's wall; without served_s
+        (an old replica) the split degrades to forward-start
+        boundaries."""
+
+        def mdata() -> bytes:
+            # fresh child span per landing attempt, like the balanced
+            # retry loop (the blob body is rebuilt per attempt anyway)
+            mbody: dict = {"blob": blob64}
+            if ctx is not None:
+                mbody = propagation.inject(mbody, propagation.child(ctx))
+            return json.dumps(mbody).encode()
+
         outcome, result, holder = None, None, None
         holder_policy, holder_ahit = "load", False
+        t3: Optional[float] = None        # successful forward's start
         decode_r, dpolicy, dhit = self._pick(tokens, False, (),
                                              role="decode", steer=steer,
                                              adapter=adapter)
         if decode_r is not None:
-            result = self._forward_watched(decode_r, mdata,
-                                           path="/migrate_in")
+            t3 = time.perf_counter()
+            with telemetry.span("router.migrate_in_forward",
+                                cat="router", replica=decode_r.name,
+                                trace=ctx.trace_id if ctx else None):
+                result = self._forward_watched(decode_r, mdata(),
+                                               path="/migrate_in")
             if result is not None and result[0] == 200:
                 outcome, holder = "ok", decode_r
                 holder_policy, holder_ahit = dpolicy, dhit
@@ -937,8 +1021,12 @@ class FleetRouter:
             # whose pool held the session a moment ago
             with self._lock:
                 prefill_r.inflight += 1   # _pick increments; mirror it
-            result = self._forward_watched(prefill_r, mdata,
-                                           path="/migrate_in")
+            t3 = time.perf_counter()
+            with telemetry.span("router.migrate_in_forward",
+                                cat="router", replica=prefill_r.name,
+                                trace=ctx.trace_id if ctx else None):
+                result = self._forward_watched(prefill_r, mdata(),
+                                               path="/migrate_in")
             if result is not None and result[0] == 200:
                 outcome, holder = "local_fallback", prefill_r
         if outcome is None:
@@ -956,8 +1044,30 @@ class FleetRouter:
                 max_new = 32
             return self._forward_balanced(
                 body, tokens, self._prefill_heavy(tokens, max_new),
-                steer=steer, adapter=adapter)
+                steer=steer, adapter=adapter, ctx=ctx)
         metrics.ROUTER_HANDOFFS.inc(outcome=outcome)
+        # close the hand-off hops: pop the receiver's served_s ALWAYS
+        # (a measurement channel, not client payload), then split the
+        # remaining wall into decode_ttft + migration_wire
+        t4 = time.perf_counter()
+        served = None
+        if isinstance(result[1], dict):
+            served = result[1].pop("served_s", None)
+        if t2 is not None:
+            remain = t4 - t2
+            if isinstance(served, (int, float)) \
+                    and 0.0 <= float(served) <= remain:
+                metrics.REQUEST_HOP.observe(remain - float(served),
+                                            hop="migration_wire")
+                metrics.REQUEST_HOP.observe(float(served),
+                                            hop="decode_ttft")
+            elif t3 is not None:
+                # no (or implausible) receiver report: fall back to
+                # forward-start boundaries — still sums to the wall
+                metrics.REQUEST_HOP.observe(t3 - t2,
+                                            hop="migration_wire")
+                metrics.REQUEST_HOP.observe(t4 - t3,
+                                            hop="decode_ttft")
         with self._lock:
             holder.requests += 1
             holder.consecutive_failures = 0

@@ -1,0 +1,112 @@
+"""Trace-context propagation: the ONE wire format for cross-process
+traces (router -> replica -> migration receiver).
+
+Rounds 15-16 made a request's path span processes; the ring tracer
+(:mod:`tpushare.telemetry.trace`) and the rid attribution stop at the
+process boundary.  This module owns the boundary crossing: a
+W3C-traceparent-style context (``00-<32 hex trace_id>-<16 hex
+span_id>-01``) rides a JSON-body field on every forwarded request and
+inside the migration session header, so every process's spans and
+flight-recorder events carry the SAME ``trace_id`` and the fleet
+scraper (``kubectl inspect tpushare --trace``) can merge them into one
+timeline.
+
+Confinement mirrors the migration codec: the ``trace-wire-confinement``
+tpulint rule keeps every traceparent parse/format inside this module —
+the serving plane threads opaque ``trace_id`` strings, never the wire
+encoding.  A body field rather than an HTTP header because
+:class:`tpushare.utils.httpserver.JsonHTTPServer` routes hand handlers
+the parsed body only (headers never reach them), and because the
+migration blob's session meta is JSON either way.
+
+Parse failures are SILENT (``None``): tracing is observability, and a
+malformed context from an old client must never 400 a request that
+would otherwise serve.  Stdlib only, pre-jax importable (the router
+imports this before any backend exists; lint rule ``router-no-jax``
+covers it).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import NamedTuple, Optional
+
+#: the JSON-body field the context rides in (/generate forwards,
+#: /migrate_in hand-offs) — one name everywhere, so the fake replica,
+#: the router, and the LLM server cannot drift
+TRACEPARENT_FIELD = "traceparent"
+
+#: the critical-path hops of one disaggregated request, the enumerated
+#: values of ``tpushare_request_hop_seconds{hop=}`` (enum-pinned in
+#: tests/test_metric_lint.py).  ``router_queue`` = receipt to first
+#: forward (both routing paths); the other three decompose the
+#: disaggregated path: ``prefill_device`` = the prefill forward's wall,
+#: ``decode_ttft`` = the decode replica's reported import+decode wall
+#: (one-shot delivery: TTFT is the full serve, the repo-wide
+#: convention), ``migration_wire`` = the hand-off remainder (blob
+#: transfer + routing gap), so the four hops SUM to the router's
+#: measured request wall.
+REQUEST_HOPS = ("router_queue", "prefill_device", "migration_wire",
+                "decode_ttft")
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+class TraceContext(NamedTuple):
+    """One hop's view of a trace: the fleet-wide ``trace_id`` plus this
+    hop's ``span_id`` (the downstream process's parent)."""
+
+    trace_id: str
+    span_id: str
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_context() -> TraceContext:
+    """A fresh root context (the router mints one per request that
+    arrives without a ``traceparent`` field)."""
+    return TraceContext(new_trace_id(), new_span_id())
+
+
+def child(ctx: TraceContext) -> TraceContext:
+    """Same trace, fresh span id — one per forward ATTEMPT, so a retry's
+    spans are distinguishable from the attempt they replaced."""
+    return TraceContext(ctx.trace_id, new_span_id())
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+def parse_traceparent(value) -> Optional[TraceContext]:
+    """Strict parse of the wire string; None for anything malformed
+    (wrong version, casing, length — silently untraced, never a 400)."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value)
+    if m is None:
+        return None
+    return TraceContext(m.group(1), m.group(2))
+
+
+def extract(body) -> Optional[TraceContext]:
+    """The context a request body carries, or None."""
+    if not isinstance(body, dict):
+        return None
+    return parse_traceparent(body.get(TRACEPARENT_FIELD))
+
+
+def inject(body: dict, ctx: TraceContext) -> dict:
+    """Return a copy of ``body`` carrying ``ctx`` (the caller's dict is
+    never mutated — retry loops re-inject a fresh child per attempt)."""
+    out = dict(body)
+    out[TRACEPARENT_FIELD] = format_traceparent(ctx)
+    return out

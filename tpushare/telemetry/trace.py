@@ -13,6 +13,14 @@ operator debugging "why is serving slow RIGHT NOW" wants.  Span enter/
 exit is two ``perf_counter`` reads and one deque append (lock-held
 nanoseconds); when telemetry is disabled ``span()`` returns a shared
 no-op context, so the disabled path is one flag check.
+
+Fleet-merge support (docs/TRACING.md): every event carries a monotonic
+``seq`` so ``/debug/trace?since=<seq>`` tails the ring incrementally
+(the ``/debug/events`` cursor contract), and ``to_chrome()`` attaches a
+``tpushareClock`` key — this process's wall time paired with its
+``perf_counter`` reading at dump time — so the fleet scraper can rebase
+each process's private monotonic epoch onto one timeline (extra
+top-level keys are ignored by Perfetto; event ``ts`` stays local).
 """
 
 from __future__ import annotations
@@ -24,6 +32,12 @@ import time
 from typing import List
 
 from . import registry
+
+#: Lock-discipline manifest (tpushare.analysis.confinement): ring and
+#: sequence mutations happen only under the tracer's own lock.
+_LOCK_GUARDED = {
+    "Tracer": ("_buf", "_seq"),
+}
 
 
 class _NullSpan:
@@ -72,6 +86,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._buf: collections.deque = collections.deque(maxlen=capacity)
         self._epoch = time.perf_counter()
+        self._seq = 0
 
     @property
     def capacity(self) -> int:
@@ -88,6 +103,8 @@ class Tracer:
 
     def _emit(self, event: dict) -> None:
         with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
             self._buf.append(event)
 
     def span(self, name: str, cat: str = "tpushare", **args):
@@ -113,14 +130,57 @@ class Tracer:
         with self._lock:
             return list(self._buf)
 
+    def events_since(self, seq: int) -> List[dict]:
+        """Events with ``seq`` strictly greater than the cursor, oldest
+        first — the ``/debug/trace?since=`` incremental tail (same
+        contract as the flight recorder's: a cursor that has fallen off
+        the back simply returns the whole ring; the seq gap tells the
+        scraper how much it lost)."""
+        with self._lock:
+            return [e for e in self._buf if e["seq"] > seq]
+
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
 
-    def to_chrome(self) -> dict:
-        """The Chrome trace-event JSON object /debug/trace serves."""
-        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+    def to_chrome(self, since: int = 0) -> dict:
+        """The Chrome trace-event JSON object /debug/trace serves.
+        The ``tpushareClock`` key (ignored by trace viewers) pins this
+        process's private monotonic epoch to wall time AT DUMP TIME —
+        an event's wall time is ``wall_time_s - (trace_time_us -
+        ts) / 1e6`` — which is what lets the fleet scraper merge dumps
+        from processes with unrelated ``perf_counter`` bases onto one
+        timeline (durations are epoch-free and survive any rebase)."""
+        events = self.events_since(since) if since else self.events()
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "tpushareClock": {
+                "pid": os.getpid(),
+                "wall_time_s": time.time(),
+                "trace_time_us": (time.perf_counter() - self._epoch)
+                * 1e6,
+            },
+        }
 
 
 #: the process-global tracer every span site feeds
 TRACER = Tracer()
+
+
+from ..utils.httpserver import with_query  # noqa: E402 (stdlib-only)
+
+
+@with_query
+def debug_trace_route(_body=None, query=None):
+    """Drop-in JsonHTTPServer handler: GET /debug/trace[?since=<seq>]
+    off :data:`TRACER` — the whole ring as Chrome trace JSON by
+    default, or only events past the cursor (the ``debug_events_route``
+    tailing contract), each dump stamped with the clock anchor the
+    fleet merge needs.  One shared implementation for the daemon, the
+    LLM server, and the router."""
+    try:
+        since = int((query or {}).get("since", 0))
+    except (TypeError, ValueError):
+        return 400, {"Error": "since must be an integer seq cursor"}
+    return 200, TRACER.to_chrome(since=since)
