@@ -1,0 +1,270 @@
+"""On-chip pipeline-stage microbatched decode: compile-check + wavefront
+timing.
+
+The staged program's CPU-side contract is pinned in
+tests/test_pp_serving.py (pp=2 streams exactly equal pp=1 on every
+flavor).  What only the real chip can answer is
+
+* does the STAGED shard_map program COMPILE AND LOWER on real XLA:TPU —
+  the round-21 surface is a ``fori_loop`` wavefront INSIDE a shard_map
+  body with a ``ppermute`` activation hop per tick, stage-local
+  dynamic-slice cache row updates gated by the bubble mask, and the
+  final masked ``psum`` fold, over params/KV whose LAYER axis is
+  sharded across the pp mesh (the layer→stage partition) — none of
+  which a CPU mesh proves about Mosaic/ICI lowering;
+* what the wavefront WINS: with the layer stack split over two chips
+  each stage runs half the layers, and microbatch m+1 overlaps
+  microbatch m across stages — staged decode throughput vs the flat
+  single-chip program is the number this drive prices (the bubble
+  fraction (pp-1)/(n_micro+pp-1) is the theoretical ceiling's
+  discount);
+* that stage-local KV STAYS local: the staged arm's caches are sharded
+  on the layer axis, so each chip holds half the KV bytes — the
+  capacity story behind serving deeper models at fixed per-chip HBM.
+
+Method (CLAUDE.md tunnel rules): per arm, coalesced prefill then a
+device-resident ``lax.scan`` decode (ONE dispatch, host-fetch barrier);
+greedy stream agreement staged-vs-flat is ASSERTED (placement plus the
+wavefront's exact-zero fold make the staged program stream-exact, not
+tolerance-bounded — any disagreement is a schedule/containment bug).
+
+    python drives/drive_pp_decode.py        # real chip; ~6 min
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the on-chip staged shape (must stay in sync with the TPU branch of
+#: main()): 16 layers over pp=2 stages = 8 per stage; full-causal
+#: storage, no tp/sp composition (the pp_mesh gate refuses it)
+_TPU_PP = dict(n_layers=16, pp=2, tp=1, sp=1, rolling=False)
+
+
+def precheck() -> dict:
+    """Chip-free verdicts for every staged cell this drive would
+    dispatch, BEFORE any jax import (importing jax dials the tunnel
+    when PALLAS_AXON_POOL_IPS is set).  The pp gate is purely
+    structural — the staged program reuses the flat per-stage forwards,
+    so there are no Mosaic blocks to derive — but the precheck still
+    proves the drive's shapes would ENGAGE the staged program instead
+    of silently demoting to placement.  ``cross_check=False`` pre-dial;
+    gate agreement lives in tier-1 (tests/test_analysis.py)."""
+    from tpushare.analysis import mosaic
+
+    cells = {
+        "pp2": mosaic.precheck_pp_stage(
+            cross_check=False, **_TPU_PP).summary(),
+        # the CPU rehearsal shape (4 tiny layers over 2 stages)
+        "pp2_cpu": mosaic.precheck_pp_stage(
+            n_layers=4, pp=2, cross_check=False).summary(),
+    }
+    return cells
+
+
+def main() -> int:
+    pre = precheck()
+    precheck_ok = all(c["ok"] for c in pre.values())
+    if not precheck_ok:
+        print(json.dumps({"metric": "pp_decode",
+                          "precheck_ok": False, "precheck": pre}))
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpushare.models import transformer
+    from tpushare.parallel.mesh import (make_mesh, shard_kv_storage,
+                                        shard_params)
+    from tpushare.parallel.pipeline import pp_bubble_fraction
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = transformer.ModelConfig(
+            vocab=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq=4096)
+        batch, prompt_len, n_dec, page = 8, 1024, 64, 64
+    else:
+        cfg = transformer.ModelConfig(
+            vocab=256, d_model=256, n_layers=4, n_heads=2, n_kv_heads=2,
+            d_ff=128, max_seq=96, dtype=jnp.bfloat16)
+        batch, prompt_len, n_dec, page = 4, 24, 8, 16
+    pp = 2
+    n_micro = 2
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab)
+
+    out = {"metric": "pp_decode", "platform": dev.platform,
+           "batch": batch, "prompt_len": prompt_len, "decoded": n_dec,
+           "pp": pp, "n_micro": n_micro,
+           "bubble_fraction": pp_bubble_fraction(pp, n_micro),
+           "precheck_ok": precheck_ok, "precheck": pre, "arms": {}}
+
+    if len(jax.devices()) < pp:
+        out["skipped"] = f"needs >= {pp} devices for the pp mesh"
+        print(json.dumps(out))
+        return 0
+
+    mesh = make_mesh({"pp": pp})
+    lengths0 = jnp.full((batch,), prompt_len, jnp.int32)
+
+    # -- dense full-size caches ----------------------------------------
+    def run_dense(staged: bool):
+        run_params = (shard_params(params, mesh, layer_axis="pp")
+                      if staged else params)
+
+        @jax.jit
+        def prefill_jit(caches):
+            return transformer.forward(run_params, prompt, cfg,
+                                       kv_caches=caches, cache_len=0)
+
+        @functools.partial(jax.jit, static_argnames=("n",),
+                           donate_argnums=(1,))
+        def decode_n(tok0, caches, n: int):
+            def body(carry, _):
+                tok, caches, lengths = carry
+                if staged:
+                    logits, caches = transformer.forward_pp_decode(
+                        run_params, tok[:, None], cfg, caches, lengths,
+                        mesh, n_micro=n_micro)
+                else:
+                    logits, caches = transformer.forward(
+                        run_params, tok[:, None], cfg, kv_caches=caches,
+                        cache_len=lengths)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+                return (nxt, caches, lengths + 1), nxt
+
+            (_, caches, _), toks = jax.lax.scan(
+                body, (tok0, caches, lengths0), None, length=n)
+            return toks.T, caches
+
+        def run():
+            caches = transformer.init_kv_caches(cfg, batch)
+            if staged:
+                caches = shard_kv_storage(caches, mesh, layer_axis="pp")
+            logits, caches = prefill_jit(caches)
+            tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks, caches = decode_n(tok0, caches, n_dec)
+            return logits, toks, caches
+
+        t0 = time.perf_counter()
+        logits, toks, caches = run()
+        first = [int(t) for t in toks[0]]            # compile + barrier
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        logits, toks, caches = run()                 # warm timed pass
+        int(toks[0, -1])                             # host fetch barrier
+        dt = time.perf_counter() - t0
+        finite = bool(np.isfinite(np.asarray(logits[:, -1],
+                                             np.float32)).all())
+        if staged:
+            # stage-local KV: each chip holds its stage's layer slice
+            k_leaf = jax.tree_util.tree_leaves(caches)[0]
+            shard = k_leaf.sharding.shard_shape(k_leaf.shape)
+            out["stage_local_kv"] = bool(shard[0] == k_leaf.shape[0] // pp)
+        return compile_s, batch * n_dec / dt, first, finite
+
+    # -- paged pools ---------------------------------------------------
+    pages_per_slot = cfg.max_seq // page
+    w = -(-prompt_len // page) * page
+    padded = jnp.pad(prompt, ((0, 0), (0, w - prompt_len)))
+    n_pages = batch * pages_per_slot + 1
+    table = np.zeros((batch, pages_per_slot), np.int32)
+    for b in range(batch):
+        table[b, :] = 1 + b * pages_per_slot + np.arange(pages_per_slot)
+    table = jnp.asarray(table)
+
+    def run_paged(staged: bool):
+        run_params = (shard_params(params, mesh, layer_axis="pp")
+                      if staged else params)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_jit(pools):
+            return transformer.forward_paged_prefill_batch(
+                run_params, padded, cfg, pools, table,
+                jnp.zeros((batch,), jnp.int32),
+                jnp.full((batch,), prompt_len - 1, jnp.int32))
+
+        @functools.partial(jax.jit, static_argnames=("n",),
+                           donate_argnums=(1,))
+        def decode_n(tok0, pools, n: int):
+            def body(carry, _):
+                tok, pools, lengths = carry
+                if staged:
+                    logits, pools = transformer.forward_paged_decode_pp(
+                        run_params, tok[:, None], cfg, pools, table,
+                        lengths, mesh, n_micro=n_micro)
+                else:
+                    logits, pools = transformer.forward_paged_decode(
+                        run_params, tok[:, None], cfg, pools, table,
+                        lengths)
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+                return (nxt, pools, lengths + 1), nxt
+
+            (_, pools, _), toks = jax.lax.scan(
+                body, (tok0, pools, lengths0), None, length=n)
+            return toks.T, pools
+
+        def run():
+            pools = transformer.init_paged_kv(cfg, n_pages=n_pages,
+                                              page_size=page)
+            if staged:
+                pools = shard_kv_storage(pools, mesh, layer_axis="pp")
+            sel, pools = prefill_jit(pools)
+            tok0 = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+            toks, pools = decode_n(tok0, pools, n_dec)
+            return sel, toks
+
+        t0 = time.perf_counter()
+        sel, toks = run()
+        first = [int(t) for t in toks[0]]
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sel, toks = run()
+        int(toks[0, -1])
+        dt = time.perf_counter() - t0
+        finite = bool(np.isfinite(np.asarray(sel, np.float32)).all())
+        return compile_s, batch * n_dec / dt, first, finite
+
+    streams = {}
+    for arm, runner, staged in (("dense_flat", run_dense, False),
+                                ("dense_pp2", run_dense, True),
+                                ("paged_flat", run_paged, False),
+                                ("paged_pp2", run_paged, True)):
+        compile_s, tps, first, finite = runner(staged)
+        streams[arm] = first
+        out["arms"][arm] = {"compile_s": round(compile_s, 1),
+                            "tokens_per_s": round(tps, 1),
+                            "finite": finite}
+    # the staged program is stream-EXACT vs the flat one (placement is
+    # value-preserving; the wavefront fold adds exact zeros) — any
+    # disagreement is a schedule or bubble-containment bug, never noise
+    assert streams["dense_pp2"] == streams["dense_flat"], \
+        "staged dense stream diverged from flat"
+    assert streams["paged_pp2"] == streams["paged_flat"], \
+        "staged paged stream diverged from flat"
+    out["exact"] = True
+    out["compile_ok"] = all(a["finite"] for a in out["arms"].values())
+    out["pp2"] = {"compile_ok": out["compile_ok"]}
+    for flavor in ("dense", "paged"):
+        out[f"staged_vs_flat_{flavor}"] = round(
+            out["arms"][f"{flavor}_pp2"]["tokens_per_s"]
+            / out["arms"][f"{flavor}_flat"]["tokens_per_s"], 3)
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -645,8 +645,8 @@ import jax
 import numpy as np
 from ..telemetry import health
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _tick_prog(x, n):
+@functools.partial(jax.jit, static_argnames=("n", "pp"))
+def _tick_prog(x, n, pp=None):
     return x
 
 @functools.partial(jax.jit)
@@ -657,7 +657,7 @@ _JIT_ENTRIES = [_tick_prog, _other_prog]
 
 class B:
     def _step(self, x):
-        out = _tick_prog(x, 1)
+        out = _tick_prog(x, 1, pp=None)
         return out
     def tick(self):
         with health.MONITOR.dispatch_guard("decode") as g:
@@ -795,8 +795,8 @@ def test_dispatch_audit_adapter_operand_helper_rules():
 
 def test_dispatch_audit_catches_fetch_inside_hook():
     bad = _AUDIT_FIXTURE.replace(
-        "        out = _tick_prog(x, 1)\n",
-        "        out = np.asarray(_tick_prog(x, 1))\n")
+        "        out = _tick_prog(x, 1, pp=None)\n",
+        "        out = np.asarray(_tick_prog(x, 1, pp=None))\n")
     fs = dispatch_audit.audit_pair(bad)
     assert any(f.rule == "hook-body" and "host-fetches" in f.message
                for f in fs), fs
@@ -853,12 +853,123 @@ def test_dispatch_audit_catches_pacing_inside_hook():
     between trace and dispatch of the jitted program — hooks stay
     pure single-program dispatch."""
     bad = _AUDIT_FIXTURE.replace(
-        "        out = _tick_prog(x, 1)\n",
+        "        out = _tick_prog(x, 1, pp=None)\n",
         '        self._policy.acquire("decode")\n'
-        "        out = _tick_prog(x, 1)\n")
+        "        out = _tick_prog(x, 1, pp=None)\n")
     fs = dispatch_audit.audit_pair(bad)
     assert [f.rule for f in fs] == ["pacing-guard"], fs
     assert "hook" in fs[0].message
+
+
+def test_dispatch_audit_catches_dropped_pp_operand():
+    """Seeded violation (round 21): a staged entry's hook dispatching
+    its program WITHOUT the static pp operand silently serves pp
+    placement-only — the contract declares tick staged, so the audit
+    names the drop."""
+    bad = _AUDIT_FIXTURE.replace(
+        "        out = _tick_prog(x, 1, pp=None)\n",
+        "        out = _tick_prog(x, 1)\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert any(f.rule == "pp-thread"
+               and "without the static pp operand" in f.message
+               for f in fs), fs
+
+
+def test_dispatch_audit_catches_pp_on_placement_entry():
+    """Seeded violation, the other direction: a placement-only entry
+    (tick_spec) threading pp into its program is contract drift —
+    stage the program and the contract together, or neither."""
+    bad = _AUDIT_FIXTURE.replace(
+        "class B:\n",
+        "class B:\n"
+        "    def _step_spec(self, x):\n"
+        "        out = _tick_prog(x, 1, pp=self._pp_args)\n"
+        "        return out\n")
+    fs = dispatch_audit.audit_pair(bad)
+    assert any(f.rule == "pp-thread" and "placement-only" in f.message
+               for f in fs), fs
+    # the sanctioned placement shape — no pp keyword — stays clean
+    ok = _AUDIT_FIXTURE.replace(
+        "class B:\n",
+        "class B:\n"
+        "    def _step_spec(self, x):\n"
+        "        out = _tick_prog(x, 1)\n"
+        "        return out\n")
+    assert dispatch_audit.audit_pair(ok) == []
+
+
+def test_stage_schedule_mirror_and_audit():
+    """The stdlib schedule mirror equals the live wavefront, the audit
+    proves a clean schedule, and each seeded schedule violation —
+    including a second dispatch inside one stage's round — is caught
+    by name."""
+    from tpushare.parallel.pipeline import pp_stage_schedule
+
+    for ns, nm in ((1, 1), (2, 2), (2, 4), (4, 2), (4, 4), (3, 5)):
+        mirror = dispatch_audit.pp_stage_schedule_mirror(ns, nm)
+        assert mirror == pp_stage_schedule(ns, nm)
+        assert dispatch_audit.audit_stage_schedule(mirror, ns, nm) == []
+    good = dispatch_audit.pp_stage_schedule_mirror(2, 2)
+    # a duplicated (stage, microbatch) cell IS a second dispatch in
+    # that stage's round — the in-program twin of dispatch-count
+    dup = good + ((3, 1, 0),)
+    fs = dispatch_audit.audit_stage_schedule(dup, 2, 2)
+    assert any(f.rule == "stage-dispatch"
+               and "dispatches microbatch 0 twice" in f.message
+               for f in fs), fs
+    # a dropped cell: the wavefront must cover every pair
+    fs = dispatch_audit.audit_stage_schedule(good[:-1], 2, 2)
+    assert any(f.rule == "stage-dispatch" and "never dispatches"
+               in f.message for f in fs), fs
+    # out-of-range stage and out-of-order microbatches
+    fs = dispatch_audit.audit_stage_schedule(((0, 5, 0),), 2, 1)
+    assert any("outside" in f.message for f in fs), fs
+    reordered = ((0, 0, 1), (1, 0, 0), (1, 1, 0), (2, 1, 1))
+    fs = dispatch_audit.audit_stage_schedule(reordered, 2, 2)
+    assert any("out of order" in f.message for f in fs), fs
+
+
+def test_dispatches_per_round_closed_form():
+    """The runtime dispatch-count tests assert against this closed
+    form: one HOST dispatch per round at EVERY pipeline degree (the
+    wavefront is in-program), for every contract entry."""
+    for entry in dispatch_audit.ENTRY_CONTRACT:
+        for pp in (1, 2, 4):
+            assert dispatch_audit.dispatches_per_round(entry, pp) == 1
+    with pytest.raises(KeyError):
+        dispatch_audit.dispatches_per_round("tick_bogus")
+    with pytest.raises(ValueError):
+        dispatch_audit.dispatches_per_round("tick", pp=0)
+
+
+def test_dispatch_cross_check_pins_schedule_mirror():
+    """cross_check_live pins the stdlib schedule mirror against the
+    live pipeline module, mosaic-style: drift is a loud
+    DispatchDriftError."""
+    from tpushare.parallel import pipeline
+    from tpushare.serving import continuous  # noqa: F401 (jax-heavy)
+
+    dispatch_audit.cross_check_live()
+    real = pipeline.pp_stage_schedule
+    pipeline.pp_stage_schedule = lambda ns, nm: real(ns, nm)[:-1]
+    try:
+        with pytest.raises(dispatch_audit.DispatchDriftError):
+            dispatch_audit.cross_check_live()
+    finally:
+        pipeline.pp_stage_schedule = real
+
+
+def test_precheck_pp_stage_gate_drift_raises(monkeypatch):
+    """mosaic.precheck_pp_stage(cross_check=True) is pinned to the live
+    gate exactly like precheck_paged: a gate edit the prechecker does
+    not mirror raises GateDriftError instead of going silently stale."""
+    attention = importlib.import_module("tpushare.ops.attention")
+
+    mosaic.precheck_pp_stage(n_layers=4, pp=2, cross_check=True)
+    monkeypatch.setattr(attention, "pp_stage_fallback_reason",
+                        lambda *a, **k: "pp_layers")
+    with pytest.raises(mosaic.GateDriftError):
+        mosaic.precheck_pp_stage(n_layers=4, pp=2, cross_check=True)
 
 
 def test_confinement_lock_discipline_covers_policy_module():
@@ -904,6 +1015,14 @@ def test_dispatch_contract_matches_runtime_wrap_lists():
     hooks = set(dispatch_audit.TICK_HOOKS)
     assert {c["steady"] for c in
             dispatch_audit.ENTRY_CONTRACT.values()} == hooks
+    # round 21: every entry declares its pipeline mode, and the split
+    # the runtime equivalence tests rely on is staged decode entries
+    # vs placement-only spec entries
+    modes = {e: c["pp"] for e, c in
+             dispatch_audit.ENTRY_CONTRACT.items()}
+    assert modes == {"tick": "staged", "tick_fused": "staged",
+                     "tick_mixed": "staged", "tick_spec": "placement",
+                     "tick_mixed_spec": "placement"}
 
 
 def test_dispatch_cross_check_raises_on_drift():

@@ -42,6 +42,11 @@ def _seed_serving_metrics():
     telemetry.gauge("tpushare_mixed_budget_utilization",
                     "Real prompt tokens / padded prefill-block "
                     "capacity").set(0.62)
+    telemetry.gauge("tpushare_pp_stages",
+                    "Pipeline stages the layer stack spans "
+                    "(1 = unstaged)").set(2)
+    telemetry.gauge("tpushare_pp_bubble_fraction",
+                    "GPipe bubble share of the staged wavefront").set(0.25)
 
 
 def test_summarize_serving_quantiles():
@@ -55,6 +60,8 @@ def test_summarize_serving_quantiles():
     assert s["kv_util"] == 0.75
     assert s["prefill_queue"] == 2
     assert s["mixed_budget_util"] == 0.62
+    assert s["pp_stages"] == 2
+    assert s["pp_bubble_fraction"] == 0.25
 
 
 def _run_inspect(monkeypatch, api, argv):
@@ -88,6 +95,7 @@ def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
         assert "30/10 (75%)" in out               # KV pages used/free (util)
         assert "PREFILL Q" in out and "BUDGET%" in out
         assert "62%" in out                       # mixed budget utilization
+        assert "STAGES" in out and "2 (bub 25%)" in out   # pipeline stages
     finally:
         api.stop()
         srv.stop()

@@ -208,7 +208,7 @@ ENUMERATED_VALUES = {
     # keep in sync with ops.attention.FALLBACK_REASONS (asserted below)
     ("tpushare_attn_kernel_fallback_total", "reason"):
         {"head_dim", "page_tile", "max_rows", "tp_heads", "sp_pool",
-         "forced"},
+         "forced", "pp_layers", "pp_mesh", "pp_storage"},
     # keep in sync with continuous.SPEC_FALLBACK_REASONS (asserted
     # below)
     ("tpushare_spec_fallback_total", "reason"):

@@ -1,0 +1,466 @@
+"""Pipeline-parallel serving (round 21): microbatched pp decode with
+stage-local parameters and KV.
+
+Contract:
+
+* ``pp=1`` is byte-identical to pre-round-21 serving (no mesh, no new
+  operand — the pp static arg defaults to None and the traced programs
+  are the old ones);
+* ``pp=2`` streams are EXACTLY the pp=1 streams on the f32 tiny config
+  for ticked/fused/mixed/spec on dense AND paged storage, greedy and
+  sampled — microbatch splitting is row-local and the final stage fold
+  adds exact zeros, so this is equality, not a tolerance;
+* the staged program keeps the one-dispatch-per-round invariant: the
+  (stage, microbatch) wavefront runs as in-program fori_loop ticks, so
+  the HOST dispatch count per round is
+  ``dispatches_per_round(entry, pp)`` == 1 — the counter wrap lists
+  derive from the auditor's ENTRY_CONTRACT exactly like
+  tests/test_mixed_step.py;
+* structurally impossible configs DEMOTE to placement-only pp (params
+  and KV still stage-sharded by GSPMD, program flat) with a counted
+  fallback — ``pp_layers`` (indivisible stack), ``pp_mesh`` (tp/sp
+  composition), ``pp_storage`` (rolling windows) — and still serve
+  exact streams;
+* migration blobs stay layout-agnostic ACROSS pipeline depths:
+  pp=2 -> pp=1 and pp=1 -> pp=2 reproduce the stream token for token.
+
+Runs on the conftest 8-device CPU mesh; the Mosaic lowering claims for
+the staged program live in drives/drive_pp_decode.py (``-m tpu`` lane).
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from tpushare.models import transformer
+from tpushare.parallel.mesh import make_mesh, stage_layer_ranges
+from tpushare.parallel.pipeline import pp_bubble_fraction, pp_stage_schedule
+from tpushare.serving import metrics
+from tpushare.serving.continuous import ContinuousBatcher, ContinuousService
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+CFG = transformer.tiny(n_layers=4, max_seq=96)
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14], [5, 4, 3, 2]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _drain(b, prompts=PROMPTS, gen=8, sampled=True):
+    """Admit greedy and (optionally) sampled rows, tick to completion,
+    return the streams in admission order."""
+    rids = []
+    for i, p in enumerate(prompts):
+        rids.append(b.admit(list(p), gen,
+                            temperature=0.8 if (sampled and i % 2) else 0.0,
+                            seed=42 + i))
+    assert all(r is not None for r in rids)
+    b.run_until_drained()
+    return [b.completed[r] for r in rids]
+
+
+def _pp_mesh(pp=2, **extra):
+    axes = {"pp": pp}
+    axes.update(extra)
+    return make_mesh(axes)
+
+
+# ---------------------------------------------------------------------------
+# gates / structure (no device compute)
+# ---------------------------------------------------------------------------
+def test_pp_gate_reasons_and_mosaic_agreement():
+    from tpushare.analysis import mosaic
+    from tpushare.ops.attention import (FALLBACK_REASONS,
+                                        pp_stage_fallback_reason)
+
+    for r in ("pp_layers", "pp_mesh", "pp_storage"):
+        assert r in FALLBACK_REASONS
+    cases = [
+        (dict(n_layers=4, pp=1), None),
+        (dict(n_layers=4, pp=2), None),
+        (dict(n_layers=4, pp=4), None),
+        (dict(n_layers=3, pp=2), "pp_layers"),
+        (dict(n_layers=4, pp=2, tp=2), "pp_mesh"),
+        (dict(n_layers=4, pp=2, sp=2), "pp_mesh"),
+        (dict(n_layers=4, pp=2, rolling=True), "pp_storage"),
+        # precedence mirrors the gate order: the stack split is the
+        # structural impossibility, the mesh merely unimplemented
+        (dict(n_layers=3, pp=2, tp=2), "pp_layers"),
+    ]
+    for kwargs, want in cases:
+        assert pp_stage_fallback_reason(**kwargs) == want, kwargs
+        v = mosaic.precheck_pp_stage(cross_check=True, **kwargs)
+        assert v.reason == want and v.ok == (want is None), kwargs
+        if want is not None:
+            assert v.findings, kwargs
+
+
+def test_pp_schedule_and_bubble():
+    # degenerate pipelines have no wavefront and no bubble
+    assert pp_bubble_fraction(1, 4) == 0.0
+    assert pp_stage_schedule(1, 3) == ((0, 0, 0), (1, 0, 1), (2, 0, 2))
+    # GPipe wavefront: stage s runs microbatch t-s; every cell once
+    sched = pp_stage_schedule(2, 2)
+    assert sched == ((0, 0, 0), (1, 0, 1), (1, 1, 0), (2, 1, 1))
+    assert pp_bubble_fraction(2, 2) == pytest.approx(1 / 3)
+    assert pp_bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    # deeper pipelines with more microbatches shrink the bubble
+    assert pp_bubble_fraction(4, 16) < pp_bubble_fraction(4, 4)
+
+
+def test_pp_construction_refusals(params):
+    with pytest.raises(ValueError, match="pp"):
+        ContinuousBatcher(params, CFG, n_slots=4, pp=2)   # no mesh
+    with pytest.raises(ValueError, match="pp"):
+        ContinuousBatcher(params, CFG, n_slots=4,
+                          mesh=_pp_mesh(2), pp=4)          # axis mismatch
+    with pytest.raises(ValueError, match="divide"):
+        ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2),
+                          pp=2, pp_microbatches=3)
+    # n_micro defaults to the largest divisor of n_slots <= pp
+    b = ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2), pp=2)
+    assert b.pp_microbatches == 2
+    b3 = ContinuousBatcher(params, CFG, n_slots=3, mesh=_pp_mesh(2), pp=2)
+    assert b3.pp_microbatches == 1
+    # an explicit deeper split is legal (more microbatches than stages)
+    b4 = ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2),
+                           pp=2, pp_microbatches=4)
+    assert b4.pp_microbatches == 4
+
+
+def test_pp_storage_info_and_gauges(params):
+    b = ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2), pp=2)
+    info = b.storage_info()
+    assert info["pp_stages"] == 2
+    assert info["pool_bytes_per_stage"] * 2 == info["pool_bytes"]
+    assert info["stage_layer_ranges"] == ((0, 2), (2, 4))
+    assert info["stage_layer_ranges"] == stage_layer_ranges(4, 2)
+    assert info["pp_fallback_reason"] is None
+    assert info["pp_microbatches"] == 2
+    assert info["pp_bubble_fraction"] == pytest.approx(
+        pp_bubble_fraction(2, 2))
+    assert metrics.PP_STAGES.value() == 2
+    assert metrics.PP_BUBBLE_FRACTION.value() == pytest.approx(1 / 3)
+    # unstaged batchers report one stage (and reset the gauges)
+    b1 = ContinuousBatcher(params, CFG, n_slots=4)
+    i1 = b1.storage_info()
+    assert i1["pp_stages"] == 1 and i1["pp_bubble_fraction"] == 0.0
+    assert metrics.PP_STAGES.value() == 1
+    assert metrics.PP_BUBBLE_FRACTION.value() == 0.0
+
+
+def test_pp_layers_demotion_counted_and_serves():
+    cfg3 = transformer.tiny(n_layers=3, max_seq=96)
+    p3 = transformer.init_params(jax.random.PRNGKey(0), cfg3)
+    before = metrics.ATTN_FALLBACK.value(reason="pp_layers")
+    b = ContinuousBatcher(p3, cfg3, n_slots=4, mesh=_pp_mesh(2), pp=2)
+    assert b._pp_args is None and b._pp_reason == "pp_layers"
+    assert metrics.ATTN_FALLBACK.value(reason="pp_layers") == before + 1
+    assert b.storage_info()["pp_fallback_reason"] == "pp_layers"
+    # an indivisible stack still splits remainder-to-earliest for the
+    # placement sharding, and the batcher still serves
+    assert stage_layer_ranges(3, 2) == ((0, 2), (2, 3))
+    ref = ContinuousBatcher(p3, cfg3, n_slots=4)
+    assert _drain(b) == _drain(ref)
+
+
+def test_pp_rolling_storage_demotes(params):
+    wcfg = transformer.tiny(n_layers=4, max_seq=96, window=16)
+    wparams = transformer.init_params(jax.random.PRNGKey(0), wcfg)
+    # dense rolling slot pool
+    b = ContinuousBatcher(wparams, wcfg, n_slots=2, mesh=_pp_mesh(2), pp=2)
+    assert b._pp_reason == "pp_storage" and b._pp_args is None
+    # paged windowed page RING (rolling_slots is False on paged — the
+    # gate hook asks the storage, not the flag)
+    pb = PagedContinuousBatcher(wparams, wcfg, n_slots=2, page_size=16,
+                                mesh=_pp_mesh(2), pp=2)
+    assert pb._pp_reason == "pp_storage" and pb._pp_args is None
+    # full-causal paged pools stage fine
+    pb2 = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16,
+                                 mesh=_pp_mesh(2), pp=2)
+    assert pb2._pp_reason is None and pb2._pp_args is not None
+
+
+# ---------------------------------------------------------------------------
+# stream equivalence (device compute; small shapes)
+# ---------------------------------------------------------------------------
+def test_pp_ticked_streams_exact_dense(params):
+    base = _drain(ContinuousBatcher(params, CFG, n_slots=4))
+    b = ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2), pp=2)
+    assert b._pp_args is not None
+    assert _drain(b) == base
+
+
+def test_pp_ticked_streams_exact_paged(params):
+    base = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
+                                         page_size=8))
+    b = PagedContinuousBatcher(params, CFG, n_slots=4, page_size=8,
+                               mesh=_pp_mesh(2), pp=2)
+    assert b._pp_args is not None
+    assert _drain(b) == base
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_pp_one_dispatch_per_round(params, paged):
+    """The round-7 invariant survives staging: fused and mixed rounds
+    each stay dispatches_per_round(entry, pp) == 1 HOST dispatch — the
+    stage wavefront is in-program.  Wrap lists derive FROM the static
+    auditor's contract so this test and the audit prove the same
+    invariant (the test_mixed_step pattern)."""
+    from tpushare.analysis import dispatch_audit
+
+    if paged:
+        b = PagedContinuousBatcher(params, CFG, n_slots=4, page_size=4,
+                                   mesh=_pp_mesh(2), pp=2)
+    else:
+        b = ContinuousBatcher(params, CFG, n_slots=4, mesh=_pp_mesh(2),
+                              pp=2)
+    assert b._pp_args is not None
+    counts = {"n": 0, "mixed": 0, "other": 0}
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    rd = b.admit([1, 2, 3], 9)
+    rp = b.admit_chunked([5] * 20, 3, chunk=4)
+    wrap(dispatch_audit.ENTRY_CONTRACT["tick_fused"]["steady"], "n")
+    wrap(dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"], "mixed")
+    for hook in (dispatch_audit.TICK_HOOKS + dispatch_audit.PREFILL_HOOKS):
+        if hook not in ("_step_n", "_step_mixed"):
+            wrap(hook, "other")
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    per_round = dispatch_audit.dispatches_per_round("tick_mixed", pp=2)
+    assert counts["mixed"] == per_round * rounds and rounds >= 1
+    fused = 0
+    while b.slots:
+        b.tick_fused(4)
+        fused += 1
+    assert counts["n"] == \
+        dispatch_audit.dispatches_per_round("tick_fused", pp=2) * fused
+    assert counts["other"] == 0
+    assert rd in b.completed and rp in b.completed
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(),                                  # dense mixed + fused
+    dict(page_size=8, spec_k=3),             # paged spec (placement pp)
+], ids=["dense-mixed", "paged-spec"])
+def test_pp_service_streams_exact(params, kwargs):
+    def run(svc):
+        svc.start()
+        try:
+            qs = [svc.submit(list(p), 8,
+                             temperature=0.7 if i == 1 else 0.0,
+                             seed=7 + i)
+                  for i, p in enumerate(PROMPTS)]
+            return [q.get(timeout=180) for q in qs]
+        finally:
+            svc.stop()
+
+    base = run(ContinuousService(params, CFG, n_slots=4, prefill_chunk=4,
+                                 decode_chunk=4, **kwargs))
+    got = run(ContinuousService(params, CFG, n_slots=4, prefill_chunk=4,
+                                decode_chunk=4, mesh=_pp_mesh(2), pp=2,
+                                **kwargs))
+    assert got == base
+
+
+def test_pp_composes_with_tp_on_3d_mesh(params):
+    """pp x tp (x sp below, slow lane): the staged program refuses
+    composition (pp_mesh — counted demotion) but stage PLACEMENT still
+    shards the layer stack, and the partitioned flat program reproduces
+    the unsharded stream exactly.  Greedy rows only — the round-12 tp
+    bar: the partitioner reassociates projection reductions, which
+    sampling draws amplify (test_serving_tp.py keeps the same bar);
+    pure-pp staging above IS sampled-exact because placement never
+    reassociates."""
+    b = ContinuousBatcher(params, CFG, n_slots=4,
+                          mesh=make_mesh({"pp": 2, "tp": 2}), pp=2)
+    assert b._pp_reason == "pp_mesh" and b._pp_args is None
+    assert b.storage_info()["pp_stages"] == 2   # placement still staged
+    assert _drain(b, sampled=False) == _drain(
+        ContinuousBatcher(params, CFG, n_slots=4), sampled=False)
+
+
+def test_pp_migration_across_depths(params):
+    """Session blobs are layout-agnostic across pipeline depths: a
+    decoding session exported from a pp=2 pool resumes on a pp=1 pool
+    (and back) token for token — the blob carries pages + slot state,
+    never placement."""
+    ref = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16)
+    rr = ref.admit([3, 1, 4, 1, 5, 9, 2, 6] * 2, 12)
+    ref.run_until_drained()
+    want = ref.completed[rr]
+
+    def roundtrip(src_pp, dst_pp):
+        def build(pp):
+            if pp > 1:
+                return PagedContinuousBatcher(
+                    params, CFG, n_slots=2, page_size=16,
+                    mesh=_pp_mesh(pp), pp=pp)
+            return PagedContinuousBatcher(params, CFG, n_slots=2,
+                                          page_size=16)
+        src = build(src_pp)
+        rid = src.admit([3, 1, 4, 1, 5, 9, 2, 6] * 2, 12)
+        for _ in range(3):
+            src.tick()
+        blob = src.export_session(rid)
+        src.pop_session(rid)
+        dst = build(dst_pp)
+        rid2 = dst.import_session(blob)
+        assert rid2 is not None
+        dst.run_until_drained()
+        return dst.completed[rid2]
+
+    assert roundtrip(2, 1) == want
+    assert roundtrip(1, 2) == want
+
+
+def test_bench_pp_microbatch_smoke(params):
+    """The bench_all scenario at tiny sizes with the sleep proxy
+    turned OFF (rpc_s=0): real staged-vs-flat streams asserted inside
+    the helper, one dispatch per staged round, ``pp * n_micro``
+    charged to the sequential-stage baseline."""
+    import bench_all
+    out = bench_all.pp_microbatch_bench(params, CFG, slots=4, gen=9,
+                                        decode_chunk=4, pp=2,
+                                        rpc_s=0.0, reps=1)
+    assert out["n_micro"] == 2
+    assert out["schedule_cells"] == 4
+    assert out["wavefront_ticks"] == 3
+    # both arms ran the same number of fused rounds; the staged arm
+    # dispatched ONCE per round, the baseline once per schedule cell
+    assert out["sequential_stage"]["dispatches"] == \
+        out["schedule_cells"] * out["microbatched"]["dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# heavier matrices (slow lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("kwargs", [
+    dict(mixed_step=False),                  # dense sequential interleave
+    dict(spec_k=3),                          # dense spec (placement pp)
+    dict(page_size=8),                       # paged mixed + fused
+], ids=["dense-seq", "dense-spec", "paged-mixed"])
+def test_pp_service_flavor_matrix(params, kwargs):
+    def run(svc):
+        svc.start()
+        try:
+            qs = [svc.submit(list(p), 8,
+                             temperature=0.7 if i == 1 else 0.0,
+                             seed=7 + i)
+                  for i, p in enumerate(PROMPTS)]
+            return [q.get(timeout=180) for q in qs]
+        finally:
+            svc.stop()
+
+    base = run(ContinuousService(params, CFG, n_slots=4, prefill_chunk=4,
+                                 decode_chunk=4, **kwargs))
+    got = run(ContinuousService(params, CFG, n_slots=4, prefill_chunk=4,
+                                decode_chunk=4, mesh=_pp_mesh(2), pp=2,
+                                **kwargs))
+    assert got == base
+
+
+@pytest.mark.slow
+def test_pp_int8_self_consistency_and_vs_pp1(params):
+    """int8 KV stays exactly self-consistent across dispatch flavors
+    under staging (quantization is append-only; staging only moves
+    which device holds a layer's pages), and pp=2 int8 equals pp=1
+    int8 stream for stream."""
+    cfg = dataclasses.replace(CFG, kv_dtype="int8")
+    prompt = [1, 2, 3, 4] * 3
+    gen = 9
+
+    def build(pp):
+        if pp > 1:
+            return PagedContinuousBatcher(params, cfg, n_slots=2,
+                                          page_size=16,
+                                          mesh=_pp_mesh(pp), pp=pp,
+                                          spec_k=4)
+        return PagedContinuousBatcher(params, cfg, n_slots=2,
+                                      page_size=16, spec_k=4)
+
+    outs = {}
+    for pp in (1, 2):
+        b1 = build(pp)
+        r1 = b1.admit(prompt, gen)
+        while b1.slots:
+            b1.tick()
+        b2 = build(pp)
+        r2 = b2.admit(prompt, gen)
+        while b2.slots:
+            b2.tick_fused(4)
+        b3 = build(pp)
+        r3 = b3.admit(prompt, gen)
+        while b3.slots:
+            b3.tick_spec(2, k=4)
+        assert (b1.completed[r1] == b2.completed[r2]
+                == b3.completed[r3]), f"pp={pp} flavors disagree"
+        outs[pp] = b1.completed[r1]
+    assert outs[2] == outs[1]
+
+
+@pytest.mark.slow
+def test_pp_composes_with_tp_sp_on_3d_paged_mesh(params):
+    """The full 3-D composition: pp x tp x sp over the 8-device mesh.
+    The staged program demotes (pp_mesh) but placement shards layers
+    over pp, pages over sp, heads over tp — greedy streams stay exactly
+    the unsharded paged streams (the round-12 tp bar; see
+    test_pp_composes_with_tp_on_3d_mesh)."""
+    base = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
+                                         page_size=8), sampled=False)
+    b = PagedContinuousBatcher(
+        params, CFG, n_slots=4, page_size=8, n_pages=24,
+        mesh=make_mesh({"pp": 2, "tp": 2, "sp": 2}), pp=2)
+    assert b._pp_reason == "pp_mesh"
+    assert _drain(b, sampled=False) == base
+
+
+@pytest.mark.slow
+def test_pp_migration_sampled_int8_matrix(params):
+    """Cross-depth migration with sampling state and int8 pages: the
+    blob carries the PRNG key, so the resumed sampled stream matches
+    the uninterrupted one on both depth transitions."""
+    cfg = dataclasses.replace(CFG, kv_dtype="int8")
+    prompt = [2, 7, 1, 8, 2, 8] * 3
+
+    def build(pp):
+        if pp > 1:
+            return PagedContinuousBatcher(params, cfg, n_slots=2,
+                                          page_size=16,
+                                          mesh=_pp_mesh(pp), pp=pp)
+        return PagedContinuousBatcher(params, cfg, n_slots=2,
+                                      page_size=16)
+
+    ref = build(1)
+    rr = ref.admit(prompt, 12, temperature=0.9, seed=123)
+    ref.run_until_drained()
+    want = ref.completed[rr]
+
+    for src_pp, dst_pp in ((2, 1), (1, 2)):
+        src = build(src_pp)
+        rid = src.admit(prompt, 12, temperature=0.9, seed=123)
+        for _ in range(4):
+            src.tick()
+        blob = src.export_session(rid)
+        src.pop_session(rid)
+        dst = build(dst_pp)
+        rid2 = dst.import_session(blob)
+        dst.run_until_drained()
+        assert dst.completed[rid2] == want, (src_pp, dst_pp)

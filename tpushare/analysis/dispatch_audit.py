@@ -65,22 +65,114 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .tpulint import Finding, repo_root
 
-#: per-entry dispatch contract: the ONE steady-state storage hook, and
-#: the helpers sanctioned to dispatch extra (boundary stragglers, the
-#: sequential reference composition).  The runtime dispatch-count tests
-#: derive their counter wrap lists from this table, so editing it
-#: without editing the serving code fails them — and vice versa.
+#: per-entry dispatch contract: the ONE steady-state storage hook, the
+#: helpers sanctioned to dispatch extra (boundary stragglers, the
+#: sequential reference composition), and the entry's PIPELINE mode
+#: (round 21): "staged" entries thread the ``pp`` static arg into their
+#: hook's jitted program — under pp the one dispatch runs the
+#: microbatched stage wavefront IN-PROGRAM (stage s × microbatch m as
+#: fori_loop ticks, never extra host dispatches); "placement" entries
+#: keep the flat program (layers merely PLACED across the pp axis by
+#: GSPMD).  The runtime dispatch-count tests derive their counter wrap
+#: lists from this table, so editing it without editing the serving
+#: code fails them — and vice versa.
 ENTRY_CONTRACT = {
-    "tick": {"steady": "_step", "sanctioned": ()},
-    "tick_fused": {"steady": "_step_n", "sanctioned": ()},
+    "tick": {"steady": "_step", "sanctioned": (), "pp": "staged"},
+    "tick_fused": {"steady": "_step_n", "sanctioned": (),
+                   "pp": "staged"},
     "tick_mixed": {"steady": "_step_mixed",
                    "sanctioned": ("_mixed_fallback",
-                                  "_finish_mixed_round")},
-    "tick_spec": {"steady": "_step_spec", "sanctioned": ()},
+                                  "_finish_mixed_round"),
+                   "pp": "staged"},
+    "tick_spec": {"steady": "_step_spec", "sanctioned": (),
+                  "pp": "placement"},
     "tick_mixed_spec": {"steady": "_step_mixed_spec",
                         "sanctioned": ("_mixed_fallback",
-                                       "_finish_mixed_round")},
+                                       "_finish_mixed_round"),
+                        "pp": "placement"},
 }
+
+
+def dispatches_per_round(entry: str, pp: int = 1) -> int:
+    """Host dispatches one steady round of ``entry`` costs at pipeline
+    degree ``pp`` — ALWAYS 1: the stage wavefront is in-program (the
+    staged entries' one jitted program runs every (stage, microbatch)
+    cell as fori_loop ticks; the placement entries keep the flat
+    program).  This closed form is what the runtime dispatch-count
+    tests assert against, so a serving change that made pp cost
+    per-stage host dispatches would have to edit the contract here —
+    and fail :func:`audit_stage_schedule`'s fixtures."""
+    if entry not in ENTRY_CONTRACT:
+        raise KeyError(f"unknown tick entry {entry!r}")
+    if pp < 1:
+        raise ValueError(f"pp must be >= 1, got {pp}")
+    return 1
+
+
+def pp_stage_schedule_mirror(n_stages: int, n_micro: int):
+    """Stdlib mirror of ``tpushare.parallel.pipeline.pp_stage_schedule``
+    (mirrored the way mosaic mirrors ``PAGED_KERNEL_MAX_ROWS``;
+    :func:`cross_check_live` pins the two): the GPipe decode wavefront
+    as ``(tick, stage, microbatch)`` cells — stage s runs microbatch
+    ``t - s`` on tick t when that index is in range."""
+    if n_stages < 1 or n_micro < 1:
+        raise ValueError(
+            f"need n_stages >= 1 and n_micro >= 1, got "
+            f"({n_stages}, {n_micro})")
+    return tuple((t, s, t - s)
+                 for t in range(n_micro + n_stages - 1)
+                 for s in range(n_stages)
+                 if 0 <= t - s < n_micro)
+
+
+def audit_stage_schedule(table, n_stages: int,
+                         n_micro: int) -> List[Finding]:
+    """Prove one dispatch per stage per round over a schedule ``table``
+    of ``(tick, stage, microbatch)`` cells: every (stage, microbatch)
+    pair exactly once, stages within their range, and each stage's
+    microbatch sequence in order (the wavefront never reorders a
+    stage's work).  A duplicated pair is a second dispatch inside one
+    stage's round — the in-program twin of the dispatch-count rule."""
+    out: List[Finding] = []
+    seen: Dict[Tuple[int, int], int] = {}
+    per_stage: Dict[int, List[int]] = {}
+    for tick, stage, micro in table:
+        if not 0 <= stage < n_stages:
+            out.append(Finding(
+                "stage-dispatch", DENSE_MODULE, 0,
+                f"schedule cell (t={tick}, s={stage}, m={micro}) names "
+                f"stage {stage} outside [0, {n_stages})"))
+            continue
+        if not 0 <= micro < n_micro:
+            out.append(Finding(
+                "stage-dispatch", DENSE_MODULE, 0,
+                f"schedule cell (t={tick}, s={stage}, m={micro}) names "
+                f"microbatch {micro} outside [0, {n_micro})"))
+            continue
+        if (stage, micro) in seen:
+            out.append(Finding(
+                "stage-dispatch", DENSE_MODULE, 0,
+                f"stage {stage} dispatches microbatch {micro} twice "
+                f"(ticks {seen[(stage, micro)]} and {tick}) — one "
+                f"dispatch per stage per microbatch per round"))
+            continue
+        seen[(stage, micro)] = tick
+        per_stage.setdefault(stage, []).append(micro)
+    for stage in range(n_stages):
+        got = per_stage.get(stage, [])
+        if sorted(got) != list(range(n_micro)):
+            missing = sorted(set(range(n_micro)) - set(got))
+            out.append(Finding(
+                "stage-dispatch", DENSE_MODULE, 0,
+                f"stage {stage} never dispatches microbatch(es) "
+                f"{missing} — the wavefront must cover every "
+                f"(stage, microbatch) cell"))
+        elif got != sorted(got):
+            out.append(Finding(
+                "stage-dispatch", DENSE_MODULE, 0,
+                f"stage {stage} runs microbatches out of order "
+                f"({got}) — a stage's KV writes are order-dependent"))
+    return out
 
 #: the tick storage hooks — one jitted program each, no fetches
 TICK_HOOKS = ("_step", "_step_n", "_step_mixed", "_step_spec",
@@ -407,6 +499,42 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                 f"{flavor.name} operand helper {helper} host-fetches — "
                 f"it hands device handles through, never synchronizes"))
 
+    # -- pipeline threading: staged entries' hooks thread pp -----------
+    # (round 21): a "staged" entry's one jitted program carries the
+    # static pp operand — that is HOW the wavefront stays in-program —
+    # and a "placement" entry's must not (its program is the flat one;
+    # an undeclared pp operand is contract drift in the other
+    # direction).  Checked on the hook's jitted call keywords.
+    for entry, contract in ENTRY_CONTRACT.items():
+        mode = contract.get("pp")
+        hook = contract["steady"]
+        if mode is None or hook not in flavor.table:
+            continue
+        fn, facts = flavor.table[hook]
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in facts.jitted
+                    and node.func.id not in AUX_JIT):
+                continue
+            has_pp = any(kw.arg == "pp" for kw in node.keywords)
+            if mode == "staged" and not has_pp:
+                out.append(Finding(
+                    "pp-thread", path_of(hook), node.lineno,
+                    f"{flavor.name} hook {hook} ({entry}) dispatches "
+                    f"{node.func.id} without the static pp operand — "
+                    f"a staged entry threads the pipeline into its ONE "
+                    f"program (contract pp='staged'); dropping it "
+                    f"silently serves pp placement-only"))
+            if mode == "placement" and has_pp:
+                out.append(Finding(
+                    "pp-thread", path_of(hook), node.lineno,
+                    f"{flavor.name} hook {hook} ({entry}) threads pp "
+                    f"into {node.func.id} but the contract declares "
+                    f"{entry} placement-only — stage the program and "
+                    f"update ENTRY_CONTRACT together, or drop the "
+                    f"operand"))
+
     # -- guard discipline: hook call sites outside hooks ---------------
     for method in flavor.table:
         if method in TICK_HOOKS or method in PREFILL_HOOKS:
@@ -628,3 +756,44 @@ def cross_check_live() -> None:
                     f"jitted program {rel}:{name} is not registered in "
                     f"continuous._JIT_ENTRIES — the retrace counter "
                     f"cannot watch it")
+
+    # -- pipeline schedule mirror (round 21) ---------------------------
+    # the stdlib mirror and the live wavefront schedule must agree cell
+    # for cell, like mosaic's MAX_ROWS pin: the auditor's
+    # one-dispatch-per-stage proof is only as good as its schedule
+    from ..parallel import pipeline
+    for n_stages, n_micro in ((1, 1), (2, 2), (2, 4), (4, 2), (4, 4),
+                              (3, 5)):
+        mirror = pp_stage_schedule_mirror(n_stages, n_micro)
+        live = pipeline.pp_stage_schedule(n_stages, n_micro)
+        if tuple(live) != mirror:
+            raise DispatchDriftError(
+                f"pp_stage_schedule({n_stages}, {n_micro}) drifted "
+                f"from the audit mirror — edit "
+                f"parallel/pipeline.py and analysis/dispatch_audit.py "
+                f"together")
+        if audit_stage_schedule(live, n_stages, n_micro):
+            raise DispatchDriftError(
+                f"live pp_stage_schedule({n_stages}, {n_micro}) fails "
+                f"its own one-dispatch-per-stage audit")
+    # the contract's pp modes must match the live programs: a staged
+    # entry's jitted program accepts the static pp operand, a
+    # placement entry's does not
+    import inspect as _inspect
+    for entry, contract in ENTRY_CONTRACT.items():
+        # hook name -> program name: _step -> _tick, _step_n ->
+        # _tick_n, _step_mixed_spec -> _tick_mixed_spec, ...
+        prog_name = "_tick" + contract["steady"][len("_step"):]
+        prog = getattr(continuous, prog_name, None)
+        inner = getattr(prog, "__wrapped__", prog)
+        if inner is None:
+            raise DispatchDriftError(
+                f"no jitted program for contract entry {entry}")
+        has_pp = "pp" in _inspect.signature(inner).parameters
+        want = contract["pp"] == "staged"
+        if has_pp != want:
+            raise DispatchDriftError(
+                f"contract entry {entry} is pp={contract['pp']!r} but "
+                f"continuous.{inner.__name__} "
+                f"{'lacks' if want else 'takes'} the pp parameter — "
+                f"edit ENTRY_CONTRACT and the program together")

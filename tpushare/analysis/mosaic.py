@@ -363,6 +363,64 @@ def precheck_spec_paged(page: int, head_dim: int, quantized: bool, dtype,
         cross_check=cross_check)
 
 
+def precheck_pp_stage(n_layers: int, pp: int, tp: int = 1, sp: int = 1,
+                      rolling: bool = False,
+                      cross_check: bool = False) -> Verdict:
+    """Would the microbatched pipeline-stage decode program engage at
+    these parameters?  Stdlib mirror of the serving gate
+    (``ops.attention.pp_stage_fallback_reason``, round 21) — every
+    refusal here is STRUCTURAL (no Mosaic blocks to derive: the staged
+    program reuses the flat forwards per stage), so the verdict holds
+    on every platform:
+
+    * ``pp_layers`` — the stage count must divide the layer count (an
+      indivisible stack legalizes params/KV to replication, which
+      defeats stage-local residency; the serving demotion is
+      placement-only).
+    * ``pp_mesh`` — the staged shard_map program does not nest inside
+      the tp/sp shard_map read paths; a >1 tp or sp axis keeps the
+      flat program (placement still shards layers across pp).
+    * ``pp_storage`` — rolling storages (dense ring, windowed page
+      ring) evict in place; their write arithmetic couples rows across
+      wavefront ticks, which the stage-local microbatch slices cannot
+      honor.
+
+    ``cross_check=True`` additionally imports the live gate and raises
+    :class:`GateDriftError` on disagreement — NEVER pass it from a
+    drive's pre-dial precheck (it imports jax)."""
+    findings = []
+    reason = None
+    if pp > 1:
+        if n_layers % pp:
+            reason = "pp_layers"
+            findings.append(
+                f"layer count {n_layers} is not divisible by the stage "
+                f"count {pp}: stage-local params/KV would legalize to "
+                f"replication")
+        elif tp > 1 or sp > 1:
+            reason = "pp_mesh"
+            findings.append(
+                f"tp={tp} sp={sp}: the staged wavefront program does "
+                f"not nest inside the tp/sp shard_map read paths")
+        elif rolling:
+            reason = "pp_storage"
+            findings.append(
+                "rolling storage evicts in place — wavefront microbatch "
+                "slices cannot honor cross-row eviction arithmetic")
+    v = Verdict(ok=reason is None, reason=reason,
+                findings=tuple(findings), blocks=())
+    if cross_check:
+        from ..ops.attention import pp_stage_fallback_reason
+        gate = pp_stage_fallback_reason(n_layers, pp, tp=tp, sp=sp,
+                                        rolling=rolling)
+        if gate != v.reason:
+            raise GateDriftError(
+                f"verdict drift at n_layers={n_layers} pp={pp} tp={tp} "
+                f"sp={sp} rolling={rolling}: gate says {gate!r}, "
+                f"prechecker says {v.reason!r}")
+    return v
+
+
 def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
                        rows, tp, n_kv_heads, n_heads, assume_tpu,
                        sp=1, n_pages=0):

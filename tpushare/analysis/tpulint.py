@@ -728,6 +728,8 @@ table.
 | `jit-registry` | every `@jax.jit` definition in the serving modules is on the retrace watch list (`_JIT_ENTRIES` / `register_jit_entries`), so `tpushare_jit_retraces_total` sees every program |
 | `pacing-guard` | a tenant-policy pacing `acquire` (`*policy*`/`*pacer*` receivers) in the serving modules sits inside a `dispatch_guard` with-block and never inside a tick hook — the sanctioned pacing site is the guard's own pre-dispatch hook, an unguarded sleep stalls the loop invisibly, and the policy layer adds ZERO device dispatches |
 | `adapter-operand` | the multi-adapter operand helpers (`_adapter_operands`) are host-side handle passing ONLY — no jitted dispatch, no hook call, no host fetch may hide in operand prep: the per-row adapter gather is hook-interior (inside the hook's one jitted program), so the adapter plane adds ZERO dispatches per round |
+| `pp-thread` | each tick entry threads the static pipeline operand per its `ENTRY_CONTRACT` mode: staged entries (tick/tick_fused/tick_mixed) must pass `pp` to their hook's jitted program (dropping it silently serves a staged batcher through the flat program), placement entries (tick_spec/tick_mixed_spec) must NOT (spec serves staged models via GSPMD placement alone) — `dispatches_per_round` stays 1 at every pp because the wavefront is ONE SPMD dispatch |
+| `stage-dispatch` | the GPipe wavefront schedule executes each (stage, microbatch) cell EXACTLY once, ticks in order — `audit_stage_schedule` flags duplicate, dropped, out-of-range, and out-of-order cells; `pp_stage_schedule_mirror` (stdlib) is pinned against the live `parallel.pipeline.pp_stage_schedule` in `cross_check_live` |
 """
 
 

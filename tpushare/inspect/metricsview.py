@@ -144,6 +144,13 @@ def summarize_serving(parsed: dict) -> dict:
         # KV pages span (1 = unstriped; > 1 multiplies per-sequence
         # max context by the degree)
         "kv_stripe_shards": _gauge(parsed, "tpushare_kv_stripe_shards"),
+        # pipeline stages (round 21): how many stages the layer stack
+        # (params + stage-local KV) spans, and the static idle fraction
+        # of the microbatched decode wavefront (0 = unstaged or the
+        # stage program demoted to placement-only)
+        "pp_stages": _gauge(parsed, "tpushare_pp_stages"),
+        "pp_bubble_fraction": _gauge(parsed,
+                                     "tpushare_pp_bubble_fraction"),
         # mixed-step scheduler: mid-prefill queue depth and how full the
         # last round's coalesced prefill block was
         "prefill_queue": _gauge(parsed, "tpushare_prefill_queue_depth"),
@@ -342,13 +349,13 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "ATTN", "STRIPE", "SPEC", "ADAPTERS",
-              "PREFILL Q", "BUDGET%"]]
+              "KV BYTES(dtype)", "ATTN", "STRIPE", "STAGES", "SPEC",
+              "ADAPTERS", "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
                           "-", "-", "-", "-", "-", "-", "-", "-", "-",
-                          "-", "-"])
+                          "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -369,6 +376,16 @@ def render_metrics_table(
         stripe = "-"
         if summary.get("kv_stripe_shards"):
             stripe = f"x{int(summary['kv_stripe_shards'])}"
+        # STAGES: pipeline stages the layer stack spans, with the
+        # wavefront's static bubble fraction alongside when staged
+        # decode is live ("2 (bub 33%)"); a bare "x2"-style count with
+        # no bubble means placement-only (the stage program demoted)
+        stages = "-"
+        if summary.get("pp_stages") and summary["pp_stages"] > 1:
+            stages = f"{int(summary['pp_stages'])}"
+            if summary.get("pp_bubble_fraction"):
+                stages += (f" (bub "
+                           f"{summary['pp_bubble_fraction'] * 100:.0f}%)")
         # SPEC: tokens committed per verify round (the acceptance win),
         # with the skipped/disabled fallback count alongside so a
         # "spec on, nothing speculating" node explains itself
@@ -399,6 +416,7 @@ def render_metrics_table(
             kv_bytes,
             attn,
             stripe,
+            stages,
             spec,
             adapters,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),

@@ -677,6 +677,146 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
     return _head_mm(x, params["lm_head"])
 
 
+def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
+                      cache_len, mesh, n_micro: Optional[int] = None,
+                      axis_name: str = "pp",
+                      adapters=None, adapter_ids=None):
+    """One MICROBATCHED decode step over pipeline stages: the round-21
+    staged serving program (dense full-size caches).
+
+    tokens [B, S]; kv_caches the stacked pair from
+    :func:`init_kv_caches` (FULL-SIZE rows only — the ``pp_storage``
+    gate refuses rolling rings); cache_len [B].  Returns
+    (logits [B, S, vocab], updated caches) — the same signature as the
+    dense ``forward(..., cache_len=)`` tick, so the serving programs
+    route between the two per static ``pp`` argument.
+
+    ONE SPMD dispatch executes the whole GPipe wavefront
+    (``parallel.pipeline.pp_stage_schedule``): ``shard_map`` over the
+    ``pp`` axis alone, each stage owning its layer slice of params,
+    adapters, AND KV rows (in_specs shard dim 0 — the layer→stage
+    partition), a ``fori_loop`` over ``n_micro + pp - 1`` ticks where
+    stage s works microbatch ``t - s``, one ``ppermute`` activation hop
+    per tick.  Stage s therefore decodes microbatch m while stage s-1
+    decodes m+1 — the pipelining win.  Bubble ticks (m out of range)
+    compute a clipped microbatch and DISCARD both the activation and
+    the cache write-back (``jnp.where`` on the sliced rows), so storage
+    is touched exactly once per (stage, microbatch).
+
+    Exactness: microbatch splitting is row-local (every attention /
+    matmul row depends only on its own row), the layer order is the
+    sequential order, and the final ``psum`` broadcast adds exact
+    zeros (the ``pipeline_apply`` pattern) — streams equal the
+    unstaged ``forward`` bit-for-bit on the f32 config, and int8 KV
+    quantization stays append-only per row (the round-8 invariant).
+    """
+    from ..parallel.shardmap_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.tree_util as jtu
+
+    b, s = tokens.shape
+    n_stages = int(mesh.shape[axis_name])
+    n_micro = n_micro or n_stages
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} "
+                         f"microbatches")
+    mb = b // n_micro
+    cl = jnp.asarray(cache_len)
+    if cl.ndim == 0:
+        cl = jnp.broadcast_to(cl, (b,))
+    positions = cl[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
+    ck, cv = kv_caches
+
+    stage_spec = P(axis_name)
+    lspec = jtu.tree_map(lambda _: stage_spec, params["layers"])
+    adspec = jtu.tree_map(lambda _: stage_spec, ad_scan)
+    kspec = jtu.tree_map(lambda _: stage_spec, ck)
+    vspec = jtu.tree_map(lambda _: stage_spec, cv)
+    rep = P()
+    idspec = None if adapter_ids is None else rep
+
+    def stage_fn(layers_local, ad_local, ckl, cvl, x_all, pos_all,
+                 cl_all, ids_all):
+        stage = jax.lax.axis_index(axis_name)
+        d = x_all.shape[-1]
+        x_m = x_all.reshape(n_micro, mb, s, d)
+        buf = jnp.zeros((mb, s, d), x_all.dtype)
+        outs = jnp.zeros_like(x_m)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def run_stage(xin, ck_rows, cv_rows, pos, cl_rows, ids):
+            def body(h, layer_and):
+                layer, ad, ckr, cvr = layer_and
+                lora = None if ad is None else (ad, ad_scales, ids)
+                return _attn_ffn(
+                    layer, h, cfg,
+                    lambda lyr, xi: _attend_dense(
+                        lyr, xi, cfg, pos, kv_cache=(ckr, cvr),
+                        cache_len=cl_rows, lora=lora), lora=lora)
+
+            h, (nck, ncv) = jax.lax.scan(
+                body, xin, (layers_local, ad_local, ck_rows, cv_rows))
+            return h, nck, ncv
+
+        def step(t, carry):
+            buf, outs, ckl, cvl = carry
+            m = t - stage
+            active = (m >= 0) & (m < n_micro)
+            mc = jnp.clip(m, 0, n_micro - 1)
+            row0 = mc * mb
+            feed = jax.lax.dynamic_index_in_dim(x_m, mc, 0,
+                                                keepdims=False)
+            x_in = jnp.where(stage == 0, feed, buf)
+            rows = lambda store: _smap(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, row0, mb,
+                                                       axis=1), store)
+            ck_rows, cv_rows = rows(ckl), rows(cvl)
+            pos = jax.lax.dynamic_slice_in_dim(pos_all, row0, mb, 0)
+            cl_rows = jax.lax.dynamic_slice_in_dim(cl_all, row0, mb, 0)
+            ids = (None if ids_all is None
+                   else jax.lax.dynamic_slice_in_dim(ids_all, row0,
+                                                     mb, 0))
+            y, nck, ncv = run_stage(x_in, ck_rows, cv_rows, pos,
+                                    cl_rows, ids)
+            # bubble ticks recompute a clipped microbatch — discard
+            # the activation (never collected) AND the cache rows
+            keep = lambda new, old: _smap(
+                lambda n, o: jnp.where(active, n, o), new, old)
+            put = lambda store, new: _smap(
+                lambda c, n: jax.lax.dynamic_update_slice_in_dim(
+                    c, n, row0, axis=1), store, new)
+            ckl = put(ckl, keep(nck, ck_rows))
+            cvl = put(cvl, keep(ncv, cv_rows))
+            done_idx = t - (n_stages - 1)
+            outs = jnp.where(
+                (stage == n_stages - 1) & (done_idx >= 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(done_idx, 0, n_micro - 1), 0),
+                outs)
+            buf = jax.lax.ppermute(y, axis_name, perm)
+            return buf, outs, ckl, cvl
+
+        _, outs, ckl, cvl = jax.lax.fori_loop(
+            0, n_micro + n_stages - 1, step, (buf, outs, ckl, cvl))
+        outs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs,
+                      jnp.zeros_like(outs)), axis_name)
+        return outs, ckl, cvl
+
+    outs, new_ck, new_cv = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(lspec, adspec, kspec, vspec, rep, rep, rep, idspec),
+        out_specs=(rep, kspec, vspec), check_vma=False,
+    )(params["layers"], ad_scan, ck, cv, x, positions, cl, adapter_ids)
+
+    x = outs.reshape(b, s, x.shape[-1])
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    logits = _head_mm(x, params["lm_head"])
+    return logits, (new_ck, new_cv)
+
+
 def wants_rolling(cfg: ModelConfig) -> bool:
     """THE rolling-cache eligibility predicate (one place): a sliding-
     window config whose window is smaller than its context decodes from
@@ -1008,6 +1148,135 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
 
     x, (new_kp, new_vp) = jax.lax.scan(
         body, x, (params["layers"], ad_scan, kp, vp))
+    x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    logits = _head_mm(x, params["lm_head"])
+    return logits, (new_kp, new_vp)
+
+
+def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
+                            page_table, lengths, mesh,
+                            n_micro: Optional[int] = None,
+                            axis_name: str = "pp",
+                            adapters=None, adapter_ids=None):
+    """Microbatched pipeline twin of :func:`forward_paged_decode`:
+    one staged SPMD decode step against a LAYER-SHARDED paged pool.
+
+    Same wavefront as :func:`forward_pp_decode` — ``shard_map`` over
+    the ``pp`` axis, each stage owning its [L/pp, n_pages, Hkv, P, D]
+    pool slab (the layer→stage partition alongside the round-17
+    ``page_axis="sp"`` stripe; the ``pp_mesh`` gate keeps the two
+    programs from nesting), fori_loop ticks, one ppermute hop.  The
+    one paged wrinkle is bubble containment: a discarded microbatch's
+    scatter cannot be ``jnp.where``-masked after the fact (pages are
+    scattered, not sliced), so bubble ticks route their writes to the
+    TRASH page (page 0) — the same masked-garbage sink every paged
+    flavor already relies on — and real pages are written exactly once
+    per (stage, microbatch).  Reads route through
+    :func:`paged_attention` like every paged flavor (``mesh=None``
+    inside the body: the stage IS the shard).
+    """
+    from ..parallel.shardmap_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.tree_util as jtu
+
+    b, s = tokens.shape
+    n_stages = int(mesh.shape[axis_name])
+    n_micro = n_micro or n_stages
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} "
+                         f"microbatches")
+    mb = b // n_micro
+    positions = lengths[:, None] + jnp.arange(s)[None, :]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    kp, vp = pools
+    page = _kv_leaf(kp).shape[3]
+    page_ids = jnp.take_along_axis(
+        page_table, (lengths // page)[:, None], axis=1)[:, 0]
+    offsets = lengths % page
+    ad_scan, ad_scales = _adapter_scan_split(adapters)
+
+    stage_spec = P(axis_name)
+    lspec = jtu.tree_map(lambda _: stage_spec, params["layers"])
+    adspec = jtu.tree_map(lambda _: stage_spec, ad_scan)
+    kspec = jtu.tree_map(lambda _: stage_spec, kp)
+    vspec = jtu.tree_map(lambda _: stage_spec, vp)
+    rep = P()
+    idspec = None if adapter_ids is None else rep
+    tbl = jnp.asarray(page_table, jnp.int32)
+
+    def stage_fn(layers_local, ad_local, kpl, vpl, x_all, pos_all,
+                 tbl_all, pid_all, off_all, ids_all):
+        stage = jax.lax.axis_index(axis_name)
+        d = x_all.shape[-1]
+        x_m = x_all.reshape(n_micro, mb, s, d)
+        buf = jnp.zeros((mb, s, d), x_all.dtype)
+        outs = jnp.zeros_like(x_m)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def run_stage(xin, kpl, vpl, pos, tblm, pid_w, offm, ids):
+            def body(h, layer_and):
+                layer, ad, kpool, vpool = layer_and
+                lora = None if ad is None else (ad, ad_scales, ids)
+
+                def attend(lyr, xi):
+                    q, k, v = _qkv(lyr, xi, cfg, pos, lora=lora)
+                    k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
+                    kp2 = _smap(lambda c, n: c.at[pid_w, :, offm, :]
+                                .set(n[:, :, 0, :]), kpool, k_st)
+                    vp2 = _smap(lambda c, n: c.at[pid_w, :, offm, :]
+                                .set(n[:, :, 0, :]), vpool, v_st)
+                    o = paged_attention(q, kp2, vp2, tblm, pos, cfg,
+                                        mesh=None)
+                    return o, (kp2, vp2)
+
+                return _attn_ffn(layer, h, cfg, attend, lora=lora)
+
+            h, (nkp, nvp) = jax.lax.scan(
+                body, xin, (layers_local, ad_local, kpl, vpl))
+            return h, nkp, nvp
+
+        def step(t, carry):
+            buf, outs, kpl, vpl = carry
+            m = t - stage
+            active = (m >= 0) & (m < n_micro)
+            mc = jnp.clip(m, 0, n_micro - 1)
+            row0 = mc * mb
+            feed = jax.lax.dynamic_index_in_dim(x_m, mc, 0,
+                                                keepdims=False)
+            x_in = jnp.where(stage == 0, feed, buf)
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, row0, mb, 0)
+            pos, tblm, offm = sl(pos_all), sl(tbl_all), sl(off_all)
+            # bubble ticks scatter to the trash page instead of a real
+            # page — there is no post-hoc mask for a scatter
+            pid_w = jnp.where(active, sl(pid_all), 0)
+            ids = None if ids_all is None else sl(ids_all)
+            y, kpl, vpl = run_stage(x_in, kpl, vpl, pos, tblm, pid_w,
+                                    offm, ids)
+            done_idx = t - (n_stages - 1)
+            outs = jnp.where(
+                (stage == n_stages - 1) & (done_idx >= 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(done_idx, 0, n_micro - 1), 0),
+                outs)
+            buf = jax.lax.ppermute(y, axis_name, perm)
+            return buf, outs, kpl, vpl
+
+        _, outs, kpl, vpl = jax.lax.fori_loop(
+            0, n_micro + n_stages - 1, step, (buf, outs, kpl, vpl))
+        outs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs,
+                      jnp.zeros_like(outs)), axis_name)
+        return outs, kpl, vpl
+
+    outs, new_kp, new_vp = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(lspec, adspec, kspec, vspec, rep, rep, rep, rep, rep,
+                  idspec),
+        out_specs=(rep, kspec, vspec), check_vma=False,
+    )(params["layers"], ad_scan, kp, vp, x, positions, tbl, page_ids,
+      offsets, adapter_ids)
+
+    x = outs.reshape(b, s, x.shape[-1])
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x, params["lm_head"])
     return logits, (new_kp, new_vp)

@@ -83,6 +83,41 @@ SHARDING_RULES: List[Tuple[str, P]] = [
 
 
 
+def stage_layer_ranges(n_layers: int, pp: int) -> Tuple[Tuple[int, int], ...]:
+    """``((start, stop), ...)`` layer slice per pipeline stage.
+
+    Even split when ``pp`` divides ``n_layers``; otherwise the remainder
+    goes to the EARLIEST stages (matching how a leading layer-axis
+    sharding would legalize to replication — callers gate the staged
+    program on divisibility via ``pp_stage_fallback_reason``, this
+    helper still answers for the storage_info/docs view).
+    """
+    if pp <= 0:
+        raise ValueError(f"pp must be positive, got {pp}")
+    base, rem = divmod(n_layers, pp)
+    out, start = [], 0
+    for s in range(pp):
+        n = base + (1 if s < rem else 0)
+        out.append((start, start + n))
+        start += n
+    return tuple(out)
+
+
+def _with_layer_axis(spec: P, shape: Tuple[int, ...],
+                     layer_axis: str) -> P:
+    """Prepend ``layer_axis`` on dim 0 of a stacked [L, ...] leaf's
+    spec (right-aligned like :func:`_legalize`; an explicit dim-0 entry
+    from the rule wins)."""
+    entries = list(spec)
+    if len(entries) < len(shape):
+        entries = [None] * (len(shape) - len(entries)) + entries
+    elif len(entries) > len(shape):
+        entries = entries[len(entries) - len(shape):]
+    if entries and entries[0] is None:
+        entries[0] = layer_axis
+    return P(*entries)
+
+
 def spec_for(path: str, rules: Sequence[Tuple[str, P]] = SHARDING_RULES) -> P:
     from ..utils.treepath import leaf_key, param_key
 
@@ -102,12 +137,24 @@ def spec_for(path: str, rules: Sequence[Tuple[str, P]] = SHARDING_RULES) -> P:
 
 
 def shard_params(params, mesh: Mesh,
-                 rules: Sequence[Tuple[str, P]] = SHARDING_RULES):
+                 rules: Sequence[Tuple[str, P]] = SHARDING_RULES,
+                 layer_axis: Optional[str] = None):
     """Place a param pytree onto the mesh (rule entries naming axes the
-    mesh lacks are dropped by legalization)."""
+    mesh lacks are dropped by legalization).
+
+    ``layer_axis`` additionally shards the leading stacked-layer dim of
+    every ``layers/...`` leaf over that mesh axis — the round-21
+    layer→stage partition: stage s holds only its own layers'
+    parameters.  Non-stacked leaves (embed, lm_head, final norms) stay
+    replicated across stages; an indivisible layer count legalizes back
+    to replication like every other rule.
+    """
 
     def _place(path, leaf):
-        spec = spec_for(jax.tree_util.keystr(path), rules)
+        key = jax.tree_util.keystr(path)
+        spec = spec_for(key, rules)
+        if layer_axis and "layers" in key:
+            spec = _with_layer_axis(spec, leaf.shape, layer_axis)
         # Drop axes the array is too small to shard cleanly.
         spec = _legalize(spec, leaf.shape, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -116,11 +163,15 @@ def shard_params(params, mesh: Mesh,
 
 
 def param_shardings(params, mesh: Mesh,
-                    rules: Sequence[Tuple[str, P]] = SHARDING_RULES):
+                    rules: Sequence[Tuple[str, P]] = SHARDING_RULES,
+                    layer_axis: Optional[str] = None):
     """NamedSharding pytree (for jit in_shardings) without moving data."""
 
     def _spec(path, leaf):
-        spec = spec_for(jax.tree_util.keystr(path), rules)
+        key = jax.tree_util.keystr(path)
+        spec = spec_for(key, rules)
+        if layer_axis and "layers" in key:
+            spec = _with_layer_axis(spec, leaf.shape, layer_axis)
         return NamedSharding(mesh, _legalize(spec, leaf.shape, mesh))
 
     return jax.tree_util.tree_map_with_path(_spec, params)
@@ -157,7 +208,8 @@ def _legalize(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
 
 
 def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp",
-                     page_axis: Optional[str] = None):
+                     page_axis: Optional[str] = None,
+                     layer_axis: Optional[str] = None):
     """Place stacked KV serving storage onto the mesh, sharded on the
     kv-head dim.
 
@@ -178,15 +230,25 @@ def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp",
     axis size.  Same divisibility legalization: an indivisible page
     count replicates, and the read dispatcher's ``sp_pool`` gate
     degrades to the unsharded paths.
+
+    ``layer_axis`` (round 21) shards dim 0 — the stacked LAYER dim in
+    both layouts — so each pipeline stage holds only its own layers'
+    KV: the ``layer→stage`` partition riding alongside the
+    ``page_axis="sp"`` stripe.  Same legalization: an indivisible layer
+    count replicates and the ``pp_layers`` gate demotes the staged
+    program.
     """
     page_entry = page_axis if (page_axis and page_axis
                                in mesh.axis_names) else None
     head_entry = axis if axis in mesh.axis_names else None
-    if page_entry is None and head_entry is None:
+    layer_entry = layer_axis if (layer_axis and layer_axis
+                                 in mesh.axis_names) else None
+    if page_entry is None and head_entry is None and layer_entry is None:
         return storage
 
     def _place(leaf):
-        spec = _legalize(P(None, page_entry, head_entry, None, None),
+        spec = _legalize(P(layer_entry, page_entry, head_entry,
+                           None, None),
                          leaf.shape, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
@@ -200,7 +262,8 @@ def shard_kv_storage(storage, mesh: Mesh, axis: str = "tp",
 _ADAPTER_COL_TARGETS = ("wq", "wk", "wv", "w_gate", "w_up")
 
 
-def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp"):
+def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp",
+                       layer_axis: Optional[str] = None):
     """Place a stacked serving LoRA pool (:func:`tpushare.ops.lora
     .init_adapter_pool_arrays`) onto the mesh with each adapter leaf
     sharded LIKE ITS BASE projection: column-parallel targets shard
@@ -211,8 +274,13 @@ def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp"):
     the rank dim, the scale vector, the [N] pool axis — replicates
     (rank is tiny; sharding the POOL axis would turn every per-row
     gather into a cross-shard shuffle).  Same divisibility
-    legalization as :func:`shard_params`."""
-    if axis not in mesh.axis_names:
+    legalization as :func:`shard_params`.
+
+    ``layer_axis`` shards the stacked [L, ...] leading dim of every
+    adapter leaf like :func:`shard_params` does for the base layers —
+    a pipeline stage holds only its own layers' adapter slices."""
+    if axis not in mesh.axis_names and not (
+            layer_axis and layer_axis in mesh.axis_names):
         return pool
     out = {}
     for name, leaves in pool.items():
@@ -228,6 +296,8 @@ def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp"):
                 spec = P(None, None, axis, None)
             else:
                 spec = P()
+            if layer_axis:
+                spec = _with_layer_axis(spec, leaf.shape, layer_axis)
             placed[key] = jax.device_put(
                 leaf, NamedSharding(mesh, _legalize(spec, leaf.shape,
                                                     mesh)))

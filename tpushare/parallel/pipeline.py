@@ -48,6 +48,38 @@ from .shardmap_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def pp_stage_schedule(n_stages: int, n_micro: int):
+    """The GPipe wavefront as a static table: ``((t, s, m), ...)`` —
+    at tick t stage s works microbatch m = t - s, for every tick where
+    0 <= m < n_micro.  ``n_micro + n_stages - 1`` ticks total; each
+    (stage, microbatch) pair appears EXACTLY once — that uniqueness IS
+    the per-stage one-dispatch-per-round invariant the round-21 serving
+    pipeline is audited against (``analysis.dispatch_audit`` mirrors
+    this function stdlib-side and cross-checks the two, exactly like
+    mosaic mirrors the kernel gates).  The serving decode program
+    (:func:`tpushare.models.transformer.forward_pp_decode`) executes
+    this same schedule inside ONE SPMD dispatch via fori_loop +
+    ppermute; the bench proxy replays it with per-entry dispatch costs.
+    """
+    if n_stages < 1 or n_micro < 1:
+        raise ValueError(f"need n_stages >= 1 and n_micro >= 1, got "
+                         f"({n_stages}, {n_micro})")
+    return tuple((t, s, t - s)
+                 for t in range(n_micro + n_stages - 1)
+                 for s in range(n_stages)
+                 if 0 <= t - s < n_micro)
+
+
+def pp_bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """GPipe bubble share of the wavefront: idle (stage, tick) cells
+    over all cells — ``(S-1)/(M+S-1)``.  0.0 at S=1.  The serving
+    gauge ``tpushare_pp_bubble_fraction`` reports this for the engaged
+    staged program."""
+    if n_stages <= 1:
+        return 0.0
+    return (n_stages - 1) / (n_micro + n_stages - 1)
+
+
 def pipeline_apply(layer_fn: Callable, stacked_params, x_micro,
                    mesh: Mesh, axis_name: str = "pp"):
     """Run microbatches through layer stages spread over ``axis_name``.

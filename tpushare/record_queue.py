@@ -48,6 +48,7 @@ MANIFEST: List[Tuple[str, str]] = [
     ("drive_kv_quant.py", "KV_QUANT_TPU.json"),
     ("drive_prefix_cache.py", "PREFIX_CACHE_TPU.json"),
     ("drive_lora_gather.py", "LORA_GATHER_TPU.json"),
+    ("drive_pp_decode.py", "PP_DECODE_TPU.json"),
 ]
 
 

@@ -112,7 +112,7 @@ class AdapterPool:
 
     def __init__(self, cfg, rank: int, n_slots: int, mesh=None,
                  loader: Optional[Callable[[str], Dict]] = None,
-                 dtype=None):
+                 dtype=None, layer_axis=None):
         if n_slots < 1:
             raise ValueError("adapter pool needs >= 1 named slot")
         self.cfg = cfg
@@ -124,7 +124,8 @@ class AdapterPool:
             cfg, self.rank, self.n_slots + 1, dtype=self._dtype)
         if mesh is not None:
             from ..parallel.mesh import shard_adapter_pool
-            self._pool = shard_adapter_pool(self._pool, mesh)
+            self._pool = shard_adapter_pool(self._pool, mesh,
+                                            layer_axis=layer_axis)
         self._by_name: Dict[str, int] = {}
         #: idx -> {"name", "refs", "last_used"} for rows 1..n_slots
         self._rows: Dict[int, dict] = {

@@ -144,10 +144,29 @@ def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
     return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "rich"),
+def _pp_forward(params, tokens, caches, lengths, cfg, pp,
+                adapters=None, aids=None):
+    """The ONE dense decode-forward routing point for the round-21
+    pipeline: ``pp`` is the hashable static ``(mesh, n_micro)`` pair
+    (None = the exact pre-pp trace — byte-identity by construction).
+    When set, the step runs :func:`transformer.forward_pp_decode` —
+    the whole GPipe wavefront inside this same single dispatch, each
+    stage decoding its microbatch against its LOCAL layer slice of
+    params and KV rows."""
+    if pp is None:
+        return transformer.forward(
+            params, tokens, cfg, kv_caches=caches, cache_len=lengths,
+            adapters=adapters, adapter_ids=aids)
+    mesh, n_micro = pp
+    return transformer.forward_pp_decode(
+        params, tokens, cfg, caches, lengths, mesh, n_micro=n_micro,
+        adapters=adapters, adapter_ids=aids)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "rich", "pp"),
                    donate_argnums=(2,))
 def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
-          rich: bool = False, adapters=None, aids=None):
+          rich: bool = False, adapters=None, aids=None, pp=None):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
     Per-slot sampling via :func:`_sample_next` — greedy and sampling
@@ -155,11 +174,12 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
     top-k/top-p filter only when some live slot uses it, so plain
     greedy/temperature serving never pays the [B, V] sort.  The pooled
     cache is donated: XLA updates it in place instead of holding two
-    full copies across the hot loop.
+    full copies across the hot loop.  ``pp`` (static; see
+    :func:`_pp_forward`) swaps the forward for the staged pipeline
+    program — None traces byte-identically to the pre-pp tick.
     """
-    logits, caches = transformer.forward(
-        params, tokens, cfg, kv_caches=caches, cache_len=lengths,
-        adapters=adapters, adapter_ids=aids)
+    logits, caches = _pp_forward(params, tokens, caches, lengths, cfg,
+                                 pp, adapters=adapters, aids=aids)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
     return nxt, caches
@@ -167,17 +187,19 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
 
 def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
                  incs, cfg, n: int, rich: bool, adapters=None,
-                 aids=None):
+                 aids=None, pp=None):
     """The fused decode scan BODY (trace-level, not jitted itself) —
     the one definition shared by :func:`_tick_n` and the mixed-step
     program :func:`_tick_mixed`, so the two dispatch flavors cannot
-    drift.  See :func:`_tick_n` for the semantics contract."""
+    drift.  See :func:`_tick_n` for the semantics contract.  ``pp``
+    routes each step's forward through :func:`_pp_forward` — the
+    staged program runs INSIDE the scan body, so the fused round stays
+    one dispatch."""
     def body(carry, _):
         tok, caches, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
-        logits, caches = transformer.forward(
-            params, tok, cfg, kv_caches=caches, cache_len=lengths,
-            adapters=adapters, adapter_ids=aids)
+        logits, caches = _pp_forward(params, tok, caches, lengths, cfg,
+                                     pp, adapters=adapters, aids=aids)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
         return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
@@ -187,10 +209,11 @@ def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
     return toks.T, keys, caches
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "pp"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
-            cfg, n: int, rich: bool = False, adapters=None, aids=None):
+            cfg, n: int, rich: bool = False, adapters=None, aids=None,
+            pp=None):
     """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
     round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
     per token, the same fusion :func:`tpushare.serving.generate
@@ -220,16 +243,16 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     """
     return _decode_scan(params, tokens, caches, lengths, temps, keys,
                         tks, tps, incs, cfg, n, rich, adapters=adapters,
-                        aids=aids)
+                        aids=aids, pp=pp)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
-                                             "rich"),
+                                             "rich", "pp"),
                    donate_argnums=(7,))
 def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
                 src_mask, caches, tokens, lengths, temps, keys, tks, tps,
                 incs, cfg, chunk_len: int, n: int, rich: bool = False,
-                adapters=None, aids=None, p_aids=None):
+                adapters=None, aids=None, p_aids=None, pp=None):
     """ONE device program per mixed service round: (a) the pending
     chunks of up to R mid-prefill slots coalesced into a single batched,
     padded prefill forward, then (b) the fused ``n``-step decode scan
@@ -281,7 +304,7 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
     toks, keys, caches = _decode_scan(
         params, tokens, caches, lengths, temps, keys, tks, tps, incs,
-        cfg, n, rich, adapters=adapters, aids=aids)
+        cfg, n, rich, adapters=adapters, aids=aids, pp=pp)
     return sel, toks, keys, caches
 
 
@@ -507,7 +530,8 @@ class ContinuousBatcher:
     def __init__(self, params, cfg: transformer.ModelConfig, n_slots: int,
                  mesh=None, rolling_slots: Optional[bool] = None,
                  spec_k: int = 0, adapter_slots: int = 0,
-                 adapter_rank: int = 8, adapter_loader=None):
+                 adapter_rank: int = 8, adapter_loader=None,
+                 pp: int = 1, pp_microbatches: Optional[int] = None):
         """``mesh``: optional ``jax.sharding.Mesh`` for tensor-parallel
         serving — params take the Megatron tp layout
         (:func:`tpushare.parallel.mesh.shard_params`) and KV storage
@@ -538,7 +562,21 @@ class ContinuousBatcher:
         every tick flavor gathers each row's adapter inside its ONE
         jitted dispatch, and streams for adapter-0 (base) rows stay
         bit-identical to a pool-less batcher's.  0 (default) threads
-        None everywhere — the byte-identical pre-adapter programs."""
+        None everywhere — the byte-identical pre-adapter programs.
+
+        ``pp > 1`` serves pipeline-parallel (round 21): the mesh's
+        ``pp`` axis partitions the LAYER dim of params, KV storage, and
+        the adapter pool (stage-local residency via GSPMD placement —
+        value-preserving, so streams are exact), and the steady decode
+        step runs the explicit microbatched wavefront program
+        (:func:`tpushare.models.transformer.forward_pp_decode`: stage s
+        decodes microbatch m while stage s-1 decodes m+1, ONE host
+        dispatch per round).  ``pp_microbatches`` fixes the microbatch
+        count (must divide ``n_slots``); default = largest divisor of
+        ``n_slots`` that is <= ``pp``.  Structural refusals
+        (:func:`tpushare.ops.attention.pp_stage_fallback_reason`:
+        ``pp_layers``/``pp_mesh``/``pp_storage``) DEMOTE the staged
+        program to placement-only — counted, never a crash."""
         self.mesh = mesh
         self.spec_k = max(0, int(spec_k))
         if rolling_slots is None:
@@ -553,9 +591,51 @@ class ContinuousBatcher:
             # positional-masking containment story
             rolling_slots = False
         self.rolling_slots = bool(rolling_slots)
+        self.pp = max(1, int(pp))
+        self._pp_reason = None
+        self._pp_args = None
+        self.pp_microbatches = None
+        if self.pp > 1:
+            from ..ops.attention import (pp_stage_fallback_reason,
+                                         tp_degree, count_attn_fallback)
+            if mesh is None or "pp" not in mesh.axis_names:
+                raise ValueError("pp > 1 needs a mesh with a 'pp' axis")
+            if mesh.shape["pp"] != self.pp:
+                raise ValueError(
+                    f"mesh 'pp' axis has {mesh.shape['pp']} devices, "
+                    f"batcher asked pp={self.pp}")
+            if pp_microbatches is not None:
+                if n_slots % pp_microbatches:
+                    raise ValueError(
+                        f"pp_microbatches={pp_microbatches} must divide "
+                        f"n_slots={n_slots}")
+                n_micro = int(pp_microbatches)
+            else:
+                # largest divisor of n_slots that keeps the wavefront
+                # no deeper than the stage count (bubble fraction
+                # (pp-1)/(m+pp-1) only improves with more microbatches,
+                # but m > pp buys nothing at decode's uniform cost)
+                n_micro = max(m for m in range(1, min(self.pp, n_slots) + 1)
+                              if n_slots % m == 0)
+            self.pp_microbatches = n_micro
+            self._pp_reason = pp_stage_fallback_reason(
+                cfg.n_layers, self.pp, tp=tp_degree(mesh, "tp"),
+                sp=tp_degree(mesh, "sp"),
+                rolling=self._pp_rolling_storage(cfg))
+            if self._pp_reason is None:
+                self._pp_args = (mesh, n_micro)
+            else:
+                # structural demotion to placement-only pipeline
+                # parallelism: layers still shard over the pp axis (the
+                # partitioner legalizes what it must), the staged
+                # wavefront program stays off — counted like every
+                # other kernel-path demotion
+                count_attn_fallback(self._pp_reason)
         if mesh is not None:
             from ..parallel.mesh import shard_params
-            params = shard_params(params, mesh)
+            params = shard_params(
+                params, mesh,
+                layer_axis="pp" if "pp" in mesh.axis_names else None)
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -569,7 +649,9 @@ class ContinuousBatcher:
             from .adapters import AdapterPool
             self.adapter_pool = AdapterPool(
                 cfg, adapter_rank, adapter_slots, mesh=mesh,
-                loader=adapter_loader)
+                loader=adapter_loader,
+                layer_axis=("pp" if mesh is not None
+                            and "pp" in mesh.axis_names else None))
         self._slot_adapter: Dict[int, int] = {}
         self.slots: Dict[int, _Slot] = {}      # slot index -> live request
         self.prefilling: Dict[int, _Prefill] = {}   # slot -> mid-prefill
@@ -613,6 +695,9 @@ class ContinuousBatcher:
         metrics.ATTN_KERNEL_INFO.set(
             1, attn_kernel=info.get("attn_kernel", "xla"))
         metrics.KV_STRIPE_SHARDS.set(info.get("sp_shards", 1))
+        metrics.PP_STAGES.set(info.get("pp_stages", 1))
+        metrics.PP_BUBBLE_FRACTION.set(
+            info.get("pp_bubble_fraction", 0.0))
 
     def _observe_tick(self, t0: float) -> None:
         """Record one tick's wall time and the post-tick occupancy."""
@@ -730,7 +815,10 @@ class ContinuousBatcher:
             ring_slack=self.spec_k)
         if self.mesh is not None:
             from ..parallel.mesh import shard_kv_storage
-            self.caches = shard_kv_storage(self.caches, self.mesh)
+            self.caches = shard_kv_storage(
+                self.caches, self.mesh,
+                layer_axis=("pp" if "pp" in self.mesh.axis_names
+                            else None))
 
     def storage_info(self) -> dict:
         """HBM accounting for the slot pool: what one slot costs and how
@@ -757,11 +845,45 @@ class ContinuousBatcher:
                 "bytes_per_slot": int(bytes_per_slot),
                 "slots_per_gib": (2 ** 30) // bytes_per_slot,
                 "pool_bytes": int(bytes_per_slot * self.n_slots)}
+        info.update(self._pp_storage_info(info["pool_bytes"]))
         if self.adapter_pool is not None:
             # the SECOND HBM pool class (round 20): adapter residency
             # economics next to the KV pool's
             info.update(self.adapter_pool.storage_info())
         return info
+
+    def _pp_storage_info(self, pool_bytes: int) -> dict:
+        """Pipeline-stage residency economics (round 21), shared by the
+        dense and paged ``storage_info``: how the layer partition
+        splits the KV pool across stages.  A layer count the stage
+        count does not divide legalizes to REPLICATION (every stage
+        holds the whole pool — and the staged program is refused with
+        ``pp_layers``), so per-stage bytes only shrink when the
+        partition is real."""
+        from ..parallel.mesh import stage_layer_ranges
+        from ..parallel.pipeline import pp_bubble_fraction
+        pp = self.pp
+        divides = self.cfg.n_layers % pp == 0
+        info = {"pp_stages": pp,
+                "pool_bytes_per_stage": int(
+                    pool_bytes // pp if divides else pool_bytes),
+                "stage_layer_ranges": stage_layer_ranges(
+                    self.cfg.n_layers, pp)}
+        if pp > 1:
+            info["pp_fallback_reason"] = self._pp_reason
+            info["pp_microbatches"] = self.pp_microbatches
+        info["pp_bubble_fraction"] = (
+            pp_bubble_fraction(pp, self.pp_microbatches)
+            if self._pp_args is not None else 0.0)
+        return info
+
+    def _pp_rolling_storage(self, cfg) -> bool:
+        """Whether this storage recycles KV in place (the ``pp_storage``
+        structural gate): a rolling write's eviction arithmetic couples
+        rows across wavefront ticks, which the stage-local microbatch
+        slices cannot honor.  The paged subclass adds the windowed page
+        ring."""
+        return self.rolling_slots
 
     def _reserve(self, slot: int, prompt_len: int, max_new: int,
                  prompt: Optional[List[int]] = None) -> bool:
@@ -795,7 +917,8 @@ class ContinuousBatcher:
         adapters, aids = self._adapter_operands(ads)
         nxt, self.caches = _tick(
             self.params, tokens, self.caches, lengths, temps, keys,
-            tks, tps, self.cfg, rich, adapters=adapters, aids=aids)
+            tks, tps, self.cfg, rich, adapters=adapters, aids=aids,
+            pp=self._pp_args)
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
@@ -804,7 +927,7 @@ class ContinuousBatcher:
         toks, keys, self.caches = _tick_n(
             self.params, tokens, self.caches, lengths, temps, keys,
             tks, tps, incs, self.cfg, n_steps, rich, adapters=adapters,
-            aids=aids)
+            aids=aids, pp=self._pp_args)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -1423,7 +1546,7 @@ class ContinuousBatcher:
             jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
             tokens, lengths, temps, keys, tks, tps, incs,
             self.cfg, chunk_len, n_steps, rich, adapters=adapters,
-            aids=aids, p_aids=p_aids)
+            aids=aids, p_aids=p_aids, pp=self._pp_args)
         return sel, toks, keys
 
     def _mixed_src(self, p_slots, p_active):
@@ -2022,7 +2145,9 @@ class ContinuousService:
                  spill_bytes: Optional[int] = None,
                  policy=None,
                  adapter_slots: int = 0,
-                 adapter_rank: int = 8):
+                 adapter_rank: int = 8,
+                 pp: int = 1,
+                 pp_microbatches: Optional[int] = None):
         import os as _os
         import queue as _q
         import threading
@@ -2104,7 +2229,8 @@ class ContinuousService:
                 params, cfg, n_slots, page_size=page_size, n_pages=n_pages,
                 mesh=mesh, max_prefill_chunk=self._prefill_chunk,
                 prefix_cache=prefix_cache, spec_k=self._spec_k,
-                adapter_slots=adapter_slots, adapter_rank=adapter_rank)
+                adapter_slots=adapter_slots, adapter_rank=adapter_rank,
+                pp=pp, pp_microbatches=pp_microbatches)
         else:
             if prefix_cache:
                 raise ValueError("prefix_cache rides the paged pool; "
@@ -2113,7 +2239,9 @@ class ContinuousService:
                                               mesh=mesh,
                                               spec_k=self._spec_k,
                                               adapter_slots=adapter_slots,
-                                              adapter_rank=adapter_rank)
+                                              adapter_rank=adapter_rank,
+                                              pp=pp,
+                                              pp_microbatches=pp_microbatches)
         if self._spec_k:
             # the REAL capability check (replaced the round-5 dense-only
             # refusal): a storage that cannot contain a k-token rejected

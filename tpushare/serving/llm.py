@@ -116,6 +116,8 @@ class LLMServer:
                  n_pages: int = 0,
                  tp: int = 0,
                  sp: int = 0,
+                 pp: int = 0,
+                 pp_microbatches: int = 0,
                  spec_k: int = 0,
                  prefix_cache: bool = False,
                  prefill_budget: int = 0,
@@ -188,6 +190,11 @@ class LLMServer:
             raise ValueError("sp > 1 requires n_slots > 0 and "
                              "page_size > 0 (position striping is a "
                              "paged-pool feature)")
+        if pp > 1 and n_slots <= 0:
+            # only the batcher path is mesh-aware, same rule as tp
+            raise ValueError("pp > 1 requires n_slots > 0 (pipeline-"
+                             "parallel serving rides the continuous "
+                             "batcher)")
         # attn_kernel="pallas" + tp > 1 is served: the paged dispatcher
         # shard_maps the kernel over the tp axis (whole GQA head groups
         # per shard; ops.attention.sharded_paged_decode_attention) and
@@ -198,13 +205,15 @@ class LLMServer:
             from .continuous import ContinuousService
 
             mesh = None
-            if tp > 1 or sp > 1:
+            if tp > 1 or sp > 1 or pp > 1:
                 from ..parallel.mesh import make_mesh
                 axes = {}
                 if tp > 1:
                     axes["tp"] = tp
                 if sp > 1:
                     axes["sp"] = sp     # position striping (round 17)
+                if pp > 1:
+                    axes["pp"] = pp     # pipeline stages (round 21)
                 mesh = make_mesh(axes)
             self._service = ContinuousService(
                 params, cfg, n_slots,
@@ -219,7 +228,9 @@ class LLMServer:
                 policy=(policy_client.pacer
                         if policy_client is not None else None),
                 adapter_slots=adapter_slots,
-                adapter_rank=adapter_rank).start()
+                adapter_rank=adapter_rank,
+                pp=max(1, pp),
+                pp_microbatches=pp_microbatches or None).start()
             # Operator-visible kernel demotion (round 17 satellite): a
             # pallas config whose pool fails a viability gate (e.g. a
             # page_size=16 int8 pool's 32-row sublane tile) serves the
@@ -235,6 +246,16 @@ class LLMServer:
                     "tpushare_attn_kernel_fallback_total{reason=%r} "
                     "and the ATTN column in `kubectl inspect tpushare "
                     "--metrics`", reason, reason)
+            pp_reason = info.get("pp_fallback_reason")
+            if pp_reason:
+                log.warning(
+                    "pp=%d cannot run the microbatched stage program "
+                    "on this config (reason=%s): layers still place "
+                    "across the pp axis but every round runs the flat "
+                    "program — see tpushare_attn_kernel_fallback_total"
+                    "{reason=%r} and the STAGES column in `kubectl "
+                    "inspect tpushare --metrics`", pp, pp_reason,
+                    pp_reason)
         if policy_client is not None and self._service is None:
             # per-request mode has no service lifecycle to ride: arm
             # the dispatch-guard pacer directly (the slot-pool path
@@ -1018,6 +1039,24 @@ def main(argv=None) -> int:
                          "--attn-kernel pallas (per-shard page walk + "
                          "online-softmax merge), --spec-k, and "
                          "session migration")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline-parallel stage count: partition the "
+                         "layer stack (params AND each layer's KV "
+                         "storage — stage-local residency) across this "
+                         "many mesh shards, and run the steady decode "
+                         "step as a microbatched stage wavefront in "
+                         "ONE dispatch per round (stage s decodes "
+                         "microbatch m while stage s-1 decodes m+1).  "
+                         "Requires --slots; streams are exactly the "
+                         "unstaged server's.  Layer counts the stage "
+                         "count does not divide, a >1 --tp/--sp axis, "
+                         "or a rolling storage demote the wavefront to "
+                         "placement-only sharding (counted, logged at "
+                         "startup, still served)")
+    ap.add_argument("--pp-microbatches", type=int, default=0,
+                    help="microbatch count for the --pp wavefront (must "
+                         "divide --slots; 0 = largest divisor of "
+                         "--slots that is <= --pp)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="prompt-lookup speculation depth (0 = off; "
                          "greedy-exact; requires --slots).  Works on "
@@ -1121,6 +1160,12 @@ def main(argv=None) -> int:
         ap.error("--tp requires --slots")
     if args.sp > 1 and not (args.slots and args.page_size):
         ap.error("--sp requires --slots and --page-size")
+    if args.pp > 1 and not args.slots:
+        ap.error("--pp requires --slots")
+    if args.pp_microbatches and args.pp <= 1:
+        ap.error("--pp-microbatches requires --pp")
+    if args.pp_microbatches and args.slots % args.pp_microbatches:
+        ap.error("--pp-microbatches must divide --slots")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -1171,6 +1216,7 @@ def main(argv=None) -> int:
     srv = LLMServer(cfg, params, port=args.port, addr=args.addr,
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp, sp=args.sp,
+                    pp=args.pp, pp_microbatches=args.pp_microbatches,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill,
@@ -1198,10 +1244,11 @@ def main(argv=None) -> int:
                          name="tpushare-usage-report").start()
         log.info("usage reporting to daemon every %.0fs (policy: %s)",
                  interval, args.policy)
-    log.info("llm server: model=%s quant=%s kv=%s tp=%d sp=%d on :%d",
+    log.info("llm server: model=%s quant=%s kv=%s tp=%d sp=%d pp=%d "
+             "on :%d",
              args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
-             args.kv_dtype, args.tp, args.sp, srv.port)
+             args.kv_dtype, args.tp, args.sp, args.pp, srv.port)
     srv.serve_forever()
     return 0
 

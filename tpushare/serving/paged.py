@@ -70,28 +70,47 @@ def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
         mesh=mesh, adapters=adapters, adapter_ids=aids)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "rich", "mesh"),
+def _pp_forward(params, tokens, pools, page_table, lengths, cfg, mesh,
+                pp, adapters=None, aids=None):
+    """Route one paged decode forward: the flat program, or — when
+    ``pp = (mesh, n_micro)`` (STATIC, the round-21 pipeline) — the
+    microbatched stage wavefront with stage-local pool slabs
+    (:func:`transformer.forward_paged_decode_pp`).  ``pp=None`` traces
+    byte-identically to the pre-pipeline program."""
+    if pp is None:
+        return transformer.forward_paged_decode(
+            params, tokens, cfg, pools, page_table, lengths, mesh=mesh,
+            adapters=adapters, adapter_ids=aids)
+    pmesh, n_micro = pp
+    return transformer.forward_paged_decode_pp(
+        params, tokens, cfg, pools, page_table, lengths, pmesh,
+        n_micro=n_micro, adapters=adapters, adapter_ids=aids)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "rich", "mesh",
+                                             "pp"),
                    donate_argnums=(2,))
 def _tick(params, tokens, pools, page_table, lengths, temps, keys,
           tks, tps, cfg, rich: bool = False, mesh=None, adapters=None,
-          aids=None):
+          aids=None, pp=None):
     """Paged twin of continuous._tick (same sampling helper).  ``mesh``
     is STATIC (jax.sharding.Mesh hashes by devices+axes): under tp it
     reaches the paged-attention dispatcher, which shard_maps the Pallas
     read per device."""
-    logits, pools = transformer.forward_paged_decode(
-        params, tokens, cfg, pools, page_table, lengths, mesh=mesh,
-        adapters=adapters, adapter_ids=aids)
+    logits, pools = _pp_forward(
+        params, tokens, pools, page_table, lengths, cfg, mesh, pp,
+        adapters=adapters, aids=aids)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
     return nxt, pools
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "mesh"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "mesh",
+                                             "pp"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
             tks, tps, incs, cfg, n: int, rich: bool = False, mesh=None,
-            adapters=None, aids=None):
+            adapters=None, aids=None, pp=None):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
@@ -109,21 +128,21 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     """
     return _decode_scan(params, tokens, pools, page_table, lengths,
                         temps, keys, tks, tps, incs, cfg, n, rich, mesh,
-                        adapters=adapters, aids=aids)
+                        adapters=adapters, aids=aids, pp=pp)
 
 
 def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
                  tks, tps, incs, cfg, n: int, rich: bool, mesh=None,
-                 adapters=None, aids=None):
+                 adapters=None, aids=None, pp=None):
     """The paged fused decode scan BODY (trace-level) shared by
     :func:`_tick_n` and the mixed-step program :func:`_tick_mixed` —
     one definition, so the two dispatch flavors cannot drift."""
     def body(carry, _):
         tok, pools, lengths, keys = carry
         ks = jax.vmap(jax.random.split)(keys)
-        logits, pools = transformer.forward_paged_decode(
-            params, tok, cfg, pools, page_table, lengths, mesh=mesh,
-            adapters=adapters, adapter_ids=aids)
+        logits, pools = _pp_forward(
+            params, tok, pools, page_table, lengths, cfg, mesh, pp,
+            adapters=adapters, aids=aids)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
         return (nxt[:, None], pools, lengths + incs, ks[:, 0]), nxt
@@ -134,12 +153,13 @@ def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
-                                             "rich", "mesh"),
+                                             "rich", "mesh", "pp"),
                    donate_argnums=(5,))
 def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
                 page_table, tokens, lengths, temps, keys, tks, tps, incs,
                 cfg, chunk_len: int, n: int, rich: bool = False,
-                mesh=None, adapters=None, aids=None, p_aids=None):
+                mesh=None, adapters=None, aids=None, p_aids=None,
+                pp=None):
     """Paged twin of continuous._tick_mixed: the coalesced multi-prompt
     prefill (:func:`transformer.forward_paged_prefill_batch` — live rows
     write their own distinct pages, padded rows ride all-zero tables so
@@ -152,7 +172,8 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
         p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids)
     toks, keys, pools = _decode_scan(
         params, tokens, pools, page_table, lengths, temps, keys, tks,
-        tps, incs, cfg, n, rich, mesh, adapters=adapters, aids=aids)
+        tps, incs, cfg, n, rich, mesh, adapters=adapters, aids=aids,
+        pp=pp)
     return sel, toks, keys, pools
 
 
@@ -285,7 +306,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
                  prefix_cache: bool = False,
                  pool_bytes: Optional[int] = None,
                  spec_k: int = 0, adapter_slots: int = 0,
-                 adapter_rank: int = 8, adapter_loader=None):
+                 adapter_rank: int = 8, adapter_loader=None,
+                 pp: int = 1, pp_microbatches: Optional[int] = None):
         if cfg.max_seq % page_size:
             raise ValueError("max_seq must be a multiple of page_size")
         self.page_size = page_size
@@ -367,7 +389,13 @@ class PagedContinuousBatcher(ContinuousBatcher):
                          rolling_slots=False, spec_k=spec_k,
                          adapter_slots=adapter_slots,
                          adapter_rank=adapter_rank,
-                         adapter_loader=adapter_loader)
+                         adapter_loader=adapter_loader,
+                         pp=pp, pp_microbatches=pp_microbatches)
+
+    def _pp_rolling_storage(self, cfg) -> bool:
+        # the windowed page RING recycles pages in place — same
+        # structural refusal as the dense rolling pool (pp_storage)
+        return transformer.wants_rolling(cfg)
 
     def validate_request(self, prompt: List[int],
                          max_new_tokens: int) -> None:
@@ -477,6 +505,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
                 # pages span, and what each shard persistently holds
                 "sp_shards": sp,
                 "pool_bytes_per_shard": pool_bytes // sp}
+        info.update(self._pp_storage_info(pool_bytes))
         if sp > 1:
             # what the cross-shard merge moves per striped KERNEL
             # dispatch per layer: each shard contributes its f32
@@ -503,8 +532,10 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.cfg, self.n_pages, self.page_size)
         if self.mesh is not None:
             from ..parallel.mesh import shard_kv_storage
-            self.pools = shard_kv_storage(self.pools, self.mesh,
-                                          page_axis="sp")
+            self.pools = shard_kv_storage(
+                self.pools, self.mesh, page_axis="sp",
+                layer_axis=("pp" if "pp" in self.mesh.axis_names
+                            else None))
         self.page_table = np.zeros(
             (self.n_slots, self.pages_per_slot), np.int32)
         # Free pages, one list per position stripe.  Unstriped (sp==1)
@@ -815,7 +846,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         nxt, self.pools = _tick(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, self.cfg, rich,
-            mesh=self.mesh, adapters=adapters, aids=aids)
+            mesh=self.mesh, adapters=adapters, aids=aids,
+            pp=self._pp_args)
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
@@ -824,7 +856,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         toks, keys, self.pools = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich,
-            mesh=self.mesh, adapters=adapters, aids=aids)
+            mesh=self.mesh, adapters=adapters, aids=aids,
+            pp=self._pp_args)
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -858,7 +891,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
             jnp.asarray(self.page_table), tokens, lengths, temps, keys,
             tks, tps, incs, self.cfg, chunk_len, n_steps, rich,
-            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids)
+            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids,
+            pp=self._pp_args)
         return sel, toks, keys
 
     def _prefill_tables(self, p_slots, p_active):
