@@ -645,8 +645,8 @@ import jax
 import numpy as np
 from ..telemetry import health
 
-@functools.partial(jax.jit, static_argnames=("n", "pp"))
-def _tick_prog(x, n, pp=None):
+@functools.partial(jax.jit, static_argnames=("n", "pp", "moe"))
+def _tick_prog(x, n, pp=None, moe=None):
     return x
 
 @functools.partial(jax.jit)
@@ -657,7 +657,7 @@ _JIT_ENTRIES = [_tick_prog, _other_prog]
 
 class B:
     def _step(self, x):
-        out = _tick_prog(x, 1, pp=None)
+        out = _tick_prog(x, 1, pp=None, moe=None)
         return out
     def tick(self):
         with health.MONITOR.dispatch_guard("decode") as g:
@@ -793,10 +793,54 @@ def test_dispatch_audit_adapter_operand_helper_rules():
                in f.message for f in fs), fs
 
 
+def test_dispatch_audit_expert_operand_helper_rules():
+    """The round-22 expert-operand contract mirrors round 20's:
+    ``_expert_operands`` is host-side handle passing — a jitted
+    dispatch, a hook call, or a host fetch hiding inside it is a
+    second device program per round — and a steady hook dropping the
+    static ``moe`` operand silently serves the replicated expert pool
+    (each seeded violation caught by name; the clean shapes pass)."""
+    ok = _AUDIT_FIXTURE.replace(
+        "class B:\n",
+        "class B:\n"
+        "    def _expert_operands(self):\n"
+        "        return self._moe_args\n")
+    assert dispatch_audit.audit_pair(ok) == []
+    bad_jit = ok.replace(
+        "        return self._moe_args\n",
+        "        return _other_prog(self._moe_args)\n")
+    fs = dispatch_audit.audit_pair(bad_jit)
+    assert any(f.rule == "expert-operand" and "_other_prog"
+               in f.message for f in fs), fs
+    bad_fetch = ok.replace(
+        "        return self._moe_args\n",
+        "        return np.asarray(self._moe_args)\n")
+    fs = dispatch_audit.audit_pair(bad_fetch)
+    assert any(f.rule == "expert-operand" and "host-fetches"
+               in f.message for f in fs), fs
+    bad_hook = ok.replace(
+        "        return self._moe_args\n",
+        "        self._step(1)\n"
+        "        return self._moe_args\n")
+    fs = dispatch_audit.audit_pair(bad_hook)
+    assert any(f.rule == "expert-operand" and "calls hook"
+               in f.message for f in fs), fs
+    # the other direction: a steady hook dispatching WITHOUT the moe
+    # keyword serves the replicated pool no matter what the batcher
+    # gated — the contract declares every entry expert-threaded
+    bad_drop = _AUDIT_FIXTURE.replace(
+        "        out = _tick_prog(x, 1, pp=None, moe=None)\n",
+        "        out = _tick_prog(x, 1, pp=None)\n")
+    fs = dispatch_audit.audit_pair(bad_drop)
+    assert any(f.rule == "expert-operand"
+               and "without the static moe operand" in f.message
+               for f in fs), fs
+
+
 def test_dispatch_audit_catches_fetch_inside_hook():
     bad = _AUDIT_FIXTURE.replace(
-        "        out = _tick_prog(x, 1, pp=None)\n",
-        "        out = np.asarray(_tick_prog(x, 1, pp=None))\n")
+        "        out = _tick_prog(x, 1, pp=None, moe=None)\n",
+        "        out = np.asarray(_tick_prog(x, 1, pp=None, moe=None))\n")
     fs = dispatch_audit.audit_pair(bad)
     assert any(f.rule == "hook-body" and "host-fetches" in f.message
                for f in fs), fs
@@ -853,9 +897,9 @@ def test_dispatch_audit_catches_pacing_inside_hook():
     between trace and dispatch of the jitted program — hooks stay
     pure single-program dispatch."""
     bad = _AUDIT_FIXTURE.replace(
-        "        out = _tick_prog(x, 1, pp=None)\n",
+        "        out = _tick_prog(x, 1, pp=None, moe=None)\n",
         '        self._policy.acquire("decode")\n'
-        "        out = _tick_prog(x, 1, pp=None)\n")
+        "        out = _tick_prog(x, 1, pp=None, moe=None)\n")
     fs = dispatch_audit.audit_pair(bad)
     assert [f.rule for f in fs] == ["pacing-guard"], fs
     assert "hook" in fs[0].message
@@ -867,8 +911,8 @@ def test_dispatch_audit_catches_dropped_pp_operand():
     placement-only — the contract declares tick staged, so the audit
     names the drop."""
     bad = _AUDIT_FIXTURE.replace(
-        "        out = _tick_prog(x, 1, pp=None)\n",
-        "        out = _tick_prog(x, 1)\n")
+        "        out = _tick_prog(x, 1, pp=None, moe=None)\n",
+        "        out = _tick_prog(x, 1, moe=None)\n")
     fs = dispatch_audit.audit_pair(bad)
     assert any(f.rule == "pp-thread"
                and "without the static pp operand" in f.message
@@ -883,7 +927,7 @@ def test_dispatch_audit_catches_pp_on_placement_entry():
         "class B:\n",
         "class B:\n"
         "    def _step_spec(self, x):\n"
-        "        out = _tick_prog(x, 1, pp=self._pp_args)\n"
+        "        out = _tick_prog(x, 1, pp=self._pp_args, moe=None)\n"
         "        return out\n")
     fs = dispatch_audit.audit_pair(bad)
     assert any(f.rule == "pp-thread" and "placement-only" in f.message
@@ -893,7 +937,7 @@ def test_dispatch_audit_catches_pp_on_placement_entry():
         "class B:\n",
         "class B:\n"
         "    def _step_spec(self, x):\n"
-        "        out = _tick_prog(x, 1)\n"
+        "        out = _tick_prog(x, 1, moe=None)\n"
         "        return out\n")
     assert dispatch_audit.audit_pair(ok) == []
 
@@ -970,6 +1014,21 @@ def test_precheck_pp_stage_gate_drift_raises(monkeypatch):
                         lambda *a, **k: "pp_layers")
     with pytest.raises(mosaic.GateDriftError):
         mosaic.precheck_pp_stage(n_layers=4, pp=2, cross_check=True)
+
+
+def test_precheck_expert_gather_gate_drift_raises(monkeypatch):
+    """mosaic.precheck_expert_gather(cross_check=True) is pinned to
+    ops.experts.expert_fallback_reason the same way — the ep gate and
+    its stdlib mirror move together or the sweep raises."""
+    experts = importlib.import_module("tpushare.ops.experts")
+
+    assert mosaic.precheck_expert_gather(4, 2, cross_check=True).ok
+    assert mosaic.precheck_expert_gather(3, 2).reason == "ep_experts"
+    assert mosaic.precheck_expert_gather(4, 2, pp=2).reason == "ep_mesh"
+    monkeypatch.setattr(experts, "expert_fallback_reason",
+                        lambda *a, **k: "ep_experts")
+    with pytest.raises(mosaic.GateDriftError):
+        mosaic.precheck_expert_gather(4, 2, cross_check=True)
 
 
 def test_confinement_lock_discipline_covers_policy_module():

@@ -32,6 +32,9 @@ NAME_RE = re.compile(r"^tpushare_[a-z0-9_]+$")
 DIMENSIONLESS_HISTOGRAMS = {
     # accepted proposal tokens per speculative verify round per slot
     "tpushare_spec_accept_depth",
+    # fraction of a dispatch's token->expert assignments per expert
+    # (balance view; expert IDS never become label values)
+    "tpushare_expert_load",
 }
 
 
@@ -236,6 +239,10 @@ ENUMERATED_VALUES = {
     # keep in sync with the serving.adapters constants (enum-pinned)
     ("tpushare_adapter_loads_total", "reason"): {"miss"},
     ("tpushare_adapter_evictions_total", "reason"): {"capacity"},
+    # keep in sync with ops.experts.EXPERT_FALLBACK_REASONS (enum-
+    # pinned): structural ep demotions to the replicated expert pool
+    ("tpushare_expert_fallback_total", "reason"):
+        {"ep_experts", "ep_mesh"},
     # keep in sync with telemetry.propagation.REQUEST_HOPS (enum-
     # pinned): the router's critical-path decomposition
     ("tpushare_request_hop_seconds", "hop"):
@@ -277,6 +284,8 @@ ENUM_PINS = {
         ("tpushare.serving.adapters", "ADAPTER_LOAD_REASONS"),
     ("tpushare_adapter_evictions_total", "reason"):
         ("tpushare.serving.adapters", "ADAPTER_EVICTION_REASONS"),
+    ("tpushare_expert_fallback_total", "reason"):
+        ("tpushare.ops.experts", "EXPERT_FALLBACK_REASONS"),
     # a histogram pin (the completeness sweep covers counters; the
     # drift sweep checks every pin against the declared family)
     ("tpushare_request_hop_seconds", "hop"):

@@ -621,6 +621,12 @@ def test_bench_trajectory_smoke(tmp_path):
     traj = bench_trajectory.trajectory()
     assert traj["rounds"], "no committed BENCH_r*.json records?"
     assert "llm_decode_tokens_per_s" in traj["metrics"]
+    # the round-21/22 records collate as their own rows
+    assert "r16" in traj["rounds"] and "r17" in traj["rounds"]
+    assert "pp_decode_tokens_per_s" in traj["metrics"]
+    assert "moe_ep_decode_tokens_per_s" in traj["metrics"]
+    assert "r17" in traj["metrics"]["moe_ep_decode_tokens_per_s"][
+        "values"]
     md = bench_trajectory.render_markdown(traj)
     assert "| metric |" in md and "llm_decode_tokens_per_s" in md
     # drift math over a synthetic pair of rounds

@@ -1,0 +1,271 @@
+"""Expert-parallel MoE serving (round 22).
+
+The exactness contract under test:
+
+* DEGENERATE IDENTITY — an ``n_experts=1, moe_top_k=1`` config whose
+  expert-0 weights ARE a dense model's FFN weights streams
+  bit-identically to that dense model on every dispatch flavor and
+  both storage pools (the short-circuit in
+  :func:`tpushare.ops.experts.moe_ffn` never evaluates the router —
+  the adapter-row-0 identity story, told for experts);
+* SELF-CONSISTENCY — a routed MoE batch's streams are IDENTICAL
+  across ticked / fused / mixed / spec dispatch on every storage
+  flavor x kv dtype (routing is deterministic per token, the gather
+  is row-local, and int8 KV quantization stays append-only — the
+  slow-marked matrix);
+* EP == REPLICATED — ep-sharded serving streams EXACTLY equal the
+  replicated pool's on the f32 tiny config (routing is computed once
+  outside the shard_map; out-of-range slots contribute exact zeros
+  into the psum fold);
+* ONE DISPATCH PER ROUND survives with experts active (wrap lists
+  derive from dispatch_audit.ENTRY_CONTRACT, so the runtime count and
+  the static audit prove the same invariant);
+* STRUCTURAL DEMOTION — an indivisible expert count (or a staged pp
+  program) demotes to the replicated pool: counted, reported in
+  storage_info, never a crash.
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.models import transformer
+from tpushare.ops import experts
+from tpushare.parallel.mesh import make_mesh
+from tpushare.serving import metrics
+from tpushare.serving.continuous import ContinuousBatcher
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = dataclasses.replace(transformer.tiny(max_seq=64),
+                              n_experts=4, moe_top_k=2, moe_every=1)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(scope="module")
+def dense_model():
+    cfg = transformer.tiny(max_seq=64)
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _mk(params, cfg, paged, **kw):
+    if paged:
+        return PagedContinuousBatcher(params, cfg, n_slots=3,
+                                      page_size=4, **kw)
+    return ContinuousBatcher(params, cfg, n_slots=3, **kw)
+
+
+def _drain(b, mode="tick", max_rounds=500):
+    for _ in range(max_rounds):
+        if not b.slots and not b.prefilling:
+            return b
+        if mode == "mixed":
+            b.tick_mixed(2, chunk=4, budget=8)
+        elif mode == "spec":
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick_spec(2, k=3)
+        elif mode == "fused":
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick_fused(2)
+        else:
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick()
+    raise RuntimeError("did not drain")
+
+
+def _wrap_dense_as_moe(params, cfg):
+    """Build an n_experts=1 MoE param tree whose expert 0 IS a dense
+    model's FFN — the construction the degenerate identity needs
+    (independent init splits keys differently, so equal-weight MoE
+    params come FROM the dense tree, not from a fresh init)."""
+    moe_cfg = dataclasses.replace(cfg, n_experts=1, moe_top_k=1,
+                                  moe_every=1)
+    layers = dict(params["layers"])
+    layers["moe_gate"] = layers.pop("w_gate")[:, None]
+    layers["moe_up"] = layers.pop("w_up")[:, None]
+    layers["moe_down"] = layers.pop("w_down")[:, None]
+    n_layers = layers["moe_gate"].shape[0]
+    layers["router"] = jnp.zeros(
+        (n_layers, cfg.d_model, 1), layers["moe_gate"].dtype)
+    layers["moe_route"] = jnp.ones((n_layers,), jnp.float32)
+    return {**params, "layers": layers}, moe_cfg
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_degenerate_single_expert_bit_identical_to_dense(dense_model,
+                                                         paged):
+    """Acceptance bar: n_experts=1/top_k=1 on a dense model's own FFN
+    weights == the dense-FFN forward, bit for bit, across ticked /
+    fused / mixed dispatch on both storage flavors."""
+    params, cfg = dense_model
+    mparams, mcfg = _wrap_dense_as_moe(params, cfg)
+    prompts = [([1, 2, 3], 8), ([4, 5, 6, 7], 8)]
+    for mode in ("tick", "fused", "mixed"):
+        ref = _mk(params, cfg, paged)
+        rids = [ref.admit_chunked(p, n, chunk=4) for p, n in prompts]
+        _drain(ref, mode)
+        got = _mk(mparams, mcfg, paged)
+        gids = [got.admit_chunked(p, n, chunk=4) for p, n in prompts]
+        _drain(got, mode)
+        for r, g in zip(rids, gids):
+            assert got.completed[g] == ref.completed[r], \
+                f"degenerate identity broke on {mode} (paged={paged})"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_moe_streams_self_consistent_across_flavors(moe_model, paged,
+                                                    kv_dtype):
+    """The round-8/round-14 bar extended to routed experts: the same
+    requests produce IDENTICAL streams through ticked, fused, mixed,
+    and spec dispatch on each storage x kv-dtype flavor (routing is
+    per-token deterministic; int8 quantization stays append-only)."""
+    params, cfg = moe_model
+    cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
+    reqs = [([1, 2, 3] * 3, 10), ([4, 5, 6, 7], 10), ([8, 9], 10)]
+    streams = {}
+    for mode in ("tick", "fused", "mixed", "spec"):
+        b = _mk(params, cfg, paged,
+                spec_k=3 if mode == "spec" else 0)
+        rids = [b.admit_chunked(p, n, chunk=4) for p, n in reqs]
+        _drain(b, mode)
+        streams[mode] = [b.completed[r] for r in rids]
+    for mode in ("fused", "mixed", "spec"):
+        assert streams[mode] == streams["tick"], \
+            f"{mode} drifted from ticked (paged={paged}, {kv_dtype})"
+
+
+def test_ep_sharded_streams_equal_replicated(moe_model):
+    """ep=2 over the virtual mesh: streams exactly equal the
+    replicated pool's (f32 tiny config), and storage_info prices the
+    per-shard pool."""
+    params, cfg = moe_model
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh({"ep": 2})
+    reqs = [([1, 2, 3] * 3, 10), ([4, 5, 6, 7], 10)]
+    for paged in (False, True):
+        ref = _mk(params, cfg, paged)
+        rids = [ref.admit(p, n) for p, n in reqs]
+        _drain(ref, "fused")
+        b = _mk(params, cfg, paged, mesh=mesh)
+        gids = [b.admit(p, n) for p, n in reqs]
+        _drain(b, "fused")
+        for r, g in zip(rids, gids):
+            assert b.completed[g] == ref.completed[r], \
+                f"ep-sharded stream drifted (paged={paged})"
+        info = b.storage_info()
+        assert info["n_experts"] == 4 and info["moe_top_k"] == 2
+        assert info["ep_shards"] == 2
+        assert info["expert_pool_bytes"] == \
+            experts.expert_pool_bytes(cfg)
+        assert info["expert_pool_bytes_per_shard"] * 2 == \
+            pytest.approx(info["expert_pool_bytes"], abs=64)
+        assert "expert_fallback_reason" not in info
+
+
+def test_ep_gate_demotes_structurally(moe_model):
+    """n_experts % ep != 0 demotes to the replicated pool: counted,
+    named in storage_info, and the batcher still serves (the gate
+    mirror in analysis.mosaic is pin-tested in test_analysis)."""
+    params, cfg = moe_model
+    assert experts.expert_fallback_reason(4, 1) is None
+    assert experts.expert_fallback_reason(4, 2) is None
+    assert experts.expert_fallback_reason(3, 2) == "ep_experts"
+    assert experts.expert_fallback_reason(4, 2, pp=2) == "ep_mesh"
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg3 = dataclasses.replace(cfg, n_experts=3, moe_top_k=2)
+    params3 = transformer.init_params(jax.random.PRNGKey(1), cfg3)
+    before = metrics.EXPERT_FALLBACK.value(reason="ep_experts")
+    b = _mk(params3, cfg3, False, mesh=make_mesh({"ep": 2}))
+    assert metrics.EXPERT_FALLBACK.value(reason="ep_experts") == \
+        before + 1
+    info = b.storage_info()
+    assert info["expert_fallback_reason"] == "ep_experts"
+    assert info["ep_shards"] == 1
+    rid = b.admit([1, 2, 3], 6)
+    _drain(b, "fused")
+    assert len(b.completed[rid]) == 9
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_one_dispatch_per_mixed_round_with_experts(moe_model, paged):
+    """The round-7 invariant with routed experts active: a steady
+    mixed round carrying MoE prefill AND decode rows is exactly ONE
+    device dispatch (wrap lists derive from the audited contract)."""
+    from tpushare.analysis import dispatch_audit
+
+    params, cfg = moe_model
+    b = _mk(params, cfg, paged)
+    b.admit([1, 2, 3], 12)                      # decoding throughout
+    b.admit_chunked([5] * 20, 3, chunk=4)
+    b.admit_chunked([6] * 20, 3, chunk=4)
+    counts = {"mixed": 0, "other": 0}
+    steady = dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"]
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    wrap(steady, "mixed")
+    for hook in (dispatch_audit.TICK_HOOKS
+                 + dispatch_audit.PREFILL_HOOKS):
+        if hook != steady:
+            wrap(hook, "other")
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    assert rounds > 1
+    assert counts["mixed"] == rounds, \
+        "not one dispatch per expert-routed mixed round"
+    assert counts["other"] == 0, \
+        "an expert-routed mixed round leaked an extra dispatch"
+
+
+def test_expert_load_histogram_observes_on_cadence(moe_model):
+    """The per-expert load fractions reach tpushare_expert_load at the
+    derived-observe cadence (device-resident between observations —
+    no per-tick fetch), and routing actually spreads tokens."""
+    params, cfg = moe_model
+    before = metrics.EXPERT_LOAD.count()
+    b = _mk(params, cfg, False)
+    b.admit([1, 2, 3], 40)
+    _drain(b, "tick")
+    after = metrics.EXPERT_LOAD.count()
+    assert after > before, "expert load never observed over 40 ticks"
+    # each observation flushes one fraction per expert
+    assert (after - before) % cfg.n_experts == 0
+
+
+def test_storage_info_replicated_expert_keys(moe_model):
+    """Without a mesh the expert keys still price the pool (ep_shards
+    1, no fallback reason — replication is the configured state, not
+    a demotion)."""
+    params, cfg = moe_model
+    b = _mk(params, cfg, True)
+    info = b.storage_info()
+    assert info["n_experts"] == 4 and info["moe_top_k"] == 2
+    assert info["ep_shards"] == 1
+    assert info["expert_pool_bytes"] == experts.expert_pool_bytes(cfg)
+    assert "expert_fallback_reason" not in info

@@ -77,19 +77,20 @@ from .tpulint import Finding, repo_root
 #: lists from this table, so editing it without editing the serving
 #: code fails them — and vice versa.
 ENTRY_CONTRACT = {
-    "tick": {"steady": "_step", "sanctioned": (), "pp": "staged"},
+    "tick": {"steady": "_step", "sanctioned": (), "pp": "staged",
+             "moe": "operand"},
     "tick_fused": {"steady": "_step_n", "sanctioned": (),
-                   "pp": "staged"},
+                   "pp": "staged", "moe": "operand"},
     "tick_mixed": {"steady": "_step_mixed",
                    "sanctioned": ("_mixed_fallback",
                                   "_finish_mixed_round"),
-                   "pp": "staged"},
+                   "pp": "staged", "moe": "operand"},
     "tick_spec": {"steady": "_step_spec", "sanctioned": (),
-                  "pp": "placement"},
+                  "pp": "placement", "moe": "operand"},
     "tick_mixed_spec": {"steady": "_step_mixed_spec",
                         "sanctioned": ("_mixed_fallback",
                                        "_finish_mixed_round"),
-                        "pp": "placement"},
+                        "pp": "placement", "moe": "operand"},
 }
 
 
@@ -193,6 +194,14 @@ AUX_JIT = ("_wrap_keys",)
 #: device program per round — exactly the drift the dispatch-count
 #: rule exists to forbid.
 OPERAND_HELPERS = ("_adapter_operands",)
+
+#: HOST-side operand-prep helper for the expert-parallel MoE plane
+#: (round 22): hands the serving mesh through to each hook's jitted
+#: program as the static ``moe`` operand — the per-token routed expert
+#: gather is HOOK-INTERIOR exactly like the adapter gather, so this
+#: helper follows the same audited purity contract (expert-operand
+#: rule): never a jitted dispatch, never a hook call, never a fetch.
+EXPERT_OPERAND_HELPERS = ("_expert_operands",)
 
 #: receiver-name fragments that identify a tenant-policy pacing object
 #: (serving/policy.py DispatchPacer / PolicyClient) for the
@@ -499,6 +508,40 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                 f"{flavor.name} operand helper {helper} host-fetches — "
                 f"it hands device handles through, never synchronizes"))
 
+    # -- expert-operand helpers: host handle passing ONLY --------------
+    # (round 22, the adapter-operand twin): _expert_operands hands the
+    # serving mesh to the hooks as the static ``moe`` operand; the
+    # routed top-k expert gather runs INSIDE each hook's one jitted
+    # program, so the MoE plane adds ZERO dispatches per round — a
+    # dispatch, hook call, or fetch hiding in the prep helper would be
+    # exactly the second-program drift the dispatch-count rule forbids.
+    for helper in EXPERT_OPERAND_HELPERS:
+        if helper not in flavor.table:
+            continue
+        fn, facts = flavor.table[helper]
+        s = scan(helper)
+        for n, ln, _ in s.fn_calls:
+            if n in facts.jitted and n not in AUX_JIT:
+                out.append(Finding(
+                    "expert-operand", path_of(helper), ln,
+                    f"{flavor.name} operand helper {helper} dispatches "
+                    f"jitted program {n} — expert operand prep is "
+                    f"host-side handle passing; the routed gather is "
+                    f"hook-interior (inside the hook's one program)"))
+        for n, ln, _ in s.self_calls:
+            if n in TICK_HOOKS or n in PREFILL_HOOKS:
+                out.append(Finding(
+                    "expert-operand", path_of(helper), ln,
+                    f"{flavor.name} operand helper {helper} calls hook "
+                    f"{n} — operand prep must not dispatch"))
+        for ln, _, _, kind in s.fetches:
+            if kind == "cast":
+                continue
+            out.append(Finding(
+                "expert-operand", path_of(helper), ln,
+                f"{flavor.name} operand helper {helper} host-fetches — "
+                f"it hands device handles through, never synchronizes"))
+
     # -- pipeline threading: staged entries' hooks thread pp -----------
     # (round 21): a "staged" entry's one jitted program carries the
     # static pp operand — that is HOW the wavefront stays in-program —
@@ -534,6 +577,21 @@ def _audit_flavor(flavor: _Flavor) -> List[Finding]:
                     f"{entry} placement-only — stage the program and "
                     f"update ENTRY_CONTRACT together, or drop the "
                     f"operand"))
+            # MoE operand threading (round 22): every contract entry
+            # declares moe="operand" — the hook's one jitted program
+            # takes the static ``moe`` mesh so the routed expert block
+            # runs in-program on every path (dense/paged × ticked/
+            # fused/mixed/spec).  Dropping the keyword silently serves
+            # an ep-sharded pool through a replicated trace.
+            if contract.get("moe") == "operand" and not any(
+                    kw.arg == "moe" for kw in node.keywords):
+                out.append(Finding(
+                    "expert-operand", path_of(hook), node.lineno,
+                    f"{flavor.name} hook {hook} ({entry}) dispatches "
+                    f"{node.func.id} without the static moe operand — "
+                    f"the contract threads the expert mesh into every "
+                    f"hook's ONE program (ENTRY_CONTRACT moe="
+                    f"'operand'); dropping it serves MoE unsharded"))
 
     # -- guard discipline: hook call sites outside hooks ---------------
     for method in flavor.table:
@@ -737,7 +795,8 @@ def cross_check_live() -> None:
         if not hasattr(continuous.ContinuousBatcher, entry):
             raise DispatchDriftError(
                 f"contract entry {entry} missing on ContinuousBatcher")
-    for hook in TICK_HOOKS + PREFILL_HOOKS + OPERAND_HELPERS:
+    for hook in (TICK_HOOKS + PREFILL_HOOKS + OPERAND_HELPERS
+                 + EXPERT_OPERAND_HELPERS):
         for cls in (continuous.ContinuousBatcher,
                     paged.PagedContinuousBatcher):
             if not hasattr(cls, hook):
@@ -797,3 +856,14 @@ def cross_check_live() -> None:
                 f"continuous.{inner.__name__} "
                 f"{'lacks' if want else 'takes'} the pp parameter — "
                 f"edit ENTRY_CONTRACT and the program together")
+        # round 22: every entry threads the static MoE mesh operand
+        has_moe = "moe" in _inspect.signature(inner).parameters
+        want_moe = contract.get("moe") == "operand"
+        if has_moe != want_moe:
+            raise DispatchDriftError(
+                f"contract entry {entry} is moe="
+                f"{contract.get('moe')!r} but continuous."
+                f"{inner.__name__} "
+                f"{'lacks' if want_moe else 'takes'} the moe "
+                f"parameter — edit ENTRY_CONTRACT and the program "
+                f"together")

@@ -421,6 +421,53 @@ def precheck_pp_stage(n_layers: int, pp: int, tp: int = 1, sp: int = 1,
     return v
 
 
+def precheck_expert_gather(n_experts: int, ep: int, pp: int = 1,
+                           cross_check: bool = False) -> Verdict:
+    """Would the ep-sharded MoE expert path engage at these parameters?
+    Stdlib mirror of the serving gate
+    (``ops.experts.expert_fallback_reason``, round 22) — like
+    :func:`precheck_pp_stage`, every refusal is STRUCTURAL (the routed
+    block is XLA take+einsum, no Pallas arm: there are no Mosaic
+    blocks to derive, so the verdict holds on every platform and the
+    chip drive records ``xla_only``):
+
+    * ``ep_experts`` — the ep degree must divide the expert count (the
+      shard_map pool split needs an equal expert slice per shard; an
+      indivisible pool legalizes to replication).
+    * ``ep_mesh`` — the ep shard_map does not nest inside the round-21
+      staged pp wavefront; ep composes with tp/sp only.
+
+    ``cross_check=True`` additionally imports the live gate and raises
+    :class:`GateDriftError` on disagreement — NEVER pass it from a
+    drive's pre-dial precheck (it imports jax)."""
+    findings = []
+    reason = None
+    if ep > 1:
+        if n_experts % ep:
+            reason = "ep_experts"
+            findings.append(
+                f"expert count {n_experts} is not divisible by the ep "
+                f"degree {ep}: the per-shard pool slice would be "
+                f"ragged; the pool legalizes to replication")
+        elif pp > 1:
+            reason = "ep_mesh"
+            findings.append(
+                f"pp={pp}: the ep shard_map does not nest inside the "
+                f"staged pipeline wavefront (ep composes with tp/sp "
+                f"only)")
+    v = Verdict(ok=reason is None, reason=reason,
+                findings=tuple(findings), blocks=())
+    if cross_check:
+        from ..ops.experts import expert_fallback_reason
+        gate = expert_fallback_reason(n_experts, ep, pp=pp)
+        if gate != v.reason:
+            raise GateDriftError(
+                f"verdict drift at n_experts={n_experts} ep={ep} "
+                f"pp={pp}: gate says {gate!r}, prechecker says "
+                f"{v.reason!r}")
+    return v
+
+
 def _cross_check_paged(v: Verdict, page, head_dim, quantized, dtype,
                        rows, tp, n_kv_heads, n_heads, assume_tpu,
                        sp=1, n_pages=0):

@@ -242,6 +242,46 @@ def _paged_gather_confined(ctx: Context):
                 f"through transformer.paged_attention")
 
 
+#: first-argument name fragments that identify a stacked weight pool
+#: for the expert-gather confinement (an expert/adapter pool, not a KV
+#: page table or an activation)
+_POOL_NAME_FRAGMENTS = ("pool", "expert", "moe_", "adapter")
+
+
+@rule(
+    "expert-gather-confined",
+    "A ``jnp.take`` whose first argument names a stacked weight pool "
+    "(``*pool*``/``*expert*``/``moe_*``/``*adapter*``) outside "
+    "tpushare/ops/experts.py re-derives the grouped-gather matmul by "
+    "hand: the stray gather would bypass ``gathered_matmul`` — the ONE "
+    "shape the Mosaic precheck, the chip drive "
+    "(drives/drive_moe_decode.py), and the row-local identity "
+    "contract cover.  Route per-row/per-token weight selection "
+    "through ``ops.experts.gathered_matmul``.",
+    _in_package, "tpushare/",
+    allow=("tpushare/ops/experts.py",),
+    allow_doc="the one sanctioned grouped-gather module")
+def _expert_gather_confined(ctx: Context):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "take"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jnp"
+                and node.args):
+            continue
+        first = node.args[0]
+        name = first.id if isinstance(first, ast.Name) else (
+            first.attr if isinstance(first, ast.Attribute) else None)
+        if name and any(f in name.lower()
+                        for f in _POOL_NAME_FRAGMENTS):
+            yield node.lineno, (
+                f"pool-through-index gather of {name!r} "
+                f"(`{ctx.quote(node.lineno)}`) outside "
+                f"ops/experts.py — route it through "
+                f"ops.experts.gathered_matmul")
+
+
 @rule(
     "kv-byte-math",
     "A ``2 *`` multiply in an expression touching ``n_kv_heads`` is "
@@ -728,6 +768,7 @@ table.
 | `jit-registry` | every `@jax.jit` definition in the serving modules is on the retrace watch list (`_JIT_ENTRIES` / `register_jit_entries`), so `tpushare_jit_retraces_total` sees every program |
 | `pacing-guard` | a tenant-policy pacing `acquire` (`*policy*`/`*pacer*` receivers) in the serving modules sits inside a `dispatch_guard` with-block and never inside a tick hook — the sanctioned pacing site is the guard's own pre-dispatch hook, an unguarded sleep stalls the loop invisibly, and the policy layer adds ZERO device dispatches |
 | `adapter-operand` | the multi-adapter operand helpers (`_adapter_operands`) are host-side handle passing ONLY — no jitted dispatch, no hook call, no host fetch may hide in operand prep: the per-row adapter gather is hook-interior (inside the hook's one jitted program), so the adapter plane adds ZERO dispatches per round |
+| `expert-operand` | the expert-parallel operand helper (`_expert_operands`) is host-side handle passing ONLY — no jitted dispatch, no hook call, no host fetch (the per-token routed expert gather is hook-interior, so the MoE plane adds ZERO dispatches per round) — and every tick hook's jitted call threads the static `moe` mesh operand (`ENTRY_CONTRACT` moe='operand'; dropping it silently serves an ep-sharded pool through a replicated trace) |
 | `pp-thread` | each tick entry threads the static pipeline operand per its `ENTRY_CONTRACT` mode: staged entries (tick/tick_fused/tick_mixed) must pass `pp` to their hook's jitted program (dropping it silently serves a staged batcher through the flat program), placement entries (tick_spec/tick_mixed_spec) must NOT (spec serves staged models via GSPMD placement alone) — `dispatches_per_round` stays 1 at every pp because the wavefront is ONE SPMD dispatch |
 | `stage-dispatch` | the GPipe wavefront schedule executes each (stage, microbatch) cell EXACTLY once, ticks in order — `audit_stage_schedule` flags duplicate, dropped, out-of-range, and out-of-order cells; `pp_stage_schedule_mirror` (stdlib) is pinned against the live `parallel.pipeline.pp_stage_schedule` in `cross_check_live` |
 """

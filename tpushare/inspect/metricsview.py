@@ -179,6 +179,17 @@ def summarize_serving(parsed: dict) -> dict:
         "adapter_evictions": sum(
             v for _, v in parsed["samples"].get(
                 "tpushare_adapter_evictions_total", ())) or None,
+        # expert-parallel MoE serving (round 22): experts per routed
+        # layer (0/None = dense FFN), the stacked expert pool's HBM,
+        # and how many configured-ep batchers demoted to a replicated
+        # pool (summed over reasons — nonzero means some live batcher
+        # is NOT sharding experts although ep was asked for)
+        "moe_experts": _gauge(parsed, "tpushare_moe_experts"),
+        "expert_pool_bytes": _gauge(parsed,
+                                    "tpushare_expert_pool_bytes"),
+        "expert_fallbacks": sum(
+            v for _, v in parsed["samples"].get(
+                "tpushare_expert_fallback_total", ())) or None,
     }
 
 
@@ -350,12 +361,12 @@ def render_metrics_table(
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
               "KV BYTES(dtype)", "ATTN", "STRIPE", "STAGES", "SPEC",
-              "ADAPTERS", "PREFILL Q", "BUDGET%"]]
+              "ADAPTERS", "EXPERTS", "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
                           "-", "-", "-", "-", "-", "-", "-", "-", "-",
-                          "-", "-", "-"])
+                          "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -405,6 +416,19 @@ def render_metrics_table(
             adapters = f"{int(summary['adapters_resident'])}"
             if summary.get("adapter_evictions"):
                 adapters += f" (ev {int(summary['adapter_evictions'])})"
+        # EXPERTS: experts per routed layer with the stacked pool's HBM
+        # alongside ("4 (96.5KiB)"), and the structural demotion count
+        # when a configured ep could not shard ("(fb 1)") — a MoE node
+        # must never read clean while its expert pool replicated
+        experts = "-"
+        if summary.get("moe_experts"):
+            experts = f"{int(summary['moe_experts'])}"
+            if summary.get("expert_pool_bytes"):
+                experts += (
+                    f" ({_fmt_bytes(summary['expert_pool_bytes'])})")
+        if summary.get("expert_fallbacks"):
+            experts = (("" if experts == "-" else experts + " ")
+                       + f"(fb {int(summary['expert_fallbacks'])})")
         health = (summary.get("health") or "-").upper()
         table.append([
             name, addr, health,
@@ -419,6 +443,7 @@ def render_metrics_table(
             stages,
             spec,
             adapters,
+            experts,
             _fmt(summary.get("prefill_queue"), 1.0, "", 0),
             _fmt(summary.get("mixed_budget_util"), 100.0, "%", 0),
         ])

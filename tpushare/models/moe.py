@@ -6,6 +6,18 @@ limit, expert FFNs run batched over the expert axis, and results combine
 back — all as einsums, so sharding the expert axis over ``ep``
 (``P("ep", ...)`` on the stacked expert weights) makes XLA insert the
 all-to-alls over ICI.  Load-balancing aux loss per Switch Transformer.
+
+This module is the TRAINING-side MoE (`__graft_entry__.dryrun_multichip`
+exercises it): capacity-limited dense dispatch, dropped-token semantics,
+aux loss.  The SERVING-side MoE (round 22) lives in
+:mod:`tpushare.ops.experts` + the ``n_experts``/``moe_top_k``/
+``moe_every`` fields of :class:`tpushare.models.transformer.ModelConfig`
+— decode batches are tiny and latency-bound, so serving routes by
+per-token gather (:func:`tpushare.ops.experts.gathered_matmul`, no
+capacity drops — every token reaches its experts, deterministic streams)
+instead of the einsum dispatch/combine here; the stacked-pool layout and
+the ep sharding rule (leading expert axis over "ep",
+``parallel.mesh.EXPERT_SHARDING_RULES``) are shared shape-for-shape.
 """
 
 from __future__ import annotations

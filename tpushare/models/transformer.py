@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import attention
+from ..ops.experts import moe_ffn
 from ..ops.quant import matmul_maybe_q as _mm
 
 
@@ -70,6 +71,22 @@ class ModelConfig:
     #: (non-paged) storage ignores the knob.  Default stays "xla"
     #: until the chip record lands (drives/drive_paged_attn.py).
     attn_kernel: str = "xla"
+    #: Mixture-of-experts FFN (round 22): ``n_experts`` > 0 swaps the
+    #: dense w_gate/w_up/w_down leaves of EVERY layer for a stacked
+    #: expert pool (router [d, E], moe_gate/up [E, d, f], moe_down
+    #: [E, f, d]) routed per TOKEN with ``moe_top_k`` experts inside
+    #: the same jitted forwards (:func:`tpushare.ops.experts.moe_ffn`).
+    #: ``moe_every`` interleaves dense layers real MoE models keep:
+    #: layer l ROUTES iff ``l % moe_every == 0``; other layers force
+    #: expert 0 with weight exactly 1.0 (their expert-0 slice IS their
+    #: dense FFN — one scanned layer body for the whole stack).  The
+    #: ``n_experts=1, moe_top_k=1`` degenerate config short-circuits
+    #: to the plain SwiGLU on expert row 0, bit-identical to the
+    #: dense-FFN program on equal weights.  0 (default) = dense FFN,
+    #: byte-identical pre-round-22 params and traces.
+    n_experts: int = 0
+    moe_top_k: int = 1
+    moe_every: int = 1
 
     def __post_init__(self):
         if self.window is not None and self.window < 1:
@@ -83,6 +100,17 @@ class ModelConfig:
         if self.attn_kernel not in ("xla", "pallas"):
             raise ValueError(f"attn_kernel must be 'xla' or 'pallas', "
                              f"got {self.attn_kernel!r}")
+        if self.n_experts < 0:
+            raise ValueError(f"n_experts must be >= 0, "
+                             f"got {self.n_experts}")
+        if self.n_experts:
+            if not 1 <= self.moe_top_k <= self.n_experts:
+                raise ValueError(
+                    f"moe_top_k must be in [1, n_experts={self.n_experts}], "
+                    f"got {self.moe_top_k}")
+            if self.moe_every < 1:
+                raise ValueError(f"moe_every must be >= 1, "
+                                 f"got {self.moe_every}")
 
     @property
     def head_dim(self) -> int:
@@ -128,6 +156,15 @@ def init_params(key, cfg: ModelConfig) -> Dict:
     runs ``lax.scan`` over them: XLA compiles one layer body regardless of
     depth — compile time and program size stay O(1) in n_layers, which is
     the difference between seconds and minutes on TPU.
+
+    An MoE config (``cfg.n_experts`` > 0) REPLACES the dense
+    w_gate/w_up/w_down leaves of every layer with the routed expert
+    leaves (router [d, E], moe_gate/moe_up [E, d, f], moe_down
+    [E, f, d], and the f32 ``moe_route`` flag = 1.0 iff the layer
+    routes under ``cfg.moe_every``) — every layer carries the same
+    leaf structure so the layer scan stays uniform; non-routed layers
+    use their expert-0 slice as their dense FFN
+    (:func:`tpushare.ops.experts.moe_ffn`).
     """
     k_embed, k_head, k_stack = jax.random.split(key, 3)
     d, hd = cfg.d_model, cfg.head_dim
@@ -137,21 +174,39 @@ def init_params(key, cfg: ModelConfig) -> Dict:
         return (jax.random.normal(k, shape, dtype=jnp.float32)
                 / np.sqrt(fan_in)).astype(cfg.dtype)
 
-    def layer(k):
-        ks = jax.random.split(k, 7)
-        return {
+    def layer(k, idx):
+        ks = jax.random.split(k, 8)
+        out = {
             "attn_scale": jnp.ones((d,), cfg.dtype),
             "wq": dense(ks[0], d, (d, d)),
             "wk": dense(ks[1], d, (d, kvd)),
             "wv": dense(ks[2], d, (d, kvd)),
             "wo": dense(ks[3], d, (d, d)),
             "ffn_scale": jnp.ones((d,), cfg.dtype),
-            "w_gate": dense(ks[4], d, (d, cfg.d_ff)),
-            "w_up": dense(ks[5], d, (d, cfg.d_ff)),
-            "w_down": dense(ks[6], cfg.d_ff, (cfg.d_ff, d)),
         }
+        if cfg.n_experts:
+            def experts(kk, fan_in, shape):
+                return jax.vmap(lambda q: dense(q, fan_in, shape))(
+                    jax.random.split(kk, cfg.n_experts))
 
-    layers = jax.vmap(layer)(jax.random.split(k_stack, cfg.n_layers))
+            out.update({
+                "router": dense(ks[7], d, (d, cfg.n_experts)),
+                "moe_gate": experts(ks[4], d, (d, cfg.d_ff)),
+                "moe_up": experts(ks[5], d, (d, cfg.d_ff)),
+                "moe_down": experts(ks[6], cfg.d_ff, (cfg.d_ff, d)),
+                "moe_route": (idx % cfg.moe_every == 0)
+                .astype(jnp.float32),
+            })
+        else:
+            out.update({
+                "w_gate": dense(ks[4], d, (d, cfg.d_ff)),
+                "w_up": dense(ks[5], d, (d, cfg.d_ff)),
+                "w_down": dense(ks[6], cfg.d_ff, (cfg.d_ff, d)),
+            })
+        return out
+
+    layers = jax.vmap(layer)(jax.random.split(k_stack, cfg.n_layers),
+                             jnp.arange(cfg.n_layers))
     return {
         "embed": dense(k_embed, d, (cfg.vocab, d)),
         "layers": layers,
@@ -499,7 +554,8 @@ def _attend_dense(p, xin, cfg: ModelConfig, positions,
                      mesh=mesh), None
 
 
-def _attn_ffn(layer, x, cfg: ModelConfig, attend, lora=None):
+def _attn_ffn(layer, x, cfg: ModelConfig, attend, lora=None,
+              moe_mesh=None):
     """THE pre-norm decoder layer, once: rmsnorm -> attend -> o-proj
     residual -> rmsnorm -> ffn residual.
 
@@ -509,15 +565,27 @@ def _attn_ffn(layer, x, cfg: ModelConfig, attend, lora=None):
     ``lora`` (see :func:`_mm_ad`) adds each row's gathered adapter
     delta to the o-projection and FFN matmuls (the attend closure
     threads it into :func:`_qkv` itself).
+
+    Returns ``(x, carry, load)``: an MoE layer (it carries a "router"
+    leaf — :func:`init_params` on an ``n_experts`` config) routes its
+    FFN through :func:`tpushare.ops.experts.moe_ffn` and ``load`` is
+    that layer's [E] f32 token→expert counts (``moe_mesh`` reaches the
+    expert-parallel shard_map); a dense-FFN layer returns ``load`` =
+    None — an EMPTY pytree, so scan ys keep one structure and the
+    pre-MoE traces stay byte-identical.  MoE layers skip FFN adapter
+    deltas by construction: serving pools on MoE configs carry
+    attention targets only (``ops.lora.serving_adapter_dims``).
     """
     b, s, _ = x.shape
     xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
     o, carry = attend(layer, xin)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     x = x + _mm_ad(o, layer["wo"], lora, "wo")
-    x = x + ffn_block(layer, rmsnorm(x, layer["ffn_scale"], cfg.norm_eps),
-                      lora=lora)
-    return x, carry
+    xn = rmsnorm(x, layer["ffn_scale"], cfg.norm_eps)
+    if "router" in layer:
+        y, load = moe_ffn(xn, layer, cfg, mesh=moe_mesh)
+        return x + y, carry, load
+    return x + ffn_block(layer, xn, lora=lora), carry, None
 
 
 def ffn_block(p, x, lora=None):
@@ -536,7 +604,9 @@ def forward(params, tokens, cfg: ModelConfig,
             return_hidden: bool = False,
             mesh=None,
             adapters=None,
-            adapter_ids=None):
+            adapter_ids=None,
+            moe_mesh=None,
+            return_expert_load: bool = False):
     """tokens [B, S] -> logits [B, S, vocab] (+ updated caches if given).
 
     Runs ``lax.scan`` over the stacked layer params (one compiled layer
@@ -569,6 +639,14 @@ def forward(params, tokens, cfg: ModelConfig,
     inside this one jitted program — see :func:`_mm_ad`.  ``None``
     (the default) traces the exact pre-adapter program.
 
+    ``moe_mesh`` (MoE configs) reaches the expert-parallel shard_map in
+    :func:`tpushare.ops.experts.moe_ffn` — callers gate it via
+    ``ops.experts.expert_fallback_reason`` (None = the replicated
+    gather, value-identical).  ``return_expert_load=True`` appends the
+    summed [E] f32 token→expert counts (None on dense configs) to the
+    return tuple — it stays a device value; serving entries fetch it
+    at their observe cadence.
+
     ``remat_policy`` (no-cache path only) wraps the scanned layer body
     in per-layer ``jax.checkpoint``: the backward holds one layer's
     internals at a time plus whatever the policy saves — pass
@@ -598,35 +676,38 @@ def forward(params, tokens, cfg: ModelConfig,
         def body(x, layer_and_ad):
             layer, ad = layer_and_ad
             lora = lora_of(ad)
-            return _attn_ffn(
+            x, _, load = _attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, attention_fn=attention_fn,
-                    mesh=mesh, lora=lora), lora=lora)
+                    mesh=mesh, lora=lora), lora=lora, moe_mesh=moe_mesh)
+            return x, load
 
         if remat_policy is not None:
             body = jax.checkpoint(
                 body, policy=None if remat_policy is True else remat_policy,
                 prevent_cse=False)   # scan carries already block CSE
-        x, _ = jax.lax.scan(body, x, (params["layers"], ad_scan))
+        x, loads = jax.lax.scan(body, x, (params["layers"], ad_scan))
         new_caches = None
     else:
         def body(x, layer_and_cache):
             layer, ad, ck, cv = layer_and_cache
             lora = lora_of(ad)
-            return _attn_ffn(
+            x, (ck, cv), load = _attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: _attend_dense(
                     lyr, xin, cfg, positions, kv_cache=(ck, cv),
                     cache_len=cache_len, kv_write_len=kv_write_len,
-                    lora=lora), lora=lora)
+                    lora=lora), lora=lora, moe_mesh=moe_mesh)
+            return x, (ck, cv, load)
 
         ck, cv = kv_caches
-        x, (new_ck, new_cv) = jax.lax.scan(
+        x, (new_ck, new_cv, loads) = jax.lax.scan(
             body, x, (params["layers"], ad_scan, ck, cv))
         new_caches = (new_ck, new_cv)
 
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
+    expert_load = None if loads is None else loads.sum(axis=0)
     if return_hidden:
         # pre-head hidden states (post final norm): the chunked-loss
         # path applies the LM head itself, one sequence chunk at a
@@ -636,6 +717,10 @@ def forward(params, tokens, cfg: ModelConfig,
             return x, new_caches
         return x
     logits = _head_mm(x, params["lm_head"])
+    if return_expert_load:
+        if new_caches is not None:
+            return logits, new_caches, expert_load
+        return logits, expert_load
     if new_caches is not None:
         return logits, new_caches
     return logits
@@ -663,7 +748,7 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b // n_micro, s))
 
     def layer_fn(layer, x):
-        x, _ = _attn_ffn(
+        x, _, _ = _attn_ffn(
             layer, x, cfg,
             lambda lyr, xin: _attend_dense(lyr, xin, cfg, positions))
         return x
@@ -750,11 +835,15 @@ def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
             def body(h, layer_and):
                 layer, ad, ckr, cvr = layer_and
                 lora = None if ad is None else (ad, ad_scales, ids)
-                return _attn_ffn(
+                # staged serving demotes ep (the ``ep_mesh`` gate), so
+                # MoE layers run the replicated gather per stage and
+                # the per-layer load is discarded
+                h, carry, _ = _attn_ffn(
                     layer, h, cfg,
                     lambda lyr, xi: _attend_dense(
                         lyr, xi, cfg, pos, kv_cache=(ckr, cvr),
                         cache_len=cl_rows, lora=lora), lora=lora)
+                return h, carry
 
             h, (nck, ncv) = jax.lax.scan(
                 body, xin, (layers_local, ad_local, ck_rows, cv_rows))
@@ -1105,7 +1194,8 @@ def _sp_striped_attention(q, k_store, v_store, page_table, positions,
 
 def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                          page_table, lengths, mesh=None,
-                         adapters=None, adapter_ids=None):
+                         adapters=None, adapter_ids=None,
+                         moe_mesh=None, return_expert_load=False):
     """One decode step for every slot against the paged pool.
 
     tokens [B, 1]; pools from :func:`init_paged_kv`; page_table
@@ -1115,6 +1205,8 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
     (trash page, beyond-length lanes) are masked exactly like the dense
     cache's unwritten tail.  ``mesh`` (tensor-parallel serving) reaches
     :func:`paged_attention`, which runs the Pallas read per shard.
+    ``moe_mesh``/``return_expert_load`` mirror :func:`forward`: the
+    ep-sharded expert path and the summed per-expert assignment counts.
     """
     b, s = tokens.shape
     positions = lengths[:, None] + jnp.arange(s)[None, :]
@@ -1144,12 +1236,17 @@ def forward_paged_decode(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend, lora=lora)
+        x, carry, load = _attn_ffn(layer, x, cfg, attend, lora=lora,
+                                   moe_mesh=moe_mesh)
+        return x, (*carry, load)
 
-    x, (new_kp, new_vp) = jax.lax.scan(
+    x, (new_kp, new_vp, loads) = jax.lax.scan(
         body, x, (params["layers"], ad_scan, kp, vp))
     x = rmsnorm(x, params["final_scale"], cfg.norm_eps)
     logits = _head_mm(x, params["lm_head"])
+    if return_expert_load:
+        expert_load = None if loads is None else loads.sum(axis=0)
+        return logits, (new_kp, new_vp), expert_load
     return logits, (new_kp, new_vp)
 
 
@@ -1229,7 +1326,10 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
                                         mesh=None)
                     return o, (kp2, vp2)
 
-                return _attn_ffn(layer, h, cfg, attend, lora=lora)
+                # ep demotes under pp (``ep_mesh``): replicated expert
+                # gather per stage, per-layer load discarded
+                h, carry, _ = _attn_ffn(layer, h, cfg, attend, lora=lora)
+                return h, carry
 
             h, (nkp, nvp) = jax.lax.scan(
                 body, xin, (layers_local, ad_local, kpl, vpl))
@@ -1284,7 +1384,8 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
 
 def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
                          page_table, lengths, mesh=None,
-                         adapters=None, adapter_ids=None):
+                         adapters=None, adapter_ids=None,
+                         moe_mesh=None):
     """Speculative VERIFY step against the paged pool: every slot's
     pending token plus its k proposal tokens scored in one forward.
 
@@ -1348,7 +1449,9 @@ def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend, lora=lora)
+        x, carry, _ = _attn_ffn(layer, x, cfg, attend, lora=lora,
+                                moe_mesh=moe_mesh)
+        return x, carry
 
     x, (new_kp, new_vp) = jax.lax.scan(
         body, x, (params["layers"], ad_scan, kp, vp))
@@ -1359,7 +1462,8 @@ def forward_paged_verify(params, tokens, cfg: ModelConfig, pools,
 
 def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
                                 page_rows, pos, last_idx, mesh=None,
-                                adapters=None, adapter_ids=None):
+                                adapters=None, adapter_ids=None,
+                                moe_mesh=None):
     """One prompt WINDOW into a slot's reserved pages at offset ``pos``.
 
     tokens [1, W] with W a multiple of the page size and ``pos``
@@ -1411,7 +1515,9 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
                                 cfg, mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend, lora=lora)
+        x, carry, _ = _attn_ffn(layer, x, cfg, attend, lora=lora,
+                                moe_mesh=moe_mesh)
+        return x, carry
 
     x, (new_kp, new_vp) = jax.lax.scan(
         body, x, (params["layers"], ad_scan, kp, vp))
@@ -1422,7 +1528,8 @@ def forward_paged_prefill_chunk(params, tokens, cfg: ModelConfig, pools,
 
 def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
                                 page_rows, pos, last_idx, mesh=None,
-                                adapters=None, adapter_ids=None):
+                                adapters=None, adapter_ids=None,
+                                moe_mesh=None):
     """Coalesced MULTI-prompt prefill: one window per row, each into its
     own slot's reserved pages, in a single forward — the paged half of
     the mixed-step scheduler (one device dispatch per service round).
@@ -1482,7 +1589,9 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
                                 mesh=mesh)
             return o, (kp2, vp2)
 
-        return _attn_ffn(layer, x, cfg, attend, lora=lora)
+        x, carry, _ = _attn_ffn(layer, x, cfg, attend, lora=lora,
+                                moe_mesh=moe_mesh)
+        return x, carry
 
     x, (new_kp, new_vp) = jax.lax.scan(
         body, x, (params["layers"], ad_scan, kp, vp))
@@ -1494,7 +1603,8 @@ def forward_paged_prefill_batch(params, tokens, cfg: ModelConfig, pools,
 
 def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
                           page_rows, prompt_len: int, mesh=None,
-                          adapters=None, adapter_ids=None):
+                          adapters=None, adapter_ids=None,
+                          moe_mesh=None):
     """Prefill ONE whole request into its reserved pages: the page-
     aligned chunk body (:func:`forward_paged_prefill_chunk`) at pos 0,
     with the prompt padded to a page multiple.  Returns (last-position
@@ -1507,5 +1617,6 @@ def forward_paged_prefill(params, tokens, cfg: ModelConfig, pools,
         tokens = jnp.pad(tokens[:, :s], ((0, 0), (0, w - s)))
     logits, pools = forward_paged_prefill_chunk(
         params, tokens, cfg, pools, page_rows, 0, prompt_len - 1,
-        mesh=mesh, adapters=adapters, adapter_ids=adapter_ids)
+        mesh=mesh, adapters=adapters, adapter_ids=adapter_ids,
+        moe_mesh=moe_mesh)
     return logits[None], pools

@@ -1,0 +1,242 @@
+"""Grouped-gather matmuls: per-row/per-token weight selection from a
+stacked pool, in one dispatch.
+
+THE shared BGMV primitive (Punica's shape): a batch where every row —
+or every token — rides its OWN weight matrix gathered by index from a
+stacked device pool, contracted in one einsum instead of one dispatch
+per group.  Two consumers route through :func:`gathered_matmul`:
+
+* multi-adapter LoRA serving (:func:`tpushare.ops.lora
+  .batched_adapter_matmul`) — 1-D ``ids`` [B], one adapter per row;
+* MoE expert dispatch (:func:`moe_ffn`) — 2-D ``ids`` [B, S], top-k
+  experts per TOKEN, the round-22 serving workload.
+
+Confinement (lint rule ``expert-gather-confined``,
+``analysis/tpulint.py``): pool-through-index gathers of expert/adapter
+pools live HERE, like ``pallas_call`` lives in ops/attention.py — a
+stray ``jnp.take(pool, ids)`` elsewhere would bypass the one shape the
+Mosaic precheck and the chip drive (drives/drive_moe_decode.py) cover.
+
+Routing containment (DESIGN.md "Expert-parallel decode"): top-k
+gather keeps the math ROW-LOCAL — a token's output depends on its own
+hidden state and its own experts' weights only; the batch dim never
+enters a reduction — so a mixed batch's rows equal the same requests
+served solo, and adding MoE to a dispatch flavor cannot change any
+other row's stream.  That is the same identity contract adapter row 0
+gives LoRA serving.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .quant import matmul_maybe_q as _mm
+
+#: WHY the ep-sharded expert path cannot run (the gate demotes to the
+#: replicated gather — value-preserving, never an error), mirroring
+#: ``ops.attention.FALLBACK_REASONS``.  Enum-pinned against
+#: ``tpushare_expert_fallback_total{reason=}`` in the metric lint.
+EXPERT_FALLBACK_REASONS = ("ep_experts", "ep_mesh")
+
+
+def expert_fallback_reason(n_experts: int, ep: int,
+                           pp: int = 1) -> Optional[str]:
+    """THE viability gate for expert-parallel (ep-sharded) MoE serving,
+    returning WHY the sharded path cannot run (None = viable) so
+    fallback sites can label ``tpushare_expert_fallback_total``.
+
+    Every reason is STRUCTURAL (applies on all platforms, like
+    ``pp_mesh``), and a refusal is a DEMOTION, never an error: the
+    expert pool legalizes to replication and the plain gather serves
+    the exact same streams — only the /ep per-device HBM saving is
+    lost.
+
+    * ``ep_experts`` — ``n_experts % ep != 0``: every shard must own an
+      equal expert slice for the ``shard_map`` pool split (the
+      placement sharding legalizes the same way).
+    * ``ep_mesh`` — ``pp > 1``: the ep shard_map does not nest inside
+      the round-21 staged wavefront (which shard_maps over "pp" alone);
+      ep composes with tp/sp only.
+    """
+    if ep <= 1:
+        return None
+    if n_experts % ep:
+        return "ep_experts"
+    if pp > 1:
+        return "ep_mesh"
+    return None
+
+
+def count_expert_fallback(reason: str) -> None:
+    """Bump ``tpushare_expert_fallback_total{reason=}`` — called at
+    every ep-gate demotion site (batcher construction; once per
+    service, not per dispatch).  Lazy import: ops must stay importable
+    without the serving plane."""
+    from ..serving.metrics import EXPERT_FALLBACK
+    EXPERT_FALLBACK.inc(reason=reason)
+
+
+def expert_pool_bytes(cfg, dtype=None) -> int:
+    """Persistent HBM the whole stacked expert pool costs (router +
+    gate/up/down expert stacks across every layer, plus the per-layer
+    f32 route flag) — the MoE analogue of
+    :func:`tpushare.ops.lora.adapter_entry_bytes`: capacity math and
+    the ``tpushare_expert_pool_bytes`` gauge both price through here.
+    Divide by the ep degree for the per-device share under a viable
+    ep sharding."""
+    if not getattr(cfg, "n_experts", 0):
+        return 0
+    dtype = dtype or cfg.dtype
+    item = jnp.dtype(dtype).itemsize
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+    elems = cfg.n_layers * (d * e + 3 * e * d * f)
+    return int(elems * item + cfg.n_layers * 4)
+
+
+def gathered_matmul(x, pool, ids):
+    """Gathered matmul against a stacked weight pool — THE one
+    grouped-gather contraction (BGMV):
+
+    * ``ids`` [B] (per-ROW: LoRA adapters): row b of ``x`` [B, S, d_in]
+      contracts with ``pool[ids[b]]`` — ``[N, d_in, d_out]`` pool,
+      result [B, S, d_out];
+    * ``ids`` [B, S] (per-TOKEN: MoE experts): token (b, s) contracts
+      with ``pool[ids[b, s]]`` — ``[E, d_in, d_out]`` pool, same
+      result shape.
+
+    The gather + einsum stay row-local (no reduction over the batch or
+    pool dims), so a row's numbers are independent of which other
+    groups share the dispatch — the mixed-batch identity contract both
+    consumers rely on.  Weights cast to ``x.dtype`` AFTER the gather,
+    preserving the exact take→astype→einsum op order the round-20
+    LoRA goldens pinned."""
+    w = jnp.take(pool, ids, axis=0).astype(x.dtype)
+    if ids.ndim == 1:
+        return jnp.einsum("bsd,bdo->bso", x, w)      # [B, d_in, d_out]
+    return jnp.einsum("bsd,bsdo->bso", x, w)         # [B, S, d_in, d_out]
+
+
+def _expert_block(x, gate, up, down, ids):
+    """One expert-FFN evaluation with per-token gathered weights —
+    the SwiGLU body of :func:`tpushare.models.transformer.ffn_block`
+    with every matmul routed through :func:`gathered_matmul`."""
+    h = jax.nn.silu(gathered_matmul(x, gate, ids)) \
+        * gathered_matmul(x, up, ids)
+    return gathered_matmul(h, down, ids)
+
+
+def _moe_compute(x, gate, up, down, topi, topw, k: int):
+    """Replicated top-k expert mixture: static unroll over the k slots
+    (k is a small config constant), each slot one gathered expert FFN
+    weighted by its renormalized router weight."""
+    y = jnp.zeros(x.shape[:-1] + (down.shape[-1],), x.dtype)
+    for slot in range(k):
+        ids = topi[..., slot]                        # [B, S]
+        w = topw[..., slot]                          # [B, S] f32
+        y = y + _expert_block(x, gate, up, down, ids) \
+            * w[..., None].astype(x.dtype)
+    return y
+
+
+def _moe_compute_sharded(x, gate, up, down, topi, topw, k: int, mesh,
+                         axis: str):
+    """Expert-parallel mixture: each shard owns ``E/ep`` experts
+    (``shard_map`` over the ``ep`` axis alone — activations and routing
+    replicate), evaluates only the slots that land in its local expert
+    range (out-of-range slots gather a clipped row and contribute with
+    weight EXACTLY 0.0), and one ``psum`` folds the shard partials.
+
+    The per-shard FLOPs equal the replicated path's (masked, not
+    skipped — static shapes); the ep win is expert-pool HBM: each
+    device holds 1/ep of the gate/up/down stacks.  Within a config the
+    mixture is deterministic (routing is computed once, outside the
+    shard_map), so every dispatch flavor stays exactly
+    self-consistent; across ep degrees the psum fold can reassociate
+    the slot additions, the same accuracy-bounded contract as tp."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.shardmap_compat import shard_map
+
+    pool = P(axis, None, None)
+    rep = P()
+
+    def body(xl, gl, ul, dl, ti, tw):
+        shard = jax.lax.axis_index(axis)
+        e_local = gl.shape[0]
+        lo = shard * e_local
+        local = ti - lo                              # [B, S, k]
+        ok = (local >= 0) & (local < e_local)
+        ids = jnp.clip(local, 0, e_local - 1)
+        y = jnp.zeros(xl.shape[:-1] + (dl.shape[-1],), xl.dtype)
+        for slot in range(k):
+            w = tw[..., slot] * ok[..., slot].astype(tw.dtype)
+            y = y + _expert_block(xl, gl, ul, dl, ids[..., slot]) \
+                * w[..., None].astype(xl.dtype)
+        return jax.lax.psum(y, axis)
+
+    return shard_map(body, mesh=mesh,
+                     in_specs=(rep, pool, pool, pool, rep, rep),
+                     out_specs=rep, check_vma=False)(
+        x, gate, up, down, topi, topw)
+
+
+def moe_ffn(x, layer, cfg, mesh=None, axis: str = "ep"
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed expert FFN for one layer: ``(y [B, S, d], load [E] f32)``.
+
+    ``layer`` carries the MoE leaves :func:`tpushare.models.transformer
+    .init_params` builds for an ``n_experts`` config: ``router``
+    [d, E], ``moe_gate``/``moe_up`` [E, d, f], ``moe_down`` [E, f, d],
+    and the f32 scalar ``moe_route`` (1.0 = this layer routes, 0.0 =
+    it FORCES expert 0 with weight exactly 1.0 — the dense-FFN
+    interleave of a ``moe_every`` config, sharing one scanned layer
+    body).  Router softmax and top-k run in f32; the k selected
+    experts' renormalized weights mix gathered expert FFNs
+    (:func:`gathered_matmul` — per-token, row-local).
+
+    ``load`` counts this dispatch's token→expert assignments (zeroed
+    on forced layers so the balance histogram sees ROUTED traffic
+    only); it stays on device — serving entries fetch it at the
+    derived-observe cadence.
+
+    ``mesh`` (with a >1 ``axis`` dividing ``n_experts``) runs the
+    expert-parallel path; callers gate via
+    :func:`expert_fallback_reason` — this dispatcher re-checks
+    defensively and falls back to the replicated gather.
+
+    The ``n_experts == 1, moe_top_k == 1`` degenerate config
+    short-circuits to the plain SwiGLU on expert row 0 — bit-identical
+    to :func:`tpushare.models.transformer.ffn_block` on equal weights
+    (the router is never evaluated), mirroring adapter row 0's
+    identity story."""
+    e, k = cfg.n_experts, cfg.moe_top_k
+    route = layer["moe_route"]
+    n_tokens = x.shape[0] * x.shape[1]
+    if e == 1 and k == 1:
+        g = _mm(x, layer["moe_gate"][0])
+        u = _mm(x, layer["moe_up"][0])
+        y = _mm(jax.nn.silu(g) * u, layer["moe_down"][0])
+        return y, jnp.full((1,), float(n_tokens), jnp.float32) * route
+    logits = _mm(x, layer["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # [B, S, E]
+    topw, topi = jax.lax.top_k(probs, k)             # [B, S, k]
+    topw = topw / topw.sum(axis=-1, keepdims=True)
+    forced_w = jnp.zeros_like(topw).at[..., 0].set(1.0)
+    topi = jnp.where(route > 0, topi, 0)
+    topw = jnp.where(route > 0, topw, forced_w)
+    load = (jax.nn.one_hot(topi, e, dtype=jnp.float32)
+            .sum(axis=(0, 1, 2)) * route)            # [E]
+    ep = 1
+    if mesh is not None and axis in mesh.axis_names:
+        ep = int(mesh.shape[axis])
+    if ep > 1 and e % ep == 0:
+        y = _moe_compute_sharded(x, layer["moe_gate"], layer["moe_up"],
+                                 layer["moe_down"], topi, topw, k,
+                                 mesh, axis)
+    else:
+        y = _moe_compute(x, layer["moe_gate"], layer["moe_up"],
+                         layer["moe_down"], topi, topw, k)
+    return y, load

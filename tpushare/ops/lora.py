@@ -46,6 +46,11 @@ def _is_weight_dict(x) -> bool:
 #: and lm_head stay dense — the usual LoRA recipe).
 LORA_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
+#: Serving targets on an MoE config (round 22): the dense FFN leaves do
+#: not exist there — the routed expert pool replaces them — so serving
+#: adapters attach to the attention projections only.
+ATTN_LORA_SUFFIXES = ("wq", "wk", "wv", "wo")
+
 
 def _leaf_dims(leaf) -> tuple:
     """(d_in, d_out) of a 2D or stacked [L, d_in, d_out] weight leaf —
@@ -228,11 +233,17 @@ def make_lora_train_step(cfg, optimizer, remat: str = "none"):
 # hands it out — base-model rows ride the same program unchanged.
 
 
-def serving_adapter_dims(cfg, suffixes=LORA_SUFFIXES) -> Dict:
+def serving_adapter_dims(cfg, suffixes=None) -> Dict:
     """{leaf name: (d_in, d_out)} of the adapter targets — THE one
     definition of which projections carry serving adapters and their
     shapes; pool construction, byte pricing, and the synthetic loader
-    all derive from it so they cannot drift."""
+    all derive from it so they cannot drift.  MoE configs
+    (``cfg.n_experts``) restrict to the attention projections: their
+    layers carry no dense w_gate/w_up/w_down leaves for an adapter
+    delta to ride (the routed expert pool replaces them)."""
+    if suffixes is None:
+        suffixes = (ATTN_LORA_SUFFIXES
+                    if getattr(cfg, "n_experts", 0) else LORA_SUFFIXES)
     d = cfg.d_model
     kvd = cfg.n_kv_heads * cfg.head_dim
     dims = {"wq": (d, d), "wk": (d, kvd), "wv": (d, kvd),
@@ -328,12 +339,17 @@ def batched_adapter_matmul(x, a_pool, b_pool, scales, adapter_ids):
     contract).  The gather + two skinny matmuls stay row-local: the
     batch dim never enters a reduction, so a row's numbers are
     independent of which other adapters share the dispatch.
+
+    Both skinny matmuls route through the shared grouped-gather
+    primitive (:func:`tpushare.ops.experts.gathered_matmul` — same
+    take→astype→einsum op order as the pre-round-22 inline spelling,
+    so streams stay bit-identical); MoE expert dispatch rides the
+    identical shape with per-token ids.
     """
-    a = jnp.take(a_pool, adapter_ids, axis=0)      # [B, d_in, r]
-    b = jnp.take(b_pool, adapter_ids, axis=0)      # [B, r, d_out]
-    s = jnp.take(scales, adapter_ids, axis=0)      # [B] f32
-    xa = jnp.einsum("bsd,bdr->bsr", x, a.astype(x.dtype))
-    delta = jnp.einsum("bsr,bro->bso", xa, b.astype(x.dtype))
+    from .experts import gathered_matmul
+    xa = gathered_matmul(x, a_pool, adapter_ids)       # [B, S, r]
+    delta = gathered_matmul(xa, b_pool, adapter_ids)   # [B, S, d_out]
+    s = jnp.take(scales, adapter_ids, axis=0)          # [B] f32
     return delta * s[:, None, None].astype(x.dtype)
 
 

@@ -305,6 +305,52 @@ def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp",
     return out
 
 
+#: Serving-MoE expert-pool rules (round 22): the stacked expert stacks
+#: [L, E, d, f] / [L, E, f, d] shard their EXPERT dim over "ep" (the
+#: right-aligned legalization lands the leading rule axis on E — dim 1
+#: of the stacked leaf, mirroring how models/moe.py's EP_RULES shard
+#: the training-side pool), the router and the route flag replicate
+#: (every shard routes identically — routing runs OUTSIDE the ep
+#: shard_map, once).  Suffix-clash safe with SHARDING_RULES
+#: ("moe_gate" does not end with "w_gate"); prepend these to the base
+#: list so an ep mesh shards the pool and a no-ep mesh legalizes every
+#: entry back to replication — the ``ep_experts``/``ep_mesh`` gate
+#: demotion costs placement only, never correctness.
+EXPERT_SHARDING_RULES: List[Tuple[str, P]] = [
+    ("router", P()),
+    ("moe_route", P()),
+    ("moe_gate", P("ep", None, None)),
+    ("moe_up", P("ep", None, None)),
+    ("moe_down", P("ep", None, None)),
+]
+
+
+def shard_expert_pool(layers, mesh: Mesh, axis: str = "ep"):
+    """Place a stacked layers pytree's EXPERT leaves onto the mesh with
+    the expert dim sharded over ``axis`` — the standalone counterpart
+    of passing :data:`EXPERT_SHARDING_RULES` to :func:`shard_params`
+    (which the serving batcher does so base and expert placement happen
+    in one pass); drives and tests use this to shard just the pool.
+    Non-expert leaves replicate; the usual divisibility legalization
+    applies (``n_experts % ep != 0`` falls back to replication, the
+    ``ep_experts`` gate reason)."""
+    if axis not in mesh.axis_names:
+        return layers
+    from ..utils.treepath import param_key
+
+    def _place(path, leaf):
+        name = param_key(jax.tree_util.keystr(path))
+        spec = P()
+        for suffix, rule in EXPERT_SHARDING_RULES:
+            if name.endswith(suffix):
+                spec = P(*[axis if e == "ep" else e for e in rule])
+                break
+        return jax.device_put(
+            leaf, NamedSharding(mesh, _legalize(spec, leaf.shape, mesh)))
+
+    return jax.tree_util.tree_map_with_path(_place, layers)
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
     """Shard array leaves along their leading (batch) dim on ``axis``."""
     if axis not in mesh.axis_names:

@@ -49,6 +49,7 @@ MANIFEST: List[Tuple[str, str]] = [
     ("drive_prefix_cache.py", "PREFIX_CACHE_TPU.json"),
     ("drive_lora_gather.py", "LORA_GATHER_TPU.json"),
     ("drive_pp_decode.py", "PP_DECODE_TPU.json"),
+    ("drive_moe_decode.py", "MOE_DECODE_TPU.json"),
 ]
 
 

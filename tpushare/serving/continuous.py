@@ -42,10 +42,10 @@ log = logging.getLogger("tpushare.serving")
 _wrap_keys = jax.jit(jax.vmap(jax.random.wrap_key_data))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len"),
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "moe"),
                    donate_argnums=(2,))
 def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
-                   chunk_len: int, adapters=None, aids=None):
+                   chunk_len: int, adapters=None, aids=None, moe=None):
     """One prompt chunk into row ``slot`` at cache offset ``pos`` —
     whole-prompt prefill is just the ``pos=0`` single-chunk case, so
     the slice-row/forward/scatter body exists ONCE.
@@ -76,7 +76,8 @@ def _prefill_chunk(params, tokens, caches, slot, pos, last_idx, cfg,
     # length==p before attendable).
     logits, row = transformer.forward(
         params, tokens[:, :chunk_len], cfg, kv_caches=row, cache_len=pos,
-        kv_write_len=last_idx + 1, adapters=adapters, adapter_ids=aids)
+        kv_write_len=last_idx + 1, adapters=adapters, adapter_ids=aids,
+        moe_mesh=moe)
     caches = jax.tree_util.tree_map(
         lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
         caches, row)
@@ -145,28 +146,38 @@ def _sample_next(logits, temps, keys, top_ks=None, top_ps=None):
 
 
 def _pp_forward(params, tokens, caches, lengths, cfg, pp,
-                adapters=None, aids=None):
+                adapters=None, aids=None, moe=None):
     """The ONE dense decode-forward routing point for the round-21
     pipeline: ``pp`` is the hashable static ``(mesh, n_micro)`` pair
     (None = the exact pre-pp trace — byte-identity by construction).
     When set, the step runs :func:`transformer.forward_pp_decode` —
     the whole GPipe wavefront inside this same single dispatch, each
     stage decoding its microbatch against its LOCAL layer slice of
-    params and KV rows."""
+    params and KV rows.
+
+    Returns ``(logits, caches, expert_load)`` — the round-22 MoE
+    threading: ``moe`` is the hashable static ep Mesh (None = the
+    replicated gather, which a dense-FFN config traces byte-identically
+    to the pre-MoE program), and ``expert_load`` is the dispatch's
+    [E] token→expert assignment counts (None for dense-FFN configs and
+    for the staged pipeline, where ep demotes — the ``ep_mesh`` gate)."""
     if pp is None:
         return transformer.forward(
             params, tokens, cfg, kv_caches=caches, cache_len=lengths,
-            adapters=adapters, adapter_ids=aids)
+            adapters=adapters, adapter_ids=aids, moe_mesh=moe,
+            return_expert_load=True)
     mesh, n_micro = pp
-    return transformer.forward_pp_decode(
+    logits, caches = transformer.forward_pp_decode(
         params, tokens, cfg, caches, lengths, mesh, n_micro=n_micro,
         adapters=adapters, adapter_ids=aids)
+    return logits, caches, None
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "rich", "pp"),
+@functools.partial(jax.jit, static_argnames=("cfg", "rich", "pp", "moe"),
                    donate_argnums=(2,))
 def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
-          rich: bool = False, adapters=None, aids=None, pp=None):
+          rich: bool = False, adapters=None, aids=None, pp=None,
+          moe=None):
     """Advance every slot one token; tokens [B,1], lengths [B].
 
     Per-slot sampling via :func:`_sample_next` — greedy and sampling
@@ -176,44 +187,59 @@ def _tick(params, tokens, caches, lengths, temps, keys, tks, tps, cfg,
     cache is donated: XLA updates it in place instead of holding two
     full copies across the hot loop.  ``pp`` (static; see
     :func:`_pp_forward`) swaps the forward for the staged pipeline
-    program — None traces byte-identically to the pre-pp tick.
+    program — None traces byte-identically to the pre-pp tick.  ``moe``
+    (static ep Mesh; round 22) threads the expert-parallel path; the
+    returned ``load`` stays device-resident (the entry fetches it only
+    at the derived-observe cadence, guard-interior).
     """
-    logits, caches = _pp_forward(params, tokens, caches, lengths, cfg,
-                                 pp, adapters=adapters, aids=aids)
+    logits, caches, load = _pp_forward(params, tokens, caches, lengths,
+                                       cfg, pp, adapters=adapters,
+                                       aids=aids, moe=moe)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
-    return nxt, caches
+    return nxt, caches, load
 
 
 def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
                  incs, cfg, n: int, rich: bool, adapters=None,
-                 aids=None, pp=None):
+                 aids=None, pp=None, moe=None):
     """The fused decode scan BODY (trace-level, not jitted itself) —
     the one definition shared by :func:`_tick_n` and the mixed-step
     program :func:`_tick_mixed`, so the two dispatch flavors cannot
     drift.  See :func:`_tick_n` for the semantics contract.  ``pp``
     routes each step's forward through :func:`_pp_forward` — the
     staged program runs INSIDE the scan body, so the fused round stays
-    one dispatch."""
+    one dispatch.  A MoE config accumulates the per-step expert load
+    through the scan carry (summed [E] counts for the whole chunk;
+    None when the config is dense-FFN or the staged pipeline runs —
+    ep demotes under pp, so no load is produced to track)."""
+    track_load = bool(getattr(cfg, "n_experts", 0)) and pp is None
+
     def body(carry, _):
-        tok, caches, lengths, keys = carry
+        tok, caches, lengths, keys, lacc = carry
         ks = jax.vmap(jax.random.split)(keys)          # [B,2]: (next, sub)
-        logits, caches = _pp_forward(params, tok, caches, lengths, cfg,
-                                     pp, adapters=adapters, aids=aids)
+        logits, caches, load = _pp_forward(params, tok, caches, lengths,
+                                           cfg, pp, adapters=adapters,
+                                           aids=aids, moe=moe)
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
-        return (nxt[:, None], caches, lengths + incs, ks[:, 0]), nxt
+        if track_load:
+            lacc = lacc + load
+        return (nxt[:, None], caches, lengths + incs, ks[:, 0], lacc), nxt
 
-    (_, caches, _, keys), toks = jax.lax.scan(
-        body, (tokens, caches, lengths, keys), None, length=n)
-    return toks.T, keys, caches
+    lacc0 = (jnp.zeros((cfg.n_experts,), jnp.float32)
+             if track_load else None)
+    (_, caches, _, keys, lacc), toks = jax.lax.scan(
+        body, (tokens, caches, lengths, keys, lacc0), None, length=n)
+    return toks.T, keys, caches, lacc
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "pp"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "pp",
+                                             "moe"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
             cfg, n: int, rich: bool = False, adapters=None, aids=None,
-            pp=None):
+            pp=None, moe=None):
     """``n`` decode ticks in ONE device-resident ``lax.scan`` — one host
     round trip (and one ~70 ms tunnel RPC) per ``n`` tokens instead of
     per token, the same fusion :func:`tpushare.serving.generate
@@ -225,7 +251,8 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     PRNG keys are carried through the scan with the SAME
     ``key, sub = split(key)`` sequence the host loop performs — splits
     are deterministic, so any interleaving of ``tick``/``tick_fused``
-    yields the same stream.  Returns (tokens [B, n], final keys, caches);
+    yields the same stream.  Returns (tokens [B, n], final keys, caches,
+    accumulated expert load — see :func:`_decode_scan`);
     the caller consumes only each slot's first ``remaining`` tokens —
     steps past a finished slot write garbage K/V that is contained
     exactly like an inactive slot's (position p is overwritten at
@@ -243,16 +270,17 @@ def _tick_n(params, tokens, caches, lengths, temps, keys, tks, tps, incs,
     """
     return _decode_scan(params, tokens, caches, lengths, temps, keys,
                         tks, tps, incs, cfg, n, rich, adapters=adapters,
-                        aids=aids, pp=pp)
+                        aids=aids, pp=pp, moe=moe)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
-                                             "rich", "pp"),
+                                             "rich", "pp", "moe"),
                    donate_argnums=(7,))
 def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
                 src_mask, caches, tokens, lengths, temps, keys, tks, tps,
                 incs, cfg, chunk_len: int, n: int, rich: bool = False,
-                adapters=None, aids=None, p_aids=None, pp=None):
+                adapters=None, aids=None, p_aids=None, pp=None,
+                moe=None):
     """ONE device program per mixed service round: (a) the pending
     chunks of up to R mid-prefill slots coalesced into a single batched,
     padded prefill forward, then (b) the fused ``n``-step decode scan
@@ -286,14 +314,15 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     observe.
 
     Returns (chunk-final logits [R, V], decode tokens [B, n], final
-    keys, caches).
+    keys, caches, expert load — the ROUND's total: prefill block plus
+    decode scan, both halves of the one dispatch).
     """
     rows = jax.tree_util.tree_map(
         lambda c: jnp.take(c, p_slots, axis=1), caches)
-    p_logits, rows = transformer.forward(
+    p_logits, rows, p_load = transformer.forward(
         params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
         cache_len=p_pos, kv_write_len=p_last + 1, adapters=adapters,
-        adapter_ids=p_aids)
+        adapter_ids=p_aids, moe_mesh=moe, return_expert_load=True)
 
     def put(c, r):
         g = jnp.take(r, src_rows, axis=1)
@@ -302,13 +331,15 @@ def _tick_mixed(params, p_tokens, p_slots, p_pos, p_last, src_rows,
 
     caches = jax.tree_util.tree_map(put, caches, rows)
     sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
-    toks, keys, caches = _decode_scan(
+    toks, keys, caches, load = _decode_scan(
         params, tokens, caches, lengths, temps, keys, tks, tps, incs,
-        cfg, n, rich, adapters=adapters, aids=aids, pp=pp)
-    return sel, toks, keys, caches
+        cfg, n, rich, adapters=adapters, aids=aids, pp=pp, moe=moe)
+    if p_load is not None:
+        load = p_load if load is None else load + p_load
+    return sel, toks, keys, caches, load
 
 
-def _dense_spec_verify(params, cfg, adapters=None, aids=None):
+def _dense_spec_verify(params, cfg, adapters=None, aids=None, moe=None):
     """The dense slot pool's ``verify`` closure for
     :func:`tpushare.serving.speculative.spec_scan`: one cached forward
     over the ``[B, 1+k]`` blocks at each row's own depth.
@@ -325,19 +356,19 @@ def _dense_spec_verify(params, cfg, adapters=None, aids=None):
         logits, caches = transformer.forward(
             params, blocks, cfg, kv_caches=caches, cache_len=n_ctxs,
             kv_write_len=jnp.where(live, blocks.shape[1], 0),
-            adapters=adapters, adapter_ids=aids)
+            adapters=adapters, adapter_ids=aids, moe_mesh=moe)
         return logits, caches
 
     return verify
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
-                                             "n_rounds", "rich"),
+                                             "n_rounds", "rich", "moe"),
                    donate_argnums=(2,))
 def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
                remainings, actives, temps, keys, tks, tps, cfg, k: int,
                ngram: int, n_rounds: int, rich: bool = False,
-               adapters=None, aids=None):
+               adapters=None, aids=None, moe=None):
     """``n_rounds`` of batched PROMPT-LOOKUP speculative decoding in one
     dispatch — the continuous batcher's speculation path (the serving
     integration of :mod:`.speculative`'s single-request while_loop; the
@@ -373,7 +404,8 @@ def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
     ``bufs[i, old_len : old_len + produced[i]]``.
     """
     from .speculative import spec_scan
-    return spec_scan(_dense_spec_verify(params, cfg, adapters, aids),
+    return spec_scan(_dense_spec_verify(params, cfg, adapters, aids,
+                                        moe=moe),
                      _sample_next, bufs, buf_lens, n_ctxs, next_toks,
                      remainings, actives, temps, keys, tks, tps, caches,
                      k, ngram, n_rounds, rich)
@@ -381,14 +413,14 @@ def _tick_spec(params, bufs, caches, buf_lens, n_ctxs, next_toks,
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "k",
                                              "ngram", "n_rounds",
-                                             "rich"),
+                                             "rich", "moe"),
                    donate_argnums=(7,))
 def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
                      src_mask, caches, bufs, buf_lens, n_ctxs,
                      next_toks, remainings, actives, temps, keys, tks,
                      tps, cfg, chunk_len: int, k: int, ngram: int,
                      n_rounds: int, rich: bool = False,
-                     adapters=None, aids=None, p_aids=None):
+                     adapters=None, aids=None, p_aids=None, moe=None):
     """ONE device program per mixed service round WITH speculation: the
     coalesced budget-bounded prefill block (identical to
     :func:`_tick_mixed`'s prefill half), then ``n_rounds`` speculative
@@ -408,7 +440,7 @@ def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     p_logits, rows = transformer.forward(
         params, p_tokens[:, :chunk_len], cfg, kv_caches=rows,
         cache_len=p_pos, kv_write_len=p_last + 1, adapters=adapters,
-        adapter_ids=p_aids)
+        adapter_ids=p_aids, moe_mesh=moe)
 
     def put(c, r):
         g = jnp.take(r, src_rows, axis=1)
@@ -419,7 +451,8 @@ def _tick_mixed_spec(params, p_tokens, p_slots, p_pos, p_last, src_rows,
     sel = p_logits[jnp.arange(p_tokens.shape[0]), p_last]       # [R, V]
 
     from .speculative import spec_scan
-    out = spec_scan(_dense_spec_verify(params, cfg, adapters, aids),
+    out = spec_scan(_dense_spec_verify(params, cfg, adapters, aids,
+                                       moe=moe),
                     _sample_next, bufs, buf_lens, n_ctxs, next_toks,
                     remainings, actives, temps, keys, tks, tps, caches,
                     k, ngram, n_rounds, rich)
@@ -631,10 +664,42 @@ class ContinuousBatcher:
                 # wavefront program stays off — counted like every
                 # other kernel-path demotion
                 count_attn_fallback(self._pp_reason)
+        # Expert-parallel gate (round 22): a MoE cfg on a mesh with an
+        # "ep" axis shards the stacked expert pool over it and threads
+        # the mesh as the static ``moe`` operand into every jitted
+        # program (the per-layer gather runs shard-local + psum).
+        # Structural refusals (ops.experts.expert_fallback_reason:
+        # ``ep_experts`` = n_experts % ep, ``ep_mesh`` = the staged pp
+        # program keeps its flat replicated gather) DEMOTE to a
+        # replicated pool — counted, never a crash.  The demoted case
+        # must ALSO skip the ep sharding rules: a pool the partitioner
+        # has to all-gather per dispatch is strictly worse than
+        # replication.
+        self._moe_reason = None
+        self._moe_args = None
+        moe_rules = None
+        if getattr(cfg, "n_experts", 0):
+            from ..ops.experts import (expert_fallback_reason,
+                                       count_expert_fallback)
+            from ..ops.attention import tp_degree
+            ep = tp_degree(mesh, "ep") if mesh is not None else 1
+            if ep > 1:
+                self._moe_reason = expert_fallback_reason(
+                    cfg.n_experts, ep,
+                    pp=self.pp if self._pp_args is not None else 1)
+                if self._moe_reason is None:
+                    self._moe_args = mesh
+                    from ..parallel.mesh import (EXPERT_SHARDING_RULES,
+                                                 SHARDING_RULES)
+                    moe_rules = (list(EXPERT_SHARDING_RULES)
+                                 + list(SHARDING_RULES))
+                else:
+                    count_expert_fallback(self._moe_reason)
         if mesh is not None:
             from ..parallel.mesh import shard_params
             params = shard_params(
                 params, mesh,
+                **({"rules": moe_rules} if moe_rules is not None else {}),
                 layer_axis="pp" if "pp" in mesh.axis_names else None)
         self.params = params
         self.cfg = cfg
@@ -676,6 +741,11 @@ class ContinuousBatcher:
         # alongside rids into dispatch guards/spans and migration
         # blobs; populated only for requests that arrived with one
         self._rid_traces: Dict[int, str] = {}
+        # last round's per-expert routed-token counts ([E] device array
+        # from the one dispatch, None for non-MoE cfgs / pp-staged
+        # rounds) — flushed into tpushare_expert_load on the
+        # DERIVED_OBSERVE_EVERY cadence (see _maybe_observe_expert_load)
+        self._moe_load = None
         self._tick_count = 0
         self._init_storage()
         self._observe_storage()
@@ -698,6 +768,8 @@ class ContinuousBatcher:
         metrics.PP_STAGES.set(info.get("pp_stages", 1))
         metrics.PP_BUBBLE_FRACTION.set(
             info.get("pp_bubble_fraction", 0.0))
+        metrics.EXPERT_POOL_BYTES.set(info.get("expert_pool_bytes", 0))
+        metrics.MOE_EXPERTS.set(info.get("n_experts", 0))
 
     def _observe_tick(self, t0: float) -> None:
         """Record one tick's wall time and the post-tick occupancy."""
@@ -850,6 +922,32 @@ class ContinuousBatcher:
             # the SECOND HBM pool class (round 20): adapter residency
             # economics next to the KV pool's
             info.update(self.adapter_pool.storage_info())
+        info.update(self._expert_storage_info())
+        return info
+
+    def _expert_storage_info(self) -> dict:
+        """Expert-pool residency economics (round 22), shared by the
+        dense and paged ``storage_info``: the THIRD HBM pool class —
+        the stacked expert weights a MoE cfg keeps resident.  With the
+        ep gate admitted the pool shards its expert axis, so per-shard
+        bytes divide by the mesh's ep degree; demoted (``ep_experts``/
+        ``ep_mesh``) or mesh-less configs hold the whole pool
+        replicated."""
+        cfg = self.cfg
+        if not getattr(cfg, "n_experts", 0):
+            return {}
+        from ..ops.attention import tp_degree
+        from ..ops.experts import expert_pool_bytes
+        pool = expert_pool_bytes(cfg)
+        ep = (tp_degree(self.mesh, "ep")
+              if self._moe_args is not None else 1)
+        info = {"n_experts": int(cfg.n_experts),
+                "moe_top_k": int(cfg.moe_top_k),
+                "expert_pool_bytes": int(pool),
+                "ep_shards": int(ep),
+                "expert_pool_bytes_per_shard": int(pool // ep)}
+        if self._moe_reason is not None:
+            info["expert_fallback_reason"] = self._moe_reason
         return info
 
     def _pp_storage_info(self, pool_bytes: int) -> dict:
@@ -909,25 +1007,26 @@ class ContinuousBatcher:
             [self._slot_adapter.get(slot, 0)])
         logits, self.caches = _prefill_chunk(
             self.params, tokens, self.caches, slot, 0, prompt_len - 1,
-            self.cfg, prompt_len, adapters=adapters, aids=aids)
+            self.cfg, prompt_len, adapters=adapters, aids=aids,
+            moe=self._expert_operands())
         return logits
 
     def _step(self, tokens, lengths, temps, keys, tks, tps, rich,
               ads=None):
         adapters, aids = self._adapter_operands(ads)
-        nxt, self.caches = _tick(
+        nxt, self.caches, self._moe_load = _tick(
             self.params, tokens, self.caches, lengths, temps, keys,
             tks, tps, self.cfg, rich, adapters=adapters, aids=aids,
-            pp=self._pp_args)
+            pp=self._pp_args, moe=self._expert_operands())
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int, ads=None):
         adapters, aids = self._adapter_operands(ads)
-        toks, keys, self.caches = _tick_n(
+        toks, keys, self.caches, self._moe_load = _tick_n(
             self.params, tokens, self.caches, lengths, temps, keys,
             tks, tps, incs, self.cfg, n_steps, rich, adapters=adapters,
-            aids=aids, pp=self._pp_args)
+            aids=aids, pp=self._pp_args, moe=self._expert_operands())
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -939,7 +1038,7 @@ class ContinuousBatcher:
         logits, self.caches = _prefill_chunk(
             self.params, jnp.asarray(padded_tokens), self.caches,
             slot, pos, last_idx, self.cfg, chunk_len, adapters=adapters,
-            aids=aids)
+            aids=aids, moe=self._expert_operands())
         return logits
 
     # -- session migration capability ----------------------------------
@@ -1087,6 +1186,36 @@ class ContinuousBatcher:
         if ads is None:
             ads = np.zeros((self.n_slots,), np.int32)  # all-identity
         return self.adapter_pool.device_operands(), jnp.asarray(ads)
+
+    def _expert_operands(self):
+        """The static ``moe`` operand for the MoE-threaded programs: the
+        serving mesh when the ep gate admitted expert sharding, else
+        None (which traces the replicated gather — byte-identical to a
+        mesh-less batcher for a non-MoE cfg).  HOST-side handle passing
+        only, like :meth:`_adapter_operands` (hook-interior — audited
+        by dispatch_audit's expert-operand rule; must never dispatch or
+        fetch)."""
+        return self._moe_args
+
+    def _maybe_observe_expert_load(self) -> None:
+        """Flush the last round's accumulated per-expert token counts
+        into the ``tpushare_expert_load`` histogram — every
+        ``DERIVED_OBSERVE_EVERY`` ticks, like the goodput re-derivation
+        (the [E] fetch is one tiny transfer, but per-tick it would
+        shave the <2% telemetry overhead budget).  Guard-INTERIOR on
+        purpose: the fetch drains the in-flight dispatch, so it must
+        count as device wait, not host time."""
+        if self._moe_load is None:
+            return
+        if self._tick_count % DERIVED_OBSERVE_EVERY:
+            return
+        load = np.asarray(self._moe_load)
+        total = float(load.sum())
+        if total > 0.0:
+            # observe each expert's SHARE of the round's routed tokens:
+            # a balanced router puts every sample near 1/E, a collapsed
+            # one bimodal at 0 and 1 — dimensionless by design
+            metrics.EXPERT_LOAD.observe_many((load / total).tolist())
 
     # -- speculation capability ----------------------------------------
     def spec_fallback_reason(self, k: int) -> Optional[str]:
@@ -1424,6 +1553,7 @@ class ContinuousBatcher:
                 _wrap_keys(jnp.asarray(keys)),
                 jnp.asarray(tks), jnp.asarray(tps), self._rich(),
                 ads=self._adapter_ids_array()))
+            self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         for i in list(self.slots):
@@ -1487,6 +1617,7 @@ class ContinuousBatcher:
                     ads=self._adapter_ids_array())
             toks = np.asarray(toks)
             new_keys = np.asarray(jax.random.key_data(new_keys))
+            self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
         self._drain_fused_tokens(toks, new_keys, n_steps)
@@ -1540,13 +1671,14 @@ class ContinuousBatcher:
         src_rows, src_mask = self._mixed_src(p_slots, p_active)
         adapters, aids = self._adapter_operands(ads)
         _, p_aids = self._adapter_operands(p_ads)
-        sel, toks, keys, self.caches = _tick_mixed(
+        sel, toks, keys, self.caches, self._moe_load = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_slots),
             jnp.asarray(p_pos), jnp.asarray(p_last),
             jnp.asarray(src_rows), jnp.asarray(src_mask), self.caches,
             tokens, lengths, temps, keys, tks, tps, incs,
             self.cfg, chunk_len, n_steps, rich, adapters=adapters,
-            aids=aids, p_aids=p_aids, pp=self._pp_args)
+            aids=aids, p_aids=p_aids, pp=self._pp_args,
+            moe=self._expert_operands())
         return sel, toks, keys
 
     def _mixed_src(self, p_slots, p_active):
@@ -1572,7 +1704,8 @@ class ContinuousBatcher:
          self.caches) = _tick_spec(
             self.params, bufs, self.caches, buf_lens, n_ctxs, next_toks,
             remainings, actives, temps, keys, tks, tps, self.cfg, k,
-            ngram, n_rounds, rich, adapters=adapters, aids=aids)
+            ngram, n_rounds, rich, adapters=adapters, aids=aids,
+            moe=self._expert_operands())
         return bufs, produced, next_toks, keys, accepts, lives
 
     def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
@@ -1594,7 +1727,7 @@ class ContinuousBatcher:
             bufs, buf_lens, n_ctxs, next_toks, remainings, actives,
             temps, keys, tks, tps, self.cfg, chunk_len, k, ngram,
             n_rounds, rich, adapters=adapters, aids=aids,
-            p_aids=p_aids)
+            p_aids=p_aids, moe=self._expert_operands())
         return sel, bufs, produced, next_toks, keys, accepts, lives
 
     def _plan_mixed_round(self, chunk: int, budget: int):
@@ -1766,6 +1899,7 @@ class ContinuousBatcher:
             if n_active:
                 toks = np.asarray(toks)
                 new_keys = np.asarray(jax.random.key_data(new_keys))
+            self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, decode_rids, prefill_rids)
         if n_active:
             self._drain_fused_tokens(toks, new_keys, n_steps)

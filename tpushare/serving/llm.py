@@ -27,14 +27,18 @@ log = logging.getLogger("tpushare.llm")
 
 def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
                 quantize_int4: bool = False, kv_dtype: str = "bf16",
-                attn_kernel: str = "xla"):
+                attn_kernel: str = "xla", n_experts: int = 0,
+                moe_top_k: int = 1, moe_every: int = 1):
     """``kv_dtype="int8"`` stores the serving KV cache quantized
     (per-token scales, ~2x sequences per HBM byte; decode is accuracy-
     bounded, not bit-identical — see DESIGN.md "Quantized KV").
     Orthogonal to the weight-only ``--int8``/``--int4`` flags.
     ``attn_kernel="pallas"`` reads paged KV pools through the fused
     Pallas decode kernel instead of the XLA gather (DESIGN.md "The
-    paged decode kernel"); dense storage ignores it."""
+    paged decode kernel"); dense storage ignores it.
+    ``n_experts > 0`` swaps every ``moe_every``-th FFN for a routed
+    top-``moe_top_k`` expert block (DESIGN.md "Expert-parallel
+    decode"); the named checkpoints stay dense unless asked."""
     import dataclasses
 
     import jax
@@ -64,6 +68,10 @@ def build_model(model_name: str, quantize_int8: bool, seed: int = 0,
         cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype)
     if attn_kernel != "xla":
         cfg = dataclasses.replace(cfg, attn_kernel=attn_kernel)
+    if n_experts:
+        cfg = dataclasses.replace(cfg, n_experts=n_experts,
+                                  moe_top_k=moe_top_k,
+                                  moe_every=moe_every)
     params = transformer.init_params(jax.random.PRNGKey(seed), cfg)
     if quantize_int4:
         params = quant.quantize_params(params, bits=4)
@@ -118,6 +126,7 @@ class LLMServer:
                  sp: int = 0,
                  pp: int = 0,
                  pp_microbatches: int = 0,
+                 ep: int = 0,
                  spec_k: int = 0,
                  prefix_cache: bool = False,
                  prefill_budget: int = 0,
@@ -195,6 +204,15 @@ class LLMServer:
             raise ValueError("pp > 1 requires n_slots > 0 (pipeline-"
                              "parallel serving rides the continuous "
                              "batcher)")
+        if ep > 1 and n_slots <= 0:
+            raise ValueError("ep > 1 requires n_slots > 0 (expert-"
+                             "parallel serving rides the continuous "
+                             "batcher)")
+        if ep > 1 and not getattr(cfg, "n_experts", 0):
+            # an expert axis with no experts to place on it is a
+            # config error, not a demotion — say so before jax spins up
+            raise ValueError("ep > 1 requires an MoE config "
+                             "(n_experts > 0)")
         # attn_kernel="pallas" + tp > 1 is served: the paged dispatcher
         # shard_maps the kernel over the tp axis (whole GQA head groups
         # per shard; ops.attention.sharded_paged_decode_attention) and
@@ -205,7 +223,7 @@ class LLMServer:
             from .continuous import ContinuousService
 
             mesh = None
-            if tp > 1 or sp > 1 or pp > 1:
+            if tp > 1 or sp > 1 or pp > 1 or ep > 1:
                 from ..parallel.mesh import make_mesh
                 axes = {}
                 if tp > 1:
@@ -214,6 +232,8 @@ class LLMServer:
                     axes["sp"] = sp     # position striping (round 17)
                 if pp > 1:
                     axes["pp"] = pp     # pipeline stages (round 21)
+                if ep > 1:
+                    axes["ep"] = ep     # expert sharding (round 22)
                 mesh = make_mesh(axes)
             self._service = ContinuousService(
                 params, cfg, n_slots,
@@ -256,6 +276,15 @@ class LLMServer:
                     "{reason=%r} and the STAGES column in `kubectl "
                     "inspect tpushare --metrics`", pp, pp_reason,
                     pp_reason)
+            ep_reason = info.get("expert_fallback_reason")
+            if ep_reason:
+                log.warning(
+                    "ep=%d cannot shard the expert pool on this "
+                    "config (reason=%s): every rank holds the full "
+                    "pool and the routed block runs unsharded — see "
+                    "tpushare_expert_fallback_total{reason=%r} and "
+                    "the EXPERTS column in `kubectl inspect tpushare "
+                    "--metrics`", ep, ep_reason, ep_reason)
         if policy_client is not None and self._service is None:
             # per-request mode has no service lifecycle to ride: arm
             # the dispatch-guard pacer directly (the slot-pool path
@@ -1057,6 +1086,36 @@ def main(argv=None) -> int:
                     help="microbatch count for the --pp wavefront (must "
                          "divide --slots; 0 = largest divisor of "
                          "--slots that is <= --pp)")
+    ap.add_argument("--ep", type=int, default=0,
+                    help="expert-parallel degree: shard an MoE "
+                         "config's expert pool (gate/up/down stacks "
+                         "and nothing else) across this many mesh "
+                         "shards, each rank computing only its own "
+                         "experts' contributions inside the one "
+                         "batched dispatch (psum-merged routed "
+                         "block; see DESIGN.md \"Expert-parallel "
+                         "decode\").  Requires --slots and "
+                         "--n-experts; composes with --tp/--sp "
+                         "(tp*sp*ep devices).  Expert counts the "
+                         "degree does not divide, or a >1 --pp "
+                         "staged wavefront, demote to a replicated "
+                         "pool (counted, logged at startup, still "
+                         "served)")
+    ap.add_argument("--n-experts", type=int, default=0,
+                    help="serve an MoE variant of --model: swap every "
+                         "--moe-every'th FFN for a routed block of "
+                         "this many experts (0 = dense; per-token "
+                         "top---moe-top-k routing inside the same "
+                         "single-dispatch programs on every storage "
+                         "flavor)")
+    ap.add_argument("--moe-top-k", type=int, default=1,
+                    help="experts each token routes to per MoE layer "
+                         "(softmax-renormalized over the selected "
+                         "gates; needs --n-experts)")
+    ap.add_argument("--moe-every", type=int, default=1,
+                    help="route every Nth layer's FFN through the "
+                         "expert block, counting from layer 0 "
+                         "(1 = all layers; needs --n-experts)")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="prompt-lookup speculation depth (0 = off; "
                          "greedy-exact; requires --slots).  Works on "
@@ -1166,6 +1225,13 @@ def main(argv=None) -> int:
         ap.error("--pp-microbatches requires --pp")
     if args.pp_microbatches and args.slots % args.pp_microbatches:
         ap.error("--pp-microbatches must divide --slots")
+    if args.ep > 1 and not args.slots:
+        ap.error("--ep requires --slots")
+    if args.ep > 1 and not args.n_experts:
+        ap.error("--ep requires --n-experts (an expert axis needs "
+                 "experts to shard)")
+    if (args.moe_top_k != 1 or args.moe_every != 1) and not args.n_experts:
+        ap.error("--moe-top-k/--moe-every require --n-experts")
     logging.basicConfig(level=logging.INFO)
 
     # Contract first — fail fast with the scheduler's own words, and set
@@ -1182,7 +1248,10 @@ def main(argv=None) -> int:
     cfg, params = build_model(args.model, args.int8,
                               quantize_int4=args.int4,
                               kv_dtype=args.kv_dtype,
-                              attn_kernel=args.attn_kernel)
+                              attn_kernel=args.attn_kernel,
+                              n_experts=args.n_experts,
+                              moe_top_k=args.moe_top_k,
+                              moe_every=args.moe_every)
     # Health plane: on a tunnel-attached backend, run the low-frequency
     # probe loop (tiny dispatch + scalar fetch with a deadline — the
     # true barrier) so /healthz reflects the tunnel, not hope.  A
@@ -1217,6 +1286,7 @@ def main(argv=None) -> int:
                     n_slots=args.slots, page_size=args.page_size,
                     n_pages=args.kv_pages, tp=args.tp, sp=args.sp,
                     pp=args.pp, pp_microbatches=args.pp_microbatches,
+                    ep=args.ep,
                     spec_k=args.spec_k, prefix_cache=args.prefix_cache,
                     prefill_budget=args.prefill_budget,
                     mixed_step=not args.sequential_prefill,
@@ -1245,10 +1315,11 @@ def main(argv=None) -> int:
         log.info("usage reporting to daemon every %.0fs (policy: %s)",
                  interval, args.policy)
     log.info("llm server: model=%s quant=%s kv=%s tp=%d sp=%d pp=%d "
-             "on :%d",
+             "ep=%d experts=%d on :%d",
              args.model,
              "int4" if args.int4 else ("int8" if args.int8 else "none"),
-             args.kv_dtype, args.tp, args.sp, args.pp, srv.port)
+             args.kv_dtype, args.tp, args.sp, args.pp, args.ep,
+             args.n_experts, srv.port)
     srv.serve_forever()
     return 0
 

@@ -52,65 +52,75 @@ log = logging.getLogger("tpushare.serving")
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "prompt_len",
-                                             "mesh"),
+                                             "mesh", "moe"),
                    donate_argnums=(2,))
 def _prefill(params, tokens, pools, page_rows, cfg, prompt_len: int,
-             mesh=None, adapters=None, aids=None):
+             mesh=None, adapters=None, aids=None, moe=None):
     return transformer.forward_paged_prefill(
         params, tokens, cfg, pools, page_rows, prompt_len, mesh=mesh,
-        adapters=adapters, adapter_ids=aids)
+        adapters=adapters, adapter_ids=aids, moe_mesh=moe)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "window", "mesh"),
+@functools.partial(jax.jit, static_argnames=("cfg", "window", "mesh",
+                                             "moe"),
                    donate_argnums=(2,))
 def _prefill_chunk(params, tokens, pools, page_rows, pos, last_idx, cfg,
-                   window: int, mesh=None, adapters=None, aids=None):
+                   window: int, mesh=None, adapters=None, aids=None,
+                   moe=None):
     return transformer.forward_paged_prefill_chunk(
         params, tokens[:, :window], cfg, pools, page_rows, pos, last_idx,
-        mesh=mesh, adapters=adapters, adapter_ids=aids)
+        mesh=mesh, adapters=adapters, adapter_ids=aids, moe_mesh=moe)
 
 
 def _pp_forward(params, tokens, pools, page_table, lengths, cfg, mesh,
-                pp, adapters=None, aids=None):
+                pp, adapters=None, aids=None, moe=None):
     """Route one paged decode forward: the flat program, or — when
     ``pp = (mesh, n_micro)`` (STATIC, the round-21 pipeline) — the
     microbatched stage wavefront with stage-local pool slabs
     (:func:`transformer.forward_paged_decode_pp`).  ``pp=None`` traces
-    byte-identically to the pre-pipeline program."""
+    byte-identically to the pre-pipeline program.
+
+    Returns (logits, pools, expert_load) like the dense twin: load is
+    the per-expert routed-token count of a MoE forward, None for dense
+    cfgs AND under the staged pipeline program (the ``ep_mesh``
+    demotion — the stage wavefront owns the layer loop and keeps the
+    replicated gather)."""
     if pp is None:
         return transformer.forward_paged_decode(
             params, tokens, cfg, pools, page_table, lengths, mesh=mesh,
-            adapters=adapters, adapter_ids=aids)
+            adapters=adapters, adapter_ids=aids, moe_mesh=moe,
+            return_expert_load=True)
     pmesh, n_micro = pp
-    return transformer.forward_paged_decode_pp(
+    logits, pools = transformer.forward_paged_decode_pp(
         params, tokens, cfg, pools, page_table, lengths, pmesh,
         n_micro=n_micro, adapters=adapters, adapter_ids=aids)
+    return logits, pools, None
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "rich", "mesh",
-                                             "pp"),
+                                             "pp", "moe"),
                    donate_argnums=(2,))
 def _tick(params, tokens, pools, page_table, lengths, temps, keys,
           tks, tps, cfg, rich: bool = False, mesh=None, adapters=None,
-          aids=None, pp=None):
+          aids=None, pp=None, moe=None):
     """Paged twin of continuous._tick (same sampling helper).  ``mesh``
     is STATIC (jax.sharding.Mesh hashes by devices+axes): under tp it
     reaches the paged-attention dispatcher, which shard_maps the Pallas
     read per device."""
-    logits, pools = _pp_forward(
+    logits, pools, load = _pp_forward(
         params, tokens, pools, page_table, lengths, cfg, mesh, pp,
-        adapters=adapters, aids=aids)
+        adapters=adapters, aids=aids, moe=moe)
     nxt = _sample_next(logits[:, 0], temps, keys,
                        tks if rich else None, tps if rich else None)
-    return nxt, pools
+    return nxt, pools, load
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "rich", "mesh",
-                                             "pp"),
+                                             "pp", "moe"),
                    donate_argnums=(2,))
 def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
             tks, tps, incs, cfg, n: int, rich: bool = False, mesh=None,
-            adapters=None, aids=None, pp=None):
+            adapters=None, aids=None, pp=None, moe=None):
     """Paged twin of continuous._tick_n: ``n`` paged decode ticks in one
     device scan.  The page table is FIXED across the chunk — safe because
     reservation is worst-case at admit (a slot can never need a new page
@@ -128,38 +138,50 @@ def _tick_n(params, tokens, pools, page_table, lengths, temps, keys,
     """
     return _decode_scan(params, tokens, pools, page_table, lengths,
                         temps, keys, tks, tps, incs, cfg, n, rich, mesh,
-                        adapters=adapters, aids=aids, pp=pp)
+                        adapters=adapters, aids=aids, pp=pp, moe=moe)
 
 
 def _decode_scan(params, tokens, pools, page_table, lengths, temps, keys,
                  tks, tps, incs, cfg, n: int, rich: bool, mesh=None,
-                 adapters=None, aids=None, pp=None):
+                 adapters=None, aids=None, pp=None, moe=None):
     """The paged fused decode scan BODY (trace-level) shared by
     :func:`_tick_n` and the mixed-step program :func:`_tick_mixed` —
-    one definition, so the two dispatch flavors cannot drift."""
+    one definition, so the two dispatch flavors cannot drift.
+
+    Returns (toks [B, n], keys, pools, expert_load): the load carry
+    exists only when the cfg routes experts AND the flat program runs
+    (``track_load`` is a TRACE-time decision, like the dense twin's —
+    a None load never changes the carry structure)."""
+    track_load = bool(getattr(cfg, "n_experts", 0)) and pp is None
+
     def body(carry, _):
-        tok, pools, lengths, keys = carry
+        tok, pools, lengths, keys, lacc = carry
         ks = jax.vmap(jax.random.split)(keys)
-        logits, pools = _pp_forward(
+        logits, pools, load = _pp_forward(
             params, tok, pools, page_table, lengths, cfg, mesh, pp,
-            adapters=adapters, aids=aids)
+            adapters=adapters, aids=aids, moe=moe)
+        if track_load:
+            lacc = lacc + load
         nxt = _sample_next(logits[:, 0], temps, ks[:, 1],
                            tks if rich else None, tps if rich else None)
-        return (nxt[:, None], pools, lengths + incs, ks[:, 0]), nxt
+        return (nxt[:, None], pools, lengths + incs, ks[:, 0], lacc), nxt
 
-    (_, pools, _, keys), toks = jax.lax.scan(
-        body, (tokens, pools, lengths, keys), None, length=n)
-    return toks.T, keys, pools
+    lacc0 = (jnp.zeros((cfg.n_experts,), jnp.float32)
+             if track_load else None)
+    (_, pools, _, keys, lacc), toks = jax.lax.scan(
+        body, (tokens, pools, lengths, keys, lacc0), None, length=n)
+    return toks.T, keys, pools, lacc
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "n",
-                                             "rich", "mesh", "pp"),
+                                             "rich", "mesh", "pp",
+                                             "moe"),
                    donate_argnums=(5,))
 def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
                 page_table, tokens, lengths, temps, keys, tks, tps, incs,
                 cfg, chunk_len: int, n: int, rich: bool = False,
                 mesh=None, adapters=None, aids=None, p_aids=None,
-                pp=None):
+                pp=None, moe=None):
     """Paged twin of continuous._tick_mixed: the coalesced multi-prompt
     prefill (:func:`transformer.forward_paged_prefill_batch` — live rows
     write their own distinct pages, padded rows ride all-zero tables so
@@ -169,21 +191,28 @@ def _tick_mixed(params, p_tokens, p_tables, p_pos, p_last, pools,
     writes through each row's own table row, never reshaping it."""
     sel, pools = transformer.forward_paged_prefill_batch(
         params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
-        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids)
-    toks, keys, pools = _decode_scan(
+        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids,
+        moe_mesh=moe)
+    # load covers the decode scan only (the prefill block's routing is
+    # not sampled on the paged path — the decode phase dominates the
+    # balance signal and the dense twin's histogram carries the mixed
+    # prefill contribution)
+    toks, keys, pools, load = _decode_scan(
         params, tokens, pools, page_table, lengths, temps, keys, tks,
         tps, incs, cfg, n, rich, mesh, adapters=adapters, aids=aids,
-        pp=pp)
-    return sel, toks, keys, pools
+        pp=pp, moe=moe)
+    return sel, toks, keys, pools, load
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "ngram",
-                                             "n_rounds", "rich", "mesh"),
+                                             "n_rounds", "rich", "mesh",
+                                             "moe"),
                    donate_argnums=(2,))
 def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
                next_toks, remainings, actives, temps, keys, tks, tps,
                cfg, k: int, ngram: int, n_rounds: int,
-               rich: bool = False, mesh=None, adapters=None, aids=None):
+               rich: bool = False, mesh=None, adapters=None, aids=None,
+               moe=None):
     """Paged twin of continuous._tick_spec: ``n_rounds`` of batched
     prompt-lookup speculation against the page pool in one dispatch
     (the shared round body, :func:`tpushare.serving.speculative
@@ -202,7 +231,7 @@ def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
     def verify(blocks, n_ctxs, live, pools):
         return transformer.forward_paged_verify(
             params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh,
-            adapters=adapters, adapter_ids=aids)
+            adapters=adapters, adapter_ids=aids, moe_mesh=moe)
 
     return spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
                      next_toks, remainings, actives, temps, keys, tks,
@@ -211,14 +240,14 @@ def _tick_spec(params, bufs, pools, page_table, buf_lens, n_ctxs,
 
 @functools.partial(jax.jit, static_argnames=("cfg", "chunk_len", "k",
                                              "ngram", "n_rounds", "rich",
-                                             "mesh"),
+                                             "mesh", "moe"),
                    donate_argnums=(5,))
 def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
                      page_table, bufs, buf_lens, n_ctxs, next_toks,
                      remainings, actives, temps, keys, tks, tps, cfg,
                      chunk_len: int, k: int, ngram: int, n_rounds: int,
                      rich: bool = False, mesh=None, adapters=None,
-                     aids=None, p_aids=None):
+                     aids=None, p_aids=None, moe=None):
     """Paged twin of continuous._tick_mixed_spec: the coalesced
     multi-prompt prefill (:func:`transformer.forward_paged_prefill_
     batch`) followed by the speculative verify rounds, in ONE dispatch
@@ -228,14 +257,15 @@ def _tick_mixed_spec(params, p_tokens, p_tables, p_pos, p_last, pools,
     like the plain mixed scan's ``incs``-frozen rows."""
     sel, pools = transformer.forward_paged_prefill_batch(
         params, p_tokens[:, :chunk_len], cfg, pools, p_tables, p_pos,
-        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids)
+        p_last, mesh=mesh, adapters=adapters, adapter_ids=p_aids,
+        moe_mesh=moe)
 
     from .speculative import spec_scan
 
     def verify(blocks, n_ctxs, live, pools):
         return transformer.forward_paged_verify(
             params, blocks, cfg, pools, page_table, n_ctxs, mesh=mesh,
-            adapters=adapters, adapter_ids=aids)
+            adapters=adapters, adapter_ids=aids, moe_mesh=moe)
 
     out = spec_scan(verify, _sample_next, bufs, buf_lens, n_ctxs,
                     next_toks, remainings, actives, temps, keys, tks,
@@ -524,6 +554,7 @@ class PagedContinuousBatcher(ContinuousBatcher):
             # the SECOND HBM pool class (round 20): adapter residency
             # economics next to the KV pool's
             info.update(self.adapter_pool.storage_info())
+        info.update(self._expert_storage_info())
         return info
 
     # -- storage hooks -------------------------------------------------
@@ -837,27 +868,28 @@ class PagedContinuousBatcher(ContinuousBatcher):
         logits, self.pools = _prefill(
             self.params, tokens, self.pools,
             jnp.asarray(self.page_table[slot]), self.cfg, prompt_len,
-            mesh=self.mesh, adapters=adapters, aids=aids)
+            mesh=self.mesh, adapters=adapters, aids=aids,
+            moe=self._expert_operands())
         return logits[0]      # [V]: the prompt's last-position logits
 
     def _step(self, tokens, lengths, temps, keys, tks, tps, rich,
               ads=None):
         adapters, aids = self._adapter_operands(ads)
-        nxt, self.pools = _tick(
+        nxt, self.pools, self._moe_load = _tick(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, self.cfg, rich,
             mesh=self.mesh, adapters=adapters, aids=aids,
-            pp=self._pp_args)
+            pp=self._pp_args, moe=self._expert_operands())
         return nxt
 
     def _step_n(self, tokens, lengths, temps, keys, tks, tps, incs, rich,
                 n_steps: int, ads=None):
         adapters, aids = self._adapter_operands(ads)
-        toks, keys, self.pools = _tick_n(
+        toks, keys, self.pools, self._moe_load = _tick_n(
             self.params, tokens, self.pools, jnp.asarray(self.page_table),
             lengths, temps, keys, tks, tps, incs, self.cfg, n_steps, rich,
             mesh=self.mesh, adapters=adapters, aids=aids,
-            pp=self._pp_args)
+            pp=self._pp_args, moe=self._expert_operands())
         return toks, keys
 
     def _prefill_chunk_into(self, slot: int, padded_tokens, pos: int,
@@ -867,7 +899,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
         logits, self.pools = _prefill_chunk(
             self.params, jnp.asarray(padded_tokens), self.pools,
             jnp.asarray(self.page_table[slot]), pos, last_idx, self.cfg,
-            chunk_len, mesh=self.mesh, adapters=adapters, aids=aids)
+            chunk_len, mesh=self.mesh, adapters=adapters, aids=aids,
+            moe=self._expert_operands())
         return logits
 
     def _mixed_chunk_len(self, chunk: int) -> int:
@@ -886,13 +919,13 @@ class PagedContinuousBatcher(ContinuousBatcher):
         p_tables = self._prefill_tables(p_slots, p_active)
         adapters, aids = self._adapter_operands(ads)
         _, p_aids = self._adapter_operands(p_ads)
-        sel, toks, keys, self.pools = _tick_mixed(
+        sel, toks, keys, self.pools, self._moe_load = _tick_mixed(
             self.params, jnp.asarray(p_tokens), jnp.asarray(p_tables),
             jnp.asarray(p_pos), jnp.asarray(p_last), self.pools,
             jnp.asarray(self.page_table), tokens, lengths, temps, keys,
             tks, tps, incs, self.cfg, chunk_len, n_steps, rich,
             mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids,
-            pp=self._pp_args)
+            pp=self._pp_args, moe=self._expert_operands())
         return sel, toks, keys
 
     def _prefill_tables(self, p_slots, p_active):
@@ -914,7 +947,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             self.params, bufs, self.pools, jnp.asarray(self.page_table),
             buf_lens, n_ctxs, next_toks, remainings, actives, temps,
             keys, tks, tps, self.cfg, k, ngram, n_rounds, rich,
-            mesh=self.mesh, adapters=adapters, aids=aids)
+            mesh=self.mesh, adapters=adapters, aids=aids,
+            moe=self._expert_operands())
         return bufs, produced, next_toks, keys, accepts, lives
 
     def _step_mixed_spec(self, p_tokens, p_slots, p_active, p_pos,
@@ -932,7 +966,8 @@ class PagedContinuousBatcher(ContinuousBatcher):
             jnp.asarray(self.page_table), bufs, buf_lens, n_ctxs,
             next_toks, remainings, actives, temps, keys, tks, tps,
             self.cfg, chunk_len, k, ngram, n_rounds, rich,
-            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids)
+            mesh=self.mesh, adapters=adapters, aids=aids, p_aids=p_aids,
+            moe=self._expert_operands())
         return sel, bufs, produced, next_toks, keys, accepts, lives
 
     # ------------------------------------------------------------------
