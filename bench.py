@@ -42,24 +42,15 @@ def _log(msg: str) -> None:
 
 _T0 = time.perf_counter()
 
-# Chip bf16 peak FLOP/s by device_kind substring, most specific first.
-# Sources: public TPU spec sheets (per chip, all cores).
-_PEAK_BF16 = (
-    ("v6", 918e12),   # Trillium
-    ("v5p", 459e12),
-    ("v5", 197e12),   # v5e / "TPU v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# The peak table moved to telemetry.chipdb (round 23: the roofline
+# cost plane's denominators) so the repo keeps ONE copy; this wrapper
+# keeps the device-object signature the bench has always used.
+from tpushare.telemetry import chipdb as _chipdb
 
 
 def chip_peak_flops(device) -> float | None:
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return None
+    kind = getattr(device, "device_kind", "") or None
+    return _chipdb.chip_peak_flops(kind)
 
 
 def bert_fwd_flops_per_batch(cfg, batch: int, seq: int) -> float:
@@ -303,11 +294,28 @@ def main() -> int:
         latency_ms = stats["latency_ms"]
 
     # --- absolute yardstick: MFU vs chip bf16 peak -------------------------
-    peak = chip_peak_flops(jax.devices()[0]) if on_tpu else None
+    peaks = (_chipdb.chip_peaks(
+        getattr(jax.devices()[0], "device_kind", "") or None)
+        if on_tpu else None)
+    flops = bert_fwd_flops_per_batch(cfg, batch, seq)
     mfu = None
-    if peak:
-        flops = bert_fwd_flops_per_batch(cfg, batch, seq)
-        mfu = round(flops * (headline_qps / batch) / peak, 4)
+    if peaks:
+        mfu = round(flops * (headline_qps / batch) / peaks.flops_bf16, 4)
+    # --- roofline cost card: predicted vs measured (round 23) -------------
+    # The analytical card for THIS program: matmul FLOPs per batch (the
+    # MFU numerator above) and the dominant HBM traffic — one full
+    # weight pass per forward (activations stay on-chip at these
+    # shapes).  mfu/bw_util divide by the chipdb peaks and stay null on
+    # CPU/unknown chips (no denominator ≠ zero utilization).
+    param_bytes = sum(int(x.size) * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(params))
+    cost_model = {
+        "predicted_flops": flops,
+        "predicted_hbm_bytes": float(param_bytes),
+        "mfu": mfu,
+        "bw_util": (round(param_bytes * (headline_qps / batch)
+                          / peaks.hbm_bytes_per_s, 4) if peaks else None),
+    }
 
     # --- naive baseline: batch=1, reference attention, no batching --------
     # What one unoptimized pod gets per chip: single-query forwards with
@@ -343,6 +351,7 @@ def main() -> int:
     watch["stage"] = "naive-baseline"
     result.update(
         value=round(headline_qps, 2), attention=attn_path, mfu=mfu,
+        cost_model=cost_model,
         qps_offline=(round(qps_offline, 2) if qps_offline is not None
                      else None),
         latency_ms_per_batch=round(latency_ms, 2))

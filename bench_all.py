@@ -34,6 +34,9 @@ def _emit(metric, value, unit, **extra):
                    if queue_s is not None
                    and health.MONITOR.state != health.CPU_FALLBACK
                    else None)
+    # roofline cost plane (round 23): what the scenario's serving
+    # programs analytically cost so far, with mfu/bw_util null off-TPU
+    rec.setdefault("cost_model", serving_metrics.cost_model_record())
     if health.MONITOR.state != health.OK:
         # a fallback/wedge fired somewhere this run: every record says
         # so, so a degraded sweep artifact explains itself

@@ -114,6 +114,17 @@ class FakeReplica:
         self._health_state = self._registry.gauge(
             "tpushare_backend_health_state", "fake health state",
             labels=("state",))
+        # roofline cost plane (round 23): registered but UNSET until
+        # set_roofline() scripts them — an unset gauge renders no
+        # sample, mirroring the real absent-on-CPU semantics the
+        # inspect ROOFLINE column must handle
+        self._mfu = self._registry.gauge(
+            "tpushare_model_flops_utilization", "fake mfu")
+        self._bw_util = self._registry.gauge(
+            "tpushare_hbm_bandwidth_utilization", "fake bw util")
+        self._roofline_bound = self._registry.gauge(
+            "tpushare_roofline_bound_info", "fake roofline bound",
+            labels=("bound",))
         self.set_load()
         self.set_wedged(False)             # seed the ok one-hot
         self._http = JsonHTTPServer(0, "127.0.0.1", routes={
@@ -147,6 +158,15 @@ class FakeReplica:
         self._ttft.clear()
         if ttft_p99_s:
             self._ttft.observe(ttft_p99_s)
+
+    def set_roofline(self, mfu: float, bw_util: float,
+                     bound: str = "flops") -> None:
+        """Script the cost-plane gauges the inspect ROOFLINE column
+        renders (one-hot bound info, like the real refresh_roofline)."""
+        self._mfu.set(mfu)
+        self._bw_util.set(bw_util)
+        for b in ("flops", "hbm", "ici"):
+            self._roofline_bound.set(1.0 if b == bound else 0.0, bound=b)
 
     def set_wedged(self, wedged: bool = True) -> None:
         self.wedged = wedged

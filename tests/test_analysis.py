@@ -1100,6 +1100,80 @@ def test_dispatch_cross_check_raises_on_drift():
 
 
 # ---------------------------------------------------------------------------
+# Layer 5: roofline cost cards (costmodel) — seeded drift, caught by name
+# ---------------------------------------------------------------------------
+def test_costmodel_sweep_and_cross_check_clean():
+    """The acceptance pin: every tiny shape derives a consistent card
+    and every stdlib mirror agrees with the live pricing + a live
+    dense/paged batcher's storage_info()."""
+    from tpushare.analysis import costmodel
+
+    assert costmodel.sweep_findings(cross_check=True) == []
+
+
+def test_costmodel_live_pricing_drift_caught_by_name(monkeypatch):
+    """Seeded drift on the LIVE side: ops.quant.kv_cache_bytes changes
+    without the mirror following — cross_check_live raises
+    CostDriftError and the sweep surfaces it as a 'costmodel:' finding
+    (the string the CLI maps to rule id 'costmodel' in --json)."""
+    from tpushare.analysis import costmodel
+    from tpushare.ops import quant
+
+    real = quant.kv_cache_bytes
+    monkeypatch.setattr(quant, "kv_cache_bytes",
+                        lambda cfg, tokens: real(cfg, tokens) + 1)
+    with pytest.raises(costmodel.CostDriftError,
+                       match="kv_cache_bytes mirror drifted"):
+        costmodel.cross_check_live()
+    findings = costmodel.sweep_findings(cross_check=True)
+    assert findings and all(f.startswith("costmodel:") for f in findings)
+
+
+def test_costmodel_stale_mirror_caught(monkeypatch):
+    """Seeded drift on the MIRROR side: a stale stdlib constant
+    (KV_SCALE_BYTES) is the same loud CostDriftError — drift detection
+    is symmetric, not just live-code-moved."""
+    from tpushare.analysis import costmodel
+
+    monkeypatch.setattr(costmodel, "KV_SCALE_BYTES", 8)
+    with pytest.raises(costmodel.CostDriftError):
+        costmodel.cross_check_live()
+
+
+def test_costmodel_contract_pin_drift(monkeypatch):
+    """ENTRY_PHASES must cover ENTRY_CONTRACT exactly and draw phases
+    from health.PHASES — a new tick entry without a cost phase (or a
+    made-up phase) refuses at the stdlib layer, before any jax import."""
+    from tpushare.analysis import costmodel
+
+    original = dict(costmodel.ENTRY_PHASES)
+    dropped = dict(original)
+    dropped.pop("tick_spec")
+    monkeypatch.setattr(costmodel, "ENTRY_PHASES", dropped)
+    with pytest.raises(costmodel.CostDriftError, match="ENTRY_PHASES"):
+        costmodel.cross_check_live()
+
+    bad_phase = dict(original, tick="warmup")
+    monkeypatch.setattr(costmodel, "ENTRY_PHASES", bad_phase)
+    with pytest.raises(costmodel.CostDriftError, match="health.PHASES"):
+        costmodel.cross_check_live()
+
+
+def test_costmodel_storage_key_drift(monkeypatch):
+    """A storage_info() key the cost plane consumes disappearing (here:
+    the contract growing a key live batchers don't carry) is a named
+    finding — renames cannot silently decouple the card from the live
+    byte accounting."""
+    from tpushare.analysis import costmodel
+
+    grown = dict(costmodel.REQUIRED_STORAGE_KEYS)
+    grown["dense"] = grown["dense"] | {"bytes_per_flux_capacitor"}
+    monkeypatch.setattr(costmodel, "REQUIRED_STORAGE_KEYS", grown)
+    with pytest.raises(costmodel.CostDriftError, match="lost keys"):
+        costmodel.cross_check_live()
+
+
+# ---------------------------------------------------------------------------
 # Repo-clean + catalog sync (the docs/METRICS.md pattern)
 # ---------------------------------------------------------------------------
 def _clean_env():

@@ -1,0 +1,234 @@
+"""Roofline cost plane runtime: cards wired into the serving loop.
+
+The analytical side (mirror pricing, drift pins) lives in
+tests/test_analysis.py; this file covers the RUNTIME half of round 23:
+
+* ramp math — ``_cost_ctx_ramp`` equals the brute-force sum of
+  window-capped attended context, for every regime (below cap,
+  straddling, saturated);
+* accounting — admit/tick/tick_fused accumulate exactly (steps, real
+  tokens, attended ctx) per phase, and ``_cost_flush`` multiplies the
+  accumulator through the card into the program FLOP/HBM/ICI counters
+  (cadence-throttled: the 16th tick flushes without being asked);
+* gauges — ``refresh_roofline`` divides by the chipdb peaks when the
+  accelerator type resolves, and stays ABSENT (not zero) when it
+  doesn't;
+* tenant attribution — the daemon ingests cumulative per-tenant FLOP
+  reports as inc-by-delta (restart-clamped) into
+  ``tpushare_tenant_flops_total`` and ``aggregate_tenants`` carries the
+  raw figure to ``inspect --tenants``.
+"""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from tpushare import telemetry
+from tpushare.analysis import costmodel
+from tpushare.models import transformer
+from tpushare.plugin.status import StatusServer, aggregate_tenants
+from tpushare.serving import metrics
+from tpushare.serving.continuous import (DERIVED_OBSERVE_EVERY,
+                                         ContinuousBatcher)
+from tpushare.telemetry import chipdb
+
+
+@pytest.fixture(scope="module")
+def batcher():
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    return ContinuousBatcher(params, cfg, n_slots=2)
+
+
+def _acc(b, phase):
+    return tuple(b._cost_acc[phase])
+
+
+def _reset_acc(b):
+    for acc in b._cost_acc.values():
+        acc[0] = acc[1] = acc[2] = 0.0
+
+
+# ------------------------------------------------------------- ramp math
+def test_ctx_ramp_matches_brute_force(batcher):
+    cap = batcher._cost_ctx_cap
+    for pos0 in (0, 1, cap - 3, cap - 1, cap, cap + 5):
+        for n in (0, 1, 2, 5, cap + 7):
+            brute = sum(min(pos0 + i + 1, cap) for i in range(n))
+            assert batcher._cost_ctx_ramp(pos0, n) == brute, (pos0, n)
+
+
+def test_ctx_cap_is_the_window_when_configured():
+    windowed = transformer.tiny(window=8)
+    params = transformer.init_params(jax.random.PRNGKey(0), windowed)
+    b = ContinuousBatcher(params, windowed, n_slots=2)
+    assert b._cost_ctx_cap == 8
+    # saturated: every token past the window attends exactly `window`
+    assert b._cost_ctx_ramp(50, 4) == 4 * 8
+
+
+# ----------------------------------------------------------- accounting
+def test_admit_and_ticks_accumulate_exact_counts(batcher):
+    b = batcher
+    _reset_acc(b)
+    prompt = [1, 2, 3, 4, 5]
+    rid = b.admit(prompt, max_new_tokens=DERIVED_OBSERVE_EVERY + 4)
+    assert rid is not None
+    # admission = one full-prompt prefill pass: 1 weight step, P real
+    # tokens, triangular attended context (cap far above P here)
+    p = len(prompt)
+    assert _acc(b, "prefill") == (1.0, float(p), float(p * (p + 1) // 2))
+
+    steps = tokens = ctx = 0.0
+    for _ in range(3):
+        expect = sum(min(s.length + 1, b._cost_ctx_cap)
+                     for s in b.slots.values())
+        n_active = b.tick()
+        steps += 1
+        tokens += n_active
+        ctx += expect
+    assert _acc(b, "decode") == (steps, tokens, ctx)
+
+    # a fused n-step scan notes n weight re-reads and n*active tokens
+    n_steps = 2
+    expect = sum(b._cost_ctx_ramp(s.length, n_steps)
+                 for s in b.slots.values())
+    n_active = b.tick_fused(n_steps)
+    assert _acc(b, "decode") == (steps + n_steps,
+                                 tokens + n_active * n_steps,
+                                 ctx + expect)
+
+
+def test_flush_multiplies_through_the_card_and_cadence_fires(batcher):
+    b = batcher
+    card = b._cost_card
+    _reset_acc(b)
+    if not b.slots:
+        b.admit([7, 8, 9], max_new_tokens=2 * DERIVED_OBSERVE_EVERY)
+    b.tick()
+    snap = {p: _acc(b, p) for p in b._cost_acc}
+    before_f = {p: metrics.PROGRAM_FLOPS.value(phase=p) for p in snap}
+    before_h = {p: metrics.PROGRAM_HBM_BYTES.value(phase=p)
+                for p in snap}
+    b._cost_flush()
+    for phase, (steps, toks, ctx) in snap.items():
+        assert (metrics.PROGRAM_FLOPS.value(phase=phase)
+                - before_f[phase]) == pytest.approx(
+                    card.flops(steps, toks, ctx))
+        assert (metrics.PROGRAM_HBM_BYTES.value(phase=phase)
+                - before_h[phase]) == pytest.approx(
+                    card.hbm_bytes(steps, toks, ctx))
+    # the accumulator drains on flush; a second flush is a no-op
+    assert all(_acc(b, p) == (0.0, 0.0, 0.0) for p in b._cost_acc)
+
+    # cadence: run until _tick_count crosses a DERIVED_OBSERVE_EVERY
+    # boundary — the counters must advance WITHOUT a manual flush
+    before = metrics.PROGRAM_FLOPS.value(phase="decode")
+    for _ in range(DERIVED_OBSERVE_EVERY):
+        if not b.slots:
+            b.admit([3, 1], max_new_tokens=2 * DERIVED_OBSERVE_EVERY)
+        b.tick()
+    assert metrics.PROGRAM_FLOPS.value(phase="decode") > before
+
+
+def test_single_dispatch_flops_exceed_per_token_floor(batcher):
+    """Sanity anchor: one decode token costs at least the per-token
+    card coefficient (the context term only adds)."""
+    card = batcher._cost_card
+    assert card.flops(1, 1, 1) >= card.flops_per_token > 0
+
+
+# --------------------------------------------------------------- gauges
+def test_refresh_roofline_absent_without_chip(monkeypatch):
+    for env in chipdb.ACCELERATOR_TYPE_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    assert chipdb.chip_peaks() is None
+    mfu_before = metrics.MODEL_FLOPS_UTILIZATION.value()
+    metrics.refresh_roofline()              # early-returns, sets nothing
+    assert metrics.MODEL_FLOPS_UTILIZATION.value() == mfu_before
+
+
+def test_refresh_roofline_sets_gauges_and_one_hot_bound(monkeypatch):
+    monkeypatch.setenv("TPUSHIM_ACCELERATOR_TYPE", "v5litepod-4")
+    peaks = chipdb.chip_peaks()
+    assert peaks is not None and peaks.generation == "v5"
+    metrics.PROGRAM_FLOPS.inc(1e9, phase="decode")
+    metrics.refresh_roofline()
+    mfu = metrics.MODEL_FLOPS_UTILIZATION.value()
+    bw = metrics.HBM_BANDWIDTH_UTILIZATION.value()
+    assert mfu is not None and mfu >= 0.0
+    assert bw is not None and bw >= 0.0
+    one_hot = [metrics.ROOFLINE_BOUND.value(bound=b)
+               for b in costmodel.ROOFLINE_BOUNDS]
+    assert sum(one_hot) == 1.0 and max(one_hot) == 1.0
+
+
+def test_chipdb_resolution_order(monkeypatch):
+    for env in chipdb.ACCELERATOR_TYPE_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    # explicit kind beats nothing; TPUSHIM override beats the
+    # host-rewritten TPU_ACCELERATOR_TYPE; unknown chips return None
+    assert chipdb.chip_peaks("TPU v4").generation == "v4"
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v3-8")
+    assert chipdb.chip_peaks().generation == "v3"
+    monkeypatch.setenv("TPUSHIM_ACCELERATOR_TYPE", "v5litepod-1")
+    assert chipdb.chip_peaks().generation == "v5"
+    assert chipdb.chip_peaks("tpu v99") is None
+    assert chipdb.chip_peak_flops("v5p-128") == 459e12
+
+
+def test_cost_model_record_shape(monkeypatch):
+    for env in chipdb.ACCELERATOR_TYPE_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    rec = metrics.cost_model_record()
+    assert set(rec) == {"predicted_flops", "predicted_hbm_bytes",
+                        "mfu", "bw_util"}
+    assert rec["mfu"] is None and rec["bw_util"] is None  # no peaks
+    monkeypatch.setenv("TPUSHIM_ACCELERATOR_TYPE", "v5litepod-1")
+    rec = metrics.cost_model_record()
+    assert rec["mfu"] is not None and rec["bw_util"] is not None
+
+
+# -------------------------------------------------- tenant attribution
+def _post_usage(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/usage",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status
+
+
+def _flops_report(pod, flops):
+    return {"pod": pod, "chip": 0, "hbm_fraction": 0.5,
+            "device_time_s": 1.0, "qps": 1.0, "flops": flops,
+            "health_state": "ok"}
+
+
+def test_tenant_flops_ingest_is_delta_clamped():
+    srv = StatusServer(0).start()
+    counter = telemetry.REGISTRY.find("tpushare_tenant_flops_total")
+    pod = "cost-tenant-a"
+    base = counter.value(tenant=pod)
+    try:
+        assert _post_usage(srv.port, _flops_report(pod, 100.0)) == 200
+        assert counter.value(tenant=pod) - base == pytest.approx(100.0)
+        assert _post_usage(srv.port, _flops_report(pod, 150.0)) == 200
+        assert counter.value(tenant=pod) - base == pytest.approx(150.0)
+        # tenant restart: the cumulative report resets — the negative
+        # delta is clamped, the baseline re-anchors
+        assert _post_usage(srv.port, _flops_report(pod, 40.0)) == 200
+        assert counter.value(tenant=pod) - base == pytest.approx(150.0)
+        assert _post_usage(srv.port, _flops_report(pod, 90.0)) == 200
+        assert counter.value(tenant=pod) - base == pytest.approx(200.0)
+    finally:
+        srv.stop()
+
+
+def test_aggregate_tenants_carries_flops():
+    agg = aggregate_tenants([_flops_report("a", 5e9),
+                             _flops_report("b", 1e9)])
+    assert agg["tenants"]["a"]["flops"] == pytest.approx(5e9)
+    assert agg["tenants"]["b"]["flops"] == pytest.approx(1e9)

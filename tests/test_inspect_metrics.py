@@ -101,6 +101,50 @@ def test_inspect_metrics_table_end_to_end(monkeypatch, capsys):
         srv.stop()
 
 
+def test_inspect_metrics_roofline_column_e2e(monkeypatch, capsys):
+    """Round-23 cost plane e2e: a replica exposing the roofline gauges
+    renders the ROOFLINE column (MFU%/BW% + binding resource) and the
+    json ``serving.roofline`` key; the process-global seed WITHOUT the
+    gauges renders a dash — absent means "no peak-table row", never
+    0%."""
+    from fakes.replica import FakeReplica
+
+    rep = FakeReplica("rf").start()
+    rep.set_roofline(0.51, 0.12, bound="hbm")
+    api = FakeApiServer().start()
+    try:
+        api.nodes["node-a"] = make_node("node-a", ip="127.0.0.1")
+        rc = _run_inspect(monkeypatch, api,
+                          ["--metrics", "--metrics-port", str(rep.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "ROOFLINE" in out
+        assert "51%/12% hbm" in out
+
+        rc = _run_inspect(monkeypatch, api,
+                          ["-o", "json", "--metrics",
+                           "--metrics-port", str(rep.port)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        rf = {n["name"]: n for n in doc["nodes"]}[
+            "node-a"]["serving"]["roofline"]
+        assert rf == {"mfu": 0.51, "bw_util": 0.12, "bound": "hbm"}
+    finally:
+        api.stop()
+        rep.stop()
+
+    # absent-gauge arm: the plain seeded registry has no roofline
+    # series -> the summary's sub-dict is all-None and the cell dashes
+    _seed_serving_metrics()
+    parsed = telemetry.parse_text(telemetry.REGISTRY.render())
+    s = metricsview.summarize_serving(parsed)
+    if s["roofline"]["mfu"] is None:        # global registry untouched
+        row = metricsview.render_metrics_table(
+            [("n1", "10.0.0.1", s, None)])
+        line = next(l for l in row.splitlines() if "n1" in l)
+        assert "% hbm" not in line and "% flops" not in line
+
+
 def test_inspect_metrics_dead_port_renders_down_row(monkeypatch, capsys):
     """ISSUE-4 satellite e2e: one node with a LIVE endpoint, one whose
     port refuses the connection — the dead node renders a DOWN row

@@ -37,6 +37,18 @@ DIMENSIONLESS_HISTOGRAMS = {
     "tpushare_expert_load",
 }
 
+#: ``_utilization``-suffixed gauges are dimensionless fractions of a
+#: capacity — declared HERE deliberately (the namespace decision, like
+#: DIMENSIONLESS_HISTOGRAMS), so a new utilization gauge is a reviewed
+#: addition rather than an accidental unit-free series
+DIMENSIONLESS_UTILIZATION_GAUGES = {
+    "tpushare_device_utilization",
+    "tpushare_mixed_budget_utilization",
+    # roofline cost plane (round 23): analytical rate / chipdb peak
+    "tpushare_model_flops_utilization",
+    "tpushare_hbm_bandwidth_utilization",
+}
+
 
 def _registered():
     # the instrumented modules register at import
@@ -82,6 +94,12 @@ def test_unit_suffix_conventions():
         if name.endswith("_info"):
             assert kind == "gauge", \
                 f"{name}: _info series are constant-1 gauges (info idiom)"
+        if name.endswith("_utilization"):
+            assert kind == "gauge" \
+                and name in DIMENSIONLESS_UTILIZATION_GAUGES, (
+                    f"{name}: _utilization series are dimensionless "
+                    f"fraction gauges, declared in "
+                    f"DIMENSIONLESS_UTILIZATION_GAUGES deliberately")
 
 
 def test_kv_byte_series_registered():
@@ -188,7 +206,10 @@ ALLOWED_LABEL_NAMES = {"phase", "state", "tenant", "pod", "over_grant",
                        "kind", "direction", "outcome",
                        # fleet tracing: the request-hop decomposition
                        # (enum-pinned to propagation.REQUEST_HOPS)
-                       "hop"}
+                       "hop",
+                       # roofline cost plane: the binding resource
+                       # (enum-pinned to costmodel.ROOFLINE_BOUNDS)
+                       "bound"}
 FORBIDDEN_LABEL_NAMES = {"rid", "rids", "request", "request_id", "seq",
                          "id",
                          # fleet trace ids are per-request values:
@@ -248,6 +269,16 @@ ENUMERATED_VALUES = {
     ("tpushare_request_hop_seconds", "hop"):
         {"router_queue", "prefill_device", "migration_wire",
          "decode_ttft"},
+    # roofline cost plane (round 23): the work counters share ONE
+    # phase enum with the guard attribution (telemetry.health.PHASES,
+    # enum-pinned), and the bound info gauge enumerates
+    # analysis.costmodel.ROOFLINE_BOUNDS (asserted below — the gauge
+    # twin of the counter pins)
+    ("tpushare_program_flops_total", "phase"):
+        {"prefill", "decode", "mixed"},
+    ("tpushare_program_hbm_bytes_total", "phase"):
+        {"prefill", "decode", "mixed"},
+    ("tpushare_roofline_bound_info", "bound"): {"flops", "hbm", "ici"},
 }
 
 # -- enum pins (round-18 satellite): ONE declarative table ------------------
@@ -258,7 +289,7 @@ ENUMERATED_VALUES = {
 #: label fails the completeness sweep until it gets a pin, and a pinned
 #: constant drifting from ENUMERATED_VALUES fails the drift sweep.
 ENUM_PIN_LABELS = ("reason", "kind", "outcome", "policy", "direction",
-                   "hop")
+                   "hop", "phase")
 #: (family, label) -> (module, constant) — the ONE place a labelled
 #: counter's value enum is tied to the code that observes it
 ENUM_PINS = {
@@ -290,6 +321,12 @@ ENUM_PINS = {
     # drift sweep checks every pin against the declared family)
     ("tpushare_request_hop_seconds", "hop"):
         ("tpushare.telemetry.propagation", "REQUEST_HOPS"),
+    # roofline work counters share the guard-attribution phase enum —
+    # ONE definition of "phase" across device time and cost accounting
+    ("tpushare_program_flops_total", "phase"):
+        ("tpushare.telemetry.health", "PHASES"),
+    ("tpushare_program_hbm_bytes_total", "phase"):
+        ("tpushare.telemetry.health", "PHASES"),
 }
 
 
@@ -359,6 +396,26 @@ def test_policy_series_registered_with_contracted_names():
     assert set(policy.POLICY_MODES) == ENUMERATED_VALUES[
         ("tpushare_tenant_policy_info", "policy")], \
         "POLICY_MODES drifted from the lint enum"
+
+
+def test_roofline_series_registered_with_contracted_names():
+    """The roofline cost plane's series exist under their contracted
+    names and kinds (what the inspect ROOFLINE column, the --tenants
+    FLOPS column, and the bench cost_model records key on), and the
+    bound info gauge's enum pins to costmodel.ROOFLINE_BOUNDS (the
+    gauge twin of the counter ENUM_PINS)."""
+    by_name = {n: kind for n, kind, _ in _registered()}
+    assert by_name.get("tpushare_program_flops_total") == "counter"
+    assert by_name.get("tpushare_program_hbm_bytes_total") == "counter"
+    assert by_name.get("tpushare_ici_bytes_total") == "counter"
+    assert by_name.get("tpushare_model_flops_utilization") == "gauge"
+    assert by_name.get("tpushare_hbm_bandwidth_utilization") == "gauge"
+    assert by_name.get("tpushare_roofline_bound_info") == "gauge"
+    assert by_name.get("tpushare_tenant_flops_total") == "counter"
+    from tpushare.analysis import costmodel
+    assert set(costmodel.ROOFLINE_BOUNDS) == ENUMERATED_VALUES[
+        ("tpushare_roofline_bound_info", "bound")], \
+        "ROOFLINE_BOUNDS drifted from the lint enum"
 
 
 def test_migration_series_registered_with_contracted_names():

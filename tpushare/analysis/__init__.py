@@ -40,11 +40,20 @@ findings — wired as ``make lint`` and run in tier-1):
   cross-checked against the live classes the way mosaic cross-checks
   the dispatch gate (drift raises).
 
+* :mod:`tpushare.analysis.costmodel` — Layer 5 (round 23): analytical
+  roofline cost cards (FLOPs / HBM bytes / ICI bytes per serving
+  program × config), the denominator-side of the live MFU and
+  bandwidth-utilization gauges.  Stdlib mirrors of the byte-pricing
+  functions, cross-checked against the live pricing AND a live
+  batcher's ``storage_info()`` the way mosaic cross-checks the
+  dispatch gate (``CostDriftError`` on drift; see docs/ROOFLINE.md).
+
 ``python -m tpushare.analysis --catalog`` renders docs/LINTS.md (the
 rule catalog; sync-tested like docs/METRICS.md); ``--json`` emits
 machine-readable findings.
 """
 
-from . import confinement, dispatch_audit, mosaic, tpulint  # noqa: F401
+from . import confinement, costmodel, dispatch_audit, mosaic, tpulint  # noqa: F401,E501
 
-__all__ = ["confinement", "dispatch_audit", "mosaic", "tpulint"]
+__all__ = ["confinement", "costmodel", "dispatch_audit", "mosaic",
+           "tpulint"]

@@ -3,11 +3,12 @@ non-zero on findings (wired as ``make lint``; tier-1 runs it via
 tests/test_analysis.py in a clean subprocess).
 
 Layers 2-4 (tpulint, confinement, dispatch audit) need only the
-stdlib; Layer 1's gate cross-check and Layer 4's registry pin import
-jax (ops.attention / the serving modules), so run the CLI with the
-tunnel scrubbed (``env -u PALLAS_AXON_POOL_IPS``, as the Makefile
-target does) — nothing here initializes a backend, but a sitecustomize
-hook dials on ANY jax import when the variable is set.
+stdlib; Layer 1's gate cross-check, Layer 4's registry pin, and Layer
+5's cost-card pricing pins import jax (ops.attention / the serving
+modules), so run the CLI with the tunnel scrubbed
+(``env -u PALLAS_AXON_POOL_IPS``, as the Makefile target does) — the
+only backend work is Layer 5's tiny CPU batcher construction, but a
+sitecustomize hook dials on ANY jax import when the variable is set.
 
 ``--json`` emits machine-readable findings (rule id, file:line,
 message) for CI and editors; ``make lint`` stays exit-code based.
@@ -20,7 +21,7 @@ import argparse
 import json
 import sys
 
-from . import confinement, dispatch_audit, mosaic, tpulint
+from . import confinement, costmodel, dispatch_audit, mosaic, tpulint
 
 
 def main(argv=None) -> int:
@@ -62,6 +63,8 @@ def main(argv=None) -> int:
         n_files = len(files)
         findings.extend(confinement.check_tree(root))
         findings.extend(dispatch_audit.audit_tree(root))
+        findings.extend(costmodel.sweep_findings(
+            cross_check=not args.no_mosaic))
         if not args.no_mosaic:
             findings.extend(mosaic.sweep_findings(cross_check=True))
             dispatch_audit.cross_check_live()   # DispatchDriftError raises
@@ -70,8 +73,9 @@ def main(argv=None) -> int:
         if isinstance(f, tpulint.Finding):
             return {"rule": f.rule, "path": f.path, "line": f.line,
                     "message": f.message}
-        return {"rule": "mosaic-sweep", "path": "", "line": 0,
-                "message": str(f)}
+        rule = ("costmodel" if str(f).startswith("costmodel:")
+                else "mosaic-sweep")
+        return {"rule": rule, "path": "", "line": 0, "message": str(f)}
 
     if args.as_json:
         print(json.dumps([as_dict(f) for f in findings], indent=2))

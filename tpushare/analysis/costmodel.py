@@ -1,0 +1,610 @@
+"""Roofline cost plane: analytical FLOP / HBM-byte / ICI-byte cost
+cards for every serving program (Layer 5 of ``make lint``).
+
+The measured half of the observability plane already exists —
+``tpushare_device_time_seconds`` says how long the chip was busy.  This
+module is the ANALYTICAL half: for a serving configuration (dense/paged
+storage × tp/sp/pp/ep mesh degrees × kv dtype × speculation depth ×
+adapter pool × MoE) it derives a :class:`CostCard` — linear
+coefficients that turn the counts a dispatch guard already has (scan
+steps, tokens processed, attended context positions) into FLOPs, HBM
+bytes, and ICI collective bytes.  Divided by device time and the chip
+peaks (:mod:`tpushare.telemetry.chipdb`) that yields live MFU and
+bandwidth utilization; argmax of the three fractions names the
+roofline bound (``flops`` / ``hbm`` / ``ici``).
+
+Like :mod:`tpushare.analysis.mosaic`, everything here is STDLIB-ONLY
+and the byte model is a deliberate MIRROR of the live pricing functions
+(``ops.quant.kv_bytes_per_elem`` / ``kv_cache_bytes``,
+``ops.experts.expert_pool_bytes``, ``ops.lora.adapter_entry_bytes``,
+``transformer.paged_read_transient_bytes``, the paged batcher's
+``sp_merge_transient_bytes``) — duplicated so this module stays
+importable without jax; :func:`cross_check_live` pins every mirror
+against the live function AND a live batcher's ``storage_info()`` keys,
+raising :class:`CostDriftError` on disagreement exactly like mosaic's
+``GateDriftError`` (wired into ``make lint``; tests seed drift on both
+sides and expect the finding by name).
+
+Conventions of the card (documented once, relied on everywhere):
+
+* FLOPs are matmul-only (multiply-add = 2), the roofline convention —
+  norms, rope, softmax and other vector work ride the VPU and are not
+  what MFU measures.
+* HBM charges weight reads per SCAN STEP (a fused n-step decode
+  re-reads the stack n times), KV writes per token, KV reads per
+  attended context position, and gathered pools (experts, adapters)
+  per token — an upper bound when many tokens share an expert, which
+  is the usual roofline optimism.
+* The XLA paged-gather transient is charged per step at 2× (materialize
+  + consume) per layer; 0 under the Pallas kernel — pricing exactly the
+  bandwidth the kernel exists to save.
+* ICI charges tp's two ring-allreduces per layer, pp's activation hops
+  (+ the staged program's logit fold), ep's per-routed-layer psum, and
+  sp's per-step stat merge.  Degrees in the shape are EFFECTIVE (a
+  demoted gate passes 1), mirroring what the program actually runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+from . import dispatch_audit
+from .mosaic import spec_verify_rows
+from ..telemetry import health
+
+__all__ = [
+    "CostDriftError", "CostCard", "derive_card", "roofline_fractions",
+    "cross_check_live", "sweep_findings", "ENTRY_PHASES",
+    "REQUIRED_STORAGE_KEYS", "ROOFLINE_BOUNDS",
+]
+
+#: the three roofline resources, in gauge/label order — the ``bound``
+#: label of ``tpushare_roofline_bound_info`` enumerates these
+#: (enum-pinned in tests/test_metric_lint.py)
+ROOFLINE_BOUNDS = ("flops", "hbm", "ici")
+
+
+class CostDriftError(AssertionError):
+    """The stdlib cost mirror and the live pricing/serving code
+    disagree — update ``costmodel`` alongside the byte-model or
+    contract change (the same discipline as ``GateDriftError``)."""
+
+
+#: dtype-name -> itemsize.  Shapes carry dtype by NAME (the migrate.py
+#: wire discipline: bf16's numpy ``.str`` is unroundtrippable, names
+#: are not) so this module never touches jnp.dtype.
+DTYPE_ITEMSIZE = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
+#: bytes of one per-(token, kv-head) KV scale — mirror of
+#: ``ops.quant.KV_SCALE_DTYPE`` (f32).  Duplicated so this module stays
+#: importable without jax; cross_check_live pins the two.
+KV_SCALE_BYTES = 4
+
+#: guard phase each ENTRY_CONTRACT program accounts under — keys are
+#: pinned against ``dispatch_audit.ENTRY_CONTRACT`` (a new tick entry
+#: without a phase here is lint drift), values against
+#: ``telemetry.health.PHASES`` (the one phase enum; the admission /
+#: chunked-prefill guards account under "prefill" without an entry —
+#: they are not tick programs).
+ENTRY_PHASES = {
+    "tick": "decode",
+    "tick_fused": "decode",
+    "tick_spec": "decode",
+    "tick_mixed": "mixed",
+    "tick_mixed_spec": "mixed",
+}
+
+#: storage_info() keys the card's byte model must agree with, per
+#: storage kind — cross_check_live asserts presence AND value equality
+#: on live batchers, so renaming a key or changing its pricing without
+#: updating the mirror is a named lint finding.
+REQUIRED_STORAGE_KEYS = {
+    "dense": frozenset({"kind", "attn_kernel", "kv_dtype", "slot_tokens",
+                        "bytes_per_slot", "pool_bytes"}),
+    "paged": frozenset({"kind", "attn_kernel", "kv_dtype", "page_tokens",
+                        "bytes_per_page", "n_pages", "pool_bytes",
+                        "attn_read_transient_bytes"}),
+}
+
+#: adapter-target projection dims, mirror of
+#: ``ops.lora.serving_adapter_dims`` (MoE configs restrict to the
+#: attention projections — routed layers carry no dense FFN leaves).
+_LORA_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+_ATTN_LORA_SUFFIXES = ("wq", "wk", "wv", "wo")
+
+
+def _itemsize(shape: Dict) -> int:
+    try:
+        return DTYPE_ITEMSIZE[shape["dtype"]]
+    except KeyError:
+        raise CostDriftError(
+            f"unknown dtype name {shape['dtype']!r} — add it to "
+            "costmodel.DTYPE_ITEMSIZE") from None
+
+
+def kv_bytes_per_elem(shape: Dict) -> float:
+    """Mirror of ``ops.quant.kv_bytes_per_elem``: value byte(s) plus
+    the amortized per-(token, head) scale for int8 storage."""
+    if shape.get("kv_dtype", "bf16") == "int8":
+        return 1.0 + KV_SCALE_BYTES / shape["head_dim"]
+    return float(_itemsize(shape))
+
+
+def kv_cache_bytes(shape: Dict, tokens: int) -> int:
+    """Mirror of ``ops.quant.kv_cache_bytes``: K+V across layers and
+    kv-heads for ``tokens`` cache positions."""
+    elems = (2 * shape["n_layers"] * shape["n_kv_heads"] * tokens
+             * shape["head_dim"])
+    return int(round(elems * kv_bytes_per_elem(shape)))
+
+
+def adapter_dims(shape: Dict) -> Dict[str, tuple]:
+    """Mirror of ``ops.lora.serving_adapter_dims``."""
+    d = shape["d_model"]
+    kvd = shape["n_kv_heads"] * shape["head_dim"]
+    f = shape["d_ff"]
+    dims = {"wq": (d, d), "wk": (d, kvd), "wv": (d, kvd), "wo": (d, d),
+            "w_gate": (d, f), "w_up": (d, f), "w_down": (f, d)}
+    keys = (_ATTN_LORA_SUFFIXES if shape.get("n_experts", 0)
+            else _LORA_SUFFIXES)
+    return {k: dims[k] for k in keys}
+
+
+def adapter_entry_bytes(shape: Dict, rank: int) -> int:
+    """Mirror of ``ops.lora.adapter_entry_bytes`` (one resident
+    adapter: a + b across target leaves and layers + its f32 scale)."""
+    elems = sum(rank * (di + do) for di, do in adapter_dims(shape).values())
+    return int(shape["n_layers"] * elems * _itemsize(shape) + 4)
+
+
+def expert_pool_bytes(shape: Dict) -> int:
+    """Mirror of ``ops.experts.expert_pool_bytes`` (router + stacked
+    gate/up/down expert leaves + the per-layer f32 route flag)."""
+    e = shape.get("n_experts", 0)
+    if not e:
+        return 0
+    d, f, layers = shape["d_model"], shape["d_ff"], shape["n_layers"]
+    elems = layers * (d * e + 3 * e * d * f)
+    return int(elems * _itemsize(shape) + layers * 4)
+
+
+def paged_read_transient_bytes(shape: Dict, rows: int) -> int:
+    """Mirror of ``transformer.paged_read_transient_bytes``: the dense
+    per-layer K/V view the XLA gather path materializes — full q-head
+    width (the gather expands GQA before attention) in the COMPUTE
+    dtype (int8 pools dequantize the whole view first); 0 under the
+    Pallas kernel."""
+    if shape["attn_kernel"] == "pallas":
+        return 0
+    elems = (2 * rows * shape["n_heads"] * shape["max_seq"]
+             * shape["head_dim"])
+    return int(elems * _itemsize(shape))
+
+
+def sp_merge_transient_bytes(shape: Dict) -> int:
+    """Mirror of the paged batcher's ``sp_merge_transient_bytes``
+    pricing: each stripe's f32 (out, max, sumexp) partials per
+    (slot, kv-head, q-row) — what the cross-shard online-softmax fold
+    moves per striped kernel dispatch per layer."""
+    rows = (spec_verify_rows(shape["n_heads"], shape["n_kv_heads"],
+                             shape["spec_k"]) if shape.get("spec_k")
+            else 1)
+    return int(shape["n_slots"] * shape["n_kv_heads"] * rows
+               * (shape["head_dim"] + 2) * 4)
+
+
+def param_bytes(shape: Dict) -> int:
+    """Persistent bytes of the whole param pytree (embed + stacked
+    layer leaves + final_scale + lm_head), mirroring
+    ``transformer.init_params`` leaf-for-leaf — pinned against a
+    ``jax.eval_shape`` of the real initializer in cross_check_live, so
+    a new leaf cannot drift past this model silently."""
+    d = shape["d_model"]
+    kvd = shape["n_kv_heads"] * shape["head_dim"]
+    f, layers, vocab = shape["d_ff"], shape["n_layers"], shape["vocab"]
+    item = _itemsize(shape)
+    per_layer = (2 * d                      # attn_scale + ffn_scale
+                 + d * d + 2 * d * kvd + d * d)  # wq wk wv wo
+    per_layer_bytes = per_layer * item
+    e = shape.get("n_experts", 0)
+    if e:
+        per_layer_bytes += (d * e + 3 * e * d * f) * item + 4  # + route flag
+    else:
+        per_layer_bytes += 3 * d * f * item
+    return int(vocab * d * item             # embed
+               + layers * per_layer_bytes
+               + d * item                   # final_scale
+               + d * vocab * item)          # lm_head
+
+
+def _routed_layers(shape: Dict) -> int:
+    """Layers whose MoE route flag is 1.0 (``l % moe_every == 0``)."""
+    if not shape.get("n_experts", 0):
+        return 0
+    every = max(1, shape.get("moe_every", 1))
+    return len(range(0, shape["n_layers"], every))
+
+
+class CostCard(NamedTuple):
+    """Linear cost coefficients for one serving configuration.
+
+    A round's totals are ``per_step * steps + per_token * tokens
+    + per_ctx_token * ctx`` where ``steps`` counts scan iterations
+    (a fused n-step decode re-reads weights n times), ``tokens`` the
+    positions actually computed (real prefill tokens, decode rows,
+    spec verify rows — padding excluded, so MFU reads as goodput), and
+    ``ctx`` the total attended context positions across those tokens.
+    """
+
+    flops_per_step: float
+    flops_per_token: float
+    flops_per_ctx_token: float
+    hbm_per_step: float
+    hbm_per_token: float
+    hbm_per_ctx_token: float
+    ici_per_step: float
+    ici_per_token: float
+    #: storage_info()-comparable byte predictions (the cross-check
+    #: surface) + param/pool bytes for capacity consumers
+    predicted: Dict[str, int]
+
+    def flops(self, steps: float, tokens: float, ctx: float) -> float:
+        return (self.flops_per_step * steps
+                + self.flops_per_token * tokens
+                + self.flops_per_ctx_token * ctx)
+
+    def hbm_bytes(self, steps: float, tokens: float, ctx: float) -> float:
+        return (self.hbm_per_step * steps
+                + self.hbm_per_token * tokens
+                + self.hbm_per_ctx_token * ctx)
+
+    def ici_bytes(self, steps: float, tokens: float) -> float:
+        return self.ici_per_step * steps + self.ici_per_token * tokens
+
+
+def normalize_shape(shape: Dict) -> Dict:
+    """Fill derivable defaults so callers (and tests) can pass the
+    minimal dict; returns a new dict, never mutates."""
+    s = dict(shape)
+    s.setdefault("head_dim", s["d_model"] // s["n_heads"])
+    s.setdefault("kv_dtype", "bf16")
+    s.setdefault("attn_kernel", "xla")
+    s.setdefault("kind", "dense")
+    s.setdefault("window", None)
+    s.setdefault("n_experts", 0)
+    s.setdefault("moe_top_k", 1)
+    s.setdefault("moe_every", 1)
+    s.setdefault("tp", 1)
+    s.setdefault("sp", 1)
+    s.setdefault("pp", 1)
+    s.setdefault("pp_staged", False)
+    s.setdefault("ep", 1)
+    s.setdefault("spec_k", 0)
+    s.setdefault("adapter_rank", 0)
+    s.setdefault("n_slots", 1)
+    return s
+
+
+def derive_card(shape: Dict) -> CostCard:
+    """Derive the cost card for one serving configuration.
+
+    ``shape`` is a plain dict (see :func:`normalize_shape` for
+    defaults): model dims (``vocab``/``d_model``/``n_layers``/
+    ``n_heads``/``n_kv_heads``/``d_ff``/``max_seq``/``dtype`` by NAME/
+    ``kv_dtype``/``window``/MoE fields), storage (``kind`` dense/
+    rolling/paged, EFFECTIVE ``attn_kernel``, ``n_slots``, and
+    ``slot_tokens`` or ``page_tokens`` + ``n_pages``), effective mesh
+    degrees (``tp``/``sp``/``pp``/``pp_staged``/``ep``), ``spec_k``,
+    and ``adapter_rank`` (0 = no pool).  The serving batchers build it
+    from their own config + ``storage_info()`` (see
+    ``ContinuousBatcher.cost_shape``)."""
+    s = normalize_shape(shape)
+    d = s["d_model"]
+    kvd = s["n_kv_heads"] * s["head_dim"]
+    hd_all = s["n_heads"] * s["head_dim"]
+    f, layers, vocab = s["d_ff"], s["n_layers"], s["vocab"]
+    e, top_k = s["n_experts"], s["moe_top_k"]
+    item = _itemsize(s)
+
+    # ---- FLOPs -------------------------------------------------------
+    proj = 2 * d * (2 * d + 2 * kvd)                 # wq wk wv wo
+    if e:
+        # the uniform scanned body: router matmul every layer, top_k
+        # gathered expert SwiGLUs (non-routed layers execute the same
+        # gather on forced expert 0 — executed work, uniform by design)
+        ffn = 2 * d * e + top_k * 6 * d * f
+    else:
+        ffn = 6 * d * f
+    lora = 0
+    if s["adapter_rank"]:
+        lora = sum(2 * s["adapter_rank"] * (di + do)
+                   for di, do in adapter_dims(s).values())
+    flops_per_token = layers * (proj + ffn + lora) + 2 * d * vocab
+    flops_per_ctx = layers * 4 * hd_all              # QK^T + PV
+
+    # ---- HBM bytes ---------------------------------------------------
+    kv_token = kv_cache_bytes(s, 1)                  # K+V of one position
+    # weights re-read each scan step: attn projections + dense FFN (or
+    # just the router for MoE — expert reads are per-token gathers) +
+    # lm_head.  The embed table is a gather (rows-read, negligible);
+    # norm scales are vector-sized.
+    weights = layers * (2 * d * d + 2 * d * kvd) * item
+    weights += (layers * d * e * item if e else layers * 3 * d * f * item)
+    weights += d * vocab * item
+    hbm_per_step = float(weights)
+    if s["kind"] == "paged":
+        transient = paged_read_transient_bytes(s, s["n_slots"])
+        hbm_per_step += 2.0 * layers * transient     # materialize+consume
+    hbm_per_token = float(kv_token)
+    if e:
+        hbm_per_token += layers * top_k * 3 * d * f * item
+    if s["adapter_rank"]:
+        hbm_per_token += (adapter_entry_bytes(s, s["adapter_rank"]) - 4.0)
+    hbm_per_ctx = float(kv_token)                    # read K+V per position
+
+    # ---- ICI bytes ---------------------------------------------------
+    tp, sp, pp, ep = s["tp"], s["sp"], s["pp"], s["ep"]
+    ici_per_token = 0.0
+    ici_per_step = 0.0
+    if tp > 1:
+        # two ring allreduces per layer (post-attention wo, post-FFN
+        # down) of a [d] activation: 2(tp-1)/tp * d bytes each per token
+        ici_per_token += layers * 2 * (2.0 * (tp - 1) / tp) * d * item
+    if pp > 1:
+        # activation hops between adjacent stages
+        ici_per_token += (pp - 1) * d * item
+        if s["pp_staged"]:
+            # the staged wavefront's final masked psum fold of f32
+            # logits across stages
+            ici_per_token += (2.0 * (pp - 1) / pp) * vocab * 4
+    if e and ep > 1:
+        ici_per_token += (_routed_layers(s)
+                          * (2.0 * (ep - 1) / ep) * d * item)
+    if sp > 1:
+        # per-step cross-stripe merge: the kernel path folds f32 stat
+        # partials, the gather path all-gathers the dense view
+        if s["attn_kernel"] == "pallas":
+            ici_per_step += layers * sp_merge_transient_bytes(s)
+        else:
+            ici_per_step += layers * paged_read_transient_bytes(
+                s, s["n_slots"])
+
+    # ---- storage_info-comparable predictions -------------------------
+    predicted: Dict[str, int] = {"param_bytes": param_bytes(s)}
+    if s["kind"] == "paged":
+        bpp = kv_cache_bytes(s, s["page_tokens"])
+        predicted.update({
+            "bytes_per_page": bpp,
+            "pool_bytes": bpp * s["n_pages"],
+            "attn_read_transient_bytes":
+                paged_read_transient_bytes(s, s["n_slots"]),
+        })
+        if sp > 1:
+            predicted["sp_merge_transient_bytes"] = (
+                sp_merge_transient_bytes(s))
+    else:
+        bps = kv_cache_bytes(s, s["slot_tokens"])
+        predicted.update({"bytes_per_slot": bps,
+                          "pool_bytes": bps * s["n_slots"]})
+    if e:
+        predicted["expert_pool_bytes"] = expert_pool_bytes(s)
+    if s["adapter_rank"]:
+        predicted["bytes_per_adapter"] = adapter_entry_bytes(
+            s, s["adapter_rank"])
+
+    return CostCard(
+        flops_per_step=0.0,
+        flops_per_token=float(flops_per_token),
+        flops_per_ctx_token=float(flops_per_ctx),
+        hbm_per_step=hbm_per_step,
+        hbm_per_token=hbm_per_token,
+        hbm_per_ctx_token=hbm_per_ctx,
+        ici_per_step=ici_per_step,
+        ici_per_token=ici_per_token,
+        predicted=predicted,
+    )
+
+
+def roofline_fractions(flops_per_s: float, hbm_bytes_per_s: float,
+                       ici_bytes_per_s: float, peaks):
+    """(mfu, bw_util, ici_util, bound) against a
+    :class:`tpushare.telemetry.chipdb.ChipPeaks` row — ``bound`` names
+    the largest fraction (``flops`` / ``hbm`` / ``ici``), the resource
+    this workload would saturate first at these rates."""
+    mfu = flops_per_s / peaks.flops_bf16
+    bw = hbm_bytes_per_s / peaks.hbm_bytes_per_s
+    ici = ici_bytes_per_s / peaks.ici_bytes_per_s
+    bound = max((mfu, "flops"), (bw, "hbm"), (ici, "ici"))[1]
+    return mfu, bw, ici, bound
+
+
+# ---------------------------------------------------------------------------
+# Cross-check: pin the mirrors against the live code
+# ---------------------------------------------------------------------------
+def _tiny_shapes():
+    """The sweep/cross-check configurations: every storage kind ×
+    kv dtype × kernel × a MoE + adapter + spec + mesh-degree spread."""
+    base = dict(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq=128, dtype="float32",
+                n_slots=4, kind="dense", slot_tokens=128)
+    shapes = [dict(base)]
+    shapes.append(dict(base, dtype="bfloat16", kv_dtype="int8"))
+    shapes.append(dict(base, kind="paged", page_tokens=16, n_pages=33,
+                       spec_k=3))
+    shapes.append(dict(base, kind="paged", page_tokens=32, n_pages=17,
+                       dtype="bfloat16", kv_dtype="int8",
+                       attn_kernel="pallas", tp=2, sp=2))
+    shapes.append(dict(base, n_experts=4, moe_top_k=2, moe_every=2,
+                       ep=2, adapter_rank=8))
+    shapes.append(dict(base, tp=2, pp=2, pp_staged=True))
+    return [normalize_shape(s) for s in shapes]
+
+
+def cross_check_live() -> None:
+    """Pin every stdlib mirror against the live code; raise
+    :class:`CostDriftError` on disagreement.  Three layers:
+
+    1. stdlib: :data:`ENTRY_PHASES` keys == ``ENTRY_CONTRACT`` keys,
+       phases drawn from ``telemetry.health.PHASES``;
+    2. pricing functions (imports jax, CPU-safe — dtype metadata and
+       one ``jax.eval_shape``, no device arrays beyond tiny CPU init):
+       ``kv_cache_bytes`` / ``expert_pool_bytes`` /
+       ``adapter_entry_bytes`` / ``paged_read_transient_bytes`` /
+       the param tree vs an abstract ``init_params`` evaluation;
+    3. live batchers: a tiny dense + paged pair's ``storage_info()``
+       must carry :data:`REQUIRED_STORAGE_KEYS` and agree with the
+       card's ``predicted`` bytes key-for-key.
+    """
+    # -- layer 1: contract pins (stdlib) -------------------------------
+    entries = set(dispatch_audit.ENTRY_CONTRACT)
+    if set(ENTRY_PHASES) != entries:
+        raise CostDriftError(
+            f"ENTRY_PHASES covers {sorted(ENTRY_PHASES)} but "
+            f"ENTRY_CONTRACT declares {sorted(entries)} — every tick "
+            "program needs a cost-accounting phase")
+    bad = set(ENTRY_PHASES.values()) - set(health.PHASES)
+    if bad:
+        raise CostDriftError(
+            f"ENTRY_PHASES uses phases {sorted(bad)} outside "
+            f"health.PHASES {health.PHASES}")
+
+    # -- layer 2: pricing-function mirrors (lazy jax) ------------------
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer
+    from ..ops import experts as ops_experts
+    from ..ops import lora as ops_lora
+    from ..ops import quant as ops_quant
+    from ..ops.attention import spec_verify_rows as live_rows
+
+    if KV_SCALE_BYTES != jnp.dtype(ops_quant.KV_SCALE_DTYPE).itemsize:
+        raise CostDriftError(
+            f"KV_SCALE_BYTES={KV_SCALE_BYTES} but ops.quant stores "
+            f"scales as {ops_quant.KV_SCALE_DTYPE}")
+    if live_rows(8, 2, 3) != spec_verify_rows(8, 2, 3):
+        raise CostDriftError(
+            "spec_verify_rows mirror drifted from ops.attention")
+
+    _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+    cfgs = [
+        transformer.tiny(),
+        transformer.tiny(dtype=jnp.bfloat16),
+    ]
+    cfgs.append(transformer.ModelConfig(
+        vocab=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, dtype=jnp.bfloat16, kv_dtype="int8"))
+    cfgs.append(transformer.ModelConfig(
+        vocab=256, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, n_experts=4, moe_top_k=2, moe_every=2))
+    for cfg in cfgs:
+        shape = normalize_shape(dict(
+            vocab=cfg.vocab, d_model=cfg.d_model, n_layers=cfg.n_layers,
+            n_heads=cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            d_ff=cfg.d_ff, max_seq=cfg.max_seq,
+            dtype=jnp.dtype(cfg.dtype).name, kv_dtype=cfg.kv_dtype,
+            n_experts=cfg.n_experts, moe_top_k=cfg.moe_top_k,
+            moe_every=cfg.moe_every, n_slots=2, slot_tokens=cfg.max_seq))
+        for tokens in (1, 7, 128):
+            mine = kv_cache_bytes(shape, tokens)
+            live = ops_quant.kv_cache_bytes(cfg, tokens)
+            if mine != live:
+                raise CostDriftError(
+                    f"kv_cache_bytes mirror drifted: {mine} vs live "
+                    f"{live} ({cfg.kv_dtype}, tokens={tokens})")
+        if expert_pool_bytes(shape) != ops_experts.expert_pool_bytes(cfg):
+            raise CostDriftError(
+                f"expert_pool_bytes mirror drifted: "
+                f"{expert_pool_bytes(shape)} vs live "
+                f"{ops_experts.expert_pool_bytes(cfg)}")
+        for rank in (4, 8):
+            mine = adapter_entry_bytes(shape, rank)
+            live = ops_lora.adapter_entry_bytes(cfg, rank)
+            if mine != live:
+                raise CostDriftError(
+                    f"adapter_entry_bytes mirror drifted at rank "
+                    f"{rank}: {mine} vs live {live}")
+        for kernel in ("xla", "pallas"):
+            mine = paged_read_transient_bytes(
+                dict(shape, attn_kernel=kernel), 2)
+            live = transformer.paged_read_transient_bytes(
+                cfg, 2, attn_kernel=kernel)
+            if mine != live:
+                raise CostDriftError(
+                    f"paged_read_transient_bytes mirror drifted "
+                    f"({kernel}): {mine} vs live {live}")
+        # param tree: abstract evaluation only — no weight arrays
+        tree = jax.eval_shape(
+            lambda k: transformer.init_params(k, cfg),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        live_bytes = sum(
+            int(l.size) * jnp.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(tree))
+        if param_bytes(shape) != live_bytes:
+            raise CostDriftError(
+                f"param_bytes mirror drifted: {param_bytes(shape)} vs "
+                f"abstract init_params {live_bytes} "
+                f"(n_experts={cfg.n_experts})")
+
+    # -- layer 3: live storage_info agreement --------------------------
+    from ..serving.continuous import ContinuousBatcher
+    from ..serving.paged import PagedContinuousBatcher
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    for batcher in (
+            ContinuousBatcher(params, cfg, n_slots=2),
+            PagedContinuousBatcher(params, cfg, n_slots=2,
+                                   page_size=16, n_pages=17)):
+        info = batcher.storage_info()
+        kind = "paged" if info["kind"] == "paged" else "dense"
+        missing = REQUIRED_STORAGE_KEYS[kind] - set(info)
+        if missing:
+            raise CostDriftError(
+                f"storage_info() lost keys {sorted(missing)} the cost "
+                f"plane consumes ({kind})")
+        card = derive_card(batcher.cost_shape())
+        for key, want in card.predicted.items():
+            if key == "param_bytes" or key not in info:
+                continue
+            if int(info[key]) != int(want):
+                raise CostDriftError(
+                    f"cost card predicts {key}={want} but live "
+                    f"storage_info() says {info[key]} ({kind})")
+
+
+def sweep_findings(cross_check: bool = False):
+    """Internal-consistency sweep over :func:`_tiny_shapes` (+ the live
+    cross-check when asked), errors collected as finding strings — the
+    ``make lint`` entry point, mirroring ``mosaic.sweep_findings``."""
+    findings = []
+    try:
+        for s in _tiny_shapes():
+            card = derive_card(s)
+            if card.flops_per_token <= 0 or card.hbm_per_step <= 0:
+                findings.append(
+                    f"costmodel: non-positive card for shape {s}")
+            if (s["kv_dtype"] == "int8"
+                    and kv_cache_bytes(s, 64)
+                    >= kv_cache_bytes(dict(s, kv_dtype="bf16"), 64)):
+                findings.append(
+                    "costmodel: int8 KV must price below bf16")
+            if (s["kind"] == "paged" and s["attn_kernel"] == "pallas"
+                    and card.predicted["attn_read_transient_bytes"]):
+                findings.append(
+                    "costmodel: pallas path must zero the gather "
+                    "transient")
+            if s["n_experts"]:
+                dense = derive_card(dict(s, n_experts=0))
+                if card.flops_per_token <= dense.flops_per_token:
+                    findings.append(
+                        "costmodel: MoE card must out-flop its dense "
+                        "sibling (router + top_k experts)")
+    except CostDriftError as exc:           # pragma: no cover - drift
+        findings.append(f"costmodel: {exc}")
+    if cross_check:
+        try:
+            cross_check_live()
+        except CostDriftError as exc:
+            findings.append(f"costmodel: {exc}")
+    return findings

@@ -84,6 +84,29 @@ def trajectory(root: Optional[str] = None) -> dict:
                 entry["values"][rnd] = val
             if rec.get("unit"):
                 entry["unit"] = rec["unit"]
+            # roofline cost plane (round 23): the card's predicted
+            # utilizations, and the predicted-vs-measured delta when
+            # the record also carries a measured fraction (bench.py's
+            # MFU, or the goodput gauge on bench_all records).  Every
+            # piece gated on _numeric — degraded lines carry nulls and
+            # must skip cells, not poison them (round-17 rule).
+            cm = rec.get("cost_model")
+            if isinstance(cm, dict):
+                cell = {}
+                if _numeric(cm.get("mfu")):
+                    cell["predicted_mfu"] = cm["mfu"]
+                if _numeric(cm.get("bw_util")):
+                    cell["predicted_bw_util"] = cm["bw_util"]
+                meas = rec.get("mfu")
+                if not _numeric(meas):
+                    meas = rec.get("device_utilization")
+                if _numeric(meas):
+                    cell["measured_util"] = meas
+                if _numeric(cell.get("predicted_mfu")) \
+                        and _numeric(meas) and meas:
+                    cell["delta"] = round(cell["predicted_mfu"] / meas, 3)
+                if cell:
+                    entry.setdefault("cost_model", {})[rnd] = cell
     for entry in metrics.values():
         seen = [r for r in rounds if r in entry["values"]]
         if len(seen) >= 2 and entry["values"][seen[-2]]:
@@ -114,7 +137,8 @@ def render_markdown(traj: dict) -> str:
     metric is the regression this table exists to surface)."""
     rounds = traj["rounds"]
     lines = ["# Bench trajectory (committed BENCH_r*.json)", ""]
-    header = (["metric", "unit"] + rounds + ["last/prev"])
+    header = (["metric", "unit"] + rounds
+              + ["last/prev", "pred mfu/bw", "pred/meas"])
     lines.append("| " + " | ".join(header) + " |")
     lines.append("|" + "---|" * len(header))
     for name in sorted(traj["metrics"]):
@@ -123,6 +147,19 @@ def render_markdown(traj: dict) -> str:
         cells += [_fmt(entry["values"].get(r)) for r in rounds]
         ratio = entry.get("last_vs_prev")
         cells.append(f"{ratio:.3f}x" if ratio is not None else "-")
+        # trailing cost-model columns: the LATEST round's predicted
+        # utilizations and its predicted-vs-measured ratio ("-" until
+        # a record carries the round-23 cost_model subdict)
+        cm_rounds = [r for r in rounds
+                     if r in entry.get("cost_model", {})]
+        if cm_rounds:
+            c = entry["cost_model"][cm_rounds[-1]]
+            cells.append(f"{_fmt(c.get('predicted_mfu'))}/"
+                         f"{_fmt(c.get('predicted_bw_util'))}")
+            d = c.get("delta")
+            cells.append(f"{d:.3f}x" if d is not None else "-")
+        else:
+            cells += ["-", "-"]
         lines.append("| " + " | ".join(cells) + " |")
     lines.append("")
     return "\n".join(lines)

@@ -190,6 +190,17 @@ def summarize_serving(parsed: dict) -> dict:
         "expert_fallbacks": sum(
             v for _, v in parsed["samples"].get(
                 "tpushare_expert_fallback_total", ())) or None,
+        # roofline cost plane (round 23): live MFU and HBM-bandwidth
+        # utilization against the chip-peak table, plus which resource
+        # binds (one-hot info gauge).  All three ABSENT (not zero) on
+        # CPU/unknown chips — chipdb returned no peaks to divide by.
+        "roofline": {
+            "mfu": _gauge(parsed, "tpushare_model_flops_utilization"),
+            "bw_util": _gauge(parsed,
+                              "tpushare_hbm_bandwidth_utilization"),
+            "bound": _info_label(parsed, "tpushare_roofline_bound_info",
+                                 "bound"),
+        },
     }
 
 
@@ -212,6 +223,9 @@ def summarize_tenants(parsed: dict) -> dict:
     fold("tpushare_tenant_device_time_seconds", "device_time_s")
     fold("tpushare_tenant_device_share", "share")
     fold("tpushare_tenant_entitlement_share", "entitlement")
+    # cost-plane attribution (round 23): cumulative analytical FLOPs
+    # the daemon ingested per tenant (inc-by-delta over /usage reports)
+    fold("tpushare_tenant_flops_total", "flops")
     # enforcement plane (round 19): the SGDRC-adjusted entitlement the
     # verdicts pace against, and the daemon's issued-verdict ledger
     fold("tpushare_tenant_effective_entitlement_share",
@@ -360,13 +374,13 @@ def render_metrics_table(
     anomaly this view exists to surface) instead of raising."""
     table = [["NAME", "IPADDRESS", "HEALTH", "QPS", "TTFT p50(ms)",
               "TTFT p99(ms)", "OCCUPANCY", "KV PAGES(used/free)",
-              "KV BYTES(dtype)", "ATTN", "STRIPE", "STAGES", "SPEC",
-              "ADAPTERS", "EXPERTS", "PREFILL Q", "BUDGET%"]]
+              "KV BYTES(dtype)", "ATTN", "ROOFLINE", "STRIPE", "STAGES",
+              "SPEC", "ADAPTERS", "EXPERTS", "PREFILL Q", "BUDGET%"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, addr, "DOWN", err or "unreachable",
                           "-", "-", "-", "-", "-", "-", "-", "-", "-",
-                          "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-"])
             continue
         kv = "-"
         if summary["kv_pages_used"] is not None:
@@ -382,6 +396,17 @@ def render_metrics_table(
             # the viability gates demoted some compiled program(s) to
             # the gather — the ATTN column must not read "pallas" clean
             attn += f" (fb {int(summary['attn_fallbacks'])})"
+        # ROOFLINE: MFU% / BW% against the chipdb peaks with the
+        # binding resource alongside ("51%/12% flops").  "-" on CPU /
+        # unknown chips — the gauges are ABSENT there, never zero, so
+        # a dash means "no peak to divide by", not "idle"
+        roofline = "-"
+        rf = summary.get("roofline") or {}
+        if rf.get("mfu") is not None:
+            roofline = (f"{rf['mfu'] * 100:.0f}%/"
+                        f"{(rf.get('bw_util') or 0.0) * 100:.0f}%")
+            if rf.get("bound"):
+                roofline += f" {rf['bound']}"
         # STRIPE: position shards per sequence ("x4" = this pool
         # stripes every sequence's pages over 4 shards)
         stripe = "-"
@@ -439,6 +464,7 @@ def render_metrics_table(
             kv,
             kv_bytes,
             attn,
+            roofline,
             stripe,
             stages,
             spec,
@@ -462,19 +488,19 @@ def render_tenants_table(
     SGDRC-adjusted effective value when slack donation changed it.
     Nodes without reports render a placeholder row (the daemon is up
     but no tenant reported), dead nodes a DOWN row."""
-    table = [["NAME", "TENANT", "DEVICE TIME(s)", "SHARE", "ENTITLEMENT",
-              "HBM PEAK/GRANT", "FAIRNESS", "POLICY", "PACED",
-              "REFUSED", "FLAG"]]
+    table = [["NAME", "TENANT", "DEVICE TIME(s)", "FLOPS", "SHARE",
+              "ENTITLEMENT", "HBM PEAK/GRANT", "FAIRNESS", "POLICY",
+              "PACED", "REFUSED", "FLAG"]]
     for name, addr, summary, err in rows:
         if summary is None:
             table.append([name, "-", "DOWN", err or "unreachable",
-                          "-", "-", "-", "-", "-", "-", "-"])
+                          "-", "-", "-", "-", "-", "-", "-", "-"])
             continue
         fairness = _fmt(summary.get("fairness_index"), digits=3)
         policy = summary.get("policy") or "-"
         tenants = summary["tenants"]
         if not tenants:
-            table.append([name, "-", "-", "-", "-", "-", fairness,
+            table.append([name, "-", "-", "-", "-", "-", "-", fairness,
                           policy, "-", "-", "no reports"])
             continue
         for tenant in sorted(tenants):
@@ -496,9 +522,15 @@ def render_tenants_table(
                 flags.append("OVER")
             if t.get("hbm_over"):
                 flags.append("HBM-OVER")
+            # FLOPS: the cost plane's per-tenant attribution — the
+            # analytical work each tenant put through the chip, in
+            # compact engineering form ("1.1e+09"); dash = the tenant
+            # never reported a flops field (pre-round-23 workload)
+            flops = t.get("flops")
             table.append([
                 name, tenant,
                 _fmt(t.get("device_time_s")),
+                f"{flops:.2g}" if flops else "-",
                 _fmt(t.get("share"), 100.0, "%", 0),
                 ent,
                 hbm, fairness, policy,

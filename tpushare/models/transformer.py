@@ -175,7 +175,11 @@ def init_params(key, cfg: ModelConfig) -> Dict:
                 / np.sqrt(fan_in)).astype(cfg.dtype)
 
     def layer(k, idx):
-        ks = jax.random.split(k, 8)
+        # the split COUNT is stream-visible (threefry pairs counters by
+        # total length): dense configs must keep the pre-MoE 7-way
+        # split or every dense weight re-randomizes and the committed
+        # bf16 stream goldens break
+        ks = jax.random.split(k, 8 if cfg.n_experts else 7)
         out = {
             "attn_scale": jnp.ones((d,), cfg.dtype),
             "wq": dense(ks[0], d, (d, d)),

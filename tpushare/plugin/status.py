@@ -136,6 +136,13 @@ _POLICY_INFO = telemetry.gauge(
     "computed and counted but tenants do not act, enforce = tenants "
     "pace/refuse on them; Prometheus info idiom)",
     labels=("policy",))
+_TENANT_FLOPS = telemetry.counter(
+    "tpushare_tenant_flops_total",
+    "Per-tenant analytical FLOPs (round-23 cost plane: each tenant's "
+    "cumulative tpushare_program_flops_total reported via /usage, "
+    "ingested as inc-by-delta so the counter survives report "
+    "reordering; a tenant restart resets its cumulative report and "
+    "the negative delta is clamped to zero)", labels=("tenant",))
 _TENANT_EFF_ENTITLEMENT = telemetry.gauge(
     "tpushare_tenant_effective_entitlement_share",
     "Per-tenant EFFECTIVE entitlement after SGDRC-style slack "
@@ -179,6 +186,7 @@ def aggregate_tenants(reports) -> dict:
             "over_share": over,
             "device_utilization": r.get("device_utilization"),
             "qps": r.get("qps"),
+            "flops": r.get("flops"),
             "generated_tokens": r.get("generated_tokens"),
             "stalls": r.get("stalls"),
             "health_state": r.get("health_state"),
@@ -246,6 +254,9 @@ class StatusServer:
         # tenant processes.  on_usage(reports) fires after each ingest
         # (main.py wires it to a node-annotation patch for inspect).
         self.usage_reports: dict = {}
+        # last cumulative per-tenant FLOP report (guarded by _LOCK like
+        # usage_reports): the inc-by-delta baseline for _TENANT_FLOPS
+        self._flops_seen: dict = {}
         self.on_usage = on_usage
         # Reports age out (tenant pods churn; the daemon never learns of
         # deletions through this channel) and are capped so label
@@ -311,6 +322,7 @@ class StatusServer:
                # serving-plane accounting (contract.serving_snapshot):
                # same coerce-or-drop posture — tenant-supplied floats
                "hbm_fraction": _flt("hbm_fraction"),
+               "flops": _flt("flops"),
                "device_time_s": _flt("device_time_s"),
                "device_utilization": _flt("device_utilization"),
                "qps": _flt("qps"),
@@ -328,6 +340,18 @@ class StatusServer:
             self._evict_locked()
             reports = {p: {k: v for k, v in r.items() if k != "ts"}
                        for p, r in self.usage_reports.items()}
+            # per-tenant FLOP attribution: the report carries a
+            # CUMULATIVE count, the counter is inc-only — ingest the
+            # delta against the last report seen, clamped at zero (a
+            # restarted tenant's counter resets; its first report's
+            # negative delta must not poison the ledger)
+            flops_delta = 0.0
+            if rec.get("flops") is not None:
+                prev = self._flops_seen.get(rec["pod"], 0.0)
+                flops_delta = max(0.0, rec["flops"] - prev)
+                self._flops_seen[rec["pod"]] = rec["flops"]
+        if flops_delta > 0:
+            _TENANT_FLOPS.inc(flops_delta, tenant=rec["pod"])
         grant, peak = rec.get("grant_bytes"), rec.get("peak_bytes")
         if grant and peak and peak > grant:
             inc("tpushare_hbm_overshoot_total")
@@ -384,6 +408,12 @@ class StatusServer:
             oldest = min(self.usage_reports,
                          key=lambda p: self.usage_reports[p].get("ts", 0))
             del self.usage_reports[oldest]
+        # the FLOP-delta baseline follows the report population, so the
+        # map stays bounded with it (a returning pod re-baselines — its
+        # first delta after eviction is clamped like a restart's)
+        for p in list(self._flops_seen):
+            if p not in self.usage_reports:
+                del self._flops_seen[p]
 
     def render_metrics(self) -> str:
         """Refresh the daemon-state gauges, then render the WHOLE

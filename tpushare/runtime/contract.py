@@ -214,9 +214,17 @@ def serving_snapshot() -> dict:
     tok_c = _registry.REGISTRY.find("tpushare_generated_tokens_total")
     occ_g = _registry.REGISTRY.find("tpushare_batch_occupancy")
     qd_g = _registry.REGISTRY.find("tpushare_request_queue_depth")
+    fl_c = _registry.REGISTRY.find("tpushare_program_flops_total")
     qps = qps_g.value() if qps_g is not None else None
     tokens = tok_c.value() if tok_c is not None else 0
+    # cumulative analytical FLOPs across phases (round 23 cost plane):
+    # the daemon turns successive reports into per-tenant FLOP deltas
+    # (tpushare_tenant_flops_total) — compute attribution next to the
+    # device-time share the fairness ledger already carries
+    flops = (sum(fl_c.value(phase=p) for p in _health.PHASES)
+             if fl_c is not None else 0.0)
     return {
+        "flops": round(flops),
         "device_time_s": round(busy, 6),
         "device_utilization": (round(util, 6)
                                if util is not None else None),

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry
+from ..analysis import costmodel
 from ..telemetry import health
 from ..telemetry.events import RECORDER
 from ..models import transformer
@@ -748,6 +749,17 @@ class ContinuousBatcher:
         self._moe_load = None
         self._tick_count = 0
         self._init_storage()
+        # Roofline cost plane (round 23): the analytical card is a pure
+        # function of the serving configuration, derived ONCE here (a
+        # dict lookup + arithmetic — never per-tick math); guard exits
+        # accumulate (steps, tokens, ctx) per phase into _cost_acc and
+        # the DERIVED_OBSERVE_EVERY cadence multiplies through the card
+        # into the program FLOP/byte counters (see _cost_flush).
+        self._cost_card = costmodel.derive_card(self.cost_shape())
+        # attended context per token saturates at the attention window
+        # (full-causal configs: max_seq)
+        self._cost_ctx_cap = int(cfg.window or cfg.max_seq)
+        self._cost_acc = {p: [0.0, 0.0, 0.0] for p in health.PHASES}
         self._observe_storage()
 
     # -- telemetry helpers ---------------------------------------------
@@ -787,6 +799,7 @@ class ContinuousBatcher:
             # utilization at scrape time anyway, and retrace growth is
             # a trend, not a per-tick event)
             health.refresh_device_utilization()
+            self._cost_flush()
             _observe_retraces()
 
     def _complete(self, rid: int, output: List[int]) -> None:
@@ -875,6 +888,66 @@ class ContinuousBatcher:
                                                 phase="decode")
             metrics.GENERATED_TOKENS.inc(a["done_tokens"])
 
+    # -- roofline cost accounting (round 23) ----------------------------
+    def _cost_ctx_ramp(self, pos0: int, n: int) -> int:
+        """Total attended context positions across ``n`` consecutive
+        tokens whose FIRST sits at cache position ``pos0`` (attending
+        ``pos0 + 1`` positions, itself included), saturating at the
+        attention window — the arithmetic-series half of the card's
+        ``ctx`` count, host-side integer math only."""
+        cap = self._cost_ctx_cap
+        a = pos0 + 1
+        if a >= cap:
+            return n * cap
+        m = min(n, cap - a + 1)
+        return m * a + m * (m - 1) // 2 + (n - m) * cap
+
+    def _cost_note(self, phase: str, steps: float, tokens: float,
+                   ctx: float) -> None:
+        """Accumulate one guarded dispatch's (scan steps, real tokens,
+        attended context) under ``phase`` — three float adds on the hot
+        path; the card multiply happens at the DERIVED_OBSERVE_EVERY
+        cadence in :meth:`_cost_flush` (the round-11 overhead guard
+        covers this site)."""
+        if telemetry.enabled():
+            acc = self._cost_acc[phase]
+            acc[0] += steps
+            acc[1] += tokens
+            acc[2] += ctx
+
+    def _cost_spec_counts(self, n_rounds: int, k: int):
+        """(verify-row tokens, attended context) of ``n_rounds`` spec
+        rounds over the current slots: greedy slots verify ``1 + k``
+        rows per round (the spec row multiplier), sampling slots ride
+        the dispatch as plain decode rows."""
+        toks = ctx = 0
+        for s in self.slots.values():
+            rows = (1 + k) if s.temperature == 0.0 else 1
+            toks += rows * n_rounds
+            ctx += rows * self._cost_ctx_ramp(s.length, n_rounds)
+        return toks, ctx
+
+    def _cost_flush(self) -> None:
+        """Multiply the accumulated counts through the cost card into
+        the program FLOP / HBM-byte / ICI-byte counters and re-derive
+        the roofline gauges — cadence-throttled like the goodput
+        re-derivation it rides next to."""
+        card = self._cost_card
+        ici = 0.0
+        for phase, acc in self._cost_acc.items():
+            steps, tokens, ctx = acc
+            if not steps and not tokens:
+                continue
+            metrics.PROGRAM_FLOPS.inc(card.flops(steps, tokens, ctx),
+                                      phase=phase)
+            metrics.PROGRAM_HBM_BYTES.inc(
+                card.hbm_bytes(steps, tokens, ctx), phase=phase)
+            ici += card.ici_bytes(steps, tokens)
+            acc[0] = acc[1] = acc[2] = 0.0
+        if ici:
+            metrics.ICI_BYTES.inc(ici)
+        metrics.refresh_roofline()
+
     def _observe_prefill(self) -> None:
         """Mirror the mid-prefill queue depth into /metrics (every site
         that grows or shrinks ``self.prefilling`` calls this)."""
@@ -924,6 +997,49 @@ class ContinuousBatcher:
             info.update(self.adapter_pool.storage_info())
         info.update(self._expert_storage_info())
         return info
+
+    def cost_shape(self) -> dict:
+        """This batcher's configuration as the plain dict
+        :func:`tpushare.analysis.costmodel.derive_card` prices — model
+        dims by value, dtype by NAME, storage geometry from
+        :meth:`storage_info`, and EFFECTIVE mesh degrees (a demoted
+        gate reports 1, mirroring what the programs actually run).
+        ``cross_check_live`` builds a card from this dict and pins its
+        ``predicted`` bytes against ``storage_info()`` key-for-key, so
+        the two surfaces cannot drift silently."""
+        cfg = self.cfg
+        info = self.storage_info()
+        from ..ops.attention import tp_degree
+        shape = {
+            "vocab": cfg.vocab, "d_model": cfg.d_model,
+            "n_layers": cfg.n_layers, "n_heads": cfg.n_heads,
+            "n_kv_heads": cfg.n_kv_heads, "head_dim": cfg.head_dim,
+            "d_ff": cfg.d_ff, "max_seq": cfg.max_seq,
+            "window": cfg.window,
+            "dtype": jnp.dtype(cfg.dtype).name,
+            "kv_dtype": cfg.kv_dtype,
+            "n_experts": getattr(cfg, "n_experts", 0),
+            "moe_top_k": getattr(cfg, "moe_top_k", 1),
+            "moe_every": getattr(cfg, "moe_every", 1),
+            "kind": info["kind"],
+            "attn_kernel": info.get("attn_kernel", "xla"),
+            "n_slots": self.n_slots,
+            "tp": tp_degree(self.mesh, "tp") if self.mesh is not None
+                  else 1,
+            "sp": info.get("sp_shards", 1),
+            "pp": self.pp,
+            "pp_staged": self._pp_args is not None,
+            "ep": info.get("ep_shards", 1),
+            "spec_k": self.spec_k,
+            "adapter_rank": (self.adapter_pool.rank
+                             if self.adapter_pool is not None else 0),
+        }
+        if info["kind"] == "paged":
+            shape["page_tokens"] = info["page_tokens"]
+            shape["n_pages"] = info["n_pages"]
+        else:
+            shape["slot_tokens"] = info["slot_tokens"]
+        return costmodel.normalize_shape(shape)
 
     def _expert_storage_info(self) -> dict:
         """Expert-pool residency economics (round 22), shared by the
@@ -1319,6 +1435,8 @@ class ContinuousBatcher:
                            max_new_tokens, temperature, seed, eos_id,
                            top_k, top_p)
         self._acct_credit(g.device_s, [], [rid])
+        self._cost_note("prefill", 1, len(prompt),
+                        self._cost_ctx_ramp(0, len(prompt)))
         self._acct_flush()
         return rid
 
@@ -1465,6 +1583,7 @@ class ContinuousBatcher:
         # stall-watch without observing, or the prefill device-time
         # histogram would fill with ~0 samples
         final = end >= n
+        pos0 = st.pos
         with health.MONITOR.dispatch_guard(
                 "prefill", observe=final, tokens=len(piece),
                 rids=[st.request_id],
@@ -1480,6 +1599,8 @@ class ContinuousBatcher:
         # mid-prompt chunks dispatch async (device_s is None there, like
         # the phase histogram); only the final chunk's sync point credits
         self._acct_credit(g.device_s, [], [st.request_id])
+        self._cost_note("prefill", 1, len(piece),
+                        self._cost_ctx_ramp(pos0, len(piece)))
         if final:
             self._acct_flush()
 
@@ -1556,6 +1677,13 @@ class ContinuousBatcher:
             self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
+        if telemetry.enabled():
+            # each slot's token attends its cache depth + itself,
+            # window-capped — lengths are pre-increment here
+            cap = self._cost_ctx_cap
+            self._cost_note("decode", 1, n_active,
+                            sum(min(s.length + 1, cap)
+                                for s in self.slots.values()))
         for i in list(self.slots):
             s = self.slots[i]
             s.length += 1              # last_token now lives in the cache
@@ -1620,6 +1748,10 @@ class ContinuousBatcher:
             self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
+        if telemetry.enabled():
+            self._cost_note("decode", n_steps, n_active * n_steps,
+                            sum(self._cost_ctx_ramp(s.length, n_steps)
+                                for s in self.slots.values()))
         self._drain_fused_tokens(toks, new_keys, n_steps)
         self._observe_tick(t0)
         return n_active
@@ -1848,6 +1980,14 @@ class ContinuousBatcher:
             # steps produce nothing, so they don't count (tick_fused
             # returns before counting when no slot decodes)
             metrics.FUSED_STEPS.inc(n_steps)
+        # cost counts use PRE-advance offsets (real chunk tokens and the
+        # context each attends — padded rows excluded, MFU = goodput)
+        if telemetry.enabled():
+            p_toks = sum(end - st.pos for _, _, st, end in plan)
+            p_ctx = sum(self._cost_ctx_ramp(st.pos, end - st.pos)
+                        for _, _, st, end in plan)
+        else:
+            p_toks = p_ctx = 0
         # Advance host-side offsets BEFORE gathering the decode operands:
         # the scan's frozen garbage write for a row prefilled this round
         # must aim at the POST-chunk offset (the next window, overwritten
@@ -1901,6 +2041,15 @@ class ContinuousBatcher:
                 new_keys = np.asarray(jax.random.key_data(new_keys))
             self._maybe_observe_expert_load()
         self._acct_credit(g.device_s, decode_rids, prefill_rids)
+        if telemetry.enabled():
+            # one weight pass for the prefill block + n_steps scan
+            # iterations when anything decodes (decoder-empty rounds
+            # run the scan for shape only — no goodput, not counted)
+            self._cost_note(
+                "mixed", (n_steps if n_active else 0) + 1,
+                p_toks + n_active * n_steps,
+                p_ctx + sum(self._cost_ctx_ramp(s.length, n_steps)
+                            for s in self.slots.values()))
         if n_active:
             self._drain_fused_tokens(toks, new_keys, n_steps)
         self._finish_mixed_round(plan, sel, overflow)
@@ -2120,6 +2269,9 @@ class ContinuousBatcher:
             lives = np.asarray(out[5])
         self._acct_credit(g.device_s, rids)
         n_active = len(self.slots)
+        if telemetry.enabled():
+            toks, ctx = self._cost_spec_counts(n_rounds, k)
+            self._cost_note("decode", n_rounds, toks, ctx)
         self._drain_spec(bufs_h, produced, next_h, new_keys, accepts,
                          lives, n_rounds)
         self._observe_tick(t0)
@@ -2152,6 +2304,13 @@ class ContinuousBatcher:
                 overflow, t0,
                 lambda: self.tick_spec(n_rounds, k=k, ngram=ngram))
         plan = block["plan"]
+        # cost counts use PRE-advance offsets, like tick_mixed
+        if telemetry.enabled():
+            p_toks = sum(end - st.pos for _, _, st, end in plan)
+            p_ctx = sum(self._cost_ctx_ramp(st.pos, end - st.pos)
+                        for _, _, st, end in plan)
+        else:
+            p_toks = p_ctx = 0
         # advance offsets BEFORE gathering: frozen rows aim their
         # (1+k)-wide garbage verify at the POST-chunk offset, the same
         # aim tick_mixed gives the frozen decode scan
@@ -2196,6 +2355,10 @@ class ContinuousBatcher:
                 accepts = np.asarray(out[5])
                 lives = np.asarray(out[6])
         self._acct_credit(g.device_s, decode_rids, prefill_rids)
+        if telemetry.enabled():
+            toks, ctx = self._cost_spec_counts(n_rounds, k)
+            self._cost_note("mixed", (n_rounds if n_active else 0) + 1,
+                            p_toks + toks, p_ctx + ctx)
         if n_active:
             self._drain_spec(bufs_h, produced, next_h, new_keys,
                              accepts, lives, n_rounds)

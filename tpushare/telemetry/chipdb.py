@@ -1,0 +1,88 @@
+"""Chip peak database: the denominators of the roofline cost plane.
+
+One tiny table of published per-chip peaks — bf16 matmul FLOP/s, HBM
+bandwidth, ICI (inter-chip interconnect) bandwidth — keyed by
+accelerator-type substring, most specific first (the bench.py
+``_PEAK_BF16`` idiom; bench now routes through here so the repo keeps
+ONE peak table).  Sources: public TPU spec sheets, per chip.
+
+Resolution order mirrors the C shim (native/tpushim.c):
+``TPUSHIM_ACCELERATOR_TYPE`` wins — the test/generation override,
+because the host rewrites ``TPU_ACCELERATOR_TYPE`` (CLAUDE.md) — then
+``TPU_ACCELERATOR_TYPE``, then an explicit ``kind`` argument (e.g. a
+jax ``device_kind`` string).  An unknown/absent type returns ``None``:
+the roofline gauges are ABSENT on CPU or unrecognized chips, never
+zero — a 0% MFU reading must mean "measured idle", not "no table row".
+
+Stdlib only; importable before jax like the rest of the telemetry
+plane.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+
+class ChipPeaks(NamedTuple):
+    """Published peaks for one chip generation (per chip, all cores)."""
+
+    #: canonical generation name (the substring key that matched)
+    generation: str
+    #: bf16 matmul peak, FLOP/s
+    flops_bf16: float
+    #: HBM bandwidth, bytes/s
+    hbm_bytes_per_s: float
+    #: ICI bandwidth per chip (aggregate across links), bytes/s
+    ici_bytes_per_s: float
+
+
+#: (substring, peaks) — matched against the lowercased accelerator
+#: type, MOST SPECIFIC FIRST ("v5p" before "v5"; "v5" covers
+#: v5e / v5lite / v5litepod, the chip this repo's tunnel serves).
+CHIP_PEAKS = (
+    ("v6", ChipPeaks("v6", 918e12, 1640e9, 448e9)),     # Trillium
+    ("v5p", ChipPeaks("v5p", 459e12, 2765e9, 600e9)),
+    ("v5", ChipPeaks("v5", 197e12, 819e9, 200e9)),      # v5e / v5 lite
+    ("v4", ChipPeaks("v4", 275e12, 1228e9, 300e9)),
+    ("v3", ChipPeaks("v3", 123e12, 900e9, 100e9)),
+    ("v2", ChipPeaks("v2", 45e12, 700e9, 62e9)),
+)
+
+#: env vars consulted, in order (shim precedence: the test override
+#: beats the host-rewritten one)
+ACCELERATOR_TYPE_ENVS = ("TPUSHIM_ACCELERATOR_TYPE",
+                         "TPU_ACCELERATOR_TYPE")
+
+
+def accelerator_type(kind: Optional[str] = None) -> Optional[str]:
+    """The accelerator-type string to key peaks by: the explicit
+    ``kind`` argument (a jax ``device_kind``, when the caller has a
+    live backend) beats the env, which follows shim precedence."""
+    if kind:
+        return kind
+    for env in ACCELERATOR_TYPE_ENVS:
+        val = os.environ.get(env)
+        if val:
+            return val
+    return None
+
+
+def chip_peaks(kind: Optional[str] = None) -> Optional[ChipPeaks]:
+    """Peaks for the resolved accelerator type, or ``None`` when the
+    type is absent (CPU) or matches no table row (future chips refuse
+    loudly-by-absence instead of reusing a stale generation's peaks)."""
+    resolved = accelerator_type(kind)
+    if not resolved:
+        return None
+    lowered = resolved.lower()
+    for key, peaks in CHIP_PEAKS:
+        if key in lowered:
+            return peaks
+    return None
+
+
+def chip_peak_flops(kind: Optional[str] = None) -> Optional[float]:
+    """bf16 peak FLOP/s alone (the bench.py MFU denominator)."""
+    peaks = chip_peaks(kind)
+    return peaks.flops_bf16 if peaks else None
