@@ -523,6 +523,83 @@ def pp_microbatch_bench(params, cfg, *, slots, gen, decode_chunk, pp,
     return out
 
 
+def pp_composed_bench(params, cfg, *, slots, gen, decode_chunk, pp, tp,
+                      rpc_s, reps=2):
+    """Composed-mesh staged decode (round 24): the NESTED tp x pp
+    wavefront (one SPMD dispatch per fused round runs the whole
+    ``pp_stage_schedule`` inside the tp shard_map's stage bodies) vs
+    the PLACEMENT-DEMOTED baseline it replaces — pre-round-24 a
+    tp x pp mesh tripped the old ``pp_mesh`` gate and demoted the
+    staged program, so an operator wanting the wavefront had to drive
+    the schedule from the host: every (stage, microbatch) cell its own
+    dispatch through the placement-sharded flat program,
+    ``pp * n_micro`` dispatch costs per round where the composed
+    wavefront pays one.
+
+    Both arms run REAL programs off-TPU over the SAME tp x pp virtual
+    mesh — the placement arm keeps ``pp=1`` on the staged side while
+    layer placement still shards over the mesh's pp axis (exactly the
+    pre-round-24 demoted serving shape) — and the ~70 ms tunnel RPC
+    is charged per dispatch by a GIL-releasing sleep.  Greedy rows
+    only (composed tp keeps the round-12 agreement bar on bf16; the
+    f32 tiny config is exact) and streams asserted identical between
+    arms.  Importable so a test can smoke-run it at tiny sizes
+    (tier-1-safe).  Returns {"composed", "placement_replay",
+    "n_micro", "schedule_cells"}.
+    """
+    from tpushare.parallel.mesh import make_mesh
+    from tpushare.parallel.pipeline import pp_stage_schedule
+    from tpushare.serving.continuous import ContinuousBatcher
+
+    prompts = [[1 + ((5 * i + j) % 11) for j in range(4 + (i % 3))]
+               for i in range(slots)]
+
+    def drain(b, disp_per_round):
+        n_disp = [0]
+        real = b._step_n
+
+        def counted(*a, **k):
+            n_disp[0] += disp_per_round
+            time.sleep(rpc_s * disp_per_round)
+            return real(*a, **k)
+
+        b._step_n = counted
+        rids = [b.admit(p, gen) for p in prompts]
+        t0 = time.perf_counter()
+        while b.slots:
+            b.tick_fused(decode_chunk)
+        dt = time.perf_counter() - t0
+        return dt, n_disp[0], {
+            tuple(p): b.completed[r] for p, r in zip(prompts, rids)}
+
+    mesh = make_mesh({"pp": pp, "tp": tp})
+    probe = ContinuousBatcher(params, cfg, n_slots=slots, mesh=mesh,
+                              pp=pp)
+    assert probe.cost_shape()["pp_staged"], \
+        "composed tp x pp mesh demoted the staged program"
+    n_micro = probe.pp_microbatches
+    cells = len(pp_stage_schedule(pp, n_micro))
+    out = {}
+    for _ in range(reps):       # first rep absorbs the compiles
+        composed = ContinuousBatcher(params, cfg, n_slots=slots,
+                                     mesh=mesh, pp=pp)
+        dt_c, disp_c, st_c = drain(composed, 1)
+        placement = ContinuousBatcher(params, cfg, n_slots=slots,
+                                      mesh=mesh)
+        dt_p, disp_p, st_p = drain(placement, cells)
+        out = {
+            "composed": {"tokens_per_s": slots * gen / dt_c,
+                         "dispatches": disp_c},
+            "placement_replay": {"tokens_per_s": slots * gen / dt_p,
+                                 "dispatches": disp_p},
+            "n_micro": n_micro,
+            "schedule_cells": cells,
+        }
+    assert st_c == st_p, \
+        "composed wavefront streams diverged from the placement arm"
+    return out
+
+
 def moe_ep_decode_bench(params, cfg, *, slots, gen, decode_chunk, ep,
                         rpc_s, reps=2):
     """Expert-parallel MoE decode (round 22): per-token top-k routing
@@ -1992,6 +2069,40 @@ def main() -> int:
                    "drive_moe_decode)")
         assert moe_vs_seq > 1.0, \
             f"batched routed decode only {moe_vs_seq}x per-expert groups"
+
+    # 2i. COMPOSED-MESH STAGED DECODE (round 24): the pp wavefront
+    # nested inside the tp shard_map — one dispatch per fused round on
+    # the tp x pp mesh — vs the placement-demoted host-driven schedule
+    # replay a pre-round-24 deployment paid (the old pp_mesh gate
+    # kept the staged program off any composed mesh).  CPU-only like
+    # 2g/2h — the sleep proxy is only honest where real dispatch is
+    # sub-ms; the chip claim lives in drive_pp_decode's tp2_pp2 arm.
+    if not on_tpu and len(jax.devices()) >= 4:
+        cmcfg = transformer.tiny(n_layers=4, max_seq=96)
+        cmpar = transformer.init_params(jax.random.PRNGKey(13), cmcfg)
+        cmb = pp_composed_bench(cmpar, cmcfg, slots=4, gen=9,
+                                decode_chunk=4, pp=2, tp=2, rpc_s=0.07)
+        cm_vs_place = round(cmb["composed"]["tokens_per_s"]
+                            / cmb["placement_replay"]["tokens_per_s"],
+                            3)
+        _emit("pp_composed_decode_tokens_per_s",
+              cmb["composed"]["tokens_per_s"], "tokens/s",
+              platform=platform, pp=2, tp=2, n_micro=cmb["n_micro"],
+              slots=4,
+              dispatches=cmb["composed"]["dispatches"],
+              placement_dispatches=cmb["placement_replay"][
+                  "dispatches"],
+              vs_placement_replay=cm_vs_place,
+              placement_tokens_per_s=round(
+                  cmb["placement_replay"]["tokens_per_s"], 2),
+              schedule_cells=cmb["schedule_cells"],
+              note="nested tp x pp wavefront (one dispatch per fused "
+                   "round) vs the placement-demoted host-driven "
+                   "schedule replay at ~70 ms per dispatch; streams "
+                   "asserted identical (chip claim in drive_pp_decode "
+                   "tp2_pp2 arm)")
+        assert cm_vs_place >= 2.0, \
+            f"composed wavefront only {cm_vs_place}x placement replay"
 
     # 3. speculative decoding ceiling: draft == target isolates the
     # mechanism (acceptance 1.0); with randomly-initialized models a

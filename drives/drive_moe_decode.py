@@ -55,8 +55,13 @@ def precheck() -> dict:
     from tpushare.analysis.mosaic import precheck_expert_gather
 
     v = precheck_expert_gather(N_EXPERTS, 2, pp=1, cross_check=False)
-    return {"mode": "xla_only", "ok": v.ok,
-            "reason": getattr(v, "reason", None)}
+    # composed ep x pp (round 24): the staged wavefront runs the ep
+    # psum inside its stage bodies — the gate must agree it composes
+    vc = precheck_expert_gather(N_EXPERTS, 2, pp=2, cross_check=False)
+    return {"mode": "xla_only", "ok": v.ok and vc.ok,
+            "reason": getattr(v, "reason", None),
+            "composed_pp": {"ok": vc.ok,
+                            "reason": getattr(vc, "reason", None)}}
 
 
 def main() -> int:
@@ -90,10 +95,10 @@ def main() -> int:
            "page_size": page, "n_experts": N_EXPERTS, "top_k": TOP_K,
            "precheck_ok": pre["ok"], "precheck": pre}
 
-    def drain(run_params, run_cfg, mesh=None):
+    def drain(run_params, run_cfg, mesh=None, pp=1):
         """One fused drain; returns (wall_s, dispatches, streams)."""
         b = PagedContinuousBatcher(run_params, run_cfg, n_slots=slots,
-                                   page_size=page, mesh=mesh)
+                                   page_size=page, mesh=mesh, pp=pp)
         n_disp = [0]
         real = b._step_n
 
@@ -177,11 +182,11 @@ def main() -> int:
     # partial fold lowering when each shard holds E/ep experts —
     # neither the CPU mesh nor the single-device compile exercises the
     # sharded gather on real Mosaic/ICI.
-    def ep_arm(axes):
+    def ep_arm(axes, pp=1):
         from tpushare.parallel.mesh import make_mesh
         mesh = make_mesh(axes)
-        drain(params, cfg, mesh=mesh)
-        dt_ep, disp_ep, st_ep = drain(params, cfg, mesh=mesh)
+        drain(params, cfg, mesh=mesh, pp=pp)
+        dt_ep, disp_ep, st_ep = drain(params, cfg, mesh=mesh, pp=pp)
         agree = sum(x == y for sa, sb in zip(streams_b, st_ep)
                     for x, y in zip(sa[prompt_len:], sb[prompt_len:]))
         return {"compile_ok": True, "axes": axes,
@@ -207,6 +212,20 @@ def main() -> int:
         out["tp2ep2"] = ep_arm({"tp": 2, "ep": 2})
     else:
         out["tp2ep2"] = {"skipped": "needs 4 devices + divisible heads"}
+
+    if len(jax.devices()) >= 4 and cfg.n_experts % 2 == 0 \
+            and cfg.n_layers % 2 == 0:
+        # ep x pp composed (round 24): the staged wavefront runs the
+        # clipped local gather + ep psum INSIDE its stage bodies — the
+        # fori_loop + ppermute(pp) carrying ep collectives on the
+        # disjoint axis is exactly what the flat-mesh arms above cannot
+        # prove.  Pure ep x pp never reassociates (staging adds exact
+        # zeros, out-of-range experts contribute weight-zero partials),
+        # so exact_vs_single is the bar even in bf16.
+        out["ep2_pp2"] = ep_arm({"pp": 2, "ep": 2}, pp=2)
+    else:
+        out["ep2_pp2"] = {
+            "skipped": "needs 4 devices + divisible experts/layers"}
 
     print(json.dumps(out))
     return 0

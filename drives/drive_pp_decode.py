@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 #: the on-chip staged shape (must stay in sync with the TPU branch of
 #: main()): 16 layers over pp=2 stages = 8 per stage; full-causal
-#: storage, no tp/sp composition (the pp_mesh gate refuses it)
+#: storage; tp×pp composition rides its own arm (round 24)
 _TPU_PP = dict(n_layers=16, pp=2, tp=1, sp=1, rolling=False)
 
 
@@ -66,6 +66,12 @@ def precheck() -> dict:
         # the CPU rehearsal shape (4 tiny layers over 2 stages)
         "pp2_cpu": mosaic.precheck_pp_stage(
             n_layers=4, pp=2, cross_check=False).summary(),
+        # round 24: the composed tp x pp wavefront must ENGAGE (the
+        # old pp_mesh refusal is gone) — the nested shard_map's
+        # Megatron psums riding the fori_loop + ppermute ticks are
+        # exactly what only real ICI lowering proves
+        "tp2_pp2": mosaic.precheck_pp_stage(
+            cross_check=False, **dict(_TPU_PP, tp=2)).summary(),
     }
     return cells
 
@@ -120,8 +126,9 @@ def main() -> int:
     lengths0 = jnp.full((batch,), prompt_len, jnp.int32)
 
     # -- dense full-size caches ----------------------------------------
-    def run_dense(staged: bool):
-        run_params = (shard_params(params, mesh, layer_axis="pp")
+    def run_dense(staged: bool, run_mesh=None):
+        run_mesh = mesh if run_mesh is None else run_mesh
+        run_params = (shard_params(params, run_mesh, layer_axis="pp")
                       if staged else params)
 
         @jax.jit
@@ -137,7 +144,7 @@ def main() -> int:
                 if staged:
                     logits, caches = transformer.forward_pp_decode(
                         run_params, tok[:, None], cfg, caches, lengths,
-                        mesh, n_micro=n_micro)
+                        run_mesh, n_micro=n_micro)
                 else:
                     logits, caches = transformer.forward(
                         run_params, tok[:, None], cfg, kv_caches=caches,
@@ -152,7 +159,8 @@ def main() -> int:
         def run():
             caches = transformer.init_kv_caches(cfg, batch)
             if staged:
-                caches = shard_kv_storage(caches, mesh, layer_axis="pp")
+                caches = shard_kv_storage(caches, run_mesh,
+                                          layer_axis="pp")
             logits, caches = prefill_jit(caches)
             tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
             toks, caches = decode_n(tok0, caches, n_dec)
@@ -255,6 +263,25 @@ def main() -> int:
     assert streams["paged_pp2"] == streams["paged_flat"], \
         "staged paged stream diverged from flat"
     out["exact"] = True
+    # -- round 24: the COMPOSED tp x pp wavefront -----------------------
+    # Compile-check arm: one shard_map over {pp, tp}, the stage body
+    # running the per-shard attention + Megatron psums inside the
+    # fori_loop + ppermute wavefront.  bf16 tp reassociates projection
+    # reductions (the round-12 bar), so this arm records greedy
+    # AGREEMENT with the flat stream, not exactness.
+    if len(jax.devices()) >= 2 * pp:
+        mesh_tp = make_mesh({"pp": pp, "tp": 2})
+        compile_s, tps, first, finite = run_dense(True, run_mesh=mesh_tp)
+        ref = streams["dense_flat"]
+        agree = (sum(a == b for a, b in zip(first, ref)) / len(ref)
+                 if ref else 0.0)
+        out["arms"]["dense_tp2_pp2"] = {
+            "compile_s": round(compile_s, 1),
+            "tokens_per_s": round(tps, 1), "finite": finite}
+        out["tp2_pp2"] = {"compile_ok": finite,
+                          "greedy_agree_frac": round(agree, 3)}
+    else:
+        out["tp2_pp2"] = {"skipped": "needs >= 4 devices for pp x tp"}
     out["compile_ok"] = all(a["finite"] for a in out["arms"].values())
     out["pp2"] = {"compile_ok": out["compile_ok"]}
     for flavor in ("dense", "paged"):

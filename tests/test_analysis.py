@@ -973,6 +973,39 @@ def test_stage_schedule_mirror_and_audit():
     assert any("out of order" in f.message for f in fs), fs
 
 
+def test_composed_stage_schedule_violations_caught():
+    """Round 24 nests the pp wavefront inside the tp/sp(/ep)
+    shard_map: the stage table is COLUMN-INVARIANT — every tp/sp/ep
+    column of the composed mesh replays the SAME
+    (tick, stage, microbatch) schedule as SPMD replicas of one
+    program, so the audit contract does not grow a mesh dimension.
+    Seeded composed violations must therefore surface in the replayed
+    table exactly like flat ones, caught by name."""
+    from tpushare.parallel.pipeline import pp_stage_schedule
+
+    good = dispatch_audit.pp_stage_schedule_mirror(2, 2)
+    # column-invariance: the composed program's table IS the pure-pp
+    # table — no cells are added or moved by tp/sp/ep columns
+    assert good == pp_stage_schedule(2, 2)
+    assert dispatch_audit.audit_stage_schedule(good, 2, 2) == []
+    # a WRONG composition that materialized one wavefront PER mesh
+    # column (columns are replicas, not extra dispatches) duplicates
+    # every (stage, microbatch) cell on later ticks
+    per_column = tuple((t + len(good), s, m) for (t, s, m) in good)
+    fs = dispatch_audit.audit_stage_schedule(good + per_column, 2, 2)
+    dups = [f for f in fs if f.rule == "stage-dispatch"
+            and "twice" in f.message]
+    assert len(dups) == len(good), fs
+    # a stage body that re-issues one cell inside the nested shard_map
+    # (e.g. the attention read dispatched once per shard AND once in
+    # the fold) is the single-cell twin
+    seeded = good + ((len(good), 1, 1),)
+    fs = dispatch_audit.audit_stage_schedule(seeded, 2, 2)
+    assert any(f.rule == "stage-dispatch"
+               and "stage 1 dispatches microbatch 1 twice" in f.message
+               for f in fs), fs
+
+
 def test_dispatches_per_round_closed_form():
     """The runtime dispatch-count tests assert against this closed
     form: one HOST dispatch per round at EVERY pipeline degree (the
@@ -1024,7 +1057,10 @@ def test_precheck_expert_gather_gate_drift_raises(monkeypatch):
 
     assert mosaic.precheck_expert_gather(4, 2, cross_check=True).ok
     assert mosaic.precheck_expert_gather(3, 2).reason == "ep_experts"
-    assert mosaic.precheck_expert_gather(4, 2, pp=2).reason == "ep_mesh"
+    # round 24: the composed wavefront runs ep inside the stage bodies
+    assert mosaic.precheck_expert_gather(4, 2, pp=2).ok
+    assert mosaic.precheck_expert_gather(
+        4, 2, pp=2, cross_check=True).ok
     monkeypatch.setattr(experts, "expert_fallback_reason",
                         lambda *a, **k: "ep_experts")
     with pytest.raises(mosaic.GateDriftError):
@@ -1157,6 +1193,57 @@ def test_costmodel_contract_pin_drift(monkeypatch):
     monkeypatch.setattr(costmodel, "ENTRY_PHASES", bad_phase)
     with pytest.raises(costmodel.CostDriftError, match="health.PHASES"):
         costmodel.cross_check_live()
+
+
+def test_costmodel_composed_ici_column_scaling():
+    """Round-24 ICI pins: the composed staged wavefront charges its
+    ppermute hops + logit fold once per tp*sp*ep mesh COLUMN (every
+    column moves its own replicated activation copy), additively with
+    the tp/sp/ep terms — so a composed card decomposes exactly into
+    the axis-only card plus cols x the pure-pp staged card.  Pure-pp
+    staged and placement-pp cards are unchanged from round 23."""
+    from tpushare.analysis import costmodel
+
+    base = dict(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq=128, dtype="float32",
+                n_slots=4, kind="dense", slot_tokens=128)
+
+    def card(**kw):
+        return costmodel.derive_card(
+            costmodel.normalize_shape(dict(base, **kw)))
+
+    d, vocab, item = 64.0, 256.0, 4.0           # f32 activations
+    hop = (2 - 1) * d * item                    # pp=2 activation hop
+    fold = (2.0 * (2 - 1) / 2) * vocab * 4      # staged f32 logit fold
+    pure = card(pp=2, pp_staged=True).ici_per_token
+    assert pure == pytest.approx(hop + fold)
+    # placement-only pp keeps the single GSPMD hop, no fold
+    assert card(pp=2).ici_per_token == pytest.approx(hop)
+
+    # tp x pp composed: tp's allreduces + 2 columns of hops + folds
+    tp_only = card(tp=2).ici_per_token
+    assert card(tp=2, pp=2, pp_staged=True).ici_per_token == \
+        pytest.approx(tp_only + 2 * pure)
+    # ep x pp composed: the routed-layer psum term + 2 columns
+    ep_kw = dict(n_experts=4, moe_top_k=2, moe_every=2, ep=2)
+    ep_only = card(**ep_kw).ici_per_token
+    assert card(pp=2, pp_staged=True, **ep_kw).ici_per_token == \
+        pytest.approx(ep_only + 2 * pure)
+    # sp x pp composed (paged): sp charges per STEP (the stripe
+    # merge), pp per token — the column scaling shows up on the
+    # token side only
+    sp_kw = dict(kind="paged", page_tokens=16, n_pages=32, sp=2)
+    sp_kw.pop("slot_tokens", None)
+    sp_only = card(**sp_kw)
+    comp = card(pp=2, pp_staged=True, **sp_kw)
+    assert comp.ici_per_step == pytest.approx(sp_only.ici_per_step)
+    assert comp.ici_per_token == pytest.approx(
+        sp_only.ici_per_token + 2 * pure)
+    # full tp x sp x ep x pp: 8 columns
+    full = card(tp=2, sp=2, pp=2, pp_staged=True,
+                kind="paged", page_tokens=16, n_pages=32, **ep_kw)
+    assert full.ici_per_token == pytest.approx(
+        tp_only + ep_only + sp_only.ici_per_token + 8 * pure)
 
 
 def test_costmodel_storage_key_drift(monkeypatch):

@@ -133,6 +133,48 @@ def test_flush_multiplies_through_the_card_and_cadence_fires(batcher):
     assert metrics.PROGRAM_FLOPS.value(phase="decode") > before
 
 
+def test_short_lived_service_flushes_cost_on_stop():
+    """Satellite fix (round 24): a service that serves FEWER than
+    DERIVED_OBSERVE_EVERY rounds used to report zero flops/hbm bytes
+    forever — the cadence flush never fired.  The loop now flushes
+    residual accumulations at the idle transition and on loop exit,
+    so even a one-request burst shows up in the work counters."""
+    from tpushare.serving.continuous import ContinuousService
+
+    cfg = transformer.tiny()
+    params = transformer.init_params(jax.random.PRNGKey(0), cfg)
+    before = {p: metrics.PROGRAM_FLOPS.value(phase=p)
+              for p in ("prefill", "decode", "mixed")}
+    svc = ContinuousService(params, cfg, n_slots=2, prefill_chunk=4,
+                            decode_chunk=4).start()
+    try:
+        out = svc.submit([1, 2, 3], 4).get(timeout=120)
+        assert len(out) == 7
+        assert svc._batcher._tick_count < DERIVED_OBSERVE_EVERY
+    finally:
+        svc.stop()
+    flushed = sum(metrics.PROGRAM_FLOPS.value(phase=p) - before[p]
+                  for p in before)
+    assert flushed > 0.0
+    # and the accumulators drained — nothing left behind
+    assert all(tuple(a) == (0.0, 0.0, 0.0)
+               for a in svc._batcher._cost_acc.values())
+
+
+def test_flush_cost_is_public_and_idempotent(batcher):
+    b = batcher
+    _reset_acc(b)
+    if not b.slots:
+        b.admit([7, 8, 9], max_new_tokens=2 * DERIVED_OBSERVE_EVERY)
+    b.tick()
+    before = metrics.PROGRAM_FLOPS.value(phase="decode")
+    b.flush_cost()
+    after = metrics.PROGRAM_FLOPS.value(phase="decode")
+    assert after > before
+    b.flush_cost()                       # drained: exact no-op
+    assert metrics.PROGRAM_FLOPS.value(phase="decode") == after
+
+
 def test_single_dispatch_flops_exceed_per_token_floor(batcher):
     """Sanity anchor: one decode token costs at least the per-token
     card coefficient (the context term only adds)."""

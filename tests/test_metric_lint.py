@@ -232,7 +232,7 @@ ENUMERATED_VALUES = {
     # keep in sync with ops.attention.FALLBACK_REASONS (asserted below)
     ("tpushare_attn_kernel_fallback_total", "reason"):
         {"head_dim", "page_tile", "max_rows", "tp_heads", "sp_pool",
-         "forced", "pp_layers", "pp_mesh", "pp_storage"},
+         "forced", "pp_layers", "pp_storage"},
     # keep in sync with continuous.SPEC_FALLBACK_REASONS (asserted
     # below)
     ("tpushare_spec_fallback_total", "reason"):
@@ -263,7 +263,7 @@ ENUMERATED_VALUES = {
     # keep in sync with ops.experts.EXPERT_FALLBACK_REASONS (enum-
     # pinned): structural ep demotions to the replicated expert pool
     ("tpushare_expert_fallback_total", "reason"):
-        {"ep_experts", "ep_mesh"},
+        {"ep_experts"},
     # keep in sync with telemetry.propagation.REQUEST_HOPS (enum-
     # pinned): the router's critical-path decomposition
     ("tpushare_request_hop_seconds", "hop"):

@@ -20,9 +20,11 @@ The exactness contract under test:
 * ONE DISPATCH PER ROUND survives with experts active (wrap lists
   derive from dispatch_audit.ENTRY_CONTRACT, so the runtime count and
   the static audit prove the same invariant);
-* STRUCTURAL DEMOTION — an indivisible expert count (or a staged pp
-  program) demotes to the replicated pool: counted, reported in
-  storage_info, never a crash.
+* STRUCTURAL DEMOTION — an indivisible expert count demotes to the
+  replicated pool: counted, reported in storage_info, never a crash.
+  Since round 24 a staged pp program no longer demotes — the composed
+  wavefront runs the ep psum inside its stage bodies
+  (tests/test_pp_composed.py holds that matrix).
 """
 
 import dataclasses
@@ -186,7 +188,9 @@ def test_ep_gate_demotes_structurally(moe_model):
     assert experts.expert_fallback_reason(4, 1) is None
     assert experts.expert_fallback_reason(4, 2) is None
     assert experts.expert_fallback_reason(3, 2) == "ep_experts"
-    assert experts.expert_fallback_reason(4, 2, pp=2) == "ep_mesh"
+    # round 24: staged pp composes with ep — pp no longer refuses
+    assert experts.expert_fallback_reason(4, 2, pp=2) is None
+    assert experts.expert_fallback_reason(3, 2, pp=2) == "ep_experts"
     if len(jax.devices()) < 2:
         pytest.skip("needs >= 2 devices")
     cfg3 = dataclasses.replace(cfg, n_experts=3, moe_top_k=2)

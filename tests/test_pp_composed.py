@@ -1,0 +1,239 @@
+"""Composed-mesh staged decode (round 24): the pp wavefront nested
+inside the tp/sp shard_map with ep inside the stage bodies.
+
+Round 21's GPipe wavefront only pipelined on a pure-pp mesh — the
+``pp_mesh`` gate demoted any tp/sp composition to placement, and the
+``ep_mesh`` gate kept staged MoE on the flat replicated gather.  Round
+24 lifts both: ONE shard_map over the full tp×sp×pp(×ep) mesh whose
+stage body runs the per-shard attention reads (round-12 local tp
+heads + psum, round-17 stripe walk + merge over sp) and the per-token
+expert gather + ep psum (round 22) inside the round-21 fori_loop +
+ppermute(pp) wavefront.  Collectives on disjoint axes compose, so:
+
+* COMPOSED == FLAT — staged streams on a composed mesh exactly equal
+  the unsharded single-device streams on the f32 tiny config, across
+  ticked / fused / mixed dispatch on dense AND paged storage and both
+  kv dtypes.  Greedy AND sampled rows on pure-pp×sp meshes (neither
+  staging nor striping reassociates — the sp gather merge is the exact
+  degenerate fold); tp-composed meshes keep the round-12 greedy bar
+  (the manual Megatron split reassociates projection reductions
+  exactly like the partitioner — but the f32 tiny config stays exact,
+  so the assertions below are equality even with tp);
+* ONE DISPATCH PER ROUND survives composition — the wavefront plus
+  every tp/sp/ep collective live inside one jitted program (wrap
+  lists derive from dispatch_audit.ENTRY_CONTRACT, the
+  test_mixed_step pattern, with tp/sp/ep ACTIVE);
+* EP NESTS IN STAGES — a staged MoE batcher on a pp×ep (or pp×tp×ep)
+  mesh engages BOTH ``_pp_args`` and ``_moe_args`` and streams equal
+  the replicated flat program's.
+
+Runs on the conftest 8-device CPU mesh; the Mosaic/ICI lowering claims
+for the composed program live in drives/drive_pp_decode.py (tp×pp arm)
+and drives/drive_moe_decode.py (ep×pp arm), ``-m tpu`` lane.
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from tpushare.models import transformer
+from tpushare.parallel.mesh import make_mesh
+from tpushare.serving.continuous import ContinuousBatcher
+from tpushare.serving.paged import PagedContinuousBatcher
+
+
+CFG = transformer.tiny(n_layers=4, max_seq=96)
+PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14], [5, 4, 3, 2]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = dataclasses.replace(transformer.tiny(max_seq=64),
+                              n_experts=4, moe_top_k=2, moe_every=1)
+    return transformer.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _mesh(**axes):
+    if len(jax.devices()) < max(
+            2, __import__("math").prod(axes.values())):
+        pytest.skip("needs the virtual multi-device mesh")
+    return make_mesh(axes)
+
+
+def _drain(b, prompts=PROMPTS, gen=8, sampled=True, mode="tick",
+           max_rounds=500):
+    rids = [b.admit(list(p), gen,
+                    temperature=0.8 if (sampled and i % 2) else 0.0,
+                    seed=42 + i)
+            for i, p in enumerate(prompts)]
+    assert all(r is not None for r in rids)
+    for _ in range(max_rounds):
+        if not b.slots and not b.prefilling:
+            return [b.completed[r] for r in rids]
+        if mode == "mixed":
+            b.tick_mixed(2, chunk=4, budget=8)
+        else:
+            if b.prefilling:
+                b.advance_prefill()
+            if b.slots:
+                b.tick_fused(2) if mode == "fused" else b.tick()
+    raise RuntimeError("did not drain")
+
+
+def _build(params, cfg, paged, **kw):
+    if paged:
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 24)
+        return PagedContinuousBatcher(params, cfg, n_slots=4, **kw)
+    return ContinuousBatcher(params, cfg, n_slots=4, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the matrix: composed staged == flat, per mesh x storage x kv dtype x mode
+# ---------------------------------------------------------------------------
+MESHES = [
+    ("pp2_tp2", dict(pp=2, tp=2), False),   # tp bar: greedy-exact here
+    ("pp2_sp2", dict(pp=2, sp=2), True),    # sampled-exact (no reassoc)
+    ("pp2_tp2_sp2", dict(pp=2, tp=2, sp=2), False),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("mesh_id,axes,sampled",
+                         MESHES, ids=[m[0] for m in MESHES])
+def test_composed_streams_equal_flat(params, mesh_id, axes, sampled,
+                                     paged, kv_dtype):
+    if not paged and "sp" in axes:
+        pytest.skip("sp stripes paged pools only")
+    cfg = dataclasses.replace(CFG, kv_dtype=kv_dtype)
+    mesh = _mesh(**axes)
+    for mode in ("tick", "fused", "mixed"):
+        base = _drain(_build(params, cfg, paged),
+                      sampled=sampled, mode=mode)
+        b = _build(params, cfg, paged, mesh=mesh, pp=2)
+        assert b._pp_reason is None and b._pp_args is not None, mesh_id
+        got = _drain(b, sampled=sampled, mode=mode)
+        assert got == base, (mesh_id, paged, kv_dtype, mode)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("axes", [dict(pp=2, ep=2),
+                                  dict(pp=2, tp=2, ep=2)],
+                         ids=["pp2_ep2", "pp2_tp2_ep2"])
+def test_composed_moe_streams_equal_replicated(moe_model, axes):
+    """ep inside the stage bodies: a staged MoE batcher on a composed
+    mesh engages the wavefront AND the sharded expert pool, and its
+    streams exactly equal the flat replicated program's (routing runs
+    replicated; out-of-range expert slots fold exact zeros)."""
+    params, cfg = moe_model
+    mesh = _mesh(**axes)
+    for paged in (False, True):
+        for mode in ("tick", "fused", "mixed"):
+            base = _drain(_build(params, cfg, paged),
+                          sampled=False, gen=6, mode=mode)
+            b = _build(params, cfg, paged, mesh=mesh, pp=2)
+            assert b._pp_args is not None and b._moe_args is not None
+            got = _drain(b, sampled=False, gen=6, mode=mode)
+            assert got == base, (axes, paged, mode)
+        info = b.storage_info()
+        assert info["pp_stages"] == 2
+        assert info["ep_shards"] == 2
+        assert "expert_fallback_reason" not in info
+
+
+# ---------------------------------------------------------------------------
+# one dispatch per round with tp/sp/ep active (fast lane)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("scenario", ["dense_tp", "paged_sp", "moe_ep"])
+def test_composed_one_dispatch_per_round(params, moe_model, scenario):
+    """The round-7 invariant under full composition: a steady mixed or
+    fused round on a composed tp/sp/ep mesh is exactly ONE host
+    dispatch — every collective (tp psum, sp merge, ep psum, pp
+    ppermute wavefront) is in-program.  Wrap lists derive from the
+    static auditor's contract (the test_mixed_step pattern)."""
+    from tpushare.analysis import dispatch_audit
+
+    if scenario == "dense_tp":
+        b = ContinuousBatcher(params, CFG, n_slots=4,
+                              mesh=_mesh(pp=2, tp=2), pp=2)
+    elif scenario == "paged_sp":
+        b = PagedContinuousBatcher(params, CFG, n_slots=4, page_size=8,
+                                   n_pages=24, mesh=_mesh(pp=2, sp=2),
+                                   pp=2)
+    else:
+        mparams, mcfg = moe_model
+        b = ContinuousBatcher(mparams, mcfg, n_slots=4,
+                              mesh=_mesh(pp=2, ep=2), pp=2)
+    assert b._pp_args is not None
+    counts = {"n": 0, "mixed": 0, "other": 0}
+
+    def wrap(name, key):
+        real = getattr(b, name)
+
+        def counted(*a, **k):
+            counts[key] += 1
+            return real(*a, **k)
+
+        setattr(b, name, counted)
+
+    rd = b.admit([1, 2, 3], 9)
+    rp = b.admit_chunked([5] * 20, 3, chunk=4)
+    wrap(dispatch_audit.ENTRY_CONTRACT["tick_fused"]["steady"], "n")
+    wrap(dispatch_audit.ENTRY_CONTRACT["tick_mixed"]["steady"], "mixed")
+    for hook in (dispatch_audit.TICK_HOOKS + dispatch_audit.PREFILL_HOOKS):
+        if hook not in ("_step_n", "_step_mixed"):
+            wrap(hook, "other")
+    rounds = 0
+    while b.prefilling:
+        b.tick_mixed(2, chunk=4, budget=8)
+        rounds += 1
+    assert counts["mixed"] == \
+        dispatch_audit.dispatches_per_round("tick_mixed", pp=2) * rounds
+    fused = 0
+    while b.slots:
+        b.tick_fused(4)
+        fused += 1
+    assert counts["n"] == \
+        dispatch_audit.dispatches_per_round("tick_fused", pp=2) * fused
+    assert counts["other"] == 0
+    assert rd in b.completed and rp in b.completed
+
+
+def test_composed_migration_across_mesh_shapes(params):
+    """Blobs stay layout-agnostic under composition: a session started
+    on a composed pp×tp pool resumes on an unsharded pool token for
+    token (and back) — striping/staging only move where pages live."""
+    ref = PagedContinuousBatcher(params, CFG, n_slots=2, page_size=16)
+    rr = ref.admit([3, 1, 4, 1, 5, 9, 2, 6] * 2, 12)
+    ref.run_until_drained()
+    want = ref.completed[rr]
+
+    def build(composed):
+        if composed:
+            return PagedContinuousBatcher(
+                params, CFG, n_slots=2, page_size=16,
+                mesh=_mesh(pp=2, tp=2), pp=2)
+        return PagedContinuousBatcher(params, CFG, n_slots=2,
+                                      page_size=16)
+
+    for src_c, dst_c in ((True, False), (False, True)):
+        src = build(src_c)
+        rid = src.admit([3, 1, 4, 1, 5, 9, 2, 6] * 2, 12)
+        for _ in range(3):
+            src.tick()
+        blob = src.export_session(rid)
+        src.pop_session(rid)
+        dst = build(dst_c)
+        rid2 = dst.import_session(blob)
+        assert rid2 is not None
+        dst.run_until_drained()
+        assert dst.completed[rid2] == want, (src_c, dst_c)

@@ -18,9 +18,11 @@ Contract:
   tests/test_mixed_step.py;
 * structurally impossible configs DEMOTE to placement-only pp (params
   and KV still stage-sharded by GSPMD, program flat) with a counted
-  fallback — ``pp_layers`` (indivisible stack), ``pp_mesh`` (tp/sp
-  composition), ``pp_storage`` (rolling windows) — and still serve
-  exact streams;
+  fallback — ``pp_layers`` (indivisible stack), ``pp_storage``
+  (rolling windows) — and still serve exact streams.  tp/sp alongside
+  pp COMPOSE since round 24 (the wavefront nests inside one shard_map
+  over the full mesh; tests/test_pp_composed.py holds the matrix) —
+  the old ``pp_mesh`` demotion is gone;
 * migration blobs stay layout-agnostic ACROSS pipeline depths:
   pp=2 -> pp=1 and pp=1 -> pp=2 reproduce the stream token for token.
 
@@ -78,19 +80,24 @@ def test_pp_gate_reasons_and_mosaic_agreement():
     from tpushare.ops.attention import (FALLBACK_REASONS,
                                         pp_stage_fallback_reason)
 
-    for r in ("pp_layers", "pp_mesh", "pp_storage"):
+    for r in ("pp_layers", "pp_storage"):
         assert r in FALLBACK_REASONS
+    # round 24: the composed wavefront serves tp/sp inside the staged
+    # shard_map — the old pp_mesh demotion no longer exists anywhere
+    assert "pp_mesh" not in FALLBACK_REASONS
     cases = [
         (dict(n_layers=4, pp=1), None),
         (dict(n_layers=4, pp=2), None),
         (dict(n_layers=4, pp=4), None),
         (dict(n_layers=3, pp=2), "pp_layers"),
-        (dict(n_layers=4, pp=2, tp=2), "pp_mesh"),
-        (dict(n_layers=4, pp=2, sp=2), "pp_mesh"),
+        # tp/sp alongside pp compose (round 24) — no refusal
+        (dict(n_layers=4, pp=2, tp=2), None),
+        (dict(n_layers=4, pp=2, sp=2), None),
+        (dict(n_layers=4, pp=2, tp=2, sp=2), None),
         (dict(n_layers=4, pp=2, rolling=True), "pp_storage"),
-        # precedence mirrors the gate order: the stack split is the
-        # structural impossibility, the mesh merely unimplemented
+        # remaining refusals stay structural regardless of the mesh
         (dict(n_layers=3, pp=2, tp=2), "pp_layers"),
+        (dict(n_layers=4, pp=2, tp=2, rolling=True), "pp_storage"),
     ]
     for kwargs, want in cases:
         assert pp_stage_fallback_reason(**kwargs) == want, kwargs
@@ -280,18 +287,19 @@ def test_pp_service_streams_exact(params, kwargs):
 
 
 def test_pp_composes_with_tp_on_3d_mesh(params):
-    """pp x tp (x sp below, slow lane): the staged program refuses
-    composition (pp_mesh — counted demotion) but stage PLACEMENT still
-    shards the layer stack, and the partitioned flat program reproduces
-    the unsharded stream exactly.  Greedy rows only — the round-12 tp
-    bar: the partitioner reassociates projection reductions, which
-    sampling draws amplify (test_serving_tp.py keeps the same bar);
-    pure-pp staging above IS sampled-exact because placement never
-    reassociates."""
+    """pp x tp (x sp below, slow lane): since round 24 the staged
+    wavefront COMPOSES — the stage bodies run the per-shard attention
+    over local tp heads with an explicit psum, nested inside the pp
+    shard_map — so ``_pp_args`` engages instead of demoting.  Greedy
+    rows only — the round-12 tp bar: the manual Megatron split
+    reassociates projection reductions exactly like the partitioner,
+    which sampling draws amplify (test_serving_tp.py keeps the same
+    bar); pure-pp staging above IS sampled-exact.  The full composed
+    matrix lives in tests/test_pp_composed.py."""
     b = ContinuousBatcher(params, CFG, n_slots=4,
                           mesh=make_mesh({"pp": 2, "tp": 2}), pp=2)
-    assert b._pp_reason == "pp_mesh" and b._pp_args is None
-    assert b.storage_info()["pp_stages"] == 2   # placement still staged
+    assert b._pp_reason is None and b._pp_args is not None
+    assert b.storage_info()["pp_stages"] == 2
     assert _drain(b, sampled=False) == _drain(
         ContinuousBatcher(params, CFG, n_slots=4), sampled=False)
 
@@ -346,6 +354,22 @@ def test_bench_pp_microbatch_smoke(params):
     # dispatched ONCE per round, the baseline once per schedule cell
     assert out["sequential_stage"]["dispatches"] == \
         out["schedule_cells"] * out["microbatched"]["dispatches"]
+
+
+def test_bench_pp_composed_smoke(params):
+    """The round-24 composed-mesh scenario at tiny sizes with the
+    sleep proxy OFF: the nested tp x pp wavefront engages (asserted
+    inside the helper via storage_info), streams equal the
+    placement-demoted arm, one dispatch per composed round vs one per
+    schedule cell for the replay."""
+    import bench_all
+    out = bench_all.pp_composed_bench(params, CFG, slots=4, gen=9,
+                                      decode_chunk=4, pp=2, tp=2,
+                                      rpc_s=0.0, reps=1)
+    assert out["n_micro"] == 2
+    assert out["schedule_cells"] == 4
+    assert out["placement_replay"]["dispatches"] == \
+        out["schedule_cells"] * out["composed"]["dispatches"]
 
 
 # ---------------------------------------------------------------------------
@@ -419,16 +443,16 @@ def test_pp_int8_self_consistency_and_vs_pp1(params):
 @pytest.mark.slow
 def test_pp_composes_with_tp_sp_on_3d_paged_mesh(params):
     """The full 3-D composition: pp x tp x sp over the 8-device mesh.
-    The staged program demotes (pp_mesh) but placement shards layers
-    over pp, pages over sp, heads over tp — greedy streams stay exactly
-    the unsharded paged streams (the round-12 tp bar; see
-    test_pp_composes_with_tp_on_3d_mesh)."""
+    Since round 24 the staged program SERVES it — layers stage over pp,
+    pages stripe over sp, heads split over tp, all inside one composed
+    shard_map — greedy streams stay exactly the unsharded paged streams
+    (the round-12 tp bar; see test_pp_composes_with_tp_on_3d_mesh)."""
     base = _drain(PagedContinuousBatcher(params, CFG, n_slots=4,
                                          page_size=8), sampled=False)
     b = PagedContinuousBatcher(
         params, CFG, n_slots=4, page_size=8, n_pages=24,
         mesh=make_mesh({"pp": 2, "tp": 2, "sp": 2}), pp=2)
-    assert b._pp_reason == "pp_mesh"
+    assert b._pp_reason is None and b._pp_args is not None
     assert _drain(b, sampled=False) == base
 
 

@@ -375,6 +375,14 @@ def test_pp_decode_on_chip():
     assert rec["exact"], rec
     assert rec["stage_local_kv"], rec
     assert rec["pp2"].get("compile_ok", True), rec
+    # round 24: the composed tp x pp arm must lower too (skipped on
+    # hosts without 4 devices).  Compile + finite is the bar, like
+    # tp2ep2 below: greedy_agree_frac vs the UNSHARDED flat stream is
+    # recorded for the eye only — a random-init tiny model's near-tie
+    # logits let one bf16 tp reassociation flip cascade through the
+    # rest of the greedy stream (CPU rehearsal: 0.375), which says
+    # nothing about the lowering this arm exists to prove
+    assert rec["tp2_pp2"].get("compile_ok", True), rec
     committed = _committed("PP_DECODE_TPU.json",
                            "staged_vs_flat_paged", default=None)
     got = rec["staged_vs_flat_paged"]
@@ -411,6 +419,10 @@ def test_moe_decode_on_chip():
     assert rec["ep2"].get("compile_ok", True), rec
     assert rec["ep2"].get("exact_vs_single", True), rec
     assert rec["tp2ep2"].get("compile_ok", True), rec
+    # composed ep x pp wavefront (round 24): stage bodies carry the ep
+    # psum; pure ep x pp never reassociates, so exactness holds
+    assert rec["ep2_pp2"].get("compile_ok", True), rec
+    assert rec["ep2_pp2"].get("exact_vs_single", True), rec
     committed = _committed("MOE_DECODE_TPU.json",
                            "speedup_batched_vs_per_expert", default=None)
     got = rec["speedup_batched_vs_per_expert"]

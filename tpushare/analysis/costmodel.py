@@ -40,8 +40,11 @@ Conventions of the card (documented once, relied on everywhere):
   bandwidth the kernel exists to save.
 * ICI charges tp's two ring-allreduces per layer, pp's activation hops
   (+ the staged program's logit fold), ep's per-routed-layer psum, and
-  sp's per-step stat merge.  Degrees in the shape are EFFECTIVE (a
-  demoted gate passes 1), mirroring what the program actually runs.
+  sp's per-step stat merge.  Under the COMPOSED staged program (round
+  24) the ppermute hops and the logit fold scale by the tp*sp*ep
+  column count — every mesh column moves its own replicated copy.
+  Degrees in the shape are EFFECTIVE (a demoted gate passes 1),
+  mirroring what the program actually runs.
 """
 
 from __future__ import annotations
@@ -351,12 +354,21 @@ def derive_card(shape: Dict) -> CostCard:
         # down) of a [d] activation: 2(tp-1)/tp * d bytes each per token
         ici_per_token += layers * 2 * (2.0 * (tp - 1) / tp) * d * item
     if pp > 1:
-        # activation hops between adjacent stages
-        ici_per_token += (pp - 1) * d * item
         if s["pp_staged"]:
-            # the staged wavefront's final masked psum fold of f32
-            # logits across stages
-            ici_per_token += (2.0 * (pp - 1) / pp) * vocab * 4
+            # the composed wavefront (round 24) runs one shard_map
+            # over the FULL mesh: every tp/sp/ep column carries its
+            # own copy of the (replicated) activation through the
+            # per-tick ppermute hops, and the final masked psum fold
+            # of f32 logits likewise runs per column — both terms
+            # scale by the column count (1 on a pure-pp mesh, so
+            # pre-round-24 cards are unchanged)
+            cols = tp * sp * ep
+            ici_per_token += cols * (pp - 1) * d * item
+            ici_per_token += cols * (2.0 * (pp - 1) / pp) * vocab * 4
+        else:
+            # placement-only pp: GSPMD moves the activation once per
+            # stage boundary
+            ici_per_token += (pp - 1) * d * item
     if e and ep > 1:
         ici_per_token += (_routed_layers(s)
                           * (2.0 * (ep - 1) / ep) * d * item)
@@ -437,6 +449,12 @@ def _tiny_shapes():
     shapes.append(dict(base, n_experts=4, moe_top_k=2, moe_every=2,
                        ep=2, adapter_rank=8))
     shapes.append(dict(base, tp=2, pp=2, pp_staged=True))
+    # the round-24 composed cells: sp and ep inside the staged
+    # wavefront (the ICI column scaling has sweep coverage)
+    shapes.append(dict(base, kind="paged", page_tokens=16, n_pages=32,
+                       tp=2, sp=2, pp=2, pp_staged=True))
+    shapes.append(dict(base, n_experts=4, moe_top_k=2, moe_every=2,
+                       ep=2, pp=2, pp_staged=True))
     return [normalize_shape(s) for s in shapes]
 
 

@@ -377,13 +377,15 @@ def precheck_pp_stage(n_layers: int, pp: int, tp: int = 1, sp: int = 1,
       indivisible stack legalizes params/KV to replication, which
       defeats stage-local residency; the serving demotion is
       placement-only).
-    * ``pp_mesh`` — the staged shard_map program does not nest inside
-      the tp/sp shard_map read paths; a >1 tp or sp axis keeps the
-      flat program (placement still shards layers across pp).
     * ``pp_storage`` — rolling storages (dense ring, windowed page
       ring) evict in place; their write arithmetic couples rows across
       wavefront ticks, which the stage-local microbatch slices cannot
       honor.
+
+    Since the composed-mesh staged program (round 24) tp/sp no longer
+    refuse — the wavefront nests inside one shard_map over the full
+    tp×sp×pp mesh; the parameters stay for caller/mirror signature
+    stability and drift pinning only.
 
     ``cross_check=True`` additionally imports the live gate and raises
     :class:`GateDriftError` on disagreement — NEVER pass it from a
@@ -397,11 +399,6 @@ def precheck_pp_stage(n_layers: int, pp: int, tp: int = 1, sp: int = 1,
                 f"layer count {n_layers} is not divisible by the stage "
                 f"count {pp}: stage-local params/KV would legalize to "
                 f"replication")
-        elif tp > 1 or sp > 1:
-            reason = "pp_mesh"
-            findings.append(
-                f"tp={tp} sp={sp}: the staged wavefront program does "
-                f"not nest inside the tp/sp shard_map read paths")
         elif rolling:
             reason = "pp_storage"
             findings.append(
@@ -434,8 +431,11 @@ def precheck_expert_gather(n_experts: int, ep: int, pp: int = 1,
     * ``ep_experts`` — the ep degree must divide the expert count (the
       shard_map pool split needs an equal expert slice per shard; an
       indivisible pool legalizes to replication).
-    * ``ep_mesh`` — the ep shard_map does not nest inside the round-21
-      staged pp wavefront; ep composes with tp/sp only.
+
+    Since the composed-mesh staged program (round 24) the ep psum runs
+    INSIDE the pipeline wavefront's stage bodies, so ``pp`` no longer
+    refuses — the parameter stays for caller/mirror signature
+    stability and drift pinning only.
 
     ``cross_check=True`` additionally imports the live gate and raises
     :class:`GateDriftError` on disagreement — NEVER pass it from a
@@ -449,12 +449,6 @@ def precheck_expert_gather(n_experts: int, ep: int, pp: int = 1,
                 f"expert count {n_experts} is not divisible by the ep "
                 f"degree {ep}: the per-shard pool slice would be "
                 f"ragged; the pool legalizes to replication")
-        elif pp > 1:
-            reason = "ep_mesh"
-            findings.append(
-                f"pp={pp}: the ep shard_map does not nest inside the "
-                f"staged pipeline wavefront (ep composes with tp/sp "
-                f"only)")
     v = Verdict(ok=reason is None, reason=reason,
                 findings=tuple(findings), blocks=())
     if cross_check:

@@ -598,6 +598,121 @@ def ffn_block(p, x, lora=None):
     return _mm_ad(jax.nn.silu(g) * u, p["w_down"], lora, "w_down")
 
 
+#: Megatron split of the layer leaves: COLUMN-parallel projections
+#: shard their OUTPUT dim (activations stay tp-local afterwards),
+#: ROW-parallel ones shard their INPUT dim and their partial products
+#: fold with one psum.  The composed staged program (round 24) builds
+#: its shard_map in_specs from these; LoRA pools split the same way
+#: (col targets shard ``b``'s d_out, row targets shard ``a``'s d_in,
+#: so the per-row adapter delta is partial exactly where the base
+#: product is and the ONE psum folds both).
+_TP_COL_LEAVES = ("wq", "wk", "wv", "w_gate", "w_up")
+_TP_ROW_LEAVES = ("wo", "w_down")
+
+
+def _composed_tp_ok(layers, cfg: ModelConfig, tp: int) -> bool:
+    """Can the composed staged program tp-shard the weight leaves?
+    Head counts and both feature dims must divide (whole GQA groups
+    per shard — the round-12 bar — plus even column/row splits), and
+    every projection leaf must be a plain array: a weight-QUANTIZED
+    dict leaf's blocked scales do not slice along one dim, so those
+    configs keep full-width weights per shard (value-preserving
+    replication; the wavefront still pipelines)."""
+    if tp <= 1:
+        return False
+    if (cfg.n_heads % tp or cfg.n_kv_heads % tp
+            or cfg.d_model % tp or cfg.d_ff % tp):
+        return False
+    return not any(isinstance(layers.get(n), dict)
+                   for n in _TP_COL_LEAVES + _TP_ROW_LEAVES)
+
+
+def _composed_local_cfg(cfg: ModelConfig, tp: int) -> ModelConfig:
+    """The per-tp-shard view of the model config for composed stage
+    bodies: local head counts with the SAME head_dim (d_model scales
+    along so the derived property holds); every other knob —
+    max_seq, window, kv_dtype, attn_kernel, MoE — rides unchanged."""
+    if tp <= 1:
+        return cfg
+    return dataclasses.replace(
+        cfg, d_model=cfg.d_model // tp, n_heads=cfg.n_heads // tp,
+        n_kv_heads=cfg.n_kv_heads // tp)
+
+
+def _attn_ffn_shard(layer, x, cfg: ModelConfig, attend, lora=None,
+                    tp_axis=None, ep_axis=None):
+    """:func:`_attn_ffn` twin for COMPOSED stage bodies (round 24).
+
+    Runs INSIDE the one shard_map over the full tp×sp×pp(×ep) mesh:
+    ``attend`` closes over tp-LOCAL weights/caches (a
+    :func:`_composed_local_cfg` view), the o/down projections consume
+    row-parallel slices and their partial products fold with one
+    ``psum`` over ``tp_axis`` — the same collective GSPMD inserts for
+    the flat Megatron program, so composed streams keep the round-12
+    agreement bar — and MoE layers route through
+    :func:`tpushare.ops.experts.moe_ffn_shard` (local mixture + psum
+    over ``ep_axis``) instead of the shard_map-wrapping ``moe_ffn``.
+    Expert weights never tp-shard (``EXPERT_SHARDING_RULES``), so MoE
+    FFNs replicate over tp and only the attention half psums.
+    ``tp_axis=None`` (tp=1 or :func:`_composed_tp_ok` refused) keeps
+    full-width weights and skips the psums."""
+    from ..ops.experts import moe_ffn_shard
+    b, s, _ = x.shape
+    xin = rmsnorm(x, layer["attn_scale"], cfg.norm_eps)
+    o, carry = attend(layer, xin)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, -1)
+    xo = _mm_ad(o, layer["wo"], lora, "wo")
+    if tp_axis is not None:
+        xo = jax.lax.psum(xo, tp_axis)
+    x = x + xo
+    xn = rmsnorm(x, layer["ffn_scale"], cfg.norm_eps)
+    if "router" in layer:
+        y, load = moe_ffn_shard(xn, layer, cfg, ep_axis=ep_axis)
+        return x + y, carry, load
+    y = ffn_block(layer, xn, lora=lora)
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    return x + y, carry, None
+
+
+def _composed_layer_specs(layers, ad_scan, axis_name: str,
+                          tp_ok: bool, ep_ok: bool,
+                          tp_axis: str, ep_axis: str):
+    """shard_map in_specs for the composed staged program's layer and
+    adapter pytrees: everything stage-shards dim 0 (the layer→stage
+    partition), tp-shardable projections additionally split their
+    Megatron dim, expert pools their expert dim over ep.  Leaves the
+    split cannot cover (norm scales, router, moe_route, any quantized
+    dict) stay stage-sharded only — replicated over tp/sp/ep."""
+    from jax.sharding import PartitionSpec as P
+    import jax.tree_util as jtu
+
+    stage_spec = P(axis_name)
+    lspec = dict(jtu.tree_map(lambda _: stage_spec, layers))
+    if tp_ok:
+        for name in _TP_COL_LEAVES:
+            if name in lspec:
+                lspec[name] = P(axis_name, None, tp_axis)
+        for name in _TP_ROW_LEAVES:
+            if name in lspec:
+                lspec[name] = P(axis_name, tp_axis, None)
+    if ep_ok:
+        for name in ("moe_gate", "moe_up", "moe_down"):
+            if name in lspec:
+                lspec[name] = P(axis_name, ep_axis, None, None)
+    adspec = jtu.tree_map(lambda _: stage_spec, ad_scan)
+    if tp_ok and ad_scan is not None:
+        adspec = dict(adspec)
+        for name in adspec:
+            if name in _TP_COL_LEAVES:
+                adspec[name] = {"a": stage_spec,
+                                "b": P(axis_name, None, None, tp_axis)}
+            elif name in _TP_ROW_LEAVES:
+                adspec[name] = {"a": P(axis_name, None, tp_axis, None),
+                                "b": stage_spec}
+    return lspec, adspec
+
+
 def forward(params, tokens, cfg: ModelConfig,
             kv_caches: Optional[Tuple] = None,
             cache_len: Optional[jnp.ndarray] = None,
@@ -769,7 +884,9 @@ def forward_pipelined(params, tokens, cfg: ModelConfig, mesh,
 def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
                       cache_len, mesh, n_micro: Optional[int] = None,
                       axis_name: str = "pp",
-                      adapters=None, adapter_ids=None):
+                      adapters=None, adapter_ids=None,
+                      moe_mesh=None, tp_axis: str = "tp",
+                      ep_axis: str = "ep"):
     """One MICROBATCHED decode step over pipeline stages: the round-21
     staged serving program (dense full-size caches).
 
@@ -782,15 +899,34 @@ def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
 
     ONE SPMD dispatch executes the whole GPipe wavefront
     (``parallel.pipeline.pp_stage_schedule``): ``shard_map`` over the
-    ``pp`` axis alone, each stage owning its layer slice of params,
-    adapters, AND KV rows (in_specs shard dim 0 — the layer→stage
-    partition), a ``fori_loop`` over ``n_micro + pp - 1`` ticks where
-    stage s works microbatch ``t - s``, one ``ppermute`` activation hop
-    per tick.  Stage s therefore decodes microbatch m while stage s-1
-    decodes m+1 — the pipelining win.  Bubble ticks (m out of range)
-    compute a clipped microbatch and DISCARD both the activation and
-    the cache write-back (``jnp.where`` on the sliced rows), so storage
-    is touched exactly once per (stage, microbatch).
+    FULL mesh, each stage owning its layer slice of params, adapters,
+    AND KV rows (in_specs shard dim 0 — the layer→stage partition), a
+    ``fori_loop`` over ``n_micro + pp - 1`` ticks where stage s works
+    microbatch ``t - s``, one ``ppermute`` activation hop per tick.
+    Stage s therefore decodes microbatch m while stage s-1 decodes
+    m+1 — the pipelining win.  Bubble ticks (m out of range) compute a
+    clipped microbatch and DISCARD both the activation and the cache
+    write-back (``jnp.where`` on the sliced rows), so storage is
+    touched exactly once per (stage, microbatch).
+
+    COMPOSED meshes (round 24): a >1 ``tp`` axis whose degree divides
+    the head/feature counts (:func:`_composed_tp_ok`) additionally
+    Megatron-splits the weight leaves, KV heads, and LoRA pool inside
+    the SAME shard_map — the stage body runs attention on its local
+    GQA head groups (a :func:`_composed_local_cfg` view of the config)
+    and folds the o/down partials with psums over ``tp``
+    (:func:`_attn_ffn_shard`); an indivisible tp replicates the
+    weights per shard instead (value-preserving — the wavefront still
+    pipelines).  ``moe_mesh`` (the ep-gated serving operand) routes
+    MoE layers through :func:`tpushare.ops.experts.moe_ffn_shard` with
+    the expert pool ep-sharded in the in_specs — the ep psum runs
+    INSIDE the stage body, nothing nests.  The ppermute / fori_loop /
+    final-psum scaffolding touches the ``pp`` axis alone, so the
+    collectives compose on disjoint axes.  A >1 ``sp`` axis is inert
+    here (dense rows never stripe): the body replicates over it.
+    Per-layer expert load is still discarded under staging (the
+    wavefront carry has no [E] slot; serving counts it on the flat
+    entries).
 
     Exactness: microbatch splitting is row-local (every attention /
     matmul row depends only on its own row), the layer order is the
@@ -799,6 +935,7 @@ def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
     unstaged ``forward`` bit-for-bit on the f32 config, and int8 KV
     quantization stays append-only per row (the round-8 invariant).
     """
+    from ..ops.attention import tp_degree
     from ..parallel.shardmap_compat import shard_map
     from jax.sharding import PartitionSpec as P
     import jax.tree_util as jtu
@@ -818,11 +955,22 @@ def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
     ad_scan, ad_scales = _adapter_scan_split(adapters)
     ck, cv = kv_caches
 
+    tp = tp_degree(mesh, tp_axis)
+    tp_ok = _composed_tp_ok(params["layers"], cfg, tp)
+    ep = tp_degree(moe_mesh, ep_axis)
+    ep_ok = (ep > 1 and cfg.n_experts > 0 and cfg.n_experts % ep == 0
+             and "moe_gate" in params["layers"])
+    lcfg = _composed_local_cfg(cfg, tp if tp_ok else 1)
+    composed = tp_ok or ep_ok
+
     stage_spec = P(axis_name)
-    lspec = jtu.tree_map(lambda _: stage_spec, params["layers"])
-    adspec = jtu.tree_map(lambda _: stage_spec, ad_scan)
-    kspec = jtu.tree_map(lambda _: stage_spec, ck)
-    vspec = jtu.tree_map(lambda _: stage_spec, cv)
+    lspec, adspec = _composed_layer_specs(
+        params["layers"], ad_scan, axis_name, tp_ok, ep_ok,
+        tp_axis, ep_axis)
+    kv_spec = P(axis_name, None, tp_axis if tp_ok else None,
+                None, None)
+    kspec = jtu.tree_map(lambda _: kv_spec, ck)
+    vspec = jtu.tree_map(lambda _: kv_spec, cv)
     rep = P()
     idspec = None if adapter_ids is None else rep
 
@@ -839,14 +987,19 @@ def forward_pp_decode(params, tokens, cfg: ModelConfig, kv_caches,
             def body(h, layer_and):
                 layer, ad, ckr, cvr = layer_and
                 lora = None if ad is None else (ad, ad_scales, ids)
-                # staged serving demotes ep (the ``ep_mesh`` gate), so
-                # MoE layers run the replicated gather per stage and
-                # the per-layer load is discarded
-                h, carry, _ = _attn_ffn(
-                    layer, h, cfg,
-                    lambda lyr, xi: _attend_dense(
-                        lyr, xi, cfg, pos, kv_cache=(ckr, cvr),
-                        cache_len=cl_rows, lora=lora), lora=lora)
+                attend = lambda lyr, xi: _attend_dense(
+                    lyr, xi, lcfg, pos, kv_cache=(ckr, cvr),
+                    cache_len=cl_rows, lora=lora)
+                if composed:
+                    # round 24: tp partials psum / ep mixture psums
+                    # INSIDE the stage body; per-layer load discarded
+                    h, carry, _ = _attn_ffn_shard(
+                        layer, h, cfg, attend, lora=lora,
+                        tp_axis=tp_axis if tp_ok else None,
+                        ep_axis=ep_axis if ep_ok else None)
+                else:
+                    h, carry, _ = _attn_ffn(layer, h, cfg, attend,
+                                            lora=lora)
                 return h, carry
 
             h, (nck, ncv) = jax.lax.scan(
@@ -1107,6 +1260,89 @@ def paged_attention(q, k_store, v_store, page_table, positions,
         positions, window=cfg.window)
 
 
+def _sp_local_gather_attention(q, k_store, v_store, page_table,
+                               positions, cfg: ModelConfig, sp: int,
+                               sp_axis: str):
+    """One position shard's striped XLA gather read, INSIDE an
+    enclosing ``shard_map``: gather the LOCAL stripe
+    (:func:`tpushare.ops.attention.striped_local_view` — a view-sized
+    transient), all-gather the per-shard stripe views over ``sp_axis``,
+    interleave them back into global position order, and run the ONE
+    :func:`cached_attention` over the reassembled full-key view — the
+    SAME key order, shapes, and reduction the unsharded gather path
+    computes, so striped "xla" streams are BIT-IDENTICAL to unsharded
+    "xla" streams on every dtype (the degenerate exact merge).  The
+    store operands are already sp-sharded by the caller's in_specs
+    (leaf dim 0 is the local stripe, n_pages // sp pages); both
+    :func:`_sp_striped_attention` (the flat program's own shard_map)
+    and the composed staged stage bodies (round 24) route here so the
+    reassembly cannot drift."""
+    from ..ops.attention import striped_local_view
+
+    leaf = _kv_leaf(k_store)
+    per_shard, page = leaf.shape[0], leaf.shape[2]
+    shard = jax.lax.axis_index(sp_axis)
+    ltbl, _ = striped_local_view(page_table, sp, shard, per_shard, page)
+    kl = _paged_gather_deq(k_store, ltbl, cfg)   # [B, Hkv/tp, Tl, D]
+    vl = _paged_gather_deq(v_store, ltbl, cfg)
+    n_tbl = page_table.shape[1]
+    n_local = -(-n_tbl // sp)
+
+    def regather(x):
+        g = jax.lax.all_gather(x, sp_axis, axis=0, tiled=False)
+        spn, bb, hh, _, d = g.shape
+        # [sp, B, H, n_local, page, D] -> range-major interleave
+        # (jj, s) -> global range jj*sp + s, then drop the padding
+        # ranges past the table
+        g = g.reshape(spn, bb, hh, n_local, page, d)
+        g = g.transpose(1, 2, 3, 0, 4, 5)
+        return g.reshape(bb, hh, n_local * spn * page,
+                         d)[:, :, :n_tbl * page, :]
+
+    n_rep = q.shape[1] // kl.shape[1]
+    return cached_attention(
+        q, _expand_kv(regather(kl), n_rep),
+        _expand_kv(regather(vl), n_rep), positions, window=cfg.window)
+
+
+def _sp_local_paged_read(q, k_store, v_store, page_table, positions,
+                         cfg: ModelConfig, sp: int, sp_axis: str):
+    """The round-17 striped paged-read dispatch for COMPOSED stage
+    bodies (round 24): same two arms as :func:`_sp_striped_attention`
+    — the striped kernel walk merged by
+    :func:`tpushare.ops.attention.sp_merge_partials`, or the bit-exact
+    :func:`_sp_local_gather_attention` reassembly — but running INSIDE
+    an existing shard_map, with the pool operand already sp-sharded by
+    the enclosing in_specs and ``cfg`` the tp-LOCAL config view.  Gate
+    evaluation happens at trace time (shapes are static), so a refusal
+    bumps the fallback counter once per compiled program, like every
+    dispatch site."""
+    from ..ops.attention import (count_attn_fallback,
+                                 paged_decode_attention,
+                                 paged_kernel_fallback_reason,
+                                 sp_merge_partials, striped_local_view)
+
+    leaf = _kv_leaf(k_store)
+    per_shard, page = leaf.shape[0], leaf.shape[2]
+    if cfg.attn_kernel == "pallas":
+        rows = (q.shape[1] // cfg.n_kv_heads) * q.shape[2]
+        reason = paged_kernel_fallback_reason(
+            page, leaf.shape[3], kv_quantized(cfg), cfg.dtype,
+            rows=rows, tp=1, n_kv_heads=leaf.shape[1],
+            n_heads=q.shape[1], sp=1, n_pages=per_shard)
+        if reason is None:
+            shard = jax.lax.axis_index(sp_axis)
+            ltbl, pmap = striped_local_view(page_table, sp, shard,
+                                            per_shard, page)
+            o, m, l = paged_decode_attention(
+                q, k_store, v_store, ltbl, positions,
+                window=cfg.window, pos_map=pmap, return_stats=True)
+            return sp_merge_partials(o, m, l, sp_axis)
+        count_attn_fallback(reason)
+    return _sp_local_gather_attention(q, k_store, v_store, page_table,
+                                      positions, cfg, sp, sp_axis)
+
+
 def _sp_striped_attention(q, k_store, v_store, page_table, positions,
                           cfg: ModelConfig, mesh, tp_axis: str = "tp",
                           sp_axis: str = "sp"):
@@ -1134,7 +1370,7 @@ def _sp_striped_attention(q, k_store, v_store, page_table, positions,
     from ..ops.attention import (count_attn_fallback,
                                  paged_kernel_fallback_reason,
                                  sp_striped_paged_decode_attention,
-                                 striped_local_view, tp_degree)
+                                 tp_degree)
     from ..parallel.shardmap_compat import shard_map
 
     leaf = _kv_leaf(k_store)
@@ -1153,10 +1389,8 @@ def _sp_striped_attention(q, k_store, v_store, page_table, positions,
                 sp_axis=sp_axis, tp_axis=tp_axis, window=cfg.window)
         count_attn_fallback(reason)
     # striped XLA gather: local stripe gather -> all-gather -> global
-    # position-order reassembly -> the ONE cached_attention
-    per_shard = n_pages // sp
-    n_tbl = page_table.shape[1]
-    n_local = -(-n_tbl // sp)
+    # position-order reassembly -> the ONE cached_attention (the body
+    # is shared with the composed staged program, round 24)
     tp_ok = (tp > 1 and cfg.n_heads % tp == 0
              and cfg.n_kv_heads % tp == 0)
     head = P(None, tp_axis, None, None) if tp_ok else P()
@@ -1167,26 +1401,8 @@ def _sp_striped_attention(q, k_store, v_store, page_table, positions,
         return jax.tree_util.tree_map(lambda _: pool, store)
 
     def body(q, ks, vs, tbl, pos):
-        shard = jax.lax.axis_index(sp_axis)
-        ltbl, _ = striped_local_view(tbl, sp, shard, per_shard, page)
-        kl = _paged_gather_deq(ks, ltbl, cfg)   # [B, Hkv/tp, Tl, D]
-        vl = _paged_gather_deq(vs, ltbl, cfg)
-
-        def regather(x):
-            g = jax.lax.all_gather(x, sp_axis, axis=0, tiled=False)
-            spn, bb, hh, _, d = g.shape
-            # [sp, B, H, n_local, page, D] -> range-major interleave
-            # (jj, s) -> global range jj*sp + s, then drop the padding
-            # ranges past the table
-            g = g.reshape(spn, bb, hh, n_local, page, d)
-            g = g.transpose(1, 2, 3, 0, 4, 5)
-            return g.reshape(bb, hh, n_local * spn * page,
-                             d)[:, :, :n_tbl * page, :]
-
-        n_rep = q.shape[1] // kl.shape[1]
-        return cached_attention(
-            q, _expand_kv(regather(kl), n_rep),
-            _expand_kv(regather(vl), n_rep), pos, window=cfg.window)
+        return _sp_local_gather_attention(q, ks, vs, tbl, pos, cfg,
+                                          sp, sp_axis)
 
     return shard_map(
         body, mesh=mesh,
@@ -1258,24 +1474,39 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
                             page_table, lengths, mesh,
                             n_micro: Optional[int] = None,
                             axis_name: str = "pp",
-                            adapters=None, adapter_ids=None):
+                            adapters=None, adapter_ids=None,
+                            moe_mesh=None, tp_axis: str = "tp",
+                            sp_axis: str = "sp", ep_axis: str = "ep"):
     """Microbatched pipeline twin of :func:`forward_paged_decode`:
     one staged SPMD decode step against a LAYER-SHARDED paged pool.
 
     Same wavefront as :func:`forward_pp_decode` — ``shard_map`` over
-    the ``pp`` axis, each stage owning its [L/pp, n_pages, Hkv, P, D]
-    pool slab (the layer→stage partition alongside the round-17
-    ``page_axis="sp"`` stripe; the ``pp_mesh`` gate keeps the two
-    programs from nesting), fori_loop ticks, one ppermute hop.  The
-    one paged wrinkle is bubble containment: a discarded microbatch's
-    scatter cannot be ``jnp.where``-masked after the fact (pages are
-    scattered, not sliced), so bubble ticks route their writes to the
-    TRASH page (page 0) — the same masked-garbage sink every paged
-    flavor already relies on — and real pages are written exactly once
-    per (stage, microbatch).  Reads route through
-    :func:`paged_attention` like every paged flavor (``mesh=None``
-    inside the body: the stage IS the shard).
+    the FULL mesh, each stage owning its [L/pp, n_pages, Hkv, P, D]
+    pool slab (the layer→stage partition), fori_loop ticks, one
+    ppermute hop.  The one paged wrinkle is bubble containment: a
+    discarded microbatch's scatter cannot be ``jnp.where``-masked
+    after the fact (pages are scattered, not sliced), so bubble ticks
+    route their writes to the TRASH page (page 0) — the same
+    masked-garbage sink every paged flavor already relies on — and
+    real pages are written exactly once per (stage, microbatch).
+
+    COMPOSED meshes (round 24): tp splits heads/features exactly as in
+    :func:`forward_pp_decode`; a >1 ``sp`` axis dividing the pool's
+    page count additionally stripes each stage's pool slab over
+    position shards (the round-17 layout — pool dim 1 sharded over
+    ``sp``, every stripe's LOCAL page 0 its own trash), the stage body
+    reading through :func:`_sp_local_paged_read` (striped kernel walk
+    + ``sp_merge_partials``, or the bit-exact gather reassembly) and
+    writing only the pages its stripe OWNS (non-owned and bubble rows
+    scatter to the stripe-local trash).  ``moe_mesh`` ep-shards the
+    expert pool with the psum inside the stage body.  An sp-indivisible
+    pool replicates over sp (the structural ``sp_pool`` demotion),
+    exactly like an indivisible tp.  Reads on an unstriped pool route
+    through :func:`paged_attention` with the tp-LOCAL config
+    (``mesh=None`` inside the body: the shard_map already made the
+    operands per-shard).
     """
+    from ..ops.attention import tp_degree
     from ..parallel.shardmap_compat import shard_map
     from jax.sharding import PartitionSpec as P
     import jax.tree_util as jtu
@@ -1290,17 +1521,31 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
     positions = lengths[:, None] + jnp.arange(s)[None, :]
     x = params["embed"][tokens].astype(cfg.dtype)
     kp, vp = pools
-    page = _kv_leaf(kp).shape[3]
+    n_pages, page = _kv_leaf(kp).shape[1], _kv_leaf(kp).shape[3]
     page_ids = jnp.take_along_axis(
         page_table, (lengths // page)[:, None], axis=1)[:, 0]
     offsets = lengths % page
     ad_scan, ad_scales = _adapter_scan_split(adapters)
 
+    tp = tp_degree(mesh, tp_axis)
+    tp_ok = _composed_tp_ok(params["layers"], cfg, tp)
+    sp = tp_degree(mesh, sp_axis)
+    sp_ok = sp > 1 and n_pages % sp == 0
+    per_shard = n_pages // sp if sp_ok else n_pages
+    ep = tp_degree(moe_mesh, ep_axis)
+    ep_ok = (ep > 1 and cfg.n_experts > 0 and cfg.n_experts % ep == 0
+             and "moe_gate" in params["layers"])
+    lcfg = _composed_local_cfg(cfg, tp if tp_ok else 1)
+    composed = tp_ok or ep_ok
+
     stage_spec = P(axis_name)
-    lspec = jtu.tree_map(lambda _: stage_spec, params["layers"])
-    adspec = jtu.tree_map(lambda _: stage_spec, ad_scan)
-    kspec = jtu.tree_map(lambda _: stage_spec, kp)
-    vspec = jtu.tree_map(lambda _: stage_spec, vp)
+    lspec, adspec = _composed_layer_specs(
+        params["layers"], ad_scan, axis_name, tp_ok, ep_ok,
+        tp_axis, ep_axis)
+    pool_spec = P(axis_name, sp_axis if sp_ok else None,
+                  tp_axis if tp_ok else None, None, None)
+    kspec = jtu.tree_map(lambda _: pool_spec, kp)
+    vspec = jtu.tree_map(lambda _: pool_spec, vp)
     rep = P()
     idspec = None if adapter_ids is None else rep
     tbl = jnp.asarray(page_table, jnp.int32)
@@ -1320,19 +1565,31 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
                 lora = None if ad is None else (ad, ad_scales, ids)
 
                 def attend(lyr, xi):
-                    q, k, v = _qkv(lyr, xi, cfg, pos, lora=lora)
-                    k_st, v_st = _kv_pack(k, cfg), _kv_pack(v, cfg)
+                    q, k, v = _qkv(lyr, xi, lcfg, pos, lora=lora)
+                    k_st, v_st = _kv_pack(k, lcfg), _kv_pack(v, lcfg)
                     kp2 = _smap(lambda c, n: c.at[pid_w, :, offm, :]
                                 .set(n[:, :, 0, :]), kpool, k_st)
                     vp2 = _smap(lambda c, n: c.at[pid_w, :, offm, :]
                                 .set(n[:, :, 0, :]), vpool, v_st)
-                    o = paged_attention(q, kp2, vp2, tblm, pos, cfg,
-                                        mesh=None)
+                    if sp_ok:
+                        o = _sp_local_paged_read(q, kp2, vp2, tblm,
+                                                 pos, lcfg, sp,
+                                                 sp_axis)
+                    else:
+                        o = paged_attention(q, kp2, vp2, tblm, pos,
+                                            lcfg, mesh=None)
                     return o, (kp2, vp2)
 
-                # ep demotes under pp (``ep_mesh``): replicated expert
-                # gather per stage, per-layer load discarded
-                h, carry, _ = _attn_ffn(layer, h, cfg, attend, lora=lora)
+                if composed:
+                    # round 24: tp partials psum / ep mixture psums
+                    # INSIDE the stage body; per-layer load discarded
+                    h, carry, _ = _attn_ffn_shard(
+                        layer, h, cfg, attend, lora=lora,
+                        tp_axis=tp_axis if tp_ok else None,
+                        ep_axis=ep_axis if ep_ok else None)
+                else:
+                    h, carry, _ = _attn_ffn(layer, h, cfg, attend,
+                                            lora=lora)
                 return h, carry
 
             h, (nkp, nvp) = jax.lax.scan(
@@ -1352,7 +1609,19 @@ def forward_paged_decode_pp(params, tokens, cfg: ModelConfig, pools,
             pos, tblm, offm = sl(pos_all), sl(tbl_all), sl(off_all)
             # bubble ticks scatter to the trash page instead of a real
             # page — there is no post-hoc mask for a scatter
-            pid_w = jnp.where(active, sl(pid_all), 0)
+            if sp_ok:
+                # striped pool: each shard owns global pages
+                # [shard*per, (shard+1)*per) with LOCAL page 0 its own
+                # trash — write only the rows whose page this stripe
+                # owns, route everything else (other stripes' rows,
+                # bubble ticks) to the stripe-local trash
+                pid_rows = sl(pid_all)
+                shard_sp = jax.lax.axis_index(sp_axis)
+                owned = (pid_rows // per_shard) == shard_sp
+                pid_w = jnp.where(active & owned,
+                                  pid_rows - shard_sp * per_shard, 0)
+            else:
+                pid_w = jnp.where(active, sl(pid_all), 0)
             ids = None if ids_all is None else sl(ids_all)
             y, kpl, vpl = run_stage(x_in, kpl, vpl, pos, tblm, pid_w,
                                     offm, ids)

@@ -738,15 +738,15 @@ PAGED_KERNEL_MAX_ROWS = 2048
 #: ``tpushare_attn_kernel_fallback_total`` (tests/test_metric_lint.py
 #: pins observations to this set)
 FALLBACK_REASONS = ("head_dim", "page_tile", "max_rows", "tp_heads",
-                    "sp_pool", "forced", "pp_layers", "pp_mesh",
-                    "pp_storage")
+                    "sp_pool", "forced", "pp_layers", "pp_storage")
 
 
 def pp_stage_fallback_reason(n_layers: int, pp: int, *, tp: int = 1,
                              sp: int = 1,
                              rolling: bool = False) -> Optional[str]:
-    """THE viability gate for the round-21 microbatched pipeline decode
-    program (``transformer.forward_pp_decode`` and its paged twin),
+    """THE viability gate for the microbatched pipeline decode program
+    (``transformer.forward_pp_decode`` and its paged twin, round 21;
+    composed over the full tp×sp×pp(×ep) mesh since round 24),
     returning WHY the staged program cannot run (None = viable).
 
     Every reason is STRUCTURAL — it applies on all platforms, like
@@ -759,19 +759,24 @@ def pp_stage_fallback_reason(n_layers: int, pp: int, *, tp: int = 1,
     * ``pp_layers`` — ``n_layers % pp != 0``: stages must own equal
       layer slices for the ``shard_map`` layer split (the placement
       sharding legalizes the same way: indivisible counts replicate).
-    * ``pp_mesh`` — ``tp`` or ``sp`` > 1: the staged program shard_maps
-      over the "pp" axis alone and does not nest with the tp/sp
-      shard_map read paths; a 3-D mesh serves via placement.
     * ``pp_storage`` — rolling-ring dense caches: the ring write path
       carries per-row wrap state the staged row-slice carry does not
       thread.
+
+    ``tp``/``sp`` are accepted for caller/mirror signature stability
+    but no longer refuse: the composed staged program (round 24) runs
+    ONE shard_map over the full mesh whose stage bodies execute the
+    per-shard tp attention/projection math (explicit ``psum`` where
+    GSPMD would all-reduce), the sp stripe walk + merge, and the ep
+    expert fold — the old ``pp_mesh`` demotion is gone.  Indivisible
+    tp head/feature counts degrade INSIDE the composed program to
+    tp-replicated weights (value-preserving, like placement
+    legalization), never to a refusal here.
     """
     if pp <= 1:
         return None
     if n_layers % pp:
         return "pp_layers"
-    if tp > 1 or sp > 1:
-        return "pp_mesh"
     if rolling:
         return "pp_storage"
     return None

@@ -39,7 +39,7 @@ from .quant import matmul_maybe_q as _mm
 #: replicated gather — value-preserving, never an error), mirroring
 #: ``ops.attention.FALLBACK_REASONS``.  Enum-pinned against
 #: ``tpushare_expert_fallback_total{reason=}`` in the metric lint.
-EXPERT_FALLBACK_REASONS = ("ep_experts", "ep_mesh")
+EXPERT_FALLBACK_REASONS = ("ep_experts",)
 
 
 def expert_fallback_reason(n_experts: int, ep: int,
@@ -49,7 +49,7 @@ def expert_fallback_reason(n_experts: int, ep: int,
     fallback sites can label ``tpushare_expert_fallback_total``.
 
     Every reason is STRUCTURAL (applies on all platforms, like
-    ``pp_mesh``), and a refusal is a DEMOTION, never an error: the
+    ``pp_layers``), and a refusal is a DEMOTION, never an error: the
     expert pool legalizes to replication and the plain gather serves
     the exact same streams — only the /ep per-device HBM saving is
     lost.
@@ -57,16 +57,17 @@ def expert_fallback_reason(n_experts: int, ep: int,
     * ``ep_experts`` — ``n_experts % ep != 0``: every shard must own an
       equal expert slice for the ``shard_map`` pool split (the
       placement sharding legalizes the same way).
-    * ``ep_mesh`` — ``pp > 1``: the ep shard_map does not nest inside
-      the round-21 staged wavefront (which shard_maps over "pp" alone);
-      ep composes with tp/sp only.
+
+    ``pp`` is accepted for caller/mirror signature stability but no
+    longer refuses: since the composed-mesh staged program (round 24)
+    the expert psum runs INSIDE the pipeline wavefront's stage bodies
+    (:func:`moe_ffn_shard`), so ep composes with tp, sp, AND staged pp
+    — the old ``ep_mesh`` demotion is gone.
     """
     if ep <= 1:
         return None
     if n_experts % ep:
         return "ep_experts"
-    if pp > 1:
-        return "ep_mesh"
     return None
 
 
@@ -141,13 +142,36 @@ def _moe_compute(x, gate, up, down, topi, topw, k: int):
     return y
 
 
+def _moe_local_mixture(xl, gl, ul, dl, ti, tw, k: int, shard):
+    """One ep shard's PRE-PSUM mixture partial: ``gl``/``ul``/``dl``
+    are the shard's local ``E/ep`` expert slice, ``shard`` its ep
+    axis index.  Slots routed outside the local expert range gather a
+    clipped row and contribute with weight EXACTLY 0.0, so summing the
+    partials over the ep axis (the caller's ``psum``) reproduces the
+    replicated mixture.  THE one local-mixture body —
+    :func:`_moe_compute_sharded` (the flat program's own shard_map)
+    and :func:`moe_ffn_shard` (the composed staged stage body, round
+    24) both route here so the two cannot drift."""
+    e_local = gl.shape[0]
+    lo = shard * e_local
+    local = ti - lo                                  # [B, S, k]
+    ok = (local >= 0) & (local < e_local)
+    ids = jnp.clip(local, 0, e_local - 1)
+    y = jnp.zeros(xl.shape[:-1] + (dl.shape[-1],), xl.dtype)
+    for slot in range(k):
+        w = tw[..., slot] * ok[..., slot].astype(tw.dtype)
+        y = y + _expert_block(xl, gl, ul, dl, ids[..., slot]) \
+            * w[..., None].astype(xl.dtype)
+    return y
+
+
 def _moe_compute_sharded(x, gate, up, down, topi, topw, k: int, mesh,
                          axis: str):
     """Expert-parallel mixture: each shard owns ``E/ep`` experts
     (``shard_map`` over the ``ep`` axis alone — activations and routing
     replicate), evaluates only the slots that land in its local expert
-    range (out-of-range slots gather a clipped row and contribute with
-    weight EXACTLY 0.0), and one ``psum`` folds the shard partials.
+    range (:func:`_moe_local_mixture`), and one ``psum`` folds the
+    shard partials.
 
     The per-shard FLOPs equal the replicated path's (masked, not
     skipped — static shapes); the ep win is expert-pool HBM: each
@@ -165,22 +189,73 @@ def _moe_compute_sharded(x, gate, up, down, topi, topw, k: int, mesh,
 
     def body(xl, gl, ul, dl, ti, tw):
         shard = jax.lax.axis_index(axis)
-        e_local = gl.shape[0]
-        lo = shard * e_local
-        local = ti - lo                              # [B, S, k]
-        ok = (local >= 0) & (local < e_local)
-        ids = jnp.clip(local, 0, e_local - 1)
-        y = jnp.zeros(xl.shape[:-1] + (dl.shape[-1],), xl.dtype)
-        for slot in range(k):
-            w = tw[..., slot] * ok[..., slot].astype(tw.dtype)
-            y = y + _expert_block(xl, gl, ul, dl, ids[..., slot]) \
-                * w[..., None].astype(xl.dtype)
+        y = _moe_local_mixture(xl, gl, ul, dl, ti, tw, k, shard)
         return jax.lax.psum(y, axis)
 
     return shard_map(body, mesh=mesh,
                      in_specs=(rep, pool, pool, pool, rep, rep),
                      out_specs=rep, check_vma=False)(
         x, gate, up, down, topi, topw)
+
+
+def _route_topk(x, layer, cfg):
+    """Router → top-k → renormalize → forced-layer override: the ONE
+    routing computation, shared by :func:`moe_ffn` (the flat programs)
+    and :func:`moe_ffn_shard` (the composed staged stage body) so the
+    two cannot drift — the op order is golden-pinned (round 22).
+    Returns ``(topi [B,S,k] int32, topw [B,S,k] f32, load [E] f32)``;
+    routing runs replicated (the router leaf never shards), so every
+    shard computes identical assignments deterministically."""
+    e = cfg.n_experts
+    route = layer["moe_route"]
+    logits = _mm(x, layer["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # [B, S, E]
+    topw, topi = jax.lax.top_k(probs, cfg.moe_top_k)  # [B, S, k]
+    topw = topw / topw.sum(axis=-1, keepdims=True)
+    forced_w = jnp.zeros_like(topw).at[..., 0].set(1.0)
+    topi = jnp.where(route > 0, topi, 0)
+    topw = jnp.where(route > 0, topw, forced_w)
+    load = (jax.nn.one_hot(topi, e, dtype=jnp.float32)
+            .sum(axis=(0, 1, 2)) * route)            # [E]
+    return topi, topw, load
+
+
+def moe_ffn_shard(x, layer, cfg, ep_axis: Optional[str] = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Routed expert FFN for one layer INSIDE an existing ``shard_map``
+    — the composed staged stage body's entry point (round 24): the
+    caller is already a per-device program, so no shard_map wrapper
+    here.  Activations and routing replicate per shard
+    (:func:`_route_topk` — deterministic, identical on every shard);
+    with ``ep_axis`` set the layer's ``moe_gate``/``moe_up``/
+    ``moe_down`` leaves are this shard's LOCAL ``E/ep`` slice and the
+    local mixture partial (:func:`_moe_local_mixture`) folds with one
+    ``psum`` over ``ep_axis`` — exactly the collective
+    :func:`_moe_compute_sharded` inserts, so composed-staged MoE
+    streams equal the flat ep program's.  ``ep_axis=None`` runs the
+    replicated mixture (an ep-refused or ep=1 composed config).
+    Callers gate via :func:`expert_fallback_reason`; the ``E=1, k=1``
+    degenerate short-circuits identically to :func:`moe_ffn`.
+    Returns ``(y, load)`` like :func:`moe_ffn`."""
+    e, k = cfg.n_experts, cfg.moe_top_k
+    route = layer["moe_route"]
+    n_tokens = x.shape[0] * x.shape[1]
+    if e == 1 and k == 1:
+        g = _mm(x, layer["moe_gate"][0])
+        u = _mm(x, layer["moe_up"][0])
+        y = _mm(jax.nn.silu(g) * u, layer["moe_down"][0])
+        return y, jnp.full((1,), float(n_tokens), jnp.float32) * route
+    topi, topw, load = _route_topk(x, layer, cfg)
+    if ep_axis is None:
+        y = _moe_compute(x, layer["moe_gate"], layer["moe_up"],
+                         layer["moe_down"], topi, topw, k)
+    else:
+        shard = jax.lax.axis_index(ep_axis)
+        y = jax.lax.psum(
+            _moe_local_mixture(x, layer["moe_gate"], layer["moe_up"],
+                               layer["moe_down"], topi, topw, k,
+                               shard), ep_axis)
+    return y, load
 
 
 def moe_ffn(x, layer, cfg, mesh=None, axis: str = "ep"
@@ -220,15 +295,7 @@ def moe_ffn(x, layer, cfg, mesh=None, axis: str = "ep"
         u = _mm(x, layer["moe_up"][0])
         y = _mm(jax.nn.silu(g) * u, layer["moe_down"][0])
         return y, jnp.full((1,), float(n_tokens), jnp.float32) * route
-    logits = _mm(x, layer["router"]).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)          # [B, S, E]
-    topw, topi = jax.lax.top_k(probs, k)             # [B, S, k]
-    topw = topw / topw.sum(axis=-1, keepdims=True)
-    forced_w = jnp.zeros_like(topw).at[..., 0].set(1.0)
-    topi = jnp.where(route > 0, topi, 0)
-    topw = jnp.where(route > 0, topw, forced_w)
-    load = (jax.nn.one_hot(topi, e, dtype=jnp.float32)
-            .sum(axis=(0, 1, 2)) * route)            # [E]
+    topi, topw, load = _route_topk(x, layer, cfg)
     ep = 1
     if mesh is not None and axis in mesh.axis_names:
         ep = int(mesh.shape[axis])

@@ -314,8 +314,8 @@ def shard_adapter_pool(pool, mesh: Mesh, axis: str = "tp",
 #: shard_map, once).  Suffix-clash safe with SHARDING_RULES
 #: ("moe_gate" does not end with "w_gate"); prepend these to the base
 #: list so an ep mesh shards the pool and a no-ep mesh legalizes every
-#: entry back to replication — the ``ep_experts``/``ep_mesh`` gate
-#: demotion costs placement only, never correctness.
+#: entry back to replication — the ``ep_experts`` gate demotion costs
+#: placement only, never correctness.
 EXPERT_SHARDING_RULES: List[Tuple[str, P]] = [
     ("router", P()),
     ("moe_route", P()),

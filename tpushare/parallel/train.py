@@ -222,7 +222,7 @@ def make_pipeline_train_step(cfg: transformer.ModelConfig, optimizer,
             # shard_map hands the layer a dp-shard of each microbatch
             positions = jnp.broadcast_to(jnp.arange(s)[None, :],
                                          (x.shape[0], s))
-            x, _ = transformer._attn_ffn(
+            x, _, _ = transformer._attn_ffn(
                 layer, x, cfg,
                 lambda lyr, xin: transformer._attend_dense(
                     lyr, xin, cfg, positions))

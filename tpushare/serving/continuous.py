@@ -161,7 +161,9 @@ def _pp_forward(params, tokens, caches, lengths, cfg, pp,
     replicated gather, which a dense-FFN config traces byte-identically
     to the pre-MoE program), and ``expert_load`` is the dispatch's
     [E] token→expert assignment counts (None for dense-FFN configs and
-    for the staged pipeline, where ep demotes — the ``ep_mesh`` gate)."""
+    for the staged pipeline — the composed stage bodies run the ep
+    psum inline, round 24, but the wavefront carry discards per-layer
+    load)."""
     if pp is None:
         return transformer.forward(
             params, tokens, cfg, kv_caches=caches, cache_len=lengths,
@@ -170,7 +172,7 @@ def _pp_forward(params, tokens, caches, lengths, cfg, pp,
     mesh, n_micro = pp
     logits, caches = transformer.forward_pp_decode(
         params, tokens, cfg, caches, lengths, mesh, n_micro=n_micro,
-        adapters=adapters, adapter_ids=aids)
+        adapters=adapters, adapter_ids=aids, moe_mesh=moe)
     return logits, caches, None
 
 
@@ -213,7 +215,7 @@ def _decode_scan(params, tokens, caches, lengths, temps, keys, tks, tps,
     one dispatch.  A MoE config accumulates the per-step expert load
     through the scan carry (summed [E] counts for the whole chunk;
     None when the config is dense-FFN or the staged pipeline runs —
-    ep demotes under pp, so no load is produced to track)."""
+    the composed wavefront discards per-layer load, round 24)."""
     track_load = bool(getattr(cfg, "n_experts", 0)) and pp is None
 
     def body(carry, _):
@@ -609,8 +611,11 @@ class ContinuousBatcher:
         count (must divide ``n_slots``); default = largest divisor of
         ``n_slots`` that is <= ``pp``.  Structural refusals
         (:func:`tpushare.ops.attention.pp_stage_fallback_reason`:
-        ``pp_layers``/``pp_mesh``/``pp_storage``) DEMOTE the staged
-        program to placement-only — counted, never a crash."""
+        ``pp_layers``/``pp_storage``) DEMOTE the staged program to
+        placement-only — counted, never a crash.  Since round 24 the
+        wavefront COMPOSES with tp/sp/ep on one mesh (the stage bodies
+        run the per-shard attention reads and the ep psum inline), so
+        a composed mesh no longer demotes."""
         self.mesh = mesh
         self.spec_k = max(0, int(spec_k))
         if rolling_slots is None:
@@ -670,9 +675,10 @@ class ContinuousBatcher:
         # the mesh as the static ``moe`` operand into every jitted
         # program (the per-layer gather runs shard-local + psum).
         # Structural refusals (ops.experts.expert_fallback_reason:
-        # ``ep_experts`` = n_experts % ep, ``ep_mesh`` = the staged pp
-        # program keeps its flat replicated gather) DEMOTE to a
-        # replicated pool — counted, never a crash.  The demoted case
+        # ``ep_experts`` = n_experts % ep) DEMOTE to a replicated
+        # pool — counted, never a crash; since round 24 the staged pp
+        # program runs the ep psum inside its stage bodies, so pp no
+        # longer refuses.  The demoted case
         # must ALSO skip the ep sharding rules: a pool the partitioner
         # has to all-gather per dispatch is strictly worse than
         # replication.
@@ -948,6 +954,15 @@ class ContinuousBatcher:
             metrics.ICI_BYTES.inc(ici)
         metrics.refresh_roofline()
 
+    def flush_cost(self) -> None:
+        """Flush residual cost accumulations into the program FLOP /
+        HBM / ICI counters NOW.  The steady-state flush rides the
+        DERIVED_OBSERVE_EVERY cadence in ``_observe_tick`` — a server
+        that stops (or goes idle) before serving 16 rounds would
+        otherwise report zero work forever.  Idempotent (the
+        accumulators drain); call from the thread that ticks."""
+        self._cost_flush()
+
     def _observe_prefill(self) -> None:
         """Mirror the mid-prefill queue depth into /metrics (every site
         that grows or shrinks ``self.prefilling`` calls this)."""
@@ -1046,9 +1061,8 @@ class ContinuousBatcher:
         dense and paged ``storage_info``: the THIRD HBM pool class —
         the stacked expert weights a MoE cfg keeps resident.  With the
         ep gate admitted the pool shards its expert axis, so per-shard
-        bytes divide by the mesh's ep degree; demoted (``ep_experts``/
-        ``ep_mesh``) or mesh-less configs hold the whole pool
-        replicated."""
+        bytes divide by the mesh's ep degree; demoted (``ep_experts``)
+        or mesh-less configs hold the whole pool replicated."""
         cfg = self.cfg
         if not getattr(cfg, "n_experts", 0):
             return {}
@@ -3372,6 +3386,7 @@ class ContinuousService:
                             log.exception("stream on_complete callback "
                                           "raised; continuing")
                     entry[0].put(("done", out))
+            idle = False
             with self._lock:
                 queued = len(self._waiting)
                 if (not active and not self._batcher.prefilling
@@ -3382,9 +3397,21 @@ class ContinuousService:
                         and not (self._spill is not None
                                  and len(self._spill))):
                     self._work.clear()
+                    idle = True
+            if idle:
+                # going idle: push whatever the DERIVED_OBSERVE_EVERY
+                # cadence has not flushed yet — a burst shorter than
+                # 16 rounds must still show up in the work counters
+                # (outside the lock; flush touches only loop-owned
+                # accumulators + the registry's own locks)
+                self._batcher.flush_cost()
             # backpressure visibility: requests submitted but not yet
             # admitted to a slot — the DEMAND signal the tenant-policy
             # slack reallocation reads (a tenant with queued work is
             # under-using involuntarily and donates nothing; see
             # serving/policy.py effective_entitlements)
             metrics.REQUEST_QUEUE_DEPTH.set(queued)
+        # loop exit (stop / drain-to-halt): flush whatever the cadence
+        # left behind — still on the loop thread, so the accumulators
+        # are ours to drain
+        self._batcher.flush_cost()

@@ -82,9 +82,9 @@ def _pp_forward(params, tokens, pools, page_table, lengths, cfg, mesh,
 
     Returns (logits, pools, expert_load) like the dense twin: load is
     the per-expert routed-token count of a MoE forward, None for dense
-    cfgs AND under the staged pipeline program (the ``ep_mesh``
-    demotion — the stage wavefront owns the layer loop and keeps the
-    replicated gather)."""
+    cfgs AND under the staged pipeline program (the composed stage
+    bodies run the ep psum inline, round 24, but the wavefront carry
+    discards per-layer load)."""
     if pp is None:
         return transformer.forward_paged_decode(
             params, tokens, cfg, pools, page_table, lengths, mesh=mesh,
@@ -93,7 +93,8 @@ def _pp_forward(params, tokens, pools, page_table, lengths, cfg, mesh,
     pmesh, n_micro = pp
     logits, pools = transformer.forward_paged_decode_pp(
         params, tokens, cfg, pools, page_table, lengths, pmesh,
-        n_micro=n_micro, adapters=adapters, adapter_ids=aids)
+        n_micro=n_micro, adapters=adapters, adapter_ids=aids,
+        moe_mesh=moe)
     return logits, pools, None
 
 
